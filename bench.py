@@ -1,0 +1,86 @@
+"""Headline benchmark: ResNet-50 ImageNet-shape training throughput,
+images/sec/chip (BASELINE.md: ≥ 360 img/s = nd4j-cuda V100-class fp32).
+
+Runs on the real TPU (default JAX platform in this environment — axon).
+Synthetic ImageNet-shaped data generated ON DEVICE (zero-egress env; the
+host pipeline is benchmarked separately in tests) so the number measures
+the training-step compute path: whole step = ONE jitted XLA executable
+(fwd + bwd + SGD-momentum update, bf16 activations / fp32 masters).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N/360}
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_IMG_S = 360.0
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from deeplearning4j_tpu.models.zoo import ResNet50
+    from deeplearning4j_tpu.nn.updaters import Nesterovs
+
+    model = ResNet50(numClasses=1000, dataType="bfloat16",
+                     inputShape=(224, 224, 3),
+                     updater=Nesterovs(0.1, 0.9))
+    net = model.init()
+
+    # on-device synthetic batch (static): uniform images + random one-hots
+    key = jax.random.PRNGKey(0)
+    kx, ky = jax.random.split(key)
+    x = jax.random.uniform(kx, (batch, 224, 224, 3), jnp.float32)
+    labels = jax.random.randint(ky, (batch,), 0, 1000)
+    y = jax.nn.one_hot(labels, 1000, dtype=jnp.float32)
+
+    ins = {"input": x}
+    labs = [y]
+
+    step = net._train_step
+    params, opt, state = net._params, net._opt_state, net._state
+    rng = jax.random.PRNGKey(1)
+
+    t_compile = time.perf_counter()
+    for i in range(warmup):
+        params, opt, state, loss = step(params, opt, state, ins, labs, None,
+                                        None, jax.random.fold_in(rng, i))
+    jax.block_until_ready(loss)
+    compile_s = time.perf_counter() - t_compile
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, opt, state, loss = step(params, opt, state, ins, labs, None,
+                                        None, jax.random.fold_in(rng, 100 + i))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    result = {
+        "metric": "resnet50_imagenet_images_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }
+    print(json.dumps(result))
+    print(f"# batch={batch} steps={steps} step_time={dt/steps*1000:.1f}ms "
+          f"loss={float(loss):.3f} warmup+compile={compile_s:.1f}s "
+          f"device={jax.devices()[0]}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

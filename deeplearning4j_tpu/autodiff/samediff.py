@@ -1,0 +1,602 @@
+"""SameDiff-equivalent autodiff graph engine (≡ nd4j-api ::
+autodiff.samediff.SameDiff / SDVariable).
+
+The reference builds an op graph, differentiates it symbolically, and
+executes op-by-op on the CUDA executioner. Here the graph records ops as
+composable pure functions; `output()`/`fit()` trace the WHOLE graph into a
+single jitted XLA executable (the "compile SameDiff graphs whole into one
+XLA executable" north-star line in BASELINE.json), and gradients come from
+`jax.grad` of that executable rather than symbolic graph surgery.
+
+Variable kinds mirror the reference: PLACEHOLDER (fed at exec), VARIABLE
+(trainable), CONSTANT.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.nn.updaters import Updater, build_optimizer
+from deeplearning4j_tpu.ops.ndarray import NDArray, as_jax
+
+
+class VariableType:
+    PLACEHOLDER = "placeholder"
+    VARIABLE = "variable"
+    CONSTANT = "constant"
+    ARRAY = "array"  # op outputs
+
+
+class SDVariable:
+    def __init__(self, sd, name, vtype, shape=None, fn=None, inputs=()):
+        self.sd = sd
+        self.name = name
+        self.vtype = vtype
+        self.shape = shape
+        self.fn = fn                    # for ARRAY nodes: f(*input_arrays)
+        self.inputs = list(inputs)      # parent variable names
+
+    # -- fluent math (mirrors SDVariable's operator surface) -------------
+    def _bin(self, other, fn, opname):
+        other = self.sd._lift(other)
+        return self.sd._op(opname, fn, self, other)
+
+    def add(self, o):
+        return self._bin(o, jnp.add, "add")
+
+    def sub(self, o):
+        return self._bin(o, jnp.subtract, "sub")
+
+    def mul(self, o):
+        return self._bin(o, jnp.multiply, "mul")
+
+    def div(self, o):
+        return self._bin(o, jnp.divide, "div")
+
+    def rsub(self, o):
+        return self.sd._lift(o)._bin(self, jnp.subtract, "rsub")
+
+    def rdiv(self, o):
+        return self.sd._lift(o)._bin(self, jnp.divide, "rdiv")
+
+    def mmul(self, o):
+        return self._bin(o, jnp.matmul, "mmul")
+
+    def pow(self, p):
+        return self.sd._op("pow", lambda a: jnp.power(a, p), self)
+
+    def neg(self):
+        return self.sd._op("neg", jnp.negative, self)
+
+    def transpose(self, *axes):
+        ax = axes or None
+        return self.sd._op("transpose",
+                           lambda a: jnp.transpose(a, ax), self)
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return self.sd._op("reshape", lambda a: jnp.reshape(a, shape), self)
+
+    def _reduce(self, fn, opname, dims, keepdims):
+        ax = None
+        if dims:
+            ax = dims[0] if len(dims) == 1 else tuple(dims)
+        return self.sd._op(opname,
+                           lambda a: fn(a, axis=ax, keepdims=keepdims), self)
+
+    def sum(self, *dims, keepdims=False):
+        return self._reduce(jnp.sum, "sum", dims, keepdims)
+
+    def mean(self, *dims, keepdims=False):
+        return self._reduce(jnp.mean, "mean", dims, keepdims)
+
+    def max(self, *dims, keepdims=False):
+        return self._reduce(jnp.max, "max", dims, keepdims)
+
+    def min(self, *dims, keepdims=False):
+        return self._reduce(jnp.min, "min", dims, keepdims)
+
+    def std(self, *dims, keepdims=False):
+        return self._reduce(jnp.std, "std", dims, keepdims)
+
+    def argmax(self, dim=-1):
+        return self.sd._op("argmax", lambda a: jnp.argmax(a, axis=dim), self)
+
+    # python operators
+    __add__ = add
+    __radd__ = add
+    __sub__ = sub
+    __mul__ = mul
+    __rmul__ = mul
+    __truediv__ = div
+    __matmul__ = mmul
+
+    def __rsub__(self, o):
+        return self.rsub(o)
+
+    def __rtruediv__(self, o):
+        return self.rdiv(o)
+
+    def __neg__(self):
+        return self.neg()
+
+    def __pow__(self, p):
+        return self.pow(p)
+
+    def rename(self, new_name):
+        return self.sd.rename(self.name, new_name)
+
+    def eval(self, placeholders=None):
+        return self.sd.output(placeholders or {}, [self.name])[self.name]
+
+    def getArr(self):
+        if self.vtype in (VariableType.VARIABLE, VariableType.CONSTANT):
+            return NDArray(self.sd._values[self.name])
+        return self.eval()
+
+    def setArray(self, arr):
+        self.sd._values[self.name] = as_jax(arr)
+        self.sd._invalidate()
+
+    def __repr__(self):
+        return f"SDVariable(name={self.name!r}, type={self.vtype})"
+
+
+class _MathNamespace:
+    def __init__(self, sd):
+        self.sd = sd
+
+    def _u(self, opname, fn, x):
+        return self.sd._op(opname, fn, self.sd._lift(x))
+
+    def exp(self, x):
+        return self._u("exp", jnp.exp, x)
+
+    def log(self, x):
+        return self._u("log", jnp.log, x)
+
+    def sqrt(self, x):
+        return self._u("sqrt", jnp.sqrt, x)
+
+    def square(self, x):
+        return self._u("square", jnp.square, x)
+
+    def abs(self, x):
+        return self._u("abs", jnp.abs, x)
+
+    def sin(self, x):
+        return self._u("sin", jnp.sin, x)
+
+    def cos(self, x):
+        return self._u("cos", jnp.cos, x)
+
+    def tanh(self, x):
+        return self._u("tanh", jnp.tanh, x)
+
+    def sigmoid(self, x):
+        return self._u("sigmoid", jax.nn.sigmoid, x)
+
+    def clip(self, x, lo, hi):
+        return self._u("clip", lambda a: jnp.clip(a, lo, hi), x)
+
+
+class _NNNamespace:
+    def __init__(self, sd):
+        self.sd = sd
+
+    def relu(self, x):
+        return self.sd._op("relu", jax.nn.relu, self.sd._lift(x))
+
+    def gelu(self, x):
+        return self.sd._op("gelu", jax.nn.gelu, self.sd._lift(x))
+
+    def softmax(self, x, axis=-1):
+        return self.sd._op("softmax",
+                           lambda a: jax.nn.softmax(a, axis=axis),
+                           self.sd._lift(x))
+
+    def logSoftmax(self, x, axis=-1):
+        return self.sd._op("log_softmax",
+                           lambda a: jax.nn.log_softmax(a, axis=axis),
+                           self.sd._lift(x))
+
+    def tanh(self, x):
+        return self.sd._op("tanh", jnp.tanh, self.sd._lift(x))
+
+    def sigmoid(self, x):
+        return self.sd._op("sigmoid", jax.nn.sigmoid, self.sd._lift(x))
+
+    def dropout(self, x, keep_prob):
+        # inference identity; train-time dropout arrives via fit rngs
+        return self.sd._op("dropout_id", lambda a: a, self.sd._lift(x))
+
+    def linear(self, input, weights, bias=None):
+        if bias is None:
+            return input.mmul(weights)
+        return input.mmul(weights).add(bias)
+
+    def layerNorm(self, x, gain, bias=None, eps=1e-5, axis=-1):
+        x, gain = self.sd._lift(x), self.sd._lift(gain)
+
+        def f(a, g, *b):
+            mu = jnp.mean(a, axis=axis, keepdims=True)
+            var = jnp.var(a, axis=axis, keepdims=True)
+            y = (a - mu) * jax.lax.rsqrt(var + eps) * g
+            return y + b[0] if b else y
+
+        ins = (x, gain) + ((self.sd._lift(bias),) if bias is not None else ())
+        return self.sd._op("layer_norm", f, *ins)
+
+    def batchNorm(self, x, mean, var, gamma, beta, eps=1e-5):
+        def f(a, m, v, g, b):
+            return (a - m) * jax.lax.rsqrt(v + eps) * g + b
+        return self.sd._op("batch_norm", f, *(self.sd._lift(v) for v in
+                                              (x, mean, var, gamma, beta)))
+
+
+class _LossNamespace:
+    def __init__(self, sd):
+        self.sd = sd
+
+    def softmaxCrossEntropy(self, name, labels, logits):
+        labels, logits = self.sd._lift(labels), self.sd._lift(logits)
+
+        def f(y, z):
+            return -jnp.mean(jnp.sum(y * jax.nn.log_softmax(z, -1), -1))
+
+        return self.sd._op_named(name, "softmax_xent", f, labels, logits)
+
+    def sigmoidCrossEntropy(self, name, labels, logits):
+        labels, logits = self.sd._lift(labels), self.sd._lift(logits)
+
+        def f(y, z):
+            per = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+            return jnp.mean(jnp.sum(per, -1))
+
+        return self.sd._op_named(name, "sigmoid_xent", f, labels, logits)
+
+    def meanSquaredError(self, name, labels, predictions):
+        labels, predictions = self.sd._lift(labels), self.sd._lift(predictions)
+
+        def f(y, p):
+            return jnp.mean((y - p) ** 2)
+
+        return self.sd._op_named(name, "mse", f, labels, predictions)
+
+    def l2Loss(self, name, x):
+        return self.sd._op_named(name, "l2", lambda a: 0.5 * jnp.sum(a * a),
+                                 self.sd._lift(x))
+
+
+class TrainingConfig:
+    """≡ org.nd4j.autodiff.samediff.TrainingConfig.Builder."""
+
+    def __init__(self, updater=None, l1=0.0, l2=0.0,
+                 dataSetFeatureMapping=None, dataSetLabelMapping=None):
+        self.updater = updater
+        self.l1 = float(l1)
+        self.l2 = float(l2)
+        self.dataSetFeatureMapping = dataSetFeatureMapping or []
+        self.dataSetLabelMapping = dataSetLabelMapping or []
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def updater(self, u):
+            self._kw["updater"] = u
+            return self
+
+        def l1(self, v):
+            self._kw["l1"] = v
+            return self
+
+        def l2(self, v):
+            self._kw["l2"] = v
+            return self
+
+        def dataSetFeatureMapping(self, *names):
+            self._kw["dataSetFeatureMapping"] = list(names)
+            return self
+
+        def dataSetLabelMapping(self, *names):
+            self._kw["dataSetLabelMapping"] = list(names)
+            return self
+
+        def build(self):
+            return TrainingConfig(**self._kw)
+
+
+class SameDiff:
+    def __init__(self):
+        self._nodes = {}      # name -> SDVariable
+        self._values = {}     # VARIABLE/CONSTANT name -> jnp array
+        self._counter = 0
+        self._loss_names = []
+        self._training_config = None
+        self._opt_state = None
+        self._tx = None
+        self._rng = np.random.default_rng(0)
+        self._exec_cache = {}
+        self.math = _MathNamespace(self)
+        self.nn = _NNNamespace(self)
+        self.loss = _LossNamespace(self)
+
+    @staticmethod
+    def create():
+        return SameDiff()
+
+    def _invalidate(self):
+        self._exec_cache = {}
+
+    # -- variable creation ----------------------------------------------
+    def _fresh(self, base):
+        self._counter += 1
+        return f"{base}_{self._counter}"
+
+    def placeHolder(self, name, *shape, dtype=None):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        v = SDVariable(self, name, VariableType.PLACEHOLDER, shape)
+        self._nodes[name] = v
+        return v
+
+    def var(self, name, init=None, shape=None):
+        """Trainable variable: init can be an array or a shape tuple (then
+        xavier-initialized)."""
+        if init is None and shape is not None:
+            init = shape
+        if isinstance(init, (tuple, list)) and all(
+                isinstance(i, (int, np.integer)) for i in init):
+            fan_in = init[0] if len(init) > 1 else 1
+            arr = (self._rng.standard_normal(tuple(init))
+                   * np.sqrt(1.0 / max(1, fan_in))).astype(np.float32)
+        else:
+            arr = np.asarray(as_jax(init))
+        v = SDVariable(self, name, VariableType.VARIABLE,
+                       tuple(arr.shape))
+        self._nodes[name] = v
+        self._values[name] = jnp.asarray(arr)
+        self._invalidate()
+        return v
+
+    def constant(self, name, value=None):
+        if value is None:
+            name, value = self._fresh("const"), name
+        arr = as_jax(value)
+        v = SDVariable(self, name, VariableType.CONSTANT, tuple(arr.shape))
+        self._nodes[name] = v
+        self._values[name] = arr
+        self._invalidate()
+        return v
+
+    def _lift(self, x):
+        if isinstance(x, SDVariable):
+            return x
+        return self.constant(self._fresh("lit"), x)
+
+    # -- op recording ----------------------------------------------------
+    def _op(self, opname, fn, *inputs):
+        return self._op_named(self._fresh(opname), opname, fn, *inputs)
+
+    def _op_named(self, name, opname, fn, *inputs):
+        v = SDVariable(self, name, VariableType.ARRAY, None, fn,
+                       [i.name for i in inputs])
+        self._nodes[name] = v
+        self._invalidate()
+        return v
+
+    def rename(self, old, new):
+        v = self._nodes.pop(old)
+        v.name = new
+        self._nodes[new] = v
+        for node in self._nodes.values():
+            node.inputs = [new if i == old else i for i in node.inputs]
+        if old in self._values:
+            self._values[new] = self._values.pop(old)
+        if old in self._loss_names:
+            self._loss_names = [new if n == old else n for n in self._loss_names]
+        self._invalidate()
+        return v
+
+    def getVariable(self, name):
+        return self._nodes[name]
+
+    def variables(self):
+        return [v for v in self._nodes.values()
+                if v.vtype == VariableType.VARIABLE]
+
+    # -- execution -------------------------------------------------------
+    def _topo(self, targets):
+        order, seen = [], set()
+
+        def visit(name):
+            if name in seen:
+                return
+            seen.add(name)
+            for p in self._nodes[name].inputs:
+                visit(p)
+            order.append(name)
+
+        for t in targets:
+            visit(t)
+        return order
+
+    def _make_exec(self, out_names):
+        """Build one pure function (values, placeholders) -> outputs dict,
+        jit-compiled: the whole graph is a single XLA executable."""
+        order = self._topo(out_names)
+        nodes = {n: self._nodes[n] for n in order}
+
+        def run(values, placeholders):
+            env = {}
+            for n in order:
+                node = nodes[n]
+                if node.vtype == VariableType.PLACEHOLDER:
+                    env[n] = placeholders[n]
+                elif node.vtype in (VariableType.VARIABLE, VariableType.CONSTANT):
+                    env[n] = values[n]
+                else:
+                    env[n] = node.fn(*(env[i] for i in node.inputs))
+            return {n: env[n] for n in out_names}
+
+        return run
+
+    def output(self, placeholders, outputs):
+        """≡ SameDiff.output(Map, String...) — returns dict name->NDArray."""
+        if isinstance(outputs, str):
+            outputs = [outputs]
+        key = tuple(outputs)
+        if key not in self._exec_cache:
+            self._exec_cache[key] = jax.jit(self._make_exec(key))
+        phs = {k: as_jax(v) for k, v in (placeholders or {}).items()}
+        res = self._exec_cache[key](self._values, phs)
+        return {k: NDArray(v) for k, v in res.items()}
+
+    def outputSingle(self, placeholders, output):
+        return self.output(placeholders, [output])[output]
+
+    def batchOutput(self):
+        sd = self
+
+        class _B:
+            def __init__(self):
+                self._phs, self._outs = {}, []
+
+            def input(self, name, arr):
+                self._phs[name] = arr
+                return self
+
+            def output(self, *names):
+                self._outs.extend(names)
+                return self
+
+            def outputSingle(self):
+                return sd.output(self._phs, self._outs)[self._outs[0]]
+
+            def exec(self):
+                return sd.output(self._phs, self._outs)
+
+        return _B()
+
+    # -- training --------------------------------------------------------
+    def setLossVariables(self, *names):
+        self._loss_names = [n.name if isinstance(n, SDVariable) else n
+                            for n in names]
+
+    def setTrainingConfig(self, tc):
+        self._training_config = tc
+        self._tx = None
+
+    def _total_loss(self, values, placeholders):
+        runner = self._make_exec(tuple(self._loss_names))
+        outs = runner(values, placeholders)
+        total = 0.0
+        for n in self._loss_names:
+            total = total + jnp.sum(outs[n])
+        tc = self._training_config
+        if tc is not None and (tc.l1 or tc.l2):
+            for v in self.variables():
+                arr = values[v.name]
+                if tc.l1:
+                    total = total + tc.l1 * jnp.sum(jnp.abs(arr))
+                if tc.l2:
+                    total = total + 0.5 * tc.l2 * jnp.sum(arr * arr)
+        return total
+
+    def _ensure_optimizer(self):
+        if self._tx is None:
+            tc = self._training_config
+            if tc is None or tc.updater is None:
+                raise ValueError("setTrainingConfig with an updater before fit()")
+            self._tx = (tc.updater.to_optax()
+                        if isinstance(tc.updater, Updater) else tc.updater)
+            var_names = [v.name for v in self.variables()]
+            self._opt_state = self._tx.init(
+                {n: self._values[n] for n in var_names})
+
+    @functools.cached_property
+    def _fit_step(self):
+        tx_holder = self
+
+        @jax.jit
+        def step(var_values, const_values, opt_state, placeholders):
+            values = {**const_values, **var_values}
+            loss, grads = jax.value_and_grad(
+                lambda vv: tx_holder._total_loss({**const_values, **vv},
+                                                 placeholders))(var_values)
+            updates, opt_state = tx_holder._tx.update(grads, opt_state,
+                                                      var_values)
+            var_values = optax.apply_updates(var_values, updates)
+            return var_values, opt_state, loss
+
+        return step
+
+    def fit(self, dataset=None, placeholders=None):
+        """fit(DataSet) using TrainingConfig mappings, or
+        fit(placeholders=dict) feeding labels directly."""
+        self._ensure_optimizer()
+        tc = self._training_config
+        if placeholders is None:
+            from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+            if isinstance(dataset, DataSet):
+                feats, labs = [dataset.features], [dataset.labels]
+            elif isinstance(dataset, MultiDataSet):
+                feats, labs = dataset.features, dataset.labels
+            else:
+                raise TypeError(f"Cannot fit on {type(dataset)}")
+            placeholders = {}
+            for name, arr in zip(tc.dataSetFeatureMapping, feats):
+                placeholders[name] = arr
+            for name, arr in zip(tc.dataSetLabelMapping, labs):
+                placeholders[name] = arr
+        phs = {k: as_jax(v) for k, v in placeholders.items()}
+        var_names = [v.name for v in self.variables()]
+        var_values = {n: self._values[n] for n in var_names}
+        const_values = {k: v for k, v in self._values.items()
+                        if k not in var_values}
+        var_values, self._opt_state, loss = self._fit_step(
+            var_values, const_values, self._opt_state, phs)
+        self._values.update(var_values)
+        return float(loss)
+
+    def calculateGradients(self, placeholders, *wrt):
+        """≡ SameDiff.calculateGradients — gradients of the loss wrt the
+        given variable names."""
+        if not self._loss_names:
+            raise ValueError("setLossVariables(...) first")
+        wrt = [w.name if isinstance(w, SDVariable) else w for w in wrt]
+        phs = {k: as_jax(v) for k, v in (placeholders or {}).items()}
+        var_values = {n: self._values[n] for n in
+                      [v.name for v in self.variables()]}
+        const_values = {k: v for k, v in self._values.items()
+                        if k not in var_values}
+        grads = jax.grad(
+            lambda vv: self._total_loss({**const_values, **vv}, phs))(var_values)
+        return {n: NDArray(grads[n]) for n in wrt}
+
+    def grad(self, name):
+        raise RuntimeError("Use calculateGradients(placeholders, names...)")
+
+    # -- persistence -----------------------------------------------------
+    def save(self, path, save_updater=False):
+        import pickle
+        blob = {"values": {k: np.asarray(v) for k, v in self._values.items()},
+                "loss_names": self._loss_names}
+        with open(path, "wb") as f:
+            pickle.dump(blob, f)
+
+    def load_values(self, path):
+        import pickle
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        for k, v in blob["values"].items():
+            if k in self._values:
+                self._values[k] = jnp.asarray(v)
+        self._invalidate()
+        return self

@@ -1,0 +1,17 @@
+from deeplearning4j_tpu.datasets.dataset import DataSet, SplitTestAndTrain
+from deeplearning4j_tpu.datasets.iterators import (
+    ArrayDataSetIterator, AsyncDataSetIterator, CifarDataSetIterator,
+    DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
+    MnistDataSetIterator, SyntheticImageNetIterator)
+from deeplearning4j_tpu.datasets.normalizers import (
+    DataNormalization, ImagePreProcessingScaler, NormalizerMinMaxScaler,
+    NormalizerStandardize, VGG16ImagePreProcessor)
+
+__all__ = [
+    "DataSet", "SplitTestAndTrain", "ArrayDataSetIterator",
+    "AsyncDataSetIterator", "CifarDataSetIterator", "DataSetIterator",
+    "EmnistDataSetIterator", "IrisDataSetIterator", "MnistDataSetIterator",
+    "SyntheticImageNetIterator", "DataNormalization",
+    "ImagePreProcessingScaler", "NormalizerMinMaxScaler",
+    "NormalizerStandardize", "VGG16ImagePreProcessor",
+]

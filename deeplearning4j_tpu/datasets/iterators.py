@@ -1,0 +1,427 @@
+"""DataSetIterators (≡ deeplearning4j-datasets :: iterator.impl.* and
+nd4j DataSetIterator protocol).
+
+Zero-egress environment: the IDX/bin parsers read real files when present
+(MNIST at ~/.deeplearning4j/mnist or a given path); otherwise iterators fall
+back to DETERMINISTIC synthetic datasets with the same shapes/types, so
+training code and tests behave identically either way. The native C++ fast
+path (runtime.native) accelerates parsing/batching when built.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Protocol base: python iteration + the reference's next/hasNext/reset."""
+
+    def __init__(self, batch_size):
+        self._batch = int(batch_size)
+        self._cursor = 0
+
+    # reference surface
+    def batch(self):
+        return self._batch
+
+    def hasNext(self):
+        return self._cursor < self.numExamples()
+
+    def _check_has_next(self):
+        if not self.hasNext():
+            # ≡ the reference's NoSuchElementException on exhausted iterator
+            raise StopIteration("DataSetIterator exhausted; call reset()")
+
+    def next(self, num=None):
+        raise NotImplementedError
+
+    def reset(self):
+        self._cursor = 0
+
+    def resetSupported(self):
+        return True
+
+    def asyncSupported(self):
+        return True
+
+    def numExamples(self):
+        raise NotImplementedError
+
+    def totalOutcomes(self):
+        raise NotImplementedError
+
+    def inputColumns(self):
+        raise NotImplementedError
+
+    def setPreProcessor(self, pp):
+        self._preprocessor = pp
+
+    def getPreProcessor(self):
+        return getattr(self, "_preprocessor", None)
+
+    def _maybe_preprocess(self, ds):
+        pp = getattr(self, "_preprocessor", None)
+        if pp is not None:
+            pp.preProcess(ds)
+        return ds
+
+    # python iteration
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        if not self.hasNext():
+            raise StopIteration
+        return self.next()
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Iterate an in-memory (features, labels) pair (≡ ListDataSetIterator)."""
+
+    def __init__(self, features, labels, batch_size, shuffle=False, seed=123):
+        super().__init__(batch_size)
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self._shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+        self._order = np.arange(len(self.features))
+
+    def numExamples(self):
+        return len(self.features)
+
+    def totalOutcomes(self):
+        return int(self.labels.shape[-1])
+
+    def inputColumns(self):
+        return int(np.prod(self.features.shape[1:]))
+
+    def reset(self):
+        super().reset()
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+
+    def next(self, num=None):
+        self._check_has_next()
+        n = num or self._batch
+        idx = self._order[self._cursor:self._cursor + n]
+        self._cursor += len(idx)
+        return self._maybe_preprocess(
+            DataSet(self.features[idx], self.labels[idx]))
+
+
+def _read_idx(path):
+    """Parse an IDX (MNIST) file, gzipped or raw. Uncompressed files take
+    the native C++ parser (runtime.native_lib) when built."""
+    if not path.endswith(".gz"):
+        from deeplearning4j_tpu.runtime import native_lib
+        arr = native_lib.idx_read(path)
+        if arr is not None:
+            return arr
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        data = f.read()
+    zeros, dtype_code, ndim = struct.unpack(">HBB", data[:4])
+    dims = struct.unpack(">" + "I" * ndim, data[4:4 + 4 * ndim])
+    dtype = {8: np.uint8, 9: np.int8, 11: np.int16, 12: np.int32,
+             13: np.float32, 14: np.float64}[dtype_code]
+    return np.frombuffer(data, dtype=dtype, offset=4 + 4 * ndim).reshape(dims)
+
+
+def _one_hot(y, n):
+    out = np.zeros((len(y), n), np.float32)
+    out[np.arange(len(y)), y.astype(np.int64)] = 1.0
+    return out
+
+
+def _synthetic_images(n, h, w, c, n_classes, seed):
+    """Deterministic, linearly-separable-ish synthetic image set: each class
+    has a characteristic frequency pattern plus noise (so LeNet-class models
+    reach high accuracy, exercising the real training dynamics)."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, n_classes, size=n)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    imgs = np.zeros((n, h, w, c), np.float32)
+    for cls in range(n_classes):
+        m = y == cls
+        freq = 1 + cls % 5
+        phase = (cls // 5) * 0.7
+        pattern = 0.5 + 0.5 * np.sin(freq * 2 * np.pi * xx / w + phase) \
+            * np.cos(freq * 2 * np.pi * yy / h + phase)
+        imgs[m] = pattern[None, :, :, None]
+    imgs += 0.15 * rng.standard_normal(imgs.shape).astype(np.float32)
+    imgs = np.clip(imgs, 0, 1)
+    return (imgs * 255).astype(np.uint8), y
+
+
+class MnistDataSetIterator(DataSetIterator):
+    """≡ deeplearning4j-datasets :: MnistDataSetIterator.
+
+    Emits (B, 784) float features in [0,1] + one-hot(10) labels, matching
+    the reference's flattened-row convention (use
+    InputType.convolutionalFlat(28,28,1) for CNNs). Reads real IDX files
+    from `root` when present, else deterministic synthetic digits.
+    """
+
+    H = W = 28
+    NUM_CLASSES = 10
+
+    def __init__(self, batch_size, train=True, seed=123, root=None,
+                 num_examples=None):
+        super().__init__(batch_size)
+        root = root or os.path.expanduser("~/.deeplearning4j/mnist")
+        kind = "train" if train else "t10k"
+        img_path = None
+        for suffix in ("-images-idx3-ubyte.gz", "-images-idx3-ubyte"):
+            p = os.path.join(root, kind + suffix)
+            if os.path.exists(p):
+                img_path = p
+                break
+        if img_path is not None:
+            lbl_path = img_path.replace("images-idx3", "labels-idx1")
+            images = _read_idx(img_path)
+            labels = _read_idx(lbl_path)
+            self._images = images.reshape(len(images), self.H, self.W, 1)
+            self._labels = labels
+        else:
+            n = num_examples or (6000 if train else 1000)
+            self._images, self._labels = _synthetic_images(
+                n, self.H, self.W, 1, self.NUM_CLASSES,
+                seed if train else seed + 1)
+        if num_examples:
+            self._images = self._images[:num_examples]
+            self._labels = self._labels[:num_examples]
+
+    def numExamples(self):
+        return len(self._images)
+
+    def totalOutcomes(self):
+        return self.NUM_CLASSES
+
+    def inputColumns(self):
+        return self.H * self.W
+
+    def next(self, num=None):
+        self._check_has_next()
+        n = num or self._batch
+        end = min(self._cursor + n, len(self._images))
+        idx = np.arange(self._cursor, end)
+        self._cursor = end
+        # native batch assembly: gather + u8→f32 scale + one-hot in C++
+        from deeplearning4j_tpu.runtime import native_lib
+        feats = native_lib.gather_batch_u8(
+            self._images.reshape(len(self._images), -1), idx)
+        labels = native_lib.one_hot_u8(
+            np.ascontiguousarray(self._labels, np.uint8), idx,
+            self.NUM_CLASSES)
+        return self._maybe_preprocess(DataSet(feats, labels))
+
+
+class EmnistDataSetIterator(MnistDataSetIterator):
+    """≡ EmnistDataSetIterator (letters split: 26 classes)."""
+    NUM_CLASSES = 26
+
+    def __init__(self, batch_size, split="letters", train=True, seed=123,
+                 num_examples=None):
+        super().__init__(batch_size, train=train, seed=seed + 17,
+                         num_examples=num_examples)
+
+
+class CifarDataSetIterator(DataSetIterator):
+    """≡ Cifar10DataSetIterator — (B, 32, 32, 3) NHWC in [0,1]."""
+
+    H = W = 32
+    NUM_CLASSES = 10
+
+    def __init__(self, batch_size, train=True, seed=123, root=None,
+                 num_examples=None):
+        super().__init__(batch_size)
+        root = root or os.path.expanduser("~/.deeplearning4j/cifar10")
+        files = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+                 else ["test_batch.bin"])
+        paths = [os.path.join(root, "cifar-10-batches-bin", f) for f in files]
+        if all(os.path.exists(p) for p in paths):
+            imgs, labels = [], []
+            for p in paths:
+                raw = np.fromfile(p, np.uint8).reshape(-1, 3073)
+                labels.append(raw[:, 0])
+                # stored CHW; convert to NHWC
+                imgs.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+            self._images = np.concatenate(imgs)
+            self._labels = np.concatenate(labels)
+        else:
+            n = num_examples or (5000 if train else 1000)
+            self._images, self._labels = _synthetic_images(
+                n, self.H, self.W, 3, self.NUM_CLASSES,
+                seed if train else seed + 1)
+        if num_examples:
+            self._images = self._images[:num_examples]
+            self._labels = self._labels[:num_examples]
+
+    def numExamples(self):
+        return len(self._images)
+
+    def totalOutcomes(self):
+        return self.NUM_CLASSES
+
+    def inputColumns(self):
+        return self.H * self.W * 3
+
+    def next(self, num=None):
+        self._check_has_next()
+        n = num or self._batch
+        img = self._images[self._cursor:self._cursor + n]
+        lab = self._labels[self._cursor:self._cursor + n]
+        self._cursor += len(img)
+        return self._maybe_preprocess(
+            DataSet(img.astype(np.float32) / 255.0,
+                    _one_hot(lab, self.NUM_CLASSES)))
+
+
+class IrisDataSetIterator(DataSetIterator):
+    """≡ IrisDataSetIterator — the classic 150×4, deterministic synthetic
+    replica (three gaussian clusters with the reference's class structure)."""
+
+    def __init__(self, batch_size=150, num=150, seed=6):
+        super().__init__(batch_size)
+        rng = np.random.default_rng(seed)
+        n_per = num // 3
+        centers = np.array([[5.0, 3.4, 1.5, 0.2],
+                            [5.9, 2.8, 4.3, 1.3],
+                            [6.6, 3.0, 5.6, 2.0]], np.float32)
+        scales = np.array([[0.35, 0.38, 0.17, 0.10],
+                           [0.52, 0.31, 0.47, 0.20],
+                           [0.64, 0.32, 0.55, 0.27]], np.float32)
+        feats, labels = [], []
+        for c in range(3):
+            feats.append(centers[c] + scales[c] * rng.standard_normal((n_per, 4)).astype(np.float32))
+            labels.append(np.full(n_per, c))
+        self.features = np.concatenate(feats)
+        self.labels = _one_hot(np.concatenate(labels), 3)
+        perm = rng.permutation(len(self.features))
+        self.features, self.labels = self.features[perm], self.labels[perm]
+
+    def numExamples(self):
+        return len(self.features)
+
+    def totalOutcomes(self):
+        return 3
+
+    def inputColumns(self):
+        return 4
+
+    def next(self, num=None):
+        self._check_has_next()
+        n = num or self._batch
+        f = self.features[self._cursor:self._cursor + n]
+        l = self.labels[self._cursor:self._cursor + n]
+        self._cursor += len(f)
+        return self._maybe_preprocess(DataSet(f, l))
+
+
+class SyntheticImageNetIterator(DataSetIterator):
+    """ImageNet-shaped synthetic data for zoo/bench (224×224×3, 1000
+    classes) — the bench harness's data source (no egress)."""
+
+    def __init__(self, batch_size, num_examples=1024, height=224, width=224,
+                 channels=3, num_classes=1000, seed=7, dtype=np.float32):
+        super().__init__(batch_size)
+        self._n = num_examples
+        self._shape = (height, width, channels)
+        self._classes = num_classes
+        self._rng = np.random.default_rng(seed)
+        self._dtype = dtype
+
+    def numExamples(self):
+        return self._n
+
+    def totalOutcomes(self):
+        return self._classes
+
+    def inputColumns(self):
+        return int(np.prod(self._shape))
+
+    def next(self, num=None):
+        self._check_has_next()
+        n = min(num or self._batch, self._n - self._cursor)
+        self._cursor += n
+        h, w, c = self._shape
+        x = self._rng.random((n, h, w, c), np.float32).astype(self._dtype)
+        y = _one_hot(self._rng.integers(0, self._classes, n), self._classes)
+        return self._maybe_preprocess(DataSet(x, y))
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """≡ AsyncDataSetIterator — background-thread prefetch so host batch
+    prep overlaps device compute (the reference uses a workspace-backed
+    prefetch thread; same shape here)."""
+
+    _EMPTY = object()   # distinct "nothing peeked" sentinel (None = EOS)
+
+    def __init__(self, base, queue_size=4):
+        super().__init__(base.batch())
+        import queue as _q
+        import threading
+        self._base = base
+        self._qsize = queue_size
+        self._queue = _q.Queue(maxsize=queue_size)
+        self._thread = None
+        self._stop = threading.Event()
+        self._peek = self._EMPTY
+
+    def _worker(self):
+        try:
+            while self._base.hasNext() and not self._stop.is_set():
+                self._queue.put(self._base.next())
+        finally:
+            self._queue.put(None)
+
+    def _ensure_thread(self):
+        import threading
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._worker, daemon=True)
+            self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        if self._thread is not None:
+            while self._thread.is_alive():
+                try:
+                    self._queue.get_nowait()
+                except Exception:
+                    pass
+            self._thread.join(timeout=5)
+        self._stop.clear()
+        self._thread = None
+        self._peek = self._EMPTY   # drop any batch prefetched pre-reset
+        import queue as _q
+        self._queue = _q.Queue(maxsize=self._qsize)
+        self._base.reset()
+
+    def hasNext(self):
+        if self._peek is None:      # already saw end-of-stream
+            return False
+        self._ensure_thread()
+        if self._peek is self._EMPTY:
+            self._peek = self._queue.get()
+        return self._peek is not None
+
+    def next(self, num=None):
+        if not self.hasNext():
+            raise StopIteration("DataSetIterator exhausted; call reset()")
+        item, self._peek = self._peek, self._EMPTY
+        return item
+
+    def numExamples(self):
+        return self._base.numExamples()
+
+    def totalOutcomes(self):
+        return self._base.totalOutcomes()
+
+    def inputColumns(self):
+        return self._base.inputColumns()

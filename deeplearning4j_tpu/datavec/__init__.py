@@ -1,0 +1,10 @@
+from deeplearning4j_tpu.datavec.records import (CollectionRecordReader,
+                                                CSVRecordReader,
+                                                LineRecordReader,
+                                                RecordReader,
+                                                RecordReaderDataSetIterator,
+                                                Schema, TransformProcess)
+
+__all__ = ["CollectionRecordReader", "CSVRecordReader", "LineRecordReader",
+           "RecordReader", "RecordReaderDataSetIterator", "Schema",
+           "TransformProcess"]

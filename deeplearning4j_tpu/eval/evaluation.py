@@ -1,0 +1,269 @@
+"""Evaluation classes (≡ nd4j-api :: org.nd4j.evaluation.classification.
+Evaluation / EvaluationBinary / ROC, regression.RegressionEvaluation).
+
+Accumulator-style: call eval(labels, predictions) per batch (numpy host
+side — evaluation is not on the accelerator hot path), then read metrics.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _to2d(labels, preds, mask=None):
+    labels, preds = np.asarray(labels), np.asarray(preds)
+    if labels.ndim == 3:  # (B, T, C): fold time into batch, apply mask
+        b, t, c = labels.shape
+        labels = labels.reshape(b * t, c)
+        preds = preds.reshape(b * t, -1)
+        if mask is not None:
+            m = np.asarray(mask).reshape(b * t).astype(bool)
+            labels, preds = labels[m], preds[m]
+    return labels, preds
+
+
+class Evaluation:
+    def __init__(self, num_classes=None, top_n=1):
+        self.num_classes = num_classes
+        self.top_n = top_n
+        self._cm = None
+        self._top_n_correct = 0
+        self._count = 0
+
+    # -- accumulate ------------------------------------------------------
+    def eval(self, labels, predictions, mask=None):
+        labels, predictions = _to2d(labels, predictions, mask)
+        n_cls = labels.shape[-1]
+        if self._cm is None:
+            self.num_classes = self.num_classes or n_cls
+            self._cm = np.zeros((self.num_classes, self.num_classes), np.int64)
+        actual = labels.argmax(-1)
+        pred = predictions.argmax(-1)
+        np.add.at(self._cm, (actual, pred), 1)
+        if self.top_n > 1:
+            topn = np.argsort(-predictions, axis=-1)[:, :self.top_n]
+            self._top_n_correct += int((topn == actual[:, None]).any(-1).sum())
+        self._count += len(actual)
+
+    # -- metrics ---------------------------------------------------------
+    def accuracy(self):
+        return float(np.trace(self._cm)) / max(1, self._cm.sum())
+
+    def topNAccuracy(self):
+        if self.top_n <= 1:
+            return self.accuracy()
+        return self._top_n_correct / max(1, self._count)
+
+    def truePositives(self, cls):
+        return int(self._cm[cls, cls])
+
+    def falsePositives(self, cls):
+        return int(self._cm[:, cls].sum() - self._cm[cls, cls])
+
+    def falseNegatives(self, cls):
+        return int(self._cm[cls, :].sum() - self._cm[cls, cls])
+
+    def precision(self, cls=None):
+        if cls is not None:
+            denom = self._cm[:, cls].sum()
+            return float(self._cm[cls, cls]) / denom if denom else 0.0
+        vals = [self.precision(c) for c in range(self.num_classes)
+                if self._cm[:, c].sum() or self._cm[c, :].sum()]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls=None):
+        if cls is not None:
+            denom = self._cm[cls, :].sum()
+            return float(self._cm[cls, cls]) / denom if denom else 0.0
+        vals = [self.recall(c) for c in range(self.num_classes)
+                if self._cm[c, :].sum() or self._cm[:, c].sum()]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls=None):
+        if cls is not None:
+            p, r = self.precision(cls), self.recall(cls)
+            return 2 * p * r / (p + r) if (p + r) else 0.0
+        vals = [self.f1(c) for c in range(self.num_classes)
+                if self._cm[c, :].sum() or self._cm[:, c].sum()]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def confusionMatrix(self):
+        return self._cm.copy()
+
+    def getConfusionMatrix(self):
+        return self._cm.copy()
+
+    def stats(self):
+        lines = ["========================Evaluation Metrics========================",
+                 f" # of classes:    {self.num_classes}",
+                 f" Accuracy:        {self.accuracy():.4f}",
+                 f" Precision:       {self.precision():.4f}",
+                 f" Recall:          {self.recall():.4f}",
+                 f" F1 Score:        {self.f1():.4f}"]
+        if self.top_n > 1:
+            lines.append(f" Top-{self.top_n} Accuracy: {self.topNAccuracy():.4f}")
+        lines.append("=========================Confusion Matrix=========================")
+        lines.append(str(self._cm))
+        return "\n".join(lines)
+
+
+class EvaluationBinary:
+    """Per-output independent binary evaluation (sigmoid multi-label)."""
+
+    def __init__(self, threshold=0.5):
+        self.threshold = threshold
+        self._tp = self._fp = self._tn = self._fn = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels, predictions = _to2d(labels, predictions, mask)
+        pred = (predictions >= self.threshold).astype(np.int64)
+        lab = (labels >= 0.5).astype(np.int64)
+        tp = ((pred == 1) & (lab == 1)).sum(0)
+        fp = ((pred == 1) & (lab == 0)).sum(0)
+        tn = ((pred == 0) & (lab == 0)).sum(0)
+        fn = ((pred == 0) & (lab == 1)).sum(0)
+        if self._tp is None:
+            self._tp, self._fp, self._tn, self._fn = tp, fp, tn, fn
+        else:
+            self._tp += tp; self._fp += fp; self._tn += tn; self._fn += fn
+
+    def accuracy(self, out=None):
+        tp, fp, tn, fn = self._tp, self._fp, self._tn, self._fn
+        acc = (tp + tn) / np.maximum(1, tp + fp + tn + fn)
+        return float(acc.mean() if out is None else acc[out])
+
+    def precision(self, out=None):
+        p = self._tp / np.maximum(1, self._tp + self._fp)
+        return float(p.mean() if out is None else p[out])
+
+    def recall(self, out=None):
+        r = self._tp / np.maximum(1, self._tp + self._fn)
+        return float(r.mean() if out is None else r[out])
+
+    def f1(self, out=None):
+        p, r = self.precision(out), self.recall(out)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def stats(self):
+        return (f"EvaluationBinary(acc={self.accuracy():.4f}, "
+                f"precision={self.precision():.4f}, recall={self.recall():.4f}, "
+                f"f1={self.f1():.4f})")
+
+
+class ROC:
+    """Binary ROC/AUC. threshold_steps=0 → exact (all unique scores)."""
+
+    def __init__(self, threshold_steps=0):
+        self.threshold_steps = threshold_steps
+        self._scores = []
+        self._labels = []
+
+    def eval(self, labels, predictions, mask=None):
+        labels, predictions = _to2d(labels, predictions, mask)
+        if labels.shape[-1] == 2:  # [P(neg), P(pos)] convention
+            labels = labels[:, 1]
+            predictions = predictions[:, 1]
+        self._scores.append(np.asarray(predictions).ravel())
+        self._labels.append(np.asarray(labels).ravel())
+
+    def _roc_points(self):
+        scores = np.concatenate(self._scores)
+        labels = np.concatenate(self._labels) >= 0.5
+        order = np.argsort(-scores)
+        scores, labels = scores[order], labels[order]
+        tps = np.cumsum(labels)
+        fps = np.cumsum(~labels)
+        # tie handling: one ROC point per DISTINCT threshold (all tied
+        # scores flip together), else constant scores would fake AUC=1
+        distinct = np.where(np.diff(scores))[0]
+        idx = np.r_[distinct, len(scores) - 1]
+        P, N = max(1, labels.sum()), max(1, (~labels).sum())
+        tpr = np.concatenate([[0.0], tps[idx] / P])
+        fpr = np.concatenate([[0.0], fps[idx] / N])
+        return fpr, tpr
+
+    def calculateAUC(self):
+        fpr, tpr = self._roc_points()
+        return float(np.trapezoid(tpr, fpr))
+
+    def getRocCurve(self):
+        return self._roc_points()
+
+
+class ROCMultiClass:
+    def __init__(self, threshold_steps=0):
+        self._rocs = {}
+
+    def eval(self, labels, predictions, mask=None):
+        labels, predictions = _to2d(labels, predictions, mask)
+        for c in range(labels.shape[-1]):
+            roc = self._rocs.setdefault(c, ROC())
+            roc._scores.append(predictions[:, c])
+            roc._labels.append(labels[:, c])
+
+    def calculateAUC(self, cls):
+        return self._rocs[cls].calculateAUC()
+
+    def calculateAverageAUC(self):
+        return float(np.mean([r.calculateAUC() for r in self._rocs.values()]))
+
+
+class RegressionEvaluation:
+    def __init__(self, n_columns=None):
+        self._sse = None
+        self._sae = None
+        self._n = 0
+        self._sum_label = None
+        self._sum_label_sq = None
+        self._sum_pred = None
+        self._sum_pred_sq = None
+        self._sum_label_pred = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels, predictions = _to2d(labels, predictions, mask)
+        err = predictions - labels
+        if self._sse is None:
+            ncol = labels.shape[-1]
+            z = lambda: np.zeros(ncol)
+            self._sse, self._sae = z(), z()
+            self._sum_label, self._sum_label_sq = z(), z()
+            self._sum_pred, self._sum_pred_sq = z(), z()
+            self._sum_label_pred = z()
+        self._sse += (err ** 2).sum(0)
+        self._sae += np.abs(err).sum(0)
+        self._sum_label += labels.sum(0)
+        self._sum_label_sq += (labels ** 2).sum(0)
+        self._sum_pred += predictions.sum(0)
+        self._sum_pred_sq += (predictions ** 2).sum(0)
+        self._sum_label_pred += (labels * predictions).sum(0)
+        self._n += labels.shape[0]
+
+    def meanSquaredError(self, col=None):
+        mse = self._sse / max(1, self._n)
+        return float(mse.mean() if col is None else mse[col])
+
+    def meanAbsoluteError(self, col=None):
+        mae = self._sae / max(1, self._n)
+        return float(mae.mean() if col is None else mae[col])
+
+    def rootMeanSquaredError(self, col=None):
+        return float(np.sqrt(self.meanSquaredError(col)))
+
+    def rSquared(self, col=None):
+        n = max(1, self._n)
+        ss_tot = self._sum_label_sq - self._sum_label ** 2 / n
+        r2 = 1.0 - self._sse / np.maximum(ss_tot, 1e-12)
+        return float(r2.mean() if col is None else r2[col])
+
+    def pearsonCorrelation(self, col=None):
+        n = max(1, self._n)
+        cov = self._sum_label_pred - self._sum_label * self._sum_pred / n
+        vl = self._sum_label_sq - self._sum_label ** 2 / n
+        vp = self._sum_pred_sq - self._sum_pred ** 2 / n
+        pc = cov / np.maximum(np.sqrt(vl * vp), 1e-12)
+        return float(pc.mean() if col is None else pc[col])
+
+    def stats(self):
+        return (f"RegressionEvaluation(MSE={self.meanSquaredError():.6f}, "
+                f"MAE={self.meanAbsoluteError():.6f}, "
+                f"RMSE={self.rootMeanSquaredError():.6f}, "
+                f"R2={self.rSquared():.6f})")

@@ -1,0 +1,8 @@
+from deeplearning4j_tpu.models.zoo.models import (AlexNet, LeNet, ResNet50,
+                                                  SimpleCNN,
+                                                  TextGenerationLSTM,
+                                                  TinyYOLO, UNet, VGG16,
+                                                  ZooModel)
+
+__all__ = ["AlexNet", "LeNet", "ResNet50", "SimpleCNN",
+           "TextGenerationLSTM", "TinyYOLO", "UNet", "VGG16", "ZooModel"]

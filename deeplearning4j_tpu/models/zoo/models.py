@@ -1,0 +1,373 @@
+"""Model zoo (≡ deeplearning4j-zoo :: org.deeplearning4j.zoo.model.*:
+LeNet, AlexNet, VGG16, ResNet50, SimpleCNN, UNet, TinyYOLO,
+TextGenerationLSTM).
+
+All models build through the SAME public config DSL a user would write —
+they are living examples of the builder API. TPU-first choices: NHWC
+layouts, bf16-friendly (pass dataType="bfloat16"), identity-shortcut
+ResNet with fused BN, big matmuls in classifier heads.
+
+ZooModel surface parity: `ResNet50(numClasses=...).init()` returns the
+network; `initPretrained()` is gated (zero-egress environment, documented).
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_vertices import ElementWiseVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                               BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               DropoutLayer,
+                                               GlobalPoolingLayer, LossLayer,
+                                               OutputLayer, SubsamplingLayer,
+                                               Upsampling2D, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam, Nesterovs
+
+
+class ZooModel:
+    """Base surface (≡ org.deeplearning4j.zoo.ZooModel)."""
+
+    def __init__(self, numClasses=1000, seed=123, inputShape=None,
+                 updater=None, dataType="float32"):
+        self.numClasses = int(numClasses)
+        self.seed = int(seed)
+        self.inputShape = inputShape or self.DEFAULT_INPUT
+        self.updater = updater
+        self.dataType = dataType
+
+    DEFAULT_INPUT = (224, 224, 3)
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        conf = self.conf()
+        from deeplearning4j_tpu.nn.conf.graph_builder import \
+            ComputationGraphConfiguration
+        if isinstance(conf, ComputationGraphConfiguration):
+            return ComputationGraph(conf).init()
+        return MultiLayerNetwork(conf).init()
+
+    def initPretrained(self, *_, **__):
+        raise RuntimeError(
+            "Pretrained weights unavailable: this environment has no network "
+            "egress. Train from scratch or load a local checkpoint via "
+            "ModelSerializer.restoreModel.")
+
+    def pretrainedAvailable(self, *_):
+        return False
+
+
+class LeNet(ZooModel):
+    """≡ zoo.model.LeNet — the classic MNIST CNN."""
+
+    DEFAULT_INPUT = (28, 28, 1)
+
+    def conf(self):
+        h, w, c = self.inputShape
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(self.updater or Nesterovs(0.01, 0.9))
+                .weightInit("xavier")
+                .dataType(self.dataType)
+                .list()
+                .layer(ConvolutionLayer(kernelSize=(5, 5), stride=(1, 1),
+                                        nOut=20, activation="identity",
+                                        convolutionMode="same"))
+                .layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(kernelSize=(5, 5), stride=(1, 1),
+                                        nOut=50, activation="identity",
+                                        convolutionMode="same"))
+                .layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                        stride=(2, 2)))
+                .layer(DenseLayer(nOut=500, activation="relu"))
+                .layer(OutputLayer(lossFunction="negativeloglikelihood",
+                                   nOut=self.numClasses,
+                                   activation="softmax"))
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+
+
+class SimpleCNN(ZooModel):
+    """≡ zoo.model.SimpleCNN."""
+
+    DEFAULT_INPUT = (48, 48, 3)
+
+    def conf(self):
+        h, w, c = self.inputShape
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(self.updater or Adam(1e-3))
+                .weightInit("relu")
+                .activation("relu")
+                .dataType(self.dataType)
+                .list()
+                .layer(ConvolutionLayer(kernelSize=(7, 7), nOut=16,
+                                        convolutionMode="same"))
+                .layer(BatchNormalization())
+                .layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(kernelSize=(5, 5), nOut=32,
+                                        convolutionMode="same"))
+                .layer(BatchNormalization())
+                .layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(kernelSize=(3, 3), nOut=64,
+                                        convolutionMode="same"))
+                .layer(BatchNormalization())
+                .layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(nOut=128))
+                .layer(DropoutLayer(dropOut=0.5))
+                .layer(OutputLayer(lossFunction="mcxent",
+                                   nOut=self.numClasses,
+                                   activation="softmax"))
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+
+
+class AlexNet(ZooModel):
+    """≡ zoo.model.AlexNet (one-tower variant)."""
+
+    def conf(self):
+        h, w, c = self.inputShape
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(self.updater or Nesterovs(1e-2, 0.9))
+                .weightInit("relu")
+                .activation("relu")
+                .l2(5e-4)
+                .dataType(self.dataType)
+                .list()
+                .layer(ConvolutionLayer(kernelSize=(11, 11), stride=(4, 4),
+                                        nOut=96, convolutionMode="same"))
+                .layer(BatchNormalization())
+                .layer(SubsamplingLayer(kernelSize=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(kernelSize=(5, 5), nOut=256,
+                                        convolutionMode="same"))
+                .layer(BatchNormalization())
+                .layer(SubsamplingLayer(kernelSize=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(kernelSize=(3, 3), nOut=384,
+                                        convolutionMode="same"))
+                .layer(ConvolutionLayer(kernelSize=(3, 3), nOut=384,
+                                        convolutionMode="same"))
+                .layer(ConvolutionLayer(kernelSize=(3, 3), nOut=256,
+                                        convolutionMode="same"))
+                .layer(SubsamplingLayer(kernelSize=(3, 3), stride=(2, 2)))
+                .layer(DenseLayer(nOut=4096, dropOut=0.5))
+                .layer(DenseLayer(nOut=4096, dropOut=0.5))
+                .layer(OutputLayer(lossFunction="mcxent",
+                                   nOut=self.numClasses,
+                                   activation="softmax"))
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+
+
+class VGG16(ZooModel):
+    """≡ zoo.model.VGG16."""
+
+    def conf(self):
+        h, w, c = self.inputShape
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Nesterovs(1e-2, 0.9))
+             .weightInit("relu")
+             .activation("relu")
+             .dataType(self.dataType)
+             .list())
+        plan = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+        for n_out, reps in plan:
+            for _ in range(reps):
+                b.layer(ConvolutionLayer(kernelSize=(3, 3), nOut=n_out,
+                                         convolutionMode="same"))
+            b.layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+        return (b.layer(DenseLayer(nOut=4096, dropOut=0.5))
+                 .layer(DenseLayer(nOut=4096, dropOut=0.5))
+                 .layer(OutputLayer(lossFunction="mcxent",
+                                    nOut=self.numClasses,
+                                    activation="softmax"))
+                 .setInputType(InputType.convolutional(h, w, c))
+                 .build())
+
+
+class ResNet50(ZooModel):
+    """≡ zoo.model.ResNet50 — bottleneck-v1 residual graph, built on the
+    ComputationGraph DSL with ElementWiseVertex(Add) shortcuts. NHWC +
+    identity shortcuts keep every conv MXU-shaped; bf16 via dataType."""
+
+    def conf(self):
+        h, w, c = self.inputShape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Nesterovs(1e-1, 0.9))
+             .weightInit("relu")
+             .dataType(self.dataType)
+             .l2(1e-4)
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        def conv_bn(name, inp, n_out, k, s, act="relu"):
+            g.addLayer(f"{name}_conv",
+                       ConvolutionLayer(kernelSize=k, stride=s, nOut=n_out,
+                                        convolutionMode="same",
+                                        hasBias=False,
+                                        activation="identity"), inp)
+            g.addLayer(f"{name}_bn",
+                       BatchNormalization(activation=act), f"{name}_conv")
+            return f"{name}_bn"
+
+        def bottleneck(name, inp, filters, stride, downsample):
+            f1, f2, f3 = filters
+            x = conv_bn(f"{name}_a", inp, f1, (1, 1), stride)
+            x = conv_bn(f"{name}_b", x, f2, (3, 3), (1, 1))
+            x = conv_bn(f"{name}_c", x, f3, (1, 1), (1, 1), act="identity")
+            if downsample:
+                sc = conv_bn(f"{name}_sc", inp, f3, (1, 1), stride,
+                             act="identity")
+            else:
+                sc = inp
+            g.addVertex(f"{name}_add", ElementWiseVertex("add"), x, sc)
+            g.addLayer(f"{name}_relu", ActivationLayer(activation="relu"),
+                       f"{name}_add")
+            return f"{name}_relu"
+
+        x = conv_bn("stem", "input", 64, (7, 7), (2, 2))
+        g.addLayer("stem_pool",
+                   SubsamplingLayer(poolingType="max", kernelSize=(3, 3),
+                                    stride=(2, 2), convolutionMode="same"), x)
+        x = "stem_pool"
+        stages = [
+            ("res2", (64, 64, 256), 3, (1, 1)),
+            ("res3", (128, 128, 512), 4, (2, 2)),
+            ("res4", (256, 256, 1024), 6, (2, 2)),
+            ("res5", (512, 512, 2048), 3, (2, 2)),
+        ]
+        for sname, filters, blocks, stride in stages:
+            x = bottleneck(f"{sname}_0", x, filters, stride, True)
+            for i in range(1, blocks):
+                x = bottleneck(f"{sname}_{i}", x, filters, (1, 1), False)
+        g.addLayer("avgpool", GlobalPoolingLayer(poolingType="avg"), x)
+        g.addLayer("fc", OutputLayer(lossFunction="mcxent",
+                                     nOut=self.numClasses,
+                                     activation="softmax"), "avgpool")
+        g.setOutputs("fc")
+        return g.build()
+
+
+class UNet(ZooModel):
+    """≡ zoo.model.UNet — encoder/decoder with skip connections
+    (MergeVertex concat), sigmoid pixel output."""
+
+    DEFAULT_INPUT = (128, 128, 3)
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.graph_vertices import MergeVertex
+        h, w, c = self.inputShape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Adam(1e-3))
+             .weightInit("relu")
+             .activation("relu")
+             .dataType(self.dataType)
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        def double_conv(name, inp, n_out):
+            g.addLayer(f"{name}_c1", ConvolutionLayer(
+                kernelSize=(3, 3), nOut=n_out, convolutionMode="same"), inp)
+            g.addLayer(f"{name}_c2", ConvolutionLayer(
+                kernelSize=(3, 3), nOut=n_out, convolutionMode="same"),
+                f"{name}_c1")
+            return f"{name}_c2"
+
+        d1 = double_conv("down1", "input", 32)
+        g.addLayer("pool1", SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)), d1)
+        d2 = double_conv("down2", "pool1", 64)
+        g.addLayer("pool2", SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)), d2)
+        mid = double_conv("mid", "pool2", 128)
+        g.addLayer("up2", Upsampling2D(size=2), mid)
+        g.addVertex("cat2", MergeVertex(), "up2", d2)
+        u2 = double_conv("dec2", "cat2", 64)
+        g.addLayer("up1", Upsampling2D(size=2), u2)
+        g.addVertex("cat1", MergeVertex(), "up1", d1)
+        u1 = double_conv("dec1", "cat1", 32)
+        g.addLayer("outconv", ConvolutionLayer(kernelSize=(1, 1), nOut=1,
+                                               activation="identity",
+                                               convolutionMode="same"), u1)
+        g.addLayer("out", LossLayer(lossFunction="xent",
+                                    activation="sigmoid"), "outconv")
+        g.setOutputs("out")
+        return g.build()
+
+
+class TinyYOLO(ZooModel):
+    """≡ zoo.model.TinyYOLO — Darknet-style backbone; detection head is the
+    final 1×1 conv producing B*(5+C) maps (full YOLO loss: round 2)."""
+
+    DEFAULT_INPUT = (416, 416, 3)
+
+    def __init__(self, numClasses=20, boxes=5, **kw):
+        super().__init__(numClasses=numClasses, **kw)
+        self.boxes = boxes
+
+    def conf(self):
+        h, w, c = self.inputShape
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Adam(1e-3))
+             .weightInit("relu")
+             .dataType(self.dataType)
+             .list())
+        n_out = 16
+        for i in range(5):
+            b.layer(ConvolutionLayer(kernelSize=(3, 3), nOut=n_out,
+                                     convolutionMode="same", hasBias=False,
+                                     activation="identity"))
+            b.layer(BatchNormalization(activation="leakyrelu"))
+            b.layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+            n_out *= 2
+        b.layer(ConvolutionLayer(kernelSize=(3, 3), nOut=512,
+                                 convolutionMode="same", hasBias=False,
+                                 activation="identity"))
+        b.layer(BatchNormalization(activation="leakyrelu"))
+        b.layer(ConvolutionLayer(kernelSize=(3, 3), nOut=1024,
+                                 convolutionMode="same", hasBias=False,
+                                 activation="identity"))
+        b.layer(BatchNormalization(activation="leakyrelu"))
+        head_out = self.boxes * (5 + self.numClasses)
+        b.layer(ConvolutionLayer(kernelSize=(1, 1), nOut=head_out,
+                                 convolutionMode="same",
+                                 activation="identity"))
+        b.layer(LossLayer(lossFunction="l2", activation="identity"))
+        return (b.setInputType(InputType.convolutional(h, w, c)).build())
+
+
+class TextGenerationLSTM(ZooModel):
+    """≡ zoo.model.TextGenerationLSTM — char-RNN: stacked LSTMs +
+    per-timestep softmax (the GravesLSTM char-modelling baseline config)."""
+
+    def __init__(self, numClasses=77, lstmLayerSize=256, **kw):
+        kw.setdefault("inputShape", (None, numClasses))
+        super().__init__(numClasses=numClasses, **kw)
+        self.lstmLayerSize = lstmLayerSize
+
+    DEFAULT_INPUT = (None, 77)
+
+    def conf(self):
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(self.updater or Adam(1e-2))
+                .weightInit("xavier")
+                .dataType(self.dataType)
+                .list()
+                .layer(LSTM(nOut=self.lstmLayerSize, activation="tanh"))
+                .layer(LSTM(nOut=self.lstmLayerSize, activation="tanh"))
+                .layer(RnnOutputLayer(lossFunction="mcxent",
+                                      nOut=self.numClasses,
+                                      activation="softmax"))
+                .setInputType(InputType.recurrent(self.numClasses))
+                .build())

@@ -1,0 +1,33 @@
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf.builders import (BackpropType,
+                                                 NeuralNetConfiguration,
+                                                 MultiLayerConfiguration,
+                                                 WorkspaceMode)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf import layers
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer, BatchNormalization, Convolution1DLayer, ConvolutionLayer,
+    DenseLayer, DropoutLayer, EmbeddingLayer, EmbeddingSequenceLayer,
+    GlobalPoolingLayer, LossLayer, OutputLayer, PReLULayer,
+    SeparableConvolution2D, Subsampling1DLayer, SubsamplingLayer,
+    Upsampling2D, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.losses import LossFunction
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import (AMSGrad, AdaDelta, AdaGrad,
+                                            AdaMax, Adam, GradientNormalization,
+                                            Nadam, Nesterovs, NoOp, RmsProp,
+                                            Sgd, Updater)
+from deeplearning4j_tpu.nn.weights_init import WeightInit
+
+__all__ = [
+    "Activation", "BackpropType", "NeuralNetConfiguration",
+    "MultiLayerConfiguration", "WorkspaceMode", "InputType", "layers",
+    "ActivationLayer", "BatchNormalization", "Convolution1DLayer",
+    "ConvolutionLayer", "DenseLayer", "DropoutLayer", "EmbeddingLayer",
+    "EmbeddingSequenceLayer", "GlobalPoolingLayer", "LossLayer",
+    "OutputLayer", "PReLULayer", "SeparableConvolution2D",
+    "Subsampling1DLayer", "SubsamplingLayer", "Upsampling2D",
+    "ZeroPaddingLayer", "LossFunction", "MultiLayerNetwork", "AMSGrad",
+    "AdaDelta", "AdaGrad", "AdaMax", "Adam", "GradientNormalization",
+    "Nadam", "Nesterovs", "NoOp", "RmsProp", "Sgd", "Updater", "WeightInit",
+]

@@ -1,0 +1,102 @@
+"""Activation catalog (≡ nd4j-api :: activations.Activation enum + impls).
+
+Reference surface: IActivation implementations under
+org.nd4j.linalg.activations.impl (reference mount empty; reconstructed).
+All are jnp-pure so XLA fuses them into the surrounding matmul/conv.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _rationaltanh(x):
+    # ND4J's ActivationRationalTanh: 1.7159 * softsign-style rational approx.
+    a = jnp.abs(x)
+    return jnp.sign(x) * 1.7159 * (1 - 1 / (1 + a + a * a + 1.41645 * a ** 4))
+
+
+def _rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def _hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def _hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def _cube(x):
+    return x ** 3
+
+
+def _mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def _thresholdedrelu(x, theta=1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+ACTIVATIONS = {
+    "identity": lambda x: x,
+    "linear": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "swish": jax.nn.silu,
+    "silu": jax.nn.silu,
+    "mish": _mish,
+    "sigmoid": jax.nn.sigmoid,
+    "hardsigmoid": _hardsigmoid,
+    "tanh": jnp.tanh,
+    "rationaltanh": _rationaltanh,
+    "rectifiedtanh": _rectifiedtanh,
+    "hardtanh": _hardtanh,
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+    "logsoftmax": lambda x: jax.nn.log_softmax(x, axis=-1),
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "cube": _cube,
+    "thresholdedrelu": _thresholdedrelu,
+}
+
+
+def get_activation(name):
+    """Resolve an activation by ND4J enum name (case-insensitive) or callable."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in ACTIVATIONS:
+        raise ValueError(
+            f"Unknown activation '{name}'. Available: {sorted(ACTIVATIONS)}")
+    return ACTIVATIONS[key]
+
+
+class Activation:
+    """Enum-style accessors: Activation.RELU etc. (≡ nd4j Activation enum)."""
+    IDENTITY = "identity"
+    RELU = "relu"
+    RELU6 = "relu6"
+    LEAKYRELU = "leakyrelu"
+    ELU = "elu"
+    SELU = "selu"
+    GELU = "gelu"
+    SWISH = "swish"
+    MISH = "mish"
+    SIGMOID = "sigmoid"
+    HARDSIGMOID = "hardsigmoid"
+    TANH = "tanh"
+    RATIONALTANH = "rationaltanh"
+    RECTIFIEDTANH = "rectifiedtanh"
+    HARDTANH = "hardtanh"
+    SOFTMAX = "softmax"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    CUBE = "cube"
+    THRESHOLDEDRELU = "thresholdedrelu"

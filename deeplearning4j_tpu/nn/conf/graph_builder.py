@@ -1,0 +1,234 @@
+"""ComputationGraphConfiguration + GraphBuilder (≡ deeplearning4j-nn ::
+conf.ComputationGraphConfiguration.GraphBuilder).
+
+addInputs/addLayer/addVertex/setOutputs with a topologically-sorted DAG;
+shape inference + automatic preprocessor insertion runs at build() exactly
+like the MultiLayer path."""
+from __future__ import annotations
+
+import json
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.builders import BackpropType, _CNN_LAYERS
+from deeplearning4j_tpu.nn.conf.graph_vertices import GraphVertex
+from deeplearning4j_tpu.nn.conf.inputs import (ConvolutionalFlatType,
+                                               ConvolutionalType,
+                                               FeedForwardType, InputType)
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor, FeedForwardToCnnPreProcessor)
+
+
+class GraphNode:
+    def __init__(self, name, kind, ref, inputs):
+        self.name = name
+        self.kind = kind          # "input" | "layer" | "vertex"
+        self.ref = ref            # Layer conf or GraphVertex or None
+        self.inputs = list(inputs)
+        self.preprocessor = None  # auto-inserted for layer nodes
+
+
+class ComputationGraphConfiguration:
+    def __init__(self, defaults, nodes, input_names, output_names,
+                 input_types=None, backprop_type=BackpropType.Standard,
+                 tbptt_fwd_length=20, tbptt_back_length=20,
+                 data_type="float32", seed=0):
+        self.defaults = defaults
+        self.nodes = nodes                    # dict name -> GraphNode
+        self.input_names = list(input_names)
+        self.output_names = list(output_names)
+        self.input_types = list(input_types or [])
+        self.backprop_type = backprop_type
+        self.tbptt_fwd_length = tbptt_fwd_length
+        self.tbptt_back_length = tbptt_back_length
+        self.data_type = data_type
+        self.seed = seed
+        self.topo_order = self._topo_sort()
+        self.node_output_types = {}
+        if self.input_types:
+            self._infer_shapes()
+
+    def _topo_sort(self):
+        order, seen, visiting = [], set(), set()
+
+        def visit(name):
+            if name in seen:
+                return
+            if name in visiting:
+                raise ValueError(f"Cycle in graph at '{name}'")
+            visiting.add(name)
+            for parent in self.nodes[name].inputs:
+                if parent not in self.nodes:
+                    raise ValueError(f"Node '{name}' references unknown input "
+                                     f"'{parent}'")
+                visit(parent)
+            visiting.discard(name)
+            seen.add(name)
+            order.append(name)
+
+        for name in self.nodes:
+            visit(name)
+        return order
+
+    def _infer_shapes(self):
+        if len(self.input_types) != len(self.input_names):
+            raise ValueError("setInputTypes arity != addInputs arity")
+        types = {}
+        for name, t in zip(self.input_names, self.input_types):
+            if isinstance(t, ConvolutionalFlatType):
+                # keep flat marker for preprocessor insertion
+                types[name] = t
+            else:
+                types[name] = t
+        for name in self.topo_order:
+            node = self.nodes[name]
+            if node.kind == "input":
+                self.node_output_types[name] = types[name]
+                continue
+            in_types = [self.node_output_types[p] for p in node.inputs]
+            if node.kind == "vertex":
+                self.node_output_types[name] = node.ref.output_type(*in_types)
+                continue
+            layer = node.ref
+            layer.apply_defaults(self.defaults)
+            cur = in_types[0]
+            if node.preprocessor is None:
+                node.preprocessor = self._auto_preprocessor(cur, layer)
+            if node.preprocessor is not None:
+                cur = node.preprocessor.getOutputType(cur)
+            if isinstance(cur, ConvolutionalFlatType):
+                cur = InputType.feedForward(cur.arrayElementsPerExample())
+            if getattr(layer, "nIn", "na") is None:
+                if isinstance(cur, ConvolutionalType):
+                    layer.nIn = cur.channels
+                else:
+                    layer.nIn = cur.size
+            node.resolved_input_type = cur
+            self.node_output_types[name] = layer.output_type(cur)
+
+    @staticmethod
+    def _auto_preprocessor(cur, layer):
+        if isinstance(layer, _CNN_LAYERS):
+            if isinstance(cur, ConvolutionalFlatType):
+                return FeedForwardToCnnPreProcessor(cur.height, cur.width,
+                                                    cur.channels)
+        elif isinstance(cur, ConvolutionalType) and isinstance(
+                layer, (L.DenseLayer, L.EmbeddingLayer)):
+            return CnnToFeedForwardPreProcessor(cur.height, cur.width,
+                                                cur.channels)
+        return None
+
+    def toJson(self):
+        from deeplearning4j_tpu.util.serde import encode
+        return json.dumps({
+            "format": "deeplearning4j_tpu/ComputationGraphConfiguration/v1",
+            "defaults": encode(self.defaults),
+            "nodes": [
+                {"name": n.name, "kind": n.kind, "inputs": n.inputs,
+                 "ref": encode(n.ref) if n.ref is not None else None}
+                for n in (self.nodes[k] for k in self.topo_order)],
+            "input_names": self.input_names,
+            "output_names": self.output_names,
+            "input_types": [  # encoded separately
+                encode(t) for t in self.input_types],
+            "backprop_type": self.backprop_type,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
+            "data_type": self.data_type,
+            "seed": self.seed,
+        }, indent=2)
+
+    @staticmethod
+    def fromJson(s):
+        from deeplearning4j_tpu.util.serde import decode
+        d = json.loads(s)
+        nodes = {}
+        for nd in d["nodes"]:
+            ref = decode(nd["ref"]) if nd["ref"] is not None else None
+            nodes[nd["name"]] = GraphNode(nd["name"], nd["kind"], ref,
+                                          nd["inputs"])
+        return ComputationGraphConfiguration(
+            decode(d["defaults"]), nodes, d["input_names"], d["output_names"],
+            [decode(t) for t in d["input_types"]],
+            d.get("backprop_type", "standard"),
+            d.get("tbptt_fwd_length", 20), d.get("tbptt_back_length", 20),
+            d.get("data_type", "float32"), d.get("seed", 0))
+
+
+class GraphBuilder:
+    def __init__(self, defaults, seed, data_type):
+        self._defaults = defaults
+        self._seed = seed
+        self._data_type = data_type
+        self._nodes = {}
+        self._inputs = []
+        self._outputs = []
+        self._input_types = []
+        self._backprop_type = BackpropType.Standard
+        self._tbptt_fwd = self._tbptt_back = 20
+
+    def addInputs(self, *names):
+        if len(names) == 1 and isinstance(names[0], (list, tuple)):
+            names = names[0]
+        for n in names:
+            self._inputs.append(n)
+            self._nodes[n] = GraphNode(n, "input", None, [])
+        return self
+
+    def setInputTypes(self, *types):
+        if len(types) == 1 and isinstance(types[0], (list, tuple)):
+            types = types[0]
+        self._input_types = list(types)
+        return self
+
+    def addLayer(self, name, layer, *inputs):
+        if isinstance(layer, L._Builder):
+            layer = layer.build()
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = inputs[0]
+        layer.name = name
+        self._nodes[name] = GraphNode(name, "layer", layer, inputs)
+        return self
+
+    appendLayer = addLayer
+
+    def addVertex(self, name, vertex, *inputs):
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = inputs[0]
+        self._nodes[name] = GraphNode(name, "vertex", vertex, inputs)
+        return self
+
+    def inputPreProcessor(self, layer_name, pp):
+        self._pending_pp = getattr(self, "_pending_pp", {})
+        self._pending_pp[layer_name] = pp
+        return self
+
+    def setOutputs(self, *names):
+        if len(names) == 1 and isinstance(names[0], (list, tuple)):
+            names = names[0]
+        self._outputs = list(names)
+        return self
+
+    def backpropType(self, t):
+        self._backprop_type = t
+        return self
+
+    def tBPTTForwardLength(self, n):
+        self._tbptt_fwd = int(n)
+        return self
+
+    def tBPTTBackwardLength(self, n):
+        self._tbptt_back = int(n)
+        return self
+
+    def build(self):
+        if not self._inputs:
+            raise ValueError("addInputs(...) required")
+        if not self._outputs:
+            raise ValueError("setOutputs(...) required")
+        for name, pp in getattr(self, "_pending_pp", {}).items():
+            if name in self._nodes:
+                self._nodes[name].preprocessor = pp
+        return ComputationGraphConfiguration(
+            dict(self._defaults), self._nodes, self._inputs, self._outputs,
+            self._input_types, self._backprop_type, self._tbptt_fwd,
+            self._tbptt_back, self._data_type, self._seed)

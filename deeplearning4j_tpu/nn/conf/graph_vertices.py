@@ -1,0 +1,191 @@
+"""Graph vertices (≡ deeplearning4j-nn :: conf.graph.*: MergeVertex,
+ElementWiseVertex, SubsetVertex, StackVertex, UnstackVertex, ScaleVertex,
+ShiftVertex, L2NormalizeVertex, PreprocessorVertex, ReshapeVertex,
+rnn.LastTimeStepVertex). Pure functions over one-or-more parent
+activations."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (ConvolutionalType,
+                                               FeedForwardType, InputType,
+                                               RecurrentType)
+
+
+class GraphVertex:
+    def output_type(self, *input_types):
+        raise NotImplementedError
+
+    def apply(self, *xs, mask=None):
+        raise NotImplementedError
+
+
+class MergeVertex(GraphVertex):
+    """Concat along the feature (last) axis."""
+
+    def output_type(self, *ts):
+        t0 = ts[0]
+        if isinstance(t0, ConvolutionalType):
+            return InputType.convolutional(t0.height, t0.width,
+                                           sum(t.channels for t in ts))
+        if isinstance(t0, RecurrentType):
+            return InputType.recurrent(sum(t.size for t in ts),
+                                       t0.timeSeriesLength)
+        return InputType.feedForward(sum(t.size for t in ts))
+
+    def apply(self, *xs, mask=None):
+        return jnp.concatenate(xs, axis=-1)
+
+
+class ElementWiseVertex(GraphVertex):
+    Add, Subtract, Product, Average, Max = "add", "subtract", "product", "average", "max"
+
+    def __init__(self, op="add"):
+        self.op = str(op).lower()
+
+    def output_type(self, *ts):
+        return ts[0]
+
+    def apply(self, *xs, mask=None):
+        if self.op == "add":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out + x
+            return out
+        if self.op == "subtract":
+            assert len(xs) == 2
+            return xs[0] - xs[1]
+        if self.op == "product":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+            return out
+        if self.op == "average":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out + x
+            return out / len(xs)
+        if self.op == "max":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        raise ValueError(f"Unknown ElementWiseVertex op {self.op}")
+
+
+class SubsetVertex(GraphVertex):
+    def __init__(self, frm, to):
+        self.frm, self.to = int(frm), int(to)  # inclusive, per reference
+
+    def output_type(self, *ts):
+        n = self.to - self.frm + 1
+        t = ts[0]
+        if isinstance(t, RecurrentType):
+            return InputType.recurrent(n, t.timeSeriesLength)
+        return InputType.feedForward(n)
+
+    def apply(self, *xs, mask=None):
+        return xs[0][..., self.frm:self.to + 1]
+
+
+class StackVertex(GraphVertex):
+    """Stack along batch dim (≡ StackVertex: concat examples)."""
+
+    def output_type(self, *ts):
+        return ts[0]
+
+    def apply(self, *xs, mask=None):
+        return jnp.concatenate(xs, axis=0)
+
+
+class UnstackVertex(GraphVertex):
+    def __init__(self, frm, stackSize):
+        self.frm, self.stackSize = int(frm), int(stackSize)
+
+    def output_type(self, *ts):
+        return ts[0]
+
+    def apply(self, *xs, mask=None):
+        x = xs[0]
+        step = x.shape[0] // self.stackSize
+        return x[self.frm * step:(self.frm + 1) * step]
+
+
+class ScaleVertex(GraphVertex):
+    def __init__(self, scaleFactor):
+        self.scale = float(scaleFactor)
+
+    def output_type(self, *ts):
+        return ts[0]
+
+    def apply(self, *xs, mask=None):
+        return xs[0] * self.scale
+
+
+class ShiftVertex(GraphVertex):
+    def __init__(self, shiftFactor):
+        self.shift = float(shiftFactor)
+
+    def output_type(self, *ts):
+        return ts[0]
+
+    def apply(self, *xs, mask=None):
+        return xs[0] + self.shift
+
+
+class L2NormalizeVertex(GraphVertex):
+    def __init__(self, eps=1e-8):
+        self.eps = float(eps)
+
+    def output_type(self, *ts):
+        return ts[0]
+
+    def apply(self, *xs, mask=None):
+        x = xs[0]
+        n = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True) + self.eps)
+        return x / n
+
+
+class PreprocessorVertex(GraphVertex):
+    def __init__(self, preprocessor):
+        self.pp = preprocessor
+
+    def output_type(self, *ts):
+        return self.pp.getOutputType(ts[0])
+
+    def apply(self, *xs, mask=None):
+        return self.pp.preProcess(xs[0])
+
+
+class ReshapeVertex(GraphVertex):
+    def __init__(self, *shape):
+        self.shape = tuple(int(s) for s in
+                           (shape[0] if len(shape) == 1 and
+                            isinstance(shape[0], (tuple, list)) else shape))
+
+    def output_type(self, *ts):
+        if len(self.shape) == 2:
+            return InputType.feedForward(self.shape[-1])
+        if len(self.shape) == 4:
+            return InputType.convolutional(*self.shape[1:])
+        return ts[0]
+
+    def apply(self, *xs, mask=None):
+        return xs[0].reshape(self.shape)
+
+
+class LastTimeStepVertex(GraphVertex):
+    """≡ rnn.LastTimeStepVertex — (B,T,F) -> (B,F), mask-aware."""
+
+    def __init__(self, maskArrayInputName=None):
+        self.maskName = maskArrayInputName
+
+    def output_type(self, *ts):
+        return InputType.feedForward(ts[0].size)
+
+    def apply(self, *xs, mask=None):
+        x = xs[0]
+        if mask is None:
+            return x[:, -1, :]
+        idx = jnp.maximum(jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1, 0)
+        return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :]

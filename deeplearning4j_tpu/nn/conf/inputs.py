@@ -1,0 +1,81 @@
+"""InputType system (≡ deeplearning4j-nn :: conf.inputs.InputType).
+
+Shapes are *per-example* (no batch dim). CNN activations are NHWC — the
+TPU-native layout (the reference is NCHW; we deliberately invert: XLA
+tiles NHWC convs onto the MXU without transposes).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class InputType:
+    @staticmethod
+    def feedForward(size):
+        return FeedForwardType(int(size))
+
+    @staticmethod
+    def convolutional(height, width, channels):
+        return ConvolutionalType(int(height), int(width), int(channels))
+
+    @staticmethod
+    def convolutionalFlat(height, width, channels):
+        return ConvolutionalFlatType(int(height), int(width), int(channels))
+
+    @staticmethod
+    def recurrent(size, timeSeriesLength=None):
+        return RecurrentType(int(size), timeSeriesLength)
+
+
+@dataclass(frozen=True)
+class FeedForwardType(InputType):
+    size: int
+
+    def arrayElementsPerExample(self):
+        return self.size
+
+    def shape(self):
+        return (self.size,)
+
+
+@dataclass(frozen=True)
+class ConvolutionalType(InputType):
+    """NHWC activation: (height, width, channels)."""
+    height: int
+    width: int
+    channels: int
+
+    def arrayElementsPerExample(self):
+        return self.height * self.width * self.channels
+
+    def shape(self):
+        return (self.height, self.width, self.channels)
+
+
+@dataclass(frozen=True)
+class ConvolutionalFlatType(InputType):
+    """Flattened image rows (e.g. raw MNIST vectors): needs a
+    FeedForwardToCnnPreProcessor before any conv layer."""
+    height: int
+    width: int
+    channels: int
+
+    def arrayElementsPerExample(self):
+        return self.height * self.width * self.channels
+
+    def shape(self):
+        return (self.height * self.width * self.channels,)
+
+
+@dataclass(frozen=True)
+class RecurrentType(InputType):
+    """(time, size) per example — batch-major (B, T, F) arrays."""
+    size: int
+    timeSeriesLength: object = None
+
+    def arrayElementsPerExample(self):
+        t = self.timeSeriesLength or 1
+        return self.size * t
+
+    def shape(self):
+        return (self.timeSeriesLength, self.size)

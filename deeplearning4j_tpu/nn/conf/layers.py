@@ -1,0 +1,695 @@
+"""Layer configurations (≡ deeplearning4j-nn :: conf.layers.*).
+
+Each config class doubles as the reference's `Layer.Builder` surface:
+`DenseLayer.Builder().nIn(4).nOut(3).build()` and `DenseLayer(nIn=4, nOut=3)`
+are equivalent. A layer config knows how to (a) infer its output InputType,
+(b) initialize parameters, (c) apply itself as a pure function — the network
+classes compose these into one jitted XLA program (the reference instead
+dispatches per-op kernels through its executioner; fusion is XLA's job here).
+
+Conventions: NHWC activations, HWIO conv kernels (TPU/MXU-native; the
+reference is NCHW/OIHW), batch-major (B, T, F) sequences. `dropOut(p)`
+follows the reference: p = RETAIN probability, inverted dropout at train
+time applied to the layer *input*.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import (
+    ConvolutionalType, FeedForwardType, InputType, RecurrentType)
+from deeplearning4j_tpu.nn.losses import get_loss
+from deeplearning4j_tpu.nn.weights_init import init_weight
+
+
+class _Builder:
+    """Generic fluent builder: any method call records a constructor kwarg."""
+
+    def __init__(self, cls, init_kw=None):
+        self._cls = cls
+        self._kw = dict(init_kw or {})
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def setter(*args):
+            self._kw[name] = args[0] if len(args) == 1 else tuple(args)
+            return self
+
+        return setter
+
+    def build(self):
+        return self._cls(**self._kw)
+
+
+class _BuilderFactory:
+    """Makes `SomeLayer.Builder(...)` work on every config class, including
+    the reference's positional-arg conventions (e.g.
+    `OutputLayer.Builder(LossFunction.MCXENT)`,
+    `ConvolutionLayer.Builder(5, 5)` = kernel,
+    `SubsamplingLayer.Builder(PoolingType.MAX)`)."""
+
+    def __get__(self, obj, objtype=None):
+        cls = objtype
+
+        def factory(*args):
+            return _Builder(cls, cls._builder_positional(args))
+
+        return factory
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+class Layer:
+    """Base layer config. Fields left None inherit NeuralNetConfiguration
+    globals (applied by the builder in nn.conf.builders)."""
+
+    Builder = _BuilderFactory()
+
+    INHERITED = ("activation", "weightInit", "biasInit", "l1", "l2",
+                 "dropOut", "updater", "gradientNormalization",
+                 "gradientNormalizationThreshold", "weightDecay")
+
+    @classmethod
+    def _builder_positional(cls, args):
+        if not args:
+            return {}
+        raise TypeError(f"{cls.__name__}.Builder takes no positional args")
+
+    def __init__(self, name=None, activation=None, weightInit=None,
+                 biasInit=None, l1=None, l2=None, dropOut=None, updater=None,
+                 dist=None, gradientNormalization=None,
+                 gradientNormalizationThreshold=None, weightDecay=None,
+                 constraints=None, **kw):
+        self.name = name
+        self.activation = activation
+        self.weightInit = weightInit
+        self.biasInit = biasInit
+        self.l1 = l1
+        self.l2 = l2
+        self.dropOut = dropOut
+        self.updater = updater
+        self.dist = dist
+        self.gradientNormalization = gradientNormalization
+        self.gradientNormalizationThreshold = gradientNormalizationThreshold
+        self.weightDecay = weightDecay
+        self.constraints = constraints
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+    # -- lifecycle -------------------------------------------------------
+    def apply_defaults(self, defaults: dict):
+        for field in self.INHERITED:
+            if getattr(self, field, None) is None and field in defaults:
+                setattr(self, field, defaults[field])
+        if self.activation is None:
+            self.activation = "identity"
+        if self.weightInit is None:
+            self.weightInit = "xavier"
+        if self.biasInit is None:
+            self.biasInit = 0.0
+        return self
+
+    def initialize(self, key, input_type):
+        """-> (params dict, state dict, output InputType)"""
+        return {}, {}, self.output_type(input_type)
+
+    def output_type(self, input_type):
+        return input_type
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        return x, state
+
+    # -- helpers ---------------------------------------------------------
+    def _dropout_in(self, x, train, rng):
+        p = self.dropOut
+        if not train or p is None or p == 0.0 or p == 1.0 or rng is None:
+            return x
+        keep = jax.random.bernoulli(rng, p, x.shape)
+        return jnp.where(keep, x / p, 0.0).astype(x.dtype)
+
+    def regularization_terms(self):
+        return (self.l1 or 0.0), (self.l2 or 0.0)
+
+    def n_params(self, input_type):
+        params, _, _ = self.initialize(jax.random.PRNGKey(0), input_type)
+        return sum(int(jnp.size(v)) for v in jax.tree_util.tree_leaves(params))
+
+
+class DenseLayer(Layer):
+    """≡ conf.layers.DenseLayer — y = act(xW + b), W:(nIn,nOut)."""
+
+    def __init__(self, nIn=None, nOut=None, hasBias=True, **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut, self.hasBias = nIn, nOut, hasBias
+
+    def output_type(self, input_type):
+        if self.nOut is None:
+            raise ValueError(
+                f"{type(self).__name__} '{self.name}': nOut is required "
+                "(set .nOut(n) on the builder)")
+        if isinstance(input_type, (ConvolutionalType,)):
+            raise ValueError(
+                f"DenseLayer '{self.name}' got convolutional input {input_type}; "
+                "add a CnnToFeedForwardPreProcessor (setInputType does this automatically)")
+        if isinstance(input_type, RecurrentType):
+            return InputType.recurrent(self.nOut, input_type.timeSeriesLength)
+        return InputType.feedForward(self.nOut)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.size
+        if self.nOut is None:
+            raise ValueError(f"DenseLayer '{self.name}': nOut not set")
+        w = init_weight(key, (int(self.nIn), int(self.nOut)), self.weightInit, self.dist)
+        params = {"W": w}
+        if self.hasBias:
+            params["b"] = jnp.full((int(self.nOut),), float(self.biasInit), jnp.float32)
+        return params, {}, self.output_type(input_type)
+
+    def pre_activation(self, params, x):
+        y = x @ params["W"].astype(x.dtype)
+        if self.hasBias:
+            y = y + params["b"].astype(x.dtype)
+        return y
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        return get_activation(self.activation)(self.pre_activation(params, x)), state
+
+
+class EmbeddingLayer(Layer):
+    """≡ conf.layers.EmbeddingLayer — int indices (B,) or one-hot (B, nIn)
+    to dense vectors via gather (no matmul against one-hot on TPU)."""
+
+    def __init__(self, nIn=None, nOut=None, hasBias=False, **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut, self.hasBias = nIn, nOut, hasBias
+
+    def output_type(self, input_type):
+        return InputType.feedForward(self.nOut)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.size
+        w = init_weight(key, (int(self.nIn), int(self.nOut)), self.weightInit, self.dist)
+        params = {"W": w}
+        if self.hasBias:
+            params["b"] = jnp.full((int(self.nOut),), float(self.biasInit), jnp.float32)
+        return params, {}, self.output_type(input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        w = params["W"]
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            y = jnp.take(w, x.reshape(x.shape[0]).astype(jnp.int32), axis=0)
+        elif x.ndim == 2 and x.shape[-1] == w.shape[0]:
+            idx = jnp.argmax(x, axis=-1)
+            y = jnp.take(w, idx, axis=0)
+        else:
+            y = jnp.take(w, x.reshape(-1).astype(jnp.int32), axis=0)
+        if self.hasBias:
+            y = y + params["b"].astype(y.dtype)
+        return get_activation(self.activation)(y), state
+
+
+class EmbeddingSequenceLayer(Layer):
+    """≡ EmbeddingSequenceLayer — (B, T) int tokens -> (B, T, nOut)."""
+
+    def __init__(self, nIn=None, nOut=None, inputLength=None, **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut, self.inputLength = nIn, nOut, inputLength
+
+    def output_type(self, input_type):
+        t = getattr(input_type, "timeSeriesLength", None) or self.inputLength
+        return InputType.recurrent(self.nOut, t)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.size
+        w = init_weight(key, (int(self.nIn), int(self.nOut)), self.weightInit, self.dist)
+        return {"W": w}, {}, self.output_type(input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        if x.ndim == 3:  # one-hot (B, T, nIn)
+            x = jnp.argmax(x, axis=-1)
+        y = jnp.take(params["W"], x.astype(jnp.int32), axis=0)
+        return get_activation(self.activation)(y), state
+
+
+class ConvolutionLayer(Layer):
+    """≡ conf.layers.ConvolutionLayer (2D). NHWC/HWIO, lax.conv lowering
+    straight onto the MXU (replaces CudnnConvolutionHelper algo selection —
+    XLA picks the tiling)."""
+
+    @classmethod
+    def _builder_positional(cls, args):
+        if not args:
+            return {}
+        if len(args) == 1:
+            return {"kernelSize": args[0]}
+        return {"kernelSize": tuple(args)}
+
+    def __init__(self, nIn=None, nOut=None, kernelSize=(3, 3), stride=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), convolutionMode="truncate",
+                 hasBias=True, **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut = nIn, nOut
+        self.kernelSize, self.stride = _pair(kernelSize), _pair(stride)
+        self.padding, self.dilation = _pair(padding), _pair(dilation)
+        self.convolutionMode = convolutionMode
+        self.hasBias = hasBias
+
+    def _padding_arg(self):
+        if str(self.convolutionMode).lower() == "same":
+            return "SAME"
+        return [(self.padding[0], self.padding[0]), (self.padding[1], self.padding[1])]
+
+    def output_type(self, input_type):
+        if self.nOut is None:
+            raise ValueError(
+                f"{type(self).__name__} '{self.name}': nOut is required "
+                "(set .nOut(n) on the builder)")
+        if not isinstance(input_type, ConvolutionalType):
+            raise ValueError(
+                f"ConvolutionLayer '{self.name}' needs convolutional input, got {input_type}")
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        if str(self.convolutionMode).lower() == "same":
+            oh = -(-input_type.height // sh)
+            ow = -(-input_type.width // sw)
+        else:
+            ph, pw = self.padding
+            oh = (input_type.height + 2 * ph - ((kh - 1) * self.dilation[0] + 1)) // sh + 1
+            ow = (input_type.width + 2 * pw - ((kw - 1) * self.dilation[1] + 1)) // sw + 1
+        return InputType.convolutional(oh, ow, self.nOut)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.channels
+        kh, kw = self.kernelSize
+        w = init_weight(key, (kh, kw, int(self.nIn), int(self.nOut)),
+                        self.weightInit, self.dist)
+        params = {"W": w}
+        if self.hasBias:
+            params["b"] = jnp.full((int(self.nOut),), float(self.biasInit), jnp.float32)
+        return params, {}, self.output_type(input_type)
+
+    def pre_activation(self, params, x):
+        y = lax.conv_general_dilated(
+            x, params["W"].astype(x.dtype),
+            window_strides=self.stride,
+            padding=self._padding_arg(),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.hasBias:
+            y = y + params["b"].astype(x.dtype)
+        return y
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        return get_activation(self.activation)(self.pre_activation(params, x)), state
+
+
+class SeparableConvolution2D(ConvolutionLayer):
+    """≡ conf.layers.SeparableConvolution2D — depthwise + pointwise."""
+
+    def __init__(self, depthMultiplier=1, **kw):
+        super().__init__(**kw)
+        self.depthMultiplier = int(depthMultiplier)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.channels
+        kh, kw = self.kernelSize
+        k1, k2 = jax.random.split(key)
+        dw = init_weight(k1, (kh, kw, 1, int(self.nIn) * self.depthMultiplier),
+                         self.weightInit, self.dist)
+        pw = init_weight(k2, (1, 1, int(self.nIn) * self.depthMultiplier, int(self.nOut)),
+                         self.weightInit, self.dist)
+        params = {"dW": dw, "pW": pw}
+        if self.hasBias:
+            params["b"] = jnp.full((int(self.nOut),), float(self.biasInit), jnp.float32)
+        return params, {}, self.output_type(input_type)
+
+    def pre_activation(self, params, x):
+        y = lax.conv_general_dilated(
+            x, params["dW"].astype(x.dtype),
+            window_strides=self.stride,
+            padding=self._padding_arg(),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=int(self.nIn))
+        y = lax.conv_general_dilated(
+            y, params["pW"].astype(x.dtype),
+            window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.hasBias:
+            y = y + params["b"].astype(x.dtype)
+        return y
+
+
+class SubsamplingLayer(Layer):
+    """≡ conf.layers.SubsamplingLayer — max/avg pooling, NHWC."""
+
+    MAX, AVG = "max", "avg"
+
+    @classmethod
+    def _builder_positional(cls, args):
+        if not args:
+            return {}
+        if isinstance(args[0], str):
+            out = {"poolingType": args[0]}
+            if len(args) > 1:
+                out["kernelSize"] = args[1]
+            if len(args) > 2:
+                out["stride"] = args[2]
+            return out
+        out = {"kernelSize": args[0]}
+        if len(args) > 1:
+            out["stride"] = args[1]
+        return out
+
+    def __init__(self, poolingType="max", kernelSize=(2, 2), stride=(2, 2),
+                 padding=(0, 0), convolutionMode="truncate", **kw):
+        super().__init__(**kw)
+        self.poolingType = str(poolingType).lower()
+        self.kernelSize, self.stride, self.padding = _pair(kernelSize), _pair(stride), _pair(padding)
+        self.convolutionMode = convolutionMode
+
+    def output_type(self, input_type):
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        if str(self.convolutionMode).lower() == "same":
+            oh, ow = -(-input_type.height // sh), -(-input_type.width // sw)
+        else:
+            ph, pw = self.padding
+            oh = (input_type.height + 2 * ph - kh) // sh + 1
+            ow = (input_type.width + 2 * pw - kw) // sw + 1
+        return InputType.convolutional(oh, ow, input_type.channels)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        if str(self.convolutionMode).lower() == "same":
+            pad = "SAME"
+        else:
+            ph, pw = self.padding
+            pad = [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+        dims, strides = (1, kh, kw, 1), (1, sh, sw, 1)
+        if self.poolingType == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+        elif self.poolingType in ("avg", "mean"):
+            s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad)
+            y = s / cnt
+        else:
+            raise ValueError(f"Unknown poolingType {self.poolingType}")
+        return y, state
+
+
+class BatchNormalization(Layer):
+    """≡ conf.layers.BatchNormalization — channel-last batch norm (replaces
+    CudnnBatchNormalizationHelper; XLA fuses scale/shift into neighbors).
+    State carries running mean/var; `decay` follows the reference default."""
+
+    def __init__(self, nOut=None, decay=0.9, eps=1e-5, gamma=1.0, beta=0.0,
+                 lockGammaBeta=False, **kw):
+        super().__init__(**kw)
+        self.nOut, self.decay, self.eps = nOut, float(decay), float(eps)
+        self.gammaInit, self.betaInit = float(gamma), float(beta)
+        self.lockGammaBeta = lockGammaBeta
+
+    def output_type(self, input_type):
+        return input_type
+
+    def _nfeat(self, input_type):
+        if isinstance(input_type, ConvolutionalType):
+            return input_type.channels
+        return input_type.size
+
+    def initialize(self, key, input_type):
+        n = int(self.nOut or self._nfeat(input_type))
+        self.nOut = n
+        params = {} if self.lockGammaBeta else {
+            "gamma": jnp.full((n,), self.gammaInit, jnp.float32),
+            "beta": jnp.full((n,), self.betaInit, jnp.float32)}
+        state = {"mean": jnp.zeros((n,), jnp.float32),
+                 "var": jnp.ones((n,), jnp.float32)}
+        return params, state, input_type
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        axes = tuple(range(x.ndim - 1))
+        if train:
+            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+        if not self.lockGammaBeta:
+            y = y * params["gamma"].astype(x.dtype) + params["beta"].astype(x.dtype)
+        return get_activation(self.activation)(y), new_state
+
+
+class ActivationLayer(Layer):
+    """≡ conf.layers.ActivationLayer."""
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        return get_activation(self.activation)(x), state
+
+
+class DropoutLayer(Layer):
+    """≡ conf.layers.DropoutLayer — dropOut is the RETAIN probability."""
+
+    def __init__(self, dropOut=0.5, **kw):
+        super().__init__(dropOut=dropOut, **kw)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        return self._dropout_in(x, train, rng), state
+
+
+class ZeroPaddingLayer(Layer):
+    """≡ conf.layers.ZeroPaddingLayer (2D, NHWC)."""
+
+    def __init__(self, padding=(1, 1), **kw):
+        super().__init__(**kw)
+        p = padding
+        if isinstance(p, int):
+            p = (p, p, p, p)
+        elif len(p) == 2:
+            p = (p[0], p[0], p[1], p[1])
+        self.pad = tuple(int(v) for v in p)  # (top, bottom, left, right)
+
+    def output_type(self, input_type):
+        t, b, l, r = self.pad
+        return InputType.convolutional(input_type.height + t + b,
+                                       input_type.width + l + r,
+                                       input_type.channels)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        t, b, l, r = self.pad
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+class Upsampling2D(Layer):
+    """≡ conf.layers.Upsampling2D — nearest-neighbour repeat."""
+
+    def __init__(self, size=2, **kw):
+        super().__init__(**kw)
+        self.size = _pair(size)
+
+    def output_type(self, input_type):
+        return InputType.convolutional(input_type.height * self.size[0],
+                                       input_type.width * self.size[1],
+                                       input_type.channels)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        y = jnp.repeat(jnp.repeat(x, self.size[0], axis=1), self.size[1], axis=2)
+        return y, state
+
+
+class GlobalPoolingLayer(Layer):
+    """≡ conf.layers.GlobalPoolingLayer — pools CNN (H,W) or RNN (T) dims.
+    poolingType: MAX | AVG | SUM | PNORM."""
+
+    @classmethod
+    def _builder_positional(cls, args):
+        return {"poolingType": args[0]} if args else {}
+
+    def __init__(self, poolingType="max", pnorm=2, collapseDimensions=True, **kw):
+        super().__init__(**kw)
+        self.poolingType = str(poolingType).lower()
+        self.pnorm = pnorm
+        self.collapseDimensions = collapseDimensions
+
+    def output_type(self, input_type):
+        if isinstance(input_type, ConvolutionalType):
+            return InputType.feedForward(input_type.channels)
+        if isinstance(input_type, RecurrentType):
+            return InputType.feedForward(input_type.size)
+        return input_type
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        axes = (1, 2) if x.ndim == 4 else (1,)
+        if self.poolingType == "max":
+            if mask is not None and x.ndim == 3:
+                x = jnp.where(mask[..., None] > 0, x, -jnp.inf)
+            y = jnp.max(x, axis=axes)
+        elif self.poolingType in ("avg", "mean"):
+            if mask is not None and x.ndim == 3:
+                m = mask[..., None].astype(x.dtype)
+                y = jnp.sum(x * m, axis=axes) / jnp.maximum(jnp.sum(m, axis=axes), 1.0)
+            else:
+                y = jnp.mean(x, axis=axes)
+        elif self.poolingType == "sum":
+            y = jnp.sum(x, axis=axes)
+        elif self.poolingType == "pnorm":
+            y = jnp.sum(jnp.abs(x) ** self.pnorm, axis=axes) ** (1.0 / self.pnorm)
+        else:
+            raise ValueError(f"Unknown poolingType {self.poolingType}")
+        return y, state
+
+
+class PReLULayer(Layer):
+    """≡ conf.layers.PReLULayer — learned per-channel negative slope."""
+
+    def __init__(self, alphaInit=0.0, **kw):
+        super().__init__(**kw)
+        self.alphaInit = float(alphaInit)
+
+    def initialize(self, key, input_type):
+        n = input_type.shape()[-1]
+        return ({"alpha": jnp.full((n,), self.alphaInit, jnp.float32)},
+                {}, input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        a = params["alpha"].astype(x.dtype)
+        return jnp.where(x >= 0, x, a * x), state
+
+
+class BaseOutputLayer(Layer):
+    @classmethod
+    def _builder_positional(cls, args):
+        return {"lossFunction": args[0]} if args else {}
+
+    def __init__(self, lossFunction="mcxent", **kw):
+        kw.setdefault("activation", None)
+        super().__init__(**kw)
+        self.lossFunction = lossFunction
+
+    def apply_defaults(self, defaults):
+        super().apply_defaults(defaults)
+        if self.activation in (None, "identity") and "activation" not in defaults:
+            self.activation = "softmax"
+        return self
+
+    def compute_loss(self, labels, preact, mask=None):
+        return get_loss(self.lossFunction)(labels, preact, self.activation, mask)
+
+
+class OutputLayer(BaseOutputLayer, DenseLayer):
+    """≡ conf.layers.OutputLayer — dense + loss head."""
+
+    def __init__(self, lossFunction="mcxent", **kw):
+        DenseLayer.__init__(self, **{k: v for k, v in kw.items()})
+        self.lossFunction = lossFunction
+        if kw.get("activation") is None:
+            self.activation = None
+
+    def apply_defaults(self, defaults):
+        Layer.apply_defaults(self, defaults)
+        if self.activation == "identity":
+            self.activation = "softmax"
+        return self
+
+
+class LossLayer(BaseOutputLayer):
+    """≡ conf.layers.LossLayer — loss only, no parameters."""
+
+    def pre_activation(self, params, x):
+        return x
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        return get_activation(self.activation)(x), state
+
+
+class Convolution1DLayer(Layer):
+    """≡ conf.layers.Convolution1DLayer — (B, T, F) temporal conv."""
+
+    def __init__(self, nIn=None, nOut=None, kernelSize=3, stride=1, padding=0,
+                 dilation=1, convolutionMode="same", hasBias=True, **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut = nIn, nOut
+        self.kernelSize, self.stride = int(kernelSize), int(stride)
+        self.padding, self.dilation = int(padding), int(dilation)
+        self.convolutionMode, self.hasBias = convolutionMode, hasBias
+
+    def output_type(self, input_type):
+        t = input_type.timeSeriesLength
+        if t is not None:
+            if str(self.convolutionMode).lower() == "same":
+                t = -(-t // self.stride)
+            else:
+                t = (t + 2 * self.padding - ((self.kernelSize - 1) * self.dilation + 1)) // self.stride + 1
+        return InputType.recurrent(self.nOut, t)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.size
+        w = init_weight(key, (self.kernelSize, int(self.nIn), int(self.nOut)),
+                        self.weightInit, self.dist)
+        params = {"W": w}
+        if self.hasBias:
+            params["b"] = jnp.full((int(self.nOut),), float(self.biasInit), jnp.float32)
+        return params, {}, self.output_type(input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        pad = ("SAME" if str(self.convolutionMode).lower() == "same"
+               else [(self.padding, self.padding)])
+        y = lax.conv_general_dilated(
+            x, params["W"].astype(x.dtype), window_strides=(self.stride,),
+            padding=pad, rhs_dilation=(self.dilation,),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.hasBias:
+            y = y + params["b"].astype(x.dtype)
+        return get_activation(self.activation)(y), state
+
+
+class Subsampling1DLayer(Layer):
+    """≡ conf.layers.Subsampling1DLayer — (B, T, F) pooling."""
+
+    def __init__(self, poolingType="max", kernelSize=2, stride=2, padding=0, **kw):
+        super().__init__(**kw)
+        self.poolingType = str(poolingType).lower()
+        self.kernelSize, self.stride, self.padding = int(kernelSize), int(stride), int(padding)
+
+    def output_type(self, input_type):
+        t = input_type.timeSeriesLength
+        if t is not None:
+            t = (t + 2 * self.padding - self.kernelSize) // self.stride + 1
+        return InputType.recurrent(input_type.size, t)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        dims, strides = (1, self.kernelSize, 1), (1, self.stride, 1)
+        pad = [(0, 0), (self.padding, self.padding), (0, 0)]
+        if self.poolingType == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+            c = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims, strides, pad)
+            y = s / c
+        return y, state

@@ -1,0 +1,323 @@
+"""Recurrent layers (≡ deeplearning4j-nn :: conf.layers.LSTM / GravesLSTM /
+recurrent.Bidirectional / RnnOutputLayer / recurrent.LastTimeStep).
+
+TPU-native design: batch-major (B, T, F) sequences, the whole unroll is a
+single `lax.scan` (static trip count → one compiled loop on device, the
+reference instead launches per-timestep CUDA kernels via CudnnLSTMHelper).
+The input projection x·W for ALL timesteps is hoisted out of the scan into
+one big (B*T, nIn)×(nIn, 4H) matmul that rides the MXU; only the recurrent
+h·U matmul stays inside the loop.
+
+Masking follows the reference: masked steps emit zeros and hold the carry.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType, RecurrentType
+from deeplearning4j_tpu.nn.conf.layers import (BaseOutputLayer, DenseLayer,
+                                               Layer)
+from deeplearning4j_tpu.nn.weights_init import init_weight
+
+
+class BaseRecurrentLayer(Layer):
+    is_recurrent = True
+
+    def __init__(self, nIn=None, nOut=None, forgetGateBiasInit=1.0,
+                 gateActivationFn="sigmoid", **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut = nIn, nOut
+        self.forgetGateBiasInit = float(forgetGateBiasInit)
+        self.gateActivationFn = gateActivationFn
+
+    def apply_defaults(self, defaults):
+        super().apply_defaults(defaults)
+        if self.activation == "identity":
+            self.activation = "tanh"  # reference default for LSTMs
+        return self
+
+    def output_type(self, input_type):
+        t = getattr(input_type, "timeSeriesLength", None)
+        return InputType.recurrent(self.nOut, t)
+
+    def zero_carry(self, batch, dtype=jnp.float32):
+        h = jnp.zeros((batch, int(self.nOut)), dtype)
+        c = jnp.zeros((batch, int(self.nOut)), dtype)
+        return (h, c)
+
+    def scan_apply(self, params, x, carry0=None, mask=None):
+        raise NotImplementedError
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        y, _ = self.scan_apply(params, x, None, mask)
+        return y, state
+
+
+class LSTM(BaseRecurrentLayer):
+    """≡ conf.layers.LSTM (no peepholes). Gate order [i, f, o, g]."""
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.size
+        if self.nOut is None:
+            raise ValueError(f"LSTM '{self.name}': nOut not set")
+        n_in, n_out = int(self.nIn), int(self.nOut)
+        k1, k2 = jax.random.split(key)
+        w = init_weight(k1, (n_in, 4 * n_out), self.weightInit, self.dist)
+        u = init_weight(k2, (n_out, 4 * n_out), self.weightInit, self.dist)
+        b = jnp.zeros((4, n_out), jnp.float32)
+        b = b.at[1].set(self.forgetGateBiasInit)  # forget-gate bias
+        return ({"W": w, "U": u, "b": b.reshape(4 * n_out)},
+                {}, self.output_type(input_type))
+
+    def _gates(self, z, c_prev, params, dtype):
+        n_out = int(self.nOut)
+        gate = get_activation(self.gateActivationFn)
+        act = get_activation(self.activation)
+        i = gate(z[:, 0 * n_out:1 * n_out])
+        f = gate(z[:, 1 * n_out:2 * n_out])
+        o = gate(z[:, 2 * n_out:3 * n_out])
+        g = act(z[:, 3 * n_out:4 * n_out])
+        c = f * c_prev + i * g
+        h = o * act(c)
+        return h, c
+
+    def scan_apply(self, params, x, carry0=None, mask=None):
+        b, t, _ = x.shape
+        dtype = x.dtype
+        if carry0 is None:
+            carry0 = self.zero_carry(b, dtype)
+        else:
+            carry0 = tuple(c.astype(dtype) for c in carry0)
+        # hoist input projection out of the scan: one MXU matmul for all T
+        xw = (x.reshape(b * t, -1) @ params["W"].astype(dtype)
+              + params["b"].astype(dtype)).reshape(b, t, -1)
+        xw_t = jnp.swapaxes(xw, 0, 1)  # (T, B, 4H) scan-major
+        u = params["U"].astype(dtype)
+        mask_t = None if mask is None else jnp.swapaxes(
+            mask.astype(dtype), 0, 1)  # (T, B)
+
+        def step(carry, inp):
+            h_prev, c_prev = carry
+            if mask_t is None:
+                zxw = inp
+                m = None
+            else:
+                zxw, m = inp
+            z = zxw + h_prev @ u
+            h, c = self._gates(z, c_prev, params, dtype)
+            if m is not None:
+                mm = m[:, None]
+                h = mm * h + (1 - mm) * h_prev
+                c = mm * c + (1 - mm) * c_prev
+                y = mm * h
+            else:
+                y = h
+            return (h, c), y
+
+        xs = xw_t if mask_t is None else (xw_t, mask_t)
+        carryT, ys = lax.scan(step, carry0, xs)
+        return jnp.swapaxes(ys, 0, 1), carryT
+
+
+class GravesLSTM(LSTM):
+    """≡ conf.layers.GravesLSTM — LSTM with peephole connections
+    (Graves 2013): i,f peek at c_{t-1}, o peeks at c_t."""
+
+    def initialize(self, key, input_type):
+        params, state, out = super().initialize(key, input_type)
+        n_out = int(self.nOut)
+        params["pI"] = jnp.zeros((n_out,), jnp.float32)
+        params["pF"] = jnp.zeros((n_out,), jnp.float32)
+        params["pO"] = jnp.zeros((n_out,), jnp.float32)
+        return params, state, out
+
+    def _gates(self, z, c_prev, params, dtype):
+        n_out = int(self.nOut)
+        gate = get_activation(self.gateActivationFn)
+        act = get_activation(self.activation)
+        i = gate(z[:, 0 * n_out:1 * n_out] + params["pI"].astype(dtype) * c_prev)
+        f = gate(z[:, 1 * n_out:2 * n_out] + params["pF"].astype(dtype) * c_prev)
+        g = act(z[:, 3 * n_out:4 * n_out])
+        c = f * c_prev + i * g
+        o = gate(z[:, 2 * n_out:3 * n_out] + params["pO"].astype(dtype) * c)
+        h = o * act(c)
+        return h, c
+
+
+class SimpleRnn(BaseRecurrentLayer):
+    """≡ conf.layers.recurrent.SimpleRnn — h_t = act(xW + h·U + b)."""
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.size
+        n_in, n_out = int(self.nIn), int(self.nOut)
+        k1, k2 = jax.random.split(key)
+        return ({"W": init_weight(k1, (n_in, n_out), self.weightInit, self.dist),
+                 "U": init_weight(k2, (n_out, n_out), self.weightInit, self.dist),
+                 "b": jnp.zeros((n_out,), jnp.float32)},
+                {}, self.output_type(input_type))
+
+    def zero_carry(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, int(self.nOut)), dtype),)
+
+    def scan_apply(self, params, x, carry0=None, mask=None):
+        b, t, _ = x.shape
+        dtype = x.dtype
+        if carry0 is None:
+            carry0 = self.zero_carry(b, dtype)
+        else:
+            carry0 = tuple(c.astype(dtype) for c in carry0)
+        act = get_activation(self.activation)
+        xw = (x.reshape(b * t, -1) @ params["W"].astype(dtype)
+              + params["b"].astype(dtype)).reshape(b, t, -1)
+        xw_t = jnp.swapaxes(xw, 0, 1)
+        u = params["U"].astype(dtype)
+        mask_t = None if mask is None else jnp.swapaxes(mask.astype(dtype), 0, 1)
+
+        def step(carry, inp):
+            (h_prev,) = carry
+            if mask_t is None:
+                zxw, m = inp, None
+            else:
+                zxw, m = inp
+            h = act(zxw + h_prev @ u)
+            if m is not None:
+                mm = m[:, None]
+                h = mm * h + (1 - mm) * h_prev
+                y = mm * h
+            else:
+                y = h
+            return (h,), y
+
+        xs = xw_t if mask_t is None else (xw_t, mask_t)
+        carryT, ys = lax.scan(step, carry0, xs)
+        return jnp.swapaxes(ys, 0, 1), carryT
+
+
+class Bidirectional(Layer):
+    """≡ recurrent.Bidirectional(mode, layer) — wraps any recurrent layer;
+    merge modes CONCAT/ADD/MUL/AVERAGE."""
+
+    CONCAT, ADD, MUL, AVERAGE = "concat", "add", "mul", "average"
+    is_recurrent = True
+
+    @classmethod
+    def _builder_positional(cls, args):
+        if len(args) == 1:
+            return {"layer": args[0]}
+        if len(args) == 2:
+            return {"mode": args[0], "layer": args[1]}
+        return {}
+
+    def __init__(self, layer=None, mode="concat", **kw):
+        super().__init__(**kw)
+        if layer is None:
+            raise ValueError("Bidirectional requires a wrapped recurrent layer")
+        import copy
+        self.mode = str(mode).lower()
+        self.fwd = layer
+        self.bwd = copy.deepcopy(layer)
+
+    def apply_defaults(self, defaults):
+        super().apply_defaults(defaults)
+        self.fwd.apply_defaults(defaults)
+        self.bwd.apply_defaults(defaults)
+        return self
+
+    @property
+    def nOut(self):
+        n = int(self.fwd.nOut)
+        return 2 * n if self.mode == "concat" else n
+
+    def output_type(self, input_type):
+        t = getattr(input_type, "timeSeriesLength", None)
+        return InputType.recurrent(self.nOut, t)
+
+    def initialize(self, key, input_type):
+        k1, k2 = jax.random.split(key)
+        pf, _, _ = self.fwd.initialize(k1, input_type)
+        pb, _, _ = self.bwd.initialize(k2, input_type)
+        return {"fwd": pf, "bwd": pb}, {}, self.output_type(input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        yf, _ = self.fwd.scan_apply(params["fwd"], x, None, mask)
+        xr = jnp.flip(x, axis=1)
+        mr = None if mask is None else jnp.flip(mask, axis=1)
+        yb, _ = self.bwd.scan_apply(params["bwd"], xr, None, mr)
+        yb = jnp.flip(yb, axis=1)
+        if self.mode == "concat":
+            y = jnp.concatenate([yf, yb], axis=-1)
+        elif self.mode == "add":
+            y = yf + yb
+        elif self.mode == "mul":
+            y = yf * yb
+        elif self.mode == "average":
+            y = 0.5 * (yf + yb)
+        else:
+            raise ValueError(f"Unknown Bidirectional mode {self.mode}")
+        return y, state
+
+
+class RnnOutputLayer(BaseOutputLayer, DenseLayer):
+    """≡ conf.layers.RnnOutputLayer — per-timestep dense + loss over
+    (B, T, C) with label masks."""
+
+    def __init__(self, lossFunction="mcxent", **kw):
+        DenseLayer.__init__(self, **kw)
+        self.lossFunction = lossFunction
+        if kw.get("activation") is None:
+            self.activation = None
+
+    def apply_defaults(self, defaults):
+        Layer.apply_defaults(self, defaults)
+        if self.activation == "identity":
+            self.activation = "softmax"
+        return self
+
+    def output_type(self, input_type):
+        t = getattr(input_type, "timeSeriesLength", None)
+        return InputType.recurrent(self.nOut, t)
+
+
+class LastTimeStep(Layer):
+    """≡ recurrent.LastTimeStep(layer) — wraps a recurrent layer, emits the
+    last (mask-aware) timestep as FF activations."""
+
+    @classmethod
+    def _builder_positional(cls, args):
+        return {"layer": args[0]} if args else {}
+
+    def __init__(self, layer=None, **kw):
+        super().__init__(**kw)
+        if layer is None:
+            raise ValueError("LastTimeStep requires a wrapped layer")
+        self.inner = layer
+
+    def apply_defaults(self, defaults):
+        super().apply_defaults(defaults)
+        self.inner.apply_defaults(defaults)
+        return self
+
+    def output_type(self, input_type):
+        inner_out = self.inner.output_type(input_type)
+        return InputType.feedForward(inner_out.size)
+
+    def initialize(self, key, input_type):
+        p, s, _ = self.inner.initialize(key, input_type)
+        return p, s, self.output_type(input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        y, new_state = self.inner.apply(params, state, x, train=train,
+                                        rng=rng, mask=mask)
+        if mask is None:
+            out = y[:, -1, :]
+        else:
+            idx = jnp.maximum(jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1, 0)
+            out = jnp.take_along_axis(y, idx[:, None, None], axis=1)[:, 0, :]
+        return out, new_state

@@ -1,0 +1,326 @@
+"""ComputationGraph (≡ deeplearning4j-nn :: graph.ComputationGraph).
+
+DAG-structured network over GraphNode topology: multi-input, multi-output,
+per-output losses summed into one scalar — so the whole training step is
+still ONE jitted XLA executable (forward over topo order + backward +
+updaters), the TPU-native counterpart of the reference's vertex-by-vertex
+executioner dispatch."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn.multilayer import _l1l2_penalty
+from deeplearning4j_tpu.nn.updaters import build_optimizer
+from deeplearning4j_tpu.ops.ndarray import NDArray, as_jax, resolve_dtype
+
+
+class ComputationGraph:
+    def __init__(self, conf):
+        self.conf = conf
+        self.nodes = conf.nodes
+        self._params = None
+        self._state = None
+        self._opt_state = None
+        self._tx = None
+        self._listeners = []
+        self._score = None
+        self._iteration = 0
+        self._epoch = 0
+        self._compute_dtype = resolve_dtype(conf.data_type) or jnp.float32
+        self._rng_key = jax.random.PRNGKey(conf.seed)
+
+    # layer-bearing node names in topo order
+    @property
+    def _layer_names(self):
+        return [n for n in self.conf.topo_order
+                if self.nodes[n].kind == "layer"]
+
+    @property
+    def _output_layers(self):
+        return [self.nodes[n].ref for n in self.conf.output_names]
+
+    # -- lifecycle -------------------------------------------------------
+    def init(self):
+        if not self.conf.node_output_types:
+            raise ValueError("setInputTypes(...) required before init()")
+        key = jax.random.PRNGKey(self.conf.seed)
+        ps, ss = {}, {}
+        for name in self.conf.topo_order:
+            node = self.nodes[name]
+            if node.kind != "layer":
+                continue
+            key, sub = jax.random.split(key)
+            p, s, _ = node.ref.initialize(sub, node.resolved_input_type)
+            if p:
+                ps[name] = p
+            if s:
+                ss[name] = s
+        self._params = ps
+        self._state = ss
+        self._build_optimizer()
+        return self
+
+    def _build_optimizer(self):
+        defaults = self.conf.defaults
+        global_updater = defaults.get("updater")
+        overrides = {n: self.nodes[n].ref.updater for n in self._layer_names
+                     if self.nodes[n].ref.updater is not None
+                     and self.nodes[n].ref.updater is not global_updater}
+        gn = defaults.get("gradientNormalization")
+        gn_thr = defaults.get("gradientNormalizationThreshold", 1.0)
+        wd = defaults.get("weightDecay", 0.0) or 0.0
+        if not overrides:
+            self._tx = build_optimizer(global_updater, gn, gn_thr, wd)
+        else:
+            transforms = {"__global__": build_optimizer(global_updater, gn, gn_thr, wd)}
+            transforms.update({k: build_optimizer(u, gn, gn_thr, wd)
+                               for k, u in overrides.items()})
+            labels = {k: (k if k in overrides else "__global__")
+                      for k in self._params}
+            self._tx = optax.multi_transform(transforms, labels)
+        self._opt_state = self._tx.init(self._params)
+
+    # -- parameters ------------------------------------------------------
+    def numParams(self):
+        return sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(self._params))
+
+    def params(self):
+        names = sorted(self._params)
+        leaves = jax.tree_util.tree_leaves({n: self._params[n] for n in names})
+        if not leaves:
+            return NDArray(jnp.zeros((0,)))
+        return NDArray(jnp.concatenate([l.ravel() for l in leaves]))
+
+    def paramTable(self):
+        flat = {}
+        for name, p in (self._params or {}).items():
+            for k, v in p.items():
+                flat[f"{name}_{k}"] = NDArray(v)
+        return flat
+
+    def getLayer(self, name):
+        return self.nodes[name].ref
+
+    # -- forward ---------------------------------------------------------
+    def _forward(self, params, state, inputs, train, rng, fmasks=None,
+                 want=None):
+        """inputs: dict name->array. Returns (acts dict, preacts dict for
+        output layers, new_state)."""
+        acts = {}
+        preacts = {}
+        new_state = dict(state)
+        mask0 = None
+        if fmasks:
+            mask0 = next((m for m in fmasks.values() if m is not None), None)
+        for name, x in inputs.items():
+            acts[name] = x.astype(self._compute_dtype)
+        li = 0
+        for name in self.conf.topo_order:
+            node = self.nodes[name]
+            if node.kind == "input":
+                continue
+            parents = [acts[p] for p in node.inputs]
+            if node.kind == "vertex":
+                pmask = mask0
+                if fmasks and getattr(node.ref, "maskName", None):
+                    pmask = fmasks.get(node.ref.maskName, mask0)
+                acts[name] = node.ref.apply(*parents, mask=pmask)
+                continue
+            layer = node.ref
+            x = parents[0]
+            if node.preprocessor is not None:
+                x = node.preprocessor.preProcess(x)
+            lrng = jax.random.fold_in(rng, li) if rng is not None else None
+            li += 1
+            p = params.get(name, {})
+            s = state.get(name, {})
+            if name in self.conf.output_names and hasattr(layer, "compute_loss"):
+                pre = layer.pre_activation(p, layer._dropout_in(x, train, lrng))
+                preacts[name] = pre
+                from deeplearning4j_tpu.nn.activations import get_activation
+                acts[name] = get_activation(layer.activation)(pre)
+            else:
+                y, ns = layer.apply(p, s, x, train=train, rng=lrng, mask=mask0)
+                acts[name] = y
+                if ns:
+                    new_state[name] = ns
+        return acts, preacts, new_state
+
+    def _as_input_dict(self, inputs):
+        if isinstance(inputs, dict):
+            return {k: as_jax(v) for k, v in inputs.items()}
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        return {n: as_jax(v) for n, v in zip(self.conf.input_names, inputs)}
+
+    def output(self, *inputs, train=False):
+        if len(inputs) == 1:
+            inputs = inputs[0]
+        ins = self._as_input_dict(inputs)
+        acts, _, _ = self._forward(self._params, self._state, ins, train, None)
+        outs = [NDArray(acts[n]) for n in self.conf.output_names]
+        return outs[0] if len(outs) == 1 else outs
+
+    def outputSingle(self, *inputs):
+        out = self.output(*inputs)
+        return out[0] if isinstance(out, list) else out
+
+    def feedForward(self, inputs, train=False):
+        ins = self._as_input_dict(inputs)
+        acts, _, _ = self._forward(self._params, self._state, ins, train, None)
+        return {k: NDArray(v) for k, v in acts.items()}
+
+    # -- loss ------------------------------------------------------------
+    def _loss(self, params, state, inputs, labels, fmasks, lmasks, rng,
+              train=True):
+        acts, preacts, new_state = self._forward(params, state, inputs, train,
+                                                 rng, fmasks)
+        total = 0.0
+        for i, name in enumerate(self.conf.output_names):
+            layer = self.nodes[name].ref
+            if not hasattr(layer, "compute_loss"):
+                raise ValueError(f"Output node '{name}' is not an output layer")
+            y = labels[i].astype(jnp.float32)
+            lm = None if lmasks is None else lmasks[i]
+            total = total + layer.compute_loss(y, preacts[name].astype(jnp.float32), lm)
+        layer_list = [self.nodes[n].ref for n in self._layer_names]
+        reg_params = {str(i): params.get(n, {})
+                      for i, n in enumerate(self._layer_names)}
+        total = total + _l1l2_penalty(layer_list, reg_params)
+        return total, new_state
+
+    def score(self, dataset=None):
+        if dataset is None:
+            return self._score
+        ins, labels, fmasks, lmasks = self._unpack(dataset)
+        # inference-mode forward (≡ reference score(DataSet) semantics)
+        loss, _ = self._loss(self._params, self._state, ins, labels, fmasks,
+                             lmasks, None, train=False)
+        return float(loss)
+
+    # -- training --------------------------------------------------------
+    @functools.cached_property
+    def _train_step(self):
+        tx = self._tx
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step(params, opt_state, state, inputs, labels, fmasks, lmasks, rng):
+            (loss, new_state), grads = jax.value_and_grad(
+                lambda p: self._loss(p, state, inputs, labels, fmasks, lmasks,
+                                     rng), has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_state, loss
+
+        return step
+
+    def _unpack(self, ds):
+        if isinstance(ds, MultiDataSet):
+            ins = {n: jnp.asarray(f) for n, f in
+                   zip(self.conf.input_names, ds.features)}
+            labels = [jnp.asarray(l) for l in ds.labels]
+            fmasks = None
+            if ds.featuresMasks is not None:
+                fmasks = {n: (None if m is None else jnp.asarray(m))
+                          for n, m in zip(self.conf.input_names, ds.featuresMasks)}
+            lmasks = None
+            if ds.labelsMasks is not None:
+                lmasks = [None if m is None else jnp.asarray(m)
+                          for m in ds.labelsMasks]
+            return ins, labels, fmasks, lmasks
+        if isinstance(ds, DataSet):
+            ins = {self.conf.input_names[0]: jnp.asarray(ds.features)}
+            labels = [jnp.asarray(ds.labels)]
+            fmasks = None if ds.featuresMask is None else \
+                {self.conf.input_names[0]: jnp.asarray(ds.featuresMask)}
+            lmasks = None if ds.labelsMask is None else [jnp.asarray(ds.labelsMask)]
+            return ins, labels, fmasks, lmasks
+        raise TypeError(f"Cannot fit on {type(ds)}")
+
+    def _fit_batch(self, ds):
+        ins, labels, fmasks, lmasks = self._unpack(ds)
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        self._params, self._opt_state, self._state, loss = self._train_step(
+            self._params, self._opt_state, self._state, ins, labels, fmasks,
+            lmasks, sub)
+        self._score = float(loss)
+        self._iteration += 1
+        for listener in self._listeners:
+            listener.iterationDone(self, self._iteration, self._epoch)
+
+    def fit(self, data, labels=None, epochs=None):
+        if self._params is None:
+            self.init()
+        if labels is not None:
+            self._fit_batch(DataSet(as_jax(data), as_jax(labels)))
+            return self
+        if isinstance(data, (DataSet, MultiDataSet)):
+            self._fit_batch(data)
+            return self
+        n_epochs = int(epochs) if epochs is not None else 1
+        for _ in range(n_epochs):
+            if hasattr(data, "reset"):
+                data.reset()
+            for ds in data:
+                self._fit_batch(ds)
+            self._epoch += 1
+            for listener in self._listeners:
+                if hasattr(listener, "onEpochEnd"):
+                    listener.onEpochEnd(self)
+        return self
+
+    # -- evaluation ------------------------------------------------------
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        e = Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(ds.features)
+            out0 = out[0] if isinstance(out, list) else out
+            e.eval(ds.labels, out0.numpy(), mask=ds.labelsMask)
+        return e
+
+    # -- listeners / misc ------------------------------------------------
+    def setListeners(self, *listeners):
+        if len(listeners) == 1 and isinstance(listeners[0], (list, tuple)):
+            listeners = listeners[0]
+        self._listeners = list(listeners)
+        return self
+
+    def getIterationCount(self):
+        return self._iteration
+
+    def getEpochCount(self):
+        return self._epoch
+
+    def summary(self):
+        lines = ["=" * 78,
+                 f"{'Name':<20}{'Kind':<10}{'Inputs':<26}{'nParams':>10}",
+                 "-" * 78]
+        total = 0
+        for name in self.conf.topo_order:
+            node = self.nodes[name]
+            p = (self._params or {}).get(name, {})
+            n = sum(int(np.prod(v.shape)) for v in jax.tree_util.tree_leaves(p))
+            total += n
+            kind = node.kind if node.kind != "layer" else type(node.ref).__name__
+            lines.append(f"{name:<20}{kind:<10}{','.join(node.inputs):<26}{n:>10,}")
+        lines += ["-" * 78, f"Total params: {total:,}", "=" * 78]
+        return "\n".join(lines)
+
+    def save(self, path, saveUpdater=True):
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+        ModelSerializer.writeModel(self, path, saveUpdater)
+
+    @staticmethod
+    def load(path, loadUpdater=True):
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+        return ModelSerializer.restoreComputationGraph(path, loadUpdater)

@@ -1,0 +1,177 @@
+"""Loss functions (≡ nd4j-api :: lossfunctions.LossFunctions.LossFunction).
+
+Each loss takes (labels, preact, activation, mask) where `preact` is the
+layer pre-activation; the loss applies the activation itself so that
+softmax+MCXENT / sigmoid+XENT lower to numerically-stable fused
+log-softmax / log-sigmoid forms (the reference fuses these the same way in
+its loss implementations). `mask` broadcasts over trailing dims; per-example
+losses are returned by `*_per_example`, the scalar loss is the masked mean
+over examples (ND4J "score by example" averaged).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+
+
+def _apply_mask_mean(per_elem, mask):
+    """per_elem: (batch, ...) per-element loss; returns scalar masked mean
+    over examples (sum over feature dims, mean over batch/time elements)."""
+    # Reduce feature dims -> per-example score
+    reduce_axes = tuple(range(1, per_elem.ndim))
+    per_example = jnp.sum(per_elem, axis=reduce_axes) if reduce_axes else per_elem
+    if mask is None:
+        return jnp.mean(per_example)
+    m = mask.reshape(per_example.shape).astype(per_elem.dtype)
+    return jnp.sum(per_example * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+def _flatten_time(labels, preact, mask):
+    """Fold time dim of rank-3 (batch, time, feat) into batch so losses are
+    uniform; mask (batch, time) flattens alongside."""
+    if preact.ndim == 3:
+        b, t, f = preact.shape
+        preact = preact.reshape(b * t, f)
+        labels = labels.reshape(b * t, -1)
+        if mask is not None:
+            mask = mask.reshape(b * t)
+    return labels, preact, mask
+
+
+def mcxent(labels, preact, activation="softmax", mask=None):
+    labels, preact, mask = _flatten_time(labels, preact, mask)
+    if activation in ("softmax", "logsoftmax"):
+        logp = jax.nn.log_softmax(preact, axis=-1)
+    elif activation == "sigmoid":
+        logp = jnp.log(jnp.clip(jax.nn.sigmoid(preact), 1e-10, 1.0))
+    else:
+        logp = jnp.log(jnp.clip(get_activation(activation)(preact), 1e-10, 1.0))
+    return _apply_mask_mean(-(labels * logp), mask)
+
+
+def xent(labels, preact, activation="sigmoid", mask=None):
+    """Binary cross entropy (ND4J LossFunction.XENT)."""
+    labels, preact, mask = _flatten_time(labels, preact, mask)
+    if activation == "sigmoid":
+        # stable: max(x,0) - x*z + log(1+exp(-|x|))
+        x, z = preact, labels
+        per = jnp.maximum(x, 0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    else:
+        p = jnp.clip(get_activation(activation)(preact), 1e-10, 1 - 1e-10)
+        per = -(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p))
+    return _apply_mask_mean(per, mask)
+
+
+def mse(labels, preact, activation="identity", mask=None):
+    labels, preact, mask = _flatten_time(labels, preact, mask)
+    out = get_activation(activation)(preact)
+    # ND4J MSE averages over the output dimension as well.
+    per = (out - labels) ** 2 / labels.shape[-1]
+    return _apply_mask_mean(per, mask)
+
+
+def l2(labels, preact, activation="identity", mask=None):
+    labels, preact, mask = _flatten_time(labels, preact, mask)
+    out = get_activation(activation)(preact)
+    return _apply_mask_mean((out - labels) ** 2, mask)
+
+
+def mae(labels, preact, activation="identity", mask=None):
+    labels, preact, mask = _flatten_time(labels, preact, mask)
+    out = get_activation(activation)(preact)
+    return _apply_mask_mean(jnp.abs(out - labels) / labels.shape[-1], mask)
+
+
+def l1(labels, preact, activation="identity", mask=None):
+    labels, preact, mask = _flatten_time(labels, preact, mask)
+    out = get_activation(activation)(preact)
+    return _apply_mask_mean(jnp.abs(out - labels), mask)
+
+
+def hinge(labels, preact, activation="identity", mask=None):
+    labels, preact, mask = _flatten_time(labels, preact, mask)
+    out = get_activation(activation)(preact)
+    y = 2.0 * labels - 1.0  # {0,1} -> {-1,1}
+    return _apply_mask_mean(jnp.maximum(0.0, 1.0 - y * out), mask)
+
+
+def squared_hinge(labels, preact, activation="identity", mask=None):
+    labels, preact, mask = _flatten_time(labels, preact, mask)
+    out = get_activation(activation)(preact)
+    y = 2.0 * labels - 1.0
+    return _apply_mask_mean(jnp.maximum(0.0, 1.0 - y * out) ** 2, mask)
+
+
+def kl_divergence(labels, preact, activation="softmax", mask=None):
+    labels, preact, mask = _flatten_time(labels, preact, mask)
+    out = jnp.clip(get_activation(activation)(preact), 1e-10, 1.0)
+    lab = jnp.clip(labels, 1e-10, 1.0)
+    return _apply_mask_mean(labels * (jnp.log(lab) - jnp.log(out)), mask)
+
+
+def poisson(labels, preact, activation="identity", mask=None):
+    labels, preact, mask = _flatten_time(labels, preact, mask)
+    out = get_activation(activation)(preact)
+    return _apply_mask_mean(out - labels * jnp.log(jnp.clip(out, 1e-10, None)), mask)
+
+
+def cosine_proximity(labels, preact, activation="identity", mask=None):
+    labels, preact, mask = _flatten_time(labels, preact, mask)
+    out = get_activation(activation)(preact)
+    num = jnp.sum(labels * out, axis=-1)
+    den = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(out, axis=-1) + 1e-10
+    return _apply_mask_mean((-num / den)[..., None], mask)
+
+
+def mape(labels, preact, activation="identity", mask=None):
+    labels, preact, mask = _flatten_time(labels, preact, mask)
+    out = get_activation(activation)(preact)
+    per = 100.0 * jnp.abs((labels - out) / jnp.clip(jnp.abs(labels), 1e-10, None)) / labels.shape[-1]
+    return _apply_mask_mean(per, mask)
+
+
+LOSSES = {
+    "mcxent": mcxent,
+    "negativeloglikelihood": mcxent,  # ND4J aliases NLL to MCXENT semantics
+    "xent": xent,
+    "mse": mse,
+    "squared_loss": mse,
+    "l2": l2,
+    "mae": mae,
+    "l1": l1,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "kl_divergence": kl_divergence,
+    "reconstruction_crossentropy": xent,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "mean_absolute_percentage_error": mape,
+    "mape": mape,
+}
+
+
+def get_loss(name):
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in LOSSES:
+        raise ValueError(f"Unknown loss '{name}'. Available: {sorted(LOSSES)}")
+    return LOSSES[key]
+
+
+class LossFunction:
+    MCXENT = "mcxent"
+    NEGATIVELOGLIKELIHOOD = "negativeloglikelihood"
+    XENT = "xent"
+    MSE = "mse"
+    L1 = "l1"
+    L2 = "l2"
+    MAE = "mae"
+    HINGE = "hinge"
+    SQUARED_HINGE = "squared_hinge"
+    KL_DIVERGENCE = "kl_divergence"
+    POISSON = "poisson"
+    COSINE_PROXIMITY = "cosine_proximity"
+    MEAN_ABSOLUTE_PERCENTAGE_ERROR = "mape"

@@ -1,0 +1,121 @@
+"""Learning-rate schedules (≡ nd4j-api :: schedule.ISchedule impls:
+StepSchedule, ExponentialSchedule, InverseSchedule, PolySchedule,
+SigmoidSchedule, MapSchedule, CycleSchedule).
+
+Each schedule is a callable step->lr usable directly as an optax schedule.
+ScheduleType ITERATION is the native unit; EPOCH schedules take
+iterations_per_epoch at lowering time.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Schedule:
+    def __call__(self, step):
+        raise NotImplementedError
+
+    def value(self, step):
+        return float(self(step))
+
+
+class FixedSchedule(Schedule):
+    def __init__(self, value):
+        self.v = float(value)
+
+    def __call__(self, step):
+        return jnp.asarray(self.v, dtype=jnp.float32)
+
+
+class StepSchedule(Schedule):
+    """lr = init * decayRate^floor(iter/step)"""
+
+    def __init__(self, initial_value, decay_rate, step):
+        self.init, self.rate, self.step = float(initial_value), float(decay_rate), float(step)
+
+    def __call__(self, step):
+        return self.init * self.rate ** jnp.floor(step / self.step)
+
+
+class ExponentialSchedule(Schedule):
+    """lr = init * gamma^iter"""
+
+    def __init__(self, initial_value, gamma):
+        self.init, self.gamma = float(initial_value), float(gamma)
+
+    def __call__(self, step):
+        return self.init * self.gamma ** jnp.asarray(step, jnp.float32)
+
+
+class InverseSchedule(Schedule):
+    """lr = init / (1 + gamma*iter)^power"""
+
+    def __init__(self, initial_value, gamma, power):
+        self.init, self.gamma, self.power = float(initial_value), float(gamma), float(power)
+
+    def __call__(self, step):
+        return self.init / (1.0 + self.gamma * step) ** self.power
+
+
+class PolySchedule(Schedule):
+    """lr = init * (1 - iter/maxIter)^power"""
+
+    def __init__(self, initial_value, power, max_iter):
+        self.init, self.power, self.max_iter = float(initial_value), float(power), float(max_iter)
+
+    def __call__(self, step):
+        frac = jnp.clip(step / self.max_iter, 0.0, 1.0)
+        return self.init * (1.0 - frac) ** self.power
+
+
+class SigmoidSchedule(Schedule):
+    """lr = init / (1 + exp(gamma*(iter-stepSize)))"""
+
+    def __init__(self, initial_value, gamma, step_size):
+        self.init, self.gamma, self.step_size = float(initial_value), float(gamma), float(step_size)
+
+    def __call__(self, step):
+        return self.init / (1.0 + jnp.exp(self.gamma * (step - self.step_size)))
+
+
+class MapSchedule(Schedule):
+    """Piecewise-constant mapping iteration -> lr."""
+
+    def __init__(self, values: dict):
+        items = sorted((int(k), float(v)) for k, v in values.items())
+        if not items or items[0][0] != 0:
+            raise ValueError("MapSchedule requires a value for iteration 0")
+        self.boundaries = jnp.asarray([k for k, _ in items], jnp.float32)
+        self.values = jnp.asarray([v for _, v in items], jnp.float32)
+
+    def __call__(self, step):
+        idx = jnp.sum(self.boundaries <= step) - 1
+        return self.values[idx]
+
+
+class CycleSchedule(Schedule):
+    """1cycle: ramp up to max then down, with final annihilation phase."""
+
+    def __init__(self, initial_value, max_value, cycle_length,
+                 annealing_length=None, annealing_decay=0.1):
+        self.init, self.max = float(initial_value), float(max_value)
+        self.cycle = float(cycle_length)
+        self.ann_len = float(annealing_length if annealing_length is not None else 0.1 * cycle_length)
+        self.ann_decay = float(annealing_decay)
+
+    def __call__(self, step):
+        up = self.cycle / 2.0
+        pos = jnp.asarray(step, jnp.float32)
+        ramp_up = self.init + (self.max - self.init) * (pos / up)
+        ramp_dn = self.max - (self.max - self.init) * ((pos - up) / up)
+        ann = self.init * (self.ann_decay +
+                           (1 - self.ann_decay) * jnp.clip(1 - (pos - self.cycle) / jnp.maximum(self.ann_len, 1.0), 0, 1))
+        return jnp.where(pos < up, ramp_up, jnp.where(pos < self.cycle, ramp_dn, ann))
+
+
+def as_schedule(value):
+    if isinstance(value, Schedule):
+        return value
+    if callable(value):
+        return value
+    return FixedSchedule(value)

@@ -1,0 +1,104 @@
+"""Weight initialization (≡ deeplearning4j-nn :: weights.WeightInit enum).
+
+fan_in/fan_out follow the reference's conventions: for a dense kernel
+(nIn, nOut) fan_in=nIn; for a conv kernel (kh, kw, cin, cout) [we are
+NHWC-native] fan_in = kh*kw*cin, fan_out = kh*kw*cout.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fans(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    rf = int(np.prod(shape[:-2]))
+    return rf * shape[-2], rf * shape[-1]
+
+
+def init_weight(key, shape, scheme="xavier", distribution=None, dtype=jnp.float32):
+    scheme = str(scheme).lower()
+    fan_in, fan_out = _fans(shape)
+
+    def uni(limit):
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+    def norm(std):
+        return std * jax.random.normal(key, shape, dtype)
+
+    if scheme == "xavier":
+        return norm(float(np.sqrt(2.0 / (fan_in + fan_out))))
+    if scheme == "xavier_uniform":
+        return uni(float(np.sqrt(6.0 / (fan_in + fan_out))))
+    if scheme in ("relu", "he", "he_normal"):
+        return norm(float(np.sqrt(2.0 / fan_in)))
+    if scheme in ("relu_uniform", "he_uniform"):
+        return uni(float(np.sqrt(6.0 / fan_in)))
+    if scheme in ("lecun_normal", "normal"):
+        # ND4J WeightInit.NORMAL is N(0, 1/sqrt(fanIn)) == LeCun normal.
+        return norm(float(np.sqrt(1.0 / fan_in)))
+    if scheme == "lecun_uniform":
+        return uni(float(np.sqrt(3.0 / fan_in)))
+    if scheme == "uniform":
+        return uni(float(np.sqrt(1.0 / fan_in)))
+    if scheme == "zero":
+        return jnp.zeros(shape, dtype)
+    if scheme == "ones":
+        return jnp.ones(shape, dtype)
+    if scheme == "constant":
+        value = 0.0 if distribution is None else float(distribution)
+        return jnp.full(shape, value, dtype)
+    if scheme == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init requires a square 2-D kernel")
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme == "distribution":
+        if distribution is None:
+            raise ValueError("WeightInit.DISTRIBUTION requires a distribution")
+        kind = distribution.get("type", "normal")
+        if kind == "normal":
+            return distribution.get("mean", 0.0) + distribution.get("std", 1.0) * jax.random.normal(key, shape, dtype)
+        if kind == "uniform":
+            return jax.random.uniform(key, shape, dtype,
+                                      distribution.get("lower", -1.0),
+                                      distribution.get("upper", 1.0))
+        raise ValueError(f"Unknown distribution type {kind}")
+    if scheme in ("var_scaling_normal_fan_in",):
+        return norm(float(np.sqrt(1.0 / fan_in)))
+    if scheme in ("var_scaling_normal_fan_out",):
+        return norm(float(np.sqrt(1.0 / fan_out)))
+    if scheme in ("var_scaling_normal_fan_avg",):
+        return norm(float(np.sqrt(2.0 / (fan_in + fan_out))))
+    if scheme in ("var_scaling_uniform_fan_in",):
+        return uni(float(np.sqrt(3.0 / fan_in)))
+    if scheme in ("var_scaling_uniform_fan_out",):
+        return uni(float(np.sqrt(3.0 / fan_out)))
+    if scheme in ("var_scaling_uniform_fan_avg",):
+        return uni(float(np.sqrt(6.0 / (fan_in + fan_out))))
+    raise ValueError(f"Unknown WeightInit scheme '{scheme}'")
+
+
+class WeightInit:
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    RELU = "relu"
+    RELU_UNIFORM = "relu_uniform"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    NORMAL = "normal"
+    UNIFORM = "uniform"
+    ZERO = "zero"
+    ONES = "ones"
+    CONSTANT = "constant"
+    IDENTITY = "identity"
+    DISTRIBUTION = "distribution"
+    VAR_SCALING_NORMAL_FAN_IN = "var_scaling_normal_fan_in"
+    VAR_SCALING_NORMAL_FAN_OUT = "var_scaling_normal_fan_out"
+    VAR_SCALING_NORMAL_FAN_AVG = "var_scaling_normal_fan_avg"
+    VAR_SCALING_UNIFORM_FAN_IN = "var_scaling_uniform_fan_in"
+    VAR_SCALING_UNIFORM_FAN_OUT = "var_scaling_uniform_fan_out"
+    VAR_SCALING_UNIFORM_FAN_AVG = "var_scaling_uniform_fan_avg"

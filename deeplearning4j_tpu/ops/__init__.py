@@ -1,0 +1,5 @@
+from deeplearning4j_tpu.ops.ndarray import NDArray, as_jax, resolve_dtype
+from deeplearning4j_tpu.ops.factory import nd
+from deeplearning4j_tpu.ops.random import RandomState
+
+__all__ = ["NDArray", "nd", "RandomState", "as_jax", "resolve_dtype"]

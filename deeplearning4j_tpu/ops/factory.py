@@ -1,0 +1,261 @@
+"""`nd` — the Nd4j-equivalent array factory + op-catalog namespace.
+
+Parity target: nd4j-api :: org.nd4j.linalg.factory.Nd4j and the
+`Transforms` op catalog (reference mount empty; reconstructed surface).
+Usage mirrors the reference: `nd.zeros(3, 4)`, `nd.rand(2, 2)`,
+`nd.exp(x)`, `nd.concat(0, a, b)`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.ndarray import NDArray, as_jax, resolve_dtype
+from deeplearning4j_tpu.ops.random import RandomState
+
+
+def _shape(args):
+    if len(args) == 1 and isinstance(args[0], (tuple, list)):
+        return tuple(args[0])
+    return tuple(int(a) for a in args)
+
+
+class _Nd:
+    """Singleton factory namespace (≡ static class Nd4j)."""
+
+    def __init__(self):
+        self._random = RandomState(0)
+        self.default_dtype = jnp.float32
+
+    # -- randomness ------------------------------------------------------
+    def getRandom(self):
+        return self._random
+
+    def setSeed(self, seed):
+        self._random = RandomState(int(seed))
+
+    # -- creation --------------------------------------------------------
+    def create(self, data, shape=None, dtype=None):
+        arr = NDArray(data, dtype=dtype or self.default_dtype)
+        if shape is not None:
+            arr = arr.reshape(shape)
+        return arr
+
+    def array(self, data, dtype=None):
+        return NDArray(data, dtype=dtype)
+
+    def zeros(self, *shape, dtype=None):
+        return NDArray(jnp.zeros(_shape(shape), dtype=resolve_dtype(dtype) or self.default_dtype))
+
+    def ones(self, *shape, dtype=None):
+        return NDArray(jnp.ones(_shape(shape), dtype=resolve_dtype(dtype) or self.default_dtype))
+
+    def zerosLike(self, x):
+        return NDArray(jnp.zeros_like(as_jax(x)))
+
+    def onesLike(self, x):
+        return NDArray(jnp.ones_like(as_jax(x)))
+
+    def valueArrayOf(self, shape, value, dtype=None):
+        return NDArray(jnp.full(_shape([shape]) if isinstance(shape, (tuple, list)) else (shape,),
+                                value, dtype=resolve_dtype(dtype) or self.default_dtype))
+
+    def full(self, shape, value, dtype=None):
+        return NDArray(jnp.full(tuple(shape), value, dtype=resolve_dtype(dtype) or self.default_dtype))
+
+    def eye(self, n, dtype=None):
+        return NDArray(jnp.eye(n, dtype=resolve_dtype(dtype) or self.default_dtype))
+
+    def linspace(self, start, stop, num, dtype=None):
+        return NDArray(jnp.linspace(start, stop, num, dtype=resolve_dtype(dtype) or self.default_dtype))
+
+    def arange(self, *args, dtype=None):
+        return NDArray(jnp.arange(*args, dtype=resolve_dtype(dtype)))
+
+    def rand(self, *shape):
+        return NDArray(self._random.uniform(_shape(shape)))
+
+    def randn(self, *shape):
+        return NDArray(self._random.normal(_shape(shape)))
+
+    def randint(self, low, high, shape):
+        return NDArray(self._random.randint(low, high, tuple(shape)))
+
+    def empty(self, dtype=None):
+        return NDArray(jnp.zeros((0,), dtype=resolve_dtype(dtype) or self.default_dtype))
+
+    def scalar(self, value, dtype=None):
+        return NDArray(jnp.asarray(value, dtype=resolve_dtype(dtype)))
+
+    # -- combination -----------------------------------------------------
+    def concat(self, dim, *arrays):
+        return NDArray(jnp.concatenate([as_jax(a) for a in arrays], axis=dim))
+
+    def vstack(self, *arrays):
+        return NDArray(jnp.vstack([as_jax(a) for a in arrays]))
+
+    def hstack(self, *arrays):
+        return NDArray(jnp.hstack([as_jax(a) for a in arrays]))
+
+    def stack(self, dim, *arrays):
+        return NDArray(jnp.stack([as_jax(a) for a in arrays], axis=dim))
+
+    def pile(self, *arrays):
+        return self.stack(0, *arrays)
+
+    def tile(self, x, *reps):
+        return NDArray(jnp.tile(as_jax(x), _shape(reps)))
+
+    def repeat(self, x, repeats, axis=None):
+        return NDArray(jnp.repeat(as_jax(x), repeats, axis=axis))
+
+    def where(self, cond, x=None, y=None):
+        if x is None:
+            return NDArray(jnp.argwhere(as_jax(cond)))
+        return NDArray(jnp.where(as_jax(cond), as_jax(x), as_jax(y)))
+
+    def pad(self, x, pad_width, mode="constant", value=0.0):
+        kw = {"constant_values": value} if mode == "constant" else {}
+        return NDArray(jnp.pad(as_jax(x), pad_width, mode=mode, **kw))
+
+    def sortWithIndices(self, x, dim=-1, ascending=True):
+        a = as_jax(x)
+        idx = jnp.argsort(a, axis=dim)
+        if not ascending:
+            idx = jnp.flip(idx, axis=dim)
+        return NDArray(jnp.take_along_axis(a, idx, axis=dim)), NDArray(idx)
+
+    def sort(self, x, dim=-1, ascending=True):
+        a = jnp.sort(as_jax(x), axis=dim)
+        return NDArray(a if ascending else jnp.flip(a, axis=dim))
+
+    def flip(self, x, *dims):
+        return NDArray(jnp.flip(as_jax(x), axis=_shape(dims) if dims else None))
+
+    def gather(self, x, indices, axis=0):
+        return NDArray(jnp.take(as_jax(x), as_jax(indices).astype(jnp.int32), axis=axis))
+
+    def oneHot(self, indices, depth, dtype=None):
+        return NDArray(jax.nn.one_hot(as_jax(indices).astype(jnp.int32), depth,
+                                      dtype=resolve_dtype(dtype) or self.default_dtype))
+
+    def diag(self, x):
+        return NDArray(jnp.diag(as_jax(x)))
+
+    # -- transforms op catalog (≡ ops.transforms.Transforms) -------------
+    def _unary(self, x, fn):
+        return NDArray(fn(as_jax(x)))
+
+    def exp(self, x):
+        return self._unary(x, jnp.exp)
+
+    def log(self, x):
+        return self._unary(x, jnp.log)
+
+    def log1p(self, x):
+        return self._unary(x, jnp.log1p)
+
+    def sqrt(self, x):
+        return self._unary(x, jnp.sqrt)
+
+    def square(self, x):
+        return self._unary(x, jnp.square)
+
+    def abs(self, x):
+        return self._unary(x, jnp.abs)
+
+    def sign(self, x):
+        return self._unary(x, jnp.sign)
+
+    def floor(self, x):
+        return self._unary(x, jnp.floor)
+
+    def ceil(self, x):
+        return self._unary(x, jnp.ceil)
+
+    def round(self, x):
+        return self._unary(x, jnp.round)
+
+    def sin(self, x):
+        return self._unary(x, jnp.sin)
+
+    def cos(self, x):
+        return self._unary(x, jnp.cos)
+
+    def tan(self, x):
+        return self._unary(x, jnp.tan)
+
+    def tanh(self, x):
+        return self._unary(x, jnp.tanh)
+
+    def sigmoid(self, x):
+        return self._unary(x, jax.nn.sigmoid)
+
+    def relu(self, x):
+        return self._unary(x, jax.nn.relu)
+
+    def leakyRelu(self, x, alpha=0.01):
+        return NDArray(jax.nn.leaky_relu(as_jax(x), negative_slope=alpha))
+
+    def elu(self, x):
+        return self._unary(x, jax.nn.elu)
+
+    def softmax(self, x, axis=-1):
+        return NDArray(jax.nn.softmax(as_jax(x), axis=axis))
+
+    def logSoftmax(self, x, axis=-1):
+        return NDArray(jax.nn.log_softmax(as_jax(x), axis=axis))
+
+    def softplus(self, x):
+        return self._unary(x, jax.nn.softplus)
+
+    def pow(self, x, p):
+        return NDArray(jnp.power(as_jax(x), p))
+
+    def clip(self, x, lo, hi):
+        return NDArray(jnp.clip(as_jax(x), lo, hi))
+
+    def isNaN(self, x):
+        return self._unary(x, jnp.isnan)
+
+    def isInf(self, x):
+        return self._unary(x, jnp.isinf)
+
+    def maximum(self, a, b):
+        return NDArray(jnp.maximum(as_jax(a), as_jax(b)))
+
+    def minimum(self, a, b):
+        return NDArray(jnp.minimum(as_jax(a), as_jax(b)))
+
+    def cosineSim(self, a, b):
+        a, b = as_jax(a).ravel(), as_jax(b).ravel()
+        return float(jnp.dot(a, b) / (jnp.linalg.norm(a) * jnp.linalg.norm(b) + 1e-12))
+
+    def euclideanDistance(self, a, b):
+        return float(jnp.linalg.norm(as_jax(a).ravel() - as_jax(b).ravel()))
+
+    def manhattanDistance(self, a, b):
+        return float(jnp.sum(jnp.abs(as_jax(a).ravel() - as_jax(b).ravel())))
+
+    # -- linalg ----------------------------------------------------------
+    def matmul(self, a, b):
+        return NDArray(jnp.matmul(as_jax(a), as_jax(b)))
+
+    gemm = matmul
+
+    def dot(self, a, b):
+        return NDArray(jnp.dot(as_jax(a), as_jax(b)))
+
+    def norm2(self, x):
+        return float(jnp.linalg.norm(as_jax(x)))
+
+    # -- host/device -----------------------------------------------------
+    def toNumpy(self, x):
+        return np.asarray(as_jax(x))
+
+    def fromNumpy(self, x):
+        return NDArray(x)
+
+
+nd = _Nd()

@@ -1,0 +1,431 @@
+"""NDArray — the INDArray-equivalent array facade.
+
+Parity target: nd4j-api :: org.nd4j.linalg.api.ndarray.INDArray (reference
+mount empty; surface reconstructed from the Eclipse ND4J API). The facade
+wraps a `jax.Array`; all math lowers to jax.numpy so it fuses under jit and
+tiles onto the TPU MXU/VPU. Unlike INDArray there is no mutable device
+buffer: "in-place" (`addi`, `muli`, ...) methods rebind the wrapped value
+and return self, which preserves the reference's calling convention while
+staying functional underneath.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_DTYPES = {
+    "float": jnp.float32, "float32": jnp.float32, "double": jnp.float64,
+    "float64": jnp.float64, "half": jnp.float16, "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16, "int": jnp.int32, "int32": jnp.int32,
+    "long": jnp.int64, "int64": jnp.int64, "int16": jnp.int16,
+    "int8": jnp.int8, "uint8": jnp.uint8, "bool": jnp.bool_,
+}
+
+
+def resolve_dtype(dtype):
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        return _DTYPES[dtype.lower()]
+    return jnp.dtype(dtype)
+
+
+def as_jax(x):
+    """Unwrap NDArray / convert python+numpy values to a jnp array."""
+    if isinstance(x, NDArray):
+        return x.jax()
+    return jnp.asarray(x)
+
+
+def _wrap(x):
+    return NDArray(x)
+
+
+class NDArray:
+    """N-dimensional array with the INDArray calling convention."""
+
+    __slots__ = ("_a",)
+    # Make jnp.asarray(NDArray) and reverse binary ops prefer our methods.
+    __array_priority__ = 100
+
+    def __init__(self, value, dtype=None):
+        dt = resolve_dtype(dtype)
+        if isinstance(value, NDArray):
+            value = value._a
+        self._a = jnp.asarray(value, dtype=dt)
+
+    # -- interop ---------------------------------------------------------
+    def jax(self):
+        return self._a
+
+    def numpy(self):
+        return np.asarray(self._a)
+
+    def toDoubleVector(self):
+        return self.numpy().astype(np.float64).ravel()
+
+    def toFloatVector(self):
+        return self.numpy().astype(np.float32).ravel()
+
+    def toIntVector(self):
+        return self.numpy().astype(np.int64).ravel()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._a)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._a
+
+    # -- shape / dtype ---------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._a.shape)
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    def rank(self):
+        return self._a.ndim
+
+    def length(self):
+        return int(np.prod(self._a.shape)) if self._a.ndim else 1
+
+    def size(self, dim):
+        return self._a.shape[dim]
+
+    def isScalar(self):
+        return self._a.ndim == 0 or self.length() == 1
+
+    def isVector(self):
+        return self._a.ndim == 1 or (self._a.ndim == 2 and 1 in self._a.shape)
+
+    def isMatrix(self):
+        return self._a.ndim == 2
+
+    def rows(self):
+        return self._a.shape[0]
+
+    def columns(self):
+        return self._a.shape[1]
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _wrap(self._a.reshape(shape))
+
+    def ravel(self):
+        return _wrap(self._a.ravel())
+
+    def transpose(self, *axes):
+        if not axes:
+            return _wrap(self._a.T)
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _wrap(jnp.transpose(self._a, axes))
+
+    def permute(self, *axes):
+        return self.transpose(*axes)
+
+    def swapAxes(self, a, b):
+        return _wrap(jnp.swapaxes(self._a, a, b))
+
+    def broadcast(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _wrap(jnp.broadcast_to(self._a, shape))
+
+    def dup(self):
+        return _wrap(self._a)
+
+    def castTo(self, dtype):
+        return _wrap(self._a.astype(resolve_dtype(dtype)))
+
+    def astype(self, dtype):
+        return self.castTo(dtype)
+
+    # -- elementwise arithmetic (returning copies) -----------------------
+    def _binary(self, other, fn):
+        return _wrap(fn(self._a, as_jax(other)))
+
+    def add(self, other):
+        return self._binary(other, jnp.add)
+
+    def sub(self, other):
+        return self._binary(other, jnp.subtract)
+
+    def mul(self, other):
+        return self._binary(other, jnp.multiply)
+
+    def div(self, other):
+        return self._binary(other, jnp.divide)
+
+    def rsub(self, other):
+        return _wrap(as_jax(other) - self._a)
+
+    def rdiv(self, other):
+        return _wrap(as_jax(other) / self._a)
+
+    def neg(self):
+        return _wrap(-self._a)
+
+    # -- "in-place" variants: rebind and return self ---------------------
+    def _inplace(self, other, fn):
+        self._a = fn(self._a, as_jax(other))
+        return self
+
+    def addi(self, other):
+        return self._inplace(other, jnp.add)
+
+    def subi(self, other):
+        return self._inplace(other, jnp.subtract)
+
+    def muli(self, other):
+        return self._inplace(other, jnp.multiply)
+
+    def divi(self, other):
+        return self._inplace(other, jnp.divide)
+
+    def assign(self, other):
+        val = as_jax(other)
+        self._a = jnp.broadcast_to(val, self._a.shape).astype(self._a.dtype)
+        return self
+
+    def negi(self):
+        self._a = -self._a
+        return self
+
+    # -- linalg ----------------------------------------------------------
+    def mmul(self, other):
+        return _wrap(jnp.matmul(self._a, as_jax(other)))
+
+    def dot(self, other):
+        return _wrap(jnp.dot(self._a, as_jax(other)))
+
+    def tensorMmul(self, other, axes):
+        return _wrap(jnp.tensordot(self._a, as_jax(other), axes=axes))
+
+    # -- broadcast-along-dimension (ND4J row/column ops) -----------------
+    def addRowVector(self, row):
+        return _wrap(self._a + as_jax(row).reshape(1, -1))
+
+    def addColumnVector(self, col):
+        return _wrap(self._a + as_jax(col).reshape(-1, 1))
+
+    def subRowVector(self, row):
+        return _wrap(self._a - as_jax(row).reshape(1, -1))
+
+    def subColumnVector(self, col):
+        return _wrap(self._a - as_jax(col).reshape(-1, 1))
+
+    def mulRowVector(self, row):
+        return _wrap(self._a * as_jax(row).reshape(1, -1))
+
+    def mulColumnVector(self, col):
+        return _wrap(self._a * as_jax(col).reshape(-1, 1))
+
+    def divRowVector(self, row):
+        return _wrap(self._a / as_jax(row).reshape(1, -1))
+
+    def divColumnVector(self, col):
+        return _wrap(self._a / as_jax(col).reshape(-1, 1))
+
+    # -- reductions ------------------------------------------------------
+    def _reduce(self, fn, dims, keepdims=False):
+        axis = None
+        if dims:
+            axis = dims[0] if len(dims) == 1 else tuple(dims)
+        return _wrap(fn(self._a, axis=axis, keepdims=keepdims))
+
+    def sum(self, *dims, keepdims=False):
+        return self._reduce(jnp.sum, dims, keepdims)
+
+    def mean(self, *dims, keepdims=False):
+        return self._reduce(jnp.mean, dims, keepdims)
+
+    def max(self, *dims, keepdims=False):
+        return self._reduce(jnp.max, dims, keepdims)
+
+    def min(self, *dims, keepdims=False):
+        return self._reduce(jnp.min, dims, keepdims)
+
+    def prod(self, *dims, keepdims=False):
+        return self._reduce(jnp.prod, dims, keepdims)
+
+    def std(self, *dims, biasCorrected=True, keepdims=False):
+        ddof = 1 if biasCorrected else 0
+        axis = None
+        if dims:
+            axis = dims[0] if len(dims) == 1 else tuple(dims)
+        return _wrap(jnp.std(self._a, axis=axis, ddof=ddof, keepdims=keepdims))
+
+    def var(self, *dims, biasCorrected=True, keepdims=False):
+        ddof = 1 if biasCorrected else 0
+        axis = None
+        if dims:
+            axis = dims[0] if len(dims) == 1 else tuple(dims)
+        return _wrap(jnp.var(self._a, axis=axis, ddof=ddof, keepdims=keepdims))
+
+    def argMax(self, *dims):
+        axis = dims[0] if dims else None
+        return _wrap(jnp.argmax(self._a, axis=axis))
+
+    def argMin(self, *dims):
+        axis = dims[0] if dims else None
+        return _wrap(jnp.argmin(self._a, axis=axis))
+
+    def norm1(self, *dims):
+        return self._reduce(lambda a, axis, keepdims: jnp.sum(jnp.abs(a), axis=axis, keepdims=keepdims), dims)
+
+    def norm2(self, *dims):
+        return self._reduce(lambda a, axis, keepdims: jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=keepdims)), dims)
+
+    def normmax(self, *dims):
+        return self._reduce(lambda a, axis, keepdims: jnp.max(jnp.abs(a), axis=axis, keepdims=keepdims), dims)
+
+    def cumsum(self, dim=0):
+        return _wrap(jnp.cumsum(self._a, axis=dim))
+
+    def cumprod(self, dim=0):
+        return _wrap(jnp.cumprod(self._a, axis=dim))
+
+    # -- comparisons -----------------------------------------------------
+    def gt(self, other):
+        return self._binary(other, jnp.greater)
+
+    def gte(self, other):
+        return self._binary(other, jnp.greater_equal)
+
+    def lt(self, other):
+        return self._binary(other, jnp.less)
+
+    def lte(self, other):
+        return self._binary(other, jnp.less_equal)
+
+    def eq(self, other):
+        return self._binary(other, jnp.equal)
+
+    def neq(self, other):
+        return self._binary(other, jnp.not_equal)
+
+    def equalsWithEps(self, other, eps=1e-5):
+        a, b = self._a, as_jax(other)
+        return a.shape == b.shape and bool(jnp.all(jnp.abs(a - b) <= eps))
+
+    def equals(self, other):
+        return self.equalsWithEps(other, 1e-5)
+
+    # -- indexing --------------------------------------------------------
+    def __getitem__(self, idx):
+        if isinstance(idx, NDArray):
+            idx = idx.jax()
+        return _wrap(self._a[idx])
+
+    def get(self, *idx):
+        return self.__getitem__(tuple(i if not isinstance(i, slice) else i for i in idx))
+
+    def getScalar(self, *idx):
+        return _wrap(self._a[tuple(idx)])
+
+    def getDouble(self, *idx):
+        return float(self._a[tuple(int(i) for i in idx)])
+
+    def getInt(self, *idx):
+        return int(self._a[tuple(int(i) for i in idx)])
+
+    def getRow(self, i):
+        return _wrap(self._a[i])
+
+    def getColumn(self, i):
+        return _wrap(self._a[:, i])
+
+    def getRows(self, *rows):
+        return _wrap(self._a[jnp.asarray(rows)])
+
+    def getColumns(self, *cols):
+        return _wrap(self._a[:, jnp.asarray(cols)])
+
+    def put(self, idx, value):
+        if isinstance(idx, (tuple, list)):
+            idx = tuple(idx)
+        self._a = self._a.at[idx].set(as_jax(value))
+        return self
+
+    def putScalar(self, idx, value):
+        if isinstance(idx, (tuple, list)):
+            idx = tuple(int(i) for i in idx)
+        self._a = self._a.at[idx].set(value)
+        return self
+
+    def putRow(self, i, row):
+        self._a = self._a.at[i].set(as_jax(row))
+        return self
+
+    def putColumn(self, i, col):
+        self._a = self._a.at[:, i].set(as_jax(col))
+        return self
+
+    def __setitem__(self, idx, value):
+        self.put(idx, value)
+
+    # -- python protocol -------------------------------------------------
+    def __add__(self, other):
+        return self.add(other)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self.sub(other)
+
+    def __rsub__(self, other):
+        return self.rsub(other)
+
+    def __mul__(self, other):
+        return self.mul(other)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self.div(other)
+
+    def __rtruediv__(self, other):
+        return self.rdiv(other)
+
+    def __matmul__(self, other):
+        return self.mmul(other)
+
+    def __neg__(self):
+        return self.neg()
+
+    def __pow__(self, p):
+        return _wrap(self._a ** p)
+
+    def __len__(self):
+        return self._a.shape[0]
+
+    def __float__(self):
+        return float(self._a)
+
+    def __int__(self):
+        return int(self._a)
+
+    def __repr__(self):
+        return f"NDArray{self.shape}{np.asarray(self._a)!r}"
+
+    def __str__(self):
+        return str(np.asarray(self._a))
+
+
+def _ndarray_flatten(x):
+    return (x._a,), None
+
+
+def _ndarray_unflatten(aux, children):
+    obj = NDArray.__new__(NDArray)
+    obj._a = children[0]
+    return obj
+
+
+jax.tree_util.register_pytree_node(NDArray, _ndarray_flatten, _ndarray_unflatten)

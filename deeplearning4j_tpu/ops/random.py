@@ -1,0 +1,40 @@
+"""Seeded PRNG streams (≡ nd4j NativeRandom / Nd4j.getRandom).
+
+A stateful convenience wrapper over jax.random: each draw splits the key, so
+host-side data/init code gets ND4J-style sequential semantics while
+everything inside jit still takes explicit keys.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class RandomState:
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.PRNGKey(int(seed))
+
+    def setSeed(self, seed: int):
+        self._key = jax.random.PRNGKey(int(seed))
+
+    def split(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def uniform(self, shape=(), low=0.0, high=1.0, dtype=jnp.float32):
+        return jax.random.uniform(self.split(), shape, dtype=dtype, minval=low, maxval=high)
+
+    def normal(self, shape=(), mean=0.0, std=1.0, dtype=jnp.float32):
+        return mean + std * jax.random.normal(self.split(), shape, dtype=dtype)
+
+    def randint(self, low, high, shape=()):
+        return jax.random.randint(self.split(), shape, low, high)
+
+    def bernoulli(self, p, shape=()):
+        return jax.random.bernoulli(self.split(), p, shape)
+
+    def permutation(self, n):
+        return jax.random.permutation(self.split(), n)
+
+    def shuffle(self, x, axis=0):
+        return jax.random.permutation(self.split(), x, axis=axis, independent=False)

@@ -1,0 +1,141 @@
+"""Training listeners (≡ deeplearning4j-nn :: optimize.listeners.*:
+ScoreIterationListener, PerformanceListener, TimeIterationListener,
+EvaluativeListener, CheckpointListener, and the BaseTrainingListener
+protocol)."""
+from __future__ import annotations
+
+import os
+import time
+
+
+class TrainingListener:
+    """Protocol: networks call iterationDone each step, onEpochEnd at epoch
+    boundaries (≡ BaseTrainingListener)."""
+
+    def iterationDone(self, model, iteration, epoch):
+        pass
+
+    def onEpochEnd(self, model):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    def __init__(self, printIterations=10, log_fn=print):
+        self.every = int(printIterations)
+        self.log = log_fn
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.every == 0:
+            self.log(f"Score at iteration {iteration} is {model.score()}")
+
+
+class PerformanceListener(TrainingListener):
+    """Reports examples/sec and iterations/sec (≡ PerformanceListener)."""
+
+    def __init__(self, frequency=10, reportBatch=True, log_fn=print):
+        self.every = int(frequency)
+        self.reportBatch = reportBatch
+        self.log = log_fn
+        self._last_time = None
+        self._last_iter = 0
+        self.last_throughput = None
+
+    def iterationDone(self, model, iteration, epoch):
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time, self._last_iter = now, iteration
+            return
+        if iteration - self._last_iter >= self.every:
+            dt = now - self._last_time
+            iters = iteration - self._last_iter
+            its_per_sec = iters / dt
+            self.last_throughput = its_per_sec
+            self.log(f"iteration {iteration}: {its_per_sec:.2f} iters/sec "
+                     f"(epoch {epoch})")
+            self._last_time, self._last_iter = now, iteration
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA logging over a planned iteration count."""
+
+    def __init__(self, total_iterations, frequency=50, log_fn=print):
+        self.total = int(total_iterations)
+        self.every = int(frequency)
+        self.log = log_fn
+        self._start = None
+
+    def iterationDone(self, model, iteration, epoch):
+        if self._start is None:
+            self._start = time.perf_counter()
+            return
+        if iteration % self.every == 0:
+            elapsed = time.perf_counter() - self._start
+            rate = elapsed / max(1, iteration)
+            remaining = rate * max(0, self.total - iteration)
+            self.log(f"iteration {iteration}/{self.total}, "
+                     f"ETA {remaining:.1f}s")
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodically evaluate on a held-out iterator (≡ EvaluativeListener)."""
+
+    def __init__(self, iterator, frequency, evaluation=None, log_fn=print):
+        self.iterator = iterator
+        self.every = int(frequency)
+        self.evaluation_factory = evaluation
+        self.log = log_fn
+        self.last_evaluation = None
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.every != 0:
+            return
+        e = model.evaluate(self.iterator)
+        self.last_evaluation = e
+        self.log(f"Evaluation at iteration {iteration}: "
+                 f"accuracy={e.accuracy():.4f} f1={e.f1():.4f}")
+
+
+class CheckpointListener(TrainingListener):
+    """≡ CheckpointListener.Builder: save every N iterations/epochs, keep
+    last K checkpoints."""
+
+    def __init__(self, directory, saveEveryNIterations=None,
+                 saveEveryNEpochs=None, keepLast=3, saveUpdater=True):
+        self.dir = directory
+        self.every_iter = saveEveryNIterations
+        self.every_epoch = saveEveryNEpochs
+        self.keep = int(keepLast)
+        self.saveUpdater = saveUpdater
+        self._saved = []
+        os.makedirs(directory, exist_ok=True)
+
+    def _save(self, model, tag):
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+        path = os.path.join(self.dir, f"checkpoint_{tag}.zip")
+        ModelSerializer.writeModel(model, path, self.saveUpdater)
+        self._saved.append(path)
+        while len(self._saved) > self.keep:
+            old = self._saved.pop(0)
+            if os.path.exists(old):
+                os.remove(old)
+
+    def iterationDone(self, model, iteration, epoch):
+        if self.every_iter and iteration % self.every_iter == 0:
+            self._save(model, f"iter_{iteration}")
+
+    def onEpochEnd(self, model):
+        if self.every_epoch and model.getEpochCount() % self.every_epoch == 0:
+            self._save(model, f"epoch_{model.getEpochCount()}")
+
+    def lastCheckpoint(self):
+        return self._saved[-1] if self._saved else None
+
+
+class CollectScoresListener(TrainingListener):
+    def __init__(self, frequency=1):
+        self.every = int(frequency)
+        self.scores = []
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.every == 0:
+            self.scores.append((iteration, model.score()))

@@ -1,0 +1,19 @@
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh, initialize_distributed
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.parallel.sharded_trainer import (ParameterAveragingTrainer,
+                                                         ShardedTrainer)
+from deeplearning4j_tpu.parallel.ring_attention import (blockwise_attention,
+                                                        dense_attention,
+                                                        make_ring_attention,
+                                                        ring_attention)
+from deeplearning4j_tpu.parallel.compression import (encoded_updater,
+                                                     threshold_encoding)
+from deeplearning4j_tpu.parallel.pipeline import (make_pipeline_fn,
+                                                  make_pipelined_loss,
+                                                  stack_stage_params)
+
+__all__ = ["DeviceMesh", "initialize_distributed", "ParallelWrapper",
+           "ParameterAveragingTrainer", "ShardedTrainer",
+           "blockwise_attention", "dense_attention", "make_ring_attention",
+           "ring_attention", "encoded_updater", "threshold_encoding",
+           "make_pipeline_fn", "make_pipelined_loss", "stack_stage_params"]

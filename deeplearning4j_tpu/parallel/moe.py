@@ -1,0 +1,27 @@
+"""Expert parallelism helpers (`ep` mesh axis).
+
+The reference has no MoE; this is a TPU-native addition (models.bert MoE
+layers use it implicitly via sharding_rules: expert-major parameter tensors
+shard their leading dim over ep, so each chip holds |E|/|ep| experts and
+XLA turns the dense one-hot dispatch einsum into an all-to-all).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def switch_router(x, router_w, num_experts):
+    """Top-1 switch routing: returns (one_hot dispatch, gate, aux_loss).
+    aux_loss is the standard load-balancing loss (mean_prob · mean_dispatch
+    · E) keeping experts evenly used."""
+    logits = x @ router_w.astype(x.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top = jnp.argmax(probs, axis=-1)
+    onehot = jax.nn.one_hot(top, num_experts, dtype=x.dtype)
+    gate = jnp.max(probs, axis=-1).astype(x.dtype)
+    # load-balancing aux loss (Switch Transformer eq. 4)
+    density = jnp.mean(onehot.astype(jnp.float32), axis=tuple(range(onehot.ndim - 1)))
+    mean_prob = jnp.mean(probs, axis=tuple(range(probs.ndim - 1)))
+    aux = num_experts * jnp.sum(density * mean_prob)
+    return onehot, gate, aux

@@ -1,0 +1,94 @@
+"""Pipeline parallelism over the `pp` mesh axis (GPipe-style microbatching).
+
+The reference has no pipeline parallelism (its scale-out is data-parallel
+only) — this is a TPU-native addition required for models deeper than one
+chip's HBM. Design: the layer stack is split into S = |pp| equal stages;
+stage s's params live on pp-shard s (leading stage axis sharded over pp).
+Inside shard_map, microbatches stream through the classic GPipe schedule:
+S + M - 1 ticks, activations hop stage→stage via ppermute each tick.
+Backward is just jax.grad through the shard_map (ppermute transposes to the
+reverse hop), so the whole pipeline — forward, bubble, backward — is ONE
+XLA program.
+
+Usage: stage_fn(stage_params, x) -> y applies ONE stage's chunk of layers.
+All stages must share one stage_fn/param-structure (equal chunks).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                  *per_stage_params)
+
+
+def make_pipeline_fn(stage_fn, mesh, n_microbatches, axis_name="pp"):
+    """Returns f(stacked_stage_params, x) -> y running the GPipe schedule.
+
+    x: (B, ...) global batch; split into n_microbatches along dim 0.
+    stacked_stage_params: leading dim = n_stages, sharded over `axis_name`.
+    """
+
+    def pipeline(stage_params, x):
+        # inside shard_map: stage_params has leading dim 1 (this shard's)
+        params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        n_stages = jax.lax.psum(1, axis_name)
+        stage = jax.lax.axis_index(axis_name)
+        mb = x.reshape((n_microbatches, -1) + x.shape[1:])
+        n_ticks = n_microbatches + n_stages - 1
+        state = jnp.zeros_like(mb[0])
+        outputs = jnp.zeros_like(mb)
+        perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t (while t < M); others use the
+            # activation that just arrived from the previous stage
+            mb_idx = jnp.clip(t, 0, n_microbatches - 1)
+            inp = jnp.where(stage == 0, mb[mb_idx], state)
+            out = stage_fn(params, inp)
+            # last stage emits microbatch (t - (S-1)) when valid
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_microbatches - 1)
+            valid = (t >= n_stages - 1)
+            outputs = jax.lax.cond(
+                valid & (stage == n_stages - 1),
+                lambda o: o.at[out_idx].set(out),
+                lambda o: o, outputs)
+            # hop activations forward one stage
+            state = jax.lax.ppermute(out, axis_name, perm)
+            return (state, outputs), None
+
+        (state, outputs), _ = jax.lax.scan(tick, (state, outputs),
+                                           jnp.arange(n_ticks))
+        # only the last stage holds real outputs; broadcast to all shards
+        outputs = jax.lax.psum(
+            jnp.where(stage == n_stages - 1, outputs, 0.0), axis_name)
+        return outputs.reshape((-1,) + x.shape[1:])
+
+    def wrapped(stacked_params, x):
+        in_specs = (jax.tree_util.tree_map(lambda _: P(axis_name),
+                                           stacked_params), P())
+        return jax.shard_map(pipeline, mesh=mesh,
+                             in_specs=in_specs, out_specs=P(),
+                             check_vma=False)(stacked_params, x)
+
+    return wrapped
+
+
+def make_pipelined_loss(stage_fn, loss_head, mesh, n_microbatches,
+                        axis_name="pp"):
+    """loss(stacked_params, head_params, x, y) with the pipeline inside —
+    differentiable end-to-end (grads flow back through the reversed ring)."""
+    pipe = make_pipeline_fn(stage_fn, mesh, n_microbatches, axis_name)
+
+    def loss(stacked_params, head_params, x, y):
+        h = pipe(stacked_params, x)
+        return loss_head(head_params, h, y)
+
+    return loss

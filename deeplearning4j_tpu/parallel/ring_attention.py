@@ -1,0 +1,128 @@
+"""Ring attention — sequence/context parallelism over the `sp` mesh axis.
+
+The reference scales long sequences by truncated BPTT; TPU-native long
+context instead shards the sequence across chips and rotates K/V blocks
+around the ICI ring (Liu et al., Ring Attention) with an online-softmax
+accumulator, overlapping each hop with the local attention block. Used by
+models/bert.py + parallel tests; single-device callers get the same math
+via `blockwise_attention` (flash-style lax.scan) or `dense_attention`.
+
+Shapes: (B, H, T, D) throughout; softmax stats accumulate in float32.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def dense_attention(q, k, v, causal=False, mask=None, scale=None):
+    """Reference O(T²) attention (numerics oracle for the sharded paths)."""
+    d = q.shape[-1]
+    scale = scale or (1.0 / jnp.sqrt(d).astype(q.dtype))
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        logits = jnp.where(causal_mask, logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _block_accumulate(carry, q, k, v, logits_mask, scale):
+    """Online-softmax accumulation of one K/V block into (o, l, m)."""
+    o, l, m = carry
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if logits_mask is not None:
+        s = jnp.where(logits_mask, s, -1e30)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return o_new, l_new, m_new
+
+
+def blockwise_attention(q, k, v, block_size=512, causal=False):
+    """Single-device flash-style attention: lax.scan over K/V blocks with
+    online softmax — O(T) memory."""
+    b, h, t, d = q.shape
+    scale = 1.0 / jnp.sqrt(d)
+    nblk = -(-t // block_size)
+    pad = nblk * block_size - t
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(b, h, nblk, -1, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nblk, -1, d).transpose(2, 0, 1, 3, 4)
+    q_pos = jnp.arange(t)
+
+    def step(carry, inp):
+        kv_idx, kblk, vblk = inp
+        k_pos = kv_idx * block_size + jnp.arange(block_size)
+        lm = (k_pos[None, :] < t)
+        if causal:
+            lm = lm & (q_pos[:, None] >= k_pos[None, :])
+        lm = lm[None, None]
+        return _block_accumulate(carry, q, kblk, vblk, lm, scale), None
+
+    o0 = jnp.zeros((b, h, t, d), jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+    (o, l, m), _ = lax.scan(step, (o0, l0, m0),
+                            (jnp.arange(nblk), kb, vb))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def make_ring_attention(mesh, axis_name="sp", causal=False):
+    """Build a ring-attention fn for q,k,v sharded over `axis_name` on the
+    time dim. Returns f(q_local, k_local, v_local) usable INSIDE shard_map
+    over `mesh` — each of the n devices holds (B, H, T/n, D) and K/V blocks
+    ppermute around the ring, one ICI hop per step."""
+
+    def ring_attn(q, k, v):
+        n = lax.psum(1, axis_name)
+        my = lax.axis_index(axis_name)
+        b, h, t_local, d = q.shape
+        scale = 1.0 / jnp.sqrt(d)
+        q_pos = my * t_local + jnp.arange(t_local)
+
+        def step(carry, i):
+            o, l, m, kblk, vblk = carry
+            src_idx = (my - i) % n  # whose K/V block we currently hold
+            if causal:
+                k_pos = src_idx * t_local + jnp.arange(t_local)
+                lm = (q_pos[:, None] >= k_pos[None, :])[None, None]
+            else:
+                lm = None
+            o, l, m = _block_accumulate((o, l, m), q, kblk, vblk, lm, scale)
+            # rotate K/V one hop around the ring (overlaps with next block
+            # on TPU: XLA schedules the collective-permute async)
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            kblk = lax.ppermute(kblk, axis_name, perm)
+            vblk = lax.ppermute(vblk, axis_name, perm)
+            return (o, l, m, kblk, vblk), None
+
+        o0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+        l0 = jnp.zeros((b, h, t_local), jnp.float32)
+        m0 = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)
+        (o, l, m, _, _), _ = lax.scan(step, (o0, l0, m0, k, v),
+                                      jnp.arange(n))
+        return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+    return ring_attn
+
+
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=False):
+    """Convenience wrapper: shard (B,H,T,D) over T, run the ring, gather."""
+    fn = make_ring_attention(mesh, axis_name, causal)
+    spec = P(None, None, axis_name, None)
+    shmapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec, check_vma=False)
+    return shmapped(q, k, v)

@@ -1,0 +1,121 @@
+"""ParallelWrapper (≡ deeplearning4j-parallel-wrapper ::
+parallelism.ParallelWrapper) — synchronous data-parallel training.
+
+The reference clones the model per GPU, runs workers on threads, and merges
+gradients through EncodedGradientsAccumulator over Aeron/NCCL. TPU-native
+inversion: ONE SPMD program — parameters replicated over the `dp` mesh
+axis, batch sharded on dim 0, and the gradient all-reduce is inserted by
+XLA as an ICI psum inside the SAME fused step (no accumulator thread, no
+encoding; see compression.py for the optional threshold-encoding parity).
+
+Usage parity:
+    pw = (ParallelWrapper.Builder(net)
+          .workers(8).prefetchBuffer(4).averagingFrequency(1).build())
+    pw.fit(iterator)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+
+
+class ParallelWrapper:
+    def __init__(self, model, workers=None, prefetch_buffer=2,
+                 averaging_frequency=1, report_score=True, devices=None):
+        self.model = model
+        devs = list(devices if devices is not None else jax.devices())
+        n = workers or len(devs)
+        self.mesh = DeviceMesh(devs[:n], dp=n)
+        self.prefetch_buffer = prefetch_buffer
+        self.averaging_frequency = averaging_frequency  # sync SPMD ⇒ always 1
+        self.report_score = report_score
+
+    class Builder:
+        def __init__(self, model):
+            self._model = model
+            self._kw = {}
+
+        def workers(self, n):
+            self._kw["workers"] = int(n)
+            return self
+
+        def prefetchBuffer(self, n):
+            self._kw["prefetch_buffer"] = int(n)
+            return self
+
+        def averagingFrequency(self, n):
+            self._kw["averaging_frequency"] = int(n)
+            return self
+
+        def reportScoreAfterAveraging(self, flag):
+            self._kw["report_score"] = bool(flag)
+            return self
+
+        def workspaceMode(self, *_):
+            return self  # XLA buffer reuse; accepted for parity
+
+        def trainingMode(self, *_):
+            return self  # always synchronous averaging (SPMD)
+
+        def build(self):
+            return ParallelWrapper(self._model, **self._kw)
+
+    # -- device placement ------------------------------------------------
+    def _shard_model(self):
+        m = self.model
+        m._params = self.mesh.replicate(m._params)
+        m._opt_state = self.mesh.replicate(m._opt_state)
+        if m._state:
+            m._state = self.mesh.replicate(m._state)
+
+    def _pad_batch(self, arr):
+        n = self.mesh.size
+        b = arr.shape[0]
+        if b % n == 0:
+            return arr, b
+        pad = n - b % n
+        reps = np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)])
+        return reps, b
+
+    def fit(self, iterator, epochs=1):
+        """Data-parallel fit: same jitted train step as the wrapped model —
+        input sharding makes it SPMD over the dp axis."""
+        if self.model._params is None:
+            self.model.init()
+        self._shard_model()
+        it = iterator
+        if self.prefetch_buffer and hasattr(iterator, "asyncSupported") \
+                and iterator.asyncSupported():
+            it = AsyncDataSetIterator(iterator, self.prefetch_buffer)
+        for _ in range(int(epochs)):
+            if hasattr(it, "reset"):
+                it.reset()
+            for ds in it:
+                feats, _ = self._pad_batch(np.asarray(ds.features))
+                labs, _ = self._pad_batch(np.asarray(ds.labels))
+                x = jax.device_put(feats, self.mesh.sharding("dp"))
+                y = jax.device_put(labs, self.mesh.sharding("dp"))
+                lmask = fmask = None
+                if ds.labelsMask is not None:
+                    lm, _ = self._pad_batch(np.asarray(ds.labelsMask))
+                    lmask = jax.device_put(lm, self.mesh.sharding("dp"))
+                if ds.featuresMask is not None:
+                    fm, _ = self._pad_batch(np.asarray(ds.featuresMask))
+                    fmask = jax.device_put(fm, self.mesh.sharding("dp"))
+                m = self.model
+                m._rng_key, sub = jax.random.split(m._rng_key)
+                m._params, m._opt_state, m._state, loss = m._train_step(
+                    m._params, m._opt_state, m._state, x, y, fmask, lmask, sub)
+                m._score = float(loss)
+                m._iteration += 1
+                for listener in m._listeners:
+                    listener.iterationDone(m, m._iteration, m._epoch)
+            self.model._epoch += 1
+        return self.model
+
+    def shutdown(self):
+        pass  # no worker threads to stop: one SPMD program

@@ -1,0 +1,74 @@
+"""OpExecutioner facade (≡ nd4j NativeOpExecutioner / CudaExecutioner).
+
+The reference routes every op through an executioner that picks kernels and
+manages streams. Under XLA the executioner's real job collapses into: (a)
+the jit dispatch cache (trace once per shape signature), (b) profiling
+hooks. This facade exposes both with the reference's vocabulary, so code
+written against `Nd4j.getExecutioner()` has a direct counterpart.
+"""
+from __future__ import annotations
+
+import collections
+import time
+
+import jax
+
+
+class OpExecutioner:
+    _instance = None
+
+    def __init__(self):
+        self._jit_cache = {}
+        self.profiling = False
+        self.op_counts = collections.Counter()
+        self.op_times = collections.defaultdict(float)
+
+    @classmethod
+    def getInstance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    # -- dispatch --------------------------------------------------------
+    def exec(self, fn, *args, static_argnums=(), **kwargs):
+        """Execute fn under jit with executioner-level caching/profiling."""
+        key = (fn, static_argnums)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(fn, static_argnums=static_argnums)
+        jitted = self._jit_cache[key]
+        if not self.profiling:
+            return jitted(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = jitted(*args, **kwargs)
+        jax.block_until_ready(out)
+        name = getattr(fn, "__name__", str(fn))
+        self.op_counts[name] += 1
+        self.op_times[name] += time.perf_counter() - t0
+        return out
+
+    def commit(self):
+        """≡ flushing the op queue: wait for all device work."""
+        for d in jax.devices():
+            try:
+                jax.device_put(0.0, d).block_until_ready()
+            except Exception:
+                pass
+
+    # -- profiling (≡ OpProfiler) ---------------------------------------
+    def setProfilingMode(self, enabled):
+        self.profiling = bool(enabled)
+
+    def getProfilingStats(self):
+        return {name: {"count": self.op_counts[name],
+                       "total_time_s": self.op_times[name]}
+                for name in self.op_counts}
+
+    def printEnvironmentInformation(self):
+        info = {
+            "backend": jax.default_backend(),
+            "devices": [str(d) for d in jax.devices()],
+            "jit_cache_entries": len(self._jit_cache),
+        }
+        for k, v in info.items():
+            print(f"{k}: {v}")
+        return info

@@ -1,0 +1,197 @@
+"""ctypes bindings + on-demand build of the native runtime
+(runtime/native/dl4j_native.cpp). Falls back to pure numpy when the
+toolchain is unavailable — every caller checks `available()`.
+
+ctypes releases the GIL during calls, so batch conversion in the native
+path truly overlaps Python-side device dispatch (the reference gets the
+same overlap from its javacpp worker threads).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "native", "dl4j_native.cpp")
+_SO = os.path.join(_HERE, "native", "libdl4j_native.so")
+
+_lib = None
+_lock = threading.Lock()
+_build_failed = False
+
+
+def _build():
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def get_lib():
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except Exception:
+            _build_failed = True
+            return None
+        c = ctypes
+        lib.dl4j_idx_read.restype = c.c_void_p
+        lib.dl4j_idx_read.argtypes = [c.c_char_p, c.POINTER(c.c_int64),
+                                      c.POINTER(c.c_int32),
+                                      c.POINTER(c.c_int32)]
+        lib.dl4j_free.argtypes = [c.c_void_p]
+        lib.dl4j_u8_to_f32.argtypes = [c.c_void_p, c.c_void_p, c.c_int64,
+                                       c.c_float, c.c_float]
+        lib.dl4j_gather_batch_u8.argtypes = [c.c_void_p, c.c_int64,
+                                             c.c_void_p, c.c_int64,
+                                             c.c_void_p, c.c_float, c.c_float]
+        lib.dl4j_one_hot.argtypes = [c.c_void_p, c.c_void_p, c.c_int64,
+                                     c.c_int64, c.c_void_p]
+        lib.dl4j_sub_channel_means.argtypes = [c.c_void_p, c.c_int64,
+                                               c.c_int64, c.c_void_p]
+        lib.dl4j_standardize.argtypes = [c.c_void_p, c.c_int64, c.c_int64,
+                                         c.c_void_p, c.c_void_p]
+        lib.dl4j_ring_create.restype = c.c_void_p
+        lib.dl4j_ring_create.argtypes = [c.c_int64]
+        lib.dl4j_ring_push.restype = c.c_int32
+        lib.dl4j_ring_push.argtypes = [c.c_void_p, c.c_void_p, c.c_int64]
+        lib.dl4j_ring_pop.restype = c.c_int64
+        lib.dl4j_ring_pop.argtypes = [c.c_void_p, c.POINTER(c.c_void_p)]
+        lib.dl4j_ring_size.restype = c.c_int64
+        lib.dl4j_ring_size.argtypes = [c.c_void_p]
+        lib.dl4j_ring_close.argtypes = [c.c_void_p]
+        lib.dl4j_ring_destroy.argtypes = [c.c_void_p]
+        lib.dl4j_arena_create.restype = c.c_void_p
+        lib.dl4j_arena_create.argtypes = [c.c_int64]
+        lib.dl4j_arena_alloc.restype = c.c_void_p
+        lib.dl4j_arena_alloc.argtypes = [c.c_void_p, c.c_int64]
+        lib.dl4j_arena_reset.argtypes = [c.c_void_p]
+        lib.dl4j_arena_used.restype = c.c_int64
+        lib.dl4j_arena_used.argtypes = [c.c_void_p]
+        lib.dl4j_arena_destroy.argtypes = [c.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available():
+    return get_lib() is not None
+
+
+# -- numpy-level wrappers ------------------------------------------------
+def idx_read(path):
+    """Parse an (uncompressed) IDX file natively -> numpy array, or None."""
+    lib = get_lib()
+    if lib is None or path.endswith(".gz"):
+        return None
+    dims = (ctypes.c_int64 * 8)()
+    ndim = ctypes.c_int32()
+    dtype_code = ctypes.c_int32()
+    ptr = lib.dl4j_idx_read(path.encode(), dims, ctypes.byref(ndim),
+                            ctypes.byref(dtype_code))
+    if not ptr:
+        return None
+    shape = tuple(dims[i] for i in range(ndim.value))
+    dtype = {8: np.uint8, 9: np.int8, 11: np.int16, 12: np.int32,
+             13: np.float32, 14: np.float64}[dtype_code.value]
+    n = int(np.prod(shape))
+    buf = (ctypes.c_char * (n * np.dtype(dtype).itemsize)).from_address(ptr)
+    arr = np.frombuffer(buf, dtype=dtype).reshape(shape).copy()
+    lib.dl4j_free(ptr)
+    return arr
+
+
+def gather_batch_u8(archive, indices, scale=1.0 / 255.0, bias=0.0, out=None):
+    """(N, ...)-uint8 archive + int64 indices -> (B, ...) float32 batch."""
+    lib = get_lib()
+    item_size = int(np.prod(archive.shape[1:]))
+    idx = np.ascontiguousarray(indices, np.int64)
+    b = len(idx)
+    if out is None:
+        out = np.empty((b,) + archive.shape[1:], np.float32)
+    if lib is None:
+        out[:] = archive[idx].astype(np.float32) * scale + bias
+        return out
+    lib.dl4j_gather_batch_u8(
+        archive.ctypes.data_as(ctypes.c_void_p), item_size,
+        idx.ctypes.data_as(ctypes.c_void_p), b,
+        out.ctypes.data_as(ctypes.c_void_p), scale, bias)
+    return out
+
+
+def one_hot_u8(labels_u8, indices, n_classes, out=None):
+    lib = get_lib()
+    idx = np.ascontiguousarray(indices, np.int64)
+    b = len(idx)
+    if out is None:
+        out = np.empty((b, n_classes), np.float32)
+    if lib is None:
+        out[:] = 0.0
+        out[np.arange(b), labels_u8[idx].astype(np.int64)] = 1.0
+        return out
+    lib.dl4j_one_hot(labels_u8.ctypes.data_as(ctypes.c_void_p),
+                     idx.ctypes.data_as(ctypes.c_void_p), b, n_classes,
+                     out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def standardize_inplace(data, mean, std):
+    lib = get_lib()
+    rows = data.shape[0]
+    cols = int(np.prod(data.shape[1:]))
+    if lib is None:
+        flat = data.reshape(rows, cols)
+        flat -= mean
+        flat /= std
+        return data
+    lib.dl4j_standardize(data.ctypes.data_as(ctypes.c_void_p), rows, cols,
+                         np.ascontiguousarray(mean, np.float32).ctypes
+                         .data_as(ctypes.c_void_p),
+                         np.ascontiguousarray(std, np.float32).ctypes
+                         .data_as(ctypes.c_void_p))
+    return data
+
+
+class NativeArena:
+    """Host staging arena (≡ MemoryWorkspace): bump-alloc + epoch reset."""
+
+    def __init__(self, capacity_bytes):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native lib unavailable")
+        self._lib = lib
+        self._handle = lib.dl4j_arena_create(capacity_bytes)
+        self.capacity = capacity_bytes
+
+    def alloc_f32(self, shape):
+        n = int(np.prod(shape))
+        ptr = self._lib.dl4j_arena_alloc(self._handle, n * 4)
+        if not ptr:
+            return np.empty(shape, np.float32)  # arena full: heap fallback
+        buf = (ctypes.c_float * n).from_address(ptr)
+        return np.frombuffer(buf, np.float32).reshape(shape)
+
+    def reset(self):
+        self._lib.dl4j_arena_reset(self._handle)
+
+    def used(self):
+        return int(self._lib.dl4j_arena_used(self._handle))
+
+    def close(self):
+        if self._handle:
+            self._lib.dl4j_arena_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

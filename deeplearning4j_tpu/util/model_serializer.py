@@ -1,0 +1,130 @@
+"""ModelSerializer (≡ deeplearning4j-core :: util.ModelSerializer).
+
+Same idea as the reference's zip format: a zip holding the config JSON
+("configuration.json"), parameter tensors ("coefficients.npz"), mutable
+layer state ("state.npz") and optionally the updater state
+("updaterState.npz"). Also carries normalizers, like the reference's
+addNormalizerToModel.
+"""
+from __future__ import annotations
+
+import io
+import json
+import pickle
+import zipfile
+
+import jax
+import numpy as np
+
+CONFIG_JSON = "configuration.json"
+PARAMS_NPZ = "coefficients.npz"
+STATE_NPZ = "state.npz"
+UPDATER_PKL = "updaterState.bin"
+NORMALIZER_PKL = "normalizer.bin"
+KIND_TXT = "modeltype.txt"
+
+
+def _tree_to_npz_bytes(tree):
+    flat = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}/{k}" if prefix else str(k), v)
+        else:
+            flat[prefix] = np.asarray(node)
+
+    walk("", tree or {})
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    return buf.getvalue()
+
+
+def _npz_bytes_to_tree(data):
+    loaded = np.load(io.BytesIO(data))
+    tree = {}
+    for key in loaded.files:
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jax.numpy.asarray(loaded[key])
+    return tree
+
+
+class ModelSerializer:
+    @staticmethod
+    def writeModel(model, path, saveUpdater=True, normalizer=None):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        kind = "ComputationGraph"
+        if isinstance(model, MultiLayerNetwork):
+            kind = "MultiLayerNetwork"
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr(KIND_TXT, kind)
+            zf.writestr(CONFIG_JSON, model.conf.toJson())
+            zf.writestr(PARAMS_NPZ, _tree_to_npz_bytes(model._params))
+            zf.writestr(STATE_NPZ, _tree_to_npz_bytes(model._state))
+            if saveUpdater and model._opt_state is not None:
+                leaves, treedef = jax.tree_util.tree_flatten(model._opt_state)
+                zf.writestr(UPDATER_PKL, pickle.dumps(
+                    ([np.asarray(l) for l in leaves], treedef)))
+            if normalizer is not None:
+                zf.writestr(NORMALIZER_PKL, pickle.dumps(normalizer))
+        return path
+
+    @staticmethod
+    def _restore(path, loadUpdater, expected_kind):
+        with zipfile.ZipFile(path, "r") as zf:
+            kind = zf.read(KIND_TXT).decode()
+            conf_json = zf.read(CONFIG_JSON).decode()
+            params = _npz_bytes_to_tree(zf.read(PARAMS_NPZ))
+            state = _npz_bytes_to_tree(zf.read(STATE_NPZ))
+            updater_blob = (zf.read(UPDATER_PKL)
+                            if loadUpdater and UPDATER_PKL in zf.namelist()
+                            else None)
+        if expected_kind and kind != expected_kind:
+            raise ValueError(f"Model in {path} is a {kind}, expected {expected_kind}")
+        if kind == "MultiLayerNetwork":
+            from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+            conf = MultiLayerConfiguration.fromJson(conf_json)
+            model = MultiLayerNetwork(conf)
+        else:
+            from deeplearning4j_tpu.nn.conf.graph_builder import \
+                ComputationGraphConfiguration
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+            conf = ComputationGraphConfiguration.fromJson(conf_json)
+            model = ComputationGraph(conf)
+        model.init()
+        model._params = params
+        model._state = state
+        model._build_optimizer()
+        if updater_blob is not None:
+            leaves, treedef = pickle.loads(updater_blob)
+            model._opt_state = jax.tree_util.tree_unflatten(
+                treedef, [jax.numpy.asarray(l) for l in leaves])
+        return model
+
+    @staticmethod
+    def restoreMultiLayerNetwork(path, loadUpdater=True):
+        return ModelSerializer._restore(path, loadUpdater, "MultiLayerNetwork")
+
+    @staticmethod
+    def restoreComputationGraph(path, loadUpdater=True):
+        return ModelSerializer._restore(path, loadUpdater, "ComputationGraph")
+
+    @staticmethod
+    def restoreModel(path, loadUpdater=True):
+        return ModelSerializer._restore(path, loadUpdater, None)
+
+    @staticmethod
+    def addNormalizerToModel(path, normalizer):
+        with zipfile.ZipFile(path, "a") as zf:
+            zf.writestr(NORMALIZER_PKL, pickle.dumps(normalizer))
+
+    @staticmethod
+    def restoreNormalizerFromFile(path):
+        with zipfile.ZipFile(path, "r") as zf:
+            if NORMALIZER_PKL not in zf.namelist():
+                return None
+            return pickle.loads(zf.read(NORMALIZER_PKL))

@@ -1,0 +1,100 @@
+"""Config JSON serde (≡ MultiLayerConfiguration.toJson/fromJson — the
+reference persists configs as Jackson JSON inside model zips; same idea).
+
+Objects from our config namespaces encode as {"@class": name, ...fields};
+decode resolves the class from a registry of config modules.
+"""
+from __future__ import annotations
+
+import importlib
+
+_CONFIG_MODULES = [
+    "deeplearning4j_tpu.nn.conf.layers",
+    "deeplearning4j_tpu.nn.conf.inputs",
+    "deeplearning4j_tpu.nn.conf.preprocessors",
+    "deeplearning4j_tpu.nn.conf.builders",
+    "deeplearning4j_tpu.nn.conf.recurrent",
+    "deeplearning4j_tpu.nn.conf.graph_vertices",
+    "deeplearning4j_tpu.nn.updaters",
+    "deeplearning4j_tpu.nn.schedules",
+]
+
+
+def _resolve(name):
+    for mod in _CONFIG_MODULES:
+        try:
+            m = importlib.import_module(mod)
+        except ImportError:
+            continue
+        if hasattr(m, name):
+            return getattr(m, name)
+    raise ValueError(f"Cannot resolve config class '{name}'")
+
+
+def encode(obj):
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, (list, tuple)):
+        return {"@tuple": [encode(o) for o in obj]} if isinstance(obj, tuple) \
+            else [encode(o) for o in obj]
+    if isinstance(obj, dict):
+        return {"@dict": {str(k): encode(v) for k, v in obj.items()}}
+    # config object: class + public fields
+    d = {"@class": type(obj).__name__}
+    for k, v in obj.__dict__.items():
+        if k.startswith("_") or callable(v):
+            continue
+        d[k] = encode(v)
+    return d
+
+
+def decode(obj):
+    if isinstance(obj, list):
+        return [decode(o) for o in obj]
+    if isinstance(obj, dict):
+        if "@tuple" in obj:
+            return tuple(decode(o) for o in obj["@tuple"])
+        if "@dict" in obj:
+            return {k: decode(v) for k, v in obj["@dict"].items()}
+        if "@class" in obj:
+            cls = _resolve(obj["@class"])
+            inst = cls.__new__(cls)
+            for k, v in obj.items():
+                if k != "@class":
+                    # object.__setattr__ so frozen dataclasses (InputType)
+                    # decode too
+                    object.__setattr__(inst, k, decode(v))
+            return inst
+        return {k: decode(v) for k, v in obj.items()}
+    return obj
+
+
+def config_to_dict(conf):
+    from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+    return {
+        "format": "deeplearning4j_tpu/MultiLayerConfiguration/v1",
+        "defaults": encode({k: v for k, v in conf.defaults.items()}),
+        "layers": [encode(l) for l in conf.layers],
+        "input_type": encode(conf.input_type),
+        "preprocessors": {str(k): encode(v) for k, v in conf.preprocessors.items()},
+        "backprop_type": conf.backprop_type,
+        "tbptt_fwd_length": conf.tbptt_fwd_length,
+        "tbptt_back_length": conf.tbptt_back_length,
+        "data_type": conf.data_type,
+        "seed": conf.seed,
+    }
+
+
+def config_from_dict(d):
+    from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+    defaults = decode(d["defaults"])
+    return MultiLayerConfiguration(
+        defaults if isinstance(defaults, dict) else {},
+        [decode(l) for l in d["layers"]],
+        decode(d["input_type"]),
+        {int(k): decode(v) for k, v in d.get("preprocessors", {}).items()},
+        d.get("backprop_type", "standard"),
+        d.get("tbptt_fwd_length", 20),
+        d.get("tbptt_back_length", 20),
+        d.get("data_type", "float32"),
+        d.get("seed", 0))

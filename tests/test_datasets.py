@@ -1,0 +1,116 @@
+"""Dataset/iterator/normalizer tests (SURVEY.md §4)."""
+import numpy as np
+
+from deeplearning4j_tpu.datasets import (ArrayDataSetIterator,
+                                         AsyncDataSetIterator,
+                                         CifarDataSetIterator, DataSet,
+                                         ImagePreProcessingScaler,
+                                         IrisDataSetIterator,
+                                         MnistDataSetIterator,
+                                         NormalizerMinMaxScaler,
+                                         NormalizerStandardize,
+                                         VGG16ImagePreProcessor)
+
+
+def test_dataset_basics():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1, 0, 1]]
+    ds = DataSet(x, y)
+    assert ds.numExamples() == 6
+    assert ds.numOutcomes() == 2
+    split = ds.splitTestAndTrain(4)
+    assert split.getTrain().numExamples() == 4
+    assert split.getTest().numExamples() == 2
+    batches = ds.batchBy(4)
+    assert [b.numExamples() for b in batches] == [4, 2]
+    merged = DataSet.merge(batches)
+    np.testing.assert_array_equal(merged.features, x)
+
+
+def test_dataset_shuffle_deterministic():
+    x = np.arange(10, dtype=np.float32)[:, None]
+    ds = DataSet(x, x.copy())
+    ds.shuffle(seed=3)
+    np.testing.assert_array_equal(ds.features, ds.labels)
+    assert not np.array_equal(ds.features.ravel(), np.arange(10))
+
+
+def test_mnist_iterator_protocol():
+    it = MnistDataSetIterator(32, train=True, num_examples=96)
+    assert it.numExamples() == 96
+    assert it.totalOutcomes() == 10
+    assert it.inputColumns() == 784
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].features.shape == (32, 784)
+    assert batches[0].labels.shape == (32, 10)
+    assert 0.0 <= batches[0].features.min() <= batches[0].features.max() <= 1.0
+    # deterministic across constructions
+    it2 = MnistDataSetIterator(32, train=True, num_examples=96)
+    np.testing.assert_array_equal(batches[0].features, it2.next().features)
+
+
+def test_cifar_iterator():
+    it = CifarDataSetIterator(16, train=False, num_examples=32)
+    b = it.next()
+    assert b.features.shape == (16, 32, 32, 3)
+    assert b.labels.shape == (16, 10)
+
+
+def test_iris_iterator_classes_balanced():
+    it = IrisDataSetIterator(150)
+    ds = it.next(150)
+    counts = ds.labels.sum(0)
+    np.testing.assert_array_equal(counts, [50, 50, 50])
+
+
+def test_normalizer_standardize_roundtrip():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((100, 5)).astype(np.float32) * 3 + 1
+    it = ArrayDataSetIterator(x, np.zeros((100, 1), np.float32), 25)
+    norm = NormalizerStandardize().fit(it)
+    z = norm.transform_array(x)
+    np.testing.assert_allclose(z.mean(0), np.zeros(5), atol=1e-4)
+    np.testing.assert_allclose(z.std(0), np.ones(5), atol=1e-3)
+    back = norm.revert_array(z)
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-4)
+
+
+def test_normalizer_minmax():
+    x = np.array([[0.0, 10.0], [5.0, 20.0], [10.0, 30.0]], np.float32)
+    norm = NormalizerMinMaxScaler()
+    norm.fit(DataSet(x, None))
+    z = norm.transform_array(x)
+    np.testing.assert_allclose(z.min(0), [0, 0])
+    np.testing.assert_allclose(z.max(0), [1, 1])
+
+
+def test_image_scaler_and_vgg_preproc():
+    img = np.full((2, 4, 4, 3), 255.0, np.float32)
+    s = ImagePreProcessingScaler()
+    np.testing.assert_allclose(s.transform_array(img), np.ones((2, 4, 4, 3)))
+    v = VGG16ImagePreProcessor()
+    out = v.transform_array(img)
+    np.testing.assert_allclose(out[..., 0], 255 - 123.68, rtol=1e-5)
+
+
+def test_preprocessor_attached_to_iterator():
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    it = ArrayDataSetIterator(x, np.zeros((10, 1), np.float32), 5)
+    norm = NormalizerStandardize().fit(it)
+    it.setPreProcessor(norm)
+    b = it.next()
+    assert abs(b.features.mean()) < 2.0  # normalized scale
+
+
+def test_async_iterator_equivalent():
+    x = np.arange(40, dtype=np.float32).reshape(20, 2)
+    y = np.zeros((20, 1), np.float32)
+    base = ArrayDataSetIterator(x, y, 5)
+    direct = [b.features.copy() for b in base]
+    base.reset()
+    async_it = AsyncDataSetIterator(base, queue_size=2)
+    buffered = [b.features for b in async_it]
+    assert len(buffered) == len(direct)
+    for a, d in zip(buffered, direct):
+        np.testing.assert_array_equal(a, d)

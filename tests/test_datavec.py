@@ -1,0 +1,82 @@
+"""DataVec tests (SURVEY.md §4; ≡ datavec-api transform tests)."""
+import numpy as np
+
+from deeplearning4j_tpu.datavec import (CSVRecordReader,
+                                        CollectionRecordReader,
+                                        LineRecordReader,
+                                        RecordReaderDataSetIterator, Schema,
+                                        TransformProcess)
+
+CSV = """a,b,label
+1.0,2.0,cat
+3.0,4.0,dog
+5.0,6.0,cat
+"""
+
+
+def test_csv_record_reader():
+    rr = CSVRecordReader(skipNumLines=1).initialize(CSV)
+    rows = list(rr)
+    assert rows == [["1.0", "2.0", "cat"], ["3.0", "4.0", "dog"],
+                    ["5.0", "6.0", "cat"]]
+
+
+def test_line_record_reader():
+    rr = LineRecordReader().initialize("x\ny\n")
+    assert [r[0] for r in rr] == ["x", "y"]
+
+
+def test_transform_process_pipeline():
+    schema = (Schema.Builder()
+              .addColumnsDouble("a", "b")
+              .addColumnCategorical("label", "cat", "dog")
+              .build())
+    tp = (TransformProcess.Builder(schema)
+          .doubleMathOp("a", "multiply", 2.0)
+          .categoricalToInteger("label")
+          .removeColumns("b")
+          .build())
+    rows, out_schema = tp.execute([[1.0, 2.0, "cat"], [3.0, 4.0, "dog"]])
+    assert rows == [[2.0, 0], [6.0, 1]]
+    assert out_schema.names() == ["a", "label"]
+
+
+def test_categorical_to_onehot():
+    schema = (Schema.Builder()
+              .addColumnCategorical("c", "x", "y", "z")
+              .addColumnDouble("v")
+              .build())
+    tp = TransformProcess.Builder(schema).categoricalToOneHot("c").build()
+    rows, out_schema = tp.execute([["y", 1.0], ["z", 2.0]])
+    assert rows == [[0.0, 1.0, 0.0, 1.0], [0.0, 0.0, 1.0, 2.0]]
+    assert out_schema.names() == ["c[x]", "c[y]", "c[z]", "v"]
+
+
+def test_filter_and_normalize():
+    schema = Schema.Builder().addColumnsDouble("v", "w").build()
+    tp = (TransformProcess.Builder(schema)
+          .filter(lambda r: float(r["v"]) < 0)
+          .normalize("w", "minmax")
+          .build())
+    rows, _ = tp.execute([[1.0, 0.0], [-1.0, 5.0], [2.0, 10.0]])
+    assert rows == [[1.0, 0.0], [2.0, 1.0]]
+
+
+def test_record_reader_dataset_iterator_classification():
+    rr = CollectionRecordReader([[0.1, 0.2, 0], [0.3, 0.4, 1],
+                                 [0.5, 0.6, 2], [0.7, 0.8, 1]])
+    it = RecordReaderDataSetIterator(rr, batch_size=2, labelIndex=2,
+                                     numClasses=3)
+    b = it.next()
+    assert b.features.shape == (2, 2)
+    np.testing.assert_allclose(b.labels, [[1, 0, 0], [0, 1, 0]])
+    assert it.totalOutcomes() == 3
+
+
+def test_record_reader_dataset_iterator_regression():
+    rr = CollectionRecordReader([[1.0, 2.0, 0.5], [3.0, 4.0, 1.5]])
+    it = RecordReaderDataSetIterator(rr, batch_size=2, labelIndex=2,
+                                     regression=True)
+    b = it.next()
+    assert b.labels.shape == (2, 1)
+    np.testing.assert_allclose(b.labels.ravel(), [0.5, 1.5])

@@ -1,0 +1,113 @@
+"""Evaluation metric tests vs hand oracles (SURVEY.md §4; ≡ nd4j
+EvaluationTests / ROCTest / RegressionEvalTest)."""
+import numpy as np
+
+from deeplearning4j_tpu.eval import (Evaluation, EvaluationBinary,
+                                     RegressionEvaluation, ROC, ROCMultiClass)
+
+
+def test_evaluation_accuracy_and_confusion():
+    e = Evaluation()
+    labels = np.eye(3)[[0, 0, 1, 1, 2, 2]]
+    preds = np.eye(3)[[0, 1, 1, 1, 2, 0]]
+    e.eval(labels, preds + 0.01)
+    assert abs(e.accuracy() - 4 / 6) < 1e-9
+    cm = e.confusionMatrix()
+    assert cm[0, 0] == 1 and cm[0, 1] == 1 and cm[2, 0] == 1
+    # class 1: predicted {1:3}, actual {1:2}
+    assert e.truePositives(1) == 2
+    assert e.falsePositives(1) == 1
+    assert e.falseNegatives(1) == 0
+    assert abs(e.precision(1) - 2 / 3) < 1e-9
+    assert abs(e.recall(1) - 1.0) < 1e-9
+    f1 = 2 * (2 / 3) * 1.0 / (2 / 3 + 1.0)
+    assert abs(e.f1(1) - f1) < 1e-9
+    assert "Accuracy" in e.stats()
+
+
+def test_evaluation_incremental_batches():
+    e = Evaluation()
+    labels = np.eye(2)[[0, 1]]
+    e.eval(labels, np.array([[0.9, 0.1], [0.2, 0.8]]))
+    e.eval(labels, np.array([[0.4, 0.6], [0.7, 0.3]]))
+    assert abs(e.accuracy() - 0.5) < 1e-9
+
+
+def test_top_n_accuracy():
+    e = Evaluation(top_n=2)
+    labels = np.eye(3)[[0, 1, 2]]
+    preds = np.array([[0.5, 0.4, 0.1],   # top1 correct
+                      [0.5, 0.4, 0.1],   # top2 correct
+                      [0.5, 0.4, 0.1]])  # wrong entirely
+    e.eval(labels, preds)
+    assert abs(e.accuracy() - 1 / 3) < 1e-9
+    assert abs(e.topNAccuracy() - 2 / 3) < 1e-9
+
+
+def test_roc_auc_perfect_and_random():
+    roc = ROC()
+    labels = np.array([1, 1, 1, 0, 0, 0], np.float32)[:, None]
+    scores = np.array([0.9, 0.8, 0.7, 0.3, 0.2, 0.1], np.float32)[:, None]
+    roc.eval(labels, scores)
+    assert abs(roc.calculateAUC() - 1.0) < 1e-9
+    roc2 = ROC()
+    roc2.eval(labels, np.full((6, 1), 0.5, np.float32))
+    assert abs(roc2.calculateAUC() - 0.5) < 0.01
+
+
+def test_roc_known_auc():
+    roc = ROC()
+    labels = np.array([1, 0, 1, 0], np.float32)[:, None]
+    scores = np.array([0.8, 0.7, 0.6, 0.2], np.float32)[:, None]
+    roc.eval(labels, scores)
+    # pairs: (1>0): (0.8,0.7)=1, (0.8,0.2)=1, (0.6,0.7)=0, (0.6,0.2)=1 → 3/4
+    assert abs(roc.calculateAUC() - 0.75) < 1e-9
+
+
+def test_roc_multiclass():
+    r = ROCMultiClass()
+    labels = np.eye(3)[[0, 1, 2, 0]]
+    preds = np.array([[0.8, 0.1, 0.1],
+                      [0.1, 0.8, 0.1],
+                      [0.1, 0.1, 0.8],
+                      [0.7, 0.2, 0.1]])
+    r.eval(labels, preds)
+    assert r.calculateAverageAUC() == 1.0
+
+
+def test_evaluation_binary():
+    e = EvaluationBinary()
+    labels = np.array([[1, 0], [1, 1], [0, 0], [0, 1]], np.float32)
+    preds = np.array([[0.9, 0.2], [0.8, 0.3], [0.1, 0.6], [0.3, 0.9]], np.float32)
+    e.eval(labels, preds)
+    # output 0: tp=2 fp=0 tn=2 fn=0 → acc 1; output 1: tp=1 fp=1 tn=1 fn=1 → acc .5
+    assert abs(e.accuracy(0) - 1.0) < 1e-9
+    assert abs(e.accuracy(1) - 0.5) < 1e-9
+    assert abs(e.accuracy() - 0.75) < 1e-9
+
+
+def test_regression_evaluation():
+    e = RegressionEvaluation()
+    labels = np.array([[1.0], [2.0], [3.0]])
+    preds = np.array([[1.1], [1.9], [3.2]])
+    e.eval(labels, preds)
+    mse = np.mean((preds - labels) ** 2)
+    mae = np.mean(np.abs(preds - labels))
+    assert abs(e.meanSquaredError() - mse) < 1e-9
+    assert abs(e.meanAbsoluteError() - mae) < 1e-9
+    assert abs(e.rootMeanSquaredError() - np.sqrt(mse)) < 1e-9
+    assert e.rSquared() > 0.9
+    assert e.pearsonCorrelation() > 0.99
+
+
+def test_masked_timeseries_eval():
+    e = Evaluation()
+    labels = np.zeros((1, 3, 2))
+    labels[0, :, 0] = 1
+    preds = np.zeros((1, 3, 2))
+    preds[0, 0, 0] = 1   # correct
+    preds[0, 1, 1] = 1   # wrong but masked out
+    preds[0, 2, 0] = 1   # correct
+    mask = np.array([[1, 0, 1]], np.float32)
+    e.eval(labels, preds, mask=mask)
+    assert abs(e.accuracy() - 1.0) < 1e-9

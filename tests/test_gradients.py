@@ -1,0 +1,166 @@
+"""Finite-difference gradient checks per layer type (SURVEY.md §4;
+≡ deeplearning4j-core GradientCheckTests / GradientCheckUtil)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.nn import (BatchNormalization, ConvolutionLayer,
+                                   DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, NoOp, OutputLayer,
+                                   SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+
+EPS = 1e-3
+TOL = 2e-2  # relative tolerance on central differences (fp32)
+
+
+def _check_gradients(net, x, y, n_probes=24, seed=0):
+    """Compare analytic computeGradients against central finite differences
+    at randomly probed parameter coordinates."""
+    grads = net.computeGradients(x, y)
+    flatg, treedef = jax.tree_util.tree_flatten(grads)
+    params = net._params
+    flatp, _ = jax.tree_util.tree_flatten(params)
+    rng = np.random.default_rng(seed)
+    ds = DataSet(x, y)
+
+    checked = 0
+    for li, (g, p) in enumerate(zip(flatg, flatp)):
+        idxs = [tuple(rng.integers(0, s) for s in p.shape)
+                for _ in range(max(1, n_probes // len(flatp)))]
+        for idx in idxs:
+            orig = float(p[idx])
+            flatp_plus = list(flatp)
+            flatp_plus[li] = p.at[idx].set(orig + EPS)
+            net._params = jax.tree_util.tree_unflatten(treedef, flatp_plus)
+            s_plus = net.score(ds)
+            flatp_minus = list(flatp)
+            flatp_minus[li] = p.at[idx].set(orig - EPS)
+            net._params = jax.tree_util.tree_unflatten(treedef, flatp_minus)
+            s_minus = net.score(ds)
+            net._params = jax.tree_util.tree_unflatten(treedef, flatp)
+            numeric = (s_plus - s_minus) / (2 * EPS)
+            analytic = float(g[idx])
+            # fp32 central differences bottom out ~1e-4: tiny gradients are
+            # checked absolutely, meaningful ones relatively
+            if abs(numeric - analytic) < 2e-4:
+                checked += 1
+                continue
+            denom = max(abs(numeric), abs(analytic), 1e-4)
+            assert abs(numeric - analytic) / denom < TOL, (
+                f"leaf {li} idx {idx}: numeric {numeric} vs analytic {analytic}")
+            checked += 1
+    assert checked > 0
+
+
+def test_dense_mcxent_gradients():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).updater(NoOp()).activation("tanh")
+            .list()
+            .layer(DenseLayer.Builder().nOut(6).build())
+            .layer(OutputLayer.Builder("mcxent").nOut(3)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((5, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 5)]
+    _check_gradients(net, x, y)
+
+
+def test_dense_l1l2_gradients():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).updater(NoOp()).activation("sigmoid")
+            .l1(0.01).l2(0.02)
+            .list()
+            .layer(DenseLayer.Builder().nOut(5).build())
+            .layer(OutputLayer.Builder("mse").nOut(2)
+                   .activation("identity").build())
+            .setInputType(InputType.feedForward(3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((4, 3)).astype(np.float32)
+    y = rng.standard_normal((4, 2)).astype(np.float32)
+    _check_gradients(net, x, y)
+
+
+def test_cnn_gradients():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).updater(NoOp()).activation("tanh")
+            .list()
+            .layer(ConvolutionLayer.Builder(3, 3).nOut(4)
+                   .convolutionMode("same").build())
+            .layer(SubsamplingLayer.Builder("max").kernelSize(2, 2)
+                   .stride(2, 2).build())
+            .layer(OutputLayer.Builder("mcxent").nOut(2)
+                   .activation("softmax").build())
+            .setInputType(InputType.convolutional(8, 8, 2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((3, 8, 8, 2)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3)]
+    _check_gradients(net, x, y, n_probes=12)
+
+
+def test_lstm_gradients():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).updater(NoOp())
+            .list()
+            .layer(LSTM.Builder().nOut(5).build())
+            .layer(RnnOutputLayer.Builder("mcxent").nOut(2)
+                   .activation("softmax").build())
+            .setInputType(InputType.recurrent(3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2, 4, 3)).astype(np.float32)
+    y = np.zeros((2, 4, 2), np.float32)
+    y[..., 0] = 1
+    _check_gradients(net, x, y, n_probes=12)
+
+
+def test_batchnorm_gradients():
+    """BN in train mode: batch statistics — checked against the same train
+    forward (score uses inference stats, so compute loss manually)."""
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).updater(NoOp()).activation("tanh")
+            .list()
+            .layer(DenseLayer.Builder().nOut(5).build())
+            .layer(BatchNormalization.Builder().build())
+            .layer(OutputLayer.Builder("mse").nOut(2)
+                   .activation("identity").build())
+            .setInputType(InputType.feedForward(3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((6, 3)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal((6, 2)).astype(np.float32))
+
+    def loss_of(p):
+        l, _ = net._loss(p, net._state, x, y, None, None, None)
+        return l
+
+    analytic = jax.grad(loss_of)(net._params)
+    flatp, treedef = jax.tree_util.tree_flatten(net._params)
+    flatg = jax.tree_util.tree_leaves(analytic)
+    probe_rng = np.random.default_rng(0)
+    for li, (g, p) in enumerate(zip(flatg, flatp)):
+        idx = tuple(probe_rng.integers(0, s) for s in p.shape)
+        orig = float(p[idx])
+        plus = list(flatp)
+        plus[li] = p.at[idx].set(orig + EPS)
+        minus = list(flatp)
+        minus[li] = p.at[idx].set(orig - EPS)
+        s_plus = float(loss_of(jax.tree_util.tree_unflatten(treedef, plus)))
+        s_minus = float(loss_of(jax.tree_util.tree_unflatten(treedef, minus)))
+        numeric = (s_plus - s_minus) / (2 * EPS)
+        if abs(numeric - float(g[idx])) < 2e-4:
+            continue
+        denom = max(abs(numeric), abs(float(g[idx])), 1e-4)
+        assert abs(numeric - float(g[idx])) / denom < TOL

@@ -1,0 +1,134 @@
+"""ComputationGraph tests (SURVEY.md §4; ≡ deeplearning4j-core
+ComputationGraphTestRNN / TestComputationGraphNetwork)."""
+import numpy as np
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+from deeplearning4j_tpu.nn import (Adam, DenseLayer, InputType, LossFunction,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.nn.conf.graph_vertices import (ElementWiseVertex,
+                                                       L2NormalizeVertex,
+                                                       MergeVertex,
+                                                       ScaleVertex,
+                                                       ShiftVertex,
+                                                       SubsetVertex)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+def _two_tower():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(7).updater(Adam(1e-2)).activation("relu")
+            .graphBuilder()
+            .addInputs("inA", "inB")
+            .addLayer("da", DenseLayer.Builder().nOut(8).build(), "inA")
+            .addLayer("db", DenseLayer.Builder().nOut(8).build(), "inB")
+            .addVertex("merge", MergeVertex(), "da", "db")
+            .addLayer("out", OutputLayer.Builder(LossFunction.MCXENT)
+                      .nOut(3).activation("softmax").build(), "merge")
+            .setOutputs("out")
+            .setInputTypes(InputType.feedForward(4), InputType.feedForward(5))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def test_two_input_graph_builds_and_runs():
+    g = _two_tower()
+    a = np.random.default_rng(0).standard_normal((6, 4)).astype(np.float32)
+    b = np.random.default_rng(1).standard_normal((6, 5)).astype(np.float32)
+    out = g.output([a, b]).numpy()
+    assert out.shape == (6, 3)
+    np.testing.assert_allclose(out.sum(-1), np.ones(6), rtol=1e-5)
+    # merge: 8+8 -> out nIn 16
+    assert g.nodes["out"].ref.nIn == 16
+
+
+def test_multidataset_fit_reduces_loss():
+    g = _two_tower()
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((64, 4)).astype(np.float32)
+    b = rng.standard_normal((64, 5)).astype(np.float32)
+    cls = (a[:, 0] + b[:, 0] > 0).astype(np.int64) + (a[:, 1] > 0.5)
+    y = np.eye(3, dtype=np.float32)[np.clip(cls, 0, 2)]
+    mds = MultiDataSet([a, b], [y])
+    first = g.score(mds)
+    for _ in range(60):
+        g.fit(mds)
+    assert g.score(mds) < first * 0.6
+
+
+def test_elementwise_and_scale_vertices():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(1).updater(Adam(1e-3)).activation("identity")
+            .graphBuilder()
+            .addInputs("in")
+            .addLayer("d1", DenseLayer.Builder().nOut(4).build(), "in")
+            .addLayer("d2", DenseLayer.Builder().nOut(4).build(), "in")
+            .addVertex("sum", ElementWiseVertex("add"), "d1", "d2")
+            .addVertex("scaled", ScaleVertex(2.0), "sum")
+            .addVertex("shifted", ShiftVertex(1.0), "scaled")
+            .addLayer("out", OutputLayer.Builder("mse").nOut(2)
+                      .activation("identity").build(), "shifted")
+            .setOutputs("out")
+            .setInputTypes(InputType.feedForward(3))
+            .build())
+    g = ComputationGraph(conf).init()
+    x = np.ones((2, 3), np.float32)
+    acts = g.feedForward(x)
+    np.testing.assert_allclose(
+        acts["shifted"].numpy(),
+        2.0 * (acts["d1"].numpy() + acts["d2"].numpy()) + 1.0, rtol=1e-5)
+
+
+def test_subset_and_l2norm_vertices():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(2).updater(Adam(1e-3)).activation("identity")
+            .graphBuilder()
+            .addInputs("in")
+            .addLayer("d", DenseLayer.Builder().nOut(6).build(), "in")
+            .addVertex("sub", SubsetVertex(1, 3), "d")
+            .addVertex("norm", L2NormalizeVertex(), "sub")
+            .addLayer("out", OutputLayer.Builder("mse").nOut(2)
+                      .activation("identity").build(), "norm")
+            .setOutputs("out")
+            .setInputTypes(InputType.feedForward(4))
+            .build())
+    g = ComputationGraph(conf).init()
+    x = np.random.default_rng(0).standard_normal((3, 4)).astype(np.float32)
+    acts = g.feedForward(x)
+    assert acts["sub"].shape == (3, 3)
+    norms = np.linalg.norm(acts["norm"].numpy(), axis=-1)
+    np.testing.assert_allclose(norms, np.ones(3), rtol=1e-4)
+
+
+def test_multi_output_losses():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(5).updater(Adam(1e-2)).activation("relu")
+            .graphBuilder()
+            .addInputs("in")
+            .addLayer("trunk", DenseLayer.Builder().nOut(8).build(), "in")
+            .addLayer("outA", OutputLayer.Builder("mcxent").nOut(2)
+                      .activation("softmax").build(), "trunk")
+            .addLayer("outB", OutputLayer.Builder("mse").nOut(1)
+                      .activation("identity").build(), "trunk")
+            .setOutputs("outA", "outB")
+            .setInputTypes(InputType.feedForward(4))
+            .build())
+    g = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    ya = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    yb = rng.standard_normal((16, 1)).astype(np.float32)
+    mds = MultiDataSet([x], [ya, yb])
+    outs = g.output(x)
+    assert isinstance(outs, list) and len(outs) == 2
+    first = g.score(mds)
+    for _ in range(30):
+        g.fit(mds)
+    assert g.score(mds) < first
+
+
+def test_graph_summary_and_params():
+    g = _two_tower()
+    s = g.summary()
+    assert "merge" in s and "Total params" in s
+    assert g.numParams() == (4 * 8 + 8) + (5 * 8 + 8) + (16 * 3 + 3)

@@ -1,0 +1,190 @@
+"""MultiLayerNetwork end-to-end tests (SURVEY.md §4: config→init→fit;
+≡ deeplearning4j-core MultiLayerTest / dl4j-examples LeNet MNIST)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (ArrayDataSetIterator, DataSet,
+                                         IrisDataSetIterator,
+                                         MnistDataSetIterator,
+                                         NormalizerStandardize)
+from deeplearning4j_tpu.nn import (Activation, Adam, BatchNormalization,
+                                   ConvolutionLayer, DenseLayer, InputType,
+                                   LossFunction, MultiLayerNetwork,
+                                   Nesterovs, NeuralNetConfiguration,
+                                   OutputLayer, SubsamplingLayer, WeightInit)
+
+
+def _mlp_conf(n_in=4, n_hidden=16, n_out=3, seed=42, updater=None, l2=0.0):
+    return (NeuralNetConfiguration.Builder()
+            .seed(seed)
+            .updater(updater or Adam(1e-2))
+            .weightInit(WeightInit.XAVIER)
+            .activation(Activation.RELU)
+            .l2(l2)
+            .list()
+            .layer(DenseLayer.Builder().nOut(n_hidden).build())
+            .layer(DenseLayer.Builder().nOut(n_hidden).build())
+            .layer(OutputLayer.Builder(LossFunction.MCXENT)
+                   .nOut(n_out).activation(Activation.SOFTMAX).build())
+            .setInputType(InputType.feedForward(n_in))
+            .build())
+
+
+def test_build_and_init():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    assert net.getnLayers() == 3
+    # nIn inference: 4 -> 16 -> 16 -> 3
+    assert net.layers[0].nIn == 4
+    assert net.layers[1].nIn == 16
+    assert net.layers[2].nIn == 16
+    expected = 4 * 16 + 16 + 16 * 16 + 16 + 16 * 3 + 3
+    assert net.numParams() == expected
+    assert net.params().length() == expected
+
+
+def test_output_shape_and_softmax():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    x = np.random.default_rng(0).standard_normal((5, 4)).astype(np.float32)
+    out = net.output(x).numpy()
+    assert out.shape == (5, 3)
+    np.testing.assert_allclose(out.sum(-1), np.ones(5), rtol=1e-5)
+
+
+def test_feedforward_activations():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    x = np.zeros((2, 4), np.float32)
+    acts = net.feedForward(x)
+    assert len(acts) == 3
+    assert acts[0].shape == (2, 16)
+    assert acts[-1].shape == (2, 3)
+
+
+def test_fit_decreases_loss_iris():
+    it = IrisDataSetIterator(batch_size=50)
+    norm = NormalizerStandardize().fit(it)
+    it.setPreProcessor(norm)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    ds = it.next(150)
+    first = net.score(ds)
+    net.fit(it, epochs=30)
+    assert net.score(ds) < first * 0.5
+    e = net.evaluate(IrisDataSetIterator(batch_size=150))
+    # fresh iterator has no normalizer; re-use training one for fairness
+    it2 = IrisDataSetIterator(batch_size=150)
+    it2.setPreProcessor(norm)
+    e = net.evaluate(it2)
+    assert e.accuracy() > 0.9
+
+
+def test_score_and_listeners_called():
+    calls = []
+
+    class Listener:
+        def iterationDone(self, model, iteration, epoch):
+            calls.append((iteration, epoch))
+
+    it = IrisDataSetIterator(batch_size=75)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    net.setListeners(Listener())
+    net.fit(it, epochs=2)
+    assert len(calls) == 4  # 2 batches x 2 epochs
+    assert isinstance(net.score(), float)
+
+
+def test_lenet_learns_synthetic_mnist():
+    """The round-1 minimum slice: LeNet-style CNN on (synthetic) MNIST via
+    the reference's exact builder idiom (dl4j-examples LenetMnistExample)."""
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(123)
+            .updater(Nesterovs(0.05, 0.9))
+            .weightInit(WeightInit.XAVIER)
+            .list()
+            .layer(ConvolutionLayer.Builder(5, 5)
+                   .stride(1, 1).nOut(8).activation(Activation.IDENTITY).build())
+            .layer(SubsamplingLayer.Builder("max")
+                   .kernelSize(2, 2).stride(2, 2).build())
+            .layer(ConvolutionLayer.Builder(5, 5)
+                   .stride(1, 1).nOut(16).activation(Activation.IDENTITY).build())
+            .layer(SubsamplingLayer.Builder("max")
+                   .kernelSize(2, 2).stride(2, 2).build())
+            .layer(DenseLayer.Builder().activation(Activation.RELU)
+                   .nOut(64).build())
+            .layer(OutputLayer.Builder(LossFunction.NEGATIVELOGLIKELIHOOD)
+                   .nOut(10).activation(Activation.SOFTMAX).build())
+            .setInputType(InputType.convolutionalFlat(28, 28, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    train = MnistDataSetIterator(64, train=True, num_examples=512)
+    test = MnistDataSetIterator(256, train=False, num_examples=256)
+    net.fit(train, epochs=3)
+    acc = net.evaluate(test).accuracy()
+    assert acc > 0.9, f"LeNet synthetic-MNIST accuracy {acc}"
+
+
+def test_batchnorm_updates_running_stats():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer.Builder().nOut(8).activation("relu").build())
+            .layer(BatchNormalization.Builder().build())
+            .layer(OutputLayer.Builder("mcxent").nOut(2)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    before = np.array(net._state["1"]["mean"])
+    x = np.random.default_rng(0).standard_normal((32, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.random.default_rng(1).integers(0, 2, 32)]
+    net.fit(x, y)
+    after = np.array(net._state["1"]["mean"])
+    assert not np.allclose(before, after)
+
+
+def test_setparams_roundtrip():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    flat = net.params().numpy()
+    net2 = MultiLayerNetwork(_mlp_conf(seed=7)).init()
+    net2.setParams(flat)
+    np.testing.assert_allclose(net2.params().numpy(), flat)
+    x = np.random.default_rng(2).standard_normal((3, 4)).astype(np.float32)
+    np.testing.assert_allclose(net.output(x).numpy(), net2.output(x).numpy(),
+                               rtol=1e-5)
+
+
+def test_l2_regularization_changes_loss():
+    it = IrisDataSetIterator(batch_size=150)
+    ds = it.next(150)
+    net_plain = MultiLayerNetwork(_mlp_conf(l2=0.0)).init()
+    net_l2 = MultiLayerNetwork(_mlp_conf(l2=0.1)).init()
+    assert net_l2.score(ds) > net_plain.score(ds)
+
+
+def test_dropout_only_at_train_time():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(3).updater(Adam(1e-3)).dropOut(0.5)
+            .list()
+            .layer(DenseLayer.Builder().nOut(32).activation("relu").build())
+            .layer(OutputLayer.Builder("mcxent").nOut(2)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(8))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.ones((4, 8), np.float32)
+    a = net.output(x, train=False).numpy()
+    b = net.output(x, train=False).numpy()
+    np.testing.assert_allclose(a, b)  # inference is deterministic
+
+
+def test_fit_array_signature():
+    net = MultiLayerNetwork(_mlp_conf(n_in=4, n_out=3)).init()
+    x = np.random.default_rng(0).standard_normal((10, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.random.default_rng(1).integers(0, 3, 10)]
+    net.fit(x, y)
+    net.fit(DataSet(x, y))
+    assert net.getIterationCount() == 2
+
+
+def test_summary_prints():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    s = net.summary()
+    assert "DenseLayer" in s and "Total params" in s

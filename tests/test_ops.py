@@ -1,0 +1,162 @@
+"""NDArray / nd factory op tests vs numpy oracles (SURVEY.md §4:
+≡ nd4j-api op tests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import NDArray, nd
+
+
+def test_create_and_shape():
+    a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+    assert a.shape == (2, 2)
+    assert a.rank() == 2
+    assert a.length() == 4
+    assert a.rows() == 2 and a.columns() == 2
+    assert a.isMatrix() and not a.isVector()
+
+
+def test_factory_basics():
+    assert nd.zeros(2, 3).numpy().sum() == 0
+    assert nd.ones(4).numpy().sum() == 4
+    np.testing.assert_allclose(nd.eye(3).numpy(), np.eye(3))
+    np.testing.assert_allclose(nd.linspace(0, 1, 5).numpy(),
+                               np.linspace(0, 1, 5), rtol=1e-6)
+    v = nd.valueArrayOf((3,), 7.0) if False else nd.valueArrayOf(3, 7.0)
+    assert v.numpy().tolist() == [7.0, 7.0, 7.0]
+
+
+def test_arithmetic_matches_numpy():
+    rng = np.random.default_rng(0)
+    a_np = rng.standard_normal((3, 4)).astype(np.float32)
+    b_np = rng.standard_normal((3, 4)).astype(np.float32)
+    a, b = NDArray(a_np), NDArray(b_np)
+    np.testing.assert_allclose(a.add(b).numpy(), a_np + b_np, rtol=1e-6)
+    np.testing.assert_allclose(a.sub(b).numpy(), a_np - b_np, rtol=1e-6)
+    np.testing.assert_allclose(a.mul(b).numpy(), a_np * b_np, rtol=1e-6)
+    np.testing.assert_allclose(a.div(b).numpy(), a_np / b_np, rtol=1e-5)
+    np.testing.assert_allclose((a + 1.0).numpy(), a_np + 1, rtol=1e-6)
+    np.testing.assert_allclose((2.0 * a).numpy(), 2 * a_np, rtol=1e-6)
+    np.testing.assert_allclose(a.rsub(1.0).numpy(), 1 - a_np, rtol=1e-6)
+    np.testing.assert_allclose((-a).numpy(), -a_np, rtol=1e-6)
+
+
+def test_inplace_ops_rebind():
+    a = NDArray([1.0, 2.0])
+    r = a.addi(1.0)
+    assert r is a
+    assert a.numpy().tolist() == [2.0, 3.0]
+    a.muli(2.0).subi(1.0)
+    assert a.numpy().tolist() == [3.0, 5.0]
+    a.assign(0.0)
+    assert a.numpy().tolist() == [0.0, 0.0]
+
+
+def test_mmul():
+    rng = np.random.default_rng(1)
+    a_np = rng.standard_normal((3, 4)).astype(np.float32)
+    b_np = rng.standard_normal((4, 5)).astype(np.float32)
+    out = NDArray(a_np).mmul(NDArray(b_np))
+    np.testing.assert_allclose(out.numpy(), a_np @ b_np, rtol=1e-5)
+
+
+def test_reductions():
+    a_np = np.arange(12, dtype=np.float32).reshape(3, 4)
+    a = NDArray(a_np)
+    assert float(a.sum()) == a_np.sum()
+    np.testing.assert_allclose(a.sum(0).numpy(), a_np.sum(0))
+    np.testing.assert_allclose(a.mean(1).numpy(), a_np.mean(1))
+    np.testing.assert_allclose(a.std(0).numpy(), a_np.std(0, ddof=1), rtol=1e-5)
+    np.testing.assert_allclose(a.var(0, biasCorrected=False).numpy(),
+                               a_np.var(0), rtol=1e-5)
+    assert a.argMax(1).numpy().tolist() == [3, 3, 3]
+    assert float(a.norm1()) == np.abs(a_np).sum()
+    np.testing.assert_allclose(float(a.norm2()), np.linalg.norm(a_np), rtol=1e-5)
+
+
+def test_row_column_broadcast():
+    a_np = np.ones((3, 4), np.float32)
+    row = np.arange(4, dtype=np.float32)
+    col = np.arange(3, dtype=np.float32)
+    a = NDArray(a_np)
+    np.testing.assert_allclose(a.addRowVector(row).numpy(), a_np + row)
+    np.testing.assert_allclose(a.mulColumnVector(col).numpy(),
+                               a_np * col[:, None])
+
+
+def test_indexing_and_put():
+    a = nd.zeros(3, 3)
+    a.putScalar((1, 1), 5.0)
+    assert a.getDouble(1, 1) == 5.0
+    a.putRow(0, [1.0, 2.0, 3.0])
+    assert a.getRow(0).numpy().tolist() == [1.0, 2.0, 3.0]
+    a.putColumn(2, [9.0, 9.0, 9.0])
+    assert a.getColumn(2).numpy().tolist() == [9.0, 9.0, 9.0]
+    sub = a[0:2]
+    assert sub.shape == (2, 3)
+
+
+def test_transforms():
+    x_np = np.linspace(-2, 2, 7).astype(np.float32)
+    x = NDArray(x_np)
+    np.testing.assert_allclose(nd.exp(x).numpy(), np.exp(x_np), rtol=1e-5)
+    # XLA's vectorized tanh approximation differs from libm at ~1e-5 rel
+    np.testing.assert_allclose(nd.tanh(x).numpy(), np.tanh(x_np), rtol=1e-4)
+    np.testing.assert_allclose(nd.relu(x).numpy(), np.maximum(x_np, 0))
+    sm = nd.softmax(NDArray([[1.0, 2.0, 3.0]])).numpy()
+    np.testing.assert_allclose(sm.sum(), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(nd.clip(x, -1, 1).numpy(),
+                               np.clip(x_np, -1, 1))
+
+
+def test_comparisons_and_where():
+    a = NDArray([1.0, -2.0, 3.0])
+    assert a.gt(0).numpy().tolist() == [True, False, True]
+    w = nd.where(a.gt(0), a, nd.zerosLike(a))
+    assert w.numpy().tolist() == [1.0, 0.0, 3.0]
+
+
+def test_concat_stack():
+    a, b = nd.ones(2, 3), nd.zeros(2, 3)
+    assert nd.concat(0, a, b).shape == (4, 3)
+    assert nd.concat(1, a, b).shape == (2, 6)
+    assert nd.stack(0, a, b).shape == (2, 2, 3)
+    assert nd.vstack(a, b).shape == (4, 3)
+    assert nd.hstack(a, b).shape == (2, 6)
+
+
+def test_onehot_gather():
+    oh = nd.oneHot([0, 2, 1], 3)
+    np.testing.assert_allclose(oh.numpy(),
+                               [[1, 0, 0], [0, 0, 1], [0, 1, 0]])
+    g = nd.gather(nd.create([[1.0, 2], [3, 4], [5, 6]]), [2, 0], axis=0)
+    np.testing.assert_allclose(g.numpy(), [[5, 6], [1, 2]])
+
+
+def test_random_deterministic():
+    nd.setSeed(42)
+    a = nd.rand(3, 3).numpy()
+    nd.setSeed(42)
+    b = nd.rand(3, 3).numpy()
+    np.testing.assert_allclose(a, b)
+    assert 0.0 <= a.min() and a.max() <= 1.0
+
+
+def test_dtype_cast():
+    a = nd.ones(2, 2).castTo("bfloat16")
+    assert str(a.dtype) == "bfloat16"
+    b = a.castTo("float32")
+    assert b.numpy().dtype == np.float32
+
+
+def test_equals_with_eps():
+    a = NDArray([1.0, 2.0])
+    b = NDArray([1.0, 2.0 + 1e-7])
+    assert a.equalsWithEps(b, 1e-5)
+    assert not a.equalsWithEps(NDArray([1.0, 3.0]), 1e-5)
+
+
+def test_cosine_and_distances():
+    a, b = NDArray([1.0, 0.0]), NDArray([0.0, 1.0])
+    assert abs(nd.cosineSim(a, b)) < 1e-6
+    assert abs(nd.euclideanDistance(a, b) - np.sqrt(2)) < 1e-6
+    assert abs(nd.manhattanDistance(a, b) - 2.0) < 1e-6

@@ -1,0 +1,144 @@
+"""Recurrent layer tests (SURVEY.md §4; ≡ deeplearning4j-core
+GravesLSTMTest / BidirectionalTest / TestRnnLayers)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.nn import (Adam, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.builders import BackpropType
+from deeplearning4j_tpu.nn.conf.recurrent import (Bidirectional, GravesLSTM,
+                                                  LSTM, LastTimeStep,
+                                                  RnnOutputLayer, SimpleRnn)
+
+
+def _rnn_conf(cell, n_in=5, n_hidden=8, n_out=4, seed=12, **list_kw):
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed).updater(Adam(5e-3))
+         .list()
+         .layer(cell)
+         .layer(RnnOutputLayer.Builder("mcxent").nOut(n_out)
+                .activation("softmax").build())
+         .setInputType(InputType.recurrent(n_in)))
+    for k, v in list_kw.items():
+        getattr(b, k)(v)
+    return b.build()
+
+
+def test_lstm_shapes():
+    conf = _rnn_conf(LSTM.Builder().nOut(8).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).standard_normal((3, 7, 5)).astype(np.float32)
+    out = net.output(x).numpy()
+    assert out.shape == (3, 7, 4)
+    np.testing.assert_allclose(out.sum(-1), np.ones((3, 7)), rtol=1e-5)
+    # params: W (5,32) + U (8,32) + b (32)
+    assert net._params["0"]["W"].shape == (5, 32)
+    assert net._params["0"]["U"].shape == (8, 32)
+
+
+def test_graves_lstm_has_peepholes():
+    conf = _rnn_conf(GravesLSTM.Builder().nOut(8).build())
+    net = MultiLayerNetwork(conf).init()
+    p = net._params["0"]
+    assert p["pI"].shape == (8,) and p["pF"].shape == (8,) and p["pO"].shape == (8,)
+    x = np.zeros((2, 4, 5), np.float32)
+    assert net.output(x).shape == (2, 4, 4)
+
+
+def test_lstm_masking_zeroes_and_holds():
+    conf = _rnn_conf(LSTM.Builder().nOut(6).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(1).standard_normal((2, 5, 5)).astype(np.float32)
+    mask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+    layer = net.layers[0]
+    y, carry = layer.scan_apply(net._params["0"], x, None, mask)
+    y = np.asarray(y)
+    # masked timesteps output zero
+    np.testing.assert_allclose(y[0, 3:], 0.0, atol=1e-6)
+    # carry holds value from last valid step: rerun truncated
+    y2, carry2 = layer.scan_apply(net._params["0"], x[:1, :3], None)
+    np.testing.assert_allclose(np.asarray(carry[0])[0],
+                               np.asarray(carry2[0])[0], rtol=1e-5)
+
+
+def test_lstm_learns_sequence_task():
+    """Classify by which half of the sequence has larger mean — needs
+    temporal integration."""
+    rng = np.random.default_rng(0)
+    n, t, f = 128, 8, 5
+    x = rng.standard_normal((n, t, f)).astype(np.float32)
+    sig = (x[:, :4].mean((1, 2)) > x[:, 4:].mean((1, 2))).astype(np.int64)
+    y = np.zeros((n, t, 2), np.float32)
+    y[np.arange(n), :, :] = np.eye(2, dtype=np.float32)[sig][:, None, :]
+    lmask = np.zeros((n, t), np.float32)
+    lmask[:, -1] = 1.0  # score only the last step
+    ds = DataSet(x, y, labelsMask=lmask)
+    conf = _rnn_conf(LSTM.Builder().nOut(16).build(), n_in=5, n_out=2)
+    net = MultiLayerNetwork(conf).init()
+    first = net.score(ds)
+    for _ in range(80):
+        net.fit(ds)
+    assert net.score(ds) < first * 0.5
+
+
+def test_bidirectional_concat_doubles_features():
+    conf = _rnn_conf(
+        Bidirectional(LSTM.Builder().nOut(6).build(), mode="concat"))
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(2).standard_normal((2, 4, 5)).astype(np.float32)
+    # output layer nIn must be 12
+    assert net.layers[1].nIn == 12
+    assert net.output(x).shape == (2, 4, 4)
+
+
+def test_bidirectional_add_mode():
+    conf = _rnn_conf(
+        Bidirectional(SimpleRnn.Builder().nOut(6).build(), mode="add"))
+    net = MultiLayerNetwork(conf).init()
+    assert net.layers[1].nIn == 6
+    x = np.zeros((1, 3, 5), np.float32)
+    assert net.output(x).shape == (1, 3, 4)
+
+
+def test_last_time_step_wrapper():
+    from deeplearning4j_tpu.nn import OutputLayer
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(4).updater(Adam(1e-3))
+            .list()
+            .layer(LastTimeStep(LSTM.Builder().nOut(6).build()))
+            .layer(OutputLayer.Builder("mcxent").nOut(3)
+                   .activation("softmax").build())
+            .setInputType(InputType.recurrent(5))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(3).standard_normal((4, 9, 5)).astype(np.float32)
+    out = net.output(x).numpy()
+    assert out.shape == (4, 3)
+
+
+def test_rnn_time_step_stateful():
+    conf = _rnn_conf(LSTM.Builder().nOut(6).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(5).standard_normal((2, 4, 5)).astype(np.float32)
+    full = net.output(x).numpy()
+    net.rnnClearPreviousState()
+    stepped = []
+    for t in range(4):
+        stepped.append(net.rnnTimeStep(x[:, t, :]).numpy())
+    stepped = np.stack(stepped, axis=1)
+    np.testing.assert_allclose(stepped, full, rtol=1e-4, atol=1e-5)
+
+
+def test_tbptt_fit_runs():
+    conf = _rnn_conf(LSTM.Builder().nOut(6).build(), n_out=4,
+                     backpropType=BackpropType.TruncatedBPTT,
+                     tBPTTLength=4)
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((2, 12, 5)).astype(np.float32)
+    y = np.zeros((2, 12, 4), np.float32)
+    y[..., 0] = 1.0
+    net.fit(DataSet(x, y))
+    assert net.score() is not None
+    assert net.getIterationCount() == 1

@@ -1,0 +1,131 @@
+"""Native C++ runtime tests (SURVEY.md §4; ≡ libnd4j/DataVec native
+pipeline coverage): parity of native vs pure-python paths."""
+import os
+import struct
+import tempfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.runtime import native_lib
+
+
+pytestmark = pytest.mark.skipif(not native_lib.available(),
+                                reason="native toolchain unavailable")
+
+
+def _write_idx_u8(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.tobytes())
+
+
+def test_idx_read_native_matches_python():
+    arr = (np.arange(2 * 5 * 5) % 256).astype(np.uint8).reshape(2, 5, 5)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "t-images-idx3-ubyte")
+        _write_idx_u8(p, arr)
+        got = native_lib.idx_read(p)
+        np.testing.assert_array_equal(got, arr)
+        from deeplearning4j_tpu.datasets.iterators import _read_idx
+        np.testing.assert_array_equal(_read_idx(p), arr)
+
+
+def test_gather_batch_scales():
+    arch = (np.arange(6 * 4) % 256).astype(np.uint8).reshape(6, 4)
+    out = native_lib.gather_batch_u8(arch, [5, 1, 3], scale=1 / 255.0)
+    np.testing.assert_allclose(out, arch[[5, 1, 3]].astype(np.float32) / 255,
+                               rtol=1e-6)
+    out2 = native_lib.gather_batch_u8(arch, [0], scale=2.0, bias=-1.0)
+    np.testing.assert_allclose(out2, arch[[0]].astype(np.float32) * 2 - 1,
+                               rtol=1e-6)
+
+
+def test_one_hot():
+    labels = np.array([3, 1, 0, 2], np.uint8)
+    oh = native_lib.one_hot_u8(labels, [0, 3], 4)
+    np.testing.assert_allclose(oh, [[0, 0, 0, 1], [0, 0, 1, 0]])
+
+
+def test_standardize_inplace():
+    data = np.random.default_rng(0).standard_normal((8, 3)).astype(np.float32)
+    mean = data.mean(0).astype(np.float32)
+    std = data.std(0).astype(np.float32)
+    want = (data - mean) / std
+    native_lib.standardize_inplace(data, mean, std)
+    np.testing.assert_allclose(data, want, rtol=1e-5)
+
+
+def test_arena_alloc_reset():
+    a = native_lib.NativeArena(1 << 16)
+    b1 = a.alloc_f32((16,))
+    b1[:] = 7.0
+    used1 = a.used()
+    assert used1 >= 64
+    a.reset()
+    assert a.used() == 0
+    b2 = a.alloc_f32((16,))
+    # same memory reused after reset
+    assert b2.__array_interface__["data"][0] == b1.__array_interface__["data"][0]
+    a.close()
+
+
+def test_arena_overflow_falls_back():
+    a = native_lib.NativeArena(256)
+    big = a.alloc_f32((1024,))  # larger than arena: heap fallback
+    big[:] = 1.0
+    assert big.shape == (1024,)
+    a.close()
+
+
+def test_ring_buffer_roundtrip():
+    import ctypes
+    lib = native_lib.get_lib()
+    ring = lib.dl4j_ring_create(4)
+    bufs = []
+    for i in range(3):
+        buf = ctypes.create_string_buffer(8)
+        ctypes.memset(buf, 65 + i, 8)
+        bufs.append(buf)
+        assert lib.dl4j_ring_push(ring, ctypes.cast(buf, ctypes.c_void_p), 8) == 0
+    assert lib.dl4j_ring_size(ring) == 3
+    out = ctypes.c_void_p()
+    n = lib.dl4j_ring_pop(ring, ctypes.byref(out))
+    assert n == 8
+    got = ctypes.string_at(out, 8)
+    assert got == b"A" * 8
+    lib.dl4j_ring_close(ring)
+    # drain remaining then closed → -1 (after queue empties)
+    lib.dl4j_ring_pop(ring, ctypes.byref(out))
+    lib.dl4j_ring_pop(ring, ctypes.byref(out))
+    assert lib.dl4j_ring_pop(ring, ctypes.byref(out)) == -1
+    # NOTE: ring intentionally not destroyed — dl4j_ring_destroy frees
+    # queued buffers with free(), and these are python-owned.
+
+
+def test_workspace_scope():
+    from deeplearning4j_tpu.runtime.workspace import Nd4jWorkspace
+    with Nd4jWorkspace("TEST") as ws:
+        buf = ws.alloc((32, 32))
+        buf[:] = 1.0
+        assert ws.bytes_used() >= 32 * 32 * 4
+    assert ws.bytes_used() == 0
+    ws.close()
+
+
+def test_executioner_profiling():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.runtime.executioner import OpExecutioner
+    ex = OpExecutioner.getInstance()
+    ex.setProfilingMode(True)
+
+    def square_sum(x):
+        return jnp.sum(x * x)
+
+    out = ex.exec(square_sum, jnp.ones(8))
+    assert float(out) == 8.0
+    stats = ex.getProfilingStats()
+    assert stats["square_sum"]["count"] >= 1
+    ex.setProfilingMode(False)

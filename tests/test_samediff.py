@@ -1,0 +1,129 @@
+"""SameDiff graph engine tests (SURVEY.md §4; ≡ nd4j autodiff
+SameDiffTests)."""
+import numpy as np
+
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.nn import Adam
+
+
+def test_basic_graph_exec():
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", (None, 3))
+    w = sd.var("w", np.ones((3, 2), np.float32))
+    b = sd.var("b", np.zeros((2,), np.float32))
+    y = sd.nn.softmax(x.mmul(w).add(b))
+    y.rename("y")
+    out = sd.output({"x": np.ones((4, 3), np.float32)}, ["y"])["y"].numpy()
+    assert out.shape == (4, 2)
+    np.testing.assert_allclose(out.sum(-1), np.ones(4), rtol=1e-5)
+
+
+def test_math_ops_match_numpy():
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", (None,))
+    y = sd.math.exp(x).add(sd.math.log(sd.math.abs(x).add(1.0)))
+    y.rename("out")
+    arr = np.linspace(-1, 1, 5).astype(np.float32)
+    got = sd.output({"x": arr}, ["out"])["out"].numpy()
+    want = np.exp(arr) + np.log(np.abs(arr) + 1)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_reductions_and_operators():
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", (None, 4))
+    s = (x * 2.0 + 1.0).sum(1)
+    s.rename("s")
+    arr = np.ones((3, 4), np.float32)
+    got = sd.output({"x": arr}, ["s"])["s"].numpy()
+    np.testing.assert_allclose(got, np.full(3, 12.0))
+
+
+def test_calculate_gradients():
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", (None, 2))
+    w = sd.var("w", np.array([[1.0], [2.0]], np.float32))
+    pred = x.mmul(w)
+    labels = sd.placeHolder("labels", (None, 1))
+    loss = sd.loss.meanSquaredError("loss", labels, pred)
+    sd.setLossVariables("loss")
+    xs = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    ys = np.array([[2.0], [1.0]], np.float32)
+    grads = sd.calculateGradients({"x": xs, "labels": ys}, "w")
+    # d/dw mean((xw - y)^2) = 2/N * x^T (xw - y)
+    resid = xs @ np.array([[1.0], [2.0]]) - ys
+    want = 2.0 / 2 * xs.T @ resid
+    np.testing.assert_allclose(grads["w"].numpy(), want, rtol=1e-5)
+
+
+def test_training_linear_regression():
+    rng = np.random.default_rng(0)
+    true_w = np.array([[2.0], [-3.0], [0.5]], np.float32)
+    xs = rng.standard_normal((128, 3)).astype(np.float32)
+    ys = xs @ true_w + 0.01 * rng.standard_normal((128, 1)).astype(np.float32)
+
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", (None, 3))
+    labels = sd.placeHolder("labels", (None, 1))
+    w = sd.var("w", np.zeros((3, 1), np.float32))
+    b = sd.var("b", np.zeros((1,), np.float32))
+    pred = x.mmul(w).add(b)
+    sd.loss.meanSquaredError("loss", labels, pred)
+    sd.setLossVariables("loss")
+    sd.setTrainingConfig(TrainingConfig.Builder()
+                         .updater(Adam(0.1))
+                         .dataSetFeatureMapping("x")
+                         .dataSetLabelMapping("labels")
+                         .build())
+    ds = DataSet(xs, ys)
+    losses = [sd.fit(ds) for _ in range(100)]
+    assert losses[-1] < 0.05 * losses[0]
+    np.testing.assert_allclose(sd.getVariable("w").getArr().numpy(), true_w,
+                               atol=0.15)
+
+
+def test_layernorm_op():
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", (None, 8))
+    g = sd.var("g", np.ones(8, np.float32))
+    b = sd.var("b", np.zeros(8, np.float32))
+    y = sd.nn.layerNorm(x, g, b)
+    y.rename("y")
+    arr = np.random.default_rng(1).standard_normal((4, 8)).astype(np.float32)
+    out = sd.output({"x": arr}, ["y"])["y"].numpy()
+    np.testing.assert_allclose(out.mean(-1), np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), np.ones(4), atol=1e-2)
+
+
+def test_constants_not_trained():
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", (None, 2))
+    c = sd.constant("c", np.ones((2, 2), np.float32))
+    w = sd.var("w", np.ones((2, 2), np.float32))
+    pred = x.mmul(c).mmul(w)
+    labels = sd.placeHolder("labels", (None, 2))
+    sd.loss.meanSquaredError("loss", labels, pred)
+    sd.setLossVariables("loss")
+    sd.setTrainingConfig(TrainingConfig.Builder().updater(Adam(0.05))
+                         .dataSetFeatureMapping("x")
+                         .dataSetLabelMapping("labels").build())
+    ds = DataSet(np.ones((4, 2), np.float32), np.zeros((4, 2), np.float32))
+    for _ in range(5):
+        sd.fit(ds)
+    np.testing.assert_allclose(sd.getVariable("c").getArr().numpy(),
+                               np.ones((2, 2)))  # constant untouched
+    assert not np.allclose(sd.getVariable("w").getArr().numpy(),
+                           np.ones((2, 2)))     # variable trained
+
+
+def test_save_load_values(tmp_path):
+    sd = SameDiff.create()
+    w = sd.var("w", np.arange(4, dtype=np.float32).reshape(2, 2))
+    p = str(tmp_path / "sd.bin")
+    sd.save(p)
+    sd2 = SameDiff.create()
+    sd2.var("w", np.zeros((2, 2), np.float32))
+    sd2.load_values(p)
+    np.testing.assert_allclose(sd2.getVariable("w").getArr().numpy(),
+                               np.arange(4).reshape(2, 2))
