@@ -86,6 +86,15 @@ class ComputationGraph:
             self._tx = optax.multi_transform(transforms, labels)
         self._opt_state = self._tx.init(self._params)
 
+    def clone(self):
+        m = ComputationGraph(self.conf)
+        if self._params is not None:
+            # real copies — the live net's jitted train step donates buffers
+            m._params = jax.tree_util.tree_map(jnp.copy, self._params)
+            m._state = jax.tree_util.tree_map(jnp.copy, self._state)
+            m._build_optimizer()
+        return m
+
     # -- parameters ------------------------------------------------------
     def numParams(self):
         return sum(int(np.prod(l.shape))
@@ -134,6 +143,8 @@ class ComputationGraph:
                 acts[name] = node.ref.apply(*parents, mask=pmask)
                 continue
             layer = node.ref
+            # frozen layers (transfer learning) always run inference-mode
+            ltrain = train and not getattr(layer, "frozen", False)
             x = parents[0]
             if node.preprocessor is not None:
                 x = node.preprocessor.preProcess(x)
@@ -142,12 +153,12 @@ class ComputationGraph:
             p = params.get(name, {})
             s = state.get(name, {})
             if name in self.conf.output_names and hasattr(layer, "compute_loss"):
-                pre = layer.pre_activation(p, layer._dropout_in(x, train, lrng))
+                pre = layer.pre_activation(p, layer._dropout_in(x, ltrain, lrng))
                 preacts[name] = pre
                 from deeplearning4j_tpu.nn.activations import get_activation
                 acts[name] = get_activation(layer.activation)(pre)
             else:
-                y, ns = layer.apply(p, s, x, train=train, rng=lrng, mask=mask0)
+                y, ns = layer.apply(p, s, x, train=ltrain, rng=lrng, mask=mask0)
                 acts[name] = y
                 if ns:
                     new_state[name] = ns

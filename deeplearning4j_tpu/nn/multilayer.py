@@ -155,6 +155,9 @@ class MultiLayerNetwork:
         preact = None
         n = len(self.layers) if stop_at is None else stop_at
         for i, layer in enumerate(self.layers[:n]):
+            # frozen layers (transfer learning) always run inference-mode:
+            # no dropout, batch-norm running stats pinned (≡ FrozenLayer)
+            ltrain = train and not getattr(layer, "frozen", False)
             pp = self.conf.preprocessors.get(i)
             if pp is not None:
                 x = pp.preProcess(x)
@@ -165,16 +168,16 @@ class MultiLayerNetwork:
             s = state.get(str(i), {})
             if i == len(self.layers) - 1 and hasattr(layer, "compute_loss") \
                     and hasattr(layer, "pre_activation"):
-                preact = layer.pre_activation(p, layer._dropout_in(x, train, lrng))
+                preact = layer.pre_activation(p, layer._dropout_in(x, ltrain, lrng))
                 from deeplearning4j_tpu.nn.activations import get_activation
                 x = get_activation(layer.activation)(preact)
             elif carries is not None and getattr(layer, "is_recurrent", False) \
                     and hasattr(layer, "scan_apply"):
-                x = layer._dropout_in(x, train, lrng)
+                x = layer._dropout_in(x, ltrain, lrng)
                 x, carry = layer.scan_apply(p, x, carries.get(str(i)), mask)
                 new_carries[str(i)] = carry
             else:
-                x, ns = layer.apply(p, s, x, train=train, rng=lrng, mask=mask)
+                x, ns = layer.apply(p, s, x, train=ltrain, rng=lrng, mask=mask)
                 if ns:
                     new_state[str(i)] = ns
             if collect:
@@ -444,8 +447,10 @@ class MultiLayerNetwork:
         import copy
         m = MultiLayerNetwork(self.conf)
         if self._params is not None:
-            m._params = jax.tree_util.tree_map(lambda v: v, self._params)
-            m._state = jax.tree_util.tree_map(lambda v: v, self._state)
+            # materialize real copies: the live net's jitted train step
+            # DONATES its param buffers, which would delete shared arrays
+            m._params = jax.tree_util.tree_map(jnp.copy, self._params)
+            m._state = jax.tree_util.tree_map(jnp.copy, self._state)
             m._build_optimizer()
         return m
 

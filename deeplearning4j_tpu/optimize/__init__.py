@@ -1,0 +1,15 @@
+"""Training-loop surround: listeners, early stopping
+(≡ deeplearning4j-nn optimize.listeners + deeplearning4j-core earlystopping)."""
+from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
+    CheckpointListener, CollectScoresListener, EvaluativeListener,
+    PerformanceListener, ScoreIterationListener, TimeIterationListener,
+    TrainingListener)
+from deeplearning4j_tpu.optimize.early_stopping import (  # noqa: F401
+    BestScoreEpochTerminationCondition, ClassificationScoreCalculator,
+    DataSetLossCalculator, EarlyStoppingConfiguration,
+    EarlyStoppingGraphTrainer, EarlyStoppingResult, EarlyStoppingTrainer,
+    InMemoryModelSaver, InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver, MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition, ROCScoreCalculator,
+    ScoreImprovementEpochTerminationCondition, TerminationReason)

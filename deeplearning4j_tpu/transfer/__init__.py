@@ -1,0 +1,3 @@
+"""Transfer learning (≡ deeplearning4j-nn :: transferlearning)."""
+from deeplearning4j_tpu.transfer.transfer_learning import (  # noqa: F401
+    FineTuneConfiguration, TransferLearning, TransferLearningHelper)

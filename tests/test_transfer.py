@@ -1,0 +1,300 @@
+"""Transfer learning + early stopping tests (≡ deeplearning4j-nn
+TransferLearning*Test, deeplearning4j-core EarlyStoppingTest)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.nn import (Activation, Adam, DenseLayer, InputType,
+                                   LossFunction, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer, Sgd,
+                                   WeightInit)
+from deeplearning4j_tpu.optimize import (
+    ClassificationScoreCalculator, DataSetLossCalculator,
+    EarlyStoppingConfiguration, EarlyStoppingTrainer, InMemoryModelSaver,
+    MaxEpochsTerminationCondition, MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition, TerminationReason)
+from deeplearning4j_tpu.transfer import (FineTuneConfiguration,
+                                         TransferLearning,
+                                         TransferLearningHelper)
+
+
+def _net(n_out=3, seed=7, updater=None):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed)
+            .updater(updater or Adam(1e-2))
+            .weightInit(WeightInit.XAVIER)
+            .activation(Activation.RELU)
+            .list()
+            .layer(DenseLayer.Builder().nOut(16).build())
+            .layer(DenseLayer.Builder().nOut(16).build())
+            .layer(OutputLayer.Builder(LossFunction.MCXENT)
+                   .nOut(n_out).activation(Activation.SOFTMAX).build())
+            .setInputType(InputType.feedForward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _toy_data(n=64, n_classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 4)).astype(np.float32)
+    labels = (x[:, 0] > 0).astype(int) + (x[:, 1] > 0).astype(int)
+    y = np.eye(n_classes, dtype=np.float32)[labels]
+    return DataSet(x, y)
+
+
+def _tree_equal(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(a.values(), b.values()))
+
+
+class TestTransferLearning:
+    def test_feature_extractor_freezes_params(self):
+        src = _net()
+        ds = _toy_data()
+        net = (TransferLearning.Builder(src)
+               .fineTuneConfiguration(
+                   FineTuneConfiguration.Builder().updater(Sgd(0.5)).build())
+               .setFeatureExtractor(1)       # freeze layers 0 and 1
+               .build())
+        p0_before = {k: np.asarray(v) for k, v in net._params["0"].items()}
+        p1_before = {k: np.asarray(v) for k, v in net._params["1"].items()}
+        p2_before = {k: np.asarray(v) for k, v in net._params["2"].items()}
+        for _ in range(3):
+            net.fit(ds)
+        assert _tree_equal(p0_before, net._params["0"])
+        assert _tree_equal(p1_before, net._params["1"])
+        assert not _tree_equal(p2_before, net._params["2"])
+
+    def test_transferred_params_are_shared(self):
+        src = _net()
+        net = (TransferLearning.Builder(src)
+               .setFeatureExtractor(0)
+               .build())
+        for li in ("0", "1", "2"):
+            for k in src._params[li]:
+                np.testing.assert_array_equal(
+                    np.asarray(src._params[li][k]),
+                    np.asarray(net._params[li][k]))
+
+    def test_nout_replace(self):
+        src = _net(n_out=3)
+        net = (TransferLearning.Builder(src)
+               .setFeatureExtractor(0)
+               .nOutReplace(1, 8, WeightInit.XAVIER)
+               .build())
+        assert net._params["1"]["W"].shape == (16, 8)
+        # next layer re-inferred nIn
+        assert net._params["2"]["W"].shape == (8, 3)
+        out = net.output(np.zeros((2, 4), np.float32)).numpy()
+        assert out.shape == (2, 3)
+
+    def test_remove_and_add_output_layer(self):
+        src = _net(n_out=3)
+        net = (TransferLearning.Builder(src)
+               .setFeatureExtractor(1)
+               .removeOutputLayer()
+               .addLayer(OutputLayer.Builder(LossFunction.MCXENT)
+                         .nIn(16).nOut(5).activation(Activation.SOFTMAX)
+                         .build())
+               .build())
+        out = net.output(np.zeros((2, 4), np.float32)).numpy()
+        assert out.shape == (2, 5)
+        net.fit(_toy_data(n_classes=5))
+
+    def test_frozen_training_still_learns_head(self):
+        src = _net()
+        ds = _toy_data(n=128)
+        net = (TransferLearning.Builder(src)
+               .fineTuneConfiguration(
+                   FineTuneConfiguration.Builder().updater(Adam(5e-2)).build())
+               .setFeatureExtractor(0)
+               .build())
+        first = None
+        for _ in range(30):
+            net.fit(ds)
+            if first is None:
+                first = net.score()
+        assert net.score() < first
+
+    def test_helper_featurize_path(self):
+        src = _net()
+        net = (TransferLearning.Builder(src)
+               .setFeatureExtractor(0)
+               .build())
+        helper = TransferLearningHelper(net)
+        ds = _toy_data()
+        fds = helper.featurize(ds)
+        assert fds.features.shape == (64, 16)
+        before = {k: np.asarray(v) for k, v in net._params["2"].items()}
+        helper.fitFeaturized(fds)
+        assert not _tree_equal(before, net._params["2"])
+        # featurized output == full-network output after write-back
+        full = net.output(ds.features).numpy()
+        sub = helper.outputFromFeaturized(fds.features).numpy()
+        np.testing.assert_allclose(full, sub, rtol=2e-3, atol=2e-5)
+
+    def test_source_net_survives_transfer_training(self):
+        """Regression: params are copied, not shared — the new net's donated
+        train step must not delete the source net's buffers."""
+        src = _net()
+        ds = _toy_data()
+        net = (TransferLearning.Builder(src)
+               .setFeatureExtractor(0)
+               .build())
+        net.fit(ds)
+        out = src.output(np.zeros((2, 4), np.float32)).numpy()  # must not raise
+        assert out.shape == (2, 3)
+        src.fit(ds)
+        out2 = net.output(np.zeros((2, 4), np.float32)).numpy()
+        assert out2.shape == (2, 3)
+
+    def test_requires_initialized_network(self):
+        conf = _net().conf
+        uninit = MultiLayerNetwork(conf)
+        with pytest.raises(ValueError, match="initialized"):
+            TransferLearning.Builder(uninit)
+
+
+class TestTransferLearningGraph:
+    def _graph(self):
+        from deeplearning4j_tpu.nn.conf.graph_vertices import MergeVertex
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(7).updater(Adam(1e-2)).activation("relu")
+                .graphBuilder()
+                .addInputs("in")
+                .addLayer("d1", DenseLayer.Builder().nOut(8).build(), "in")
+                .addLayer("d2", DenseLayer.Builder().nOut(8).build(), "in")
+                .addVertex("merge", MergeVertex(), "d1", "d2")
+                .addLayer("out", OutputLayer.Builder(LossFunction.MCXENT)
+                          .nOut(3).activation("softmax").build(), "merge")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(4))
+                .build())
+        return ComputationGraph(conf).init()
+
+    def test_nout_replace_through_vertex(self):
+        """nOutReplace must re-infer nIn of consumers connected through a
+        parameterless graph vertex (merge), not just direct children."""
+        g = self._graph()
+        g2 = (TransferLearning.GraphBuilder(g)
+              .nOutReplace("d1", 6, WeightInit.XAVIER)
+              .build())
+        assert g2._params["d1"]["W"].shape == (4, 6)
+        # merge output is 6+8=14 → out re-inferred
+        assert g2._params["out"]["W"].shape == (14, 3)
+        out = g2.output(np.zeros((2, 4), np.float32)).numpy()
+        assert out.shape == (2, 3)
+
+    def test_freeze_and_train_graph(self):
+        g = self._graph()
+        ds = _toy_data()
+        g2 = (TransferLearning.GraphBuilder(g)
+              .setFeatureExtractor("merge")
+              .build())
+        d1_before = {k: np.asarray(v) for k, v in g2._params["d1"].items()}
+        for _ in range(3):
+            g2.fit(ds)
+        assert _tree_equal(d1_before, g2._params["d1"])
+        # source graph unharmed (copies, not shared donated buffers)
+        out = g.output(np.zeros((2, 4), np.float32)).numpy()
+        assert out.shape == (2, 3)
+
+    def test_remove_vertex_and_rewire(self):
+        g = self._graph()
+        g2 = (TransferLearning.GraphBuilder(g)
+              .removeVertexAndConnections("out")
+              .addLayer("newOut",
+                        OutputLayer.Builder(LossFunction.MCXENT)
+                        .nIn(16).nOut(5).activation("softmax").build(),
+                        "merge")
+              .setOutputs("newOut")
+              .build())
+        out = g2.output(np.zeros((2, 4), np.float32)).numpy()
+        assert out.shape == (2, 5)
+
+
+class TestEarlyStopping:
+    def _iter(self, n=64, batch=32):
+        ds = _toy_data(n)
+        return ArrayDataSetIterator(ds.features, ds.labels, batch)
+
+    def test_max_epochs_terminates(self):
+        net = _net()
+        es = (EarlyStoppingConfiguration.Builder()
+              .epochTerminationConditions(MaxEpochsTerminationCondition(3))
+              .scoreCalculator(DataSetLossCalculator(self._iter(), True))
+              .modelSaver(InMemoryModelSaver())
+              .build())
+        result = EarlyStoppingTrainer(es, net, self._iter()).fit()
+        assert result.terminationReason == \
+            TerminationReason.EpochTerminationCondition
+        assert "MaxEpochs" in result.terminationDetails
+        assert result.totalEpochs == 3
+        assert result.bestModel is not None
+        assert len(result.scoreVsEpoch) == 3
+
+    def test_score_improvement_stops_when_stuck(self):
+        # LR=0 → score can never improve → stops after patience epochs
+        net = _net(updater=Sgd(0.0))
+        es = (EarlyStoppingConfiguration.Builder()
+              .epochTerminationConditions(
+                  MaxEpochsTerminationCondition(50),
+                  ScoreImprovementEpochTerminationCondition(2))
+              .scoreCalculator(DataSetLossCalculator(self._iter(), True))
+              .build())
+        result = EarlyStoppingTrainer(es, net, self._iter()).fit()
+        assert result.terminationReason == \
+            TerminationReason.EpochTerminationCondition
+        assert "ScoreImprovement" in result.terminationDetails
+        assert result.totalEpochs < 50
+
+    def test_iteration_condition_divergence_guard(self):
+        net = _net()
+        es = (EarlyStoppingConfiguration.Builder()
+              .iterationTerminationConditions(
+                  MaxScoreIterationTerminationCondition(1e-9))
+              .epochTerminationConditions(MaxEpochsTerminationCondition(5))
+              .build())
+        result = EarlyStoppingTrainer(es, net, self._iter()).fit()
+        assert result.terminationReason == \
+            TerminationReason.IterationTerminationCondition
+
+    def test_max_epochs_no_overshoot_with_sparse_eval(self):
+        """Regression: MaxEpochs is score-free and must fire on schedule
+        even when the score calculator only runs every N epochs."""
+        net = _net()
+        es = (EarlyStoppingConfiguration.Builder()
+              .epochTerminationConditions(MaxEpochsTerminationCondition(3))
+              .scoreCalculator(DataSetLossCalculator(self._iter(), True))
+              .evaluateEveryNEpochs(5)
+              .build())
+        result = EarlyStoppingTrainer(es, net, self._iter()).fit()
+        assert result.totalEpochs == 3
+
+    def test_best_model_survives_further_training(self):
+        """Regression: InMemoryModelSaver snapshots must deep-copy params —
+        the live net's donated train step must not delete them."""
+        net = _net()
+        saver = InMemoryModelSaver()
+        saver.saveBestModel(net, 0.0)
+        for _ in range(3):
+            net.fit(_toy_data())
+        best = saver.getBestModel()
+        out = best.output(np.zeros((2, 4), np.float32)).numpy()  # must not raise
+        assert out.shape == (2, 3)
+        best.fit(_toy_data())  # snapshot is independently trainable
+
+    def test_best_model_is_tracked(self):
+        net = _net()
+        es = (EarlyStoppingConfiguration.Builder()
+              .epochTerminationConditions(MaxEpochsTerminationCondition(4))
+              .scoreCalculator(
+                  ClassificationScoreCalculator("accuracy", self._iter()))
+              .build())
+        result = EarlyStoppingTrainer(es, net, self._iter()).fit()
+        assert 0.0 <= result.bestModelScore <= 1.0
+        assert result.bestModelEpoch >= 0
+        # best model is usable
+        out = result.bestModel.output(np.zeros((2, 4), np.float32)).numpy()
+        assert out.shape == (2, 3)
