@@ -1,0 +1,93 @@
+"""Pallas fused layer normalisation.
+
+The reference's BatchNormalization/LayerNorm path hands the fused
+normalise-scale-shift to cuDNN (deeplearning4j-cuda ::
+CudnnBatchNormalizationHelper); here the fusion is a single Pallas
+kernel: one HBM read and one write per element, mean/var/normalise/
+affine all in VMEM. Backward is the standard closed-form layernorm
+gradient in plain jnp (XLA fuses it into the surrounding step).
+
+Operates on (..., D); rows are tiled through VMEM in blocks.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    y = xc * inv * g_ref[...].astype(jnp.float32) + b_ref[...].astype(
+        jnp.float32)
+    o_ref[...] = y.astype(o_ref.dtype)
+
+
+def _ln_forward(x, gamma, beta, eps, block_rows, interpret):
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    n = x.size // d
+    x2 = x.reshape(n, d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_rows = min(block_rows, n)
+    pad = (-n) % block_rows
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    g2 = gamma.reshape(1, d)
+    b2 = beta.reshape(1, d)
+    out = pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps),
+        grid=(x2.shape[0] // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x.dtype),
+        interpret=interpret,
+    )(x2, g2, b2)
+    return out[:n].reshape(orig_shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_layernorm(x, gamma, beta, eps=1e-5, block_rows=128, interpret=None):
+    """LayerNorm over the last axis: γ·(x−μ)/√(σ²+ε)+β, one fused kernel."""
+    return _ln_forward(x, gamma, beta, eps, block_rows, interpret)
+
+
+def _ln_fwd_rule(x, gamma, beta, eps, block_rows, interpret):
+    # Under autodiff the residuals (xhat, inv) are needed anyway, so the
+    # output is derived from them in plain jnp — XLA fuses this into the
+    # surrounding train step and the input is read from HBM exactly once.
+    # The Pallas kernel is the no-residual inference path.
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = xc * inv
+    out = (xhat * gamma.astype(jnp.float32)
+           + beta.astype(jnp.float32)).astype(x.dtype)
+    return out, (xhat, inv, gamma)
+
+
+def _ln_bwd_rule(eps, block_rows, interpret, res, g):
+    xhat, inv, gamma = res
+    gf = g.astype(jnp.float32)
+    dg = jnp.sum(gf * xhat, axis=tuple(range(g.ndim - 1)))
+    db = jnp.sum(gf, axis=tuple(range(g.ndim - 1)))
+    wg = gf * gamma.astype(jnp.float32)
+    dx = inv * (wg - jnp.mean(wg, axis=-1, keepdims=True)
+                - xhat * jnp.mean(wg * xhat, axis=-1, keepdims=True))
+    return (dx.astype(g.dtype), dg.astype(gamma.dtype), db.astype(gamma.dtype))
+
+
+fused_layernorm.defvjp(_ln_fwd_rule, _ln_bwd_rule)

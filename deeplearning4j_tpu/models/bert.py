@@ -143,13 +143,23 @@ def _attention(cfg, layer, x, attn_mask, train, rng, attn_impl):
     q, k, v = heads(q), heads(k), heads(v)
     if callable(attn_impl):
         ctx = attn_impl(q, k, v)
-    elif attn_impl == "blockwise":
-        ctx = blockwise_attention(q, k, v, block_size=max(128, T // 4))
-    else:
+    elif attn_impl in ("blockwise", "flash"):
+        if attn_mask is not None:
+            raise ValueError(f"{attn_impl!r} attn_impl has no padding-mask "
+                             "path yet; use dense for masked batches")
+        if attn_impl == "flash":
+            from deeplearning4j_tpu.kernels import flash_attention
+            ctx = flash_attention(q, k, v)
+        else:
+            ctx = blockwise_attention(q, k, v, block_size=max(128, T // 4))
+    elif attn_impl == "dense":
         mask = None
         if attn_mask is not None:
             mask = attn_mask[:, None, None, :] > 0
         ctx = dense_attention(q, k, v, mask=mask)
+    else:
+        raise ValueError(f"unknown attn_impl {attn_impl!r}; expected "
+                         "'dense', 'blockwise', 'flash', or a callable")
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, T, H)
     out = ctx @ layer["proj_W"].astype(dt) + layer["proj_b"].astype(dt)
     return _dropout(out, cfg.dropout, train, rng)

@@ -116,7 +116,22 @@ class Layer:
             self.weightInit = "xavier"
         if self.biasInit is None:
             self.biasInit = 0.0
+        self.validate()
         return self
+
+    def validate(self):
+        """Build-time config validation (≡ the reference failing in
+        MultiLayerConfiguration.Builder#build, not mid-training): resolve
+        every name now so typos raise actionable ValueErrors at build()."""
+        get_activation(self.activation)
+        if isinstance(self.weightInit, str):
+            from deeplearning4j_tpu.nn.weights_init import init_weight
+            init_weight(jax.random.PRNGKey(0), (2, 2), self.weightInit,
+                        self.dist)
+        loss = getattr(self, "lossFunction", None)
+        if isinstance(loss, str):
+            from deeplearning4j_tpu.nn.losses import get_loss
+            get_loss(loss)
 
     def initialize(self, key, input_type):
         """-> (params dict, state dict, output InputType)"""

@@ -351,6 +351,10 @@ class EarlyStoppingTrainer:
             if hasattr(self.net, "_epoch"):
                 self.net._epoch += 1
             if reason:
+                # keep the "latest" snapshot honest even on mid-epoch
+                # iteration-condition termination
+                if cfg.save_last_model:
+                    cfg.model_saver.saveLatestModel(self.net, self.net.score())
                 break
 
             # score only on evaluation epochs — mixing the training loss
@@ -370,8 +374,10 @@ class EarlyStoppingTrainer:
                 if improved:
                     best_score, best_epoch = score, epoch
                     cfg.model_saver.saveBestModel(self.net, score)
-                if cfg.save_last_model:
-                    cfg.model_saver.saveLatestModel(self.net, score)
+            # "latest" means every epoch, not every evaluation epoch
+            if cfg.save_last_model:
+                cfg.model_saver.saveLatestModel(
+                    self.net, score_vs_epoch.get(epoch, self.net.score()))
 
             # score-dependent conditions fire only on evaluation epochs;
             # score-free ones (MaxEpochs) are checked every epoch so they

@@ -193,14 +193,22 @@ class TransferLearning:
                 if w_init is not None:
                     kept[idx].weightInit = w_init
                 reinit.add(idx)
-                # next parametric layer's nIn must re-infer + re-init
+                # propagate the width change: layers WITHOUT an nIn attr
+                # (BatchNormalization & co) are width-transparent but size
+                # their params from the input — clear the size initialize()
+                # pinned into the conf and re-init them; stop at the next
+                # nIn-owning layer, whose nIn re-infers
                 for j in range(idx + 1, n_keep):
-                    if getattr(kept[j], "nIn", None) is not None:
-                        kept[j].nIn = None
+                    lj = kept[j]
+                    if getattr(lj, "nIn", None) is not None:
+                        lj.nIn = None
                         if w_init_next is not None:
-                            kept[j].weightInit = w_init_next
+                            lj.weightInit = w_init_next
                         reinit.add(j)
                         break
+                    if hasattr(lj, "nOut"):
+                        lj.nOut = None
+                        reinit.add(j)
 
             for i, layer in enumerate(kept):
                 if i <= self._frozen_till:
@@ -230,7 +238,9 @@ class TransferLearning:
                         for n in dst._params[key]):
                     dst._params[key] = {k: jnp.copy(v)
                                         for k, v in src._params[key].items()}
-                if key in src._state and key in dst._state:
+                if key in src._state and key in dst._state and all(
+                        src._state[key][n].shape == dst._state[key][n].shape
+                        for n in dst._state[key]):
                     dst._state[key] = {k: jnp.copy(v)
                                        for k, v in src._state[key].items()}
             dst._build_optimizer()
@@ -346,26 +356,39 @@ class TransferLearning:
                             ref.weightInit = w_init
                         reinit.add(name)
                     # a consumer's input dim changes if a replaced vertex is
-                    # reachable through vertex-only paths (merge/elementwise
-                    # vertices forward dims without owning parameters)
+                    # reachable through width-transparent paths: vertices
+                    # (merge/elementwise forward dims without parameters) and
+                    # nIn-less layers (BatchNormalization & co size params
+                    # from the input but don't change the width)
                     def replaced_ancestors(node_name, _seen=None):
+                        seen = set() if _seen is None else _seen
                         found = []
                         for p in sconf.nodes[node_name].inputs:
+                            if p in seen:
+                                continue
+                            seen.add(p)
+                            pn = sconf.nodes[p]
                             if p in self._nout_replace:
                                 found.append(p)
-                            elif sconf.nodes[p].kind == "vertex":
-                                found.extend(replaced_ancestors(p))
+                            elif (pn.kind == "vertex"
+                                  or getattr(pn.ref, "nIn", None) is None):
+                                found.extend(replaced_ancestors(p, seen))
                         return found
 
                     replaced_parents = replaced_ancestors(name)
-                    if replaced_parents and \
-                            getattr(ref, "nIn", None) is not None:
-                        ref.nIn = None
-                        # weight_init_next from THIS node's replaced ancestor
-                        w_next = self._nout_replace[replaced_parents[0]][2]
-                        if w_next is not None:
-                            ref.weightInit = w_next
-                        reinit.add(name)
+                    if replaced_parents:
+                        if getattr(ref, "nIn", None) is not None:
+                            ref.nIn = None
+                            # weight_init_next from THIS node's ancestor
+                            w_next = self._nout_replace[replaced_parents[0]][2]
+                            if w_next is not None:
+                                ref.weightInit = w_next
+                            reinit.add(name)
+                        elif hasattr(ref, "nOut") and \
+                                name not in self._nout_replace:
+                            # width-transparent but parametric: re-infer size
+                            ref.nOut = None
+                            reinit.add(name)
                     if name in frozen:
                         _freeze_layer_conf(ref)
                     else:
@@ -402,7 +425,9 @@ class TransferLearning:
                 if all(p[k].shape == dst._params[name][k].shape
                        for k in dst._params[name]):
                     dst._params[name] = {k: jnp.copy(v) for k, v in p.items()}
-                if name in src._state and name in dst._state:
+                if name in src._state and name in dst._state and all(
+                        src._state[name][k].shape == dst._state[name][k].shape
+                        for k in dst._state[name]):
                     dst._state[name] = {k: jnp.copy(v)
                                         for k, v in src._state[name].items()}
             dst._build_optimizer()

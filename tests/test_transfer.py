@@ -298,3 +298,72 @@ class TestEarlyStopping:
         # best model is usable
         out = result.bestModel.output(np.zeros((2, 4), np.float32)).numpy()
         assert out.shape == (2, 3)
+
+
+class TestNOutReplaceThroughBatchNorm:
+    def test_nout_replace_reinits_batchnorm(self):
+        """Dense(replaced) → BatchNormalization → Output: BN must re-size
+        and the downstream Dense must re-infer nIn (regression: BN's pinned
+        nOut previously survived nOutReplace and broke forward)."""
+        from deeplearning4j_tpu.nn import BatchNormalization
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(3).updater(Adam(1e-2)).activation(Activation.RELU)
+                .list()
+                .layer(DenseLayer.Builder().nOut(16).build())
+                .layer(BatchNormalization.Builder().build())
+                .layer(OutputLayer.Builder(LossFunction.MCXENT)
+                       .nOut(3).activation(Activation.SOFTMAX).build())
+                .setInputType(InputType.feedForward(4))
+                .build())
+        src = MultiLayerNetwork(conf).init()
+        net = (TransferLearning.Builder(src)
+               .nOutReplace(0, 8, WeightInit.XAVIER)
+               .build())
+        assert net._params["0"]["W"].shape == (4, 8)
+        assert net._params["1"]["gamma"].shape == (8,)
+        assert net._state["1"]["mean"].shape == (8,)
+        assert net._params["2"]["W"].shape == (8, 3)
+        out = net.output(np.zeros((2, 4), np.float32)).numpy()
+        assert out.shape == (2, 3)
+        net.fit(_toy_data())  # one step trains through the new widths
+
+
+class TestSaveLastModel:
+    def test_latest_saved_every_epoch_with_sparse_eval(self):
+        net = _net()
+        ds = _toy_data()
+        it = ArrayDataSetIterator(ds.features, ds.labels, 32)
+        saver = InMemoryModelSaver()
+        cfg = (EarlyStoppingConfiguration.Builder()
+               .epochTerminationConditions(MaxEpochsTerminationCondition(4))
+               .scoreCalculator(DataSetLossCalculator(it))
+               .evaluateEveryNEpochs(3)
+               .modelSaver(saver)
+               .saveLastModel(True)
+               .build())
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        latest = saver.getLatestModel()
+        assert latest is not None
+        # latest must match the FINAL weights, not the last eval epoch's
+        for li in ("0", "1", "2"):
+            for k in net._params[li]:
+                np.testing.assert_array_equal(
+                    np.asarray(net._params[li][k]),
+                    np.asarray(latest._params[li][k]))
+
+    def test_latest_saved_on_iteration_termination(self):
+        net = _net()
+        ds = _toy_data()
+        it = ArrayDataSetIterator(ds.features, ds.labels, 32)
+        saver = InMemoryModelSaver()
+        cfg = (EarlyStoppingConfiguration.Builder()
+               .epochTerminationConditions(MaxEpochsTerminationCondition(10))
+               .iterationTerminationConditions(
+                   MaxScoreIterationTerminationCondition(-1.0))  # fires at once
+               .modelSaver(saver)
+               .saveLastModel(True)
+               .build())
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert result.terminationReason == \
+            TerminationReason.IterationTerminationCondition
+        assert saver.getLatestModel() is not None
