@@ -45,31 +45,39 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, l_ref, m_ref, *,
         l_ref[...] = jnp.zeros_like(l_ref)
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
 
-    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
-    k = k_ref[0]                              # (block_k, d)
-    v = v_ref[0]
-    s = jax.lax.dot_general(
-        q, k.astype(jnp.float32),
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)   # (block_q, block_k)
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    k_pos = kj * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    mask = k_pos < t_actual
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
+        k = k_ref[0]                              # (block_k, d)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # (block_q, block_k)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < t_actual
+        if causal:
+            mask &= q_pos >= k_pos
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
     if causal:
-        mask &= q_pos >= k_pos
-    s = jnp.where(mask, s, _NEG_INF)
-    m_prev, l_prev = m_ref[...], l_ref[...]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)
-    l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    m_ref[...] = m_new
-    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-        p, v.astype(jnp.float32),
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        # skip k-tiles entirely above the diagonal: both MXU matmuls would
+        # only produce fully-masked (p == 0) contributions
+        pl.when(kj * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
 
     @pl.when(kj == pl.num_programs(2) - 1)
     def _finalize():

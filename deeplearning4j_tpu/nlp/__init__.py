@@ -1,0 +1,24 @@
+"""NLP (≡ deeplearning4j-nlp): Word2Vec, ParagraphVectors, GloVe,
+FastText, tokenizers, sentence iterators, vocabulary cache."""
+from deeplearning4j_tpu.nlp.tokenization import (BasicLineIterator,
+                                                 CollectionSentenceIterator,
+                                                 CommonPreprocessor,
+                                                 DefaultTokenizerFactory,
+                                                 LowCasePreProcessor,
+                                                 NGramTokenizerFactory,
+                                                 SentenceIterator, Tokenizer,
+                                                 TokenizerFactory)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, build_vocab
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, WordVectors
+from deeplearning4j_tpu.nlp.paragraph_vectors import (LabelledDocument,
+                                                      ParagraphVectors)
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.fasttext import FastText, char_ngrams
+
+__all__ = [
+    "BasicLineIterator", "CollectionSentenceIterator", "CommonPreprocessor",
+    "DefaultTokenizerFactory", "LowCasePreProcessor", "NGramTokenizerFactory",
+    "SentenceIterator", "Tokenizer", "TokenizerFactory", "VocabCache",
+    "build_vocab", "Word2Vec", "WordVectors", "LabelledDocument",
+    "ParagraphVectors", "Glove", "FastText", "char_ngrams",
+]

@@ -1,0 +1,148 @@
+"""FastText (≡ deeplearning4j-nlp :: models.fasttext.FastText — subword
+skip-gram).
+
+Each word's input vector is the mean of its own embedding plus hashed
+character n-gram bucket embeddings (FNV-1a hashing into a fixed bucket
+table, as fastText does). The per-word n-gram id matrix is precomputed
+host-side into a fixed (V, max_ngrams) padded tensor so the training step
+— masked-mean gather + SGNS loss + update — stays one jitted executable.
+OOV words get vectors from their n-grams alone.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+def _fnv1a(s):
+    h = np.uint64(2166136261)
+    for ch in s.encode("utf-8"):
+        h = np.uint64((int(h) ^ ch) * 16777619 & 0xFFFFFFFFFFFFFFFF)
+    return int(h)
+
+
+def char_ngrams(word, min_n=3, max_n=6):
+    w = f"<{word}>"
+    out = []
+    for n in range(min_n, max_n + 1):
+        for i in range(len(w) - n + 1):
+            g = w[i:i + n]
+            if g != w:
+                out.append(g)
+    return out
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _ft_step(params, lr, ngram_ids, ngram_mask, context, negatives, weights):
+    """ngram_ids: (B, G) rows into the combined [word | bucket] table;
+    row 0 of the mask selects real entries (word id always present)."""
+
+    def loss_fn(p):
+        emb = p["syn0"][ngram_ids]                    # (B, G, D)
+        cnt = jnp.maximum(ngram_mask.sum(-1, keepdims=True), 1.0)
+        v = (emb * ngram_mask[..., None]).sum(1) / cnt
+        u_pos = p["syn1"][context]
+        u_neg = p["syn1"][negatives]
+        pos = jax.nn.log_sigmoid(jnp.sum(v * u_pos, -1))
+        neg = jax.nn.log_sigmoid(-jnp.einsum("bd,bkd->bk", v, u_neg)).sum(-1)
+        return -jnp.sum((pos + neg) * weights) / jnp.maximum(weights.sum(), 1.)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+    return params, loss
+
+
+class FastText(Word2Vec):
+    class Builder(Word2Vec.Builder):
+        def __init__(self):
+            super().__init__()
+            self._min_count = 1
+            self._buckets = 1 << 17
+            self._min_n, self._max_n = 3, 6
+            self._max_ngrams = 24
+
+        def bucket(self, v):
+            self._buckets = int(v); return self
+
+        def minN(self, v):
+            self._min_n = int(v); return self
+
+        def maxN(self, v):
+            self._max_n = int(v); return self
+
+        def build(self):
+            return FastText(self)
+
+    def __init__(self, builder):
+        super().__init__(builder)
+        self._ngram_ids = None
+        self._ngram_mask = None
+
+    def _word_ngram_row(self, word, widx=None):
+        """Row of table ids: [word_id?, bucket ids...] padded to max."""
+        G = self.b._max_ngrams
+        v = self.vocab.numWords()
+        ids, mask = [], []
+        if widx is not None:
+            ids.append(widx)
+            mask.append(1.0)
+        for g in char_ngrams(word, self.b._min_n, self.b._max_n)[:G - len(ids)]:
+            ids.append(v + _fnv1a(g) % self.b._buckets)
+            mask.append(1.0)
+        while len(ids) < G:
+            ids.append(0)
+            mask.append(0.0)
+        return np.asarray(ids, np.int32), np.asarray(mask, np.float32)
+
+    def _init_params(self):
+        v, d = self.vocab.numWords(), self.b._layer_size
+        key = jax.random.PRNGKey(self.b._seed)
+        table = (jax.random.uniform(
+            key, (v + self.b._buckets, d), jnp.float32) - 0.5) / d
+        self.params = {"syn0": table, "syn1": jnp.zeros((v, d), jnp.float32)}
+        rows = [self._word_ngram_row(w, i)
+                for i, w in enumerate(self.vocab.idx2word)]
+        self._ngram_ids = np.stack([r[0] for r in rows])
+        self._ngram_mask = np.stack([r[1] for r in rows])
+
+    def _run_epochs(self, pairs_fn, epochs):
+        for _ in range(epochs):
+            centers, contexts = pairs_fn()
+            for cen, ctx, negs, w in self._batches(
+                    np.asarray(centers), np.asarray(contexts)):
+                c = np.asarray(cen)
+                self.params, _ = _ft_step(
+                    self.params, self.b._lr,
+                    jnp.asarray(self._ngram_ids[c]),
+                    jnp.asarray(self._ngram_mask[c]),
+                    ctx, negs, w)
+        self._cached_table = None  # tables changed; recompute on lookup
+
+    # -- lookup: in-vocab mean(word+ngrams); OOV from ngrams alone -------
+    def _table(self):
+        # the (V, G, D) gather is expensive; params are frozen at lookup
+        # time, so reduce once and reuse across similarity queries
+        if getattr(self, "_cached_table", None) is None:
+            tab = np.asarray(self.params["syn0"], np.float32)
+            emb = tab[self._ngram_ids]                  # (V, G, D)
+            cnt = np.maximum(self._ngram_mask.sum(-1, keepdims=True), 1.0)
+            self._cached_table = (emb * self._ngram_mask[..., None]
+                                  ).sum(1) / cnt
+        return self._cached_table
+
+    def getWordVector(self, word):
+        i = self.vocab.indexOf(word)
+        tab = np.asarray(self.params["syn0"], np.float32)
+        if i >= 0:
+            ids, mask = self._ngram_ids[i], self._ngram_mask[i]
+        else:
+            ids, mask = self._word_ngram_row(word)  # OOV: n-grams only
+            if mask.sum() == 0:
+                raise KeyError(f"no n-grams for OOV word {word!r}")
+        emb = tab[ids]
+        return (emb * mask[:, None]).sum(0) / max(mask.sum(), 1.0)

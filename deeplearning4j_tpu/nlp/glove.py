@@ -1,0 +1,164 @@
+"""GloVe (≡ deeplearning4j-nlp :: models.glove.Glove).
+
+Co-occurrence counting is host-side (sparse dict with 1/distance
+weighting, as in the reference's CoOccurrences pipeline); the weighted
+least-squares factorization step — f(X)·(wᵢ·w̃ⱼ + bᵢ + b̃ⱼ − log X)² with
+per-parameter AdaGrad — runs as one jitted XLA executable per batch over
+fixed-shape (i, j, logX, f) tensors.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.word2vec import WordVectors
+from deeplearning4j_tpu.nlp.tokenization import (CollectionSentenceIterator,
+                                                 DefaultTokenizerFactory)
+from deeplearning4j_tpu.nlp.vocab import build_vocab
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _glove_step(params, hist, lr, rows, cols, log_x, f_w, mask):
+    def loss_fn(p):
+        wi = p["w"][rows]
+        wj = p["wc"][cols]
+        diff = (wi * wj).sum(-1) + p["b"][rows] + p["bc"][cols] - log_x
+        return jnp.sum(f_w * diff * diff * mask) / jnp.maximum(mask.sum(), 1.)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    hist = jax.tree_util.tree_map(lambda h, g: h + g * g, hist, grads)
+    params = jax.tree_util.tree_map(
+        lambda p, g, h: p - lr * g / jnp.sqrt(h + 1e-8), params, grads, hist)
+    return params, hist, loss
+
+
+class Glove(WordVectors):
+    class Builder:
+        def __init__(self):
+            self._min_count = 1
+            self._layer_size = 100
+            self._seed = 42
+            self._window = 5
+            self._lr = 0.05
+            self._epochs = 25
+            self._xmax = 100.0
+            self._alpha = 0.75
+            self._batch = 4096
+            self._symmetric = True
+            self._iter = None
+            self._tok = DefaultTokenizerFactory()
+
+        def minWordFrequency(self, v):
+            self._min_count = int(v); return self
+
+        def layerSize(self, v):
+            self._layer_size = int(v); return self
+
+        def seed(self, v):
+            self._seed = int(v); return self
+
+        def windowSize(self, v):
+            self._window = int(v); return self
+
+        def learningRate(self, v):
+            self._lr = float(v); return self
+
+        def epochs(self, v):
+            self._epochs = int(v); return self
+
+        def xMax(self, v):
+            self._xmax = float(v); return self
+
+        def alpha(self, v):
+            self._alpha = float(v); return self
+
+        def batchSize(self, v):
+            self._batch = int(v); return self
+
+        def symmetric(self, v):
+            self._symmetric = bool(v); return self
+
+        def iterate(self, sentence_iterator):
+            if isinstance(sentence_iterator, (list, tuple)):
+                sentence_iterator = CollectionSentenceIterator(
+                    sentence_iterator)
+            self._iter = sentence_iterator
+            return self
+
+        def tokenizerFactory(self, tok):
+            self._tok = tok; return self
+
+        def build(self):
+            return Glove(self)
+
+    def __init__(self, builder):
+        self.b = builder
+        self.vocab = None
+        self.params = None
+        self._rng = np.random.default_rng(builder._seed)
+
+    def _table(self):
+        # GloVe convention: final vectors = w + context w
+        return np.asarray(self.params["w"] + self.params["wc"], np.float32)
+
+    def _cooccurrences(self, sentences_ids):
+        co = {}
+        for ids in sentences_ids:
+            n = len(ids)
+            for i in range(n):
+                for j in range(max(0, i - self.b._window), i):
+                    w = 1.0 / (i - j)
+                    co[(ids[i], ids[j])] = co.get((ids[i], ids[j]), 0.0) + w
+                    if self.b._symmetric:
+                        co[(ids[j], ids[i])] = co.get(
+                            (ids[j], ids[i]), 0.0) + w
+        return co
+
+    def fit(self):
+        toks = [self.b._tok.create(s).getTokens() for s in self.b._iter]
+        self.vocab = build_vocab(toks, self.b._min_count)
+        w2i = self.vocab.word2idx
+        ids = [[w2i[t] for t in s if t in w2i] for s in toks]
+        co = self._cooccurrences(ids)
+        if not co:
+            raise ValueError("no co-occurrences (corpus too small)")
+
+        v, d = self.vocab.numWords(), self.b._layer_size
+        key = jax.random.PRNGKey(self.b._seed)
+        k1, k2 = jax.random.split(key)
+        scale = 0.5 / d
+        self.params = {
+            "w": jax.random.uniform(k1, (v, d), minval=-scale, maxval=scale),
+            "wc": jax.random.uniform(k2, (v, d), minval=-scale, maxval=scale),
+            "b": jnp.zeros((v,)), "bc": jnp.zeros((v,)),
+        }
+        hist = jax.tree_util.tree_map(
+            lambda p: jnp.full(p.shape, 1e-8), self.params)
+
+        pairs = np.asarray(list(co.keys()), np.int32)
+        xs = np.asarray(list(co.values()), np.float64)
+        log_x = np.log(xs).astype(np.float32)
+        f_w = np.minimum((xs / self.b._xmax) ** self.b._alpha,
+                         1.0).astype(np.float32)
+        B = self.b._batch
+        n = len(pairs)
+        pad = (-n) % B
+        mask = np.concatenate([np.ones(n, np.float32),
+                               np.zeros(pad, np.float32)])
+        pairs = np.concatenate([pairs, np.zeros((pad, 2), np.int32)])
+        log_x = np.concatenate([log_x, np.zeros(pad, np.float32)])
+        f_w = np.concatenate([f_w, np.zeros(pad, np.float32)])
+
+        for _ in range(self.b._epochs):
+            perm = self._rng.permutation(len(pairs))
+            for s in range(0, len(pairs), B):
+                sl = perm[s:s + B]
+                self.params, hist, _ = _glove_step(
+                    self.params, hist, self.b._lr,
+                    jnp.asarray(pairs[sl, 0]), jnp.asarray(pairs[sl, 1]),
+                    jnp.asarray(log_x[sl]), jnp.asarray(f_w[sl]),
+                    jnp.asarray(mask[sl]))
+        return self

@@ -1,0 +1,232 @@
+"""ParagraphVectors / doc2vec (≡ deeplearning4j-nlp ::
+models.paragraphvectors.ParagraphVectors, PV-DBOW + PV-DM).
+
+PV-DBOW: the label (document) vector plays the skip-gram center role and
+predicts each word of its document — reuses the jitted SGNS step with the
+doc table as syn0. PV-DM: mean(doc vector, context word vectors) predicts
+the center word. `inferVector` gradient-descends a fresh doc vector with
+all trained tables frozen (jitted closed-form grad, no optimizer state).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, _sgns_step
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _pvdm_step(params, lr, doc_ids, ctx_ids, ctx_mask, center, negatives,
+               weights):
+    """PV-DM: v = mean(doc vec + context word vecs) → SGNS vs center."""
+
+    def loss_fn(p):
+        dv = p["docs"][doc_ids]                       # (B, D)
+        wv = p["syn0"][ctx_ids]                       # (B, C, D)
+        cnt = ctx_mask.sum(-1, keepdims=True) + 1.0
+        v = (dv + (wv * ctx_mask[..., None]).sum(1)) / cnt
+        u_pos = p["syn1"][center]
+        u_neg = p["syn1"][negatives]
+        pos = jax.nn.log_sigmoid(jnp.sum(v * u_pos, -1))
+        neg = jax.nn.log_sigmoid(-jnp.einsum("bd,bkd->bk", v, u_neg)).sum(-1)
+        return -jnp.sum((pos + neg) * weights) / jnp.maximum(weights.sum(), 1.)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+    return params, loss
+
+
+_INFER_CHUNK = 128  # fixed shape → one XLA compile for any document length
+
+
+@jax.jit
+def _infer_step(doc_vec, syn1, lr, context, negatives, mask):
+    def loss_fn(v):
+        pos = jax.nn.log_sigmoid(syn1[context] @ v) * mask
+        neg = (jax.nn.log_sigmoid(-(syn1[negatives] @ v))
+               * mask[:, None])
+        return -(pos.sum() + neg.sum())
+
+    return doc_vec - lr * jax.grad(loss_fn)(doc_vec)
+
+
+class LabelledDocument:
+    """≡ text.documentiterator.LabelledDocument."""
+
+    def __init__(self, content, labels):
+        self.content = content
+        self.labels = labels if isinstance(labels, (list, tuple)) else [labels]
+
+
+class ParagraphVectors(Word2Vec):
+    class Builder(Word2Vec.Builder):
+        def __init__(self):
+            super().__init__()
+            self._min_count = 1
+            self._docs = None
+            self._dm = False
+            self._train_words = True
+
+        def iterate(self, docs):
+            """Accepts LabelledDocuments, (label, text) pairs, or raw
+            strings (auto-labelled DOC_i)."""
+            norm = []
+            for i, d in enumerate(docs):
+                if isinstance(d, LabelledDocument):
+                    norm.append((d.labels[0], d.content))
+                elif isinstance(d, tuple):
+                    norm.append(d)
+                else:
+                    norm.append((f"DOC_{i}", d))
+            self._docs = norm
+            return self
+
+        def sequenceLearningAlgorithm(self, name):
+            self._dm = "DM" in str(name).upper()
+            return self
+
+        def trainWordVectors(self, flag):
+            self._train_words = bool(flag)
+            return self
+
+        def build(self):
+            return ParagraphVectors(self)
+
+    def __init__(self, builder):
+        super().__init__(builder)
+        self.labels = [lab for lab, _ in builder._docs]
+        self.label2idx = {lab: i for i, lab in enumerate(self.labels)}
+
+    def _tokenized(self):
+        return [self.b._tok.create(text).getTokens()
+                for _, text in self.b._docs]
+
+    def fit(self):
+        toks = self._tokenized()
+        self.buildVocab(toks)
+        self._init_params()
+        d = self.b._layer_size
+        key = jax.random.PRNGKey(self.b._seed + 1)
+        self.params["docs"] = (jax.random.uniform(
+            key, (len(self.labels), d), jnp.float32) - 0.5) / d
+        w2i = self.vocab.word2idx
+        docs_ids = [[w2i[t] for t in s if t in w2i] for s in toks]
+
+        if self.b._train_words:
+            self._run_epochs(lambda: self._pairs(docs_ids), self.b._epochs)
+        if self.b._dm:
+            self._fit_dm(docs_ids)
+        else:
+            self._fit_dbow(docs_ids)
+        return self
+
+    # -- PV-DBOW: doc id predicts every word in the doc ------------------
+    def _fit_dbow(self, docs_ids):
+        centers = np.concatenate(
+            [np.full(len(ids), di, np.int32)
+             for di, ids in enumerate(docs_ids) if ids] or
+            [np.zeros(0, np.int32)])
+        contexts = np.concatenate(
+            [np.asarray(ids, np.int32)
+             for ids in docs_ids if ids] or [np.zeros(0, np.int32)])
+        if len(centers) == 0:
+            return
+        dbow = {"syn0": self.params["docs"], "syn1": self.params["syn1"]}
+        for _ in range(self.b._epochs * self.b._iterations):
+            for cen, ctx, negs, w in self._batches(centers, contexts):
+                dbow, _ = _sgns_step(dbow, self.b._lr, cen, ctx, negs, w)
+        self.params["docs"], self.params["syn1"] = dbow["syn0"], dbow["syn1"]
+
+    # -- PV-DM -----------------------------------------------------------
+    def _fit_dm(self, docs_ids):
+        neg_p = self.vocab.negative_table()
+        B, K, C = self.b._batch, max(1, self.b._negative), 2 * self.b._window
+        rows = []
+        for di, ids in enumerate(docs_ids):
+            n = len(ids)
+            for i in range(n):
+                ctx = [ids[j] for j in range(max(0, i - self.b._window),
+                                             min(n, i + self.b._window + 1))
+                       if j != i]
+                rows.append((di, ids[i], ctx))
+        if not rows:
+            return
+        for _ in range(self.b._epochs * self.b._iterations):
+            order = self._rng.permutation(len(rows))
+            doc_a = np.zeros(len(rows), np.int32)
+            cen_a = np.zeros(len(rows), np.int32)
+            ctx_a = np.zeros((len(rows), C), np.int32)
+            msk_a = np.zeros((len(rows), C), np.float32)
+            for k, r in enumerate(order):
+                di, ci, ctx = rows[r]
+                doc_a[k], cen_a[k] = di, ci
+                m = min(len(ctx), C)
+                ctx_a[k, :m] = ctx[:m]
+                msk_a[k, :m] = 1.0
+            n = len(rows)
+            pad = (-n) % B
+            w = np.concatenate([np.ones(n, np.float32),
+                                np.zeros(pad, np.float32)])
+            doc_a = np.concatenate([doc_a, np.zeros(pad, np.int32)])
+            cen_a = np.concatenate([cen_a, np.zeros(pad, np.int32)])
+            ctx_a = np.concatenate([ctx_a, np.zeros((pad, C), np.int32)])
+            msk_a = np.concatenate([msk_a, np.zeros((pad, C), np.float32)])
+            negs = self._rng.choice(self.vocab.numWords(), size=(n + pad, K),
+                                    p=neg_p).astype(np.int32)
+            for s in range(0, n + pad, B):
+                self.params, _ = _pvdm_step(
+                    self.params, self.b._lr,
+                    jnp.asarray(doc_a[s:s + B]), jnp.asarray(ctx_a[s:s + B]),
+                    jnp.asarray(msk_a[s:s + B]), jnp.asarray(cen_a[s:s + B]),
+                    jnp.asarray(negs[s:s + B]), jnp.asarray(w[s:s + B]))
+
+    # -- surface ---------------------------------------------------------
+    def getLabelVector(self, label):
+        return np.asarray(self.params["docs"], np.float32)[
+            self.label2idx[label]]
+
+    def inferVector(self, text, steps=50, lr=0.05):
+        toks = self.b._tok.create(text).getTokens()
+        ids = [self.vocab.indexOf(t) for t in toks]
+        ids = np.asarray([i for i in ids if i >= 0], np.int32)
+        d = self.b._layer_size
+        vec = jnp.asarray((self._rng.random(d).astype(np.float32) - 0.5) / d)
+        if len(ids) == 0:
+            return np.asarray(vec)
+        # pad/chunk to a fixed shape so _infer_step compiles exactly once
+        n = len(ids)
+        pad = (-n) % _INFER_CHUNK
+        mask = np.concatenate([np.ones(n, np.float32),
+                               np.zeros(pad, np.float32)])
+        ids = np.concatenate([ids, np.zeros(pad, np.int32)])
+        neg_p = self.vocab.negative_table()
+        syn1 = self.params["syn1"]
+        K = max(1, self.b._negative)
+        for _ in range(steps):
+            negs = self._rng.choice(self.vocab.numWords(),
+                                    size=(len(ids), K),
+                                    p=neg_p).astype(np.int32)
+            for s in range(0, len(ids), _INFER_CHUNK):
+                vec = _infer_step(vec, syn1, lr,
+                                  jnp.asarray(ids[s:s + _INFER_CHUNK]),
+                                  jnp.asarray(negs[s:s + _INFER_CHUNK]),
+                                  jnp.asarray(mask[s:s + _INFER_CHUNK]))
+        return np.asarray(vec)
+
+    def similarityToLabel(self, text, label):
+        v = self.inferVector(text)
+        lv = self.getLabelVector(label)
+        den = max(np.linalg.norm(v) * np.linalg.norm(lv), 1e-12)
+        return float(v @ lv / den)
+
+    def nearestLabels(self, text, topN=5):
+        v = self.inferVector(text)
+        tab = np.asarray(self.params["docs"], np.float32)
+        sims = tab @ v / np.maximum(
+            np.linalg.norm(tab, axis=1) * max(np.linalg.norm(v), 1e-12),
+            1e-12)
+        order = np.argsort(-sims)[:topN]
+        return [self.labels[i] for i in order]

@@ -1,0 +1,243 @@
+"""Word2Vec (≡ deeplearning4j-nlp :: models.word2vec.Word2Vec and
+models.embeddings.wordvectors.WordVectors).
+
+TPU-first design: the reference trains skip-gram negative sampling with
+per-pair scalar updates in Java threads (SkipGram/CBOW ops in libnd4j).
+Here training pairs are generated host-side into fixed-shape integer
+batches and the WHOLE update — embedding gathers, logits, log-sigmoid
+loss, gradients, optimizer — is ONE jitted XLA executable with donated
+embedding tables. Negative sampling uses the same unigram^0.75 table;
+frequent-word subsampling uses the same t-threshold formula.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import (CollectionSentenceIterator,
+                                                 DefaultTokenizerFactory)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, build_vocab
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _sgns_step(params, lr, center, context, negatives, weights):
+    """One skip-gram-negative-sampling SGD step (whole batch, one XLA exec).
+
+    center/context: (B,) int32; negatives: (B, K) int32; weights: (B,)
+    0/1 mask so padded tail pairs contribute nothing.
+    """
+
+    def loss_fn(p):
+        v = p["syn0"][center]                       # (B, D)
+        u_pos = p["syn1"][context]                  # (B, D)
+        u_neg = p["syn1"][negatives]                # (B, K, D)
+        pos = jax.nn.log_sigmoid(jnp.sum(v * u_pos, -1))
+        neg = jax.nn.log_sigmoid(-jnp.einsum("bd,bkd->bk", v, u_neg)).sum(-1)
+        denom = jnp.maximum(weights.sum(), 1.0)
+        return -jnp.sum((pos + neg) * weights) / denom
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+    return params, loss
+
+
+class WordVectors:
+    """Lookup/similarity surface (≡ embeddings.wordvectors.WordVectors)."""
+
+    vocab: VocabCache
+
+    def _table(self):
+        return np.asarray(self.params["syn0"], np.float32)
+
+    def hasWord(self, word):
+        return self.vocab.containsWord(word)
+
+    def getWordVector(self, word):
+        i = self.vocab.indexOf(word)
+        if i < 0:
+            raise KeyError(f"word not in vocab: {word!r}")
+        return self._table()[i]
+
+    def getWordVectorMatrix(self, word):
+        return self.getWordVector(word)
+
+    def similarity(self, w1, w2):
+        a, b = self.getWordVector(w1), self.getWordVector(w2)
+        na, nb = np.linalg.norm(a), np.linalg.norm(b)
+        if na == 0 or nb == 0:
+            return 0.0
+        return float(a @ b / (na * nb))
+
+    def wordsNearest(self, word_or_vec, topN=10):
+        if isinstance(word_or_vec, str):
+            vec = self.getWordVector(word_or_vec)
+            exclude = {word_or_vec}
+        else:
+            vec, exclude = np.asarray(word_or_vec, np.float32), set()
+        tab = self._table()
+        norms = np.linalg.norm(tab, axis=1) * max(np.linalg.norm(vec), 1e-12)
+        sims = tab @ vec / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.wordAtIndex(int(i))
+            if w not in exclude:
+                out.append(w)
+            if len(out) >= topN:
+                break
+        return out
+
+    def vocabSize(self):
+        return self.vocab.numWords()
+
+
+class Word2Vec(WordVectors):
+    """≡ models.word2vec.Word2Vec — built via the same fluent Builder."""
+
+    class Builder:
+        def __init__(self):
+            self._min_count = 5
+            self._iterations = 1
+            self._epochs = 1
+            self._layer_size = 100
+            self._seed = 42
+            self._window = 5
+            self._lr = 0.025
+            self._negative = 5
+            self._sample = 1e-3
+            self._batch = 1024
+            self._iter = None
+            self._tok = DefaultTokenizerFactory()
+
+        def minWordFrequency(self, v):
+            self._min_count = int(v); return self
+
+        def iterations(self, v):
+            self._iterations = int(v); return self
+
+        def epochs(self, v):
+            self._epochs = int(v); return self
+
+        def layerSize(self, v):
+            self._layer_size = int(v); return self
+
+        def seed(self, v):
+            self._seed = int(v); return self
+
+        def windowSize(self, v):
+            self._window = int(v); return self
+
+        def learningRate(self, v):
+            self._lr = float(v); return self
+
+        def negativeSample(self, v):
+            self._negative = int(v); return self
+
+        def sampling(self, v):
+            self._sample = float(v); return self
+
+        def batchSize(self, v):
+            self._batch = int(v); return self
+
+        def iterate(self, sentence_iterator):
+            if isinstance(sentence_iterator, (list, tuple)):
+                sentence_iterator = CollectionSentenceIterator(
+                    sentence_iterator)
+            self._iter = sentence_iterator
+            return self
+
+        def tokenizerFactory(self, tok):
+            self._tok = tok; return self
+
+        def build(self):
+            return Word2Vec(self)
+
+    def __init__(self, builder):
+        self.b = builder
+        self.vocab = VocabCache()
+        self.params = None
+        self._rng = np.random.default_rng(builder._seed)
+
+    # -- corpus → ids ----------------------------------------------------
+    def _tokenized(self):
+        out = []
+        for sent in self.b._iter:
+            out.append(self.b._tok.create(sent).getTokens())
+        return out
+
+    def buildVocab(self, sentences_tokens):
+        self.vocab = build_vocab(sentences_tokens, self.b._min_count)
+
+    def _init_params(self):
+        v, d = self.vocab.numWords(), self.b._layer_size
+        key = jax.random.PRNGKey(self.b._seed)
+        syn0 = (jax.random.uniform(key, (v, d), jnp.float32) - 0.5) / d
+        self.params = {"syn0": syn0, "syn1": jnp.zeros((v, d), jnp.float32)}
+
+    def _pairs(self, sentences_ids):
+        """Skip-gram pairs with dynamic window + subsampling (host side)."""
+        keep = self.vocab.keep_probs(self.b._sample)
+        centers, contexts = [], []
+        for ids in sentences_ids:
+            ids = np.asarray(ids, np.int64)
+            if self.b._sample:
+                ids = ids[self._rng.random(len(ids)) < keep[ids]]
+            n = len(ids)
+            if n < 2:
+                continue
+            for i in range(n):
+                b = self._rng.integers(1, self.b._window + 1)
+                lo, hi = max(0, i - b), min(n, i + b + 1)
+                for j in range(lo, hi):
+                    if j != i:
+                        centers.append(ids[i])
+                        contexts.append(ids[j])
+        return (np.asarray(centers, np.int32),
+                np.asarray(contexts, np.int32))
+
+    def _batches(self, centers, contexts):
+        """Shared epoch batcher: shuffle, pad to the fixed batch shape,
+        sample negatives from the unigram^0.75 table, yield
+        (center, context, negatives, weights) device-ready slices."""
+        n = len(centers)
+        if n == 0:
+            return
+        B, K = self.b._batch, max(1, self.b._negative)
+        neg_p = self.vocab.negative_table()
+        perm = self._rng.permutation(n)
+        centers, contexts = centers[perm], contexts[perm]
+        pad = (-n) % B
+        weights = np.concatenate(
+            [np.ones(n, np.float32), np.zeros(pad, np.float32)])
+        centers = np.concatenate([centers, np.zeros(pad, np.int32)])
+        contexts = np.concatenate([contexts, np.zeros(pad, np.int32)])
+        negs = self._rng.choice(self.vocab.numWords(),
+                                size=(len(centers), K),
+                                p=neg_p).astype(np.int32)
+        for s in range(0, len(centers), B):
+            yield (jnp.asarray(centers[s:s + B]),
+                   jnp.asarray(contexts[s:s + B]),
+                   jnp.asarray(negs[s:s + B]),
+                   jnp.asarray(weights[s:s + B]))
+
+    def _run_epochs(self, centers_contexts_fn, epochs):
+        for _ in range(epochs):
+            centers, contexts = centers_contexts_fn()
+            for cen, ctx, negs, w in self._batches(centers, contexts):
+                self.params, _ = _sgns_step(self.params, self.b._lr,
+                                            cen, ctx, negs, w)
+
+    def fit(self):
+        toks = self._tokenized()
+        self.buildVocab(toks)
+        if self.vocab.numWords() == 0:
+            raise ValueError("empty vocabulary after min-count pruning")
+        self._init_params()
+        w2i = self.vocab.word2idx
+        sentences_ids = [[w2i[t] for t in s if t in w2i] for s in toks]
+        self._run_epochs(lambda: self._pairs(sentences_ids),
+                         self.b._epochs * self.b._iterations)
+        return self

@@ -125,12 +125,10 @@ class Layer:
         every name now so typos raise actionable ValueErrors at build()."""
         get_activation(self.activation)
         if isinstance(self.weightInit, str):
-            from deeplearning4j_tpu.nn.weights_init import init_weight
             init_weight(jax.random.PRNGKey(0), (2, 2), self.weightInit,
                         self.dist)
         loss = getattr(self, "lossFunction", None)
         if isinstance(loss, str):
-            from deeplearning4j_tpu.nn.losses import get_loss
             get_loss(loss)
 
     def initialize(self, key, input_type):

@@ -1,0 +1,154 @@
+"""NLP tests (≡ deeplearning4j-nlp test suite: Word2VecTests,
+ParagraphVectorsTest, tokenizer tests — scaled to a synthetic corpus
+since the environment has no egress for real text datasets)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (CollectionSentenceIterator,
+                                    CommonPreprocessor,
+                                    DefaultTokenizerFactory, FastText, Glove,
+                                    LabelledDocument, NGramTokenizerFactory,
+                                    ParagraphVectors, Word2Vec, build_vocab,
+                                    char_ngrams)
+
+
+def synthetic_corpus(n=300, seed=0):
+    """Two topic clusters: words within a topic co-occur, across don't."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "horse", "cow", "sheep", "goat"]
+    tech = ["cpu", "gpu", "ram", "disk", "cache", "bus"]
+    sents = []
+    for _ in range(n):
+        topic = animals if rng.random() < 0.5 else tech
+        sents.append(" ".join(rng.choice(topic, size=6)))
+    return sents
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        tok = DefaultTokenizerFactory().create("hello world foo")
+        assert tok.countTokens() == 3
+        assert tok.getTokens() == ["hello", "world", "foo"]
+        assert tok.hasMoreTokens()
+        assert tok.nextToken() == "hello"
+
+    def test_common_preprocessor(self):
+        fac = DefaultTokenizerFactory()
+        fac.setTokenPreProcessor(CommonPreprocessor())
+        toks = fac.create("Hello, World! 123 test.").getTokens()
+        assert toks == ["hello", "world", "test"]
+
+    def test_ngram_tokenizer(self):
+        fac = NGramTokenizerFactory(minN=1, maxN=2)
+        toks = fac.create("a b c").getTokens()
+        assert toks == ["a", "b", "c", "a b", "b c"]
+
+
+class TestVocab:
+    def test_build_and_query(self):
+        v = build_vocab([["a", "b", "a"], ["a", "c"]], min_count=1)
+        assert v.numWords() == 3
+        assert v.wordFrequency("a") == 3
+        assert v.containsWord("b") and not v.containsWord("z")
+        assert v.wordAtIndex(v.indexOf("c")) == "c"
+        assert v.totalWordOccurrences() == 5
+
+    def test_min_count_prunes(self):
+        v = build_vocab([["a", "b", "a"]], min_count=2)
+        assert v.words() == ["a"]
+
+    def test_negative_table_normalized(self):
+        v = build_vocab([["a", "b", "a"]], min_count=1)
+        p = v.negative_table()
+        assert p.shape == (2,) and abs(p.sum() - 1.0) < 1e-9
+
+
+class TestWord2Vec:
+    @pytest.fixture(scope="class")
+    def model(self):
+        return (Word2Vec.Builder()
+                .minWordFrequency(1).layerSize(32).seed(7).windowSize(3)
+                .epochs(3).negativeSample(5).sampling(0)
+                .learningRate(0.05).batchSize(512)
+                .iterate(CollectionSentenceIterator(synthetic_corpus()))
+                .tokenizerFactory(DefaultTokenizerFactory())
+                .build().fit())
+
+    def test_vocab(self, model):
+        assert model.vocabSize() == 12
+        assert model.hasWord("cat") and model.hasWord("gpu")
+
+    def test_vector_shape(self, model):
+        assert model.getWordVector("cat").shape == (32,)
+
+    def test_topic_clustering(self, model):
+        # within-topic similarity beats cross-topic
+        assert model.similarity("cat", "dog") > model.similarity("cat", "gpu")
+        assert model.similarity("cpu", "ram") > model.similarity("cpu", "cow")
+
+    def test_words_nearest(self, model):
+        near = model.wordsNearest("cat", topN=5)
+        assert "cat" not in near
+        animals = {"dog", "horse", "cow", "sheep", "goat"}
+        assert len(set(near[:3]) & animals) >= 2
+
+
+class TestParagraphVectors:
+    def test_dbow_labels_cluster(self):
+        docs = []
+        for i, s in enumerate(synthetic_corpus(60, seed=1)):
+            topic = "animals" if s.split()[0] in {
+                "cat", "dog", "horse", "cow", "sheep", "goat"} else "tech"
+            docs.append(LabelledDocument(s, f"{topic}_{i}"))
+        pv = (ParagraphVectors.Builder()
+              .minWordFrequency(1).layerSize(24).seed(3).epochs(3)
+              .sampling(0).batchSize(256)
+              .iterate(docs).build().fit())
+        assert pv.getLabelVector(docs[0].labels[0]).shape == (24,)
+        v = pv.inferVector("cat dog horse cow")
+        assert v.shape == (24,) and np.isfinite(v).all()
+
+    def test_dm_runs(self):
+        pv = (ParagraphVectors.Builder()
+              .minWordFrequency(1).layerSize(16).seed(3).epochs(2)
+              .sampling(0).batchSize(128)
+              .sequenceLearningAlgorithm("DM")
+              .iterate(synthetic_corpus(30)).build().fit())
+        assert pv.params["docs"].shape == (30, 16)
+
+    def test_nearest_labels(self):
+        docs = [("animal_doc", "cat dog cow horse sheep goat cat dog"),
+                ("tech_doc", "cpu gpu ram disk cache bus cpu gpu")] * 5
+        docs = [(f"{lab}_{i}", txt) for i, (lab, txt) in enumerate(docs)]
+        pv = (ParagraphVectors.Builder()
+              .minWordFrequency(1).layerSize(16).seed(5).epochs(10)
+              .sampling(0).batchSize(128).iterate(docs).build().fit())
+        labs = pv.nearestLabels("cat dog sheep", topN=3)
+        assert len(labs) == 3
+
+
+class TestGlove:
+    def test_topic_clustering(self):
+        g = (Glove.Builder()
+             .minWordFrequency(1).layerSize(24).seed(11).windowSize(4)
+             .epochs(40).learningRate(0.05)
+             .iterate(synthetic_corpus(200, seed=2)).build().fit())
+        assert g.getWordVector("cat").shape == (24,)
+        assert g.similarity("cat", "dog") > g.similarity("cat", "gpu")
+
+
+class TestFastText:
+    def test_char_ngrams(self):
+        grams = char_ngrams("cat", 3, 4)
+        assert "<ca" in grams and "at>" in grams and "<cat" in grams
+
+    def test_train_and_oov(self):
+        ft = (FastText.Builder()
+              .minWordFrequency(1).layerSize(16).seed(9).windowSize(3)
+              .epochs(2).sampling(0).batchSize(256)
+              .iterate(synthetic_corpus(80)).build().fit())
+        assert ft.getWordVector("cat").shape == (16,)
+        # OOV word built purely from shared subword n-grams
+        oov = ft.getWordVector("cats")
+        assert oov.shape == (16,) and np.isfinite(oov).all()
+        assert ft.similarity("cat", "dog") == ft.similarity("dog", "cat")
