@@ -1,0 +1,19 @@
+"""Hyperparameter optimization (≡ arbiter)."""
+from deeplearning4j_tpu.arbiter.spaces import (ContinuousParameterSpace,
+                                               DiscreteParameterSpace,
+                                               FixedValue,
+                                               IntegerParameterSpace,
+                                               ParameterSpace)
+from deeplearning4j_tpu.arbiter.runner import (CandidateGenerator,
+                                               GridSearchCandidateGenerator,
+                                               LocalOptimizationRunner,
+                                               OptimizationResult,
+                                               RandomSearchGenerator,
+                                               TPEGenerator)
+
+__all__ = [
+    "ContinuousParameterSpace", "DiscreteParameterSpace", "FixedValue",
+    "IntegerParameterSpace", "ParameterSpace", "CandidateGenerator",
+    "GridSearchCandidateGenerator", "LocalOptimizationRunner",
+    "OptimizationResult", "RandomSearchGenerator", "TPEGenerator",
+]

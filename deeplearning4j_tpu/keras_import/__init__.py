@@ -1,0 +1,5 @@
+"""Keras model import (≡ deeplearning4j-modelimport)."""
+from deeplearning4j_tpu.keras_import.keras_import import (
+    InvalidKerasConfigurationException, KerasModelImport)
+
+__all__ = ["InvalidKerasConfigurationException", "KerasModelImport"]

@@ -1,0 +1,384 @@
+"""Keras model import (≡ deeplearning4j-modelimport ::
+org.deeplearning4j.nn.modelimport.keras.KerasModelImport,
+KerasSequentialModel, KerasModel).
+
+Parses Keras JSON configs (Sequential and Functional) into the native
+builder DSL — the import path produces the SAME MultiLayerConfiguration /
+ComputationGraphConfiguration a user would write by hand, so imported
+models get the identical jitted train/inference path. Weights load from
+Keras .h5 files via h5py (present in this environment); layouts match
+natively (NHWC conv kernels are HWIO in both stacks — no OIHW transpose
+dance like the reference's KerasConvolutionUtils).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_vertices import (ElementWiseVertex,
+                                                       MergeVertex)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                               BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               DropoutLayer, EmbeddingLayer,
+                                               GlobalPoolingLayer,
+                                               OutputLayer,
+                                               SeparableConvolution2D,
+                                               SubsamplingLayer, Upsampling2D,
+                                               ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer, SimpleRnn
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+_ACTIVATIONS = {
+    "linear": "identity", "relu": "relu", "relu6": "relu6",
+    "sigmoid": "sigmoid", "tanh": "tanh", "softmax": "softmax",
+    "elu": "elu", "selu": "selu", "softplus": "softplus",
+    "softsign": "softsign", "hard_sigmoid": "hardsigmoid",
+    "swish": "swish", "silu": "swish", "gelu": "gelu",
+    "leaky_relu": "leakyrelu", "exponential": "exp", "mish": "mish",
+}
+
+_INITIALIZERS = {
+    "GlorotUniform": "xavier_uniform", "glorot_uniform": "xavier_uniform",
+    "GlorotNormal": "xavier", "glorot_normal": "xavier",
+    "HeNormal": "relu", "he_normal": "relu",
+    "HeUniform": "relu_uniform", "he_uniform": "relu_uniform",
+    "LecunNormal": "lecun", "lecun_normal": "lecun",
+    "LecunUniform": "lecun_uniform", "lecun_uniform": "lecun_uniform",
+    "RandomNormal": "normal", "random_normal": "normal",
+    "RandomUniform": "uniform", "random_uniform": "uniform",
+    "Zeros": "zero", "zeros": "zero", "Ones": "ones", "ones": "ones",
+}
+
+
+class InvalidKerasConfigurationException(ValueError):
+    """≡ modelimport.keras.exceptions.InvalidKerasConfigurationException."""
+
+
+def _map_activation(name):
+    if name is None:
+        return "identity"
+    act = _ACTIVATIONS.get(name)
+    if act is None:
+        raise InvalidKerasConfigurationException(
+            f"Unsupported Keras activation: {name!r}")
+    return act
+
+
+def _map_init(cfg):
+    if not cfg:
+        return "xavier_uniform"
+    name = cfg.get("class_name", cfg) if isinstance(cfg, dict) else cfg
+    return _INITIALIZERS.get(name, "xavier_uniform")
+
+
+def _loss_for_activation(act):
+    """No training_config in a bare architecture JSON → pick the loss the
+    reference's enforceTrainingConfig=false path would allow fine-tuning
+    with: softmax→MCXENT, sigmoid→XENT, else MSE."""
+    return {"softmax": "mcxent", "sigmoid": "xent"}.get(act, "mse")
+
+
+def _keras_input_type(batch_shape):
+    dims = [d for d in batch_shape[1:]]
+    if len(dims) == 3:
+        return InputType.convolutional(dims[0], dims[1], dims[2])
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1])
+    if len(dims) == 1:
+        return InputType.feedForward(dims[0])
+    raise InvalidKerasConfigurationException(
+        f"Unsupported input shape: {batch_shape}")
+
+
+def _convert_layer(class_name, cfg, is_last=False):
+    """One Keras layer config → our layer instance (or None to skip)."""
+    act = _map_activation(cfg.get("activation", "linear"))
+    init = _map_init(cfg.get("kernel_initializer"))
+    bias = cfg.get("use_bias", True)
+
+    if class_name == "Dense":
+        if is_last:
+            return OutputLayer(nOut=cfg["units"], activation=act,
+                               lossFunction=_loss_for_activation(act),
+                               weightInit=init, hasBias=bias)
+        return DenseLayer(nOut=cfg["units"], activation=act,
+                          weightInit=init, hasBias=bias)
+    if class_name in ("Conv2D", "Convolution2D"):
+        return ConvolutionLayer(
+            nOut=cfg["filters"], kernelSize=tuple(cfg["kernel_size"]),
+            stride=tuple(cfg.get("strides", (1, 1))),
+            convolutionMode=cfg.get("padding", "valid"),
+            activation=act, weightInit=init, hasBias=bias)
+    if class_name == "SeparableConv2D":
+        return SeparableConvolution2D(
+            nOut=cfg["filters"], kernelSize=tuple(cfg["kernel_size"]),
+            stride=tuple(cfg.get("strides", (1, 1))),
+            convolutionMode=cfg.get("padding", "valid"),
+            activation=act, weightInit=init, hasBias=bias)
+    if class_name in ("MaxPooling2D", "AveragePooling2D"):
+        pool = "max" if class_name.startswith("Max") else "avg"
+        size = tuple(cfg.get("pool_size", (2, 2)))
+        return SubsamplingLayer(
+            poolingType=pool, kernelSize=size,
+            stride=tuple(cfg.get("strides") or size),
+            convolutionMode=cfg.get("padding", "valid"))
+    if class_name in ("GlobalAveragePooling2D", "GlobalMaxPooling2D",
+                      "GlobalAveragePooling1D", "GlobalMaxPooling1D"):
+        return GlobalPoolingLayer(
+            poolingType="avg" if "Average" in class_name else "max")
+    if class_name == "BatchNormalization":
+        return BatchNormalization(eps=cfg.get("epsilon", 1e-3),
+                                  decay=cfg.get("momentum", 0.99))
+    if class_name == "Dropout":
+        return DropoutLayer(dropOut=1.0 - float(cfg.get("rate", 0.5)))
+    if class_name == "Activation":
+        return ActivationLayer(activation=act)
+    if class_name == "ZeroPadding2D":
+        pad = cfg.get("padding", 1)
+        return ZeroPaddingLayer(padding=pad)
+    if class_name == "UpSampling2D":
+        size = cfg.get("size", (2, 2))
+        return Upsampling2D(size=size[0] if isinstance(
+            size, (list, tuple)) else size)
+    if class_name == "Embedding":
+        return EmbeddingLayer(nIn=cfg["input_dim"], nOut=cfg["output_dim"])
+    if class_name == "LSTM":
+        if is_last:
+            return RnnOutputLayer(nOut=cfg["units"], activation=act,
+                                  lossFunction=_loss_for_activation(act))
+        return LSTM(nOut=cfg["units"], activation=act,
+                    gateActivationFn=_map_activation(
+                        cfg.get("recurrent_activation", "sigmoid")),
+                    weightInit=init)
+    if class_name == "SimpleRNN":
+        return SimpleRnn(nOut=cfg["units"], activation=act, weightInit=init)
+    if class_name in ("Flatten", "Reshape", "InputLayer"):
+        return None  # shape plumbing — the builder's InputType inference
+    raise InvalidKerasConfigurationException(
+        f"Unsupported Keras layer: {class_name}")
+
+
+def _load_json(path_or_json):
+    if isinstance(path_or_json, dict):
+        return path_or_json
+    s = str(path_or_json)
+    if os.path.exists(s):
+        with open(s) as f:
+            return json.load(f)
+    return json.loads(s)
+
+
+class KerasModelImport:
+    @staticmethod
+    def importKerasSequentialConfiguration(path_or_json, inputType=None):
+        """Sequential architecture JSON → MultiLayerConfiguration."""
+        model = _load_json(path_or_json)
+        if model.get("class_name") != "Sequential":
+            raise InvalidKerasConfigurationException(
+                f"Not a Sequential model: {model.get('class_name')}")
+        layer_cfgs = model["config"]
+        if isinstance(layer_cfgs, dict):
+            layer_cfgs = layer_cfgs["layers"]
+        b = NeuralNetConfiguration.Builder().list()
+        converted = []
+        for i, lc in enumerate(layer_cfgs):
+            cls, cfg = lc["class_name"], lc.get("config", {})
+            if inputType is None and (
+                    "batch_input_shape" in cfg or "batch_shape" in cfg):
+                inputType = _keras_input_type(
+                    cfg.get("batch_input_shape") or cfg["batch_shape"])
+            layer = _convert_layer(cls, cfg,
+                                   is_last=(i == len(layer_cfgs) - 1))
+            if layer is not None:
+                layer.name = cfg.get("name", f"layer{i}")
+                converted.append(layer)
+                b.layer(layer)
+        if inputType is None:
+            raise InvalidKerasConfigurationException(
+                "No batch_input_shape in config; pass inputType=")
+        return b.setInputType(inputType).build()
+
+    @staticmethod
+    def importKerasSequentialModelAndWeights(config_path, weights_path=None,
+                                             inputType=None):
+        conf = KerasModelImport.importKerasSequentialConfiguration(
+            config_path, inputType)
+        net = MultiLayerNetwork(conf).init()
+        if weights_path is not None:
+            _load_h5_weights_multilayer(net, weights_path)
+        return net
+
+    @staticmethod
+    def importKerasModelConfiguration(path_or_json, inputTypes=None):
+        """Functional-API JSON → ComputationGraphConfiguration."""
+        model = _load_json(path_or_json)
+        if model.get("class_name") not in ("Model", "Functional"):
+            raise InvalidKerasConfigurationException(
+                f"Not a functional model: {model.get('class_name')}")
+        cfg = model["config"]
+        g = NeuralNetConfiguration.Builder().graphBuilder()
+        input_names, input_types = [], []
+        layer_list = cfg["layers"]
+        for lc in layer_list:
+            cls, c, name = lc["class_name"], lc.get("config", {}), None
+            name = c.get("name") or lc.get("name")
+            inbound = _inbound_names(lc)
+            if cls == "InputLayer":
+                input_names.append(name)
+                shape = c.get("batch_input_shape") or c.get("batch_shape")
+                input_types.append(_keras_input_type(shape))
+                continue
+            is_output = any(name == (o[0] if isinstance(o, list) else o)
+                            for o in _output_names(cfg))
+            if cls in ("Add", "Subtract", "Multiply", "Average", "Maximum"):
+                op = {"Add": "add", "Subtract": "subtract",
+                      "Multiply": "product", "Average": "average",
+                      "Maximum": "max"}[cls]
+                g.addVertex(name, ElementWiseVertex(op), *inbound)
+                continue
+            if cls == "Concatenate":
+                g.addVertex(name, MergeVertex(), *inbound)
+                continue
+            layer = _convert_layer(cls, c, is_last=is_output)
+            if layer is None:  # Flatten etc: alias to its input
+                g.addVertex(name, _IdentityAlias(), *inbound)
+                continue
+            g.addLayer(name, layer, *inbound)
+        g.addInputs(*input_names)
+        g.setInputTypes(*(inputTypes or input_types))
+        g.setOutputs(*[o[0] if isinstance(o, list) else o
+                       for o in _output_names(cfg)])
+        return g.build()
+
+    @staticmethod
+    def importKerasModelAndWeights(config_path, weights_path=None,
+                                   inputTypes=None):
+        conf = KerasModelImport.importKerasModelConfiguration(
+            config_path, inputTypes)
+        net = ComputationGraph(conf).init()
+        if weights_path is not None:
+            _load_h5_weights_graph(net, weights_path)
+        return net
+
+
+def _inbound_names(layer_cfg):
+    out = []
+    for node in layer_cfg.get("inbound_nodes", []):
+        if isinstance(node, dict):  # keras 3 style {"args": [...]}
+            for a in node.get("args", []):
+                out.extend(_extract_history(a))
+        else:
+            for ref in node:
+                out.append(ref[0] if isinstance(ref, list) else ref)
+    return out
+
+
+def _extract_history(arg):
+    if isinstance(arg, dict) and "config" in arg:
+        kh = arg["config"].get("keras_history")
+        if kh:
+            return [kh[0]]
+    if isinstance(arg, list):
+        out = []
+        for a in arg:
+            out.extend(_extract_history(a))
+        return out
+    return []
+
+
+def _output_names(cfg):
+    outs = cfg.get("output_layers", [])
+    return outs if isinstance(outs, list) else [outs]
+
+
+class _IdentityAlias:
+    """Pass-through vertex for Keras shape-only layers (Flatten/Reshape);
+    our builder handles layout via input preprocessors."""
+
+    def output_type(self, *input_types):
+        return input_types[0]
+
+    def apply(self, *xs, mask=None):
+        return xs[0]
+
+
+# -- .h5 weight loading (gated on h5py, which this image ships) ----------
+def _h5_layer_weights(weights_path):
+    import h5py
+    out = {}
+    with h5py.File(weights_path, "r") as f:
+        grp = f["model_weights"] if "model_weights" in f else f
+        for lname in grp:
+            sub = grp[lname]
+            arrs = []
+
+            def visit(_, obj):
+                if hasattr(obj, "shape"):
+                    arrs.append(np.array(obj))
+            sub.visititems(visit)
+            if arrs:
+                out[lname] = arrs
+    return out
+
+
+def _assign_keras_weights(layer_params, arrs, layer_state=None):
+    """Match Keras save order onto our param dicts by shape."""
+    for arr in arrs:
+        placed = False
+        for key, val in layer_params.items():
+            import numpy as _np
+            if tuple(val.shape) == tuple(arr.shape) and not placed:
+                if key == "W" and arr.ndim == 2 and "U" in layer_params:
+                    # LSTM kernel: keras gate order i,f,g,o → ours i,f,o,g
+                    n = arr.shape[1] // 4
+                    arr = _np.concatenate(
+                        [arr[:, :n], arr[:, n:2 * n], arr[:, 3 * n:],
+                         arr[:, 2 * n:3 * n]], axis=1)
+                layer_params[key] = arr
+                placed = True
+        if not placed and layer_state is not None:
+            for key, val in layer_state.items():
+                if tuple(val.shape) == tuple(arr.shape):
+                    layer_state[key] = arr
+                    break
+
+
+def _load_h5_weights_multilayer(net, weights_path):
+    by_name = _h5_layer_weights(weights_path)
+    named = [lyr for lyr in net.conf.layers if getattr(lyr, "name", None)]
+    for li, lyr in enumerate(net.conf.layers):
+        name = getattr(lyr, "name", None)
+        if name in by_name and str(li) in net._params:
+            import jax.numpy as jnp
+            params = {k: np.array(v) for k, v in net._params[str(li)].items()}
+            state = {k: np.array(v)
+                     for k, v in net._state.get(str(li), {}).items()}
+            _assign_keras_weights(params, by_name[name], state)
+            net._params[str(li)] = {k: jnp.asarray(v)
+                                    for k, v in params.items()}
+            if state:
+                net._state[str(li)] = {k: jnp.asarray(v)
+                                       for k, v in state.items()}
+    return net
+
+
+def _load_h5_weights_graph(net, weights_path):
+    by_name = _h5_layer_weights(weights_path)
+    import jax.numpy as jnp
+    for name, arrs in by_name.items():
+        if name in net._params:
+            params = {k: np.array(v) for k, v in net._params[name].items()}
+            state = {k: np.array(v)
+                     for k, v in net._state.get(name, {}).items()}
+            _assign_keras_weights(params, arrs, state)
+            net._params[name] = {k: jnp.asarray(v) for k, v in params.items()}
+            if state:
+                net._state[name] = {k: jnp.asarray(v)
+                                    for k, v in state.items()}
+    return net

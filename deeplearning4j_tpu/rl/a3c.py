@@ -1,0 +1,221 @@
+"""Advantage actor-critic + n-step Q (≡ rl4j-core :: learning.async.
+a3c.discrete.A3CDiscreteDense, nstep.discrete.AsyncNStepQLearningDiscreteDense,
+and the REINFORCE-style policy-gradient family).
+
+Architectural inversion: the reference decorrelates experience with MANY
+async CPU threads each running its own env + a shared lock-free global
+net (Hogwild-style). On TPU the same decorrelation comes from BATCHED
+environments: N env instances step host-side, and one jitted
+actor-critic update consumes the whole (N, T) rollout — n-step advantage
+returns computed in the XLA graph, policy + value + entropy losses fused
+into a single executable. Same estimator, hardware-shaped execution.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def _mlp_init(key, sizes):
+    params = []
+    for n_in, n_out in zip(sizes[:-1], sizes[1:]):
+        key, k = jax.random.split(key)
+        w = jax.random.normal(k, (n_in, n_out)) * jnp.sqrt(2.0 / n_in)
+        params.append({"w": w, "b": jnp.zeros((n_out,))})
+    return params
+
+
+def _mlp_apply(params, x):
+    for i, lyr in enumerate(params):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+class A3CConfiguration:
+    """≡ A3CLearningConfiguration (numThread → numEnvs)."""
+
+    def __init__(self, seed=123, maxEpochStep=200, maxStep=20000,
+                 numEnvs=8, nstep=5, gamma=0.99, learningRate=7e-4,
+                 entropyCoef=0.01, valueCoef=0.5, hiddenNodes=64,
+                 numLayers=2):
+        self.seed = seed
+        self.maxEpochStep = maxEpochStep
+        self.maxStep = maxStep
+        self.numEnvs = numEnvs
+        self.nstep = nstep
+        self.gamma = gamma
+        self.learningRate = learningRate
+        self.entropyCoef = entropyCoef
+        self.valueCoef = valueCoef
+        self.hiddenNodes = hiddenNodes
+        self.numLayers = numLayers
+
+
+class A3CDiscreteDense:
+    """Batched-env A2C with the A3CDiscreteDense training surface."""
+
+    def __init__(self, mdp_factory, conf=None):
+        self.conf = conf or A3CConfiguration()
+        c = self.conf
+        self.envs = [mdp_factory() for _ in range(c.numEnvs)]
+        obs_dim = int(np.prod(self.envs[0].getObservationSpace().shape))
+        self.num_actions = self.envs[0].getActionSpace().getSize()
+        key = jax.random.PRNGKey(c.seed)
+        k1, k2, k3 = jax.random.split(key, 3)
+        body_sizes = [obs_dim] + [c.hiddenNodes] * c.numLayers
+        self.params = {
+            "body": _mlp_init(k1, body_sizes),
+            "pi": _mlp_init(k2, [c.hiddenNodes, self.num_actions]),
+            "v": _mlp_init(k3, [c.hiddenNodes, 1]),
+        }
+        self.tx = optax.rmsprop(c.learningRate, decay=0.99, eps=1e-5)
+        self.opt_state = self.tx.init(self.params)
+        self._rng = np.random.default_rng(c.seed)
+        self.step_count = 0
+        self.episode_rewards = []
+        self._ep_acc = np.zeros(c.numEnvs)
+        self._update = self._build_update()
+
+    # -- jitted policy/value ---------------------------------------------
+    @functools.partial(jax.jit, static_argnums=0)
+    def _logits_values(self, params, obs):
+        h = _mlp_apply(params["body"], obs)
+        return _mlp_apply(params["pi"], h), _mlp_apply(params["v"], h)[..., 0]
+
+    def _build_update(self):
+        c = self.conf
+        tx = self.tx
+
+        @jax.jit
+        def update(params, opt_state, obs, actions, returns):
+            """obs: (N*T, D); returns: n-step bootstrapped targets."""
+
+            def loss_fn(p):
+                h = _mlp_apply(p["body"], obs)
+                logits = _mlp_apply(p["pi"], h)
+                values = _mlp_apply(p["v"], h)[..., 0]
+                logp = jax.nn.log_softmax(logits)
+                probs = jax.nn.softmax(logits)
+                adv = returns - values
+                chosen = jnp.take_along_axis(
+                    logp, actions[:, None], axis=-1)[:, 0]
+                pg_loss = -(chosen * jax.lax.stop_gradient(adv)).mean()
+                v_loss = (adv ** 2).mean()
+                entropy = -(probs * logp).sum(-1).mean()
+                return (pg_loss + c.valueCoef * v_loss
+                        - c.entropyCoef * entropy)
+
+            grads = jax.grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        return update
+
+    def _act(self, obs_batch):
+        logits, values = self._logits_values(self.params,
+                                             jnp.asarray(obs_batch))
+        probs = np.asarray(jax.nn.softmax(logits))
+        actions = np.array([self._rng.choice(self.num_actions, p=p / p.sum())
+                            for p in probs], np.int32)
+        return actions, np.asarray(values)
+
+    def train(self):
+        c = self.conf
+        obs = np.stack([e.reset() for e in self.envs]).astype(np.float32)
+        while self.step_count < c.maxStep:
+            roll_obs, roll_act, roll_rew, roll_done = [], [], [], []
+            for _ in range(c.nstep):
+                actions, _ = self._act(obs)
+                next_obs = np.empty_like(obs)
+                rewards = np.zeros(c.numEnvs, np.float32)
+                dones = np.zeros(c.numEnvs, np.float32)
+                for i, env in enumerate(self.envs):
+                    o, r, d, _ = env.step(int(actions[i]))
+                    self._ep_acc[i] += r
+                    if d:
+                        self.episode_rewards.append(self._ep_acc[i])
+                        self._ep_acc[i] = 0.0
+                        o = env.reset()
+                    next_obs[i], rewards[i], dones[i] = o, r, float(d)
+                roll_obs.append(obs.copy())
+                roll_act.append(actions)
+                roll_rew.append(rewards)
+                roll_done.append(dones)
+                obs = next_obs
+                self.step_count += c.numEnvs
+            # n-step bootstrapped returns (host; tiny T loop)
+            _, boot = self._act(obs)
+            returns = np.zeros((c.nstep, c.numEnvs), np.float32)
+            running = boot
+            for t in reversed(range(c.nstep)):
+                running = roll_rew[t] + c.gamma * running * (1 - roll_done[t])
+                returns[t] = running
+            self.params, self.opt_state = self._update(
+                self.params, self.opt_state,
+                jnp.asarray(np.concatenate(roll_obs)),
+                jnp.asarray(np.concatenate(roll_act)),
+                jnp.asarray(returns.reshape(-1)))
+        return self.episode_rewards
+
+    # -- play surface -----------------------------------------------------
+    def nextAction(self, obs):
+        logits, _ = self._logits_values(self.params,
+                                        jnp.asarray(obs[None]))
+        return int(np.argmax(np.asarray(logits)[0]))
+
+    def play(self, mdp, max_steps=10000):
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            obs, r, done, _ = mdp.step(self.nextAction(obs))
+            total += r
+            if done:
+                break
+        return total
+
+
+class AsyncNStepQLearningDiscreteDense(A3CDiscreteDense):
+    """≡ AsyncNStepQLearningDiscreteDense — same batched-env rollout
+    machinery but a pure Q head trained on n-step returns (no policy
+    head; ε-greedy behaviour policy)."""
+
+    def __init__(self, mdp_factory, conf=None, minEpsilon=0.1,
+                 epsilonNbStep=5000):
+        super().__init__(mdp_factory, conf)
+        self.minEpsilon = minEpsilon
+        self.epsilonNbStep = epsilonNbStep
+        # reuse pi head as the Q head; drop the value head from updates
+        tx = self.tx
+        c = self.conf
+
+        @jax.jit
+        def update(params, opt_state, obs, actions, returns):
+            def loss_fn(p):
+                q = _mlp_apply(p["pi"], _mlp_apply(p["body"], obs))
+                chosen = jnp.take_along_axis(
+                    q, actions[:, None], axis=-1)[:, 0]
+                return ((returns - chosen) ** 2).mean()
+
+            grads = jax.grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._update = update
+
+    def _act(self, obs_batch):
+        logits, values = self._logits_values(self.params,
+                                             jnp.asarray(obs_batch))
+        q = np.asarray(logits)
+        frac = min(1.0, self.step_count / max(1, self.epsilonNbStep))
+        eps = 1.0 + frac * (self.minEpsilon - 1.0)
+        actions = q.argmax(-1).astype(np.int32)
+        explore = self._rng.random(len(actions)) < eps
+        actions[explore] = self._rng.integers(
+            self.num_actions, size=int(explore.sum()))
+        return actions, q.max(-1)

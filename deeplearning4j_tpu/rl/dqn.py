@@ -1,0 +1,186 @@
+"""Deep Q-learning (≡ rl4j-core :: learning.sync.qlearning.discrete.
+QLearningDiscrete / QLearningDiscreteDense, network.dqn.DQNFactoryStdDense,
+policy.EpsGreedy / DQNPolicy).
+
+The Q-network is a regular MultiLayerNetwork (MSE head) built by
+DQNFactoryStdDense — exactly the reference's wiring — so each TD update
+is the framework's single jitted donated train step; the target network
+is a deep clone refreshed every `targetDqnUpdateFreq` steps. Double-DQN
+(argmax from the online net, value from the target net) is on by default
+as in the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
+
+
+class QLearningConfiguration:
+    """≡ QLearning.QLConfiguration (builder-style kwargs)."""
+
+    def __init__(self, seed=123, maxEpochStep=200, maxStep=10000,
+                 expRepMaxSize=150000, batchSize=32, targetDqnUpdateFreq=100,
+                 updateStart=10, rewardFactor=1.0, gamma=0.99,
+                 errorClamp=1.0, minEpsilon=0.1, epsilonNbStep=3000,
+                 doubleDQN=True):
+        self.seed = seed
+        self.maxEpochStep = maxEpochStep
+        self.maxStep = maxStep
+        self.expRepMaxSize = expRepMaxSize
+        self.batchSize = batchSize
+        self.targetDqnUpdateFreq = targetDqnUpdateFreq
+        self.updateStart = updateStart
+        self.rewardFactor = rewardFactor
+        self.gamma = gamma
+        self.errorClamp = errorClamp
+        self.minEpsilon = minEpsilon
+        self.epsilonNbStep = epsilonNbStep
+        self.doubleDQN = doubleDQN
+
+
+class DQNDenseNetworkConfiguration:
+    """≡ network.configuration.DQNDenseNetworkConfiguration."""
+
+    def __init__(self, numLayers=2, numHiddenNodes=64, learningRate=1e-3,
+                 l2=0.0, updater=None):
+        self.numLayers = numLayers
+        self.numHiddenNodes = numHiddenNodes
+        self.learningRate = learningRate
+        self.l2 = l2
+        self.updater = updater
+
+
+class DQNFactoryStdDense:
+    """≡ network.dqn.DQNFactoryStdDense — builds the MLP Q-network."""
+
+    def __init__(self, conf: DQNDenseNetworkConfiguration):
+        self.conf = conf
+
+    def buildDQN(self, obs_dim, num_actions, seed=123):
+        c = self.conf
+        b = (NeuralNetConfiguration.Builder()
+             .seed(seed)
+             .updater(c.updater or Adam(c.learningRate))
+             .weightInit("xavier")
+             .l2(c.l2)
+             .list())
+        for _ in range(c.numLayers):
+            b.layer(DenseLayer(nOut=c.numHiddenNodes, activation="relu"))
+        b.layer(OutputLayer(lossFunction="mse", nOut=num_actions,
+                            activation="identity"))
+        return MultiLayerNetwork(
+            b.setInputType(InputType.feedForward(obs_dim)).build()).init()
+
+
+class EpsGreedy:
+    """≡ policy.EpsGreedy — linear ε annealing over epsilonNbStep."""
+
+    def __init__(self, conf: QLearningConfiguration, rng):
+        self.conf = conf
+        self.rng = rng
+        self.step = 0
+
+    def epsilon(self):
+        c = self.conf
+        frac = min(1.0, self.step / max(1, c.epsilonNbStep))
+        return 1.0 + frac * (c.minEpsilon - 1.0)
+
+    def nextAction(self, q_values, action_space):
+        self.step += 1
+        if self.rng.random() < self.epsilon():
+            return action_space.randomAction(self.rng)
+        return int(np.argmax(q_values))
+
+
+class DQNPolicy:
+    """≡ policy.DQNPolicy — greedy play with a trained Q-network."""
+
+    def __init__(self, network):
+        self.network = network
+
+    def nextAction(self, obs):
+        q = np.asarray(self.network.output(obs[None]))[0]
+        return int(np.argmax(q))
+
+    def play(self, mdp, max_steps=10000):
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            obs, r, done, _ = mdp.step(self.nextAction(obs))
+            total += r
+            if done:
+                break
+        return total
+
+
+class QLearningDiscreteDense:
+    """≡ QLearningDiscreteDense — sync DQN over an MDP with dense obs."""
+
+    def __init__(self, mdp, net_conf, ql_conf=None):
+        self.mdp = mdp
+        self.conf = ql_conf or QLearningConfiguration()
+        if isinstance(net_conf, DQNDenseNetworkConfiguration):
+            net_conf = DQNFactoryStdDense(net_conf)
+        obs_dim = int(np.prod(mdp.getObservationSpace().shape))
+        self.num_actions = mdp.getActionSpace().getSize()
+        self.net = net_conf.buildDQN(obs_dim, self.num_actions,
+                                     self.conf.seed)
+        self.target = self.net.clone()
+        self._rng = np.random.default_rng(self.conf.seed)
+        self.replay = ExpReplay(self.conf.expRepMaxSize,
+                                self.conf.batchSize, self.conf.seed)
+        self.policy = EpsGreedy(self.conf, self._rng)
+        self.step_count = 0
+        self.epoch_rewards = []
+
+    def getPolicy(self):
+        return DQNPolicy(self.net)
+
+    def _learn_batch(self):
+        obs, actions, rewards, next_obs, dones = self.replay.getBatch()
+        c = self.conf
+        q_next_t = np.asarray(self.target.output(next_obs))
+        if c.doubleDQN:
+            best = np.asarray(self.net.output(next_obs)).argmax(-1)
+            boot = q_next_t[np.arange(len(best)), best]
+        else:
+            boot = q_next_t.max(-1)
+        td_target = rewards * c.rewardFactor + c.gamma * boot * (1 - dones)
+        q = np.array(self.net.output(obs))  # copy: jax buffers are read-only
+        err = td_target - q[np.arange(len(actions)), actions]
+        if c.errorClamp:
+            err = np.clip(err, -c.errorClamp, c.errorClamp)
+        q[np.arange(len(actions)), actions] += err
+        self.net.fit(obs, q)
+
+    def train(self):
+        """Run until maxStep env steps; returns per-epoch reward list."""
+        c = self.conf
+        while self.step_count < c.maxStep:
+            obs = self.mdp.reset()
+            ep_reward, ep_steps = 0.0, 0
+            while not self.mdp.isDone() and ep_steps < c.maxEpochStep \
+                    and self.step_count < c.maxStep:
+                q = np.asarray(self.net.output(obs[None]))[0]
+                action = self.policy.nextAction(
+                    q, self.mdp.getActionSpace())
+                next_obs, reward, done, _ = self.mdp.step(action)
+                self.replay.store(
+                    Transition(obs, action, reward, next_obs, done))
+                obs = next_obs
+                ep_reward += reward
+                ep_steps += 1
+                self.step_count += 1
+                if (self.step_count > c.updateStart
+                        and len(self.replay) >= c.batchSize):
+                    self._learn_batch()
+                if self.step_count % c.targetDqnUpdateFreq == 0:
+                    self.target.setParams(self.net.params())
+            self.epoch_rewards.append(ep_reward)
+        return self.epoch_rewards

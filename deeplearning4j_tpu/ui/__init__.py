@@ -1,0 +1,7 @@
+"""UI / monitoring (≡ deeplearning4j-ui)."""
+from deeplearning4j_tpu.ui.stats import (FileStatsStorage,
+                                         InMemoryStatsStorage, StatsListener)
+from deeplearning4j_tpu.ui.server import UIServer, render_static_html
+
+__all__ = ["FileStatsStorage", "InMemoryStatsStorage", "StatsListener",
+           "UIServer", "render_static_html"]

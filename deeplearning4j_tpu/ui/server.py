@@ -1,0 +1,162 @@
+"""Training dashboard (≡ deeplearning4j-ui :: UIServer + the Play/Vertx
+web dashboard).
+
+Two forms, both dependency-free:
+- `UIServer.getInstance().attach(storage)` then `start()` — a stdlib
+  http.server on a background thread: `/` serves the dashboard page,
+  `/stats` the JSON records the page polls every second.
+- `render_static_html(storage, path)` — a self-contained HTML snapshot
+  (inline SVG charts) for environments without an open port.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu training</title>
+<style>
+body{font-family:system-ui,sans-serif;margin:24px;background:#fafafa}
+h1{font-size:18px} .chart{background:#fff;border:1px solid #ddd;
+border-radius:6px;padding:12px;margin-bottom:16px}
+svg{width:100%;height:220px}
+.meta{color:#666;font-size:13px}
+</style></head><body>
+<h1>Training dashboard</h1>
+<div class="meta" id="meta">waiting for stats…</div>
+<div class="chart"><h2>Score vs iteration</h2><svg id="score"></svg></div>
+<div class="chart"><h2>Iteration time (ms)</h2><svg id="time"></svg></div>
+<script>
+function poly(svg, xs, ys, color){
+  const el = document.getElementById(svg);
+  if (xs.length < 2){ return; }
+  const W = el.clientWidth || 600, H = 220, P = 30;
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  const ymin = Math.min(...ys), ymax = Math.max(...ys);
+  const sx = x => P + (x - xmin) / (xmax - xmin || 1) * (W - 2*P);
+  const sy = y => H - P - (y - ymin) / (ymax - ymin || 1) * (H - 2*P);
+  const pts = xs.map((x,i)=>sx(x)+","+sy(ys[i])).join(" ");
+  el.innerHTML = `<polyline fill="none" stroke="${color}" stroke-width="1.5"
+    points="${pts}"/><text x="4" y="12" font-size="11">${ymax.toFixed(4)}
+    </text><text x="4" y="${H-6}" font-size="11">${ymin.toFixed(4)}</text>`;
+}
+async function tick(){
+  const r = await fetch('/stats'); const recs = await r.json();
+  if (recs.length){
+    const last = recs[recs.length-1];
+    document.getElementById('meta').textContent =
+      `iteration ${last.iteration} · epoch ${last.epoch} · score ` +
+      last.score.toFixed(6);
+    poly('score', recs.map(r=>r.iteration), recs.map(r=>r.score), '#0a6');
+    const t = recs.filter(r=>r.iterationTimeMs != null);
+    poly('time', t.map(r=>r.iteration), t.map(r=>r.iterationTimeMs), '#06a');
+  }
+}
+setInterval(tick, 1000); tick();
+</script></body></html>"""
+
+
+class UIServer:
+    """≡ org.deeplearning4j.ui.api.UIServer (singleton surface)."""
+
+    _instance = None
+
+    def __init__(self):
+        self._storages = []
+        self._httpd = None
+        self._thread = None
+        self.port = None
+
+    @classmethod
+    def getInstance(cls):
+        if cls._instance is None:
+            cls._instance = UIServer()
+        return cls._instance
+
+    def attach(self, storage):
+        self._storages.append(storage)
+        return self
+
+    def detach(self, storage):
+        self._storages.remove(storage)
+        return self
+
+    def start(self, port=9000):
+        if self._httpd is not None:
+            return self
+        storages = self._storages
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.startswith("/stats"):
+                    recs = []
+                    for s in storages:
+                        recs.extend(s.all())
+                    body = json.dumps(recs).encode()
+                    ctype = "application/json"
+                else:
+                    body = _PAGE.encode()
+                    ctype = "text/html"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
+        return self
+
+
+def render_static_html(storage, path):
+    """Static dashboard snapshot: inline-SVG score/time charts."""
+    recs = storage.all()
+
+    def svg_line(xs, ys, color):
+        if len(xs) < 2:
+            return "<svg></svg>"
+        W, H, P = 640, 220, 30
+        xmin, xmax = min(xs), max(xs)
+        ymin, ymax = min(ys), max(ys)
+        def sx(x):
+            return P + (x - xmin) / ((xmax - xmin) or 1) * (W - 2 * P)
+        def sy(y):
+            return H - P - (y - ymin) / ((ymax - ymin) or 1) * (H - 2 * P)
+        pts = " ".join(f"{sx(x):.1f},{sy(y):.1f}" for x, y in zip(xs, ys))
+        return (f'<svg viewBox="0 0 {W} {H}">'
+                f'<polyline fill="none" stroke="{color}" stroke-width="1.5" '
+                f'points="{pts}"/>'
+                f'<text x="4" y="12" font-size="11">{ymax:.4g}</text>'
+                f'<text x="4" y="{H-6}" font-size="11">{ymin:.4g}</text>'
+                f'</svg>')
+
+    iters = [r["iteration"] for r in recs]
+    scores = [r["score"] for r in recs]
+    times = [(r["iteration"], r["iterationTimeMs"]) for r in recs
+             if r.get("iterationTimeMs") is not None]
+    html = ("<!DOCTYPE html><html><head><title>training snapshot</title>"
+            "</head><body><h1>Training snapshot</h1>"
+            f"<p>{len(recs)} records</p>"
+            "<h2>Score</h2>" + svg_line(iters, scores, "#0a6"))
+    if times:
+        html += "<h2>Iteration time (ms)</h2>" + svg_line(
+            [t[0] for t in times], [t[1] for t in times], "#06a")
+    html += "</body></html>"
+    with open(path, "w") as f:
+        f.write(html)
+    return path

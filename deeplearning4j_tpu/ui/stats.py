@@ -1,0 +1,93 @@
+"""Training stats collection (≡ deeplearning4j-ui ::
+org.deeplearning4j.ui.model.stats.StatsListener + the StatsStorage
+hierarchy: InMemoryStatsStorage / FileStatsStorage).
+
+Each iteration records score, timing, and per-layer parameter/update
+summaries (the mean-magnitude ratios the reference's dashboard charts for
+learning-rate tuning). Storage is JSON-native; FileStatsStorage appends
+JSONL so a dashboard — live server or static HTML — can tail it.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+
+class InMemoryStatsStorage:
+    """≡ InMemoryStatsStorage."""
+
+    def __init__(self):
+        self.records = []
+
+    def put(self, record):
+        self.records.append(record)
+
+    def all(self):
+        return list(self.records)
+
+    def latest(self):
+        return self.records[-1] if self.records else None
+
+
+class FileStatsStorage(InMemoryStatsStorage):
+    """≡ FileStatsStorage — JSONL append."""
+
+    def __init__(self, path):
+        super().__init__()
+        self.path = str(path)
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                self.records = [json.loads(ln) for ln in f if ln.strip()]
+
+    def put(self, record):
+        super().put(record)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+
+class StatsListener(TrainingListener):
+    """≡ StatsListener(statsStorage, frequency)."""
+
+    def __init__(self, storage=None, frequency=1):
+        self.storage = storage if storage is not None \
+            else InMemoryStatsStorage()
+        self.frequency = max(1, int(frequency))
+        self._last_time = None
+
+    def _param_summaries(self, model):
+        out = {}
+        params = getattr(model, "_params", None) or {}
+        for lname, p in params.items():
+            for pname, v in p.items():
+                arr = np.asarray(v)
+                out[f"{lname}_{pname}"] = {
+                    "meanMagnitude": float(np.abs(arr).mean()),
+                    "stdev": float(arr.std()),
+                }
+        return out
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.frequency:
+            return
+        now = time.perf_counter()
+        dt_ms = None if self._last_time is None else (
+            (now - self._last_time) * 1000.0 / self.frequency)
+        self._last_time = now
+        record = {
+            "iteration": int(iteration),
+            "epoch": int(epoch),
+            "timestamp": time.time(),
+            "score": float(model.score()),
+            "iterationTimeMs": dt_ms,
+            "params": self._param_summaries(model),
+        }
+        self.storage.put(record)
+
+    # -- convenience ------------------------------------------------------
+    def scores(self):
+        return [r["score"] for r in self.storage.all()]

@@ -1,0 +1,139 @@
+"""Arbiter tests (≡ arbiter-core TestRandomSearch / TestGridSearch) plus
+UI stats tests (≡ deeplearning4j-ui TestStatsListener) — grouped: both
+are training-harness auxiliaries."""
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.arbiter import (ContinuousParameterSpace,
+                                        DiscreteParameterSpace, FixedValue,
+                                        GridSearchCandidateGenerator,
+                                        IntegerParameterSpace,
+                                        LocalOptimizationRunner,
+                                        RandomSearchGenerator, TPEGenerator)
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   StatsListener, UIServer,
+                                   render_static_html)
+
+
+def quadratic_scorer(params):
+    """Minimum at lr=0.3, layers=3."""
+    return (params["lr"] - 0.3) ** 2 + 0.05 * (params["layers"] - 3) ** 2
+
+
+SPACE = {
+    "lr": ContinuousParameterSpace(0.01, 1.0),
+    "layers": IntegerParameterSpace(1, 6),
+    "act": DiscreteParameterSpace("relu", "tanh"),
+    "fixed": FixedValue(7),
+}
+
+
+class TestSpaces:
+    def test_sampling_bounds(self):
+        rng = np.random.default_rng(0)
+        for _ in range(50):
+            assert 0.01 <= SPACE["lr"].sample(rng) <= 1.0
+            assert 1 <= SPACE["layers"].sample(rng) <= 6
+            assert SPACE["act"].sample(rng) in ("relu", "tanh")
+            assert SPACE["fixed"].sample(rng) == 7
+
+    def test_log_space(self):
+        sp = ContinuousParameterSpace(1e-5, 1e-1, log=True)
+        rng = np.random.default_rng(1)
+        vals = [sp.sample(rng) for _ in range(200)]
+        assert min(vals) >= 1e-5 and max(vals) <= 1e-1
+        # log-uniform: median far below arithmetic midpoint
+        assert np.median(vals) < 0.02
+
+    def test_grid(self):
+        assert len(ContinuousParameterSpace(0, 1).grid(5)) == 5
+        assert IntegerParameterSpace(1, 3).grid(10) == [1, 2, 3]
+
+
+class TestRunners:
+    def test_random_search(self):
+        runner = LocalOptimizationRunner(
+            RandomSearchGenerator(SPACE, seed=0),
+            model_builder=lambda p: p, scorer=quadratic_scorer,
+            maxCandidates=40)
+        best = runner.execute()
+        assert best.score < 0.05
+        assert runner.numCandidatesCompleted() == 40
+
+    def test_grid_search_exhausts(self):
+        gen = GridSearchCandidateGenerator(
+            {"lr": ContinuousParameterSpace(0.1, 0.5),
+             "act": DiscreteParameterSpace("relu", "tanh")},
+            discretizationCount=3)
+        runner = LocalOptimizationRunner(
+            gen, model_builder=lambda p: p,
+            scorer=lambda p: (p["lr"] - 0.3) ** 2, maxCandidates=100)
+        runner.execute()
+        assert runner.numCandidatesCompleted() == 6  # 3 lr × 2 act
+        assert abs(runner.bestResult().params["lr"] - 0.3) < 1e-9
+
+    def test_tpe_beats_its_startup(self):
+        gen = TPEGenerator(SPACE, seed=3, startupTrials=8)
+        runner = LocalOptimizationRunner(
+            gen, model_builder=lambda p: p, scorer=quadratic_scorer,
+            maxCandidates=40)
+        best = runner.execute()
+        startup_best = min(r.score for r in runner.results[:8])
+        assert best.score <= startup_best
+        assert best.score < 0.05
+
+
+class _FakeModel:
+    def __init__(self):
+        self._score = 1.0
+        self._params = {"0": {"W": np.ones((3, 3)), "b": np.zeros(3)}}
+
+    def score(self):
+        self._score *= 0.9
+        return self._score
+
+
+class TestStats:
+    def test_listener_records(self):
+        lst = StatsListener(InMemoryStatsStorage(), frequency=2)
+        m = _FakeModel()
+        for i in range(6):
+            lst.iterationDone(m, i, 0)
+        recs = lst.storage.all()
+        assert len(recs) == 3  # every 2nd iteration
+        assert recs[0]["params"]["0_W"]["meanMagnitude"] == 1.0
+        assert recs[-1]["score"] < recs[0]["score"]
+
+    def test_file_storage_roundtrip(self, tmp_path):
+        p = tmp_path / "stats.jsonl"
+        st = FileStatsStorage(p)
+        st.put({"iteration": 0, "epoch": 0, "score": 0.5})
+        st2 = FileStatsStorage(p)
+        assert st2.latest()["score"] == 0.5
+
+    def test_static_html(self, tmp_path):
+        st = InMemoryStatsStorage()
+        for i in range(10):
+            st.put({"iteration": i, "epoch": 0, "score": 1.0 / (i + 1),
+                    "iterationTimeMs": 5.0})
+        out = render_static_html(st, tmp_path / "dash.html")
+        html = open(out).read()
+        assert "polyline" in html and "Score" in html
+
+    def test_live_server(self):
+        st = InMemoryStatsStorage()
+        st.put({"iteration": 1, "epoch": 0, "score": 0.25})
+        srv = UIServer.getInstance().attach(st).start(port=0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/stats") as r:
+                recs = json.loads(r.read())
+            assert recs and recs[0]["score"] == 0.25
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/") as r:
+                assert b"dashboard" in r.read()
+        finally:
+            srv.stop()
+            UIServer._instance = None

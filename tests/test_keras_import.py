@@ -1,0 +1,144 @@
+"""Keras import tests (≡ deeplearning4j-modelimport test suite:
+KerasSequentialModelImportTest / KerasModelImportTest — configs are
+hand-built JSON in Keras's schema since the env has no TF/egress)."""
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.keras_import import (
+    InvalidKerasConfigurationException, KerasModelImport)
+
+
+def seq_mlp_json():
+    return json.dumps({
+        "class_name": "Sequential",
+        "config": {"name": "mlp", "layers": [
+            {"class_name": "Dense", "config": {
+                "name": "fc1", "units": 32, "activation": "relu",
+                "batch_input_shape": [None, 10], "use_bias": True,
+                "kernel_initializer": {"class_name": "GlorotUniform"}}},
+            {"class_name": "Dropout", "config": {"name": "do", "rate": 0.2}},
+            {"class_name": "Dense", "config": {
+                "name": "out", "units": 3, "activation": "softmax"}},
+        ]}})
+
+
+def seq_cnn_json():
+    return json.dumps({
+        "class_name": "Sequential",
+        "config": {"name": "cnn", "layers": [
+            {"class_name": "Conv2D", "config": {
+                "name": "c1", "filters": 8, "kernel_size": [3, 3],
+                "strides": [1, 1], "padding": "same", "activation": "relu",
+                "batch_input_shape": [None, 28, 28, 1]}},
+            {"class_name": "BatchNormalization", "config": {
+                "name": "bn1", "epsilon": 1e-3, "momentum": 0.99}},
+            {"class_name": "MaxPooling2D", "config": {
+                "name": "p1", "pool_size": [2, 2], "strides": [2, 2],
+                "padding": "valid"}},
+            {"class_name": "Flatten", "config": {"name": "fl"}},
+            {"class_name": "Dense", "config": {
+                "name": "out", "units": 10, "activation": "softmax"}},
+        ]}})
+
+
+def functional_json():
+    return json.dumps({
+        "class_name": "Functional",
+        "config": {
+            "name": "two_branch",
+            "layers": [
+                {"class_name": "InputLayer", "config": {
+                    "name": "in", "batch_input_shape": [None, 8]},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "config": {
+                    "name": "a", "units": 16, "activation": "relu"},
+                 "inbound_nodes": [[["in", 0, 0, {}]]]},
+                {"class_name": "Dense", "config": {
+                    "name": "b", "units": 16, "activation": "relu"},
+                 "inbound_nodes": [[["in", 0, 0, {}]]]},
+                {"class_name": "Add", "config": {"name": "add"},
+                 "inbound_nodes": [[["a", 0, 0, {}], ["b", 0, 0, {}]]]},
+                {"class_name": "Dense", "config": {
+                    "name": "out", "units": 4, "activation": "softmax"},
+                 "inbound_nodes": [[["add", 0, 0, {}]]]},
+            ],
+            "input_layers": [["in", 0, 0]],
+            "output_layers": [["out", 0, 0]],
+        }})
+
+
+class TestSequentialImport:
+    def test_mlp_forward(self):
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            seq_mlp_json())
+        x = np.random.default_rng(0).normal(size=(4, 10)).astype(np.float32)
+        y = np.asarray(net.output(x))
+        assert y.shape == (4, 3)
+        assert np.allclose(y.sum(-1), 1.0, atol=1e-5)  # softmax head
+
+    def test_cnn_forward(self):
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            seq_cnn_json())
+        x = np.random.default_rng(1).normal(
+            size=(2, 28, 28, 1)).astype(np.float32)
+        y = np.asarray(net.output(x))
+        assert y.shape == (2, 10)
+
+    def test_trainable(self):
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            seq_mlp_json())
+        x = np.random.default_rng(2).normal(size=(8, 10)).astype(np.float32)
+        labels = np.eye(3, dtype=np.float32)[
+            np.random.default_rng(3).integers(3, size=8)]
+        s0 = None
+        for _ in range(5):
+            net.fit(x, labels)
+        assert np.isfinite(float(net.score()))
+
+    def test_rejects_functional_as_sequential(self):
+        with pytest.raises(InvalidKerasConfigurationException):
+            KerasModelImport.importKerasSequentialConfiguration(
+                functional_json())
+
+
+class TestFunctionalImport:
+    def test_two_branch_forward(self):
+        net = KerasModelImport.importKerasModelAndWeights(functional_json())
+        x = np.random.default_rng(4).normal(size=(3, 8)).astype(np.float32)
+        y = np.asarray(net.output(x)[0] if isinstance(net.output(x), (list,
+                       tuple)) else net.output(x))
+        assert y.shape == (3, 4)
+
+
+class TestH5Weights:
+    def test_dense_weights_load(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        # build an h5 file in Keras's model_weights layout
+        rng = np.random.default_rng(5)
+        k1 = rng.normal(size=(10, 32)).astype(np.float32)
+        b1 = rng.normal(size=(32,)).astype(np.float32)
+        k2 = rng.normal(size=(32, 3)).astype(np.float32)
+        b2 = rng.normal(size=(3,)).astype(np.float32)
+        p = tmp_path / "w.h5"
+        with h5py.File(p, "w") as f:
+            g = f.create_group("model_weights")
+            fc1 = g.create_group("fc1").create_group("fc1")
+            fc1.create_dataset("kernel:0", data=k1)
+            fc1.create_dataset("bias:0", data=b1)
+            out = g.create_group("out").create_group("out")
+            out.create_dataset("kernel:0", data=k2)
+            out.create_dataset("bias:0", data=b2)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            seq_mlp_json(), str(p))
+        loaded_k1 = np.asarray(net._params["0"]["W"])
+        assert np.allclose(loaded_k1, k1)
+        # forward must equal the hand-computed reference MLP
+        x = rng.normal(size=(2, 10)).astype(np.float32)
+        h = np.maximum(x @ k1 + b1, 0)
+        expect = h @ k2 + b2
+        expect = np.exp(expect - expect.max(-1, keepdims=True))
+        expect /= expect.sum(-1, keepdims=True)
+        got = np.asarray(net.output(x))
+        assert np.allclose(got, expect, atol=1e-4)

@@ -1,0 +1,117 @@
+"""RL tests (≡ rl4j test suite: QLearningDiscreteTest, ExpReplay tests,
+policy tests — on the deterministic SimpleToy MDP + CartpoleNative)."""
+import numpy as np
+
+from deeplearning4j_tpu.rl import (A3CConfiguration, A3CDiscreteDense,
+                                   AsyncNStepQLearningDiscreteDense,
+                                   CartpoleNative,
+                                   DQNDenseNetworkConfiguration, DQNPolicy,
+                                   EpsGreedy, ExpReplay,
+                                   QLearningConfiguration,
+                                   QLearningDiscreteDense, SimpleToy,
+                                   Transition)
+
+
+class TestMDPs:
+    def test_cartpole_episode(self):
+        env = CartpoleNative(seed=3)
+        obs = env.reset()
+        assert obs.shape == (4,)
+        steps = 0
+        while not env.isDone():
+            obs, r, done, _ = env.step(env.action_space.randomAction(
+                np.random.default_rng(steps)))
+            assert r == 1.0
+            steps += 1
+        assert 1 <= steps <= 200
+
+    def test_simpletoy_optimal(self):
+        env = SimpleToy(length=4)
+        env.reset()
+        total = 0.0
+        for _ in range(3):
+            _, r, done, _ = env.step(1)
+            total += r
+        assert done and total == 0.1 + 0.1 + 1.0
+
+    def test_simpletoy_reset_action(self):
+        env = SimpleToy(length=4)
+        env.reset()
+        env.step(1)
+        obs, r, done, _ = env.step(0)
+        assert obs[0] == 1.0 and r == 0.0 and not done
+
+
+class TestExpReplay:
+    def test_ring_overwrite(self):
+        rp = ExpReplay(max_size=4, batch_size=2, seed=0)
+        for i in range(6):
+            rp.store(Transition(np.full(3, i, np.float32), i % 2,
+                                float(i), np.zeros(3, np.float32), False))
+        assert len(rp) == 4
+        obs, actions, rewards, next_obs, dones = rp.getBatch()
+        assert obs.shape == (2, 3) and rewards.min() >= 2.0
+
+    def test_batch_shapes(self):
+        rp = ExpReplay(max_size=10, batch_size=5, seed=1)
+        for i in range(10):
+            rp.store(Transition(np.zeros(2, np.float32), 0, 1.0,
+                                np.ones(2, np.float32), i == 9))
+        obs, actions, rewards, next_obs, dones = rp.getBatch()
+        assert obs.shape == (5, 2) and actions.dtype == np.int32
+        assert dones.shape == (5,)
+
+
+class TestEpsGreedy:
+    def test_anneals(self):
+        conf = QLearningConfiguration(minEpsilon=0.1, epsilonNbStep=100)
+        pol = EpsGreedy(conf, np.random.default_rng(0))
+        assert pol.epsilon() == 1.0
+        pol.step = 100
+        assert abs(pol.epsilon() - 0.1) < 1e-9
+
+
+class TestDQN:
+    def test_learns_simpletoy(self):
+        conf = QLearningConfiguration(
+            seed=7, maxStep=600, maxEpochStep=20, batchSize=16,
+            targetDqnUpdateFreq=50, updateStart=32, gamma=0.9,
+            minEpsilon=0.05, epsilonNbStep=300, expRepMaxSize=2000)
+        dqn = QLearningDiscreteDense(
+            SimpleToy(length=4),
+            DQNDenseNetworkConfiguration(numLayers=1, numHiddenNodes=32,
+                                         learningRate=5e-3),
+            conf)
+        dqn.train()
+        # optimal policy solves the chain: greedy play earns full reward
+        score = DQNPolicy(dqn.net).play(SimpleToy(length=4), max_steps=10)
+        assert score > 1.0, f"greedy score {score}"
+
+    def test_cartpole_runs(self):
+        conf = QLearningConfiguration(seed=1, maxStep=150, maxEpochStep=50,
+                                      updateStart=16, batchSize=16)
+        dqn = QLearningDiscreteDense(
+            CartpoleNative(seed=1),
+            DQNDenseNetworkConfiguration(numLayers=1, numHiddenNodes=16),
+            conf)
+        rewards = dqn.train()
+        assert len(rewards) >= 1 and dqn.step_count >= 150
+
+
+class TestA3C:
+    def test_learns_simpletoy(self):
+        conf = A3CConfiguration(seed=5, maxStep=4000, numEnvs=4, nstep=4,
+                                gamma=0.9, learningRate=5e-3,
+                                hiddenNodes=32, numLayers=1)
+        a3c = A3CDiscreteDense(lambda: SimpleToy(length=4), conf)
+        a3c.train()
+        score = a3c.play(SimpleToy(length=4), max_steps=10)
+        assert score > 1.0, f"greedy score {score}"
+
+    def test_nstep_q_runs(self):
+        conf = A3CConfiguration(seed=2, maxStep=400, numEnvs=4, nstep=4,
+                                hiddenNodes=16, numLayers=1)
+        nq = AsyncNStepQLearningDiscreteDense(lambda: SimpleToy(length=3),
+                                              conf)
+        nq.train()
+        assert nq.step_count >= 400
