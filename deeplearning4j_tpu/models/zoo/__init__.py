@@ -3,6 +3,12 @@ from deeplearning4j_tpu.models.zoo.models import (AlexNet, LeNet, ResNet50,
                                                   TextGenerationLSTM,
                                                   TinyYOLO, UNet, VGG16,
                                                   ZooModel)
+from deeplearning4j_tpu.models.zoo.models2 import (Darknet19,
+                                                   InceptionResNetV1,
+                                                   SqueezeNet, VGG19,
+                                                   Xception)
 
 __all__ = ["AlexNet", "LeNet", "ResNet50", "SimpleCNN",
-           "TextGenerationLSTM", "TinyYOLO", "UNet", "VGG16", "ZooModel"]
+           "TextGenerationLSTM", "TinyYOLO", "UNet", "VGG16", "ZooModel",
+           "Darknet19", "InceptionResNetV1", "SqueezeNet", "VGG19",
+           "Xception"]

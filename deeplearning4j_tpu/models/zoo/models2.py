@@ -1,0 +1,330 @@
+"""Model zoo, part 2 (≡ deeplearning4j-zoo :: org.deeplearning4j.zoo.model.
+Darknet19, VGG19, SqueezeNet, Xception, InceptionResNetV1).
+
+Same TPU-first conventions as models.py: NHWC, bf16-friendly, built
+through the public config DSL.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.models.zoo.models import ZooModel
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_vertices import (ElementWiseVertex,
+                                                       MergeVertex,
+                                                       ScaleVertex)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                               BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               DropoutLayer,
+                                               GlobalPoolingLayer,
+                                               OutputLayer,
+                                               SeparableConvolution2D,
+                                               SubsamplingLayer)
+from deeplearning4j_tpu.nn.updaters import Adam, Nesterovs
+
+
+class Darknet19(ZooModel):
+    """≡ zoo.model.Darknet19 — the YOLO9000 classifier backbone:
+    3×3/1×1 conv stacks with BN+leakyrelu, five maxpools, 1×1×classes
+    conv head + global average pooling."""
+
+    DEFAULT_INPUT = (224, 224, 3)
+
+    def conf(self):
+        h, w, c = self.inputShape
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Nesterovs(1e-3, 0.9))
+             .weightInit("relu")
+             .l2(5e-4)
+             .dataType(self.dataType)
+             .list())
+
+        def conv_bn(n_out, k):
+            b.layer(ConvolutionLayer(kernelSize=(k, k), nOut=n_out,
+                                     convolutionMode="same", hasBias=False,
+                                     activation="identity"))
+            b.layer(BatchNormalization(activation="leakyrelu"))
+
+        def pool():
+            b.layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+
+        conv_bn(32, 3); pool()
+        conv_bn(64, 3); pool()
+        conv_bn(128, 3); conv_bn(64, 1); conv_bn(128, 3); pool()
+        conv_bn(256, 3); conv_bn(128, 1); conv_bn(256, 3); pool()
+        conv_bn(512, 3); conv_bn(256, 1); conv_bn(512, 3)
+        conv_bn(256, 1); conv_bn(512, 3); pool()
+        conv_bn(1024, 3); conv_bn(512, 1); conv_bn(1024, 3)
+        conv_bn(512, 1); conv_bn(1024, 3)
+        b.layer(ConvolutionLayer(kernelSize=(1, 1), nOut=self.numClasses,
+                                 convolutionMode="same",
+                                 activation="identity"))
+        b.layer(GlobalPoolingLayer(poolingType="avg"))
+        b.layer(OutputLayer(lossFunction="mcxent", nOut=self.numClasses,
+                            activation="softmax"))
+        return b.setInputType(InputType.convolutional(h, w, c)).build()
+
+
+class VGG19(ZooModel):
+    """≡ zoo.model.VGG19 — VGG16 with the 4-conv deep stages."""
+
+    def conf(self):
+        h, w, c = self.inputShape
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Nesterovs(1e-2, 0.9))
+             .weightInit("relu")
+             .activation("relu")
+             .dataType(self.dataType)
+             .list())
+        for n_out, reps in [(64, 2), (128, 2), (256, 4), (512, 4), (512, 4)]:
+            for _ in range(reps):
+                b.layer(ConvolutionLayer(kernelSize=(3, 3), nOut=n_out,
+                                         convolutionMode="same"))
+            b.layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+        return (b.layer(DenseLayer(nOut=4096, dropOut=0.5))
+                 .layer(DenseLayer(nOut=4096, dropOut=0.5))
+                 .layer(OutputLayer(lossFunction="mcxent",
+                                    nOut=self.numClasses,
+                                    activation="softmax"))
+                 .setInputType(InputType.convolutional(h, w, c))
+                 .build())
+
+
+class SqueezeNet(ZooModel):
+    """≡ zoo.model.SqueezeNet (v1.1) — fire modules: 1×1 squeeze then
+    parallel 1×1/3×3 expands concatenated (MergeVertex)."""
+
+    def conf(self):
+        h, w, c = self.inputShape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Adam(1e-3))
+             .weightInit("relu")
+             .dataType(self.dataType)
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        def fire(name, inp, squeeze, expand):
+            g.addLayer(f"{name}_sq", ConvolutionLayer(
+                kernelSize=(1, 1), nOut=squeeze, activation="relu",
+                convolutionMode="same"), inp)
+            g.addLayer(f"{name}_e1", ConvolutionLayer(
+                kernelSize=(1, 1), nOut=expand, activation="relu",
+                convolutionMode="same"), f"{name}_sq")
+            g.addLayer(f"{name}_e3", ConvolutionLayer(
+                kernelSize=(3, 3), nOut=expand, activation="relu",
+                convolutionMode="same"), f"{name}_sq")
+            g.addVertex(f"{name}_cat", MergeVertex(),
+                        f"{name}_e1", f"{name}_e3")
+            return f"{name}_cat"
+
+        g.addLayer("conv1", ConvolutionLayer(kernelSize=(3, 3),
+                                             stride=(2, 2), nOut=64,
+                                             activation="relu",
+                                             convolutionMode="same"),
+                   "input")
+        g.addLayer("pool1", SubsamplingLayer(kernelSize=(3, 3),
+                                             stride=(2, 2),
+                                             convolutionMode="same"),
+                   "conv1")
+        x = fire("fire2", "pool1", 16, 64)
+        x = fire("fire3", x, 16, 64)
+        g.addLayer("pool3", SubsamplingLayer(kernelSize=(3, 3),
+                                             stride=(2, 2),
+                                             convolutionMode="same"), x)
+        x = fire("fire4", "pool3", 32, 128)
+        x = fire("fire5", x, 32, 128)
+        g.addLayer("pool5", SubsamplingLayer(kernelSize=(3, 3),
+                                             stride=(2, 2),
+                                             convolutionMode="same"), x)
+        x = fire("fire6", "pool5", 48, 192)
+        x = fire("fire7", x, 48, 192)
+        x = fire("fire8", x, 64, 256)
+        x = fire("fire9", x, 64, 256)
+        g.addLayer("drop", DropoutLayer(dropOut=0.5), x)
+        g.addLayer("conv10", ConvolutionLayer(kernelSize=(1, 1),
+                                              nOut=self.numClasses,
+                                              activation="relu",
+                                              convolutionMode="same"),
+                   "drop")
+        g.addLayer("gap", GlobalPoolingLayer(poolingType="avg"), "conv10")
+        g.addLayer("out", OutputLayer(lossFunction="mcxent",
+                                      nOut=self.numClasses,
+                                      activation="softmax"), "gap")
+        g.setOutputs("out")
+        return g.build()
+
+
+class Xception(ZooModel):
+    """≡ zoo.model.Xception — depthwise-separable conv stacks with
+    linear residual shortcuts (entry/middle/exit flow, middle depth
+    configurable to keep CPU tests tractable)."""
+
+    def __init__(self, middleFlowBlocks=8, **kw):
+        super().__init__(**kw)
+        self.middleFlowBlocks = middleFlowBlocks
+
+    DEFAULT_INPUT = (299, 299, 3)
+
+    def conf(self):
+        h, w, c = self.inputShape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Nesterovs(0.045, 0.9))
+             .weightInit("relu")
+             .dataType(self.dataType)
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        def conv_bn(name, inp, n_out, k, s, act="relu"):
+            g.addLayer(f"{name}_c", ConvolutionLayer(
+                kernelSize=k, stride=s, nOut=n_out, hasBias=False,
+                convolutionMode="same", activation="identity"), inp)
+            g.addLayer(f"{name}_bn", BatchNormalization(activation=act),
+                       f"{name}_c")
+            return f"{name}_bn"
+
+        def sep_bn(name, inp, n_out, act="relu"):
+            g.addLayer(f"{name}_s", SeparableConvolution2D(
+                kernelSize=(3, 3), nOut=n_out, hasBias=False,
+                convolutionMode="same", activation="identity"), inp)
+            g.addLayer(f"{name}_bn", BatchNormalization(activation=act),
+                       f"{name}_s")
+            return f"{name}_bn"
+
+        def xception_block(name, inp, n_out, relu_first=True):
+            """two sep convs + stride-2 pool, 1×1 stride-2 residual."""
+            x = inp
+            if relu_first:
+                g.addLayer(f"{name}_pre", ActivationLayer(
+                    activation="relu"), x)
+                x = f"{name}_pre"
+            x = sep_bn(f"{name}_s1", x, n_out)
+            x = sep_bn(f"{name}_s2", x, n_out, act="identity")
+            g.addLayer(f"{name}_pool", SubsamplingLayer(
+                kernelSize=(3, 3), stride=(2, 2), convolutionMode="same"), x)
+            sc = conv_bn(f"{name}_sc", inp, n_out, (1, 1), (2, 2),
+                         act="identity")
+            g.addVertex(f"{name}_add", ElementWiseVertex("add"),
+                        f"{name}_pool", sc)
+            return f"{name}_add"
+
+        x = conv_bn("stem1", "input", 32, (3, 3), (2, 2))
+        x = conv_bn("stem2", x, 64, (3, 3), (1, 1))
+        x = xception_block("entry1", x, 128, relu_first=False)
+        x = xception_block("entry2", x, 256)
+        x = xception_block("entry3", x, 728)
+        for i in range(self.middleFlowBlocks):
+            inp = x
+            y = inp
+            for j in range(3):
+                g.addLayer(f"mid{i}_relu{j}", ActivationLayer(
+                    activation="relu"), y)
+                y = sep_bn(f"mid{i}_s{j}", f"mid{i}_relu{j}", 728,
+                           act="identity")
+            g.addVertex(f"mid{i}_add", ElementWiseVertex("add"), y, inp)
+            x = f"mid{i}_add"
+        x = xception_block("exit1", x, 1024)
+        x = sep_bn("exit2", x, 1536)
+        x = sep_bn("exit3", x, 2048)
+        g.addLayer("gap", GlobalPoolingLayer(poolingType="avg"), x)
+        g.addLayer("out", OutputLayer(lossFunction="mcxent",
+                                      nOut=self.numClasses,
+                                      activation="softmax"), "gap")
+        g.setOutputs("out")
+        return g.build()
+
+
+class InceptionResNetV1(ZooModel):
+    """≡ zoo.model.InceptionResNetV1 — inception branches merged then
+    1×1-projected, residual-added with a ScaleVertex(0.17/0.10) exactly
+    as the reference scales its residual summands. Block counts are
+    configurable (defaults are the paper's 5/10/5)."""
+
+    def __init__(self, blocks=(5, 10, 5), **kw):
+        super().__init__(**kw)
+        self.blocks = blocks
+
+    DEFAULT_INPUT = (160, 160, 3)
+
+    def conf(self):
+        h, w, c = self.inputShape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Adam(1e-3))
+             .weightInit("relu")
+             .dataType(self.dataType)
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        def conv_bn(name, inp, n_out, k, s=(1, 1), act="relu"):
+            g.addLayer(f"{name}_c", ConvolutionLayer(
+                kernelSize=k, stride=s, nOut=n_out, hasBias=False,
+                convolutionMode="same", activation="identity"), inp)
+            g.addLayer(f"{name}_bn", BatchNormalization(activation=act),
+                       f"{name}_c")
+            return f"{name}_bn"
+
+        def block35(name, inp, width):
+            """Inception-ResNet-A: 1×1 / 1×1-3×3 / 1×1-3×3-3×3 branches."""
+            b0 = conv_bn(f"{name}_b0", inp, 32, (1, 1))
+            b1 = conv_bn(f"{name}_b1a", inp, 32, (1, 1))
+            b1 = conv_bn(f"{name}_b1b", b1, 32, (3, 3))
+            b2 = conv_bn(f"{name}_b2a", inp, 32, (1, 1))
+            b2 = conv_bn(f"{name}_b2b", b2, 32, (3, 3))
+            b2 = conv_bn(f"{name}_b2c", b2, 32, (3, 3))
+            g.addVertex(f"{name}_cat", MergeVertex(), b0, b1, b2)
+            g.addLayer(f"{name}_proj", ConvolutionLayer(
+                kernelSize=(1, 1), nOut=width, convolutionMode="same",
+                activation="identity"), f"{name}_cat")
+            g.addVertex(f"{name}_scale", ScaleVertex(0.17), f"{name}_proj")
+            g.addVertex(f"{name}_add", ElementWiseVertex("add"), inp,
+                        f"{name}_scale")
+            g.addLayer(f"{name}_relu", ActivationLayer(activation="relu"),
+                       f"{name}_add")
+            return f"{name}_relu"
+
+        def block17(name, inp, width):
+            b0 = conv_bn(f"{name}_b0", inp, 128, (1, 1))
+            b1 = conv_bn(f"{name}_b1a", inp, 128, (1, 1))
+            b1 = conv_bn(f"{name}_b1b", b1, 128, (1, 7))
+            b1 = conv_bn(f"{name}_b1c", b1, 128, (7, 1))
+            g.addVertex(f"{name}_cat", MergeVertex(), b0, b1)
+            g.addLayer(f"{name}_proj", ConvolutionLayer(
+                kernelSize=(1, 1), nOut=width, convolutionMode="same",
+                activation="identity"), f"{name}_cat")
+            g.addVertex(f"{name}_scale", ScaleVertex(0.10), f"{name}_proj")
+            g.addVertex(f"{name}_add", ElementWiseVertex("add"), inp,
+                        f"{name}_scale")
+            g.addLayer(f"{name}_relu", ActivationLayer(activation="relu"),
+                       f"{name}_add")
+            return f"{name}_relu"
+
+        # stem
+        x = conv_bn("stem1", "input", 32, (3, 3), (2, 2))
+        x = conv_bn("stem2", x, 64, (3, 3))
+        g.addLayer("stem_pool", SubsamplingLayer(
+            kernelSize=(3, 3), stride=(2, 2), convolutionMode="same"), x)
+        x = conv_bn("stem3", "stem_pool", 128, (1, 1))
+        x = conv_bn("stem4", x, 192, (3, 3))
+        x = conv_bn("stem5", x, 256, (3, 3), (2, 2))
+        for i in range(self.blocks[0]):
+            x = block35(f"a{i}", x, 256)
+        x = conv_bn("redA", x, 512, (3, 3), (2, 2))
+        for i in range(self.blocks[1]):
+            x = block17(f"b{i}", x, 512)
+        x = conv_bn("redB", x, 896, (3, 3), (2, 2))
+        g.addLayer("gap", GlobalPoolingLayer(poolingType="avg"), x)
+        g.addLayer("drop", DropoutLayer(dropOut=0.8), "gap")
+        g.addLayer("bottleneck", DenseLayer(nOut=128,
+                                            activation="identity"), "drop")
+        g.addLayer("out", OutputLayer(lossFunction="mcxent",
+                                      nOut=self.numClasses,
+                                      activation="softmax"), "bottleneck")
+        g.setOutputs("out")
+        return g.build()

@@ -113,15 +113,14 @@ class FastText(Word2Vec):
     def _run_epochs(self, pairs_fn, epochs):
         for _ in range(epochs):
             centers, contexts = pairs_fn()
-            for cen, ctx, negs, w in self._batches(
-                    np.asarray(centers), np.asarray(contexts)):
-                c = np.asarray(cen)
+            for cen, ctx, negs, w in self._batches(centers, contexts):
+                # cen is host-side: ngram row gather stays on host, no sync
                 self.params, _ = _ft_step(
                     self.params, self.b._lr,
-                    jnp.asarray(self._ngram_ids[c]),
-                    jnp.asarray(self._ngram_mask[c]),
+                    self._ngram_ids[cen], self._ngram_mask[cen],
                     ctx, negs, w)
-        self._cached_table = None  # tables changed; recompute on lookup
+        self._cached_table = None   # tables changed; recompute on lookup
+        self._cached_syn0 = None
 
     # -- lookup: in-vocab mean(word+ngrams); OOV from ngrams alone -------
     def _table(self):
@@ -137,12 +136,13 @@ class FastText(Word2Vec):
 
     def getWordVector(self, word):
         i = self.vocab.indexOf(word)
-        tab = np.asarray(self.params["syn0"], np.float32)
         if i >= 0:
-            ids, mask = self._ngram_ids[i], self._ngram_mask[i]
-        else:
-            ids, mask = self._word_ngram_row(word)  # OOV: n-grams only
-            if mask.sum() == 0:
-                raise KeyError(f"no n-grams for OOV word {word!r}")
-        emb = tab[ids]
+            return self._table()[i]
+        # OOV: n-grams only, against one cached host copy of syn0
+        ids, mask = self._word_ngram_row(word)
+        if mask.sum() == 0:
+            raise KeyError(f"no n-grams for OOV word {word!r}")
+        if getattr(self, "_cached_syn0", None) is None:
+            self._cached_syn0 = np.asarray(self.params["syn0"], np.float32)
+        emb = self._cached_syn0[ids]
         return (emb * mask[:, None]).sum(0) / max(mask.sum(), 1.0)

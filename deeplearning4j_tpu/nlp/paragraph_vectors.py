@@ -116,7 +116,8 @@ class ParagraphVectors(Word2Vec):
         docs_ids = [[w2i[t] for t in s if t in w2i] for s in toks]
 
         if self.b._train_words:
-            self._run_epochs(lambda: self._pairs(docs_ids), self.b._epochs)
+            self._run_epochs(lambda: self._pairs(docs_ids),
+                             self.b._epochs * self.b._iterations)
         if self.b._dm:
             self._fit_dm(docs_ids)
         else:

@@ -200,8 +200,9 @@ class Word2Vec(WordVectors):
 
     def _batches(self, centers, contexts):
         """Shared epoch batcher: shuffle, pad to the fixed batch shape,
-        sample negatives from the unigram^0.75 table, yield
-        (center, context, negatives, weights) device-ready slices."""
+        sample negatives from the unigram^0.75 table, yield host-side
+        (center, context, negatives, weights) slices — jit uploads them,
+        so callers can still index host tables by center id for free."""
         n = len(centers)
         if n == 0:
             return
@@ -218,10 +219,8 @@ class Word2Vec(WordVectors):
                                 size=(len(centers), K),
                                 p=neg_p).astype(np.int32)
         for s in range(0, len(centers), B):
-            yield (jnp.asarray(centers[s:s + B]),
-                   jnp.asarray(contexts[s:s + B]),
-                   jnp.asarray(negs[s:s + B]),
-                   jnp.asarray(weights[s:s + B]))
+            yield (centers[s:s + B], contexts[s:s + B],
+                   negs[s:s + B], weights[s:s + B])
 
     def _run_epochs(self, centers_contexts_fn, epochs):
         for _ in range(epochs):

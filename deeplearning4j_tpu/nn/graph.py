@@ -16,7 +16,7 @@ import optax
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.nn.multilayer import _l1l2_penalty
-from deeplearning4j_tpu.nn.updaters import build_optimizer
+from deeplearning4j_tpu.nn.updaters import build_optimizer, same_updater
 from deeplearning4j_tpu.ops.ndarray import NDArray, as_jax, resolve_dtype
 
 
@@ -71,7 +71,8 @@ class ComputationGraph:
         global_updater = defaults.get("updater")
         overrides = {n: self.nodes[n].ref.updater for n in self._layer_names
                      if self.nodes[n].ref.updater is not None
-                     and self.nodes[n].ref.updater is not global_updater}
+                     and not same_updater(self.nodes[n].ref.updater,
+                                          global_updater)}
         gn = defaults.get("gradientNormalization")
         gn_thr = defaults.get("gradientNormalizationThreshold", 1.0)
         wd = defaults.get("weightDecay", 0.0) or 0.0

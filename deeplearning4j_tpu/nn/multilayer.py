@@ -18,7 +18,7 @@ import numpy as np
 import optax
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
-from deeplearning4j_tpu.nn.updaters import Updater, build_optimizer
+from deeplearning4j_tpu.nn.updaters import Updater, build_optimizer, same_updater
 from deeplearning4j_tpu.ops.ndarray import NDArray, as_jax, resolve_dtype
 
 
@@ -88,7 +88,8 @@ class MultiLayerNetwork:
         defaults = self.conf.defaults
         global_updater = defaults.get("updater")
         overrides = {str(i): l.updater for i, l in enumerate(self.layers)
-                     if l.updater is not None and l.updater is not global_updater}
+                     if l.updater is not None
+                     and not same_updater(l.updater, global_updater)}
         gn = defaults.get("gradientNormalization")
         gn_thr = defaults.get("gradientNormalizationThreshold", 1.0)
         wd = defaults.get("weightDecay", 0.0) or 0.0

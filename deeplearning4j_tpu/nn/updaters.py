@@ -28,6 +28,13 @@ class Updater:
         return {"type": type(self).__name__, **self.__dict__}
 
 
+def same_updater(a, b):
+    """Structural equality (identity breaks after config JSON roundtrip)."""
+    return a is b or (type(a) is type(b)
+                      and getattr(a, "__dict__", None) == getattr(
+                          b, "__dict__", None))
+
+
 class Sgd(Updater):
     def __init__(self, learningRate=0.1):
         self.learningRate = learningRate

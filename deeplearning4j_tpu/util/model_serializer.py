@@ -65,9 +65,11 @@ class ModelSerializer:
             zf.writestr(PARAMS_NPZ, _tree_to_npz_bytes(model._params))
             zf.writestr(STATE_NPZ, _tree_to_npz_bytes(model._state))
             if saveUpdater and model._opt_state is not None:
-                leaves, treedef = jax.tree_util.tree_flatten(model._opt_state)
+                # leaves only: optax state treedefs don't survive pickling
+                # across versions; restore rebuilds structure from config
+                leaves = jax.tree_util.tree_leaves(model._opt_state)
                 zf.writestr(UPDATER_PKL, pickle.dumps(
-                    ([np.asarray(l) for l in leaves], treedef)))
+                    [np.asarray(l) for l in leaves]))
             if normalizer is not None:
                 zf.writestr(NORMALIZER_PKL, pickle.dumps(normalizer))
         return path
@@ -100,9 +102,14 @@ class ModelSerializer:
         model._state = state
         model._build_optimizer()
         if updater_blob is not None:
-            leaves, treedef = pickle.loads(updater_blob)
+            loaded = pickle.loads(updater_blob)
+            # pre-fix archives stored (leaves, treedef); now leaves only
+            leaves = loaded[0] if isinstance(loaded, tuple) else loaded
+            # unflatten against the freshly-initialized optimizer state:
+            # same config ⇒ identical structure/leaf order
+            fresh_def = jax.tree_util.tree_structure(model._opt_state)
             model._opt_state = jax.tree_util.tree_unflatten(
-                treedef, [jax.numpy.asarray(l) for l in leaves])
+                fresh_def, [jax.numpy.asarray(l) for l in leaves])
         return model
 
     @staticmethod

@@ -1,0 +1,131 @@
+"""Serialization tests (≡ deeplearning4j-core :: ModelSerializerTest /
+RegressionTest100* roundtrip suites): exact save/load for both network
+classes, updater state, normalizer attach, checkpoint listener."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_vertices import MergeVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, OutputLayer)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.optimize.listeners import CheckpointListener
+from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+
+def _mlp():
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.Builder().seed(5).updater(Adam(1e-2))
+        .list()
+        .layer(DenseLayer(nOut=16, activation="tanh"))
+        .layer(OutputLayer(lossFunction="mse", nOut=2,
+                           activation="identity"))
+        .setInputType(InputType.feedForward(4)).build()).init()
+
+
+def _graph():
+    g = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(1e-2))
+         .graphBuilder()
+         .addInputs("in")
+         .setInputTypes(InputType.feedForward(4)))
+    g.addLayer("a", DenseLayer(nOut=8, activation="relu"), "in")
+    g.addLayer("b", DenseLayer(nOut=8, activation="tanh"), "in")
+    g.addVertex("merge", MergeVertex(), "a", "b")
+    g.addLayer("out", OutputLayer(lossFunction="mse", nOut=2,
+                                  activation="identity"), "merge")
+    g.setOutputs("out")
+    return ComputationGraph(g.build()).init()
+
+
+X = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+Y = np.random.default_rng(1).normal(size=(8, 2)).astype(np.float32)
+
+
+class TestModelSerializer:
+    def test_multilayer_roundtrip_exact(self, tmp_path):
+        net = _mlp()
+        net.fit(X, Y)
+        p = str(tmp_path / "m.zip")
+        ModelSerializer.writeModel(net, p)
+        net2 = ModelSerializer.restoreMultiLayerNetwork(p)
+        assert np.array_equal(np.asarray(net.output(X)),
+                              np.asarray(net2.output(X)))
+
+    def test_graph_roundtrip_exact(self, tmp_path):
+        net = _graph()
+        net.fit(X, Y)
+        p = str(tmp_path / "g.zip")
+        ModelSerializer.writeModel(net, p)
+        net2 = ModelSerializer.restoreComputationGraph(p)
+        o1, o2 = net.output(X), net2.output(X)
+        o1 = o1[0] if isinstance(o1, (list, tuple)) else o1
+        o2 = o2[0] if isinstance(o2, (list, tuple)) else o2
+        assert np.array_equal(np.asarray(o1), np.asarray(o2))
+
+    def test_updater_state_resumes_identically(self, tmp_path):
+        net = _mlp()
+        net.fit(X, Y)
+        p = str(tmp_path / "m.zip")
+        ModelSerializer.writeModel(net, p, saveUpdater=True)
+        resumed = ModelSerializer.restoreMultiLayerNetwork(p,
+                                                           loadUpdater=True)
+        # continue training both — Adam moments must match exactly
+        net.fit(X, Y)
+        resumed.fit(X, Y)
+        assert np.allclose(np.asarray(net.params().jax()),
+                           np.asarray(resumed.params().jax()), atol=1e-7)
+
+    def test_wrong_kind_raises(self, tmp_path):
+        p = str(tmp_path / "m.zip")
+        ModelSerializer.writeModel(_mlp(), p)
+        with pytest.raises(ValueError, match="MultiLayerNetwork"):
+            ModelSerializer.restoreComputationGraph(p)
+
+    def test_restore_model_dispatches(self, tmp_path):
+        p1 = str(tmp_path / "m.zip")
+        p2 = str(tmp_path / "g.zip")
+        ModelSerializer.writeModel(_mlp(), p1)
+        ModelSerializer.writeModel(_graph(), p2)
+        assert isinstance(ModelSerializer.restoreModel(p1),
+                          MultiLayerNetwork)
+        assert isinstance(ModelSerializer.restoreModel(p2),
+                          ComputationGraph)
+
+    def test_normalizer_roundtrip(self, tmp_path):
+        norm = NormalizerStandardize()
+        norm.fit(DataSet(X, Y))
+        p = str(tmp_path / "m.zip")
+        ModelSerializer.writeModel(_mlp(), p, normalizer=norm)
+        norm2 = ModelSerializer.restoreNormalizerFromFile(p)
+        assert np.allclose(norm2.transform_array(X), norm.transform_array(X))
+
+    def test_add_normalizer_after(self, tmp_path):
+        p = str(tmp_path / "m.zip")
+        ModelSerializer.writeModel(_mlp(), p)
+        assert ModelSerializer.restoreNormalizerFromFile(p) is None
+        norm = NormalizerStandardize()
+        norm.fit(DataSet(X, Y))
+        ModelSerializer.addNormalizerToModel(p, norm)
+        assert ModelSerializer.restoreNormalizerFromFile(p) is not None
+
+
+class TestCheckpointListener:
+    def test_keeps_last_n(self, tmp_path):
+        net = _mlp()
+        lst = CheckpointListener(str(tmp_path), keepLast=2,
+                                 saveEveryNIterations=1)
+        net.setListeners(lst)
+        for _ in range(5):
+            net.fit(X, Y)
+        zips = sorted(f for f in os.listdir(tmp_path) if f.endswith(".zip"))
+        assert len(zips) == 2
+        restored = ModelSerializer.restoreMultiLayerNetwork(
+            str(tmp_path / zips[-1]))
+        assert np.array_equal(np.asarray(restored.output(X)),
+                              np.asarray(net.output(X)))

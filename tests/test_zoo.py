@@ -1,0 +1,98 @@
+"""Zoo model tests (≡ deeplearning4j-zoo :: TestInstantiation — each
+model builds, forwards the right shape, and takes a train step; tiny
+input shapes keep the 1-vCPU suite fast)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.zoo import (AlexNet, Darknet19,
+                                           InceptionResNetV1, LeNet,
+                                           ResNet50, SimpleCNN, SqueezeNet,
+                                           TextGenerationLSTM, TinyYOLO,
+                                           UNet, VGG16, VGG19, Xception,
+                                           ZooModel)
+
+
+def _rand(shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+def _onehot(n, k, seed=0):
+    return np.eye(k, dtype=np.float32)[
+        np.random.default_rng(seed).integers(k, size=n)]
+
+
+# (model ctor, input shape HWC, numClasses) — shapes shrunk for CPU
+SMALL_MODELS = [
+    (lambda: LeNet(numClasses=10), (28, 28, 1), 10),
+    (lambda: SimpleCNN(numClasses=5, inputShape=(32, 32, 3)), (32, 32, 3), 5),
+    (lambda: AlexNet(numClasses=7, inputShape=(64, 64, 3)), (64, 64, 3), 7),
+    (lambda: Darknet19(numClasses=6, inputShape=(64, 64, 3)), (64, 64, 3), 6),
+    (lambda: SqueezeNet(numClasses=4, inputShape=(64, 64, 3)),
+     (64, 64, 3), 4),
+    (lambda: Xception(numClasses=4, inputShape=(64, 64, 3),
+                      middleFlowBlocks=1), (64, 64, 3), 4),
+    (lambda: InceptionResNetV1(numClasses=4, inputShape=(64, 64, 3),
+                               blocks=(1, 1, 1)), (64, 64, 3), 4),
+]
+
+
+class TestInstantiation:
+    @pytest.mark.parametrize("ctor,shape,ncls", SMALL_MODELS,
+                             ids=lambda p: getattr(p, "__name__", str(p)))
+    def test_build_forward_fit(self, ctor, shape, ncls):
+        model = ctor()
+        net = model.init()
+        x = _rand((2,) + shape)
+        out = net.output(x)
+        out = out[0] if isinstance(out, (list, tuple)) else out
+        y = np.asarray(out)
+        assert y.shape == (2, ncls)
+        assert np.allclose(y.sum(-1), 1.0, atol=1e-4)  # softmax head
+        net.fit(x, _onehot(2, ncls))
+        assert np.isfinite(float(net.score()))
+
+    def test_vgg16_vgg19_depths(self):
+        # conv layer count is the models' defining difference: 13 vs 16
+        c16 = sum(l.__class__.__name__ == "ConvolutionLayer"
+                  for l in VGG16(numClasses=3,
+                                 inputShape=(32, 32, 3)).conf().layers)
+        c19 = sum(l.__class__.__name__ == "ConvolutionLayer"
+                  for l in VGG19(numClasses=3,
+                                 inputShape=(32, 32, 3)).conf().layers)
+        assert (c16, c19) == (13, 16)
+
+    def test_vgg19_forward(self):
+        net = VGG19(numClasses=3, inputShape=(32, 32, 3)).init()
+        y = np.asarray(net.output(_rand((2, 32, 32, 3))))
+        assert y.shape == (2, 3)
+
+    def test_resnet50_block_count(self):
+        conf = ResNet50(numClasses=4, inputShape=(64, 64, 3)).conf()
+        adds = [n for n in conf.nodes if n.endswith("_add")]
+        assert len(adds) == 16  # 3+4+6+3 bottlenecks
+
+    def test_unet_mask_output(self):
+        net = UNet(numClasses=1, inputShape=(32, 32, 3)).init()
+        out = net.output(_rand((1, 32, 32, 3)))
+        y = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+        assert y.shape == (1, 32, 32, 1)
+        assert (y >= 0).all() and (y <= 1).all()  # sigmoid pixels
+
+    def test_tinyyolo_head_shape(self):
+        m = TinyYOLO(numClasses=3, boxes=5, inputShape=(64, 64, 3))
+        net = m.init()
+        y = np.asarray(net.output(_rand((1, 64, 64, 3))))
+        # 5 pools: 64→2; head channels B*(5+C)
+        assert y.shape == (1, 2, 2, 5 * (5 + 3))
+
+    def test_textgen_lstm(self):
+        m = TextGenerationLSTM(numClasses=20, lstmLayerSize=32)
+        net = m.init()
+        x = _rand((2, 7, 20))
+        y = np.asarray(net.output(x))
+        assert y.shape == (2, 7, 20)
+
+    def test_pretrained_gated(self):
+        with pytest.raises(RuntimeError, match="egress"):
+            LeNet().initPretrained()
+        assert not LeNet().pretrainedAvailable("imagenet")
