@@ -4,11 +4,12 @@ from deeplearning4j_tpu.models.zoo.models import (AlexNet, LeNet, ResNet50,
                                                   TinyYOLO, UNet, VGG16,
                                                   ZooModel)
 from deeplearning4j_tpu.models.zoo.models2 import (Darknet19,
+                                                   FaceNetNN4Small2,
                                                    InceptionResNetV1,
                                                    SqueezeNet, VGG19,
-                                                   Xception)
+                                                   Xception, YOLO2)
 
 __all__ = ["AlexNet", "LeNet", "ResNet50", "SimpleCNN",
            "TextGenerationLSTM", "TinyYOLO", "UNet", "VGG16", "ZooModel",
            "Darknet19", "InceptionResNetV1", "SqueezeNet", "VGG19",
-           "Xception"]
+           "Xception", "YOLO2", "FaceNetNN4Small2"]

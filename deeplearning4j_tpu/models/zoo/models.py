@@ -304,15 +304,32 @@ class UNet(ZooModel):
         return g.build()
 
 
+def _resolve_priors(boxes, defaults):
+    """boxes: None → all default priors; int n → first n defaults;
+    list of [w, h] → explicit priors."""
+    if boxes is None:
+        return defaults
+    if isinstance(boxes, int):
+        if not 1 <= boxes <= len(defaults):
+            raise ValueError(
+                f"boxes={boxes}: pass 1..{len(defaults)} to subset the "
+                "default priors, or an explicit [[w, h], ...] list")
+        return defaults[:boxes]
+    return [list(map(float, b)) for b in boxes]
+
+
 class TinyYOLO(ZooModel):
-    """≡ zoo.model.TinyYOLO — Darknet-style backbone; detection head is the
-    final 1×1 conv producing B*(5+C) maps (full YOLO loss: round 2)."""
+    """≡ zoo.model.TinyYOLO — Darknet-style backbone + Yolo2OutputLayer
+    (anchor-box YOLOv2 loss) with the reference's VOC box priors."""
 
     DEFAULT_INPUT = (416, 416, 3)
+    PRIORS = [[1.08, 1.19], [3.42, 4.41], [6.63, 11.38],
+              [9.42, 5.11], [16.62, 10.52]]
 
-    def __init__(self, numClasses=20, boxes=5, **kw):
+    def __init__(self, numClasses=20, boxes=None, **kw):
         super().__init__(numClasses=numClasses, **kw)
-        self.boxes = boxes
+        self.priors = _resolve_priors(boxes, self.PRIORS)
+        self.boxes = len(self.priors)
 
     def conf(self):
         h, w, c = self.inputShape
@@ -342,7 +359,8 @@ class TinyYOLO(ZooModel):
         b.layer(ConvolutionLayer(kernelSize=(1, 1), nOut=head_out,
                                  convolutionMode="same",
                                  activation="identity"))
-        b.layer(LossLayer(lossFunction="l2", activation="identity"))
+        from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
+        b.layer(Yolo2OutputLayer(boundingBoxes=self.priors))
         return (b.setInputType(InputType.convolutional(h, w, c)).build())
 
 

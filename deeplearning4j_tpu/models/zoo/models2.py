@@ -328,3 +328,152 @@ class InceptionResNetV1(ZooModel):
                                       activation="softmax"), "bottleneck")
         g.setOutputs("out")
         return g.build()
+
+
+class YOLO2(ZooModel):
+    """≡ zoo.model.YOLO2 — Darknet19 backbone + space-to-depth
+    passthrough (the 'reorg' route) + Yolo2OutputLayer with the
+    reference's COCO box priors."""
+
+    DEFAULT_INPUT = (416, 416, 3)
+    PRIORS = [[0.57273, 0.677385], [1.87446, 2.06253], [3.33843, 5.47434],
+              [7.88282, 3.52778], [9.77052, 9.16828]]
+
+    def __init__(self, numClasses=80, boxes=None, **kw):
+        super().__init__(numClasses=numClasses, **kw)
+        from deeplearning4j_tpu.models.zoo.models import _resolve_priors
+        self.priors = _resolve_priors(boxes, self.PRIORS)
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.graph_vertices import \
+            SpaceToDepthVertex
+        from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
+        h, w, c = self.inputShape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Adam(1e-3))
+             .weightInit("relu")
+             .l2(5e-4)
+             .dataType(self.dataType)
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        def conv_bn(name, inp, n_out, k):
+            g.addLayer(f"{name}_c", ConvolutionLayer(
+                kernelSize=(k, k), nOut=n_out, convolutionMode="same",
+                hasBias=False, activation="identity"), inp)
+            g.addLayer(f"{name}_bn",
+                       BatchNormalization(activation="leakyrelu"),
+                       f"{name}_c")
+            return f"{name}_bn"
+
+        def pool(name, inp):
+            g.addLayer(name, SubsamplingLayer(kernelSize=(2, 2),
+                                              stride=(2, 2)), inp)
+            return name
+
+        x = conv_bn("c1", "input", 32, 3); x = pool("p1", x)
+        x = conv_bn("c2", x, 64, 3); x = pool("p2", x)
+        x = conv_bn("c3", x, 128, 3)
+        x = conv_bn("c4", x, 64, 1)
+        x = conv_bn("c5", x, 128, 3); x = pool("p3", x)
+        x = conv_bn("c6", x, 256, 3)
+        x = conv_bn("c7", x, 128, 1)
+        x = conv_bn("c8", x, 256, 3); x = pool("p4", x)
+        for i, (n, k) in enumerate([(512, 3), (256, 1), (512, 3),
+                                    (256, 1), (512, 3)]):
+            x = conv_bn(f"c9_{i}", x, n, k)
+        route = x                       # 26×26×512 passthrough source
+        x = pool("p5", x)
+        for i, (n, k) in enumerate([(1024, 3), (512, 1), (1024, 3),
+                                    (512, 1), (1024, 3)]):
+            x = conv_bn(f"c10_{i}", x, n, k)
+        x = conv_bn("c11", x, 1024, 3)
+        x = conv_bn("c12", x, 1024, 3)
+        g.addVertex("reorg", SpaceToDepthVertex(2), route)   # → 13×13×2048
+        g.addVertex("route_cat", MergeVertex(), "reorg", x)
+        x = conv_bn("c13", "route_cat", 1024, 3)
+        head = len(self.priors) * (5 + self.numClasses)
+        g.addLayer("head", ConvolutionLayer(kernelSize=(1, 1), nOut=head,
+                                            convolutionMode="same",
+                                            activation="identity"), x)
+        g.addLayer("out", Yolo2OutputLayer(boundingBoxes=self.priors),
+                   "head")
+        g.setOutputs("out")
+        return g.build()
+
+
+class FaceNetNN4Small2(ZooModel):
+    """≡ zoo.model.FaceNetNN4Small2 — nn4.small2-style inception embedding
+    net: stem + inception(3a/3b/4a/4e/5a/5b)-like modules, 128-d
+    L2-bottleneck, CenterLossOutputLayer head (the reference's center-loss
+    FaceNet training setup)."""
+
+    DEFAULT_INPUT = (96, 96, 3)
+
+    def __init__(self, numClasses=10, embeddingSize=128, **kw):
+        super().__init__(numClasses=numClasses, **kw)
+        self.embeddingSize = int(embeddingSize)
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.special_layers import \
+            CenterLossOutputLayer
+        h, w, c = self.inputShape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Adam(1e-3))
+             .weightInit("relu")
+             .dataType(self.dataType)
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        def conv_bn(name, inp, n_out, k, s=(1, 1)):
+            g.addLayer(f"{name}_c", ConvolutionLayer(
+                kernelSize=k, stride=s, nOut=n_out, hasBias=False,
+                convolutionMode="same", activation="identity"), inp)
+            g.addLayer(f"{name}_bn", BatchNormalization(activation="relu"),
+                       f"{name}_c")
+            return f"{name}_bn"
+
+        def inception(name, inp, n1, n3r, n3, n5r, n5, pp):
+            b1 = conv_bn(f"{name}_1", inp, n1, (1, 1))
+            b3 = conv_bn(f"{name}_3r", inp, n3r, (1, 1))
+            b3 = conv_bn(f"{name}_3", b3, n3, (3, 3))
+            b5 = conv_bn(f"{name}_5r", inp, n5r, (1, 1))
+            b5 = conv_bn(f"{name}_5", b5, n5, (5, 5))
+            g.addLayer(f"{name}_pool", SubsamplingLayer(
+                kernelSize=(3, 3), stride=(1, 1), convolutionMode="same"),
+                inp)
+            bp = conv_bn(f"{name}_pp", f"{name}_pool", pp, (1, 1))
+            g.addVertex(f"{name}_cat", MergeVertex(), b1, b3, b5, bp)
+            return f"{name}_cat"
+
+        x = conv_bn("stem1", "input", 64, (7, 7), (2, 2))
+        g.addLayer("stem_pool", SubsamplingLayer(
+            kernelSize=(3, 3), stride=(2, 2), convolutionMode="same"), x)
+        x = conv_bn("stem2", "stem_pool", 64, (1, 1))
+        x = conv_bn("stem3", x, 192, (3, 3))
+        g.addLayer("stem_pool2", SubsamplingLayer(
+            kernelSize=(3, 3), stride=(2, 2), convolutionMode="same"), x)
+        x = inception("i3a", "stem_pool2", 64, 96, 128, 16, 32, 32)
+        x = inception("i3b", x, 64, 96, 128, 32, 64, 64)
+        g.addLayer("pool3", SubsamplingLayer(
+            kernelSize=(3, 3), stride=(2, 2), convolutionMode="same"), x)
+        x = inception("i4a", "pool3", 256, 96, 192, 32, 64, 128)
+        x = inception("i4e", x, 256, 160, 256, 64, 128, 128)
+        g.addLayer("pool4", SubsamplingLayer(
+            kernelSize=(3, 3), stride=(2, 2), convolutionMode="same"), x)
+        x = inception("i5a", "pool4", 256, 96, 384, 32, 64, 96)
+        g.addLayer("gap", GlobalPoolingLayer(poolingType="avg"), x)
+        g.addLayer("bottleneck", DenseLayer(nOut=self.embeddingSize,
+                                            activation="identity"), "gap")
+        from deeplearning4j_tpu.nn.conf.graph_vertices import \
+            L2NormalizeVertex
+        g.addVertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        g.addLayer("out", CenterLossOutputLayer(
+            lambda_=2e-4, alpha=0.9, nOut=self.numClasses,
+            activation="softmax"), "embeddings")
+        g.setOutputs("out")
+        return g.build()

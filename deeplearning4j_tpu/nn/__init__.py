@@ -11,7 +11,11 @@ from deeplearning4j_tpu.nn.conf.layers import (
     GlobalPoolingLayer, LossLayer, OutputLayer, PReLULayer,
     SeparableConvolution2D, Subsampling1DLayer, SubsamplingLayer,
     Upsampling2D, ZeroPaddingLayer)
-from deeplearning4j_tpu.nn.losses import LossFunction
+from deeplearning4j_tpu.nn.conf.special_layers import (
+    CenterLossOutputLayer, LocallyConnected2D, VariationalAutoencoder)
+from deeplearning4j_tpu.nn.losses import (LossBinaryXENT, LossFunction,
+                                          LossMCXENT, LossMSE,
+                                          LossNegativeLogLikelihood)
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.updaters import (AMSGrad, AdaDelta, AdaGrad,
                                             AdaMax, Adam, GradientNormalization,
@@ -27,7 +31,10 @@ __all__ = [
     "EmbeddingSequenceLayer", "GlobalPoolingLayer", "LossLayer",
     "OutputLayer", "PReLULayer", "SeparableConvolution2D",
     "Subsampling1DLayer", "SubsamplingLayer", "Upsampling2D",
-    "ZeroPaddingLayer", "LossFunction", "MultiLayerNetwork", "AMSGrad",
+    "ZeroPaddingLayer", "CenterLossOutputLayer", "LocallyConnected2D",
+    "VariationalAutoencoder", "LossBinaryXENT", "LossMCXENT", "LossMSE",
+    "LossNegativeLogLikelihood",
+    "LossFunction", "MultiLayerNetwork", "AMSGrad",
     "AdaDelta", "AdaGrad", "AdaMax", "Adam", "GradientNormalization",
     "Nadam", "Nesterovs", "NoOp", "RmsProp", "Sgd", "Updater", "WeightInit",
 ]

@@ -189,3 +189,25 @@ class LastTimeStepVertex(GraphVertex):
             return x[:, -1, :]
         idx = jnp.maximum(jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1, 0)
         return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :]
+
+
+class SpaceToDepthVertex(GraphVertex):
+    """≡ conf.layers.SpaceToDepthLayer as a vertex (YOLOv2 'reorg'
+    passthrough): (B, H, W, C) → (B, H/b, W/b, C·b²)."""
+
+    def __init__(self, blockSize=2):
+        self.blockSize = int(blockSize)
+
+    def output_type(self, *ts):
+        t = ts[0]
+        b = self.blockSize
+        return InputType.convolutional(t.height // b, t.width // b,
+                                       t.channels * b * b)
+
+    def apply(self, *xs, mask=None):
+        x = xs[0]
+        n, h, w, c = x.shape
+        b = self.blockSize
+        x = x.reshape(n, h // b, b, w // b, b, c)
+        return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+            n, h // b, w // b, c * b * b)

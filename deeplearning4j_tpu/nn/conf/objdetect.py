@@ -1,0 +1,144 @@
+"""Object detection head (≡ deeplearning4j-nn ::
+conf.layers.objdetect.Yolo2OutputLayer + util.YoloUtils).
+
+YOLOv2 loss, fully vectorized for XLA (no per-box host loops):
+predictions (B, H, W, A·(5+C)) reshape to (B, H, W, A, 5+C) =
+(tx, ty, tw, th, to, class logits). Cell-relative box decode uses
+sigmoid(tx,ty) and anchor-scaled exp(tw,th); the anchor "responsible" for
+a ground-truth box is the best shape-prior IoU (argmax over A), computed
+batched. Loss = λcoord·coord MSE (responsible anchors) +
+confidence MSE toward the live decoded IoU (matching the reference's
+predictedWH-based confidence target) + λnoobj·conf² elsewhere +
+per-cell class cross-entropy.
+
+Labels are NHWC: (B, H, W, 4+C) — (x, y, w, h) in GRID units (center
+xy ∈ [0, W)/[0, H), wh in cells) followed by a one-hot class vector;
+all-zero class vector ⇒ no object in that cell (one gt box per cell,
+as the reference's label rasterization produces).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import ConvolutionalType, InputType
+from deeplearning4j_tpu.nn.conf.layers import Layer
+
+
+class Yolo2OutputLayer(Layer):
+    """Loss-only head (like the reference: no parameters; sits after the
+    1×1 conv that produces A·(5+C) channels)."""
+
+    def __init__(self, boundingBoxes=None, lambdaCoord=5.0, lambdaNoObj=0.5,
+                 **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        # anchors (A, 2) in grid units (≡ boundingBoxPriors)
+        self.boundingBoxes = [list(map(float, b)) for b in (
+            boundingBoxes or [[1.0, 1.0], [2.0, 2.0], [3.3, 3.3]])]
+        self.lambdaCoord = float(lambdaCoord)
+        self.lambdaNoObj = float(lambdaNoObj)
+
+    @property
+    def numBoxes(self):
+        return len(self.boundingBoxes)
+
+    def output_type(self, input_type):
+        return input_type
+
+    def initialize(self, key, input_type):
+        if isinstance(input_type, ConvolutionalType):
+            a = self.numBoxes
+            if input_type.channels % a:
+                raise ValueError(
+                    f"Yolo2OutputLayer: input channels {input_type.channels}"
+                    f" not divisible by {a} anchors")
+            self._num_classes = input_type.channels // a - 5
+            if self._num_classes < 0:
+                raise ValueError("Yolo2OutputLayer: need A*(5+C) channels")
+        return {}, {}, input_type
+
+    def pre_activation(self, params, x):
+        return x
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        return x, state
+
+    # -- decode (≡ YoloUtils.getPredictedObjects, batched) ---------------
+    def decode(self, preact):
+        """(B,H,W,A*(5+C)) → dict of decoded tensors in grid units."""
+        b, h, w, _ = preact.shape
+        a = self.numBoxes
+        p = preact.reshape(b, h, w, a, -1)
+        anchors = jnp.asarray(self.boundingBoxes, preact.dtype)
+        cx = jax.lax.broadcasted_iota(preact.dtype, (b, h, w, a), 2)
+        cy = jax.lax.broadcasted_iota(preact.dtype, (b, h, w, a), 1)
+        x = jax.nn.sigmoid(p[..., 0]) + cx
+        y = jax.nn.sigmoid(p[..., 1]) + cy
+        bw = anchors[:, 0] * jnp.exp(jnp.clip(p[..., 2], -8, 8))
+        bh = anchors[:, 1] * jnp.exp(jnp.clip(p[..., 3], -8, 8))
+        conf = jax.nn.sigmoid(p[..., 4])
+        cls = jax.nn.softmax(p[..., 5:], axis=-1)
+        return {"xy": jnp.stack([x, y], -1), "wh": jnp.stack([bw, bh], -1),
+                "confidence": conf, "classes": cls}
+
+    @staticmethod
+    def _iou_xywh(xy1, wh1, xy2, wh2):
+        """IoU of center-format boxes; broadcasts over leading dims."""
+        lo1, hi1 = xy1 - wh1 / 2, xy1 + wh1 / 2
+        lo2, hi2 = xy2 - wh2 / 2, xy2 + wh2 / 2
+        inter = jnp.clip(jnp.minimum(hi1, hi2) - jnp.maximum(lo1, lo2),
+                         0.0, None)
+        ia = inter[..., 0] * inter[..., 1]
+        a1 = jnp.clip(wh1[..., 0] * wh1[..., 1], 1e-9, None)
+        a2 = jnp.clip(wh2[..., 0] * wh2[..., 1], 1e-9, None)
+        return ia / (a1 + a2 - ia + 1e-9)
+
+    def compute_loss(self, labels, preact, mask=None):
+        b, h, w, _ = preact.shape
+        a = self.numBoxes
+        p = preact.astype(jnp.float32).reshape(b, h, w, a, -1)
+        anchors = jnp.asarray(self.boundingBoxes, jnp.float32)  # (A, 2)
+        labels = labels.astype(jnp.float32)
+        gt_xy = labels[..., 0:2]                      # (B,H,W,2) grid units
+        gt_wh = labels[..., 2:4]
+        gt_cls = labels[..., 4:]
+        obj = (gt_cls.sum(-1) > 0).astype(jnp.float32)  # (B,H,W)
+
+        # responsible anchor: best shape-prior IoU (wh only, origin-aligned)
+        inter = (jnp.minimum(gt_wh[..., None, 0], anchors[:, 0])
+                 * jnp.minimum(gt_wh[..., None, 1], anchors[:, 1]))
+        union = (gt_wh[..., 0:1] * gt_wh[..., 1:2]
+                 + anchors[:, 0] * anchors[:, 1] - inter)
+        prior_iou = inter / jnp.clip(union, 1e-9, None)   # (B,H,W,A)
+        resp = jax.nn.one_hot(jnp.argmax(prior_iou, -1), a) \
+            * obj[..., None]                              # (B,H,W,A)
+
+        # decode predictions — the same decode inference uses, so the
+        # training target can never drift from the deployed box decode
+        dec = self.decode(preact.astype(jnp.float32))
+        pred_xy, pred_wh, pred_conf = (dec["xy"], dec["wh"],
+                                       dec["confidence"])
+
+        n_obj = jnp.maximum(obj.sum(), 1.0)
+        # coordinate loss (sqrt-wh as in the paper/reference)
+        d_xy = ((pred_xy - gt_xy[..., None, :]) ** 2).sum(-1)
+        d_wh = ((jnp.sqrt(jnp.clip(pred_wh, 1e-9, None))
+                 - jnp.sqrt(jnp.clip(gt_wh[..., None, :], 1e-9, None))) ** 2
+                ).sum(-1)
+        coord = self.lambdaCoord * (resp * (d_xy + d_wh)).sum() / n_obj
+
+        # confidence: target is live decoded IoU for responsible anchors
+        live_iou = jax.lax.stop_gradient(self._iou_xywh(
+            pred_xy, pred_wh, gt_xy[..., None, :], gt_wh[..., None, :]))
+        conf_obj = (resp * (pred_conf - live_iou) ** 2).sum() / n_obj
+        conf_noobj = self.lambdaNoObj * (
+            (1.0 - resp) * pred_conf ** 2).sum() / (b * h * w * a)
+
+        # class loss at object cells (softmax CE over the responsible
+        # anchor's class logits)
+        logp = jax.nn.log_softmax(p[..., 5:], axis=-1)
+        ce = -(gt_cls[..., None, :] * logp).sum(-1)       # (B,H,W,A)
+        cls_loss = (resp * ce).sum() / n_obj
+
+        return coord + conf_obj + conf_noobj + cls_loss

@@ -1,0 +1,257 @@
+"""Special layers (≡ deeplearning4j-nn :: conf.layers.LocallyConnected2D,
+conf.layers.variational.VariationalAutoencoder, conf.layers.misc.
+CenterLossOutputLayer).
+
+LocallyConnected2D keeps the whole unshared-weights contraction as one
+einsum — an MXU-shaped batched matmul per output position instead of the
+reference's per-position im2col loop. The VAE trains by ELBO through
+`MultiLayerNetwork.pretrainLayer` (≡ the reference's layerwise
+pretrain(iterator) path); its supervised activate() is the latent mean,
+matching the reference's behaviour when a VAE sits mid-network.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import ConvolutionalType, InputType
+from deeplearning4j_tpu.nn.conf.layers import (BaseOutputLayer, DenseLayer,
+                                               Layer, _pair)
+from deeplearning4j_tpu.nn.weights_init import init_weight
+
+
+class LocallyConnected2D(Layer):
+    """≡ conf.layers.LocallyConnected2D — convolution with UNSHARED
+    weights: each output position owns its own filter bank."""
+
+    def __init__(self, nIn=None, nOut=None, kernelSize=(3, 3), stride=(1, 1),
+                 convolutionMode="truncate", hasBias=True, **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut = nIn, nOut
+        self.kernelSize, self.stride = _pair(kernelSize), _pair(stride)
+        self.convolutionMode = convolutionMode
+        self.hasBias = hasBias
+
+    def _out_hw(self, input_type):
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        if str(self.convolutionMode).lower() == "same":
+            return -(-input_type.height // sh), -(-input_type.width // sw)
+        return ((input_type.height - kh) // sh + 1,
+                (input_type.width - kw) // sw + 1)
+
+    def output_type(self, input_type):
+        if not isinstance(input_type, ConvolutionalType):
+            raise ValueError(
+                f"LocallyConnected2D '{self.name}' needs convolutional "
+                f"input, got {input_type}")
+        oh, ow = self._out_hw(input_type)
+        return InputType.convolutional(oh, ow, self.nOut)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.channels
+        if self.nOut is None:
+            raise ValueError(f"LocallyConnected2D '{self.name}': nOut not set")
+        self._in_hw = (input_type.height, input_type.width)
+        oh, ow = self._out_hw(input_type)
+        kh, kw = self.kernelSize
+        w = init_weight(key, (oh, ow, kh * kw * int(self.nIn),
+                              int(self.nOut)), self.weightInit, self.dist)
+        params = {"W": w}
+        if self.hasBias:
+            params["b"] = jnp.full((oh, ow, int(self.nOut)),
+                                   float(self.biasInit), jnp.float32)
+        return params, {}, self.output_type(input_type)
+
+    def pre_activation(self, params, x):
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        oh, ow = params["W"].shape[:2]
+        if str(self.convolutionMode).lower() == "same":
+            ph = max(0, (oh - 1) * sh + kh - x.shape[1])
+            pw = max(0, (ow - 1) * sw + kw - x.shape[2])
+            x = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
+                            (pw // 2, pw - pw // 2), (0, 0)))
+        # static unrolled patch extraction: (B, oh, ow, kh*kw*C)
+        patches = [x[:, di:di + oh * sh:sh, dj:dj + ow * sw:sw, :]
+                   for di in range(kh) for dj in range(kw)]
+        xp = jnp.concatenate(patches, axis=-1)
+        y = jnp.einsum("bhwp,hwpo->bhwo", xp,
+                       params["W"].astype(x.dtype))
+        if self.hasBias:
+            y = y + params["b"].astype(x.dtype)
+        return y
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        return (get_activation(self.activation)(
+            self.pre_activation(params, x)), state)
+
+
+class VariationalAutoencoder(Layer):
+    """≡ conf.layers.variational.VariationalAutoencoder.
+
+    Gaussian q(z|x); reconstruction distribution 'gaussian' (mean+logvar
+    heads) or 'bernoulli' (logits). Supervised activate() returns the
+    latent mean (≡ reference's VAE activate); unsupervised training goes
+    through MultiLayerNetwork.pretrain/pretrainLayer maximizing the ELBO
+    as one jitted step.
+    """
+
+    def __init__(self, nIn=None, nOut=None, encoderLayerSizes=(256,),
+                 decoderLayerSizes=(256,),
+                 reconstructionDistribution="gaussian",
+                 pzxActivationFunction="identity", numSamples=1, **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut = nIn, nOut
+        self.encoderLayerSizes = tuple(int(s) for s in encoderLayerSizes)
+        self.decoderLayerSizes = tuple(int(s) for s in decoderLayerSizes)
+        self.reconstructionDistribution = reconstructionDistribution
+        self.pzxActivationFunction = pzxActivationFunction
+        self.numSamples = int(numSamples)
+
+    def output_type(self, input_type):
+        return InputType.feedForward(self.nOut)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.size
+        if self.nOut is None:
+            raise ValueError(
+                f"VariationalAutoencoder '{self.name}': nOut not set")
+        params = {}
+        sizes_e = (int(self.nIn),) + self.encoderLayerSizes
+        for i, (a, b) in enumerate(zip(sizes_e[:-1], sizes_e[1:])):
+            key, k = jax.random.split(key)
+            params[f"eW{i}"] = init_weight(k, (a, b), self.weightInit,
+                                           self.dist)
+            params[f"eb{i}"] = jnp.zeros((b,), jnp.float32)
+        key, k1, k2 = jax.random.split(key, 3)
+        h = sizes_e[-1]
+        params["muW"] = init_weight(k1, (h, int(self.nOut)),
+                                    self.weightInit, self.dist)
+        params["mub"] = jnp.zeros((int(self.nOut),), jnp.float32)
+        params["lvW"] = init_weight(k2, (h, int(self.nOut)),
+                                    self.weightInit, self.dist)
+        params["lvb"] = jnp.zeros((int(self.nOut),), jnp.float32)
+        sizes_d = (int(self.nOut),) + self.decoderLayerSizes
+        for i, (a, b) in enumerate(zip(sizes_d[:-1], sizes_d[1:])):
+            key, k = jax.random.split(key)
+            params[f"dW{i}"] = init_weight(k, (a, b), self.weightInit,
+                                           self.dist)
+            params[f"db{i}"] = jnp.zeros((b,), jnp.float32)
+        key, k1, k2 = jax.random.split(key, 3)
+        hd = sizes_d[-1]
+        params["rW"] = init_weight(k1, (hd, int(self.nIn)),
+                                   self.weightInit, self.dist)
+        params["rb"] = jnp.zeros((int(self.nIn),), jnp.float32)
+        if self.reconstructionDistribution == "gaussian":
+            params["rlvW"] = init_weight(k2, (hd, int(self.nIn)),
+                                         self.weightInit, self.dist)
+            params["rlvb"] = jnp.zeros((int(self.nIn),), jnp.float32)
+        return params, {}, self.output_type(input_type)
+
+    # -- encoder/decoder pieces ------------------------------------------
+    def _encode(self, params, x):
+        act = get_activation(self.activation)
+        h = x
+        for i in range(len(self.encoderLayerSizes)):
+            h = act(h @ params[f"eW{i}"].astype(x.dtype)
+                    + params[f"eb{i}"].astype(x.dtype))
+        pzx = get_activation(self.pzxActivationFunction)
+        mu = pzx(h @ params["muW"].astype(x.dtype)
+                 + params["mub"].astype(x.dtype))
+        logvar = h @ params["lvW"].astype(x.dtype) \
+            + params["lvb"].astype(x.dtype)
+        return mu, logvar
+
+    def _decode(self, params, z):
+        act = get_activation(self.activation)
+        h = z
+        for i in range(len(self.decoderLayerSizes)):
+            h = act(h @ params[f"dW{i}"].astype(z.dtype)
+                    + params[f"db{i}"].astype(z.dtype))
+        return h
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        mu, _ = self._encode(params, x)
+        return mu, state
+
+    def reconstruct(self, params, x):
+        """Mean reconstruction through the latent mean (≡ reference
+        reconstructionProbability-style usage, deterministic form)."""
+        mu, _ = self._encode(params, x)
+        h = self._decode(params, mu)
+        r = h @ params["rW"] + params["rb"]
+        if self.reconstructionDistribution == "bernoulli":
+            r = jax.nn.sigmoid(r)
+        return r
+
+    def generateAtMeanGivenZ(self, params, z):
+        h = self._decode(params, jnp.asarray(z))
+        r = h @ params["rW"] + params["rb"]
+        if self.reconstructionDistribution == "bernoulli":
+            r = jax.nn.sigmoid(r)
+        return r
+
+    def pretrain_loss(self, params, x, rng):
+        """-ELBO (one MC sample per numSamples), mean over batch."""
+        mu, logvar = self._encode(params, x)
+        total = 0.0
+        for s in range(self.numSamples):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mu.shape,
+                                    mu.dtype)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+            h = self._decode(params, z)
+            rmu = h @ params["rW"].astype(x.dtype) \
+                + params["rb"].astype(x.dtype)
+            if self.reconstructionDistribution == "bernoulli":
+                ll = -(jnp.maximum(rmu, 0) - rmu * x
+                       + jnp.log1p(jnp.exp(-jnp.abs(rmu)))).sum(-1)
+            else:
+                rlv = h @ params["rlvW"].astype(x.dtype) \
+                    + params["rlvb"].astype(x.dtype)
+                ll = -0.5 * (rlv + (x - rmu) ** 2 / jnp.exp(rlv)
+                             + jnp.log(2 * jnp.pi)).sum(-1)
+            total = total + ll
+        ll = total / self.numSamples
+        kl = -0.5 * (1 + logvar - mu ** 2 - jnp.exp(logvar)).sum(-1)
+        return jnp.mean(kl - ll)
+
+
+class CenterLossOutputLayer(BaseOutputLayer, DenseLayer):
+    """≡ conf.layers.CenterLossOutputLayer — softmax loss plus
+    0.5·λ·||f−c_y||² pulling features toward per-class centers (the
+    FaceNetNN4Small2 training head).
+
+    Centers are parameters: the gradient of the center term w.r.t. c_y is
+    λ·(c_y − f̄), so the network's own updater performs the reference's
+    α-rate center pull inside the one jitted train step (α ≈ lr·λ)."""
+
+    needs_features = True
+
+    def __init__(self, alpha=0.05, lambda_=2e-4, **kw):
+        kw.setdefault("lossFunction", "mcxent")
+        self.alpha = float(alpha)
+        self.lambda_ = float(lambda_)
+        super().__init__(**kw)
+
+    def initialize(self, key, input_type):
+        params, state, out = super().initialize(key, input_type)
+        params = dict(params)
+        params["centers"] = jnp.zeros((int(self.nOut), int(self.nIn)),
+                                      jnp.float32)
+        return params, state, out
+
+    def compute_loss_with_features(self, params, labels, preact, features,
+                                   mask=None):
+        from deeplearning4j_tpu.nn.losses import get_loss
+        base = get_loss(self.lossFunction)(labels, preact, self.activation,
+                                           mask)
+        cy = labels @ params["centers"].astype(features.dtype)  # (B, nIn)
+        center_term = 0.5 * self.lambda_ * jnp.mean(
+            ((features - cy) ** 2).sum(-1))
+        return base + center_term

@@ -201,7 +201,18 @@ class ComputationGraph:
                 raise ValueError(f"Output node '{name}' is not an output layer")
             y = labels[i].astype(jnp.float32)
             lm = None if lmasks is None else lmasks[i]
-            total = total + layer.compute_loss(y, preacts[name].astype(jnp.float32), lm)
+            if getattr(layer, "needs_features", False):
+                node = self.nodes[name]
+                feats = acts[node.inputs[0]]
+                if node.preprocessor is not None:
+                    feats = node.preprocessor.preProcess(feats)
+                total = total + layer.compute_loss_with_features(
+                    params.get(name, {}), y,
+                    preacts[name].astype(jnp.float32),
+                    feats.astype(jnp.float32), lm)
+            else:
+                total = total + layer.compute_loss(
+                    y, preacts[name].astype(jnp.float32), lm)
         layer_list = [self.nodes[n].ref for n in self._layer_names]
         reg_params = {str(i): params.get(n, {})
                       for i, n in enumerate(self._layer_names)}

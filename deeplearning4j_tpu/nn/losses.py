@@ -175,3 +175,68 @@ class LossFunction:
     POISSON = "poisson"
     COSINE_PROXIMITY = "cosine_proximity"
     MEAN_ABSOLUTE_PERCENTAGE_ERROR = "mape"
+
+
+# -- configurable loss objects (≡ nd4j lossfunctions.impl.LossMCXENT /
+# LossBinaryXENT / LossMSE with weights + label smoothing) ---------------
+class _WeightedLoss:
+    """Callable loss config: per-output weights and label smoothing.
+    Instances pass straight through get_loss (callables are accepted) and
+    survive config JSON via __dict__ round-trip."""
+
+    base = None  # overridden
+
+    def __init__(self, weights=None, labelSmoothing=0.0):
+        self.weights = None if weights is None else [float(w)
+                                                     for w in weights]
+        self.labelSmoothing = float(labelSmoothing)
+
+    def _smooth(self, labels):
+        s = self.labelSmoothing
+        if not s:
+            return labels
+        k = labels.shape[-1]
+        return labels * (1.0 - s) + s / k
+
+    def __call__(self, labels, preact, activation=None, mask=None):
+        labels = self._smooth(labels)
+        if self.weights is not None:
+            w = jnp.asarray(self.weights, labels.dtype)
+            labels = labels * w
+        fn = LOSSES[self.base]
+        return fn(labels, preact,
+                  **({"activation": activation} if activation else {}),
+                  mask=mask)
+
+
+class LossMCXENT(_WeightedLoss):
+    base = "mcxent"
+
+
+class LossNegativeLogLikelihood(LossMCXENT):
+    pass
+
+
+class LossBinaryXENT(_WeightedLoss):
+    base = "xent"
+
+    def _smooth(self, labels):
+        s = self.labelSmoothing
+        # binary smoothing: y*(1-s) + 0.5*s (reference LossBinaryXENT)
+        return labels if not s else labels * (1.0 - s) + 0.5 * s
+
+
+class LossMSE(_WeightedLoss):
+    base = "mse"
+
+    def __call__(self, labels, preact, activation=None, mask=None):
+        if self.weights is None:
+            return mse(labels, preact, activation=activation or "identity",
+                       mask=mask)
+        w = jnp.asarray(self.weights, preact.dtype)
+        out = get_activation(activation or "identity")(preact)
+        labels2, out2, mask2 = _flatten_time(labels, out, mask)
+        # same /nOut normalization as unweighted mse(): identity weights
+        # must be a no-op
+        per = w * (labels2 - out2) ** 2 / labels2.shape[-1]
+        return _apply_mask_mean(per, mask2)

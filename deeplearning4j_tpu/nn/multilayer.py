@@ -32,7 +32,7 @@ def _l1l2_penalty(layer_confs, params):
             continue
         p = params.get(str(i), {})
         for name, v in p.items():
-            if name in ("b", "beta", "gamma", "alpha"):
+            if name in ("b", "beta", "gamma", "alpha", "centers"):
                 continue
             v = v.astype(jnp.float32)
             if l1:
@@ -238,15 +238,33 @@ class MultiLayerNetwork:
         out_layer = self.layers[-1]
         if not hasattr(out_layer, "compute_loss"):
             raise ValueError("Last layer must be an OutputLayer/LossLayer to fit()")
+        needs_feats = getattr(out_layer, "needs_features", False)
+        if needs_feats and carries is not None:
+            raise ValueError(
+                f"{type(out_layer).__name__} (feature-dependent loss) is "
+                "not supported with truncated BPTT")
         if carries is not None:
             _, preact, new_state, _, new_carries = self._forward(
                 params, state, x, train, rng, mask=fmask, carries=carries)
         else:
-            _, preact, new_state, _ = self._forward(
-                params, state, x, train, rng, mask=fmask)
+            _, preact, new_state, acts = self._forward(
+                params, state, x, train, rng, mask=fmask,
+                collect=needs_feats)
             new_carries = None
-        data_loss = out_layer.compute_loss(y.astype(jnp.float32),
-                                           preact.astype(jnp.float32), lmask)
+        if needs_feats and carries is None:
+            feats = acts[-2] if len(acts) >= 2 else x.astype(
+                self._compute_dtype)
+            pp = self.conf.preprocessors.get(len(self.layers) - 1)
+            if pp is not None:
+                feats = pp.preProcess(feats)
+            data_loss = out_layer.compute_loss_with_features(
+                params.get(str(len(self.layers) - 1), {}),
+                y.astype(jnp.float32), preact.astype(jnp.float32),
+                feats.astype(jnp.float32), lmask)
+        else:
+            data_loss = out_layer.compute_loss(y.astype(jnp.float32),
+                                               preact.astype(jnp.float32),
+                                               lmask)
         return (data_loss + _l1l2_penalty(self.layers, params),
                 (new_state, new_carries))
 
@@ -351,6 +369,60 @@ class MultiLayerNetwork:
         self._iteration += 1
         for listener in self._listeners:
             listener.iterationDone(self, self._iteration, self._epoch)
+
+    # -- layerwise unsupervised pretraining (≡ MultiLayerNetwork.pretrain
+    # / pretrainLayer: VAE ELBO, historically RBM contrastive divergence) -
+    def pretrainLayer(self, layer_idx, data, epochs=1):
+        """Unsupervised-train one layer (must define pretrain_loss) on the
+        activations feeding it; one jitted step over that layer's params."""
+        layer = self.layers[int(layer_idx)]
+        if not hasattr(layer, "pretrain_loss"):
+            return self  # ≡ reference: non-pretrainable layers are skipped
+        key = str(layer_idx)
+        tx = build_optimizer(
+            layer.updater or self.conf.defaults.get("updater"),
+            self.conf.defaults.get("gradientNormalization"),
+            self.conf.defaults.get("gradientNormalizationThreshold", 1.0),
+            self.conf.defaults.get("weightDecay", 0.0) or 0.0)
+        opt_state = tx.init(self._params[key])
+
+        @jax.jit
+        def step(p, opt, x, rng):
+            loss, grads = jax.value_and_grad(layer.pretrain_loss)(p, x, rng)
+            updates, opt = tx.update(grads, opt, p)
+            return optax.apply_updates(p, updates), opt, loss
+
+        def batches():
+            if hasattr(data, "reset"):
+                data.reset()
+                for ds in data:
+                    yield as_jax(ds.features)
+            else:
+                yield as_jax(data.features if isinstance(data, DataSet)
+                             else data)
+
+        p = self._params[key]
+        for _ in range(int(epochs)):
+            for feats in batches():
+                if layer_idx > 0:
+                    feats = self.activateSelectedLayers(
+                        0, layer_idx - 1, feats).jax()
+                pp = self.conf.preprocessors.get(int(layer_idx))
+                if pp is not None:
+                    feats = pp.preProcess(feats)
+                self._rng_key, sub = jax.random.split(self._rng_key)
+                p, opt_state, loss = step(p, opt_state, feats, sub)
+                self._score = float(loss)
+        self._params[key] = p
+        self._build_optimizer()  # opt state shapes unchanged but refresh
+        return self
+
+    def pretrain(self, data, epochs=1):
+        """≡ reference pretrain(iterator): layerwise over all layers that
+        support unsupervised pretraining."""
+        for i in range(len(self.layers)):
+            self.pretrainLayer(i, data, epochs)
+        return self
 
     def fit(self, data, labels=None, epochs=None):
         if self._params is None:

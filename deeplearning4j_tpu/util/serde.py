@@ -7,9 +7,13 @@ decode resolves the class from a registry of config modules.
 from __future__ import annotations
 
 import importlib
+import types
 
 _CONFIG_MODULES = [
     "deeplearning4j_tpu.nn.conf.layers",
+    "deeplearning4j_tpu.nn.conf.special_layers",
+    "deeplearning4j_tpu.nn.conf.objdetect",
+    "deeplearning4j_tpu.nn.losses",
     "deeplearning4j_tpu.nn.conf.inputs",
     "deeplearning4j_tpu.nn.conf.preprocessors",
     "deeplearning4j_tpu.nn.conf.builders",
@@ -42,7 +46,12 @@ def encode(obj):
     # config object: class + public fields
     d = {"@class": type(obj).__name__}
     for k, v in obj.__dict__.items():
-        if k.startswith("_") or callable(v):
+        # skip functions/methods, but keep callable CONFIG OBJECTS
+        # (e.g. LossMCXENT instances) — they encode via @class like any
+        # other config value
+        if k.startswith("_") or isinstance(
+                v, (types.FunctionType, types.MethodType,
+                    types.BuiltinFunctionType, type)):
             continue
         d[k] = encode(v)
     return d

@@ -1,0 +1,118 @@
+"""Object detection tests (≡ deeplearning4j :: TestYolo2OutputLayer /
+YoloUtils tests): YOLOv2 loss behaviour, decode, zoo YOLO models, FaceNet
+center-loss graph."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.zoo import TinyYOLO, YOLO2, FaceNetNN4Small2
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer
+from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def _rand(shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+def yolo_labels(b, h, w, n_cls, seed=0):
+    """One gt box in a random cell per image."""
+    rng = np.random.default_rng(seed)
+    lab = np.zeros((b, h, w, 4 + n_cls), np.float32)
+    for i in range(b):
+        ci, cj = rng.integers(h), rng.integers(w)
+        lab[i, ci, cj, 0] = cj + rng.random()          # x in grid units
+        lab[i, ci, cj, 1] = ci + rng.random()
+        lab[i, ci, cj, 2] = 1 + rng.random() * 2        # w
+        lab[i, ci, cj, 3] = 1 + rng.random() * 2
+        lab[i, ci, cj, 4 + rng.integers(n_cls)] = 1.0
+    return lab
+
+
+class TestYolo2Loss:
+    def _tiny_net(self, n_cls=3, anchors=((1., 1.), (3., 3.))):
+        return MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+            .weightInit("relu").list()
+            .layer(ConvolutionLayer(kernelSize=(3, 3), nOut=32,
+                                    convolutionMode="same",
+                                    activation="relu"))
+            .layer(ConvolutionLayer(
+                kernelSize=(1, 1), nOut=len(anchors) * (5 + n_cls),
+                convolutionMode="same", activation="identity"))
+            .layer(Yolo2OutputLayer(boundingBoxes=[list(a) for a in anchors]))
+            .setInputType(InputType.convolutional(8, 8, 3)).build()).init()
+
+    def test_loss_finite_and_decreases(self):
+        net = self._tiny_net()
+        x = _rand((4, 8, 8, 3))
+        lab = yolo_labels(4, 8, 8, 3)
+        scores = []
+        for _ in range(15):
+            net.fit(x, lab)
+            scores.append(float(net.score()))
+        assert np.isfinite(scores).all()
+        assert scores[-1] < scores[0]
+
+    def test_decode_shapes_and_ranges(self):
+        layer = Yolo2OutputLayer(boundingBoxes=[[1, 1], [2, 2]])
+        import jax.numpy as jnp
+        pre = jnp.asarray(_rand((2, 4, 4, 2 * 9)))  # C=4
+        dec = layer.decode(pre)
+        assert dec["xy"].shape == (2, 4, 4, 2, 2)
+        assert dec["wh"].shape == (2, 4, 4, 2, 2)
+        assert dec["confidence"].shape == (2, 4, 4, 2)
+        conf = np.asarray(dec["confidence"])
+        assert (conf >= 0).all() and (conf <= 1).all()
+        # xy offsets land inside the cell ⇒ within [0, grid)
+        xy = np.asarray(dec["xy"])
+        assert (xy >= 0).all() and (xy <= 4).all()
+        cls = np.asarray(dec["classes"])
+        assert np.allclose(cls.sum(-1), 1.0, atol=1e-5)
+
+    def test_channel_validation(self):
+        with pytest.raises(ValueError, match="anchors"):
+            MultiLayerNetwork(
+                NeuralNetConfiguration.Builder().list()
+                .layer(ConvolutionLayer(kernelSize=(1, 1), nOut=17,
+                                        convolutionMode="same"))
+                .layer(Yolo2OutputLayer(boundingBoxes=[[1, 1], [2, 2]]))
+                .setInputType(InputType.convolutional(4, 4, 3))
+                .build()).init()
+
+
+class TestYoloZoo:
+    def test_tinyyolo_trains(self):
+        m = TinyYOLO(numClasses=3, inputShape=(64, 64, 3))
+        net = m.init()
+        x = _rand((2, 64, 64, 3))
+        lab = yolo_labels(2, 2, 2, 3)     # 64 / 2^5 = 2 grid
+        net.fit(x, lab)
+        assert np.isfinite(float(net.score()))
+
+    def test_yolo2_builds_with_passthrough(self):
+        m = YOLO2(numClasses=4, inputShape=(64, 64, 3))
+        net = m.init()
+        out = net.output(_rand((1, 64, 64, 3)))
+        y = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+        # 64/32 = 2 grid; 5 anchors * (5+4) = 45 channels
+        assert y.shape == (1, 2, 2, 45)
+
+
+class TestFaceNet:
+    def test_builds_and_trains(self):
+        m = FaceNetNN4Small2(numClasses=5, inputShape=(32, 32, 3))
+        net = m.init()
+        x = _rand((4, 32, 32, 3))
+        out = net.output(x)
+        y = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+        assert y.shape == (4, 5)
+        lab = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
+        net.fit(x, lab)
+        assert np.isfinite(float(net.score()))
+        # embeddings are L2-normalized 128-d
+        emb = np.asarray(net.feedForward(x)["embeddings"])
+        assert emb.shape == (4, 128)
+        assert np.allclose(np.linalg.norm(emb, axis=1), 1.0, atol=1e-3)

@@ -129,3 +129,37 @@ class TestCheckpointListener:
             str(tmp_path / zips[-1]))
         assert np.array_equal(np.asarray(restored.output(X)),
                               np.asarray(net.output(X)))
+
+
+class TestLossObjectSerde:
+    def test_weighted_loss_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.nn import LossMCXENT
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(5).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(nOut=8, activation="relu"))
+            .layer(OutputLayer(lossFunction=LossMCXENT(weights=[1., 5.],
+                                                       labelSmoothing=0.1),
+                               nOut=2, activation="softmax"))
+            .setInputType(InputType.feedForward(4)).build()).init()
+        net.fit(X, np.abs(Y) / np.abs(Y).sum(1, keepdims=True))
+        p = str(tmp_path / "wl.zip")
+        ModelSerializer.writeModel(net, p)
+        net2 = ModelSerializer.restoreMultiLayerNetwork(p)
+        lf = net2.layers[-1].lossFunction
+        assert lf.weights == [1.0, 5.0] and lf.labelSmoothing == 0.1
+        # restored model must still train with the same loss value
+        s1 = net.score(DataSet(X, np.abs(Y) / np.abs(Y).sum(1, keepdims=True)))
+        s2 = net2.score(DataSet(X, np.abs(Y) / np.abs(Y).sum(1, keepdims=True)))
+        assert np.isclose(s1, s2)
+
+    def test_identity_weights_noop(self):
+        from deeplearning4j_tpu.nn import LossMSE
+        from deeplearning4j_tpu.nn.losses import mse
+        import jax.numpy as jnp
+        lab = jnp.asarray(np.random.default_rng(0).normal(
+            size=(4, 5)).astype(np.float32))
+        pre = jnp.asarray(np.random.default_rng(1).normal(
+            size=(4, 5)).astype(np.float32))
+        assert np.isclose(float(LossMSE(weights=[1.] * 5)(lab, pre)),
+                          float(mse(lab, pre)))

@@ -1,0 +1,167 @@
+"""Tests for LocallyConnected2D, VariationalAutoencoder (+pretrain),
+CenterLossOutputLayer, and weighted/label-smoothed losses (≡
+deeplearning4j-core layer tests: LocallyConnectedTest, TestVAE,
+CenterLossOutputLayerTest, LossFunctionJson/weighted loss tests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (CenterLossOutputLayer, LocallyConnected2D,
+                                   LossBinaryXENT, LossMCXENT,
+                                   VariationalAutoencoder)
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
+                                               OutputLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def _rand(shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+class TestLocallyConnected2D:
+    def _net(self, mode="truncate"):
+        return MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+            .weightInit("xavier").list()
+            .layer(LocallyConnected2D(kernelSize=(3, 3), nOut=4,
+                                      convolutionMode=mode,
+                                      activation="relu"))
+            .layer(OutputLayer(lossFunction="mcxent", nOut=3,
+                               activation="softmax"))
+            .setInputType(InputType.convolutional(8, 8, 2)).build()).init()
+
+    def test_shapes_valid_mode(self):
+        net = self._net()
+        y = np.asarray(net.output(_rand((2, 8, 8, 2))))
+        assert y.shape == (2, 3)
+        # unshared weights: W is (oh, ow, kh*kw*cin, cout)
+        assert net._params["0"]["W"].shape == (6, 6, 18, 4)
+
+    def test_same_mode_and_training(self):
+        net = self._net("same")
+        assert net._params["0"]["W"].shape == (8, 8, 18, 4)
+        x, yl = _rand((8, 8, 8, 2)), np.eye(3, dtype=np.float32)[
+            np.random.default_rng(1).integers(3, size=8)]
+        s0 = None
+        for _ in range(10):
+            net.fit(x, yl)
+            s = float(net.score())
+            s0 = s if s0 is None else s0
+        assert s < s0  # loss decreases
+
+    def test_unshared_vs_conv(self):
+        """A conv layer's response is translation-equivariant; locally
+        connected is not — check weights differ per position after a
+        gradient step (sanity that they are actually unshared)."""
+        net = self._net()
+        x, yl = _rand((4, 8, 8, 2)), np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+        net.fit(x, yl)
+        w = np.asarray(net._params["0"]["W"])
+        assert not np.allclose(w[0, 0], w[3, 3])
+
+
+class TestVAE:
+    def _vae_net(self, dist="gaussian"):
+        return MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-3))
+            .weightInit("xavier").activation("tanh").list()
+            .layer(VariationalAutoencoder(
+                nOut=4, encoderLayerSizes=(32,), decoderLayerSizes=(32,),
+                reconstructionDistribution=dist))
+            .layer(OutputLayer(lossFunction="mse", nOut=2,
+                               activation="identity"))
+            .setInputType(InputType.feedForward(10)).build()).init()
+
+    def test_activate_is_latent_mean(self):
+        net = self._vae_net()
+        x = _rand((5, 10))
+        lat = np.asarray(net.activateSelectedLayers(0, 0, x).jax())
+        assert lat.shape == (5, 4)
+
+    def test_pretrain_improves_elbo(self):
+        net = self._vae_net()
+        layer = net.layers[0]
+        x = _rand((64, 10), seed=2)
+        import jax
+        loss0 = float(layer.pretrain_loss(net._params["0"], x,
+                                          jax.random.PRNGKey(0)))
+        net.pretrainLayer(0, x, epochs=60)
+        loss1 = float(layer.pretrain_loss(net._params["0"], x,
+                                          jax.random.PRNGKey(0)))
+        assert loss1 < loss0
+
+    def test_bernoulli_reconstruction(self):
+        net = self._vae_net("bernoulli")
+        layer = net.layers[0]
+        x = (np.random.default_rng(0).random((6, 10)) > 0.5
+             ).astype(np.float32)
+        net.pretrainLayer(0, x, epochs=5)
+        rec = np.asarray(layer.reconstruct(net._params["0"], x))
+        assert rec.shape == (6, 10)
+        assert (rec >= 0).all() and (rec <= 1).all()
+
+    def test_generate_from_z(self):
+        net = self._vae_net()
+        z = _rand((3, 4))
+        out = np.asarray(net.layers[0].generateAtMeanGivenZ(
+            net._params["0"], z))
+        assert out.shape == (3, 10)
+
+    def test_supervised_fit_through_vae(self):
+        net = self._vae_net()
+        x, yl = _rand((16, 10)), _rand((16, 2), seed=9)
+        for _ in range(3):
+            net.fit(x, yl)
+        assert np.isfinite(float(net.score()))
+
+
+class TestCenterLoss:
+    def test_fit_and_centers_move(self):
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(11).updater(Adam(1e-2))
+            .weightInit("xavier").list()
+            .layer(DenseLayer(nOut=8, activation="relu"))
+            .layer(CenterLossOutputLayer(lambda_=0.1, nOut=3,
+                                         activation="softmax"))
+            .setInputType(InputType.feedForward(5)).build()).init()
+        x = _rand((12, 5))
+        yl = np.eye(3, dtype=np.float32)[
+            np.random.default_rng(2).integers(3, size=12)]
+        c0 = np.asarray(net._params["1"]["centers"]).copy()
+        s0 = None
+        for _ in range(10):
+            net.fit(x, yl)
+            s0 = float(net.score()) if s0 is None else s0
+        assert float(net.score()) < s0
+        assert not np.allclose(np.asarray(net._params["1"]["centers"]), c0)
+
+
+class TestWeightedLosses:
+    def test_label_smoothing_softens(self):
+        import jax.numpy as jnp
+        lab = jnp.asarray(np.eye(3, dtype="float32"))
+        pre = jnp.asarray(_rand((3, 3)))
+        plain = float(LossMCXENT()(lab, pre, "softmax"))
+        smooth = float(LossMCXENT(labelSmoothing=0.2)(lab, pre, "softmax"))
+        assert plain != smooth
+
+    def test_weighted_in_network(self):
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(nOut=8, activation="relu"))
+            .layer(OutputLayer(lossFunction=LossMCXENT(weights=[1., 5., 1.]),
+                               nOut=3, activation="softmax"))
+            .setInputType(InputType.feedForward(4)).build()).init()
+        x = _rand((6, 4))
+        yl = np.eye(3, dtype=np.float32)[[0, 1, 2, 0, 1, 2]]
+        net.fit(x, yl)
+        assert np.isfinite(float(net.score()))
+
+    def test_binary_smoothing_formula(self):
+        import jax.numpy as jnp
+        loss = LossBinaryXENT(labelSmoothing=0.2)
+        lab = jnp.asarray([[0.0, 1.0]])
+        assert np.allclose(np.asarray(loss._smooth(lab)), [[0.1, 0.9]])
