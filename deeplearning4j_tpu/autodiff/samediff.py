@@ -489,9 +489,96 @@ class SameDiff:
         self._loss_names = [n.name if isinstance(n, SDVariable) else n
                             for n in names]
 
+    def convertConstantsToVariables(self, *names):
+        """≡ SameDiff.convertToVariables — promote imported constants to
+        trainable variables (the imported-model fine-tune path)."""
+        for n in names:
+            n = n.name if isinstance(n, SDVariable) else n
+            node = self._nodes[n]
+            if node.vtype == VariableType.CONSTANT:
+                node.vtype = VariableType.VARIABLE
+        self._tx = None  # optimizer state must re-init over the new set
+        self._invalidate()
+        return self
+
+    def convertVariablesToConstants(self, *names):
+        """≡ SameDiff.convertToConstants — freeze variables."""
+        for n in names:
+            n = n.name if isinstance(n, SDVariable) else n
+            node = self._nodes[n]
+            if node.vtype == VariableType.VARIABLE:
+                node.vtype = VariableType.CONSTANT
+        self._tx = None
+        self._invalidate()
+        return self
+
     def setTrainingConfig(self, tc):
         self._training_config = tc
         self._tx = None
+
+    # -- control flow (≡ SameDiff control-flow ops: If/While/For — lowered
+    # to lax.cond / lax.while_loop / lax.scan so the compiled graph stays
+    # ONE XLA executable with structured control flow, no unrolling) -----
+    def ifCond(self, name, pred, inputs, true_fn, false_fn):
+        """pred: scalar SDVariable; true_fn/false_fn: plain jnp functions
+        taking the input ARRAYS and returning one array. Lowered to
+        lax.cond (both branches traced, compiler picks at runtime)."""
+        inputs = [self._lift(v) for v in inputs]
+
+        def f(p, *arrs):
+            return jax.lax.cond(jnp.reshape(p, ()).astype(bool),
+                                lambda a: true_fn(*a),
+                                lambda a: false_fn(*a), arrs)
+
+        return self._op_named(name, "if", f, self._lift(pred), *inputs)
+
+    def whileLoop(self, name, loop_vars, cond_fn, body_fn):
+        """loop_vars: list of SDVariables (initial state). cond_fn/body_fn:
+        jnp functions over the state arrays; body returns the new state
+        tuple. Returns one SDVariable per state slot (final values)."""
+        loop_vars = [self._lift(v) for v in loop_vars]
+        n = len(loop_vars)
+
+        def f(*arrs):
+            return jax.lax.while_loop(lambda vs: cond_fn(*vs),
+                                      lambda vs: tuple(body_fn(*vs)),
+                                      tuple(arrs))
+
+        tup = self._op_named(f"{name}/state", "while", f, *loop_vars)
+        return [self._op_named(f"{name}/out{i}", "tuple_get",
+                               (lambda i_: lambda t: t[i_])(i), tup)
+                for i in range(n)]
+
+    def scanLoop(self, name, init, xs, body_fn):
+        """lax.scan surface: body_fn(carry, x) -> (carry, y). Returns
+        (final_carry, stacked_ys) SDVariables."""
+        init = self._lift(init)
+        xs = self._lift(xs)
+
+        def f(c0, xs_arr):
+            return jax.lax.scan(body_fn, c0, xs_arr)
+
+        tup = self._op_named(f"{name}/state", "scan", f, init, xs)
+        carry = self._op_named(f"{name}/carry", "tuple_get",
+                               lambda t: t[0], tup)
+        ys = self._op_named(f"{name}/ys", "tuple_get", lambda t: t[1], tup)
+        return carry, ys
+
+    def forLoop(self, name, n_iters, loop_vars, body_fn):
+        """Fixed-trip-count loop via lax.fori_loop."""
+        loop_vars = [self._lift(v) for v in loop_vars]
+        n = len(loop_vars)
+
+        def f(*arrs):
+            return jax.lax.fori_loop(
+                0, int(n_iters),
+                lambda i, vs: tuple(body_fn(i, *vs)), tuple(arrs))
+
+        tup = self._op_named(f"{name}/state", "for", f, *loop_vars)
+        return [self._op_named(f"{name}/out{i}", "tuple_get",
+                               (lambda i_: lambda t: t[i_])(i), tup)
+                for i in range(n)]
+
 
     def _total_loss(self, values, placeholders):
         runner = self._make_exec(tuple(self._loss_names))
@@ -537,11 +624,15 @@ class SameDiff:
 
         return step
 
-    def fit(self, dataset=None, placeholders=None):
-        """fit(DataSet) using TrainingConfig mappings, or
-        fit(placeholders=dict) feeding labels directly."""
+    def fit(self, dataset=None, labels=None, placeholders=None):
+        """fit(DataSet) using TrainingConfig mappings, fit(features,
+        labels) arrays through the same mappings, or
+        fit(placeholders=dict) feeding everything directly."""
         self._ensure_optimizer()
         tc = self._training_config
+        if labels is not None:
+            from deeplearning4j_tpu.datasets.dataset import DataSet
+            dataset = DataSet(dataset, labels)
         if placeholders is None:
             from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
             if isinstance(dataset, DataSet):

@@ -1,0 +1,239 @@
+"""TF frozen-graph → SameDiff import (≡ nd4j-api ::
+imports.graphmapper.tf.TFGraphMapper / SameDiff.importFrozenTF — the
+path the reference's BERT examples use).
+
+Maps a GraphDef (parsed by the dependency-free tfproto codec) onto the
+SameDiff graph: Const → constants, Placeholder → placeholders, compute
+ops → jnp-backed ARRAY nodes, so the imported model compiles to ONE XLA
+executable exactly like natively-built graphs. The op set covers the
+frozen-BERT surface: MatMul/BatchMatMul, BiasAdd, layernorm fragments
+(Mean, SquaredDifference, Rsqrt), erf-based GELU, Softmax, embedding
+GatherV2, shape ops, and elementwise arithmetic.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, VariableType
+from deeplearning4j_tpu.autodiff import tfproto
+
+
+def _clean_ref(ref):
+    """strip ':0' output index; None for '^control' deps."""
+    if ref.startswith("^"):
+        return None
+    return ref.split(":")[0]
+
+
+class UnsupportedTFOpError(ValueError):
+    pass
+
+
+def _axis_from(const_inputs, idx, default=None):
+    v = const_inputs[idx]
+    if v is None:
+        return default
+    a = np.asarray(v).reshape(-1)
+    return int(a[0]) if a.size == 1 else tuple(int(x) for x in a)
+
+
+# each entry: fn(attrs) -> jnp function over input arrays
+_ELEMENTWISE = {
+    "Add": jnp.add, "AddV2": jnp.add, "BiasAdd": lambda x, b: x + b,
+    "Sub": jnp.subtract, "Mul": jnp.multiply, "RealDiv": jnp.divide,
+    "Div": jnp.divide, "Maximum": jnp.maximum, "Minimum": jnp.minimum,
+    "Pow": jnp.power, "SquaredDifference": lambda a, b: (a - b) ** 2,
+    "Relu": jax.nn.relu, "Relu6": lambda x: jnp.clip(x, 0, 6),
+    "Elu": jax.nn.elu, "Selu": jax.nn.selu, "Tanh": jnp.tanh,
+    "Sigmoid": jax.nn.sigmoid, "Erf": jax.lax.erf, "Exp": jnp.exp,
+    "Log": jnp.log, "Sqrt": jnp.sqrt, "Rsqrt": jax.lax.rsqrt,
+    "Square": jnp.square, "Abs": jnp.abs, "Neg": jnp.negative,
+    "Identity": lambda x: x, "StopGradient": jax.lax.stop_gradient,
+    "Floor": jnp.floor, "Sign": jnp.sign,
+}
+
+
+class TFGraphMapper:
+    @staticmethod
+    def importGraph(path_or_bytes, sd=None):
+        data = path_or_bytes
+        if not isinstance(data, (bytes, bytearray)):
+            with open(data, "rb") as f:
+                data = f.read()
+        nodes = tfproto.parse_graphdef(bytes(data))
+        sd = sd or SameDiff.create()
+        consts = {}     # name -> np value (for shape/axis arguments)
+
+        for node in nodes:
+            TFGraphMapper._map_node(sd, node, consts)
+        return sd
+
+    @staticmethod
+    def _map_node(sd, node, consts):
+        op, name = node.op, node.name
+        in_refs = [r for r in (_clean_ref(i) for i in node.inputs)
+                   if r is not None]
+
+        def const_val(i):
+            return consts.get(in_refs[i])
+
+        if op == "Const":
+            value = node.attrs.get("value")
+            consts[name] = np.asarray(value)
+            sd.constant(name, np.asarray(value))
+            return
+        if op in ("Identity", "StopGradient") and in_refs \
+                and in_refs[0] in consts:
+            # frozen graphs routinely wrap constants in Identity; keep the
+            # alias visible so axis/shape arguments still resolve
+            consts[name] = consts[in_refs[0]]
+        if op == "Placeholder":
+            shape = node.attrs.get("shape")
+            dims = shape[1] if isinstance(shape, tuple) else []
+            sd.placeHolder(name, *[d if d > 0 else None for d in dims])
+            return
+
+        ins = [sd.getVariable(r) for r in in_refs]
+
+        if op in _ELEMENTWISE:
+            fn = _ELEMENTWISE[op]
+            sd._op_named(name, op.lower(), fn, *ins)
+        elif op == "MatMul":
+            ta = bool(node.attrs.get("transpose_a", False))
+            tb = bool(node.attrs.get("transpose_b", False))
+
+            def mm(a, b, ta=ta, tb=tb):
+                a = a.T if ta else a
+                b = b.T if tb else b
+                return a @ b
+            sd._op_named(name, "matmul", mm, *ins)
+        elif op in ("BatchMatMul", "BatchMatMulV2"):
+            ta = bool(node.attrs.get("adj_x", False))
+            tb = bool(node.attrs.get("adj_y", False))
+
+            def bmm(a, b, ta=ta, tb=tb):
+                a = jnp.swapaxes(a, -1, -2) if ta else a
+                b = jnp.swapaxes(b, -1, -2) if tb else b
+                return a @ b
+            sd._op_named(name, "batch_matmul", bmm, *ins)
+        elif op == "Softmax":
+            sd._op_named(name, "softmax",
+                         lambda x: jax.nn.softmax(x, axis=-1), *ins)
+        elif op in ("Mean", "Sum", "Max", "Min"):
+            red = {"Mean": jnp.mean, "Sum": jnp.sum, "Max": jnp.max,
+                   "Min": jnp.min}[op]
+            if const_val(1) is None:
+                raise UnsupportedTFOpError(
+                    f"{name}: dynamic {op} axes unsupported (axis input "
+                    "must trace to a Const)")
+            axis = _axis_from([const_val(1)], 0)
+            keep = bool(node.attrs.get("keep_dims", False))
+            sd._op_named(name, op.lower(),
+                         lambda x, _a, red=red, axis=axis, keep=keep:
+                         red(x, axis=axis, keepdims=keep), *ins)
+        elif op == "Reshape":
+            shp = const_val(1)
+            if shp is None:
+                raise UnsupportedTFOpError(
+                    f"{name}: dynamic Reshape target shape unsupported")
+            shp = tuple(int(s) for s in np.asarray(shp).reshape(-1))
+            sd._op_named(name, "reshape",
+                         lambda x, _s, shp=shp: jnp.reshape(x, shp), *ins)
+        elif op == "Transpose":
+            if const_val(1) is None:
+                raise UnsupportedTFOpError(
+                    f"{name}: dynamic Transpose perm unsupported")
+            perm = tuple(int(p)
+                         for p in np.asarray(const_val(1)).reshape(-1))
+            sd._op_named(name, "transpose",
+                         lambda x, _p, perm=perm: jnp.transpose(x, perm),
+                         *ins)
+        elif op == "ExpandDims":
+            axis = _axis_from([const_val(1)], 0, 0)
+            sd._op_named(name, "expand_dims",
+                         lambda x, _a, axis=axis: jnp.expand_dims(x, axis),
+                         *ins)
+        elif op == "Squeeze":
+            dims = node.attrs.get("squeeze_dims") or None
+            sd._op_named(name, "squeeze",
+                         lambda x, dims=dims: jnp.squeeze(
+                             x, None if not dims else tuple(dims)), *ins)
+        elif op in ("ConcatV2", "Concat"):
+            axis = _axis_from([const_val(len(ins) - 1)], 0, 0)
+            sd._op_named(name, "concat",
+                         lambda *xs, axis=axis: jnp.concatenate(
+                             xs[:-1], axis=axis), *ins)
+        elif op in ("GatherV2", "Gather"):
+            axis = 0
+            if op == "GatherV2" and len(ins) > 2:
+                axis = _axis_from([const_val(2)], 0, 0)
+            sd._op_named(name, "gather",
+                         lambda p, i, *rest, axis=axis: jnp.take(
+                             p, i.astype(jnp.int32), axis=axis), *ins)
+        elif op == "Cast":
+            dst = node.attrs.get("DstT")
+            np_dt = tfproto._DTYPES.get(
+                dst[1] if isinstance(dst, tuple) else dst, np.float32)
+            sd._op_named(name, "cast",
+                         lambda x, np_dt=np_dt: x.astype(np_dt), *ins)
+        elif op == "Pack":
+            axis = int(node.attrs.get("axis", 0) or 0)
+            sd._op_named(name, "stack",
+                         lambda *xs, axis=axis: jnp.stack(xs, axis=axis),
+                         *ins)
+        elif op == "Shape":
+            sd._op_named(name, "shape",
+                         lambda x: jnp.asarray(x.shape, jnp.int32), *ins)
+        elif op == "Rsqrt":
+            sd._op_named(name, "rsqrt", jax.lax.rsqrt, *ins)
+        elif op == "Tile":
+            reps = const_val(1)
+            reps = tuple(int(r) for r in np.asarray(reps).reshape(-1))
+            sd._op_named(name, "tile",
+                         lambda x, _r, reps=reps: jnp.tile(x, reps), *ins)
+        elif op == "StridedSlice":
+            b = const_val(1)
+            e = const_val(2)
+            s = const_val(3)
+            if b is None or e is None or s is None:
+                raise UnsupportedTFOpError(
+                    f"{name}: dynamic StridedSlice unsupported")
+            begin_mask = int(node.attrs.get("begin_mask", 0) or 0)
+            end_mask = int(node.attrs.get("end_mask", 0) or 0)
+            shrink = int(node.attrs.get("shrink_axis_mask", 0) or 0)
+            if node.attrs.get("ellipsis_mask") or \
+                    node.attrs.get("new_axis_mask"):
+                raise UnsupportedTFOpError(
+                    f"{name}: StridedSlice ellipsis/new_axis masks "
+                    "unsupported")
+            sl = []
+            for d, (bi, ei, si) in enumerate(zip(
+                    np.asarray(b).reshape(-1), np.asarray(e).reshape(-1),
+                    np.asarray(s).reshape(-1))):
+                if shrink & (1 << d):
+                    sl.append(int(bi))          # rank-reducing index
+                    continue
+                lo = None if begin_mask & (1 << d) else int(bi)
+                hi = None if end_mask & (1 << d) else int(ei)
+                sl.append(slice(lo, hi, int(si)))
+            sl = tuple(sl)
+            sd._op_named(name, "strided_slice",
+                         lambda x, *_r, sl=sl: x[sl], *ins)
+        elif op == "OneHot":
+            depth = int(np.asarray(const_val(1)).reshape(()))
+            sd._op_named(name, "one_hot",
+                         lambda i, *_r, depth=depth: jax.nn.one_hot(
+                             i.astype(jnp.int32), depth), *ins)
+        else:
+            raise UnsupportedTFOpError(
+                f"TF op '{op}' (node '{name}') is not in the import op set")
+
+
+def importFrozenTF(path_or_bytes):
+    """≡ SameDiff.importFrozenTF(File)."""
+    return TFGraphMapper.importGraph(path_or_bytes)
+
+
+SameDiff.importFrozenTF = staticmethod(importFrozenTF)

@@ -1,3 +1,6 @@
+from deeplearning4j_tpu.datavec.image_records import (
+    FlipImageTransform, ImageRecordDataSetIterator, ImageRecordReader,
+    ParentPathLabelGenerator, PipelineImageTransform, ResizeImageTransform)
 from deeplearning4j_tpu.datavec.records import (CollectionRecordReader,
                                                 CSVRecordReader,
                                                 LineRecordReader,
@@ -7,4 +10,6 @@ from deeplearning4j_tpu.datavec.records import (CollectionRecordReader,
 
 __all__ = ["CollectionRecordReader", "CSVRecordReader", "LineRecordReader",
            "RecordReader", "RecordReaderDataSetIterator", "Schema",
-           "TransformProcess"]
+           "TransformProcess", "FlipImageTransform", "ImageRecordDataSetIterator",
+           "ImageRecordReader", "ParentPathLabelGenerator",
+           "PipelineImageTransform", "ResizeImageTransform"]

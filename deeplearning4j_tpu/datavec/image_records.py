@@ -1,0 +1,185 @@
+"""Image record reading (≡ datavec-data-image ::
+org.datavec.image.recordreader.ImageRecordReader +
+loader.NativeImageLoader + transform.ImageTransform family +
+api.io.labels.ParentPathLabelGenerator).
+
+PIL decodes (present in this environment — the reference used JavaCV);
+output is NHWC float32 batches, the layout every conv in this framework
+consumes directly (no NCHW permute step like the reference's loader).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+class ParentPathLabelGenerator:
+    """≡ ParentPathLabelGenerator — label = parent directory name."""
+
+    def getLabelForPath(self, path):
+        return os.path.basename(os.path.dirname(os.path.abspath(path)))
+
+
+class ImageTransform:
+    def transform(self, img_array, rng):
+        raise NotImplementedError
+
+
+class ResizeImageTransform(ImageTransform):
+    def __init__(self, newHeight, newWidth):
+        self.h, self.w = int(newHeight), int(newWidth)
+
+    def transform(self, img, rng):
+        from PIL import Image
+        pil = Image.fromarray(img.astype(np.uint8))
+        return np.asarray(pil.resize((self.w, self.h)), np.float32)
+
+
+class FlipImageTransform(ImageTransform):
+    """Random horizontal flip (p=0.5)."""
+
+    def transform(self, img, rng):
+        return img[:, ::-1] if rng.random() < 0.5 else img
+
+
+class CropImageTransform(ImageTransform):
+    """Random crop by up to `crop` pixels per edge, then pad back."""
+
+    def __init__(self, crop):
+        self.crop = int(crop)
+
+    def transform(self, img, rng):
+        c = self.crop
+        if c <= 0:
+            return img
+        top = rng.integers(0, c + 1)
+        left = rng.integers(0, c + 1)
+        h, w = img.shape[:2]
+        out = img[top:h - (c - top) or h, left:w - (c - left) or w]
+        pad = [(top, c - top), (left, c - left)] + \
+            [(0, 0)] * (img.ndim - 2)
+        return np.pad(out, pad, mode="edge")
+
+
+class PipelineImageTransform(ImageTransform):
+    def __init__(self, *transforms):
+        self.transforms = list(transforms)
+
+    def transform(self, img, rng):
+        for t in self.transforms:
+            img = t.transform(img, rng)
+        return img
+
+
+_IMG_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".gif", ".ppm"}
+
+
+class ImageRecordReader:
+    """≡ ImageRecordReader(height, width, channels, labelGenerator).
+
+    initialize() walks a directory tree; next() yields
+    [image (H,W,C) float32 0-255, label index].
+    """
+
+    def __init__(self, height, width, channels=3, labelGenerator=None,
+                 imageTransform=None, seed=0):
+        self.height, self.width = int(height), int(width)
+        self.channels = int(channels)
+        self.labelGenerator = labelGenerator or ParentPathLabelGenerator()
+        self.imageTransform = imageTransform
+        self._rng = np.random.default_rng(seed)
+        self._paths = []
+        self._labels = []
+        self._label_names = []
+        self._idx = 0
+
+    def initialize(self, path_or_split, shuffle=False):
+        root = getattr(path_or_split, "rootDir", path_or_split)
+        paths = []
+        for dirpath, _, files in sorted(os.walk(str(root))):
+            for fn in sorted(files):
+                if os.path.splitext(fn)[1].lower() in _IMG_EXTS:
+                    paths.append(os.path.join(dirpath, fn))
+        if not paths:
+            raise FileNotFoundError(f"no images under {root}")
+        names = sorted({self.labelGenerator.getLabelForPath(p)
+                        for p in paths})
+        self._label_names = names
+        lookup = {n: i for i, n in enumerate(names)}
+        if shuffle:
+            self._rng.shuffle(paths)
+        self._paths = paths
+        self._labels = [lookup[self.labelGenerator.getLabelForPath(p)]
+                        for p in paths]
+        self._idx = 0
+        return self
+
+    def getLabels(self):
+        return list(self._label_names)
+
+    def numExamples(self):
+        return len(self._paths)
+
+    def _load(self, path):
+        from PIL import Image
+        img = Image.open(path)
+        img = img.convert("RGB" if self.channels == 3 else "L")
+        img = img.resize((self.width, self.height))
+        arr = np.asarray(img, np.float32)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self.imageTransform is not None:
+            arr = self.imageTransform.transform(arr, self._rng)
+            if arr.shape[:2] != (self.height, self.width):
+                arr = ResizeImageTransform(
+                    self.height, self.width).transform(arr, self._rng)
+        return arr
+
+    def hasNext(self):
+        return self._idx < len(self._paths)
+
+    def next(self):
+        img = self._load(self._paths[self._idx])
+        label = self._labels[self._idx]
+        self._idx += 1
+        return [img, label]
+
+    def reset(self):
+        self._idx = 0
+
+    def __iter__(self):
+        self.reset()
+        while self.hasNext():
+            yield self.next()
+
+
+class ImageRecordDataSetIterator:
+    """Bridge to DataSetIterator (≡ RecordReaderDataSetIterator over an
+    ImageRecordReader): batches NHWC images + one-hot labels."""
+
+    def __init__(self, reader, batch_size, num_classes=None,
+                 preprocessor=None):
+        self.reader = reader
+        self.batch_size = int(batch_size)
+        self.num_classes = num_classes or len(reader.getLabels())
+        self.preprocessor = preprocessor
+
+    def __iter__(self):
+        self.reader.reset()
+        while self.reader.hasNext():
+            imgs, labels = [], []
+            while self.reader.hasNext() and len(imgs) < self.batch_size:
+                img, lab = self.reader.next()
+                imgs.append(img)
+                labels.append(lab)
+            from deeplearning4j_tpu.datasets.dataset import DataSet
+            x = np.stack(imgs)
+            y = np.eye(self.num_classes, dtype=np.float32)[labels]
+            ds = DataSet(x, y)
+            if self.preprocessor is not None:
+                self.preprocessor.preProcess(ds)
+            yield ds
+
+    def reset(self):
+        self.reader.reset()

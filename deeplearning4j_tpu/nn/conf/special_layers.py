@@ -227,9 +227,12 @@ class CenterLossOutputLayer(BaseOutputLayer, DenseLayer):
     0.5·λ·||f−c_y||² pulling features toward per-class centers (the
     FaceNetNN4Small2 training head).
 
-    Centers are parameters: the gradient of the center term w.r.t. c_y is
-    λ·(c_y − f̄), so the network's own updater performs the reference's
-    α-rate center pull inside the one jitted train step (α ≈ lr·λ)."""
+    Centers are parameters updated by the network's own optimizer. The
+    loss splits into two stop-gradient halves so λ and α act
+    independently, as in the reference: λ scales the pull of FEATURES
+    toward (frozen) centers, α scales the pull of CENTERS toward the
+    (frozen) batch feature means — per optimizer step the center movement
+    is lr·α·(c_y − f̄)."""
 
     needs_features = True
 
@@ -251,7 +254,11 @@ class CenterLossOutputLayer(BaseOutputLayer, DenseLayer):
         from deeplearning4j_tpu.nn.losses import get_loss
         base = get_loss(self.lossFunction)(labels, preact, self.activation,
                                            mask)
-        cy = labels @ params["centers"].astype(features.dtype)  # (B, nIn)
-        center_term = 0.5 * self.lambda_ * jnp.mean(
-            ((features - cy) ** 2).sum(-1))
-        return base + center_term
+        centers = params["centers"].astype(features.dtype)
+        cy = labels @ centers                                  # (B, nIn)
+        sg = jax.lax.stop_gradient
+        feat_pull = 0.5 * self.lambda_ * jnp.mean(
+            ((features - sg(cy)) ** 2).sum(-1))
+        center_pull = 0.5 * self.alpha * jnp.mean(
+            ((sg(features) - cy) ** 2).sum(-1))
+        return base + feat_pull + center_pull

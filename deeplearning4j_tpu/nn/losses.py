@@ -184,8 +184,6 @@ class _WeightedLoss:
     Instances pass straight through get_loss (callables are accepted) and
     survive config JSON via __dict__ round-trip."""
 
-    base = None  # overridden
-
     def __init__(self, weights=None, labelSmoothing=0.0):
         self.weights = None if weights is None else [float(w)
                                                      for w in weights]
@@ -198,19 +196,22 @@ class _WeightedLoss:
         k = labels.shape[-1]
         return labels * (1.0 - s) + s / k
 
-    def __call__(self, labels, preact, activation=None, mask=None):
-        labels = self._smooth(labels)
-        if self.weights is not None:
-            w = jnp.asarray(self.weights, labels.dtype)
-            labels = labels * w
-        fn = LOSSES[self.base]
-        return fn(labels, preact,
-                  **({"activation": activation} if activation else {}),
-                  mask=mask)
+    def _w(self, dtype):
+        return None if self.weights is None else jnp.asarray(self.weights,
+                                                             dtype)
 
 
 class LossMCXENT(_WeightedLoss):
-    base = "mcxent"
+    """Weights scale the labels — valid here because CE is linear in the
+    label vector, so label-scaling == per-element loss scaling."""
+
+    def __call__(self, labels, preact, activation=None, mask=None):
+        labels = self._smooth(labels)
+        w = self._w(labels.dtype)
+        if w is not None:
+            labels = labels * w
+        return mcxent(labels, preact, activation=activation or "softmax",
+                      mask=mask)
 
 
 class LossNegativeLogLikelihood(LossMCXENT):
@@ -218,22 +219,36 @@ class LossNegativeLogLikelihood(LossMCXENT):
 
 
 class LossBinaryXENT(_WeightedLoss):
-    base = "xent"
-
     def _smooth(self, labels):
         s = self.labelSmoothing
         # binary smoothing: y*(1-s) + 0.5*s (reference LossBinaryXENT)
         return labels if not s else labels * (1.0 - s) + 0.5 * s
 
+    def __call__(self, labels, preact, activation=None, mask=None):
+        labels = self._smooth(labels)
+        w = self._w(preact.dtype)
+        if w is None:
+            return xent(labels, preact,
+                        activation=activation or "sigmoid", mask=mask)
+        # weights must scale the PER-ELEMENT loss: BCE is not linear in
+        # the labels, label-scaling would make the loss unbounded below
+        labels2, preact2, mask2 = _flatten_time(labels, preact, mask)
+        if (activation or "sigmoid") == "sigmoid":
+            x, z = preact2, labels2
+            per = jnp.maximum(x, 0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        else:
+            p = jnp.clip(get_activation(activation)(preact2), 1e-7, 1 - 1e-7)
+            per = -(labels2 * jnp.log(p) + (1 - labels2) * jnp.log(1 - p))
+        return _apply_mask_mean(w * per, mask2)
+
 
 class LossMSE(_WeightedLoss):
-    base = "mse"
-
     def __call__(self, labels, preact, activation=None, mask=None):
+        labels = self._smooth(labels)
         if self.weights is None:
             return mse(labels, preact, activation=activation or "identity",
                        mask=mask)
-        w = jnp.asarray(self.weights, preact.dtype)
+        w = self._w(preact.dtype)
         out = get_activation(activation or "identity")(preact)
         labels2, out2, mask2 = _flatten_time(labels, out, mask)
         # same /nOut normalization as unweighted mse(): identity weights

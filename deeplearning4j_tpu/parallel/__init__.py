@@ -8,6 +8,9 @@ from deeplearning4j_tpu.parallel.ring_attention import (blockwise_attention,
                                                         ring_attention)
 from deeplearning4j_tpu.parallel.compression import (encoded_updater,
                                                      threshold_encoding)
+from deeplearning4j_tpu.parallel.elastic import (ElasticCheckpointer,
+                                                  ElasticTrainer,
+                                                  initialize_multihost)
 from deeplearning4j_tpu.parallel.pipeline import (make_pipeline_fn,
                                                   make_pipelined_loss,
                                                   stack_stage_params)
@@ -16,4 +19,5 @@ __all__ = ["DeviceMesh", "initialize_distributed", "ParallelWrapper",
            "ParameterAveragingTrainer", "ShardedTrainer",
            "blockwise_attention", "dense_attention", "make_ring_attention",
            "ring_attention", "encoded_updater", "threshold_encoding",
-           "make_pipeline_fn", "make_pipelined_loss", "stack_stage_params"]
+           "make_pipeline_fn", "make_pipelined_loss", "stack_stage_params",
+           "ElasticCheckpointer", "ElasticTrainer", "initialize_multihost"]

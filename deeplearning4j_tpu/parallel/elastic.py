@@ -1,0 +1,128 @@
+"""Elastic / fault-tolerant distributed training (SURVEY §2: orbax
+checkpoint + rejoin; ≡ the reference's SharedTrainingMaster fault
+tolerance, where a restarted worker rejoins and resumes from the last
+shared state).
+
+TPU-native inversion: instead of Aeron-replicated parameter state, the
+source of truth is an orbax sharded checkpoint in shared storage. Any
+host that dies restarts, calls `resume_or_init`, and receives the latest
+(step, params, opt_state) laid out for its mesh; training continues from
+the last completed save. Async checkpointing keeps the save off the
+training step's critical path.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+
+class ElasticCheckpointer:
+    """Orbax-backed save/resume for (step, params, opt_state) pytrees."""
+
+    def __init__(self, directory, max_to_keep=3, save_interval_steps=1):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self.directory = os.path.abspath(str(directory))
+        os.makedirs(self.directory, exist_ok=True)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps))
+
+    def save(self, step, params, opt_state=None, wait=False):
+        state = {"params": params}
+        if opt_state is not None:
+            state["opt_state"] = opt_state
+        self.manager.save(int(step),
+                          args=self._ocp.args.StandardSave(state))
+        if wait:
+            self.manager.wait_until_finished()
+        return self
+
+    def latest_step(self):
+        return self.manager.latest_step()
+
+    def restore(self, step=None, like=None):
+        """Restore (step, state). `like` — a pytree of arrays with the
+        target sharding/layout (orbax restores device-put to match)."""
+        step = self.manager.latest_step() if step is None else int(step)
+        if step is None:
+            return None, None
+        if like is not None:
+            args = self._ocp.args.StandardRestore(like)
+        else:
+            args = self._ocp.args.StandardRestore()
+        return step, self.manager.restore(step, args=args)
+
+    def close(self):
+        self.manager.wait_until_finished()
+        self.manager.close()
+
+
+class ElasticTrainer:
+    """Wrap a ShardedTrainer-style step with periodic checkpoints and
+    crash-resume (≡ fault-tolerant SharedTrainingMaster loop)."""
+
+    def __init__(self, trainer, directory, save_every=50, max_to_keep=3):
+        self.trainer = trainer
+        self.ckpt = ElasticCheckpointer(directory, max_to_keep=max_to_keep,
+                                        save_interval_steps=save_every)
+        self.save_every = int(save_every)
+        self.step_num = 0
+
+    def resume_or_init(self, init_params):
+        """Restore the latest checkpoint if one exists, else shard the
+        given fresh params. Returns (params, opt_state)."""
+        params, opt_state = self.trainer.init(init_params)
+        latest = self.ckpt.latest_step()
+        if latest is None:
+            return params, opt_state
+        like = {"params": params, "opt_state": opt_state}
+        step, state = self.ckpt.restore(like=like)
+        self.step_num = step
+        # orbax restores each leaf committed to its `like` placement; a
+        # fresh optimizer's scalars (e.g. Adam count) sit on one device,
+        # which would clash with mesh-committed params inside jit —
+        # re-place every restored leaf on a mesh-wide sharding
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def place(fresh, restored):
+            sh = fresh.sharding if isinstance(
+                getattr(fresh, "sharding", None), NamedSharding) \
+                else NamedSharding(self.trainer.mesh, PartitionSpec())
+            return jax.device_put(restored, sh)
+
+        state = jax.tree_util.tree_map(place, like, state)
+        return state["params"], state["opt_state"]
+
+    def fit_batch(self, params, opt_state, batch, rng):
+        params, opt_state, loss = self.trainer.fit_batch(
+            params, opt_state, batch, rng)
+        self.step_num += 1
+        if self.step_num % self.save_every == 0:
+            self.ckpt.save(self.step_num, params, opt_state)
+        return params, opt_state, loss
+
+    def finalize(self, params, opt_state):
+        self.ckpt.save(self.step_num, params, opt_state, wait=True)
+        self.ckpt.close()
+
+
+def initialize_multihost(coordinator_address=None, num_processes=None,
+                         process_id=None):
+    """≡ the reference's cluster join for the elastic path; reads the
+    JAX_COORDINATOR_ADDRESS env when no address is given and delegates to
+    parallel.mesh.initialize_distributed (single implementation)."""
+    from deeplearning4j_tpu.parallel.mesh import initialize_distributed
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        return False
+    return initialize_distributed(
+        coordinator_address,
+        num_processes or int(os.environ.get("JAX_NUM_PROCESSES", "1")),
+        process_id if process_id is not None
+        else int(os.environ.get("JAX_PROCESS_ID", "0")))
