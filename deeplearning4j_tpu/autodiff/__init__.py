@@ -1,8 +1,11 @@
 from deeplearning4j_tpu.autodiff import tf_import  # registers importFrozenTF
 from deeplearning4j_tpu.autodiff.tf_import import TFGraphMapper, importFrozenTF
+from deeplearning4j_tpu.autodiff.onnx_import import (OnnxGraphMapper,
+                                                     importOnnx)
 from deeplearning4j_tpu.autodiff.samediff import (SameDiff, SDVariable,
                                                   TrainingConfig,
                                                   VariableType)
 
 __all__ = ["SameDiff", "SDVariable", "TrainingConfig", "VariableType",
-           "TFGraphMapper", "importFrozenTF"]
+           "TFGraphMapper", "importFrozenTF", "OnnxGraphMapper",
+           "importOnnx"]

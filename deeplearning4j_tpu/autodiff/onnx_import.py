@@ -1,0 +1,350 @@
+"""ONNX model import → SameDiff (≡ the reference's planned
+nd4j onnx-import module; same role as tf_import for the ONNX ecosystem).
+
+Reuses the dependency-free protobuf wire codec from tfproto — ONNX
+ModelProto/GraphProto/NodeProto/TensorProto are just different field
+numbers over the same wire format (onnx/onnx.proto). Initializers become
+SameDiff constants, graph inputs placeholders, nodes jnp-backed ops; the
+imported model compiles to one XLA executable and can be fine-tuned
+after convertConstantsToVariables.
+
+Conv/pooling note: ONNX is NCHW; ops run natively NCHW via
+lax.conv_general_dilated dimension numbers (XLA lays out for the MXU
+either way) — no transpose insertion.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.autodiff.tfproto import (_read_varint, _signed,
+                                                 parse_fields)
+
+# ONNX TensorProto.DataType
+_ONNX_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 5: np.int16,
+                6: np.int32, 7: np.int64, 9: np.bool_, 10: np.float16,
+                11: np.float64}
+
+
+class UnsupportedOnnxOpError(ValueError):
+    pass
+
+
+def _packed_int64s(vals):
+    """repeated int64, packed (proto3 default: one length-delimited blob)
+    or unpacked varints."""
+    out = []
+    for v in vals:
+        if isinstance(v, bytes):
+            pos = 0
+            while pos < len(v):
+                x, pos = _read_varint(v, pos)
+                out.append(_signed(x))
+        else:
+            out.append(_signed(v))
+    return out
+
+
+def parse_onnx_tensor(buf):
+    f = parse_fields(buf)
+    dims = _packed_int64s(f.get(1, []))
+    dtype = _ONNX_DTYPES.get(f.get(2, [1])[0], np.float32)
+    if 9 in f and f[9][0]:                       # raw_data
+        arr = np.frombuffer(f[9][0], dtype=dtype)
+    elif 4 in f:                                 # float_data (packed f32)
+        raw = b"".join(v for v in f[4] if isinstance(v, bytes))
+        arr = np.frombuffer(raw, "<f4").astype(dtype) if raw else \
+            np.asarray([], dtype)
+    elif 7 in f:                                 # int64_data
+        arr = np.asarray(_packed_int64s(f[7]), dtype)
+    else:
+        arr = np.zeros(dims or (), dtype)
+    name = f.get(8, [b""])[0].decode()
+    return name, (arr.reshape(dims) if dims else arr.reshape(()))
+
+
+def _parse_attr(buf):
+    f = parse_fields(buf)
+    name = f.get(1, [b""])[0].decode()
+    if 2 in f:
+        import struct
+        return name, struct.unpack("<f", f[2][0])[0]
+    if 3 in f:
+        return name, _signed(f[3][0])
+    if 4 in f:
+        return name, f[4][0].decode("utf-8", "replace")
+    if 5 in f:
+        return name, parse_onnx_tensor(f[5][0])[1]
+    if 8 in f:                                   # ints
+        return name, _packed_int64s(f[8])
+    return name, None
+
+
+def _attr(node, name, default):
+    v = node.attrs.get(name)
+    return default if v is None else v
+
+
+class OnnxNode:
+    def __init__(self, name, op, inputs, outputs, attrs):
+        self.name, self.op = name, op
+        self.inputs, self.outputs = inputs, outputs
+        self.attrs = attrs
+
+
+def parse_onnx_model(data):
+    """ModelProto bytes -> (nodes, initializers{name: arr},
+    input_infos{name: dims}, output_names)."""
+    model = parse_fields(data)
+    graph = parse_fields(model[7][0])            # ModelProto.graph = 7
+    inits = {}
+    for t in graph.get(5, []):                   # initializer = 5
+        name, arr = parse_onnx_tensor(t)
+        inits[name] = arr
+    nodes = []
+    for nb in graph.get(1, []):                  # node = 1
+        f = parse_fields(nb)
+        attrs = dict(_parse_attr(a) for a in f.get(5, []))
+        nodes.append(OnnxNode(
+            f.get(3, [b""])[0].decode(),
+            f.get(4, [b""])[0].decode(),
+            [i.decode() for i in f.get(1, [])],
+            [o.decode() for o in f.get(2, [])],
+            attrs))
+    inputs = {}
+    for vi in graph.get(11, []):                 # input = 11
+        f = parse_fields(vi)
+        nm = f.get(1, [b""])[0].decode()
+        dims = []
+        if 2 in f:
+            tt = parse_fields(f[2][0])
+            if 1 in tt:
+                shp = parse_fields(tt[1][0])
+                if 2 in shp:
+                    for d in parse_fields(shp[2][0]).get(1, []):
+                        df = parse_fields(d)
+                        dims.append(_signed(df[1][0]) if 1 in df else -1)
+        inputs[nm] = dims
+    outputs = [parse_fields(vi).get(1, [b""])[0].decode()
+               for vi in graph.get(12, [])]      # output = 12
+    return nodes, inits, inputs, outputs
+
+
+_ONNX_ELEMENTWISE = {
+    "Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,
+    "Div": jnp.divide, "Pow": jnp.power, "Sqrt": jnp.sqrt,
+    "Exp": jnp.exp, "Log": jnp.log, "Abs": jnp.abs, "Neg": jnp.negative,
+    "Relu": jax.nn.relu, "Sigmoid": jax.nn.sigmoid, "Tanh": jnp.tanh,
+    "Erf": jax.lax.erf, "Identity": lambda x: x,
+    "Reciprocal": lambda x: 1.0 / x, "Floor": jnp.floor,
+    "Ceil": jnp.ceil, "Sign": jnp.sign,
+}
+
+
+class OnnxGraphMapper:
+    @staticmethod
+    def importModel(path_or_bytes, sd=None):
+        data = path_or_bytes
+        if not isinstance(data, (bytes, bytearray)):
+            with open(data, "rb") as f:
+                data = f.read()
+        nodes, inits, inputs, outputs = parse_onnx_model(bytes(data))
+        sd = sd or SameDiff.create()
+        consts = {}
+        for name, arr in inits.items():
+            sd.constant(name, arr)
+            consts[name] = arr
+        for name, dims in inputs.items():
+            if name in inits:
+                continue
+            sd.placeHolder(name, *[d if d > 0 else None for d in dims])
+        for node in nodes:
+            OnnxGraphMapper._map_node(sd, node, consts)
+        sd._onnx_outputs = outputs
+        return sd
+
+    @staticmethod
+    def _map_node(sd, node, consts):
+        op = node.op
+        out = node.outputs[0]
+        ins = [sd.getVariable(r) for r in node.inputs if r]
+
+        def const_val(i):
+            return consts.get(node.inputs[i])
+
+        if op == "Constant":
+            val = node.attrs.get("value")
+            consts[out] = np.asarray(val)
+            sd.constant(out, np.asarray(val))
+            return
+        if op in _ONNX_ELEMENTWISE:
+            sd._op_named(out, op.lower(), _ONNX_ELEMENTWISE[op], *ins)
+        elif op == "MatMul":
+            sd._op_named(out, "matmul", jnp.matmul, *ins)
+        elif op == "Gemm":
+            alpha = float(_attr(node, "alpha", 1.0))
+            beta = float(_attr(node, "beta", 1.0))
+            ta = int(_attr(node, "transA", 0))
+            tb = int(_attr(node, "transB", 0))
+
+            def gemm(a, b, *c, alpha=alpha, beta=beta, ta=ta, tb=tb):
+                a = a.T if ta else a
+                b = b.T if tb else b
+                y = alpha * (a @ b)
+                return y + beta * c[0] if c else y
+            sd._op_named(out, "gemm", gemm, *ins)
+        elif op == "Softmax":
+            axis = int(_attr(node, "axis", -1))
+            sd._op_named(out, "softmax",
+                         lambda x, axis=axis: jax.nn.softmax(x, axis=axis),
+                         *ins)
+        elif op == "Reshape":
+            shp = const_val(1)
+            if shp is None:
+                raise UnsupportedOnnxOpError(
+                    f"{out}: dynamic Reshape unsupported")
+            shp = tuple(int(s) for s in np.asarray(shp).reshape(-1))
+            sd._op_named(out, "reshape",
+                         lambda x, _s, shp=shp: jnp.reshape(x, shp), *ins)
+        elif op == "Transpose":
+            perm = node.attrs.get("perm")
+            perm = None if perm is None else tuple(int(p) for p in perm)
+            sd._op_named(out, "transpose",
+                         lambda x, perm=perm: jnp.transpose(x, perm), *ins)
+        elif op == "Concat":
+            axis = int(_attr(node, "axis", 0))
+            sd._op_named(out, "concat",
+                         lambda *xs, axis=axis: jnp.concatenate(xs, axis),
+                         *ins)
+        elif op == "Gather":
+            axis = int(_attr(node, "axis", 0))
+            sd._op_named(out, "gather",
+                         lambda p, i, axis=axis: jnp.take(
+                             p, i.astype(jnp.int32), axis=axis), *ins)
+        elif op == "Flatten":
+            axis = int(_attr(node, "axis", 1))
+            sd._op_named(out, "flatten",
+                         lambda x, axis=axis: x.reshape(
+                             (int(np.prod(x.shape[:axis])), -1)), *ins)
+        elif op in ("Squeeze", "Unsqueeze"):
+            axes = node.attrs.get("axes")
+            if axes is None and len(node.inputs) > 1:
+                av = const_val(1)
+                axes = None if av is None else np.asarray(
+                    av).reshape(-1).tolist()
+            axes = tuple(int(a) for a in (axes or []))
+            if op == "Squeeze":
+                sd._op_named(out, "squeeze",
+                             lambda x, *_r, axes=axes: jnp.squeeze(
+                                 x, axes or None), *ins)
+            else:
+                def unsq(x, *_r, axes=axes):
+                    for a in sorted(axes):
+                        x = jnp.expand_dims(x, a)
+                    return x
+                sd._op_named(out, "unsqueeze", unsq, *ins)
+        elif op == "ReduceMean":
+            axes = node.attrs.get("axes")
+            if axes is None and len(node.inputs) > 1:   # opset-18: input
+                av = const_val(1)
+                if av is None:
+                    raise UnsupportedOnnxOpError(
+                        f"{out}: dynamic ReduceMean axes unsupported")
+                axes = np.asarray(av).reshape(-1).tolist()
+            axes = tuple(int(a) for a in (axes or []))
+            keep = int(_attr(node, "keepdims", 1))
+            sd._op_named(out, "reduce_mean",
+                         lambda x, *_r, axes=axes, keep=keep: jnp.mean(
+                             x, axis=axes or None, keepdims=bool(keep)),
+                         *ins)
+        elif op == "Conv":
+            strides = tuple(node.attrs.get("strides") or (1, 1))
+            pads = node.attrs.get("pads") or [0, 0, 0, 0]
+            dil = tuple(node.attrs.get("dilations") or (1, 1))
+            groups = int(_attr(node, "group", 1))
+            pad_arg = [(int(pads[0]), int(pads[2])),
+                       (int(pads[1]), int(pads[3]))]
+
+            def conv(x, w, *b, strides=strides, pad_arg=pad_arg, dil=dil,
+                     groups=groups):
+                y = jax.lax.conv_general_dilated(
+                    x, w.astype(x.dtype), window_strides=strides,
+                    padding=pad_arg, rhs_dilation=dil,
+                    feature_group_count=groups,
+                    dimension_numbers=("NCHW", "OIHW", "NCHW"))
+                return y + b[0].reshape(1, -1, 1, 1) if b else y
+            sd._op_named(out, "conv", conv, *ins)
+        elif op in ("MaxPool", "AveragePool"):
+            ksize = tuple(node.attrs.get("kernel_shape") or (2, 2))
+            strides = tuple(node.attrs.get("strides") or ksize)
+            pads = node.attrs.get("pads") or [0, 0, 0, 0]
+            window = (1, 1) + ksize
+            strd = (1, 1) + strides
+            pad_arg = [(0, 0), (0, 0),
+                       (int(pads[0]), int(pads[2])),
+                       (int(pads[1]), int(pads[3]))]
+            if op == "MaxPool":
+                sd._op_named(out, "maxpool",
+                             lambda x, window=window, strd=strd,
+                             pad_arg=pad_arg: jax.lax.reduce_window(
+                                 x, -jnp.inf, jax.lax.max, window, strd,
+                                 pad_arg), *ins)
+            else:
+                def avg(x, window=window, strd=strd, pad_arg=pad_arg):
+                    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window,
+                                              strd, pad_arg)
+                    n = jax.lax.reduce_window(jnp.ones_like(x), 0.0,
+                                              jax.lax.add, window, strd,
+                                              pad_arg)
+                    return s / n
+                sd._op_named(out, "avgpool", avg, *ins)
+        elif op == "GlobalAveragePool":
+            sd._op_named(out, "gap",
+                         lambda x: jnp.mean(x, axis=(2, 3), keepdims=True),
+                         *ins)
+        elif op == "BatchNormalization":
+            eps = float(_attr(node, "epsilon", 1e-5))
+
+            def bn(x, gamma, beta, mean, var, eps=eps):
+                shape = (1, -1) + (1,) * (x.ndim - 2)
+                return ((x - mean.reshape(shape))
+                        * jax.lax.rsqrt(var.reshape(shape) + eps)
+                        * gamma.reshape(shape) + beta.reshape(shape))
+            sd._op_named(out, "batchnorm", bn, *ins)
+        elif op == "Cast":
+            to = int(_attr(node, "to", 1))
+            np_dt = _ONNX_DTYPES.get(to, np.float32)
+            sd._op_named(out, "cast",
+                         lambda x, np_dt=np_dt: x.astype(np_dt), *ins)
+        elif op == "Clip":
+            lo = _attr(node, "min", None)
+            hi = _attr(node, "max", None)
+            if lo is None and len(node.inputs) > 1 and node.inputs[1]:
+                cv = const_val(1)
+                if cv is None:
+                    raise UnsupportedOnnxOpError(
+                        f"{out}: dynamic Clip min unsupported")
+                lo = float(np.asarray(cv).reshape(()))
+            if hi is None and len(node.inputs) > 2 and node.inputs[2]:
+                cv = const_val(2)
+                if cv is None:
+                    raise UnsupportedOnnxOpError(
+                        f"{out}: dynamic Clip max unsupported")
+                hi = float(np.asarray(cv).reshape(()))
+            lo = -np.inf if lo is None else float(lo)
+            hi = np.inf if hi is None else float(hi)
+            sd._op_named(out, "clip",
+                         lambda x, *_r, lo=lo, hi=hi: jnp.clip(x, lo, hi),
+                         *ins)
+        else:
+            raise UnsupportedOnnxOpError(
+                f"ONNX op '{op}' (node '{out}') is not in the import set")
+
+
+def importOnnx(path_or_bytes):
+    return OnnxGraphMapper.importModel(path_or_bytes)
+
+
+SameDiff.importOnnx = staticmethod(importOnnx)

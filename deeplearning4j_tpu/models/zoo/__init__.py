@@ -6,10 +6,10 @@ from deeplearning4j_tpu.models.zoo.models import (AlexNet, LeNet, ResNet50,
 from deeplearning4j_tpu.models.zoo.models2 import (Darknet19,
                                                    FaceNetNN4Small2,
                                                    InceptionResNetV1,
-                                                   SqueezeNet, VGG19,
+                                                   NASNet, SqueezeNet, VGG19,
                                                    Xception, YOLO2)
 
 __all__ = ["AlexNet", "LeNet", "ResNet50", "SimpleCNN",
            "TextGenerationLSTM", "TinyYOLO", "UNet", "VGG16", "ZooModel",
            "Darknet19", "InceptionResNetV1", "SqueezeNet", "VGG19",
-           "Xception", "YOLO2", "FaceNetNN4Small2"]
+           "Xception", "YOLO2", "FaceNetNN4Small2", "NASNet"]

@@ -477,3 +477,100 @@ class FaceNetNN4Small2(ZooModel):
             activation="softmax"), "embeddings")
         g.setOutputs("out")
         return g.build()
+
+
+class NASNet(ZooModel):
+    """≡ zoo.model.NASNet (NASNet-A mobile shape) — stem + alternating
+    normal/reduction cells built from separable-conv branch combinations
+    concatenated per cell. Cell counts/penultimate filters configurable
+    (defaults follow the mobile variant scaled by `filters`)."""
+
+    DEFAULT_INPUT = (224, 224, 3)
+
+    def __init__(self, numBlocks=2, filters=44, stemFilters=32, **kw):
+        super().__init__(**kw)
+        self.numBlocks = int(numBlocks)
+        self.filters = int(filters)
+        self.stemFilters = int(stemFilters)
+
+    def conf(self):
+        h, w, c = self.inputShape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Adam(1e-3))
+             .weightInit("relu")
+             .l2(5e-5)
+             .dataType(self.dataType)
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        def conv_bn(name, inp, n_out, k, s=(1, 1), act="relu"):
+            g.addLayer(f"{name}_c", ConvolutionLayer(
+                kernelSize=k, stride=s, nOut=n_out, hasBias=False,
+                convolutionMode="same", activation="identity"), inp)
+            g.addLayer(f"{name}_bn", BatchNormalization(activation=act),
+                       f"{name}_c")
+            return f"{name}_bn"
+
+        def sep_bn(name, inp, n_out, k, s=(1, 1)):
+            g.addLayer(f"{name}_s", SeparableConvolution2D(
+                kernelSize=k, stride=s, nOut=n_out, hasBias=False,
+                convolutionMode="same", activation="identity"), inp)
+            g.addLayer(f"{name}_bn", BatchNormalization(activation="relu"),
+                       f"{name}_s")
+            return f"{name}_bn"
+
+        def normal_cell(name, inp, filters):
+            """NASNet-A normal cell (single-input simplification of the
+            two-hidden-state wiring): sep3/sep5/pool/identity branches
+            summed pairwise, outputs concatenated."""
+            p = conv_bn(f"{name}_sq", inp, filters, (1, 1))
+            b1a = sep_bn(f"{name}_b1a", p, filters, (5, 5))
+            b1b = sep_bn(f"{name}_b1b", p, filters, (3, 3))
+            g.addVertex(f"{name}_a1", ElementWiseVertex("add"), b1a, b1b)
+            g.addLayer(f"{name}_pool", SubsamplingLayer(
+                poolingType="avg", kernelSize=(3, 3), stride=(1, 1),
+                convolutionMode="same"), p)
+            g.addVertex(f"{name}_a2", ElementWiseVertex("add"),
+                        f"{name}_pool", p)
+            b3a = sep_bn(f"{name}_b3a", p, filters, (3, 3))
+            g.addVertex(f"{name}_a3", ElementWiseVertex("add"), b3a, p)
+            g.addVertex(f"{name}_cat", MergeVertex(),
+                        f"{name}_a1", f"{name}_a2", f"{name}_a3")
+            return f"{name}_cat"
+
+        def reduction_cell(name, inp, filters):
+            p = conv_bn(f"{name}_sq", inp, filters, (1, 1))
+            b1 = sep_bn(f"{name}_b1", p, filters, (5, 5), (2, 2))
+            b2 = sep_bn(f"{name}_b2", p, filters, (7, 7), (2, 2))
+            g.addVertex(f"{name}_a1", ElementWiseVertex("add"), b1, b2)
+            g.addLayer(f"{name}_mp", SubsamplingLayer(
+                poolingType="max", kernelSize=(3, 3), stride=(2, 2),
+                convolutionMode="same"), p)
+            b3 = sep_bn(f"{name}_b3", p, filters, (3, 3), (2, 2))
+            g.addVertex(f"{name}_a2", ElementWiseVertex("add"),
+                        f"{name}_mp", b3)
+            g.addVertex(f"{name}_cat", MergeVertex(),
+                        f"{name}_a1", f"{name}_a2")
+            return f"{name}_cat"
+
+        x = conv_bn("stem", "input", self.stemFilters, (3, 3), (2, 2))
+        f = self.filters
+        for i in range(self.numBlocks):
+            x = normal_cell(f"n1_{i}", x, f)
+        x = reduction_cell("r1", x, f * 2)
+        for i in range(self.numBlocks):
+            x = normal_cell(f"n2_{i}", x, f * 2)
+        x = reduction_cell("r2", x, f * 4)
+        for i in range(self.numBlocks):
+            x = normal_cell(f"n3_{i}", x, f * 4)
+        g.addLayer("relu_out", ActivationLayer(activation="relu"), x)
+        g.addLayer("gap", GlobalPoolingLayer(poolingType="avg"),
+                   "relu_out")
+        g.addLayer("drop", DropoutLayer(dropOut=0.5), "gap")
+        g.addLayer("out", OutputLayer(lossFunction="mcxent",
+                                      nOut=self.numClasses,
+                                      activation="softmax"), "drop")
+        g.setOutputs("out")
+        return g.build()

@@ -1,5 +1,8 @@
 from deeplearning4j_tpu.ops.ndarray import NDArray, as_jax, resolve_dtype
 from deeplearning4j_tpu.ops.factory import nd
+from deeplearning4j_tpu.ops.ops import (BooleanIndexing, Conditions,
+                                        Transforms)
 from deeplearning4j_tpu.ops.random import RandomState
 
-__all__ = ["NDArray", "nd", "RandomState", "as_jax", "resolve_dtype"]
+__all__ = ["NDArray", "nd", "RandomState", "as_jax", "resolve_dtype",
+           "BooleanIndexing", "Conditions", "Transforms"]

@@ -1,0 +1,203 @@
+"""Transforms op catalog (≡ nd4j-api ::
+org.nd4j.linalg.ops.transforms.Transforms + the static op surface of
+org.nd4j.linalg.factory.Nd4j: exec'd custom ops like softmax, boolean
+indexing/conditions, comparisons).
+
+Every function accepts NDArray/numpy/jax inputs and returns NDArray;
+all lower to jax.numpy so calls inside a jit trace fuse into the
+surrounding executable (the reference dispatches each as a separate
+libnd4j op launch)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.factory import nd
+from deeplearning4j_tpu.ops.ndarray import NDArray, as_jax
+
+
+def _u(fn):
+    def wrapped(x, *args, **kw):
+        return NDArray(fn(as_jax(x), *args, **kw))
+    return wrapped
+
+
+def _b(fn):
+    def wrapped(a, b, *args, **kw):
+        return NDArray(fn(as_jax(a), as_jax(b), *args, **kw))
+    return wrapped
+
+
+class Transforms:
+    """≡ ops.transforms.Transforms static methods."""
+
+    exp = staticmethod(_u(jnp.exp))
+    log = staticmethod(_u(jnp.log))
+    log1p = staticmethod(_u(jnp.log1p))
+    sqrt = staticmethod(_u(jnp.sqrt))
+    abs = staticmethod(_u(jnp.abs))
+    sign = staticmethod(_u(jnp.sign))
+    floor = staticmethod(_u(jnp.floor))
+    ceil = staticmethod(_u(jnp.ceil))
+    round = staticmethod(_u(jnp.round))
+    sin = staticmethod(nd.sin)
+    cos = staticmethod(nd.cos)
+    tan = staticmethod(nd.tan)
+    asin = staticmethod(_u(jnp.arcsin))
+    acos = staticmethod(_u(jnp.arccos))
+    atan = staticmethod(_u(jnp.arctan))
+    sinh = staticmethod(_u(jnp.sinh))
+    cosh = staticmethod(_u(jnp.cosh))
+    tanh = staticmethod(nd.tanh)
+    atanh = staticmethod(_u(jnp.arctanh))
+    sigmoid = staticmethod(nd.sigmoid)
+    sigmoidDerivative = staticmethod(_u(
+        lambda x: jax.nn.sigmoid(x) * (1 - jax.nn.sigmoid(x))))
+    hardSigmoid = staticmethod(_u(jax.nn.hard_sigmoid))
+    hardTanh = staticmethod(_u(lambda x: jnp.clip(x, -1.0, 1.0)))
+    relu = staticmethod(nd.relu)
+    relu6 = staticmethod(_u(jax.nn.relu6))
+    leakyRelu = staticmethod(nd.leakyRelu)
+    elu = staticmethod(nd.elu)
+    softPlus = staticmethod(nd.softplus)
+    softsign = staticmethod(_u(jax.nn.soft_sign))
+    gelu = staticmethod(_u(jax.nn.gelu))
+    swish = staticmethod(_u(jax.nn.swish))
+    mish = staticmethod(_u(lambda x: x * jnp.tanh(jax.nn.softplus(x))))
+    erf = staticmethod(_u(jax.lax.erf))
+    rsqrt = staticmethod(_u(jax.lax.rsqrt))
+    reciprocal = staticmethod(_u(lambda x: 1.0 / x))
+    square = staticmethod(_u(jnp.square))
+    neg = staticmethod(_u(jnp.negative))
+
+    softmax = staticmethod(nd.softmax)
+    logSoftmax = staticmethod(nd.logSoftmax)
+    pow = staticmethod(nd.pow)
+    max = staticmethod(nd.maximum)
+    min = staticmethod(nd.minimum)
+    clip = staticmethod(nd.clip)
+
+    @staticmethod
+    def unitVec(x):
+        a = as_jax(x)
+        return NDArray(a / jnp.maximum(jnp.linalg.norm(a), 1e-12))
+
+    @staticmethod
+    def normalizeZeroMeanAndUnitVariance(x):
+        a = as_jax(x)
+        return NDArray((a - a.mean()) / jnp.maximum(a.std(), 1e-12))
+
+    cosineSim = staticmethod(nd.cosineSim)
+    euclideanDistance = staticmethod(nd.euclideanDistance)
+    manhattanDistance = staticmethod(nd.manhattanDistance)
+
+    @staticmethod
+    def hammingDistance(a, b):
+        return float((as_jax(a).ravel() != as_jax(b).ravel()).sum())
+
+    @staticmethod
+    def allEuclideanDistances(a, b, dim=1):
+        """Pairwise vector distances (≡ Transforms.allEuclideanDistances):
+        dim is the FEATURE axis of the 2-D inputs (nd4j semantics) —
+        dim=1 compares rows, dim=0 compares columns."""
+        a, b = as_jax(a), as_jax(b)
+        if dim == 0:
+            a, b = a.T, b.T
+        d = (jnp.sum(a * a, 1, keepdims=True)
+             + jnp.sum(b * b, 1, keepdims=True).T
+             - 2 * a @ b.T)
+        return NDArray(jnp.sqrt(jnp.maximum(d, 0.0)))
+
+    @staticmethod
+    def dot(a, b):
+        return NDArray(as_jax(a) @ as_jax(b))
+
+    @staticmethod
+    def cross(a, b):
+        return NDArray(jnp.cross(as_jax(a), as_jax(b)))
+
+    # comparisons (≡ Transforms.eps/greaterThanOrEqual/...)
+    eq = staticmethod(_b(lambda a, b: (a == b)))
+    neq = staticmethod(_b(lambda a, b: (a != b)))
+    greaterThan = staticmethod(_b(lambda a, b: (a > b)))
+    lessThan = staticmethod(_b(lambda a, b: (a < b)))
+    greaterThanOrEqual = staticmethod(_b(lambda a, b: (a >= b)))
+    lessThanOrEqual = staticmethod(_b(lambda a, b: (a <= b)))
+
+    @staticmethod
+    def isMax(x, axis=None):
+        a = as_jax(x)
+        if axis is None:
+            return NDArray((a == a.max()).astype(a.dtype))
+        return NDArray(
+            (a == a.max(axis=axis, keepdims=True)).astype(a.dtype))
+
+
+class BooleanIndexing:
+    """≡ org.nd4j.linalg.indexing.BooleanIndexing + Conditions."""
+
+    @staticmethod
+    def replaceWhere(arr, value, condition):
+        a = as_jax(arr)
+        return NDArray(jnp.where(condition(a), as_jax(value), a))
+
+    @staticmethod
+    def applyWhere(arr, condition, fn):
+        a = as_jax(arr)
+        return NDArray(jnp.where(condition(a), fn(a), a))
+
+    @staticmethod
+    def countWhere(arr, condition):
+        return int(condition(as_jax(arr)).sum())
+
+    @staticmethod
+    def anyWhere(arr, condition):
+        return bool(condition(as_jax(arr)).any())
+
+    @staticmethod
+    def allWhere(arr, condition):
+        return bool(condition(as_jax(arr)).all())
+
+
+class Conditions:
+    """≡ indexing.conditions.Conditions factory."""
+
+    @staticmethod
+    def greaterThan(v):
+        return lambda a: a > v
+
+    @staticmethod
+    def lessThan(v):
+        return lambda a: a < v
+
+    @staticmethod
+    def greaterThanOrEqual(v):
+        return lambda a: a >= v
+
+    @staticmethod
+    def lessThanOrEqual(v):
+        return lambda a: a <= v
+
+    @staticmethod
+    def equals(v):
+        return lambda a: a == v
+
+    @staticmethod
+    def notEquals(v):
+        return lambda a: a != v
+
+    @staticmethod
+    def isNan():
+        return lambda a: jnp.isnan(a)
+
+    @staticmethod
+    def isInfinite():
+        return lambda a: jnp.isinf(a)
+
+    @staticmethod
+    def absGreaterThan(v):
+        return lambda a: jnp.abs(a) > v
+
+    @staticmethod
+    def absLessThan(v):
+        return lambda a: jnp.abs(a) < v

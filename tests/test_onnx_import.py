@@ -1,0 +1,189 @@
+"""ONNX import tests — models are authored with the same protobuf wire
+primitives the parser reads (no onnx/tensorflow in this environment), so
+the test exercises real ModelProto bytes end-to-end."""
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.onnx_import import (OnnxGraphMapper,
+                                                     UnsupportedOnnxOpError,
+                                                     importOnnx)
+from deeplearning4j_tpu.autodiff.tfproto import (_put_bytes, _put_varint,
+                                                 _field)
+
+
+# -- tiny ONNX writer ----------------------------------------------------
+def onnx_tensor(name, arr):
+    arr = np.asarray(arr)
+    dt = {np.dtype("float32"): 1, np.dtype("int64"): 7,
+          np.dtype("int32"): 6}[arr.dtype]
+    out = bytearray()
+    for d in arr.shape:
+        _put_varint(out, 1, d)          # dims
+    _put_varint(out, 2, dt)             # data_type
+    _put_bytes(out, 8, name.encode())   # name
+    _put_bytes(out, 9, arr.tobytes())   # raw_data
+    return bytes(out)
+
+
+def onnx_attr(name, value):
+    out = bytearray()
+    _put_bytes(out, 1, name.encode())
+    if isinstance(value, float):
+        _field(out, 2, 5)
+        out.extend(struct.pack("<f", value))
+    elif isinstance(value, int):
+        _put_varint(out, 3, value)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            _put_varint(out, 8, int(v))     # ints
+    elif isinstance(value, np.ndarray):
+        _put_bytes(out, 5, onnx_tensor("", value))  # t
+    return bytes(out)
+
+
+def onnx_node(op, inputs, outputs, name="", **attrs):
+    out = bytearray()
+    for i in inputs:
+        _put_bytes(out, 1, i.encode())
+    for o in outputs:
+        _put_bytes(out, 2, o.encode())
+    _put_bytes(out, 3, name.encode())
+    _put_bytes(out, 4, op.encode())
+    for k, v in attrs.items():
+        _put_bytes(out, 5, onnx_attr(k, v))
+    return bytes(out)
+
+
+def onnx_value_info(name, dims):
+    shape = bytearray()
+    for d in dims:
+        dim = bytearray()
+        _put_varint(dim, 1, d)
+        _put_bytes(shape, 1, bytes(dim))
+    tensor_type = bytearray()
+    _put_varint(tensor_type, 1, 1)          # elem_type FLOAT
+    _put_bytes(tensor_type, 2, bytes(shape))
+    type_proto = bytearray()
+    _put_bytes(type_proto, 1, bytes(tensor_type))
+    out = bytearray()
+    _put_bytes(out, 1, name.encode())
+    _put_bytes(out, 2, bytes(type_proto))
+    return bytes(out)
+
+
+def onnx_model(nodes, initializers, inputs, outputs):
+    graph = bytearray()
+    for n in nodes:
+        _put_bytes(graph, 1, n)
+    _put_bytes(graph, 2, b"test_graph")
+    for name, arr in initializers.items():
+        _put_bytes(graph, 5, onnx_tensor(name, arr))
+    for name, dims in inputs.items():
+        _put_bytes(graph, 11, onnx_value_info(name, dims))
+    for name in outputs:
+        _put_bytes(graph, 12, onnx_value_info(name, [1]))
+    model = bytearray()
+    _put_varint(model, 1, 7)                # ir_version
+    _put_bytes(model, 7, bytes(graph))      # graph
+    return bytes(model)
+
+
+class TestOnnxImport:
+    def test_gemm_mlp(self):
+        rng = np.random.default_rng(0)
+        w1 = rng.normal(size=(4, 8)).astype(np.float32)
+        b1 = rng.normal(size=(8,)).astype(np.float32)
+        w2 = rng.normal(size=(8, 3)).astype(np.float32)
+        b2 = rng.normal(size=(3,)).astype(np.float32)
+        model = onnx_model(
+            [onnx_node("Gemm", ["x", "w1", "b1"], ["h"], transB=0),
+             onnx_node("Relu", ["h"], ["a"]),
+             onnx_node("Gemm", ["a", "w2", "b2"], ["logits"]),
+             onnx_node("Softmax", ["logits"], ["probs"], axis=-1)],
+            {"w1": w1, "b1": b1, "w2": w2, "b2": b2},
+            {"x": [2, 4]}, ["probs"])
+        sd = importOnnx(model)
+        x = rng.normal(size=(2, 4)).astype(np.float32)
+        got = np.asarray(sd.outputSingle({"x": x}, "probs").jax())
+        h = np.maximum(x @ w1 + b1, 0)
+        logits = h @ w2 + b2
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        assert np.allclose(got, e / e.sum(-1, keepdims=True), atol=1e-5)
+
+    def test_conv_bn_pool(self):
+        rng = np.random.default_rng(1)
+        w = rng.normal(size=(4, 3, 3, 3)).astype(np.float32)  # OIHW
+        gamma = np.ones(4, np.float32)
+        beta = np.zeros(4, np.float32)
+        mean = np.zeros(4, np.float32)
+        var = np.ones(4, np.float32)
+        model = onnx_model(
+            [onnx_node("Conv", ["x", "w"], ["c"], strides=[1, 1],
+                       pads=[1, 1, 1, 1]),
+             onnx_node("BatchNormalization",
+                       ["c", "gamma", "beta", "mean", "var"], ["bn"],
+                       epsilon=1e-5),
+             onnx_node("Relu", ["bn"], ["r"]),
+             onnx_node("MaxPool", ["r"], ["p"], kernel_shape=[2, 2],
+                       strides=[2, 2]),
+             onnx_node("GlobalAveragePool", ["p"], ["g"]),
+             onnx_node("Flatten", ["g"], ["f"], axis=1)],
+            {"w": w, "gamma": gamma, "beta": beta, "mean": mean,
+             "var": var},
+            {"x": [2, 3, 8, 8]}, ["f"])
+        sd = importOnnx(model)
+        x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+        got = np.asarray(sd.outputSingle({"x": x}, "f").jax())
+        assert got.shape == (2, 4)
+        # oracle via torch (NCHW native)
+        torch = pytest.importorskip("torch")
+        F = torch.nn.functional
+        tx = torch.from_numpy(x)
+        tc = F.conv2d(tx, torch.from_numpy(w), padding=1)
+        tr = F.relu(tc)  # bn is identity with these stats
+        tp = F.max_pool2d(tr, 2)
+        tg = tp.mean(dim=(2, 3))
+        assert np.allclose(got, tg.numpy(), atol=1e-4)
+
+    def test_embedding_gather_reduce(self):
+        table = np.arange(12, dtype=np.float32).reshape(4, 3)
+        model = onnx_model(
+            [onnx_node("Gather", ["table", "ids"], ["emb"], axis=0),
+             onnx_node("ReduceMean", ["emb"], ["pooled"], axes=[1],
+                       keepdims=0)],
+            {"table": table},
+            {"ids": [2, 5]}, ["pooled"])
+        sd = importOnnx(model)
+        ids = np.asarray([[0, 1, 2, 3, 0], [3, 3, 3, 3, 3]], np.int32)
+        got = np.asarray(sd.outputSingle({"ids": ids}, "pooled").jax())
+        assert np.allclose(got, table[ids].mean(1), atol=1e-6)
+
+    def test_unsupported_raises(self):
+        model = onnx_model([onnx_node("LSTM", ["x"], ["y"])], {},
+                           {"x": [1, 2]}, ["y"])
+        with pytest.raises(UnsupportedOnnxOpError, match="LSTM"):
+            importOnnx(model)
+
+    def test_finetune_imported(self):
+        rng = np.random.default_rng(3)
+        w = rng.normal(size=(4, 3)).astype(np.float32)
+        model = onnx_model(
+            [onnx_node("MatMul", ["x", "w"], ["logits"])],
+            {"w": w}, {"x": [8, 4]}, ["logits"])
+        sd = importOnnx(model)
+        sd.convertConstantsToVariables("w")
+        labels = sd.placeHolder("labels", None, 3)
+        sd.loss.softmaxCrossEntropy("loss", labels,
+                                    sd.getVariable("logits"))
+        sd.setLossVariables("loss")
+        from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+        from deeplearning4j_tpu.nn.updaters import Adam
+        sd.setTrainingConfig(TrainingConfig.Builder().updater(Adam(5e-2))
+                             .dataSetFeatureMapping("x")
+                             .dataSetLabelMapping("labels").build())
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(3, size=8)]
+        losses = [sd.fit(x, y) for _ in range(15)]
+        assert losses[-1] < losses[0]

@@ -160,3 +160,70 @@ def test_cosine_and_distances():
     assert abs(nd.cosineSim(a, b)) < 1e-6
     assert abs(nd.euclideanDistance(a, b) - np.sqrt(2)) < 1e-6
     assert abs(nd.manhattanDistance(a, b) - 2.0) < 1e-6
+
+
+class TestTransformsCatalog:
+    """≡ nd4j Transforms/BooleanIndexing op tests vs numpy oracles."""
+
+    def test_unary_transforms(self):
+        from deeplearning4j_tpu.ops import Transforms as T
+        x = np.linspace(-2, 2, 9).astype(np.float32)
+        assert np.allclose(np.asarray(T.exp(x)), np.exp(x), atol=1e-5)
+        assert np.allclose(np.asarray(T.tanh(x)), np.tanh(x), atol=1e-5)
+        assert np.allclose(np.asarray(T.relu(x)), np.maximum(x, 0))
+        assert np.allclose(np.asarray(T.abs(x)), np.abs(x))
+        assert np.allclose(np.asarray(T.sigmoid(x)),
+                           1 / (1 + np.exp(-x)), atol=1e-5)
+        assert np.allclose(np.asarray(T.hardTanh(x)), np.clip(x, -1, 1))
+
+    def test_softmax_rows_sum_one(self):
+        from deeplearning4j_tpu.ops import Transforms as T
+        x = np.random.default_rng(0).normal(size=(4, 7)).astype(np.float32)
+        sm = np.asarray(T.softmax(x))
+        assert np.allclose(sm.sum(-1), 1.0, atol=1e-5)
+        assert np.allclose(np.asarray(T.logSoftmax(x)),
+                           np.log(sm), atol=1e-4)
+
+    def test_distances_and_similarity(self):
+        from deeplearning4j_tpu.ops import Transforms as T
+        a = np.asarray([1.0, 0.0], np.float32)
+        b = np.asarray([0.0, 1.0], np.float32)
+        assert abs(T.cosineSim(a, a) - 1.0) < 1e-6
+        assert abs(T.cosineSim(a, b)) < 1e-6
+        assert abs(T.euclideanDistance(a, b) - np.sqrt(2)) < 1e-5
+        assert T.manhattanDistance(a, b) == 2.0
+        assert T.hammingDistance(a, b) == 2
+
+    def test_all_euclidean(self):
+        from deeplearning4j_tpu.ops import Transforms as T
+        a = np.random.default_rng(1).normal(size=(3, 4)).astype(np.float32)
+        b = np.random.default_rng(2).normal(size=(5, 4)).astype(np.float32)
+        d = np.asarray(T.allEuclideanDistances(a, b))
+        expect = np.linalg.norm(a[:, None] - b[None], axis=-1)
+        assert np.allclose(d, expect, atol=1e-4)
+
+    def test_is_max(self):
+        from deeplearning4j_tpu.ops import Transforms as T
+        x = np.asarray([[1.0, 3.0], [5.0, 2.0]], np.float32)
+        assert np.array_equal(np.asarray(T.isMax(x, axis=1)),
+                              [[0, 1], [1, 0]])
+
+    def test_boolean_indexing(self):
+        from deeplearning4j_tpu.ops import BooleanIndexing, Conditions
+        x = np.asarray([-1.0, 2.0, np.nan, 4.0], np.float32)
+        fixed = np.asarray(BooleanIndexing.replaceWhere(
+            x, 0.0, Conditions.isNan()))
+        assert np.allclose(fixed, [-1, 2, 0, 4])
+        assert BooleanIndexing.countWhere(fixed,
+                                          Conditions.greaterThan(0)) == 2
+        assert BooleanIndexing.anyWhere(fixed, Conditions.lessThan(0))
+        assert BooleanIndexing.allWhere(fixed, Conditions.greaterThan(-5))
+        assert not BooleanIndexing.allWhere(fixed,
+                                            Conditions.greaterThan(0))
+
+    def test_apply_where(self):
+        from deeplearning4j_tpu.ops import BooleanIndexing, Conditions
+        x = np.asarray([-2.0, 3.0], np.float32)
+        y = np.asarray(BooleanIndexing.applyWhere(
+            x, Conditions.lessThan(0), lambda a: a * -1))
+        assert np.allclose(y, [2.0, 3.0])

@@ -96,3 +96,17 @@ class TestInstantiation:
         with pytest.raises(RuntimeError, match="egress"):
             LeNet().initPretrained()
         assert not LeNet().pretrainedAvailable("imagenet")
+
+
+class TestNASNet:
+    def test_builds_and_trains(self):
+        from deeplearning4j_tpu.models.zoo import NASNet
+        m = NASNet(numClasses=4, inputShape=(32, 32, 3), numBlocks=1,
+                   filters=8, stemFilters=8)
+        net = m.init()
+        x = _rand((2, 32, 32, 3))
+        out = net.output(x)
+        y = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+        assert y.shape == (2, 4)
+        net.fit(x, _onehot(2, 4))
+        assert np.isfinite(float(net.score()))
