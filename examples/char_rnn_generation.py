@@ -1,0 +1,53 @@
+"""Character-level text generation with stacked LSTMs (≡ dl4j-examples ::
+GravesLSTMCharModellingExample): overfit a tiny corpus, then sample."""
+import numpy as np
+
+from deeplearning4j_tpu.nn import (Adam, MultiLayerNetwork,
+                                   NeuralNetConfiguration, InputType)
+from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+
+CORPUS = ("the quick brown fox jumps over the lazy dog. "
+          "pack my box with five dozen liquor jugs. ") * 20
+
+
+def main():
+    chars = sorted(set(CORPUS))
+    c2i = {c: i for i, c in enumerate(chars)}
+    n = len(chars)
+    seq_len = 32
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(12).updater(Adam(1e-2)).weightInit("xavier")
+            .list()
+            .layer(LSTM(nOut=96, activation="tanh"))
+            .layer(RnnOutputLayer(lossFunction="mcxent", nOut=n,
+                                  activation="softmax"))
+            .setInputType(InputType.recurrent(n))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    # build (B, T, C) one-hot batches
+    ids = np.asarray([c2i[c] for c in CORPUS])
+    starts = np.arange(0, len(ids) - seq_len - 1, seq_len)
+    x = np.eye(n, dtype=np.float32)[
+        np.stack([ids[s:s + seq_len] for s in starts])]
+    y = np.eye(n, dtype=np.float32)[
+        np.stack([ids[s + 1:s + seq_len + 1] for s in starts])]
+
+    for epoch in range(60):
+        net.fit(x, y)
+    print("final loss:", net.score())
+
+    # sample greedily from a seed character
+    rng = np.random.default_rng(0)
+    out = "t"
+    net.rnnClearPreviousState()
+    for _ in range(80):
+        step = np.eye(n, dtype=np.float32)[[c2i[out[-1]]]][None]
+        probs = np.asarray(net.rnnTimeStep(step))[0, 0]
+        out += chars[int(rng.choice(n, p=probs / probs.sum()))]
+    print("sampled:", out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Multi-chip data parallelism (≡ dl4j-examples :: MultiGpuLenetMnist via
+ParallelWrapper). Run on a TPU pod slice, or simulate with
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+"""
+import jax
+
+from deeplearning4j_tpu.datasets.iterators import MnistDataSetIterator
+from deeplearning4j_tpu.nn import (Adam, ConvolutionLayer, DenseLayer,
+                                   InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer,
+                                   SubsamplingLayer)
+from deeplearning4j_tpu.parallel import ParallelWrapper
+
+
+def main():
+    print("devices:", jax.devices())
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(123).updater(Adam(1e-3)).weightInit("xavier")
+            .list()
+            .layer(ConvolutionLayer(kernelSize=(5, 5), nOut=16,
+                                    activation="relu",
+                                    convolutionMode="same"))
+            .layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(nOut=128, activation="relu"))
+            .layer(OutputLayer(lossFunction="mcxent", nOut=10,
+                               activation="softmax"))
+            .setInputType(InputType.convolutionalFlat(28, 28, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    # ≡ ParallelWrapper.Builder(model).workers(N)...build()
+    wrapper = (ParallelWrapper.Builder(net)
+               .workers(len(jax.devices()))
+               .prefetchBuffer(2)
+               .averagingFrequency(1)
+               .build())
+    wrapper.fit(MnistDataSetIterator(64 * len(jax.devices())))
+    ev = net.evaluate(MnistDataSetIterator(256, train=False))
+    print("accuracy:", ev.accuracy())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""DQN on CartPole (≡ rl4j-examples :: Cartpole DQN example)."""
+from deeplearning4j_tpu.rl import (CartpoleNative,
+                                   DQNDenseNetworkConfiguration,
+                                   QLearningConfiguration,
+                                   QLearningDiscreteDense)
+
+
+def main():
+    conf = QLearningConfiguration(
+        seed=123, maxEpochStep=200, maxStep=12000, expRepMaxSize=10000,
+        batchSize=64, targetDqnUpdateFreq=200, updateStart=128,
+        gamma=0.99, minEpsilon=0.05, epsilonNbStep=6000)
+    dqn = QLearningDiscreteDense(
+        CartpoleNative(seed=0),
+        DQNDenseNetworkConfiguration(numLayers=2, numHiddenNodes=64,
+                                     learningRate=1e-3),
+        conf)
+    rewards = dqn.train()
+    recent = rewards[-10:]
+    print(f"episodes: {len(rewards)}; last-10 mean reward: "
+          f"{sum(recent) / len(recent):.1f}")
+    print("greedy play:", dqn.getPolicy().play(CartpoleNative(seed=99)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Hyperparameter search (≡ arbiter examples): tune lr + width for a
+tiny classifier with TPE."""
+import numpy as np
+
+from deeplearning4j_tpu.arbiter import (ContinuousParameterSpace,
+                                        IntegerParameterSpace,
+                                        LocalOptimizationRunner,
+                                        TPEGenerator)
+from deeplearning4j_tpu.nn import (Adam, DenseLayer, InputType,
+                                   MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(128, 10)).astype(np.float32)
+W = rng.normal(size=(10, 3)).astype(np.float32)
+Y = np.eye(3, dtype=np.float32)[(X @ W).argmax(-1)]
+
+
+def build_and_score(params):
+    net = MultiLayerNetwork(
+        NeuralNetConfiguration.Builder().seed(1)
+        .updater(Adam(params["lr"])).weightInit("xavier").list()
+        .layer(DenseLayer(nOut=params["width"], activation="relu"))
+        .layer(OutputLayer(lossFunction="mcxent", nOut=3,
+                           activation="softmax"))
+        .setInputType(InputType.feedForward(10)).build()).init()
+    for _ in range(30):
+        net.fit(X, Y)
+    return net.score()
+
+
+def main():
+    space = {"lr": ContinuousParameterSpace(1e-4, 1e-1, log=True),
+             "width": IntegerParameterSpace(4, 64)}
+    runner = LocalOptimizationRunner(
+        TPEGenerator(space, seed=5, startupTrials=6),
+        model_builder=lambda p: p, scorer=build_and_score,
+        maxCandidates=15)
+    best = runner.execute()
+    print("best:", best.params, "loss:", round(best.score, 4))
+
+
+if __name__ == "__main__":
+    main()

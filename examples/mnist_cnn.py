@@ -1,0 +1,43 @@
+"""LeNet on MNIST (≡ dl4j-examples :: MnistClassifier) — the canonical
+first example: build with the config DSL, fit, evaluate."""
+from deeplearning4j_tpu.datasets.iterators import MnistDataSetIterator
+from deeplearning4j_tpu.nn import (Adam, ConvolutionLayer, DenseLayer,
+                                   InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer,
+                                   SubsamplingLayer)
+from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+
+
+def main():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(123)
+            .updater(Adam(1e-3))
+            .weightInit("xavier")
+            .list()
+            .layer(ConvolutionLayer(kernelSize=(5, 5), nOut=20,
+                                    activation="relu",
+                                    convolutionMode="same"))
+            .layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                    stride=(2, 2)))
+            .layer(ConvolutionLayer(kernelSize=(5, 5), nOut=50,
+                                    activation="relu",
+                                    convolutionMode="same"))
+            .layer(SubsamplingLayer(poolingType="max", kernelSize=(2, 2),
+                                    stride=(2, 2)))
+            .layer(DenseLayer(nOut=500, activation="relu"))
+            .layer(OutputLayer(lossFunction="negativeloglikelihood",
+                               nOut=10, activation="softmax"))
+            .setInputType(InputType.convolutionalFlat(28, 28, 1))
+            .build())
+
+    net = MultiLayerNetwork(conf).init()
+    net.setListeners(ScoreIterationListener(10))
+    train = MnistDataSetIterator(128, train=True)
+    test = MnistDataSetIterator(128, train=False)
+    net.fit(train, epochs=2)
+    ev = net.evaluate(test)
+    print(ev.stats())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Transfer learning (≡ dl4j-examples :: EditLastLayerOthersFrozen):
+freeze a trained feature extractor, swap the output head, fine-tune."""
+import numpy as np
+
+from deeplearning4j_tpu.nn import (Adam, DenseLayer, InputType,
+                                   MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.transfer.transfer_learning import (
+    FineTuneConfiguration, TransferLearning)
+
+
+def main():
+    base = MultiLayerNetwork(
+        NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-2))
+        .weightInit("xavier").list()
+        .layer(DenseLayer(nOut=64, activation="relu"))
+        .layer(DenseLayer(nOut=32, activation="relu"))
+        .layer(OutputLayer(lossFunction="mcxent", nOut=5,
+                           activation="softmax"))
+        .setInputType(InputType.feedForward(20)).build()).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 20)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.integers(5, size=64)]
+    for _ in range(10):
+        base.fit(x, y)
+    print("base loss:", base.score())
+
+    # new 3-class task: freeze everything up to layer 1, replace the head
+    transferred = (TransferLearning.Builder(base)
+                   .fineTuneConfiguration(
+                       FineTuneConfiguration.Builder()
+                       .updater(Adam(1e-3)).build())
+                   .setFeatureExtractor(1)
+                   .removeOutputLayer()
+                   .addLayer(OutputLayer(lossFunction="mcxent", nOut=3,
+                                         activation="softmax"))
+                   .build())
+    y3 = np.eye(3, dtype=np.float32)[rng.integers(3, size=64)]
+    for _ in range(10):
+        transferred.fit(x, y3)
+    print("fine-tuned loss:", transferred.score())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Variational autoencoder for anomaly scoring (≡ dl4j-examples ::
+VariationalAutoEncoderExample): pretrain unsupervised, score outliers by
+reconstruction error."""
+import numpy as np
+
+from deeplearning4j_tpu.nn import (Adam, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer,
+                                   VariationalAutoencoder)
+
+
+def main():
+    net = MultiLayerNetwork(
+        NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-3))
+        .weightInit("xavier").activation("tanh").list()
+        .layer(VariationalAutoencoder(
+            nOut=2, encoderLayerSizes=(32,), decoderLayerSizes=(32,),
+            reconstructionDistribution="gaussian"))
+        .layer(OutputLayer(lossFunction="mse", nOut=1,
+                           activation="identity"))
+        .setInputType(InputType.feedForward(8)).build()).init()
+
+    rng = np.random.default_rng(0)
+    normal = rng.normal(0, 1, size=(256, 8)).astype(np.float32)
+    net.pretrainLayer(0, normal, epochs=150)
+
+    vae = net.layers[0]
+    params = net._params["0"]
+    inliers = rng.normal(0, 1, size=(16, 8)).astype(np.float32)
+    outliers = rng.normal(6, 1, size=(16, 8)).astype(np.float32)
+
+    def recon_error(batch):
+        rec = np.asarray(vae.reconstruct(params, batch))
+        return float(((rec - batch) ** 2).mean())
+
+    print("inlier reconstruction MSE: ", round(recon_error(inliers), 3))
+    print("outlier reconstruction MSE:", round(recon_error(outliers), 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Word2Vec on a text corpus (≡ dl4j-examples :: Word2VecRawTextExample)."""
+from deeplearning4j_tpu.nlp import (CollectionSentenceIterator,
+                                    CommonPreprocessor,
+                                    DefaultTokenizerFactory, Word2Vec)
+
+import numpy as np
+
+# two topics whose words co-occur within-topic but never across — skip-gram
+# places words with similar CONTEXTS near each other
+_TIME = ["day", "night", "morning", "evening", "noon", "dusk"]
+_SKY = ["sun", "moon", "stars", "clouds", "comet", "nebula"]
+_rng = np.random.default_rng(7)
+SENTENCES = ["{} {} {} {} {} {}".format(
+    *_rng.choice(fam, 6)) for _ in range(300)
+    for fam in (_TIME if _rng.random() < 0.5 else _SKY,)]
+
+
+def main():
+    tok = DefaultTokenizerFactory()
+    tok.setTokenPreProcessor(CommonPreprocessor())
+    vec = (Word2Vec.Builder()
+           .minWordFrequency(2)
+           .layerSize(32)
+           .seed(42)
+           .windowSize(3)
+           .learningRate(0.05)
+           .epochs(20)
+           .sampling(0)  # tiny corpus: keep every token
+           .iterate(CollectionSentenceIterator(SENTENCES))
+           .tokenizerFactory(tok)
+           .build()
+           .fit())
+    print("vocab:", vec.vocabSize())
+    print("closest to 'day':", vec.wordsNearest("day", 5))
+    print("sim(day, night) =", vec.similarity("day", "night"))
+    print("sim(day, stars) =", vec.similarity("day", "stars"))
+
+
+if __name__ == "__main__":
+    main()
