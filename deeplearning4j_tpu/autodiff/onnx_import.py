@@ -87,6 +87,35 @@ def _attr(node, name, default):
     return default if v is None else v
 
 
+def _resolve_pads(node, k, s, d, spatial):
+    """Effective ((lo, hi), ...) spatial padding for Conv/pools, honoring
+    `auto_pad` (SAME_UPPER/SAME_LOWER/VALID) over the explicit `pads`
+    attribute — older exporters still emit auto_pad, and ignoring it
+    silently imported zero padding (round-1 ADVICE).  `spatial` is the
+    static input spatial shape (known at trace time)."""
+    auto = _attr(node, "auto_pad", "NOTSET")
+    if isinstance(auto, bytes):
+        auto = auto.decode()
+    if auto in ("NOTSET", ""):
+        pads = node.attrs.get("pads") or [0] * (2 * len(spatial))
+        n = len(spatial)
+        return [(int(pads[i]), int(pads[i + n])) for i in range(n)]
+    if auto == "VALID":
+        return [(0, 0)] * len(spatial)
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        out = []
+        for i, size in enumerate(spatial):
+            eff = (int(k[i]) - 1) * int(d[i]) + 1
+            o = -(-int(size) // int(s[i]))
+            total = max((o - 1) * int(s[i]) + eff - int(size), 0)
+            lo = total // 2
+            hi = total - lo
+            out.append((hi, lo) if auto == "SAME_LOWER" else (lo, hi))
+        return out
+    raise UnsupportedOnnxOpError(
+        f"{node.name}: unsupported auto_pad value {auto!r}")
+
+
 class OnnxNode:
     def __init__(self, name, op, inputs, outputs, attrs):
         self.name, self.op = name, op
@@ -129,7 +158,13 @@ def parse_onnx_model(data):
         inputs[nm] = dims
     outputs = [parse_fields(vi).get(1, [b""])[0].decode()
                for vi in graph.get(12, [])]      # output = 12
-    return nodes, inits, inputs, outputs
+    opset = 13                                   # modern default
+    for oi in model.get(8, []):                  # opset_import = 8
+        f = parse_fields(oi)
+        domain = f.get(1, [b""])[0]
+        if domain in (b"", b"ai.onnx"):
+            opset = _signed(f.get(2, [13])[0])
+    return nodes, inits, inputs, outputs, opset
 
 
 _ONNX_ELEMENTWISE = {
@@ -150,7 +185,7 @@ class OnnxGraphMapper:
         if not isinstance(data, (bytes, bytearray)):
             with open(data, "rb") as f:
                 data = f.read()
-        nodes, inits, inputs, outputs = parse_onnx_model(bytes(data))
+        nodes, inits, inputs, outputs, opset = parse_onnx_model(bytes(data))
         sd = sd or SameDiff.create()
         consts = {}
         for name, arr in inits.items():
@@ -161,12 +196,12 @@ class OnnxGraphMapper:
                 continue
             sd.placeHolder(name, *[d if d > 0 else None for d in dims])
         for node in nodes:
-            OnnxGraphMapper._map_node(sd, node, consts)
+            OnnxGraphMapper._map_node(sd, node, consts, opset)
         sd._onnx_outputs = outputs
         return sd
 
     @staticmethod
-    def _map_node(sd, node, consts):
+    def _map_node(sd, node, consts, opset=13):
         op = node.op
         out = node.outputs[0]
         ins = [sd.getVariable(r) for r in node.inputs if r]
@@ -196,10 +231,22 @@ class OnnxGraphMapper:
                 return y + beta * c[0] if c else y
             sd._op_named(out, "gemm", gemm, *ins)
         elif op == "Softmax":
-            axis = int(_attr(node, "axis", -1))
-            sd._op_named(out, "softmax",
-                         lambda x, axis=axis: jax.nn.softmax(x, axis=axis),
-                         *ins)
+            if opset < 13:
+                # opset <13: default axis=1 with coerce-to-2D semantics —
+                # softmax over ALL dims from `axis` on, flattened together.
+                axis = int(_attr(node, "axis", 1))
+
+                def softmax_2d(x, axis=axis):
+                    ax = axis if axis >= 0 else x.ndim + axis
+                    lead = int(np.prod(x.shape[:ax])) if ax else 1
+                    y = jax.nn.softmax(x.reshape(lead, -1), axis=-1)
+                    return y.reshape(x.shape)
+                sd._op_named(out, "softmax", softmax_2d, *ins)
+            else:
+                axis = int(_attr(node, "axis", -1))
+                sd._op_named(out, "softmax",
+                             lambda x, axis=axis: jax.nn.softmax(
+                                 x, axis=axis), *ins)
         elif op == "Reshape":
             shp = const_val(1)
             if shp is None:
@@ -261,14 +308,15 @@ class OnnxGraphMapper:
                          *ins)
         elif op == "Conv":
             strides = tuple(node.attrs.get("strides") or (1, 1))
-            pads = node.attrs.get("pads") or [0, 0, 0, 0]
             dil = tuple(node.attrs.get("dilations") or (1, 1))
             groups = int(_attr(node, "group", 1))
-            pad_arg = [(int(pads[0]), int(pads[2])),
-                       (int(pads[1]), int(pads[3]))]
 
-            def conv(x, w, *b, strides=strides, pad_arg=pad_arg, dil=dil,
-                     groups=groups):
+            def conv(x, w, *b, strides=strides, dil=dil, groups=groups,
+                     node=node):
+                # pads resolved at trace time: auto_pad=SAME_* depends on
+                # the (static) input spatial shape
+                pad_arg = _resolve_pads(node, w.shape[2:], strides, dil,
+                                        x.shape[2:])
                 y = jax.lax.conv_general_dilated(
                     x, w.astype(x.dtype), window_strides=strides,
                     padding=pad_arg, rhs_dilation=dil,
@@ -279,20 +327,23 @@ class OnnxGraphMapper:
         elif op in ("MaxPool", "AveragePool"):
             ksize = tuple(node.attrs.get("kernel_shape") or (2, 2))
             strides = tuple(node.attrs.get("strides") or ksize)
-            pads = node.attrs.get("pads") or [0, 0, 0, 0]
             window = (1, 1) + ksize
             strd = (1, 1) + strides
-            pad_arg = [(0, 0), (0, 0),
-                       (int(pads[0]), int(pads[2])),
-                       (int(pads[1]), int(pads[3]))]
+            ones = (1,) * len(ksize)
+
+            def pool_pads(x, node=node, ksize=ksize, strides=strides,
+                          ones=ones):
+                return [(0, 0), (0, 0)] + _resolve_pads(
+                    node, ksize, strides, ones, x.shape[2:])
             if op == "MaxPool":
                 sd._op_named(out, "maxpool",
                              lambda x, window=window, strd=strd,
-                             pad_arg=pad_arg: jax.lax.reduce_window(
+                             pool_pads=pool_pads: jax.lax.reduce_window(
                                  x, -jnp.inf, jax.lax.max, window, strd,
-                                 pad_arg), *ins)
+                                 pool_pads(x)), *ins)
             else:
-                def avg(x, window=window, strd=strd, pad_arg=pad_arg):
+                def avg(x, window=window, strd=strd, pool_pads=pool_pads):
+                    pad_arg = pool_pads(x)
                     s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window,
                                               strd, pad_arg)
                     n = jax.lax.reduce_window(jnp.ones_like(x), 0.0,

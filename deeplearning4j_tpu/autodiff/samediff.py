@@ -630,6 +630,15 @@ class SameDiff:
         fit(placeholders=dict) feeding everything directly."""
         self._ensure_optimizer()
         tc = self._training_config
+        if isinstance(labels, dict):
+            # fit(dataset, placeholders_dict) callers from the old
+            # (dataset, placeholders) signature: a dict is never a labels
+            # array — route it to placeholders.
+            if placeholders is not None:
+                raise TypeError(
+                    "fit(): got a dict for `labels` AND `placeholders`; "
+                    "pass placeholders once, as placeholders=")
+            labels, placeholders = None, labels
         if labels is not None:
             from deeplearning4j_tpu.datasets.dataset import DataSet
             dataset = DataSet(dataset, labels)

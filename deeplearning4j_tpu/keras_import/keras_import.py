@@ -310,6 +310,13 @@ class _IdentityAlias:
 
 # -- .h5 weight loading (gated on h5py, which this image ships) ----------
 def _h5_layer_weights(weights_path):
+    """layer name → [(dataset leaf name, array), ...] in save order.
+
+    Leaf names are Keras's canonical weight names (kernel / bias / gamma /
+    beta / moving_mean / moving_variance / recurrent_kernel / embeddings /
+    depthwise_kernel / pointwise_kernel), with any ":0" suffix stripped —
+    the reference's KerasLayer maps by these names, never by shape.
+    """
     import h5py
     out = {}
     with h5py.File(weights_path, "r") as f:
@@ -318,34 +325,83 @@ def _h5_layer_weights(weights_path):
             sub = grp[lname]
             arrs = []
 
-            def visit(_, obj):
+            def visit(path, obj):
                 if hasattr(obj, "shape"):
-                    arrs.append(np.array(obj))
+                    leaf = path.split("/")[-1].split(":")[0]
+                    arrs.append((leaf, np.array(obj)))
             sub.visititems(visit)
             if arrs:
                 out[lname] = arrs
     return out
 
 
+# Keras weight dataset name → (our params key, our state key)
+_KERAS_WEIGHT_NAMES = {
+    "kernel": ("W", None),
+    "embeddings": ("W", None),
+    "recurrent_kernel": ("U", None),
+    "bias": ("b", None),
+    "gamma": ("gamma", None),
+    "beta": ("beta", None),
+    "moving_mean": (None, "mean"),
+    "moving_variance": (None, "var"),
+    "depthwise_kernel": ("dW", None),
+    "pointwise_kernel": ("pW", None),
+}
+
+
+def _remap_lstm_gates(arr):
+    """Keras gate order i,f,g,o → ours i,f,o,g (kernel, recurrent kernel AND
+    bias all share the 4*n gate axis — the reference remaps all three)."""
+    n = arr.shape[-1] // 4
+    i, f, g, o = (arr[..., :n], arr[..., n:2 * n],
+                  arr[..., 2 * n:3 * n], arr[..., 3 * n:])
+    return np.concatenate([i, f, o, g], axis=-1)
+
+
 def _assign_keras_weights(layer_params, arrs, layer_state=None):
-    """Match Keras save order onto our param dicts by shape."""
-    for arr in arrs:
+    """Assign Keras .h5 arrays onto our param/state dicts BY NAME.
+
+    Shape-only matching mis-assigns any layer whose weights share a shape
+    (BatchNorm's four (C,) vectors; LSTM with nIn == nOut) — matching by
+    the Keras dataset name is how the reference's KerasLayer does it.
+    Arrays with unrecognized names fall back to shape matching against
+    still-unused keys.
+    """
+    # LSTM only: U is (n_out, 4*n_out); SimpleRNN's U is square — its
+    # weights must NOT be gate-remapped even when units % 4 == 0
+    u = layer_params.get("U")
+    is_lstm = u is not None and u.shape[-1] == 4 * u.shape[0]
+    used_p, used_s = set(), set()
+    leftovers = []
+    for name, arr in arrs:
+        pkey, skey = _KERAS_WEIGHT_NAMES.get(name, (None, None))
+        if pkey is not None and pkey in layer_params \
+                and tuple(layer_params[pkey].shape) == tuple(arr.shape):
+            if is_lstm and pkey in ("W", "U", "b") and arr.shape[-1] % 4 == 0:
+                arr = _remap_lstm_gates(arr)
+            layer_params[pkey] = arr
+            used_p.add(pkey)
+        elif skey is not None and layer_state is not None \
+                and skey in layer_state \
+                and tuple(layer_state[skey].shape) == tuple(arr.shape):
+            layer_state[skey] = arr
+            used_s.add(skey)
+        else:
+            leftovers.append(arr)
+    for arr in leftovers:  # unknown names: shape-match unused keys only
         placed = False
         for key, val in layer_params.items():
-            import numpy as _np
-            if tuple(val.shape) == tuple(arr.shape) and not placed:
-                if key == "W" and arr.ndim == 2 and "U" in layer_params:
-                    # LSTM kernel: keras gate order i,f,g,o → ours i,f,o,g
-                    n = arr.shape[1] // 4
-                    arr = _np.concatenate(
-                        [arr[:, :n], arr[:, n:2 * n], arr[:, 3 * n:],
-                         arr[:, 2 * n:3 * n]], axis=1)
+            if key not in used_p and tuple(val.shape) == tuple(arr.shape):
                 layer_params[key] = arr
+                used_p.add(key)
                 placed = True
+                break
         if not placed and layer_state is not None:
             for key, val in layer_state.items():
-                if tuple(val.shape) == tuple(arr.shape):
+                if key not in used_s and tuple(val.shape) == tuple(arr.shape):
                     layer_state[key] = arr
+                    used_s.add(key)
                     break
 
 

@@ -13,6 +13,10 @@ from deeplearning4j_tpu.nn.conf.layers import (
     Upsampling2D, ZeroPaddingLayer)
 from deeplearning4j_tpu.nn.conf.special_layers import (
     CenterLossOutputLayer, LocallyConnected2D, VariationalAutoencoder)
+from deeplearning4j_tpu.nn.constraints import (MaxNormConstraint,
+                                               MinMaxNormConstraint,
+                                               NonNegativeConstraint,
+                                               UnitNormConstraint)
 from deeplearning4j_tpu.nn.losses import (LossBinaryXENT, LossFunction,
                                           LossMCXENT, LossMSE,
                                           LossNegativeLogLikelihood)
@@ -37,4 +41,6 @@ __all__ = [
     "LossFunction", "MultiLayerNetwork", "AMSGrad",
     "AdaDelta", "AdaGrad", "AdaMax", "Adam", "GradientNormalization",
     "Nadam", "Nesterovs", "NoOp", "RmsProp", "Sgd", "Updater", "WeightInit",
+    "MaxNormConstraint", "MinMaxNormConstraint", "NonNegativeConstraint",
+    "UnitNormConstraint",
 ]

@@ -213,6 +213,36 @@ class NeuralNetConfiguration:
             self._defaults["dropOut"] = float(p)
             return self
 
+        def constrainWeights(self, *constraints):
+            """≡ Builder.constrainWeights — applied post-update to every
+            layer's weight params (W/U/dW/pW), inside the jitted step."""
+            self._defaults["constraints"] = (
+                self._defaults.get("constraints", []) + list(constraints))
+            return self
+
+        def constrainBias(self, *constraints):
+            import copy
+            cs = []
+            for c in constraints:
+                c = copy.copy(c)
+                c.applies_to = ("b",)
+                cs.append(c)
+            self._defaults["constraints"] = (
+                self._defaults.get("constraints", []) + cs)
+            return self
+
+        def constrainAllParameters(self, *constraints):
+            import copy
+            from deeplearning4j_tpu.nn.constraints import WEIGHT_KEYS
+            cs = []
+            for c in constraints:
+                c = copy.copy(c)
+                c.applies_to = WEIGHT_KEYS + ("b", "gamma", "beta")
+                cs.append(c)
+            self._defaults["constraints"] = (
+                self._defaults.get("constraints", []) + cs)
+            return self
+
         def gradientNormalization(self, gn):
             self._defaults["gradientNormalization"] = gn
             return self

@@ -76,7 +76,8 @@ class Layer:
 
     INHERITED = ("activation", "weightInit", "biasInit", "l1", "l2",
                  "dropOut", "updater", "gradientNormalization",
-                 "gradientNormalizationThreshold", "weightDecay")
+                 "gradientNormalizationThreshold", "weightDecay",
+                 "constraints")
 
     @classmethod
     def _builder_positional(cls, args):
@@ -102,6 +103,10 @@ class Layer:
         self.gradientNormalizationThreshold = gradientNormalizationThreshold
         self.weightDecay = weightDecay
         self.constraints = constraints
+        cw = kw.pop("constrainWeights", None)  # builder-method spelling
+        if cw is not None:
+            self.constraints = (list(cw) if isinstance(cw, (list, tuple))
+                                else [cw])
         for k, v in kw.items():
             setattr(self, k, v)
 

@@ -1,0 +1,97 @@
+"""Parameter constraints (≡ org.deeplearning4j.nn.conf.constraint ::
+MaxNormConstraint, MinMaxNormConstraint, NonNegativeConstraint,
+UnitNormConstraint).
+
+The reference applies constraints in-place after each parameter update
+(BaseConstraint.applyConstraint called from the updater step).  Here they
+are pure functions folded into the SAME jitted train step, immediately
+after ``optax.apply_updates`` — no extra device round-trip.
+
+Norms are taken per output unit (over all axes except the last), matching
+the reference's default dimension handling for dense/conv weights.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_EPS = 1e-12
+
+#: parameter-dict keys treated as "weights" (the reference's default
+#: constraint target — biases are excluded unless constrainBias is used)
+WEIGHT_KEYS = ("W", "U", "dW", "pW")
+
+
+class BaseConstraint:
+    """Applies to weight params by default (≡ BaseConstraint.paramNames)."""
+
+    applies_to = WEIGHT_KEYS
+
+    def apply(self, w):
+        raise NotImplementedError
+
+    def apply_to_params(self, layer_params):
+        return {k: (self.apply(v) if k in self.applies_to else v)
+                for k, v in layer_params.items()}
+
+    @staticmethod
+    def _norm(w):
+        axes = tuple(range(w.ndim - 1)) or (0,)
+        return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+
+
+class MaxNormConstraint(BaseConstraint):
+    """Rescale any output unit whose L2 norm exceeds maxNorm."""
+
+    def __init__(self, maxNorm):
+        self.maxNorm = float(maxNorm)
+
+    def apply(self, w):
+        norm = self._norm(w)
+        return w * jnp.minimum(1.0, self.maxNorm / (norm + _EPS)
+                               ).astype(w.dtype)
+
+
+class MinMaxNormConstraint(BaseConstraint):
+    """Project each output unit's norm into [min, max]; `rate` interpolates
+    between no-op (0) and full projection (1) like the reference."""
+
+    def __init__(self, minNorm, maxNorm, rate=1.0):
+        self.minNorm = float(minNorm)
+        self.maxNorm = float(maxNorm)
+        self.rate = float(rate)
+
+    def apply(self, w):
+        norm = self._norm(w)
+        target = jnp.clip(norm, self.minNorm, self.maxNorm)
+        scale = self.rate * (target / (norm + _EPS)) + (1.0 - self.rate)
+        return w * scale.astype(w.dtype)
+
+
+class UnitNormConstraint(BaseConstraint):
+    """Force each output unit onto the unit sphere."""
+
+    def apply(self, w):
+        return w / (self._norm(w) + _EPS).astype(w.dtype)
+
+
+class NonNegativeConstraint(BaseConstraint):
+    """Clamp negative entries to zero (elementwise)."""
+
+    def apply(self, w):
+        return jnp.maximum(w, 0)
+
+
+def apply_layer_constraints(layers, params):
+    """Fold each layer's constraints over its param dict.  `params` is the
+    network-level {layer_key: {param_name: array}} pytree; layer keys are
+    stringified indices (MultiLayerNetwork) or names (ComputationGraph)."""
+    out = dict(params)
+    for key, layer in layers:
+        cs = getattr(layer, "constraints", None)
+        if not cs or key not in out:
+            continue
+        lp = out[key]
+        for c in cs:
+            lp = c.apply_to_params(lp)
+        out[key] = lp
+    return out

@@ -172,11 +172,15 @@ class ComputationGraph:
             inputs = [inputs]
         return {n: as_jax(v) for n, v in zip(self.conf.input_names, inputs)}
 
-    def output(self, *inputs, train=False):
+    def output(self, *inputs, train=False, fmasks=None):
         if len(inputs) == 1:
             inputs = inputs[0]
         ins = self._as_input_dict(inputs)
-        acts, _, _ = self._forward(self._params, self._state, ins, train, None)
+        if fmasks is not None:
+            fmasks = {k: (None if v is None else as_jax(v))
+                      for k, v in fmasks.items()}
+        acts, _, _ = self._forward(self._params, self._state, ins, train,
+                                   None, fmasks)
         outs = [NDArray(acts[n]) for n in self.conf.output_names]
         return outs[0] if len(outs) == 1 else outs
 
@@ -240,9 +244,18 @@ class ComputationGraph:
                                      rng), has_aux=True)(params)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            params = self._apply_constraints(params)
             return params, opt_state, new_state, loss
 
         return step
+
+    def _apply_constraints(self, params):
+        """Post-update constraints per layer vertex (≡ BaseConstraint)."""
+        pairs = [(n, self.nodes[n].ref) for n in self._layer_names]
+        if not any(getattr(l, "constraints", None) for _, l in pairs):
+            return params
+        from deeplearning4j_tpu.nn.constraints import apply_layer_constraints
+        return apply_layer_constraints(pairs, params)
 
     def _unpack(self, ds):
         if isinstance(ds, MultiDataSet):

@@ -290,6 +290,16 @@ class MultiLayerNetwork:
         return grads
 
     # -- training ---------------------------------------------------------
+    def _apply_constraints(self, params):
+        """Post-update parameter constraints (≡ BaseConstraint application
+        after the updater step) — folded into the jitted step; free when no
+        layer declares constraints (static config, checked at trace)."""
+        pairs = [(str(i), l) for i, l in enumerate(self.layers)]
+        if not any(getattr(l, "constraints", None) for _, l in pairs):
+            return params
+        from deeplearning4j_tpu.nn.constraints import apply_layer_constraints
+        return apply_layer_constraints(pairs, params)
+
     @functools.cached_property
     def _train_step(self):
         tx = self._tx
@@ -301,6 +311,7 @@ class MultiLayerNetwork:
                 has_aux=True)(params)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            params = self._apply_constraints(params)
             return params, opt_state, new_state, loss
 
         return step
@@ -324,6 +335,7 @@ class MultiLayerNetwork:
             new_carries = jax.lax.stop_gradient(new_carries)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            params = self._apply_constraints(params)
             return params, opt_state, new_state, new_carries, loss
 
         return step

@@ -72,14 +72,12 @@ class ParallelWrapper:
         if m._state:
             m._state = self.mesh.replicate(m._state)
 
-    def _pad_batch(self, arr):
-        n = self.mesh.size
-        b = arr.shape[0]
-        if b % n == 0:
-            return arr, b
-        pad = n - b % n
-        reps = np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)])
-        return reps, b
+    @staticmethod
+    def _pad_rows(arr, pad):
+        """Append `pad` copies of the last row (row CONTENT is irrelevant —
+        padded rows are zero-weighted in the loss; repeating keeps dtypes
+        and value ranges valid, e.g. int label ids)."""
+        return np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)])
 
     def fit(self, iterator, epochs=1):
         """Data-parallel fit: same jitted train step as the wrapped model —
@@ -95,17 +93,38 @@ class ParallelWrapper:
             if hasattr(it, "reset"):
                 it.reset()
             for ds in it:
-                feats, _ = self._pad_batch(np.asarray(ds.features))
-                labs, _ = self._pad_batch(np.asarray(ds.labels))
+                feats = np.asarray(ds.features)
+                labs = np.asarray(ds.labels)
+                lm = None if ds.labelsMask is None \
+                    else np.asarray(ds.labelsMask)
+                fm = None if ds.featuresMask is None \
+                    else np.asarray(ds.featuresMask)
+                pad = (-feats.shape[0]) % self.mesh.size
+                if pad:
+                    # Ragged final batch: pad rows to a multiple of the dp
+                    # axis, and ZERO-WEIGHT them via the labels mask so the
+                    # masked-mean loss (losses._apply_mask_mean) excludes
+                    # them exactly — repeat-padding without a mask silently
+                    # biased last-batch gradients (round-1 VERDICT).
+                    b = feats.shape[0]
+                    feats = self._pad_rows(feats, pad)
+                    labs = self._pad_rows(labs, pad)
+                    if lm is None:
+                        mshape = labs.shape[:-1] if labs.ndim >= 2 \
+                            else labs.shape
+                        lm = np.ones(mshape, np.float32)
+                    else:
+                        lm = self._pad_rows(lm, pad)
+                    lm = lm.copy()
+                    lm[b:] = 0.0
+                    if fm is not None:
+                        fm = self._pad_rows(fm, pad)
                 x = jax.device_put(feats, self.mesh.sharding("dp"))
                 y = jax.device_put(labs, self.mesh.sharding("dp"))
-                lmask = fmask = None
-                if ds.labelsMask is not None:
-                    lm, _ = self._pad_batch(np.asarray(ds.labelsMask))
-                    lmask = jax.device_put(lm, self.mesh.sharding("dp"))
-                if ds.featuresMask is not None:
-                    fm, _ = self._pad_batch(np.asarray(ds.featuresMask))
-                    fmask = jax.device_put(fm, self.mesh.sharding("dp"))
+                lmask = None if lm is None \
+                    else jax.device_put(lm, self.mesh.sharding("dp"))
+                fmask = None if fm is None \
+                    else jax.device_put(fm, self.mesh.sharding("dp"))
                 m = self.model
                 m._rng_key, sub = jax.random.split(m._rng_key)
                 m._params, m._opt_state, m._state, loss = m._train_step(

@@ -111,3 +111,44 @@ def test_masked_timeseries_eval():
     mask = np.array([[1, 0, 1]], np.float32)
     e.eval(labels, preds, mask=mask)
     assert abs(e.accuracy() - 1.0) < 1e-9
+
+
+class TestROCMultiClass:
+    def test_per_class_and_average_auc(self):
+        """Hand-oracle: class 0 perfectly separable (AUC 1), class 2
+        anti-separated (AUC 0); average over classes (round-1 🟡)."""
+        from deeplearning4j_tpu.eval import ROCMultiClass
+        labels = np.array([[1, 0, 0],
+                           [1, 0, 0],
+                           [0, 1, 0],
+                           [0, 1, 0],
+                           [0, 0, 1],
+                           [0, 0, 1]], np.float32)
+        # class 0: positives scored highest -> AUC 1
+        # class 1: scores equal for pos/neg -> AUC 0.5
+        # class 2: positives scored LOWEST -> AUC 0
+        preds = np.array([[0.9, 0.5, 0.8],
+                          [0.8, 0.5, 0.9],
+                          [0.1, 0.5, 0.6],
+                          [0.2, 0.5, 0.7],
+                          [0.3, 0.5, 0.1],
+                          [0.4, 0.5, 0.2]], np.float32)
+        roc = ROCMultiClass()
+        roc.eval(labels, preds)
+        assert roc.calculateAUC(0) == 1.0
+        assert abs(roc.calculateAUC(1) - 0.5) < 1e-9
+        assert roc.calculateAUC(2) == 0.0
+        assert abs(roc.calculateAverageAUC() - 0.5) < 1e-9
+
+    def test_incremental_eval_accumulates(self):
+        from deeplearning4j_tpu.eval import ROCMultiClass
+        rng = np.random.default_rng(11)
+        labels = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)]
+        preds = rng.uniform(size=(64, 2)).astype(np.float32)
+        whole = ROCMultiClass()
+        whole.eval(labels, preds)
+        split = ROCMultiClass()
+        split.eval(labels[:32], preds[:32])
+        split.eval(labels[32:], preds[32:])
+        for c in (0, 1):
+            assert abs(whole.calculateAUC(c) - split.calculateAUC(c)) < 1e-12

@@ -132,3 +132,50 @@ def test_graph_summary_and_params():
     s = g.summary()
     assert "merge" in s and "Total params" in s
     assert g.numParams() == (4 * 8 + 8) + (5 * 8 + 8) + (16 * 3 + 3)
+
+
+class TestLastTimeStepVertex:
+    def test_masked_last_step_selection(self):
+        """(B,T,F) -> (B,F) picking each example's LAST VALID step under
+        the mask (round-1 🟡)."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.conf.graph_vertices import LastTimeStepVertex
+        v = LastTimeStepVertex()
+        x = np.arange(2 * 4 * 3, dtype=np.float32).reshape(2, 4, 3)
+        mask = np.array([[1, 1, 0, 0], [1, 1, 1, 1]], np.float32)
+        out = np.asarray(v.apply(jnp.asarray(x), mask=jnp.asarray(mask)))
+        np.testing.assert_allclose(out[0], x[0, 1])  # last valid = t1
+        np.testing.assert_allclose(out[1], x[1, 3])
+        # no mask -> plain last step
+        out2 = np.asarray(v.apply(jnp.asarray(x)))
+        np.testing.assert_allclose(out2, x[:, -1])
+
+    def test_graph_end_to_end_mask_invariance(self):
+        """In a graph LSTM->LastTimeStep->Output: values past the mask end
+        must not affect the network output."""
+        from deeplearning4j_tpu.nn.conf.graph_vertices import LastTimeStepVertex
+        from deeplearning4j_tpu.nn.conf.recurrent import LSTM as LSTMConf
+        from deeplearning4j_tpu.datasets import DataSet
+
+        def build():
+            return (NeuralNetConfiguration.Builder().seed(3)
+                    .graphBuilder()
+                    .addInputs("in")
+                    .addLayer("rnn", LSTMConf.Builder().nOut(6).build(), "in")
+                    .addVertex("last", LastTimeStepVertex("in"), "rnn")
+                    .addLayer("out", OutputLayer.Builder("mcxent").nOut(2)
+                              .activation("softmax").build(), "last")
+                    .setInputTypes(InputType.recurrent(5))
+                    .setOutputs("out")
+                    .build())
+        rng = np.random.default_rng(13)
+        x = rng.standard_normal((3, 6, 5)).astype(np.float32)
+        mask = np.zeros((3, 6), np.float32)
+        mask[:, :4] = 1.0
+        g1 = ComputationGraph(build()).init()
+        out1 = g1.output(x, fmasks={"in": mask}).numpy()
+        x2 = x.copy()
+        x2[:, 4:] = 999.0  # garbage past the mask
+        out2 = g1.output(x2, fmasks={"in": mask}).numpy()
+        np.testing.assert_allclose(out1, out2, atol=1e-5)
+        assert out1.shape == (3, 2)

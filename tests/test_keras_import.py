@@ -142,3 +142,84 @@ class TestH5Weights:
         expect /= expect.sum(-1, keepdims=True)
         got = np.asarray(net.output(x))
         assert np.allclose(got, expect, atol=1e-4)
+
+    def test_batchnorm_weights_by_name(self, tmp_path):
+        """BN's four (C,) vectors must land by NAME — shape matching would
+        pile all four into gamma (ADVICE round 1, medium)."""
+        h5py = pytest.importorskip("h5py")
+        C = 8
+        gamma = np.full((C,), 2.0, np.float32)
+        beta = np.full((C,), 3.0, np.float32)
+        mean = np.full((C,), 4.0, np.float32)
+        var = np.full((C,), 5.0, np.float32)
+        p = tmp_path / "bn.h5"
+        with h5py.File(p, "w") as f:
+            g = f.create_group("model_weights")
+            bn = g.create_group("bn1").create_group("bn1")
+            # keras save order: gamma, beta, moving_mean, moving_variance
+            bn.create_dataset("gamma:0", data=gamma)
+            bn.create_dataset("beta:0", data=beta)
+            bn.create_dataset("moving_mean:0", data=mean)
+            bn.create_dataset("moving_variance:0", data=var)
+        cfg = json.dumps({
+            "class_name": "Sequential",
+            "config": {"name": "bn_net", "layers": [
+                {"class_name": "Conv2D", "config": {
+                    "name": "c1", "filters": C, "kernel_size": [1, 1],
+                    "batch_input_shape": [None, 4, 4, 2]}},
+                {"class_name": "BatchNormalization",
+                 "config": {"name": "bn1"}},
+                {"class_name": "Flatten", "config": {"name": "fl"}},
+                {"class_name": "Dense", "config": {
+                    "name": "out", "units": 3, "activation": "softmax"}},
+            ]}})
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            cfg, str(p))
+        bn_idx = "1"
+        assert np.allclose(np.asarray(net._params[bn_idx]["gamma"]), gamma)
+        assert np.allclose(np.asarray(net._params[bn_idx]["beta"]), beta)
+        assert np.allclose(np.asarray(net._state[bn_idx]["mean"]), mean)
+        assert np.allclose(np.asarray(net._state[bn_idx]["var"]), var)
+
+    def test_square_lstm_weights_by_name(self, tmp_path):
+        """LSTM with nIn == nOut: kernel and recurrent_kernel share a shape;
+        name matching must keep them apart and remap gates i,f,g,o→i,f,o,g
+        on kernel, recurrent kernel AND bias."""
+        h5py = pytest.importorskip("h5py")
+        n = 4  # nIn == nOut == 4
+        blocks = lambda v: np.full((n, n), v, np.float32)  # noqa: E731
+        kernel = np.concatenate(
+            [blocks(1), blocks(2), blocks(3), blocks(4)], axis=1)  # i,f,g,o
+        rec = np.concatenate(
+            [blocks(5), blocks(6), blocks(7), blocks(8)], axis=1)
+        bias = np.concatenate(
+            [np.full((n,), v, np.float32) for v in (10, 20, 30, 40)])
+        p = tmp_path / "lstm.h5"
+        with h5py.File(p, "w") as f:
+            g = f.create_group("model_weights")
+            cell = g.create_group("rnn1").create_group("rnn1")
+            cell.create_dataset("kernel:0", data=kernel)
+            cell.create_dataset("recurrent_kernel:0", data=rec)
+            cell.create_dataset("bias:0", data=bias)
+        cfg = json.dumps({
+            "class_name": "Sequential",
+            "config": {"name": "lstm_net", "layers": [
+                {"class_name": "LSTM", "config": {
+                    "name": "rnn1", "units": n, "activation": "tanh",
+                    "batch_input_shape": [None, 6, n]}},
+                {"class_name": "Dense", "config": {
+                    "name": "out", "units": 2, "activation": "softmax"}},
+            ]}})
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            cfg, str(p))
+        W = np.asarray(net._params["0"]["W"])
+        U = np.asarray(net._params["0"]["U"])
+        b = np.asarray(net._params["0"]["b"])
+        # ours stores gates i,f,o,g along the last axis
+        assert np.allclose(W[:, :n], 1) and np.allclose(W[:, n:2 * n], 2)
+        assert np.allclose(W[:, 2 * n:3 * n], 4)  # o ← keras slot 4
+        assert np.allclose(W[:, 3 * n:], 3)       # g ← keras slot 3
+        assert np.allclose(U[:, :n], 5) and np.allclose(U[:, 2 * n:3 * n], 8)
+        assert np.allclose(U[:, 3 * n:], 7)
+        assert np.allclose(b[:n], 10) and np.allclose(b[2 * n:3 * n], 40)
+        assert np.allclose(b[3 * n:], 30)

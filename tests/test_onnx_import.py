@@ -33,6 +33,8 @@ def onnx_attr(name, value):
     if isinstance(value, float):
         _field(out, 2, 5)
         out.extend(struct.pack("<f", value))
+    elif isinstance(value, str):
+        _put_bytes(out, 4, value.encode())  # s
     elif isinstance(value, int):
         _put_varint(out, 3, value)
     elif isinstance(value, (list, tuple)):
@@ -73,7 +75,7 @@ def onnx_value_info(name, dims):
     return bytes(out)
 
 
-def onnx_model(nodes, initializers, inputs, outputs):
+def onnx_model(nodes, initializers, inputs, outputs, opset=None):
     graph = bytearray()
     for n in nodes:
         _put_bytes(graph, 1, n)
@@ -87,6 +89,11 @@ def onnx_model(nodes, initializers, inputs, outputs):
     model = bytearray()
     _put_varint(model, 1, 7)                # ir_version
     _put_bytes(model, 7, bytes(graph))      # graph
+    if opset is not None:
+        osid = bytearray()
+        _put_bytes(osid, 1, b"")            # domain = default
+        _put_varint(osid, 2, opset)         # version
+        _put_bytes(model, 8, bytes(osid))   # opset_import
     return bytes(model)
 
 
@@ -187,3 +194,62 @@ class TestOnnxImport:
         y = np.eye(3, dtype=np.float32)[rng.integers(3, size=8)]
         losses = [sd.fit(x, y) for _ in range(15)]
         assert losses[-1] < losses[0]
+
+    def test_conv_auto_pad_same_upper(self):
+        """auto_pad=SAME_UPPER must compute implicit padding (round-1
+        ADVICE: it imported as zero padding)."""
+        rng = np.random.default_rng(7)
+        w = rng.normal(size=(2, 3, 3, 3)).astype(np.float32)  # OIHW
+        model = onnx_model(
+            [onnx_node("Conv", ["x", "w"], ["y"], auto_pad="SAME_UPPER",
+                       kernel_shape=[3, 3])],
+            {"w": w}, {"x": [1, 3, 5, 5]}, ["y"])
+        sd = importOnnx(model)
+        x = rng.normal(size=(1, 3, 5, 5)).astype(np.float32)
+        got = np.asarray(sd.outputSingle({"x": x}, "y").jax())
+        assert got.shape == (1, 2, 5, 5)  # SAME keeps spatial dims
+        # oracle: explicit pad-1 conv
+        import jax
+        expect = np.asarray(jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW")))
+        assert np.allclose(got, expect, atol=1e-5)
+
+    def test_maxpool_auto_pad_same_upper(self):
+        model = onnx_model(
+            [onnx_node("MaxPool", ["x"], ["y"], auto_pad="SAME_UPPER",
+                       kernel_shape=[2, 2], strides=[2, 2])],
+            {}, {"x": [1, 1, 5, 5]}, ["y"])
+        sd = importOnnx(model)
+        x = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+        got = np.asarray(sd.outputSingle({"x": x}, "y").jax())
+        assert got.shape == (1, 1, 3, 3)  # ceil(5/2)
+        # last row/col window covers the (padded) edge: max is the corner
+        assert got[0, 0, 2, 2] == 24.0
+
+    def test_softmax_opset12_flatten_semantics(self):
+        """opset <13 Softmax: default axis=1, coerce-to-2D (softmax over
+        ALL trailing dims together) — not per-last-axis."""
+        model = onnx_model(
+            [onnx_node("Softmax", ["x"], ["y"])],
+            {}, {"x": [2, 3, 4]}, ["y"], opset=12)
+        sd = importOnnx(model)
+        rng = np.random.default_rng(9)
+        x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+        got = np.asarray(sd.outputSingle({"x": x}, "y").jax())
+        flat = x.reshape(2, 12)
+        e = np.exp(flat - flat.max(-1, keepdims=True))
+        expect = (e / e.sum(-1, keepdims=True)).reshape(2, 3, 4)
+        assert np.allclose(got, expect, atol=1e-5)
+        # each example sums to 1 over ALL trailing elements
+        assert np.allclose(got.reshape(2, -1).sum(-1), 1.0, atol=1e-5)
+
+    def test_softmax_opset13_last_axis(self):
+        model = onnx_model(
+            [onnx_node("Softmax", ["x"], ["y"])],
+            {}, {"x": [2, 3, 4]}, ["y"], opset=13)
+        sd = importOnnx(model)
+        rng = np.random.default_rng(10)
+        x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+        got = np.asarray(sd.outputSingle({"x": x}, "y").jax())
+        assert np.allclose(got.sum(-1), 1.0, atol=1e-5)

@@ -70,6 +70,28 @@ def test_parallel_wrapper_matches_single_device(devices8):
                                rtol=2e-4, atol=2e-5)
 
 
+def test_parallel_wrapper_ragged_batch_matches_single_device(devices8):
+    """batch % n_devices != 0: padded rows must be zero-weighted so the
+    final ragged batch produces IDENTICAL gradients to single-device
+    training (round-1 VERDICT: repeat-padding biased them)."""
+    x, y = _data(60)  # 60 % 8 != 0 on the final 28-row batch
+    it = ArrayDataSetIterator(x, y, batch_size=32)
+
+    single = _mlp(seed=3)
+    for _ in range(2):
+        it.reset()
+        for ds in it:
+            single.fit(ds)
+
+    parallel_net = _mlp(seed=3)
+    pw = ParallelWrapper.Builder(parallel_net).workers(8).build()
+    pw.fit(it, epochs=2)
+
+    np.testing.assert_allclose(single.params().numpy(),
+                               parallel_net.params().numpy(),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_sharded_trainer_dp_tp(devices8):
     """dp×tp mesh: params sharded over tp, batch over dp; loss decreases."""
     mesh = DeviceMesh(devices8, dp=2, tp=4).mesh

@@ -142,3 +142,52 @@ def test_tbptt_fit_runs():
     net.fit(DataSet(x, y))
     assert net.score() is not None
     assert net.getIterationCount() == 1
+
+
+def test_tbptt_equals_full_bptt_short_seq():
+    """Sequences no longer than tBPTTLength must train EXACTLY like
+    standard BPTT (truncation is a no-op; round-1 VERDICT 🟡)."""
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal((4, 6, 5)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (4, 6))]
+
+    std = MultiLayerNetwork(_rnn_conf(
+        LSTM.Builder().nOut(6).build(), seed=42)).init()
+    tb = MultiLayerNetwork(_rnn_conf(
+        LSTM.Builder().nOut(6).build(), seed=42,
+        backpropType=BackpropType.TruncatedBPTT, tBPTTLength=6)).init()
+
+    for _ in range(3):
+        std.fit(DataSet(x, y))
+        tb.fit(DataSet(x, y))
+
+    np.testing.assert_allclose(std.params().numpy(), tb.params().numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_tbptt_threads_hidden_state_across_segments():
+    """The tBPTT step must carry LSTM hidden state between segments (not
+    restart from zeros) while truncating gradients at the boundary."""
+    conf = _rnn_conf(LSTM.Builder().nOut(6).build(),
+                     backpropType=BackpropType.TruncatedBPTT, tBPTTLength=4)
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((2, 4, 5)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (2, 4))]
+    import jax
+
+    zero = net._zero_carries(2)
+    # a nonzero carry (as produced by a previous segment) must change the
+    # segment's loss — proves state threads through the tbptt step
+    _, _, _, carry_out, loss_zero = net._train_step_tbptt(
+        net._params, net._opt_state, net._state, zero, x, y, None, None,
+        jax.random.PRNGKey(0))
+    net2 = MultiLayerNetwork(conf).init()
+    _, _, _, _, loss_carried = net2._train_step_tbptt(
+        net2._params, net2._opt_state, net2._state, carry_out, x, y, None,
+        None, jax.random.PRNGKey(0))
+    assert not np.isclose(float(loss_zero), float(loss_carried)), \
+        "carried state had no effect — segments are not threaded"
+    # and the carry itself is not zeros
+    leaves = jax.tree_util.tree_leaves(carry_out)
+    assert any(float(np.abs(np.asarray(l)).max()) > 0 for l in leaves)
