@@ -23,7 +23,9 @@ from deeplearning4j_tpu.nn.conf.graph_vertices import (ElementWiseVertex,
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
                                                BatchNormalization,
-                                               ConvolutionLayer, DenseLayer,
+                                               ConvolutionLayer, Cropping2D,
+                                               DenseLayer,
+                                               DepthwiseConvolution2D,
                                                DropoutLayer, EmbeddingLayer,
                                                GlobalPoolingLayer,
                                                OutputLayer,
@@ -158,6 +160,28 @@ def _convert_layer(class_name, cfg, is_last=False):
                     weightInit=init)
     if class_name == "SimpleRNN":
         return SimpleRnn(nOut=cfg["units"], activation=act, weightInit=init)
+    if class_name == "DepthwiseConv2D":
+        # keras spells the initializer 'depthwise_initializer' here
+        dw_init = _map_init(cfg.get("depthwise_initializer")
+                            or cfg.get("kernel_initializer"))
+        return DepthwiseConvolution2D(
+            depthMultiplier=int(cfg.get("depth_multiplier", 1)),
+            kernelSize=tuple(cfg["kernel_size"]),
+            stride=tuple(cfg.get("strides", (1, 1))),
+            convolutionMode=cfg.get("padding", "valid"),
+            activation=act, weightInit=dw_init, hasBias=bias)
+    if class_name == "Cropping2D":
+        return Cropping2D(cropping=cfg.get("cropping", ((0, 0), (0, 0))))
+    if class_name == "TimeDistributed":
+        # our Dense/Output layers already broadcast over (B, T, F); unwrap
+        # the inner layer (≡ KerasTimeDistributed flattening to the wrapped
+        # layer with RNN format preserved)
+        inner = cfg.get("layer") or {}
+        return _convert_layer(inner.get("class_name"),
+                              inner.get("config", {}), is_last=is_last)
+    if class_name in ("SpatialDropout2D", "SpatialDropout1D"):
+        # per-element dropout parity approximation; rate semantics match
+        return DropoutLayer(dropOut=1.0 - float(cfg.get("rate", 0.5)))
     if class_name in ("Flatten", "Reshape", "InputLayer"):
         return None  # shape plumbing — the builder's InputType inference
     raise InvalidKerasConfigurationException(
@@ -236,10 +260,11 @@ class KerasModelImport:
                 continue
             is_output = any(name == (o[0] if isinstance(o, list) else o)
                             for o in _output_names(cfg))
-            if cls in ("Add", "Subtract", "Multiply", "Average", "Maximum"):
+            if cls in ("Add", "Subtract", "Multiply", "Average", "Maximum",
+                       "Minimum"):
                 op = {"Add": "add", "Subtract": "subtract",
                       "Multiply": "product", "Average": "average",
-                      "Maximum": "max"}[cls]
+                      "Maximum": "max", "Minimum": "min"}[cls]
                 g.addVertex(name, ElementWiseVertex(op), *inbound)
                 continue
             if cls == "Concatenate":
@@ -345,9 +370,31 @@ _KERAS_WEIGHT_NAMES = {
     "beta": ("beta", None),
     "moving_mean": (None, "mean"),
     "moving_variance": (None, "var"),
-    "depthwise_kernel": ("dW", None),
+    # depthwise_kernel resolves per-layer: SeparableConv stores it as
+    # 'dW', DepthwiseConvolution2D as its main 'W' — see
+    # _resolve_depthwise below
     "pointwise_kernel": ("pW", None),
 }
+
+
+def _resolve_depthwise(layer_params, arr):
+    """(key, reshaped array) for a Keras depthwise_kernel, or (None, arr).
+
+    Keras lays the kernel out (kh, kw, C, M); ours is grouped-conv HWIO
+    (kh, kw, 1, C*M) — a row-major reshape of the last two dims maps
+    channel c / multiplier m to output feature c*M + m exactly."""
+    key = "dW" if "dW" in layer_params else (
+        "W" if "W" in layer_params else None)
+    if key is None:
+        return None, arr
+    target = tuple(layer_params[key].shape)
+    if tuple(arr.shape) == target:
+        return key, arr
+    if arr.ndim == 4 and target[2] == 1 \
+            and arr.shape[:2] == target[:2] \
+            and arr.shape[2] * arr.shape[3] == target[3]:
+        return key, arr.reshape(target)
+    return None, arr
 
 
 def _remap_lstm_gates(arr):
@@ -375,7 +422,11 @@ def _assign_keras_weights(layer_params, arrs, layer_state=None):
     used_p, used_s = set(), set()
     leftovers = []
     for name, arr in arrs:
-        pkey, skey = _KERAS_WEIGHT_NAMES.get(name, (None, None))
+        if name == "depthwise_kernel":
+            pkey, arr = _resolve_depthwise(layer_params, arr)
+            skey = None
+        else:
+            pkey, skey = _KERAS_WEIGHT_NAMES.get(name, (None, None))
         if pkey is not None and pkey in layer_params \
                 and tuple(layer_params[pkey].shape) == tuple(arr.shape):
             if is_lstm and pkey in ("W", "U", "b") and arr.shape[-1] % 4 == 0:
@@ -407,7 +458,7 @@ def _assign_keras_weights(layer_params, arrs, layer_state=None):
 
 def _load_h5_weights_multilayer(net, weights_path):
     by_name = _h5_layer_weights(weights_path)
-    named = [lyr for lyr in net.conf.layers if getattr(lyr, "name", None)]
+    loaded = 0
     for li, lyr in enumerate(net.conf.layers):
         name = getattr(lyr, "name", None)
         if name in by_name and str(li) in net._params:
@@ -421,12 +472,15 @@ def _load_h5_weights_multilayer(net, weights_path):
             if state:
                 net._state[str(li)] = {k: jnp.asarray(v)
                                        for k, v in state.items()}
+            loaded += 1
+    net._h5_layers_loaded = loaded  # callers needing strictness check this
     return net
 
 
 def _load_h5_weights_graph(net, weights_path):
     by_name = _h5_layer_weights(weights_path)
     import jax.numpy as jnp
+    loaded = 0
     for name, arrs in by_name.items():
         if name in net._params:
             params = {k: np.array(v) for k, v in net._params[name].items()}
@@ -437,4 +491,6 @@ def _load_h5_weights_graph(net, weights_path):
             if state:
                 net._state[name] = {k: jnp.asarray(v)
                                     for k, v in state.items()}
+            loaded += 1
+    net._h5_layers_loaded = loaded
     return net

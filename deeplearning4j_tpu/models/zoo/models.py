@@ -52,14 +52,60 @@ class ZooModel:
             return ComputationGraph(conf).init()
         return MultiLayerNetwork(conf).init()
 
-    def initPretrained(self, *_, **__):
-        raise RuntimeError(
-            "Pretrained weights unavailable: this environment has no network "
-            "egress. Train from scratch or load a local checkpoint via "
-            "ModelSerializer.restoreModel.")
+    #: directory scanned for local pretrained checkpoints
+    #: (`<modelname>_<dataset>.zip` — ModelSerializer layout — or
+    #: `<modelname>_<dataset>.h5` — Keras weights)
+    PRETRAINED_DIR_ENV = "DL4J_TPU_PRETRAINED_DIR"
 
-    def pretrainedAvailable(self, *_):
-        return False
+    def _pretrained_path(self, dataset):
+        import os
+        d = os.environ.get(self.PRETRAINED_DIR_ENV, "")
+        if not d:
+            return None
+        name = type(self).__name__.lower()
+        for ext in (".zip", ".h5"):
+            p = os.path.join(d, f"{name}_{str(dataset).lower()}{ext}")
+            if os.path.exists(p):
+                return p
+        return None
+
+    def initPretrained(self, dataset="imagenet", path=None):
+        """Initialize with REAL trained weights from a LOCAL checkpoint
+        (≡ ZooModel.initPretrained; the reference downloads from its zoo
+        bucket — this environment has no egress, so the file must already
+        exist: pass `path=` or set $DL4J_TPU_PRETRAINED_DIR).
+
+        Supports our ModelSerializer zip (config + params npz: returns the
+        checkpointed network whole, like the reference's restore) and
+        Keras .h5 weight files (name-mapped onto this zoo config's layers;
+        conv kernels are HWIO in both stacks — no layout transpose)."""
+        path = path or self._pretrained_path(dataset)
+        if path is None:
+            raise RuntimeError(
+                f"No local pretrained checkpoint for "
+                f"{type(self).__name__}/{dataset}: pass path= or put "
+                f"<model>_<dataset>.zip/.h5 under "
+                f"${self.PRETRAINED_DIR_ENV} (no network egress).")
+        if str(path).endswith(".h5"):
+            net = self.init()
+            from deeplearning4j_tpu.keras_import.keras_import import (
+                _load_h5_weights_graph, _load_h5_weights_multilayer)
+            if isinstance(net, ComputationGraph):
+                net = _load_h5_weights_graph(net, path)
+            else:
+                net = _load_h5_weights_multilayer(net, path)
+            if getattr(net, "_h5_layers_loaded", 0) == 0:
+                raise RuntimeError(
+                    f"{path}: no layer names in the .h5 match this "
+                    f"{type(self).__name__} config — refusing to return a "
+                    f"random-init network as 'pretrained'. (Our layers are "
+                    f"named layer0..layerN unless set explicitly.)")
+            return net
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+        return ModelSerializer.restoreModel(path)
+
+    def pretrainedAvailable(self, dataset="imagenet"):
+        return self._pretrained_path(dataset) is not None
 
 
 class LeNet(ZooModel):

@@ -7,7 +7,8 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf import layers
 from deeplearning4j_tpu.nn.conf.layers import (
     ActivationLayer, BatchNormalization, Convolution1DLayer, ConvolutionLayer,
-    DenseLayer, DropoutLayer, EmbeddingLayer, EmbeddingSequenceLayer,
+    Cropping2D, DenseLayer, DepthwiseConvolution2D, DropoutLayer,
+    EmbeddingLayer, EmbeddingSequenceLayer,
     GlobalPoolingLayer, LossLayer, OutputLayer, PReLULayer,
     SeparableConvolution2D, Subsampling1DLayer, SubsamplingLayer,
     Upsampling2D, ZeroPaddingLayer)
@@ -31,7 +32,8 @@ __all__ = [
     "Activation", "BackpropType", "NeuralNetConfiguration",
     "MultiLayerConfiguration", "WorkspaceMode", "InputType", "layers",
     "ActivationLayer", "BatchNormalization", "Convolution1DLayer",
-    "ConvolutionLayer", "DenseLayer", "DropoutLayer", "EmbeddingLayer",
+    "ConvolutionLayer", "Cropping2D", "DenseLayer",
+    "DepthwiseConvolution2D", "DropoutLayer", "EmbeddingLayer",
     "EmbeddingSequenceLayer", "GlobalPoolingLayer", "LossLayer",
     "OutputLayer", "PReLULayer", "SeparableConvolution2D",
     "Subsampling1DLayer", "SubsamplingLayer", "Upsampling2D",

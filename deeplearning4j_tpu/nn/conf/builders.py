@@ -50,6 +50,9 @@ class MultiLayerConfiguration:
         self.tbptt_back_length = tbptt_back_length
         self.data_type = data_type
         self.seed = seed
+        for i, l in enumerate(self.layers):
+            if getattr(l, "name", None) is None:
+                l.name = f"layer{i}"  # addressable default (h5 import etc.)
         self._infer_shapes()
 
     def _infer_shapes(self):

@@ -38,7 +38,8 @@ class MergeVertex(GraphVertex):
 
 
 class ElementWiseVertex(GraphVertex):
-    Add, Subtract, Product, Average, Max = "add", "subtract", "product", "average", "max"
+    Add, Subtract, Product, Average, Max, Min = (
+        "add", "subtract", "product", "average", "max", "min")
 
     def __init__(self, op="add"):
         self.op = str(op).lower()
@@ -69,6 +70,11 @@ class ElementWiseVertex(GraphVertex):
             out = xs[0]
             for x in xs[1:]:
                 out = jnp.maximum(out, x)
+            return out
+        if self.op == "min":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.minimum(out, x)
             return out
         raise ValueError(f"Unknown ElementWiseVertex op {self.op}")
 

@@ -336,6 +336,79 @@ class ConvolutionLayer(Layer):
         return get_activation(self.activation)(self.pre_activation(params, x)), state
 
 
+class DepthwiseConvolution2D(ConvolutionLayer):
+    """≡ conf.layers.DepthwiseConvolution2D — per-channel conv, no
+    cross-channel mixing (feature_group_count = nIn on the MXU path).
+    nOut = nIn * depthMultiplier (fixed by the op; nOut need not be set)."""
+
+    def __init__(self, depthMultiplier=1, **kw):
+        super().__init__(**kw)
+        self.depthMultiplier = int(depthMultiplier)
+
+    def output_type(self, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.channels
+        self.nOut = int(self.nIn) * self.depthMultiplier
+        return super().output_type(input_type)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.channels
+        self.nOut = int(self.nIn) * self.depthMultiplier
+        kh, kw = self.kernelSize
+        w = init_weight(key, (kh, kw, 1, int(self.nOut)),
+                        self.weightInit, self.dist)
+        params = {"W": w}
+        if self.hasBias:
+            params["b"] = jnp.full((int(self.nOut),), float(self.biasInit),
+                                   jnp.float32)
+        return params, {}, self.output_type(input_type)
+
+    def pre_activation(self, params, x):
+        y = lax.conv_general_dilated(
+            x, params["W"].astype(x.dtype),
+            window_strides=self.stride,
+            padding=self._padding_arg(),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=int(self.nIn))
+        if self.hasBias:
+            y = y + params["b"].astype(x.dtype)
+        return y
+
+
+class Cropping2D(Layer):
+    """≡ conf.layers.convolutional.Cropping2D — crop (top, bottom, left,
+    right) off the spatial dims, NHWC."""
+
+    def __init__(self, cropping=(0, 0, 0, 0), **kw):
+        super().__init__(**kw)
+        c = cropping
+        if isinstance(c, int):
+            c = (c, c, c, c)
+        elif len(c) == 2:
+            if isinstance(c[0], (tuple, list)):  # keras ((t,b),(l,r))
+                c = (c[0][0], c[0][1], c[1][0], c[1][1])
+            else:
+                c = (c[0], c[0], c[1], c[1])
+        self.crop = tuple(int(v) for v in c)  # (top, bottom, left, right)
+
+    def output_type(self, input_type):
+        t, b, l, r = self.crop
+        oh = input_type.height - t - b
+        ow = input_type.width - l - r
+        if oh <= 0 or ow <= 0:
+            raise ValueError(
+                f"Cropping2D '{self.name}': crop {self.crop} consumes the "
+                f"whole {input_type.height}x{input_type.width} input")
+        return InputType.convolutional(oh, ow, input_type.channels)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        t, b, l, r = self.crop
+        h, w = x.shape[1], x.shape[2]
+        return x[:, t:h - b, l:w - r, :], state
+
+
 class SeparableConvolution2D(ConvolutionLayer):
     """≡ conf.layers.SeparableConvolution2D — depthwise + pointwise."""
 

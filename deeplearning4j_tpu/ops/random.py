@@ -12,12 +12,20 @@ import jax.numpy as jnp
 
 class RandomState:
     def __init__(self, seed: int = 0):
-        self._key = jax.random.PRNGKey(int(seed))
+        # LAZY key creation: PRNGKey() initializes the XLA backend, and the
+        # module-level Nd4j singleton builds a RandomState at import — an
+        # eager key here breaks jax.distributed.initialize(), which must
+        # run before ANY backend touch (multi-host bring-up).
+        self._seed = int(seed)
+        self._key = None
 
     def setSeed(self, seed: int):
-        self._key = jax.random.PRNGKey(int(seed))
+        self._seed = int(seed)
+        self._key = None
 
     def split(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
         self._key, sub = jax.random.split(self._key)
         return sub
 

@@ -2,8 +2,8 @@
 (≡ deeplearning4j-nn optimize.listeners + deeplearning4j-core earlystopping)."""
 from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
     CheckpointListener, CollectScoresListener, EvaluativeListener,
-    PerformanceListener, ScoreIterationListener, TimeIterationListener,
-    TrainingListener)
+    PerformanceListener, ProfilerListener, ScoreIterationListener,
+    TimeIterationListener, TrainingListener)
 from deeplearning4j_tpu.optimize.early_stopping import (  # noqa: F401
     BestScoreEpochTerminationCondition, ClassificationScoreCalculator,
     DataSetLossCalculator, EarlyStoppingConfiguration,
