@@ -141,6 +141,11 @@ def _attention(cfg, layer, x, attn_mask, train, rng, attn_impl):
         return t.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
 
     q, k, v = heads(q), heads(k), heads(v)
+    if attn_impl == "auto":
+        # TPU default: the Pallas flash kernel (fwd + bwd, O(T) HBM) when
+        # there is no padding mask; dense otherwise / off-TPU
+        attn_impl = ("flash" if attn_mask is None
+                     and jax.default_backend() == "tpu" else "dense")
     if callable(attn_impl):
         ctx = attn_impl(q, k, v)
     elif attn_impl in ("blockwise", "flash"):
@@ -211,7 +216,7 @@ def _encoder_layer(cfg, layer, x, attn_mask, train, rng, attn_impl):
 
 
 def bert_encode(cfg, params, input_ids, token_type_ids=None, attn_mask=None,
-                train=False, rng=None, attn_impl="dense"):
+                train=False, rng=None, attn_impl="auto"):
     """(B, T) int ids -> (B, T, H) hidden states."""
     dt = cfg.compute_dtype
     B, T = input_ids.shape
@@ -246,7 +251,7 @@ def bert_pooled(cfg, params, hidden):
 
 
 def bert_classify(cfg, params, input_ids, token_type_ids=None, attn_mask=None,
-                  train=False, rng=None, attn_impl="dense"):
+                  train=False, rng=None, attn_impl="auto"):
     """Fine-tune head: (B,T) -> (B, num_labels) logits (≡ the reference's
     BERT fine-tune SameDiff graph output)."""
     hidden = bert_encode(cfg, params, input_ids, token_type_ids, attn_mask,
@@ -269,7 +274,7 @@ def bert_mlm_logits(cfg, params, hidden):
 
 
 def classification_loss(cfg, params, batch, train=True, rng=None,
-                        attn_impl="dense"):
+                        attn_impl="auto"):
     logits = bert_classify(cfg, params, batch["input_ids"],
                            batch.get("token_type_ids"),
                            batch.get("attention_mask"), train, rng, attn_impl)

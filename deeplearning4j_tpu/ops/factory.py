@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import jax.ops  # noqa: F401 — segment_* reductions
 import numpy as np
 
 from deeplearning4j_tpu.ops.ndarray import NDArray, as_jax, resolve_dtype
@@ -19,6 +20,12 @@ def _shape(args):
     if len(args) == 1 and isinstance(args[0], (tuple, list)):
         return tuple(args[0])
     return tuple(int(a) for a in args)
+
+
+
+def _num_segments(ids, num_segments):
+    return int(num_segments) if num_segments is not None \
+        else int(jnp.max(ids)) + 1
 
 
 class _Nd:
@@ -144,6 +151,107 @@ class _Nd:
         return NDArray(jnp.diag(as_jax(x)))
 
     # -- transforms op catalog (≡ ops.transforms.Transforms) -------------
+    # -- scatter ops (≡ Nd4j scatter_upd/scatter_add/... via op exec) -----
+    def scatterUpdate(self, ref, indices, updates):
+        """ref[indices[i]] = updates[i] along dim 0; duplicate indices take
+        the LAST update (the reference's scatter_upd ordering — a bare
+        .at[].set() is nondeterministic for duplicates on XLA, so the last
+        occurrence is selected explicitly via segment_max)."""
+        a = as_jax(ref)
+        ids = jnp.asarray(indices)
+        upd = as_jax(updates)
+        n = ids.shape[0]
+        last = jax.ops.segment_max(jnp.arange(n), ids,
+                                   num_segments=a.shape[0])
+        touched = jax.ops.segment_sum(jnp.ones_like(ids), ids,
+                                      num_segments=a.shape[0]) > 0
+        gathered = upd[jnp.clip(last, 0, n - 1)]
+        mask = touched.reshape((-1,) + (1,) * (a.ndim - 1))
+        return NDArray(jnp.where(mask, gathered, a))
+
+    def scatterAdd(self, ref, indices, updates):
+        a = as_jax(ref)
+        return NDArray(a.at[jnp.asarray(indices)].add(as_jax(updates)))
+
+    def scatterSub(self, ref, indices, updates):
+        a = as_jax(ref)
+        return NDArray(a.at[jnp.asarray(indices)].add(-as_jax(updates)))
+
+    def scatterMax(self, ref, indices, updates):
+        a = as_jax(ref)
+        return NDArray(a.at[jnp.asarray(indices)].max(as_jax(updates)))
+
+    def scatterMin(self, ref, indices, updates):
+        a = as_jax(ref)
+        return NDArray(a.at[jnp.asarray(indices)].min(as_jax(updates)))
+
+    # -- segment reductions (≡ nd4j segment_* / unsorted_segment_* ops) ---
+    def segmentSum(self, data, segment_ids, num_segments=None):
+        ids = jnp.asarray(segment_ids)
+        n = _num_segments(ids, num_segments)
+        return NDArray(jax.ops.segment_sum(as_jax(data), ids,
+                                           num_segments=n))
+
+    def unsortedSegmentSum(self, data, segment_ids, num_segments):
+        return self.segmentSum(data, segment_ids, num_segments)
+
+    def segmentMean(self, data, segment_ids, num_segments=None):
+        ids = jnp.asarray(segment_ids)
+        n = _num_segments(ids, num_segments)
+        tot = jax.ops.segment_sum(as_jax(data), ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(ids, jnp.float32), ids,
+                                  num_segments=n)
+        shape = (n,) + (1,) * (tot.ndim - 1)
+        return NDArray(tot / jnp.maximum(cnt, 1.0).reshape(shape))
+
+    def unsortedSegmentMean(self, data, segment_ids, num_segments):
+        return self.segmentMean(data, segment_ids, num_segments)
+
+    def segmentMax(self, data, segment_ids, num_segments=None):
+        ids = jnp.asarray(segment_ids)
+        n = _num_segments(ids, num_segments)
+        return NDArray(jax.ops.segment_max(as_jax(data), ids,
+                                           num_segments=n))
+
+    def unsortedSegmentMax(self, data, segment_ids, num_segments):
+        return self.segmentMax(data, segment_ids, num_segments)
+
+    def segmentMin(self, data, segment_ids, num_segments=None):
+        ids = jnp.asarray(segment_ids)
+        n = _num_segments(ids, num_segments)
+        return NDArray(jax.ops.segment_min(as_jax(data), ids,
+                                           num_segments=n))
+
+    def unsortedSegmentMin(self, data, segment_ids, num_segments):
+        return self.segmentMin(data, segment_ids, num_segments)
+
+    def segmentProd(self, data, segment_ids, num_segments=None):
+        ids = jnp.asarray(segment_ids)
+        n = _num_segments(ids, num_segments)
+        return NDArray(jax.ops.segment_prod(as_jax(data), ids,
+                                            num_segments=n))
+
+    def unsortedSegmentProd(self, data, segment_ids, num_segments):
+        return self.segmentProd(data, segment_ids, num_segments)
+
+    # -- shape utilities --------------------------------------------------
+    def expandDims(self, x, dim):
+        return NDArray(jnp.expand_dims(as_jax(x), int(dim)))
+
+    def squeeze(self, x, dim=None):
+        return NDArray(jnp.squeeze(as_jax(x),
+                                   None if dim is None else int(dim)))
+
+    def meshgrid(self, *xs, indexing="ij"):
+        return [NDArray(g) for g in
+                jnp.meshgrid(*[as_jax(x) for x in xs], indexing=indexing)]
+
+    def triu(self, x, k=0):
+        return NDArray(jnp.triu(as_jax(x), int(k)))
+
+    def tril(self, x, k=0):
+        return NDArray(jnp.tril(as_jax(x), int(k)))
+
     def _unary(self, x, fn):
         return NDArray(fn(as_jax(x)))
 

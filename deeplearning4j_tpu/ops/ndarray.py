@@ -291,6 +291,76 @@ class NDArray:
     def cumprod(self, dim=0):
         return _wrap(jnp.cumprod(self._a, axis=dim))
 
+    # -- absolute-value reductions (≡ INDArray.amax/amin/amean/asum) ------
+    def amax(self, *dims, keepdims=False):
+        return self._reduce(lambda a, axis, keepdims: jnp.max(
+            jnp.abs(a), axis=axis, keepdims=keepdims), dims, keepdims)
+
+    def amin(self, *dims, keepdims=False):
+        return self._reduce(lambda a, axis, keepdims: jnp.min(
+            jnp.abs(a), axis=axis, keepdims=keepdims), dims, keepdims)
+
+    def amean(self, *dims, keepdims=False):
+        return self._reduce(lambda a, axis, keepdims: jnp.mean(
+            jnp.abs(a), axis=axis, keepdims=keepdims), dims, keepdims)
+
+    def asum(self, *dims, keepdims=False):
+        return self._reduce(lambda a, axis, keepdims: jnp.sum(
+            jnp.abs(a), axis=axis, keepdims=keepdims), dims, keepdims)
+
+    # -- entropy reductions (≡ INDArray.entropy/shannonEntropy/logEntropy):
+    # defined over the array as a probability/likelihood surface
+    def entropy(self, *dims):
+        return self._reduce(lambda a, axis, keepdims: -jnp.sum(
+            a * jnp.log(a), axis=axis, keepdims=keepdims), dims)
+
+    def shannonEntropy(self, *dims):
+        return self._reduce(lambda a, axis, keepdims: -jnp.sum(
+            a * jnp.log2(a), axis=axis, keepdims=keepdims), dims)
+
+    def logEntropy(self, *dims):
+        return _wrap(jnp.log(jnp.asarray(self.entropy(*dims))))
+
+    # -- views (≡ INDArray.slice / tensorAlongDimension / repeat / tile) --
+    def slice(self, i, dim=0):
+        """i-th subtensor along `dim` (≡ INDArray.slice)."""
+        return _wrap(jnp.take(self._a, int(i), axis=int(dim)))
+
+    def tensorAlongDimension(self, index, *dims):
+        """The index-th tensor when iterating over all dims NOT in `dims`
+        (≡ INDArray.tensorAlongDimension / TAD). Kept-out dims iterate in
+        C order, matching the reference's TAD enumeration."""
+        dims = sorted(d % self._a.ndim for d in dims)
+        iter_dims = [d for d in range(self._a.ndim) if d not in dims]
+        # move iteration dims to the front, flatten, index
+        perm = iter_dims + dims
+        moved = jnp.transpose(self._a, perm)
+        lead = 1
+        for d in iter_dims:
+            lead *= self._a.shape[d]
+        moved = moved.reshape((lead,) + tuple(self._a.shape[d] for d in dims))
+        return _wrap(moved[int(index)])
+
+    def tensorsAlongDimension(self, *dims):
+        """Count of TADs for `dims` (≡ INDArray.tensorsAlongDimension)."""
+        dims = {d % self._a.ndim for d in dims}
+        n = 1
+        for d in range(self._a.ndim):
+            if d not in dims:
+                n *= self._a.shape[d]
+        return n
+
+    def repeat(self, dim, repeats):
+        """≡ INDArray.repeat(dimension, repeatTimes) — dimension FIRST,
+        matching the reference signature."""
+        return _wrap(jnp.repeat(self._a, int(repeats), axis=int(dim)))
+
+    def tile(self, *reps):
+        return _wrap(jnp.tile(self._a, reps))
+
+    def diag(self):
+        return _wrap(jnp.diag(self._a))
+
     # -- comparisons -----------------------------------------------------
     def gt(self, other):
         return self._binary(other, jnp.greater)

@@ -77,6 +77,17 @@ class Transforms:
     min = staticmethod(nd.minimum)
     clip = staticmethod(nd.clip)
 
+    atan2 = staticmethod(_b(jnp.arctan2))
+    floorDiv = staticmethod(_b(jnp.floor_divide))
+    floorMod = staticmethod(_b(jnp.mod))       # sign follows divisor
+    fmod = staticmethod(_b(jnp.fmod))          # sign follows dividend
+
+    # boolean ops (≡ Transforms.and/or/xor/not over condition arrays)
+    and_ = staticmethod(_b(jnp.logical_and))
+    or_ = staticmethod(_b(jnp.logical_or))
+    xor = staticmethod(_b(jnp.logical_xor))
+    not_ = staticmethod(_u(jnp.logical_not))
+
     @staticmethod
     def unitVec(x):
         a = as_jax(x)

@@ -146,3 +146,21 @@ def test_ring_attention_impl_matches_dense(tiny, devices8):
                                  out_specs=spec, check_vma=False)
     got = np.asarray(bert_encode(cfg, params, ids, attn_impl=ring_sharded))
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+
+
+def test_attn_impl_auto_and_flash_match_dense():
+    """'auto' (the new default) must resolve safely on any backend, and the
+    Pallas flash path (interpret off-TPU) must equal dense numerics."""
+    import jax
+    from deeplearning4j_tpu.models.bert import (bert_tiny, bert_encode,
+                                                init_bert_params)
+    cfg = bert_tiny()
+    params = init_bert_params(cfg, jax.random.PRNGKey(0))
+    ids = np.array([[1, 2, 3, 4, 5, 6, 7, 8]], np.int32)
+    h_auto = bert_encode(cfg, params, ids, attn_impl="auto")
+    h_dense = bert_encode(cfg, params, ids, attn_impl="dense")
+    h_flash = bert_encode(cfg, params, ids, attn_impl="flash")
+    np.testing.assert_allclose(np.asarray(h_auto), np.asarray(h_dense),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(h_flash), np.asarray(h_dense),
+                               atol=2e-5, rtol=2e-5)

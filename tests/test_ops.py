@@ -3,7 +3,7 @@
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.ops import NDArray, nd
+from deeplearning4j_tpu.ops import NDArray, Transforms, nd
 
 
 def test_create_and_shape():
@@ -227,3 +227,124 @@ class TestTransformsCatalog:
         y = np.asarray(BooleanIndexing.applyWhere(
             x, Conditions.lessThan(0), lambda a: a * -1))
         assert np.allclose(y, [2.0, 3.0])
+
+
+class TestOpCatalogRound2:
+    """Round-2 op-catalog additions vs numpy oracles (OPS_PARITY.md)."""
+
+    def test_scatter_ops(self):
+        ref = np.zeros((5, 3), np.float32)
+        idx = np.array([0, 2, 2, 4])
+        upd = np.arange(12, dtype=np.float32).reshape(4, 3)
+        got = nd.scatterAdd(ref, idx, upd).numpy()
+        want = ref.copy()
+        np.add.at(want, idx, upd)
+        np.testing.assert_allclose(got, want)
+        # update: last write wins on duplicate index
+        got_u = nd.scatterUpdate(ref, idx, upd).numpy()
+        assert np.allclose(got_u[4], upd[3]) and np.allclose(got_u[0], upd[0])
+        # duplicate index 2: LAST update wins, deterministically
+        assert np.allclose(got_u[2], upd[2])
+        assert np.allclose(got_u[1], 0.0) and np.allclose(got_u[3], 0.0)
+        # max / min / sub
+        base = np.ones((5, 3), np.float32)
+        np.testing.assert_allclose(
+            nd.scatterMax(base, idx, upd).numpy()[2], np.maximum(
+                np.maximum(base[2], upd[1]), upd[2]))
+        np.testing.assert_allclose(
+            nd.scatterSub(base, idx, upd).numpy()[0], base[0] - upd[0])
+
+    def test_segment_reductions(self):
+        data = np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], np.float32)
+        ids = np.array([0, 0, 1, 1])
+        np.testing.assert_allclose(nd.segmentSum(data, ids).numpy(),
+                                   [[4., 6.], [12., 14.]])
+        np.testing.assert_allclose(nd.segmentMean(data, ids).numpy(),
+                                   [[2., 3.], [6., 7.]])
+        np.testing.assert_allclose(nd.segmentMax(data, ids).numpy(),
+                                   [[3., 4.], [7., 8.]])
+        np.testing.assert_allclose(nd.segmentMin(data, ids).numpy(),
+                                   [[1., 2.], [5., 6.]])
+        np.testing.assert_allclose(nd.segmentProd(data, ids).numpy(),
+                                   [[3., 8.], [35., 48.]])
+        # unsorted variant with explicit segment count (empty segment 2)
+        u = nd.unsortedSegmentSum(data, np.array([1, 0, 0, 1]), 3).numpy()
+        np.testing.assert_allclose(u, [[8., 10.], [8., 10.], [0., 0.]])
+
+    def test_absolute_reductions(self):
+        x = np.array([[-3., 1.], [2., -4.]], np.float32)
+        a = NDArray(x)
+        assert float(a.amax()) == 4.0
+        assert float(a.amin()) == 1.0
+        assert float(a.amean()) == 2.5
+        assert float(a.asum()) == 10.0
+        np.testing.assert_allclose(a.amax(0).numpy(), [3., 4.])
+
+    def test_entropy(self):
+        p = np.array([0.5, 0.25, 0.25], np.float32)
+        a = NDArray(p)
+        np.testing.assert_allclose(float(a.entropy()),
+                                   -np.sum(p * np.log(p)), rtol=1e-6)
+        np.testing.assert_allclose(float(a.shannonEntropy()), 1.5, rtol=1e-6)
+        np.testing.assert_allclose(float(a.logEntropy()),
+                                   np.log(-np.sum(p * np.log(p))), rtol=1e-6)
+
+    def test_slice_and_tad(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        a = NDArray(x)
+        np.testing.assert_array_equal(a.slice(1).numpy(), x[1])
+        np.testing.assert_array_equal(a.slice(2, 1).numpy(), x[:, 2, :])
+        # TADs along dim 2: iterate (dim0, dim1) in C order
+        assert a.tensorsAlongDimension(2) == 6
+        np.testing.assert_array_equal(a.tensorAlongDimension(0, 2).numpy(),
+                                      x[0, 0, :])
+        np.testing.assert_array_equal(a.tensorAlongDimension(4, 2).numpy(),
+                                      x[1, 1, :])
+        # TADs along (1, 2): matrices per dim-0 index
+        assert a.tensorsAlongDimension(1, 2) == 2
+        np.testing.assert_array_equal(a.tensorAlongDimension(1, 1, 2).numpy(),
+                                      x[1])
+
+    def test_repeat_tile_diag_methods(self):
+        x = np.array([[1., 2.], [3., 4.]], np.float32)
+        a = NDArray(x)
+        # INDArray.repeat(dimension, repeatTimes): dimension first
+        np.testing.assert_array_equal(a.repeat(0, 2).numpy(),
+                                      np.repeat(x, 2, 0))
+        np.testing.assert_array_equal(a.tile(2, 1).numpy(), np.tile(x, (2, 1)))
+        np.testing.assert_array_equal(a.diag().numpy(), np.diag(x))
+
+    def test_shape_utilities(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_array_equal(nd.expandDims(x, 1).numpy().shape,
+                                      (2, 1, 3))
+        np.testing.assert_array_equal(
+            nd.squeeze(nd.expandDims(x, 0), 0).numpy(), x)
+        gx, gy = nd.meshgrid(np.arange(2), np.arange(3))
+        np.testing.assert_array_equal(gx.numpy(),
+                                      np.meshgrid(np.arange(2), np.arange(3),
+                                                  indexing="ij")[0])
+        np.testing.assert_array_equal(nd.triu(np.ones((3, 3))).numpy(),
+                                      np.triu(np.ones((3, 3))))
+        np.testing.assert_array_equal(nd.tril(np.ones((3, 3)), -1).numpy(),
+                                      np.tril(np.ones((3, 3)), -1))
+
+    def test_transforms_round2(self):
+        a = np.array([1.0, -1.0], np.float32)
+        b = np.array([1.0, 1.0], np.float32)
+        np.testing.assert_allclose(Transforms.atan2(a, b).numpy(),
+                                   np.arctan2(a, b), rtol=1e-6)
+        x = np.array([7., -7.], np.float32)
+        y = np.array([3., 3.], np.float32)
+        np.testing.assert_allclose(Transforms.floorDiv(x, y).numpy(),
+                                   np.floor_divide(x, y))
+        np.testing.assert_allclose(Transforms.floorMod(x, y).numpy(),
+                                   np.mod(x, y))
+        np.testing.assert_allclose(Transforms.fmod(x, y).numpy(),
+                                   np.fmod(x, y))
+        t = np.array([True, True, False, False])
+        u = np.array([True, False, True, False])
+        np.testing.assert_array_equal(Transforms.and_(t, u).numpy(), t & u)
+        np.testing.assert_array_equal(Transforms.or_(t, u).numpy(), t | u)
+        np.testing.assert_array_equal(Transforms.xor(t, u).numpy(), t ^ u)
+        np.testing.assert_array_equal(Transforms.not_(t).numpy(), ~t)
