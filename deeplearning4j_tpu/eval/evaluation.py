@@ -207,6 +207,56 @@ class ROCMultiClass:
         return float(np.mean([r.calculateAUC() for r in self._rocs.values()]))
 
 
+class EvaluationCalibration:
+    """≡ evaluation.calibration.EvaluationCalibration — reliability
+    diagrams + prediction-probability histograms per class."""
+
+    def __init__(self, reliabilityDiagNumBins=10, histogramNumBins=10):
+        self.n_bins = int(reliabilityDiagNumBins)
+        self.hist_bins = int(histogramNumBins)
+        self._labels = []
+        self._preds = []
+
+    def eval(self, labels, predictions, mask=None):
+        labels, predictions = _to2d(labels, predictions, mask)
+        self._labels.append(np.asarray(labels))
+        self._preds.append(np.asarray(predictions))
+
+    def _cls(self, classIdx):
+        labels = np.concatenate(self._labels)[:, classIdx]
+        preds = np.concatenate(self._preds)[:, classIdx]
+        return labels >= 0.5, preds
+
+    def getReliabilityDiagram(self, classIdx):
+        """(mean predicted prob per bin, observed fraction positive per
+        bin, counts per bin) over equal-width probability bins — points on
+        the diagonal = perfectly calibrated."""
+        y, p = self._cls(classIdx)
+        bins = np.clip((p * self.n_bins).astype(int), 0, self.n_bins - 1)
+        mean_pred = np.zeros(self.n_bins)
+        frac_pos = np.zeros(self.n_bins)
+        counts = np.zeros(self.n_bins, dtype=np.int64)
+        for b in range(self.n_bins):
+            sel = bins == b
+            counts[b] = sel.sum()
+            if counts[b]:
+                mean_pred[b] = p[sel].mean()
+                frac_pos[b] = y[sel].mean()
+        return mean_pred, frac_pos, counts
+
+    def getProbabilityHistogram(self, classIdx):
+        """Histogram of predicted probabilities for the class."""
+        _, p = self._cls(classIdx)
+        counts, edges = np.histogram(p, bins=self.hist_bins,
+                                     range=(0.0, 1.0))
+        return counts, edges
+
+    def expectedCalibrationError(self, classIdx):
+        mean_pred, frac_pos, counts = self.getReliabilityDiagram(classIdx)
+        total = max(1, counts.sum())
+        return float(np.sum(counts / total * np.abs(mean_pred - frac_pos)))
+
+
 class RegressionEvaluation:
     def __init__(self, n_columns=None):
         self._sse = None

@@ -149,7 +149,11 @@ class Layer:
     # -- helpers ---------------------------------------------------------
     def _dropout_in(self, x, train, rng):
         p = self.dropOut
-        if not train or p is None or p == 0.0 or p == 1.0 or rng is None:
+        if not train or p is None or rng is None:
+            return x
+        if hasattr(p, "apply"):  # IDropout object (Gaussian/Alpha variants)
+            return p.apply(x, rng)
+        if p == 0.0 or p == 1.0:
             return x
         keep = jax.random.bernoulli(rng, p, x.shape)
         return jnp.where(keep, x / p, 0.0).astype(x.dtype)
@@ -445,6 +449,163 @@ class SeparableConvolution2D(ConvolutionLayer):
         if self.hasBias:
             y = y + params["b"].astype(x.dtype)
         return y
+
+
+class LocalResponseNormalization(Layer):
+    """≡ conf.layers.LocalResponseNormalization — Krizhevsky-style
+    cross-channel LRN (AlexNet era): y = x / (k + α·Σ_{window} x²)^β over
+    a window of n adjacent channels, NHWC."""
+
+    def __init__(self, k=2.0, n=5, alpha=1e-4, beta=0.75, **kw):
+        super().__init__(**kw)
+        self.k, self.n = float(k), int(n)
+        self.alpha, self.beta = float(alpha), float(beta)
+
+    def output_type(self, input_type):
+        return input_type
+
+    def initialize(self, key, input_type):
+        return {}, {}, self.output_type(input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        sq = jnp.square(x.astype(jnp.float32))
+        half = self.n // 2
+        # sliding channel-window sum of squares: reduce_window over C
+        win = lax.reduce_window(
+            sq, 0.0, lax.add,
+            window_dimensions=(1, 1, 1, self.n),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (0, 0), (0, 0), (half, self.n - 1 - half)))
+        denom = jnp.power(self.k + self.alpha * win, self.beta)
+        return (x.astype(jnp.float32) / denom).astype(x.dtype), state
+
+
+class Deconvolution2D(Layer):
+    """≡ conf.layers.Deconvolution2D — transposed conv (learned
+    upsampling), NHWC/HWIO via lax.conv_transpose."""
+
+    def __init__(self, nIn=None, nOut=None, kernelSize=(2, 2), stride=(2, 2),
+                 padding=(0, 0), convolutionMode="truncate", hasBias=True,
+                 **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut = nIn, nOut
+        self.kernelSize, self.stride = _pair(kernelSize), _pair(stride)
+        self.padding = _pair(padding)
+        self.convolutionMode = convolutionMode
+        self.hasBias = hasBias
+
+    def _padding_arg(self):
+        if str(self.convolutionMode).lower() == "same":
+            return "SAME"
+        ph, pw = self.padding
+        return [(ph, ph), (pw, pw)] if (ph or pw) else "VALID"
+
+    def output_type(self, input_type):
+        if self.nOut is None:
+            raise ValueError(
+                f"Deconvolution2D '{self.name}': nOut is required")
+        if not isinstance(input_type, ConvolutionalType):
+            raise ValueError(
+                f"Deconvolution2D '{self.name}' needs convolutional input, "
+                f"got {input_type}")
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        if str(self.convolutionMode).lower() == "same":
+            oh, ow = input_type.height * sh, input_type.width * sw
+        else:
+            ph, pw = self.padding
+            oh = sh * (input_type.height - 1) + kh - 2 * ph
+            ow = sw * (input_type.width - 1) + kw - 2 * pw
+        return InputType.convolutional(oh, ow, self.nOut)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.channels
+        kh, kw = self.kernelSize
+        w = init_weight(key, (kh, kw, int(self.nIn), int(self.nOut)),
+                        self.weightInit, self.dist)
+        params = {"W": w}
+        if self.hasBias:
+            params["b"] = jnp.full((int(self.nOut),), float(self.biasInit),
+                                   jnp.float32)
+        return params, {}, self.output_type(input_type)
+
+    def pre_activation(self, params, x):
+        y = lax.conv_transpose(
+            x, params["W"].astype(x.dtype),
+            strides=self.stride,
+            padding=self._padding_arg(),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.hasBias:
+            y = y + params["b"].astype(x.dtype)
+        return y
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        return get_activation(self.activation)(
+            self.pre_activation(params, x)), state
+
+
+class RepeatVector(Layer):
+    """≡ conf.layers.misc.RepeatVector — (B, F) -> (B, n, F)."""
+
+    def __init__(self, repetitionFactor=1, **kw):
+        super().__init__(**kw)
+        self.n = int(repetitionFactor)
+
+    def output_type(self, input_type):
+        return InputType.recurrent(input_type.size, self.n)
+
+    def initialize(self, key, input_type):
+        return {}, {}, self.output_type(input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        return jnp.repeat(x[:, None, :], self.n, axis=1), state
+
+
+class ZeroPadding1DLayer(Layer):
+    """≡ conf.layers.ZeroPadding1DLayer — pads the time axis of (B,T,F)."""
+
+    def __init__(self, padding=1, **kw):
+        super().__init__(**kw)
+        p = padding
+        self.pad = (int(p), int(p)) if isinstance(p, int) else \
+            (int(p[0]), int(p[1]))
+
+    def output_type(self, input_type):
+        return InputType.recurrent(
+            input_type.size,
+            None if getattr(input_type, "timeSeriesLength", None) is None
+            else input_type.timeSeriesLength + sum(self.pad))
+
+    def initialize(self, key, input_type):
+        return {}, {}, self.output_type(input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        return jnp.pad(x, ((0, 0), self.pad, (0, 0))), state
+
+
+class Cropping1D(Layer):
+    """≡ conf.layers.convolutional.Cropping1D — crops the time axis."""
+
+    def __init__(self, cropping=1, **kw):
+        super().__init__(**kw)
+        c = cropping
+        self.crop = (int(c), int(c)) if isinstance(c, int) else \
+            (int(c[0]), int(c[1]))
+
+    def output_type(self, input_type):
+        return InputType.recurrent(
+            input_type.size,
+            None if getattr(input_type, "timeSeriesLength", None) is None
+            else input_type.timeSeriesLength - sum(self.crop))
+
+    def initialize(self, key, input_type):
+        return {}, {}, self.output_type(input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        lo, hi = self.crop
+        return x[:, lo:x.shape[1] - hi, :], state
 
 
 class SubsamplingLayer(Layer):

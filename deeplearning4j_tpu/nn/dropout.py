@@ -1,0 +1,79 @@
+"""Dropout variants (≡ org.deeplearning4j.nn.conf.dropout.* :
+Dropout, GaussianDropout, GaussianNoise, AlphaDropout).
+
+A layer's `dropOut` may be the reference's float shorthand (p = RETAIN
+probability, inverted dropout) or one of these objects; either is applied
+to the layer INPUT at train time inside the jitted step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class IDropout:
+    def apply(self, x, rng):
+        raise NotImplementedError
+
+
+class Dropout(IDropout):
+    """p = retain probability (the reference's convention)."""
+
+    def __init__(self, p):
+        self.p = float(p)
+
+    def apply(self, x, rng):
+        if self.p <= 0.0 or self.p >= 1.0:
+            return x
+        keep = jax.random.bernoulli(rng, self.p, x.shape)
+        return jnp.where(keep, x / self.p, 0.0).astype(x.dtype)
+
+
+class GaussianDropout(IDropout):
+    """Multiplicative N(1, sqrt(rate/(1-rate))) noise (≡ GaussianDropout)."""
+
+    def __init__(self, rate):
+        self.rate = float(rate)
+
+    def apply(self, x, rng):
+        if self.rate <= 0.0:
+            return x
+        std = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + std * jax.random.normal(rng, x.shape, jnp.float32)
+        return (x * noise.astype(x.dtype))
+
+
+class GaussianNoise(IDropout):
+    """Additive N(0, stddev) noise (≡ GaussianNoise)."""
+
+    def __init__(self, stddev):
+        self.stddev = float(stddev)
+
+    def apply(self, x, rng):
+        if self.stddev <= 0.0:
+            return x
+        return x + (self.stddev * jax.random.normal(rng, x.shape, jnp.float32)
+                    ).astype(x.dtype)
+
+
+class AlphaDropout(IDropout):
+    """SELU-preserving dropout (≡ AlphaDropout): dropped units take the
+    negative saturation value α′ and the output is affinely rescaled so the
+    self-normalizing mean/variance survive. p = retain probability."""
+
+    _ALPHA = 1.6732632423543772
+    _SCALE = 1.0507009873554805
+
+    def __init__(self, p):
+        self.p = float(p)
+
+    def apply(self, x, rng):
+        p = self.p
+        if p <= 0.0 or p >= 1.0:
+            return x
+        alpha_p = -self._ALPHA * self._SCALE
+        a = (p + alpha_p ** 2 * p * (1.0 - p)) ** -0.5
+        b = -a * alpha_p * (1.0 - p)
+        keep = jax.random.bernoulli(rng, p, x.shape)
+        y = jnp.where(keep, x, jnp.asarray(alpha_p, x.dtype))
+        return (a * y + b).astype(x.dtype)
