@@ -34,11 +34,20 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, l_ref,
-                      m_ref, *, block_k, causal, scale, t_actual):
+def _flash_fwd_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
     """Grid (BH, q_tiles, k_tiles), k innermost: only one (block_k, d) K/V
     tile is VMEM-resident per step; o/l/m accumulate in VMEM scratch across
-    the k dimension and the output tile is written on the last k step."""
+    the k dimension and the output tile is written on the last k step.
+
+    With has_mask, an extra (1, block_k) int32 key-validity tile (from the
+    per-example (B, T) padding mask) masks scores; invalid QUERY rows are
+    handled outside the kernel (outputs zeroed, lse forced to +inf so the
+    backward recompute sees p == 0)."""
+    if has_mask:
+        q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref, acc_ref, l_ref, m_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, l_ref, m_ref = refs
+        km_ref = None
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     block_q = q_ref.shape[1]
@@ -64,6 +73,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, l_ref,
         mask = k_pos < t_actual
         if causal:
             mask &= q_pos >= k_pos
+        if has_mask:
+            mask &= km_ref[...] > 0          # (1, block_k) broadcasts
         s = jnp.where(mask, s, _NEG_INF)
         m_prev, l_prev = m_ref[...], l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -105,8 +116,17 @@ def _block_sizes(t, block_q, block_k):
     return min(block_q, max(t, 8)), min(block_k, max(t, 8))
 
 
-def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
-    """Returns (out (B,H,T,D), lse (B*H, T_padded))."""
+def _prep_mask(mask, block_k):
+    """(B, T) truthy mask → int32 padded to the k tiling (zero padding =
+    invalid keys, matching the padded K/V rows it covers)."""
+    return _pad_to(mask.astype(jnp.int32), 1, block_k)
+
+
+def _flash_forward(q, k, v, mask, causal, block_q, block_k, interpret):
+    """Returns (out (B,H,T,D), lse (B*H, T_padded)). `mask` is an optional
+    (B, T) token-validity mask (self-attention: keys AND queries at False
+    positions are padding) — invalid q rows come back zeroed with
+    lse = +1e30 so the backward kernels recompute p == 0 for them."""
     b, h, t, d = q.shape
     scale = 1.0 / (d ** 0.5)
     if interpret is None:
@@ -118,15 +138,22 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     tq = qp.shape[1]
     grid = (b * h, tq // block_q, kp.shape[1] // block_k)
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
-                               causal=causal, scale=scale, t_actual=t)
+                               causal=causal, scale=scale, t_actual=t,
+                               has_mask=mask is not None)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+    ]
+    operands = [qp, kp, vp]
+    if mask is not None:
+        in_specs.append(
+            pl.BlockSpec((1, block_k), lambda bh, i, j: (bh // h, j)))
+        operands.append(_prep_mask(mask, block_k))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
@@ -143,16 +170,26 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qp, kp, vp)
-    return out[:, :t, :].reshape(b, h, t, d), lse
+    )(*operands)
+    out = out[:, :t, :].reshape(b, h, t, d)
+    if mask is not None:
+        qvalid = mask.astype(bool)                      # (B, T)
+        out = jnp.where(qvalid[:, None, :, None], out, 0)
+        lse_valid = _pad_to(qvalid, 1, block_q)[:, None, :]  # (B, 1, tq)
+        lse = jnp.where(
+            jnp.broadcast_to(lse_valid, (b, h, tq)).reshape(b * h, tq),
+            lse, 1e30)
+    return out, lse
 
 
 # ---------------------------------------------------------------------------
 # backward kernels
 # ---------------------------------------------------------------------------
-def _recompute_p(q_ref, k_ref, lse_ref, qi, kj, block_q, block_k, causal,
-                 scale, t_actual):
-    """exp(S − L) for this (q, k) tile — the fwd tile re-derived in VMEM."""
+def _recompute_p(q_ref, k_ref, lse_ref, km_ref, qi, kj, block_q, block_k,
+                 causal, scale, t_actual):
+    """exp(S − L) for this (q, k) tile — the fwd tile re-derived in VMEM.
+    Invalid q rows carry lse == +1e30 (set by the forward wrapper), so
+    exp(finite − 1e30) underflows to exactly 0 without a q-side mask."""
     qs = q_ref[0].astype(jnp.float32) * scale
     s = jax.lax.dot_general(
         qs, k_ref[0].astype(jnp.float32),
@@ -165,14 +202,20 @@ def _recompute_p(q_ref, k_ref, lse_ref, qi, kj, block_q, block_k, causal,
     mask = k_pos < t_actual
     if causal:
         mask &= q_pos >= k_pos
+    if km_ref is not None:
+        mask &= km_ref[...] > 0
     s = jnp.where(mask, s, _NEG_INF)
     return jnp.exp(s - lse_ref[0][:, None])
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_acc, *, block_k, causal, scale,
-                         t_actual):
+def _flash_bwd_dq_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
     """Grid (BH, q_tiles, k_tiles), k innermost; dq accumulates in VMEM."""
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, km_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs
+        km_ref = None
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     block_q = q_ref.shape[1]
@@ -182,8 +225,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     def _compute():
-        p = _recompute_p(q_ref, k_ref, lse_ref, qi, kj, block_q, block_k,
-                         causal, scale, t_actual)
+        p = _recompute_p(q_ref, k_ref, lse_ref, km_ref, qi, kj, block_q,
+                         block_k, causal, scale, t_actual)
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
             do, v_ref[0].astype(jnp.float32),
@@ -205,10 +248,15 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_k,
-                          causal, scale, t_actual):
+def _flash_bwd_dkv_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
     """Grid (BH, k_tiles, q_tiles), q innermost; dk/dv accumulate in VMEM."""
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, km_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        km_ref = None
     kj = pl.program_id(1)
     qi = pl.program_id(2)
     block_q = q_ref.shape[1]
@@ -219,8 +267,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     def _compute():
-        p = _recompute_p(q_ref, k_ref, lse_ref, qi, kj, block_q, block_k,
-                         causal, scale, t_actual)
+        p = _recompute_p(q_ref, k_ref, lse_ref, km_ref, qi, kj, block_q,
+                         block_k, causal, scale, t_actual)
         do = do_ref[0].astype(jnp.float32)
         dv_acc[...] += jax.lax.dot_general(
             p, do, dimension_numbers=(((0,), (0,)), ((), ())),
@@ -247,12 +295,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+def _flash_backward(q, k, v, mask, o, lse, g, causal, block_q, block_k,
+                    interpret):
     b, h, t, d = q.shape
     scale = 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q, block_k = _block_sizes(t, block_q, block_k)
+    has_mask = mask is not None
 
     # D = rowsum(dO ∘ O) — one fused elementwise pass, O(T·D) traffic
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -270,28 +320,44 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
     k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
     row_spec = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i))
 
+    kmp = _prep_mask(mask, block_k) if has_mask else None
+    operands = [qp, kp, vp, dop, lsep, deltap]
+    in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+    if has_mask:
+        operands.append(kmp)
+        in_specs.append(
+            pl.BlockSpec((1, block_k), lambda bh, i, j: (bh // h, j)))
+
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
-                          causal=causal, scale=scale, t_actual=t),
+                          causal=causal, scale=scale, t_actual=t,
+                          has_mask=has_mask),
         grid=(b * h, tq // block_q, tk // block_k),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(*operands)
 
     # dk/dv: swap the roles — k tiles outer, q tiles innermost
     q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
     k_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
     row_spec2 = pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i))
+    operands2 = [qp, kp, vp, dop, lsep, deltap]
+    in_specs2 = [q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2]
+    if has_mask:
+        operands2.append(kmp)
+        in_specs2.append(
+            pl.BlockSpec((1, block_k), lambda bh, j, i: (bh // h, j)))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_k=block_k,
-                          causal=causal, scale=scale, t_actual=t),
+                          causal=causal, scale=scale, t_actual=t,
+                          has_mask=has_mask),
         grid=(b * h, tk // block_k, tq // block_q),
-        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2],
+        in_specs=in_specs2,
         out_specs=[k_spec2, k_spec2],
         out_shape=[jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, tk, d), v.dtype)],
@@ -300,7 +366,7 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(*operands2)
 
     dq = dq[:, :t, :].reshape(b, h, t, d)
     dk = dk[:, :t, :].reshape(b, h, t, d)
@@ -308,28 +374,53 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention_vjp(q, k, v, mask, causal, block_q, block_k, interpret):
+    out, _ = _flash_forward(q, k, v, mask, causal, block_q, block_k,
+                            interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, mask, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, mask, causal, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, mask, out, lse)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
+    q, k, v, mask, o, lse = res
+    dq, dk, dv = _flash_backward(q, k, v, mask, o, lse, g, causal, block_q,
+                                 block_k, interpret)
+    if mask is None:
+        dmask = None
+    elif jnp.issubdtype(mask.dtype, jnp.inexact):
+        # float masks (e.g. 0/1 float32 from DataSet masks) need a real
+        # zero cotangent — float0 is only valid for int/bool primals
+        dmask = jnp.zeros(mask.shape, mask.dtype)
+    else:
+        import numpy as np
+        dmask = np.zeros(mask.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dmask
+
+
+_flash_attention_vjp.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
 def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
-                    interpret=None):
+                    interpret=None, mask=None):
     """Fused attention: softmax(QKᵀ/√d)·V without materialising (T,T).
 
     Pallas on TPU (interpret-mode elsewhere); differentiable — backward is
     the Pallas dQ / dK-dV kernel pair (flash-attention-2 style recompute
     from the saved logsumexp), O(T) HBM in both directions.
+
+    `mask`: optional (B, T) token-validity mask for padded batches
+    (self-attention semantics: a False position is invalid as both key and
+    query — its keys are excluded from every softmax and its output rows
+    come back as zeros, matching a masked dense attention whose padded
+    rows are zeroed). Gradients flow to q/k/v only at valid positions.
     """
-    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
-    return out
-
-
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
-
-
-def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
-    q, k, v, o, lse = res
-    return _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k,
-                           interpret)
-
-
-flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+    if mask is not None and mask.ndim != 2:
+        raise ValueError(f"mask must be (batch, seq), got {mask.shape}")
+    return _flash_attention_vjp(q, k, v, mask, causal, block_q, block_k,
+                                interpret)

@@ -142,20 +142,20 @@ def _attention(cfg, layer, x, attn_mask, train, rng, attn_impl):
 
     q, k, v = heads(q), heads(k), heads(v)
     if attn_impl == "auto":
-        # TPU default: the Pallas flash kernel (fwd + bwd, O(T) HBM) when
-        # there is no padding mask; dense otherwise / off-TPU
-        attn_impl = ("flash" if attn_mask is None
-                     and jax.default_backend() == "tpu" else "dense")
+        # TPU default: the Pallas flash kernel (fwd + bwd, O(T) HBM) —
+        # padded batches route the (B, T) mask into the kernel's masked
+        # path (per-example key/query validity in VMEM)
+        attn_impl = "flash" if jax.default_backend() == "tpu" else "dense"
     if callable(attn_impl):
         ctx = attn_impl(q, k, v)
     elif attn_impl in ("blockwise", "flash"):
-        if attn_mask is not None:
-            raise ValueError(f"{attn_impl!r} attn_impl has no padding-mask "
-                             "path yet; use dense for masked batches")
         if attn_impl == "flash":
             from deeplearning4j_tpu.kernels import flash_attention
-            ctx = flash_attention(q, k, v)
+            ctx = flash_attention(q, k, v, mask=attn_mask)
         else:
+            if attn_mask is not None:
+                raise ValueError("'blockwise' attn_impl has no padding-mask "
+                                 "path; use flash or dense for masked batches")
             ctx = blockwise_attention(q, k, v, block_size=max(128, T // 4))
     elif attn_impl == "dense":
         mask = None

@@ -1,0 +1,405 @@
+"""First-class attention layers for the config DSL (≡ deeplearning4j-nn ::
+conf.layers.SelfAttentionLayer / LearnedSelfAttentionLayer /
+RecurrentAttentionLayer and conf.graph.AttentionVertex).
+
+The reference builds these on SameDiff dot-product-attention graph ops; the
+TPU-native build routes the scaled-dot-product core through the Pallas
+flash-attention kernel on TPU (O(T) HBM traffic, online softmax in VMEM)
+and a dense XLA einsum path elsewhere / for cross-length attention. All
+four are mask-aware: a (B, T) feature mask excludes padded positions as
+both keys and queries, matching the reference's mask semantics for
+attention layers.
+
+Layout: batch-major (B, T, F) sequences like the rest of the package;
+heads are split/merged around the kernel as (B, H, T, Dh).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.graph_vertices import GraphVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType, RecurrentType
+from deeplearning4j_tpu.nn.conf.layers import Layer
+from deeplearning4j_tpu.nn.weights_init import init_weight
+
+
+def _dense_attention(q, k, v, mask=None, q_mask=None):
+    """softmax(QKᵀ/√d)V over (B, H, T, Dh); mask is key-validity (B, Tk),
+    q_mask query-validity (B, Tq) — invalid query rows come back zeroed
+    (same semantics as the flash kernel's masked path)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / (d ** 0.5)
+    if mask is not None:
+        s = jnp.where(mask[:, None, None, :] > 0, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    if q_mask is not None:
+        o = jnp.where(q_mask[:, None, :, None] > 0, o, 0.0)
+    return o.astype(q.dtype)
+
+
+def _attend(q, k, v, mask=None):
+    """Self-attention core: flash kernel on TPU, dense einsum elsewhere.
+    q/k/v: (B, H, T, Dh); mask: optional (B, T) token validity."""
+    if jax.default_backend() == "tpu" and q.shape == k.shape:
+        from deeplearning4j_tpu.kernels import flash_attention
+        return flash_attention(q, k, v, mask=mask)
+    return _dense_attention(q, k, v, mask=mask, q_mask=mask)
+
+
+def _split_heads(x, n_heads):
+    b, t, f = x.shape
+    return x.reshape(b, t, n_heads, f // n_heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x):
+    y = x.transpose(0, 2, 1, 3)                  # (B, T, H, Dh)
+    return y.reshape(y.shape[0], y.shape[1], -1)
+
+
+class SelfAttentionLayer(Layer):
+    """≡ conf.layers.SelfAttentionLayer — multi-head dot-product
+    self-attention over the sequence: (B, T, nIn) → (B, T, nOut).
+
+    projectInput=True (required when nHeads > 1 or nIn != nOut) adds
+    learned Q/K/V projections plus the output projection Wo; with
+    projectInput=False the raw input is used as queries, keys and values
+    (nHeads must be 1 and nOut == nIn), exactly the reference's contract.
+    """
+
+    is_recurrent_compatible = True
+
+    def __init__(self, nIn=None, nOut=None, nHeads=1, projectInput=True,
+                 **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut = nIn, nOut
+        self.nHeads = int(nHeads)
+        self.projectInput = bool(projectInput)
+
+    def validate(self):
+        super().validate()
+        if not self.projectInput and self.nHeads != 1:
+            raise ValueError(
+                f"{type(self).__name__} '{self.name}': projectInput=False "
+                "requires nHeads == 1")
+
+    def output_type(self, input_type):
+        if not isinstance(input_type, RecurrentType):
+            raise ValueError(
+                f"{type(self).__name__} '{self.name}' needs recurrent "
+                f"(B, T, F) input, got {input_type}")
+        n_out = self.nOut if self.projectInput else input_type.size
+        return InputType.recurrent(n_out, input_type.timeSeriesLength)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.size
+        if not self.projectInput:
+            if self.nOut is not None and int(self.nOut) != int(self.nIn):
+                raise ValueError(
+                    f"{type(self).__name__} '{self.name}': "
+                    "projectInput=False requires nOut == nIn")
+            self.nOut = self.nIn
+            return {}, {}, self.output_type(input_type)
+        n_in, n_out = int(self.nIn), int(self.nOut)
+        if n_out % self.nHeads:
+            raise ValueError(
+                f"{type(self).__name__} '{self.name}': nOut={n_out} not "
+                f"divisible by nHeads={self.nHeads}")
+        ks = jax.random.split(key, 4)
+        params = {
+            "Wq": init_weight(ks[0], (n_in, n_out), self.weightInit, self.dist),
+            "Wk": init_weight(ks[1], (n_in, n_out), self.weightInit, self.dist),
+            "Wv": init_weight(ks[2], (n_in, n_out), self.weightInit, self.dist),
+            "Wo": init_weight(ks[3], (n_out, n_out), self.weightInit,
+                              self.dist),
+        }
+        return params, {}, self.output_type(input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        dt = x.dtype
+        if self.projectInput:
+            q = x @ params["Wq"].astype(dt)
+            k = x @ params["Wk"].astype(dt)
+            v = x @ params["Wv"].astype(dt)
+        else:
+            q = k = v = x
+        o = _attend(_split_heads(q, self.nHeads),
+                    _split_heads(k, self.nHeads),
+                    _split_heads(v, self.nHeads), mask)
+        y = _merge_heads(o)
+        if self.projectInput:
+            y = y @ params["Wo"].astype(dt)
+        if mask is not None:
+            y = jnp.where(mask[:, :, None] > 0, y, 0).astype(dt)
+        return get_activation(self.activation)(y), state
+
+
+class LearnedSelfAttentionLayer(SelfAttentionLayer):
+    """≡ conf.layers.LearnedSelfAttentionLayer — attention with nQueries
+    LEARNED query vectors instead of per-position queries: the sequence is
+    summarised into a fixed-length (B, nQueries, nOut) output regardless of
+    input length (the reference uses it as a trainable sequence pooler)."""
+
+    def __init__(self, nQueries=None, **kw):
+        super().__init__(**kw)
+        self.nQueries = None if nQueries is None else int(nQueries)
+
+    def validate(self):
+        super().validate()  # includes projectInput/nHeads compatibility
+        if not self.nQueries:
+            raise ValueError(
+                f"{type(self).__name__} '{self.name}': nQueries is required")
+
+    def output_type(self, input_type):
+        if not isinstance(input_type, RecurrentType):
+            raise ValueError(
+                f"{type(self).__name__} '{self.name}' needs recurrent "
+                f"(B, T, F) input, got {input_type}")
+        n_out = self.nOut if self.projectInput else input_type.size
+        return InputType.recurrent(n_out, self.nQueries)
+
+    def initialize(self, key, input_type):
+        kq, rest = jax.random.split(key)
+        params, state, out = super().initialize(rest, input_type)
+        q_dim = int(self.nOut) if self.projectInput else int(self.nIn)
+        # learned queries live in the ATTENTION space: with projectInput
+        # they are post-Wq queries directly (the reference learns Q in the
+        # projected space too)
+        params = dict(params)
+        params.pop("Wq", None)
+        params["Q"] = init_weight(kq, (int(self.nQueries), q_dim),
+                                  self.weightInit, self.dist)
+        return params, state, out
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        dt = x.dtype
+        b = x.shape[0]
+        if self.projectInput:
+            k = x @ params["Wk"].astype(dt)
+            v = x @ params["Wv"].astype(dt)
+        else:
+            k = v = x
+        q = jnp.broadcast_to(params["Q"].astype(dt)[None],
+                             (b,) + params["Q"].shape)
+        # learned queries are always valid; mask only gates the keys —
+        # cross-length, so the dense path (Tq = nQueries != Tk in general)
+        o = _dense_attention(_split_heads(q, self.nHeads),
+                             _split_heads(k, self.nHeads),
+                             _split_heads(v, self.nHeads), mask=mask)
+        y = _merge_heads(o)
+        if self.projectInput:
+            y = y @ params["Wo"].astype(dt)
+        return get_activation(self.activation)(y), state
+
+
+class RecurrentAttentionLayer(Layer):
+    """≡ conf.layers.RecurrentAttentionLayer — a recurrent cell whose step
+    input is augmented with attention over the whole input sequence, the
+    attention query being the previous hidden state:
+
+        a_t = MHA(q = h_{t-1}·Wq, K = x·Wk, V = x·Wv)
+        h_t = act(x_t·W + h_{t-1}·R + a_t·Wo + b)
+
+    The unroll is one `lax.scan` (single compiled loop); the x·W and x·Wk /
+    x·Wv projections for ALL timesteps are hoisted out of the scan onto one
+    big MXU matmul each. Masked steps hold the carry and emit zeros, like
+    the package's other recurrent layers."""
+
+    is_recurrent = True
+
+    def __init__(self, nIn=None, nOut=None, nHeads=1, **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut = nIn, nOut
+        self.nHeads = int(nHeads)
+
+    def apply_defaults(self, defaults):
+        super().apply_defaults(defaults)
+        if self.activation == "identity":
+            self.activation = "tanh"
+        return self
+
+    def output_type(self, input_type):
+        if not isinstance(input_type, RecurrentType):
+            raise ValueError(
+                f"{type(self).__name__} '{self.name}' needs recurrent "
+                f"(B, T, F) input, got {input_type}")
+        return InputType.recurrent(self.nOut, input_type.timeSeriesLength)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.size
+        n_in, n_out = int(self.nIn), int(self.nOut)
+        if n_out % self.nHeads:
+            raise ValueError(
+                f"{type(self).__name__} '{self.name}': nOut={n_out} not "
+                f"divisible by nHeads={self.nHeads}")
+        ks = jax.random.split(key, 6)
+        params = {
+            "W": init_weight(ks[0], (n_in, n_out), self.weightInit, self.dist),
+            "R": init_weight(ks[1], (n_out, n_out), self.weightInit,
+                             self.dist),
+            "Wq": init_weight(ks[2], (n_out, n_out), self.weightInit,
+                              self.dist),
+            "Wk": init_weight(ks[3], (n_in, n_out), self.weightInit,
+                              self.dist),
+            "Wv": init_weight(ks[4], (n_in, n_out), self.weightInit,
+                              self.dist),
+            "Wo": init_weight(ks[5], (n_out, n_out), self.weightInit,
+                              self.dist),
+            "b": jnp.zeros((n_out,), jnp.float32),
+        }
+        return params, {}, self.output_type(input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        dt = x.dtype
+        b, t, _ = x.shape
+        n_out = int(self.nOut)
+        h_dim = n_out // self.nHeads
+        scale = 1.0 / (h_dim ** 0.5)
+        act = get_activation(self.activation)
+
+        # hoisted whole-sequence projections (MXU-shaped)
+        xw = x @ params["W"].astype(dt)                      # (B,T,nOut)
+        keys = _split_heads(x @ params["Wk"].astype(dt), self.nHeads)
+        vals = _split_heads(x @ params["Wv"].astype(dt), self.nHeads)
+        kmask = None if mask is None else (mask > 0)         # (B,T)
+
+        R = params["R"].astype(dt)
+        Wq = params["Wq"].astype(dt)
+        Wo = params["Wo"].astype(dt)
+        bias = params["b"].astype(dt)
+
+        def step(h, inputs):
+            xw_t, m_t = inputs                               # (B,nOut), (B,)
+            q = (h @ Wq).reshape(b, self.nHeads, h_dim)
+            s = jnp.einsum("bhd,bhkd->bhk", q, keys).astype(jnp.float32)
+            s = s * scale
+            if kmask is not None:
+                s = jnp.where(kmask[:, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            a = jnp.einsum("bhk,bhkd->bhd", p,
+                           vals.astype(jnp.float32)).astype(dt)
+            a = a.reshape(b, n_out) @ Wo
+            h_new = act(xw_t + h @ R + a + bias)
+            if m_t is not None:
+                keep = m_t[:, None] > 0
+                h_new = jnp.where(keep, h_new, h)
+                out = jnp.where(keep, h_new, 0)
+            else:
+                out = h_new
+            return h_new, out
+
+        h0 = jnp.zeros((b, n_out), dt)
+        xs = jnp.swapaxes(xw, 0, 1)                          # (T,B,nOut)
+        ms = (None if mask is None
+              else jnp.swapaxes(jnp.asarray(mask), 0, 1))    # (T,B)
+        if ms is None:
+            _, ys = jax.lax.scan(lambda h, xt: step(h, (xt, None)), h0, xs)
+        else:
+            _, ys = jax.lax.scan(step, h0, (xs, ms))
+        return jnp.swapaxes(ys, 0, 1), state
+
+
+class AttentionVertex(GraphVertex):
+    """≡ conf.graph.AttentionVertex — parameterized multi-head dot-product
+    attention as a ComputationGraph vertex. Inputs: (queries, keys, values)
+    or (queries, keysAndValues) or a single input (self-attention). All
+    sequences are batch-major (B, T, F); output (B, Tq, nOut).
+
+    Unlike the package's other vertices this one CARRIES PARAMETERS (the
+    reference implements it as a SameDiffVertex for the same reason); the
+    ComputationGraph initializes/threads them exactly like layer params.
+    """
+
+    def __init__(self, nInQueries=None, nInKeys=None, nInValues=None,
+                 nOut=None, nHeads=1, projectInput=True, weightInit="xavier",
+                 name=None):
+        self.nInQueries, self.nInKeys, self.nInValues = (nInQueries, nInKeys,
+                                                         nInValues)
+        self.nOut, self.nHeads = nOut, int(nHeads)
+        self.projectInput = bool(projectInput)
+        self.weightInit = weightInit
+        self.name = name
+        self.updater = None
+
+    def output_type(self, *ts):
+        tq = ts[0]
+        if not isinstance(tq, RecurrentType):
+            raise ValueError(
+                f"AttentionVertex '{self.name}' needs recurrent inputs, "
+                f"got {tq}")
+        n_out = self.nOut if self.projectInput else tq.size
+        return InputType.recurrent(n_out, tq.timeSeriesLength)
+
+    def _resolve_nins(self, ts):
+        tq = ts[0]
+        tk = ts[1] if len(ts) > 1 else tq
+        tv = ts[2] if len(ts) > 2 else tk
+        if self.nInQueries is None:
+            self.nInQueries = tq.size
+        if self.nInKeys is None:
+            self.nInKeys = tk.size
+        if self.nInValues is None:
+            self.nInValues = tv.size
+
+    def initialize(self, key, *ts):
+        """-> (params, state); input types as inferred at build time."""
+        self._resolve_nins(ts)
+        if not self.projectInput:
+            if self.nHeads != 1:
+                raise ValueError(
+                    f"AttentionVertex '{self.name}': projectInput=False "
+                    "requires nHeads == 1")
+            return {}, {}
+        n_out = int(self.nOut)
+        if n_out % self.nHeads:
+            raise ValueError(
+                f"AttentionVertex '{self.name}': nOut={n_out} not divisible "
+                f"by nHeads={self.nHeads}")
+        ks = jax.random.split(key, 4)
+        params = {
+            "Wq": init_weight(ks[0], (int(self.nInQueries), n_out),
+                              self.weightInit, None),
+            "Wk": init_weight(ks[1], (int(self.nInKeys), n_out),
+                              self.weightInit, None),
+            "Wv": init_weight(ks[2], (int(self.nInValues), n_out),
+                              self.weightInit, None),
+            "Wo": init_weight(ks[3], (n_out, n_out), self.weightInit, None),
+        }
+        return params, {}
+
+    def apply(self, *xs, params=None, mask=None):
+        q_in = xs[0]
+        k_in = xs[1] if len(xs) > 1 else q_in
+        v_in = xs[2] if len(xs) > 2 else k_in
+        dt = q_in.dtype
+        params = params or {}
+        if self.projectInput:
+            q = q_in @ params["Wq"].astype(dt)
+            k = k_in @ params["Wk"].astype(dt)
+            v = v_in @ params["Wv"].astype(dt)
+        else:
+            q, k, v = q_in, k_in, v_in
+        self_attn = len(xs) == 1 and q.shape == k.shape
+        qh = _split_heads(q, self.nHeads)
+        kh = _split_heads(k, self.nHeads)
+        vh = _split_heads(v, self.nHeads)
+        if self_attn:
+            o = _attend(qh, kh, vh, mask)
+        else:
+            # cross attention: the feature mask gates the KEY sequence; the
+            # kernel's (B, T) self-mask doesn't apply across lengths
+            kmask = None
+            if mask is not None and mask.shape[1] == k.shape[1]:
+                kmask = mask
+            o = _dense_attention(qh, kh, vh, mask=kmask)
+        y = _merge_heads(o)
+        if self.projectInput:
+            y = y @ params["Wo"].astype(dt)
+        return y

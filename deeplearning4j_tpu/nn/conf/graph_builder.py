@@ -86,6 +86,10 @@ class ComputationGraphConfiguration:
                 continue
             in_types = [self.node_output_types[p] for p in node.inputs]
             if node.kind == "vertex":
+                if hasattr(node.ref, "initialize"):
+                    # parameterized vertex (e.g. AttentionVertex) — keep the
+                    # resolved input types for ComputationGraph.init()
+                    node.resolved_input_types = in_types
                 self.node_output_types[name] = node.ref.output_type(*in_types)
                 continue
             layer = node.ref

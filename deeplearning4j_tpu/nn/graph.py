@@ -53,6 +53,16 @@ class ComputationGraph:
         ps, ss = {}, {}
         for name in self.conf.topo_order:
             node = self.nodes[name]
+            if node.kind == "vertex" and hasattr(node.ref, "initialize"):
+                # parameterized vertex (AttentionVertex): params thread
+                # through the same jitted step as layer params
+                key, sub = jax.random.split(key)
+                p, s = node.ref.initialize(sub, *node.resolved_input_types)
+                if p:
+                    ps[name] = p
+                if s:
+                    ss[name] = s
+                continue
             if node.kind != "layer":
                 continue
             key, sub = jax.random.split(key)
@@ -141,7 +151,11 @@ class ComputationGraph:
                 pmask = mask0
                 if fmasks and getattr(node.ref, "maskName", None):
                     pmask = fmasks.get(node.ref.maskName, mask0)
-                acts[name] = node.ref.apply(*parents, mask=pmask)
+                if hasattr(node.ref, "initialize"):
+                    acts[name] = node.ref.apply(
+                        *parents, params=params.get(name, {}), mask=pmask)
+                else:
+                    acts[name] = node.ref.apply(*parents, mask=pmask)
                 continue
             layer = node.ref
             # frozen layers (transfer learning) always run inference-mode

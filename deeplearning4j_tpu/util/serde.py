@@ -18,6 +18,7 @@ _CONFIG_MODULES = [
     "deeplearning4j_tpu.nn.conf.preprocessors",
     "deeplearning4j_tpu.nn.conf.builders",
     "deeplearning4j_tpu.nn.conf.recurrent",
+    "deeplearning4j_tpu.nn.conf.attention",
     "deeplearning4j_tpu.nn.conf.graph_vertices",
     "deeplearning4j_tpu.nn.updaters",
     "deeplearning4j_tpu.nn.schedules",
