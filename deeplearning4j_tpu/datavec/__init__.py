@@ -1,6 +1,10 @@
 from deeplearning4j_tpu.datavec.image_records import (
     FlipImageTransform, ImageRecordDataSetIterator, ImageRecordReader,
     ParentPathLabelGenerator, PipelineImageTransform, ResizeImageTransform)
+from deeplearning4j_tpu.datavec.sequence import (
+    AnalyzeLocal, CollectionSequenceRecordReader, CSVSequenceRecordReader,
+    DataAnalysis, Join, SequenceRecordReader,
+    SequenceRecordReaderDataSetIterator)
 from deeplearning4j_tpu.datavec.records import (CollectionRecordReader,
                                                 CSVRecordReader,
                                                 LineRecordReader,
@@ -8,7 +12,10 @@ from deeplearning4j_tpu.datavec.records import (CollectionRecordReader,
                                                 RecordReaderDataSetIterator,
                                                 Schema, TransformProcess)
 
-__all__ = ["CollectionRecordReader", "CSVRecordReader", "LineRecordReader",
+__all__ = [
+    "AnalyzeLocal", "CollectionSequenceRecordReader",
+    "CSVSequenceRecordReader", "DataAnalysis", "Join",
+    "SequenceRecordReader", "SequenceRecordReaderDataSetIterator","CollectionRecordReader", "CSVRecordReader", "LineRecordReader",
            "RecordReader", "RecordReaderDataSetIterator", "Schema",
            "TransformProcess", "FlipImageTransform", "ImageRecordDataSetIterator",
            "ImageRecordReader", "ParentPathLabelGenerator",

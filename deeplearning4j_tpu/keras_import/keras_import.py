@@ -98,8 +98,29 @@ def _keras_input_type(batch_shape):
         f"Unsupported input shape: {batch_shape}")
 
 
+#: custom Keras layer converters (≡ KerasLayer.registerCustomLayer): maps a
+#: Keras class_name to a callable (config_dict, is_last) -> Layer — the hook
+#: user-defined SameDiffLayer subclasses ride in on
+_CUSTOM_LAYER_CONVERTERS = {}
+
+
+def registerCustomLayer(class_name, converter):
+    """Register a converter for an unsupported Keras layer type.
+    `converter(cfg: dict, is_last: bool) -> Layer` (typically returning a
+    user SameDiffLayer subclass from nn.conf.samediff_layers)."""
+    if not callable(converter):
+        raise TypeError("converter must be callable: (cfg, is_last) -> Layer")
+    _CUSTOM_LAYER_CONVERTERS[str(class_name)] = converter
+
+
+def clearCustomLayers():
+    _CUSTOM_LAYER_CONVERTERS.clear()
+
+
 def _convert_layer(class_name, cfg, is_last=False):
     """One Keras layer config → our layer instance (or None to skip)."""
+    if class_name in _CUSTOM_LAYER_CONVERTERS:
+        return _CUSTOM_LAYER_CONVERTERS[class_name](cfg, is_last)
     act = _map_activation(cfg.get("activation", "linear"))
     init = _map_init(cfg.get("kernel_initializer"))
     bias = cfg.get("use_bias", True)

@@ -14,8 +14,11 @@ from deeplearning4j_tpu.nlp.paragraph_vectors import (LabelledDocument,
                                                       ParagraphVectors)
 from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.fasttext import FastText, char_ngrams
+from deeplearning4j_tpu.nlp.serializer import (StaticWordVectors,
+                                               WordVectorSerializer)
 
 __all__ = [
+    "WordVectorSerializer", "StaticWordVectors",
     "BasicLineIterator", "CollectionSentenceIterator", "CommonPreprocessor",
     "DefaultTokenizerFactory", "LowCasePreProcessor", "NGramTokenizerFactory",
     "SentenceIterator", "Tokenizer", "TokenizerFactory", "VocabCache",

@@ -133,9 +133,12 @@ class SelfAttentionLayer(Layer):
         y = _merge_heads(o)
         if self.projectInput:
             y = y @ params["Wo"].astype(dt)
+        y = get_activation(self.activation)(y)
         if mask is not None:
+            # mask AFTER the activation so padded rows stay exactly zero
+            # even for non-zero-preserving activations (sigmoid(0) = 0.5)
             y = jnp.where(mask[:, :, None] > 0, y, 0).astype(dt)
-        return get_activation(self.activation)(y), state
+        return y, state
 
 
 class LearnedSelfAttentionLayer(SelfAttentionLayer):
@@ -174,6 +177,10 @@ class LearnedSelfAttentionLayer(SelfAttentionLayer):
         params["Q"] = init_weight(kq, (int(self.nQueries), q_dim),
                                   self.weightInit, self.dist)
         return params, state, out
+
+    def feed_forward_mask(self, mask):
+        # output length is nQueries and every learned query is valid
+        return None
 
     def apply(self, params, state, x, train=False, rng=None, mask=None):
         x = self._dropout_in(x, train, rng)

@@ -73,12 +73,9 @@ class MultiLayerConfiguration:
                 cur = self.preprocessors[i].getOutputType(cur)
             if isinstance(cur, ConvolutionalFlatType):
                 cur = InputType.feedForward(cur.arrayElementsPerExample())
-            # infer nIn
+            # infer nIn (channels for 2D/3D conv types, size otherwise)
             if getattr(layer, "nIn", "na") is None:
-                if isinstance(cur, ConvolutionalType):
-                    layer.nIn = cur.channels
-                else:
-                    layer.nIn = cur.size
+                layer.nIn = getattr(cur, "channels", None) or cur.size
             self.input_types.append(cur)
             cur = layer.output_type(cur)
         self.output_type = cur
@@ -95,6 +92,15 @@ class MultiLayerConfiguration:
         elif isinstance(cur, ConvolutionalType) and isinstance(
                 layer, (L.DenseLayer, L.EmbeddingLayer)) and not isinstance(layer, L.BatchNormalization):
             return CnnToFeedForwardPreProcessor(cur.height, cur.width, cur.channels)
+        else:
+            from deeplearning4j_tpu.nn.conf.inputs import Convolutional3DType
+            if isinstance(cur, Convolutional3DType) and isinstance(
+                    layer, (L.DenseLayer, L.EmbeddingLayer)) and not \
+                    isinstance(layer, L.BatchNormalization):
+                from deeplearning4j_tpu.nn.conf.preprocessors import \
+                    Cnn3DToFeedForwardPreProcessor
+                return Cnn3DToFeedForwardPreProcessor(
+                    cur.depth, cur.height, cur.width, cur.channels)
         return None
 
     # -- serialization (≡ MultiLayerConfiguration.toJson/fromJson) -------

@@ -102,10 +102,7 @@ class ComputationGraphConfiguration:
             if isinstance(cur, ConvolutionalFlatType):
                 cur = InputType.feedForward(cur.arrayElementsPerExample())
             if getattr(layer, "nIn", "na") is None:
-                if isinstance(cur, ConvolutionalType):
-                    layer.nIn = cur.channels
-                else:
-                    layer.nIn = cur.size
+                layer.nIn = getattr(cur, "channels", None) or cur.size
             node.resolved_input_type = cur
             self.node_output_types[name] = layer.output_type(cur)
 

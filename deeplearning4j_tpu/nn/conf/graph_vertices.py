@@ -19,6 +19,12 @@ class GraphVertex:
     def apply(self, *xs, mask=None):
         raise NotImplementedError
 
+    def feed_forward_mask(self, *parent_masks):
+        """Mask seen downstream of this vertex (≡ feedForwardMaskArray):
+        default passes the first non-None parent mask through; vertices
+        that drop or re-key the time axis override."""
+        return next((m for m in parent_masks if m is not None), None)
+
 
 class MergeVertex(GraphVertex):
     """Concat along the feature (last) axis."""
@@ -186,6 +192,9 @@ class LastTimeStepVertex(GraphVertex):
     def __init__(self, maskArrayInputName=None):
         self.maskName = maskArrayInputName
 
+    def feed_forward_mask(self, *parent_masks):
+        return None  # emits a single (feed-forward) step
+
     def output_type(self, *ts):
         return InputType.feedForward(ts[0].size)
 
@@ -217,3 +226,101 @@ class SpaceToDepthVertex(GraphVertex):
         x = x.reshape(n, h // b, b, w // b, b, c)
         return x.transpose(0, 1, 3, 2, 4, 5).reshape(
             n, h // b, w // b, c * b * b)
+
+
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """≡ rnn.DuplicateToTimeSeriesVertex — broadcast a (B, F) feed-forward
+    activation across time: (B, F) + reference (B, T, F') → (B, T, F).
+    The reference names the graph input whose length to copy; here the
+    time-series whose T is duplicated-to is wired as the SECOND parent."""
+
+    def __init__(self, referenceInputName=None):
+        self.referenceInputName = referenceInputName
+
+    def output_type(self, *ts):
+        ff, seq = ts[0], ts[1]
+        return InputType.recurrent(ff.size,
+                                   getattr(seq, "timeSeriesLength", None))
+
+    def feed_forward_mask(self, *parent_masks):
+        # time axis comes from the SECOND (reference sequence) parent
+        return parent_masks[1] if len(parent_masks) > 1 else None
+
+    def apply(self, *xs, mask=None):
+        ff, seq = xs[0], xs[1]
+        t = seq.shape[1]
+        return jnp.broadcast_to(ff[:, None, :], (ff.shape[0], t, ff.shape[1]))
+
+
+class ReverseTimeSeriesVertex(GraphVertex):
+    """≡ rnn.ReverseTimeSeriesVertex — reverse the time axis. Mask-aware:
+    each example reverses within its own valid length L (out[t] = x[L-1-t]
+    for t < L, zeros after), matching the reference's per-example
+    reversal rather than a naive flip that would move padding to the
+    front."""
+
+    def __init__(self, maskArrayInputName=None):
+        self.maskName = maskArrayInputName
+
+    def output_type(self, *ts):
+        return ts[0]
+
+    def apply(self, *xs, mask=None):
+        x = xs[0]
+        t = x.shape[1]
+        if mask is None:
+            return jnp.flip(x, axis=1)
+        lengths = jnp.sum(mask > 0, axis=1).astype(jnp.int32)   # (B,)
+        pos = jnp.arange(t)[None, :]                            # (1, T)
+        src = jnp.clip(lengths[:, None] - 1 - pos, 0, t - 1)    # (B, T)
+        y = jnp.take_along_axis(x, src[:, :, None], axis=1)
+        return jnp.where((pos < lengths[:, None])[:, :, None], y, 0)
+
+
+class L2Vertex(GraphVertex):
+    """≡ conf.graph.L2Vertex — pairwise Euclidean distance between two
+    parents: (B, ...) × (B, ...) → (B, 1) (siamese-network head)."""
+
+    def __init__(self, eps=1e-8):
+        self.eps = float(eps)
+
+    def output_type(self, *ts):
+        return InputType.feedForward(1)
+
+    def feed_forward_mask(self, *parent_masks):
+        return None  # scalar distance per example, no time axis
+
+    def apply(self, *xs, mask=None):
+        a, b = xs[0], xs[1]
+        d = (a - b).reshape(a.shape[0], -1)
+        sq = jnp.sum(d.astype(jnp.float32) ** 2, axis=1, keepdims=True)
+        return jnp.sqrt(sq + self.eps).astype(a.dtype)
+
+
+class FrozenVertex(GraphVertex):
+    """≡ conf.graph.FrozenVertex — wraps any vertex and blocks gradient
+    flow into its parameters (stop_gradient on the params; activations
+    still differentiate through to upstream layers, matching the
+    reference's frozen-during-transfer-learning semantics)."""
+
+    def __init__(self, vertex=None):
+        if vertex is None:
+            raise ValueError("FrozenVertex requires a wrapped vertex")
+        self.inner = vertex
+
+    def output_type(self, *ts):
+        return self.inner.output_type(*ts)
+
+    # parameterized-vertex protocol passthrough (only when inner has params)
+    def __getattr__(self, name):
+        if name == "initialize" and hasattr(self.inner, "initialize"):
+            return self.inner.initialize
+        raise AttributeError(name)
+
+    def apply(self, *xs, params=None, mask=None):
+        import jax
+        if hasattr(self.inner, "initialize"):
+            frozen = jax.tree_util.tree_map(jax.lax.stop_gradient,
+                                            params or {})
+            return self.inner.apply(*xs, params=frozen, mask=mask)
+        return self.inner.apply(*xs, mask=mask)

@@ -23,6 +23,11 @@ class InputType:
         return ConvolutionalFlatType(int(height), int(width), int(channels))
 
     @staticmethod
+    def convolutional3D(depth, height, width, channels):
+        return Convolutional3DType(int(depth), int(height), int(width),
+                                   int(channels))
+
+    @staticmethod
     def recurrent(size, timeSeriesLength=None):
         return RecurrentType(int(size), timeSeriesLength)
 
@@ -65,6 +70,22 @@ class ConvolutionalFlatType(InputType):
 
     def shape(self):
         return (self.height * self.width * self.channels,)
+
+
+@dataclass(frozen=True)
+class Convolutional3DType(InputType):
+    """NDHWC activation: (depth, height, width, channels) — the TPU-native
+    volumetric layout (the reference's Convolution3D is NCDHW)."""
+    depth: int
+    height: int
+    width: int
+    channels: int
+
+    def arrayElementsPerExample(self):
+        return self.depth * self.height * self.width * self.channels
+
+    def shape(self):
+        return (self.depth, self.height, self.width, self.channels)
 
 
 @dataclass(frozen=True)

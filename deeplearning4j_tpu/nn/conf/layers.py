@@ -146,6 +146,12 @@ class Layer:
     def apply(self, params, state, x, train=False, rng=None, mask=None):
         return x, state
 
+    def feed_forward_mask(self, mask):
+        """The feature mask as seen by DOWNSTREAM layers (≡ the reference's
+        feedForwardMaskArray): identity by default; layers that reshape or
+        drop the time axis override (None = everything valid)."""
+        return mask
+
     # -- helpers ---------------------------------------------------------
     def _dropout_in(self, x, train, rng):
         p = self.dropOut
@@ -684,9 +690,9 @@ class BatchNormalization(Layer):
         return input_type
 
     def _nfeat(self, input_type):
-        if isinstance(input_type, ConvolutionalType):
-            return input_type.channels
-        return input_type.size
+        # channel count for 2D/3D conv types (channel-last), size otherwise
+        c = getattr(input_type, "channels", None)
+        return c if c is not None else input_type.size
 
     def initialize(self, key, input_type):
         n = int(self.nOut or self._nfeat(input_type))
@@ -794,6 +800,9 @@ class GlobalPoolingLayer(Layer):
             return InputType.feedForward(input_type.size)
         return input_type
 
+    def feed_forward_mask(self, mask):
+        return None  # pooled output has no time axis
+
     def apply(self, params, state, x, train=False, rng=None, mask=None):
         axes = (1, 2) if x.ndim == 4 else (1,)
         if self.poolingType == "max":
@@ -888,6 +897,19 @@ class Convolution1DLayer(Layer):
         self.kernelSize, self.stride = int(kernelSize), int(stride)
         self.padding, self.dilation = int(padding), int(dilation)
         self.convolutionMode, self.hasBias = convolutionMode, hasBias
+
+    def feed_forward_mask(self, mask):
+        if mask is None or self.stride == 1 and \
+                str(self.convolutionMode).lower() == "same":
+            return mask
+        m = mask[:, ::self.stride]
+        if str(self.convolutionMode).lower() != "same":
+            t = mask.shape[1]
+            out_t = (t + 2 * self.padding
+                     - ((self.kernelSize - 1) * self.dilation + 1)) \
+                // self.stride + 1
+            m = m[:, :out_t]
+        return m
 
     def output_type(self, input_type):
         t = input_type.timeSeriesLength

@@ -1,0 +1,238 @@
+"""3D CNN layer family (≡ deeplearning4j-nn :: conf.layers.Convolution3D /
+Subsampling3DLayer / Upsampling3D / Cropping3D / ZeroPadding3DLayer /
+Cnn3DLossLayer).
+
+TPU-native volumetric convs: NDHWC activations / DHWIO kernels through
+`lax.conv_general_dilated` (the reference is NCDHW + per-kernel CUDA
+dispatch); XLA lowers the 3-D conv onto the MXU by collapsing spatial dims
+into the contraction. Pooling is one fused `lax.reduce_window` over
+(D, H, W)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import Convolutional3DType, InputType
+from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer, Layer
+from deeplearning4j_tpu.nn.weights_init import init_weight
+
+
+def _triple(v):
+    if isinstance(v, (tuple, list)):
+        if len(v) != 3:
+            raise ValueError(f"expected 3 values, got {v}")
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+def _out_dim(size, k, s, p, dilation, same):
+    if same:
+        return -(-size // s)
+    return (size + 2 * p - ((k - 1) * dilation + 1)) // s + 1
+
+
+class Convolution3D(Layer):
+    """≡ conf.layers.Convolution3D — NDHWC in, DHWIO kernel."""
+
+    def __init__(self, nIn=None, nOut=None, kernelSize=(3, 3, 3),
+                 stride=(1, 1, 1), padding=(0, 0, 0), dilation=(1, 1, 1),
+                 convolutionMode="truncate", hasBias=True, **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut = nIn, nOut
+        self.kernelSize, self.stride = _triple(kernelSize), _triple(stride)
+        self.padding, self.dilation = _triple(padding), _triple(dilation)
+        self.convolutionMode = convolutionMode
+        self.hasBias = hasBias
+
+    def _same(self):
+        return str(self.convolutionMode).lower() == "same"
+
+    def _check_input(self, input_type):
+        if not isinstance(input_type, Convolutional3DType):
+            raise ValueError(
+                f"{type(self).__name__} '{self.name}' needs convolutional3D "
+                f"(D,H,W,C) input, got {input_type}")
+
+    def output_type(self, input_type):
+        self._check_input(input_type)
+        if self.nOut is None:
+            raise ValueError(
+                f"{type(self).__name__} '{self.name}': nOut is required")
+        dims = [_out_dim(s, k, st, p, d, self._same()) for s, k, st, p, d in
+                zip(input_type.shape()[:3], self.kernelSize, self.stride,
+                    self.padding, self.dilation)]
+        return InputType.convolutional3D(*dims, self.nOut)
+
+    def initialize(self, key, input_type):
+        self._check_input(input_type)
+        if self.nIn is None:
+            self.nIn = input_type.channels
+        kd, kh, kw = self.kernelSize
+        w = init_weight(key, (kd, kh, kw, int(self.nIn), int(self.nOut)),
+                        self.weightInit, self.dist)
+        params = {"W": w}
+        if self.hasBias:
+            params["b"] = jnp.full((int(self.nOut),), float(self.biasInit),
+                                   jnp.float32)
+        return params, {}, self.output_type(input_type)
+
+    def pre_activation(self, params, x):
+        if self._same():
+            pad = "SAME"
+        else:
+            pad = [(p, p) for p in self.padding]
+        y = lax.conv_general_dilated(
+            x, params["W"].astype(x.dtype),
+            window_strides=self.stride,
+            padding=pad,
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.hasBias:
+            y = y + params["b"].astype(x.dtype)
+        return y
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        return get_activation(self.activation)(self.pre_activation(params, x)), state
+
+
+class Subsampling3DLayer(Layer):
+    """≡ conf.layers.Subsampling3DLayer — max/avg pooling over (D, H, W)."""
+
+    def __init__(self, poolingType="max", kernelSize=(2, 2, 2),
+                 stride=(2, 2, 2), padding=(0, 0, 0),
+                 convolutionMode="truncate", **kw):
+        super().__init__(**kw)
+        self.poolingType = str(poolingType).lower()
+        self.kernelSize, self.stride = _triple(kernelSize), _triple(stride)
+        self.padding = _triple(padding)
+        self.convolutionMode = convolutionMode
+
+    def _same(self):
+        return str(self.convolutionMode).lower() == "same"
+
+    def output_type(self, input_type):
+        if not isinstance(input_type, Convolutional3DType):
+            raise ValueError(
+                f"{type(self).__name__} '{self.name}' needs convolutional3D "
+                f"input, got {input_type}")
+        dims = [_out_dim(s, k, st, p, 1, self._same()) for s, k, st, p in
+                zip(input_type.shape()[:3], self.kernelSize, self.stride,
+                    self.padding)]
+        return InputType.convolutional3D(*dims, input_type.channels)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        kd, kh, kw = self.kernelSize
+        sd, sh, sw = self.stride
+        if self._same():
+            pad = "SAME"
+        else:
+            pad = [(0, 0)] + [(p, p) for p in self.padding] + [(0, 0)]
+        dims, strides = (1, kd, kh, kw, 1), (1, sd, sh, sw, 1)
+        if self.poolingType == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+        elif self.poolingType in ("avg", "mean"):
+            s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+            cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims,
+                                    strides, pad)
+            y = s / cnt
+        else:
+            raise ValueError(f"Unknown poolingType {self.poolingType}")
+        return y, state
+
+
+class Upsampling3D(Layer):
+    """≡ conf.layers.Upsampling3D — nearest-neighbour repeat over D/H/W."""
+
+    def __init__(self, size=2, **kw):
+        super().__init__(**kw)
+        self.size = _triple(size)
+
+    def output_type(self, input_type):
+        if not isinstance(input_type, Convolutional3DType):
+            raise ValueError(
+                f"{type(self).__name__} '{self.name}' needs convolutional3D "
+                f"input, got {input_type}")
+        d, h, w, c = input_type.shape()
+        return InputType.convolutional3D(d * self.size[0], h * self.size[1],
+                                         w * self.size[2], c)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        for axis, rep in zip((1, 2, 3), self.size):
+            x = jnp.repeat(x, rep, axis=axis)
+        return x, state
+
+
+class Cropping3D(Layer):
+    """≡ conf.layers.Cropping3D — crop (front, back) per spatial dim."""
+
+    def __init__(self, cropping=(0, 0, 0, 0, 0, 0), **kw):
+        super().__init__(**kw)
+        c = cropping
+        if isinstance(c, int):
+            c = (c,) * 6
+        elif len(c) == 3 and all(isinstance(v, (tuple, list)) for v in c):
+            c = tuple(int(x) for pair in c for x in pair)
+        elif len(c) == 3:
+            c = tuple(int(v) for v in c for _ in (0, 1))
+        self.cropping = tuple(int(v) for v in c)  # (d0,d1,h0,h1,w0,w1)
+
+    def output_type(self, input_type):
+        if not isinstance(input_type, Convolutional3DType):
+            raise ValueError(
+                f"{type(self).__name__} '{self.name}' needs convolutional3D "
+                f"input, got {input_type}")
+        d0, d1, h0, h1, w0, w1 = self.cropping
+        d, h, w, c = input_type.shape()
+        return InputType.convolutional3D(d - d0 - d1, h - h0 - h1,
+                                         w - w0 - w1, c)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        d0, d1, h0, h1, w0, w1 = self.cropping
+        D, H, W = x.shape[1], x.shape[2], x.shape[3]
+        return x[:, d0:D - d1, h0:H - h1, w0:W - w1, :], state
+
+
+class ZeroPadding3DLayer(Layer):
+    """≡ conf.layers.ZeroPadding3DLayer."""
+
+    def __init__(self, padding=(1, 1, 1, 1, 1, 1), **kw):
+        super().__init__(**kw)
+        p = padding
+        if isinstance(p, int):
+            p = (p,) * 6
+        elif len(p) == 3 and all(isinstance(v, (tuple, list)) for v in p):
+            p = tuple(int(x) for pair in p for x in pair)
+        elif len(p) == 3:
+            p = tuple(int(v) for v in p for _ in (0, 1))
+        self.padding = tuple(int(v) for v in p)
+
+    def output_type(self, input_type):
+        if not isinstance(input_type, Convolutional3DType):
+            raise ValueError(
+                f"{type(self).__name__} '{self.name}' needs convolutional3D "
+                f"input, got {input_type}")
+        d0, d1, h0, h1, w0, w1 = self.padding
+        d, h, w, c = input_type.shape()
+        return InputType.convolutional3D(d + d0 + d1, h + h0 + h1,
+                                         w + w0 + w1, c)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        d0, d1, h0, h1, w0, w1 = self.padding
+        widths = [(0, 0), (d0, d1), (h0, h1), (w0, w1), (0, 0)]
+        return jnp.pad(x, widths), state
+
+
+class Cnn3DLossLayer(BaseOutputLayer):
+    """≡ conf.layers.Cnn3DLossLayer — per-voxel loss over NDHWC output,
+    no parameters (the head conv supplies the channel logits)."""
+
+    def pre_activation(self, params, x):
+        return x
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        return get_activation(self.activation)(x), state
+
+    def output_type(self, input_type):
+        return input_type

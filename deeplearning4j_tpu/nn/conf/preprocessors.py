@@ -82,3 +82,20 @@ class CnnToRnnPreProcessor(InputPreProcessor):
 
     def getOutputType(self, input_type):
         return InputType.recurrent(input_type.arrayElementsPerExample(), 1)
+
+
+class Cnn3DToFeedForwardPreProcessor(InputPreProcessor):
+    """≡ preprocessor.Cnn3DToFeedForwardPreProcessor — flatten NDHWC."""
+
+    def __init__(self, depth=None, height=None, width=None, channels=None):
+        self.depth, self.height = depth, height
+        self.width, self.channels = width, channels
+
+    def preProcess(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def getOutputType(self, input_type):
+        from deeplearning4j_tpu.nn.conf.inputs import Convolutional3DType
+        if isinstance(input_type, Convolutional3DType):
+            return InputType.feedForward(input_type.arrayElementsPerExample())
+        return input_type

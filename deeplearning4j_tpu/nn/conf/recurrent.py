@@ -304,6 +304,9 @@ class LastTimeStep(Layer):
         self.inner.apply_defaults(defaults)
         return self
 
+    def feed_forward_mask(self, mask):
+        return None  # emits a single (feed-forward) step
+
     def output_type(self, input_type):
         inner_out = self.inner.output_type(input_type)
         return InputType.feedForward(inner_out.size)
@@ -321,3 +324,30 @@ class LastTimeStep(Layer):
             idx = jnp.maximum(jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1, 0)
             out = jnp.take_along_axis(y, idx[:, None, None], axis=1)[:, 0, :]
         return out, new_state
+
+
+class GravesBidirectionalLSTM(Bidirectional):
+    """≡ conf.layers.GravesBidirectionalLSTM — a single-layer bidirectional
+    peephole LSTM: independent forward/backward GravesLSTM passes whose
+    activations are combined (the reference sums the directional
+    contributions so the layer's output width stays nOut; pass
+    mode='concat' for the Keras-style 2·nOut concat instead)."""
+
+    def __init__(self, nIn=None, nOut=None, mode="add", **kw):
+        inner = GravesLSTM(nIn=nIn, nOut=nOut,
+                           **{k: v for k, v in kw.items()
+                              if k in ("forgetGateBiasInit",
+                                       "gateActivationFn", "activation",
+                                       "weightInit")})
+        outer_kw = {k: v for k, v in kw.items()
+                    if k not in ("forgetGateBiasInit", "gateActivationFn")}
+        super().__init__(layer=inner, mode=mode, **outer_kw)
+
+    @property
+    def nIn(self):
+        return self.fwd.nIn
+
+    @nIn.setter
+    def nIn(self, v):
+        self.fwd.nIn = v
+        self.bwd.nIn = v

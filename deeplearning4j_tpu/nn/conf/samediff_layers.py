@@ -1,0 +1,172 @@
+"""User-defined custom layers (≡ deeplearning4j-nn ::
+conf.layers.samediff.SameDiffLayer / SameDiffLambdaLayer / SameDiffVertex).
+
+The reference's escape hatch lets users define a layer by writing its
+forward as a SameDiff graph; autodiff + the runtime do the rest. The
+TPU-native counterpart: the user writes the forward as a PURE JAX function
+(jax.numpy / lax — anything jit-traceable) and declares parameter shapes;
+`jax.grad` through the whole-network jitted step differentiates it, so a
+custom layer trains exactly like a built-in one, with zero framework code.
+
+Usage:
+
+    class TimesPlus(SameDiffLayer):
+        def __init__(self, nOut=None, **kw):
+            super().__init__(**kw)
+            self.nOut = nOut
+        def defineParameters(self):
+            return {"W": (self.nIn, self.nOut), "b": (self.nOut,)}
+        def defineLayer(self, params, x, mask=None):
+            return jnp.tanh(x @ params["W"] + params["b"])
+
+    net = ...list().layer(TimesPlus(nOut=8))...
+
+Custom classes serialize through ModelSerializer: the config JSON records
+the defining module, which is imported again on restore (the class must be
+importable — same contract as the reference's Jackson subtype registry).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.graph_vertices import GraphVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType, RecurrentType
+from deeplearning4j_tpu.nn.conf.layers import Layer
+from deeplearning4j_tpu.nn.weights_init import init_weight
+
+
+class SameDiffLayer(Layer):
+    """Base class for user-defined layers (≡ samediff.SameDiffLayer).
+
+    Subclasses implement:
+      - defineParameters() -> {name: shape tuple}  (may be empty)
+      - defineLayer(params, x, mask=None) -> output array
+      - getOutputType(input_type) -> InputType  (optional; defaults to
+        feedForward(nOut) / recurrent(nOut) shape-preserving inference)
+    Optional: initializeParameters(key, name, shape) to override the
+    default weightInit-based initializer for specific parameters.
+    """
+
+    def __init__(self, nIn=None, nOut=None, **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut = nIn, nOut
+
+    # -- user surface ----------------------------------------------------
+    def defineParameters(self):
+        return {}
+
+    def defineLayer(self, params, x, mask=None):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement defineLayer(params, x)")
+
+    def initializeParameters(self, key, name, shape):
+        """Default: weightInit for >=2-D params, zeros for 1-D (biases)."""
+        if len(shape) >= 2:
+            return init_weight(key, shape, self.weightInit, self.dist)
+        return jnp.zeros(shape, jnp.float32)
+
+    def getOutputType(self, input_type):
+        n_out = self.nOut if self.nOut is not None else getattr(
+            input_type, "size", None)
+        if n_out is None:
+            return input_type
+        if isinstance(input_type, RecurrentType):
+            return InputType.recurrent(n_out, input_type.timeSeriesLength)
+        return InputType.feedForward(n_out)
+
+    # -- framework bridge ------------------------------------------------
+    def output_type(self, input_type):
+        return self.getOutputType(input_type)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = getattr(input_type, "size", None) or getattr(
+                input_type, "channels", None)
+        shapes = self.defineParameters()
+        params = {}
+        for name in sorted(shapes):
+            key, sub = jax.random.split(key)
+            params[name] = self.initializeParameters(
+                sub, name, tuple(int(d) for d in shapes[name]))
+        return params, {}, self.output_type(input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        return self.defineLayer(params, x, mask=mask), state
+
+
+class SameDiffLambdaLayer(SameDiffLayer):
+    """Parameter-free custom layer (≡ samediff.SameDiffLambdaLayer).
+
+    Either subclass and override defineLayer(params, x), or pass a plain
+    function: SameDiffLambdaLayer(fn=lambda x: jnp.tanh(x)). A function
+    passed by value cannot be serialized (same as the reference, where
+    lambda layers must be registered classes to round-trip) — subclass for
+    save/load support.
+    """
+
+    def __init__(self, fn=None, **kw):
+        super().__init__(**kw)
+        self._fn = fn
+
+    def defineParameters(self):
+        return {}
+
+    def defineLayer(self, params, x, mask=None):
+        fn = getattr(self, "_fn", None)
+        if fn is None:
+            raise NotImplementedError(
+                f"{type(self).__name__}: override defineLayer() or pass "
+                "fn=... (note fn= does not survive serialization — "
+                "subclass to round-trip)")
+        return fn(x)
+
+
+class SameDiffVertex(GraphVertex):
+    """Multi-input user-defined vertex for ComputationGraph (≡
+    samediff.SameDiffVertex). Carries parameters via the graph's
+    parameterized-vertex plumbing (same as AttentionVertex).
+
+    Subclasses implement:
+      - defineParameters() -> {name: shape}
+      - defineVertex(params, *inputs, mask=None) -> output
+      - getOutputType(*input_types) -> InputType
+    """
+
+    def __init__(self, name=None, weightInit="xavier"):
+        self.name = name
+        self.weightInit = weightInit
+        self.updater = None
+
+    def defineParameters(self):
+        return {}
+
+    def defineVertex(self, params, *inputs, mask=None):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement defineVertex")
+
+    def getOutputType(self, *input_types):
+        return input_types[0]
+
+    def initializeParameters(self, key, name, shape):
+        if len(shape) >= 2:
+            return init_weight(key, shape, self.weightInit, None)
+        return jnp.zeros(shape, jnp.float32)
+
+    # framework bridge (parameterized-vertex protocol)
+    def output_type(self, *ts):
+        self._input_types = ts
+        return self.getOutputType(*ts)
+
+    def initialize(self, key, *ts):
+        shapes = self.defineParameters()
+        params = {}
+        for name in sorted(shapes):
+            key, sub = jax.random.split(key)
+            params[name] = self.initializeParameters(
+                sub, name, tuple(int(d) for d in shapes[name]))
+        return params, {}
+
+    def apply(self, *xs, params=None, mask=None):
+        return self.defineVertex(params or {}, *xs, mask=mask)

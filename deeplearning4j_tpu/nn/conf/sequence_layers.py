@@ -1,0 +1,95 @@
+"""Sequence utility layers (≡ deeplearning4j-nn :: conf.layers.util.MaskLayer
+/ conf.layers.recurrent.MaskZeroLayer / conf.layers.RnnLossLayer).
+
+Mask semantics follow the package convention: feature masks are (B, T)
+with 1 = valid; masked steps emit zeros and recurrent carries hold."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer, Layer
+
+
+class MaskLayer(Layer):
+    """≡ conf.layers.util.MaskLayer — applies the current feature mask to
+    the activations (zeroes padded timesteps), passing everything else
+    through. No parameters."""
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        if mask is not None:
+            x = x * mask.astype(x.dtype)[:, :, None]
+        return x, state
+
+
+class MaskZeroLayer(Layer):
+    """≡ conf.layers.recurrent.MaskZeroLayer — wraps a recurrent layer and
+    DERIVES the time mask from the data itself: a timestep whose every
+    feature equals `maskingValue` is treated as padding (the reference's
+    trick for datasets that encode padding in-band)."""
+
+    is_recurrent = True
+
+    @classmethod
+    def _builder_positional(cls, args):
+        if len(args) == 1:
+            return {"layer": args[0]}
+        if len(args) == 2:
+            return {"layer": args[0], "maskingValue": args[1]}
+        return {}
+
+    def __init__(self, layer=None, maskingValue=0.0, **kw):
+        super().__init__(**kw)
+        if layer is None:
+            raise ValueError("MaskZeroLayer requires a wrapped layer")
+        self.inner = layer
+        self.maskingValue = float(maskingValue)
+
+    def apply_defaults(self, defaults):
+        super().apply_defaults(defaults)
+        self.inner.apply_defaults(defaults)
+        return self
+
+    @property
+    def nOut(self):
+        return self.inner.nOut
+
+    @property
+    def nIn(self):
+        return self.inner.nIn
+
+    @nIn.setter
+    def nIn(self, v):
+        self.inner.nIn = v
+
+    def output_type(self, input_type):
+        return self.inner.output_type(input_type)
+
+    def initialize(self, key, input_type):
+        return self.inner.initialize(key, input_type)
+
+    def _derived_mask(self, x):
+        return jnp.any(x != self.maskingValue, axis=-1).astype(x.dtype)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        derived = self._derived_mask(x)
+        if mask is not None:
+            derived = derived * mask.astype(x.dtype)
+        return self.inner.apply(params, state, x, train=train, rng=rng,
+                                mask=derived)
+
+
+class RnnLossLayer(BaseOutputLayer):
+    """≡ conf.layers.RnnLossLayer — per-timestep loss over (B, T, C) with
+    NO parameters (the previous layer supplies per-step logits); honours
+    label masks exactly like RnnOutputLayer."""
+
+    def pre_activation(self, params, x):
+        return x
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        return get_activation(self.activation)(x), state
+
+    def output_type(self, input_type):
+        return input_type

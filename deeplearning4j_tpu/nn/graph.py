@@ -139,28 +139,33 @@ class ComputationGraph:
         mask0 = None
         if fmasks:
             mask0 = next((m for m in fmasks.values() if m is not None), None)
+        node_masks = {}
         for name, x in inputs.items():
             acts[name] = x.astype(self._compute_dtype)
+            node_masks[name] = (fmasks.get(name, mask0) if fmasks else None)
         li = 0
         for name in self.conf.topo_order:
             node = self.nodes[name]
             if node.kind == "input":
                 continue
             parents = [acts[p] for p in node.inputs]
+            parent_masks = [node_masks.get(p) for p in node.inputs]
             if node.kind == "vertex":
-                pmask = mask0
+                pmask = next((m for m in parent_masks if m is not None), None)
                 if fmasks and getattr(node.ref, "maskName", None):
-                    pmask = fmasks.get(node.ref.maskName, mask0)
+                    pmask = fmasks.get(node.ref.maskName, pmask)
                 if hasattr(node.ref, "initialize"):
                     acts[name] = node.ref.apply(
                         *parents, params=params.get(name, {}), mask=pmask)
                 else:
                     acts[name] = node.ref.apply(*parents, mask=pmask)
+                node_masks[name] = node.ref.feed_forward_mask(*parent_masks)
                 continue
             layer = node.ref
             # frozen layers (transfer learning) always run inference-mode
             ltrain = train and not getattr(layer, "frozen", False)
             x = parents[0]
+            pmask = parent_masks[0]
             if node.preprocessor is not None:
                 x = node.preprocessor.preProcess(x)
             lrng = jax.random.fold_in(rng, li) if rng is not None else None
@@ -172,11 +177,15 @@ class ComputationGraph:
                 preacts[name] = pre
                 from deeplearning4j_tpu.nn.activations import get_activation
                 acts[name] = get_activation(layer.activation)(pre)
+                node_masks[name] = pmask
             else:
-                y, ns = layer.apply(p, s, x, train=ltrain, rng=lrng, mask=mask0)
+                y, ns = layer.apply(p, s, x, train=ltrain, rng=lrng,
+                                    mask=pmask)
                 acts[name] = y
                 if ns:
                     new_state[name] = ns
+                node_masks[name] = (layer.feed_forward_mask(pmask)
+                                    if pmask is not None else None)
         return acts, preacts, new_state
 
     def _as_input_dict(self, inputs):

@@ -181,6 +181,10 @@ class MultiLayerNetwork:
                 x, ns = layer.apply(p, s, x, train=ltrain, rng=lrng, mask=mask)
                 if ns:
                     new_state[str(i)] = ns
+            if mask is not None:
+                # layers that reshape/drop the time axis transform the mask
+                # for everything downstream (≡ feedForwardMaskArray)
+                mask = layer.feed_forward_mask(mask)
             if collect:
                 acts.append(x)
         if carries is not None:

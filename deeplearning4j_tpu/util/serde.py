@@ -19,10 +19,63 @@ _CONFIG_MODULES = [
     "deeplearning4j_tpu.nn.conf.builders",
     "deeplearning4j_tpu.nn.conf.recurrent",
     "deeplearning4j_tpu.nn.conf.attention",
+    "deeplearning4j_tpu.nn.conf.samediff_layers",
+    "deeplearning4j_tpu.nn.conf.layers3d",
+    "deeplearning4j_tpu.nn.conf.sequence_layers",
     "deeplearning4j_tpu.nn.conf.graph_vertices",
     "deeplearning4j_tpu.nn.updaters",
     "deeplearning4j_tpu.nn.schedules",
 ]
+
+
+#: modules explicitly trusted for custom-class restore (beyond ones the
+#: restoring process has ALREADY imported) — see registerCustomModule
+_TRUSTED_CUSTOM_MODULES = set()
+
+
+def registerCustomModule(module_name):
+    """Trust `module_name` for custom-layer restore. Without registration,
+    decode only resolves custom classes from modules the restoring process
+    has already imported — config JSON can never trigger an import (the
+    Jackson-polymorphic-deserialization gadget class the reference's
+    ObjectMapper had to lock down with subtype registration)."""
+    _TRUSTED_CUSTOM_MODULES.add(str(module_name))
+
+
+def _resolve_custom(name, module):
+    """Resolve a user-defined config class recorded with its module path.
+    The module must already be imported (the class was defined somewhere in
+    this process, the normal case) or explicitly trusted via
+    registerCustomModule; the class must be a config-base subclass."""
+    import sys
+    m = sys.modules.get(module)
+    if m is None:
+        if module not in _TRUSTED_CUSTOM_MODULES:
+            raise ValueError(
+                f"Cannot restore custom layer '{name}': its defining module "
+                f"'{module}' is not imported. Import it first (or call "
+                f"util.serde.registerCustomModule({module!r})) — config "
+                "files cannot trigger imports themselves.")
+        try:
+            m = importlib.import_module(module)
+        except ImportError as e:
+            raise ValueError(
+                f"Cannot restore custom layer '{name}': trusted module "
+                f"'{module}' failed to import ({e}).") from e
+    if not hasattr(m, name):
+        raise ValueError(
+            f"Cannot restore custom layer: module '{module}' has no class "
+            f"'{name}'")
+    cls = getattr(m, name)
+    from deeplearning4j_tpu.nn.conf.graph_vertices import GraphVertex
+    from deeplearning4j_tpu.nn.conf.layers import Layer
+    from deeplearning4j_tpu.nn.conf.preprocessors import InputPreProcessor
+    if not (isinstance(cls, type) and issubclass(
+            cls, (Layer, GraphVertex, InputPreProcessor))):
+        raise ValueError(
+            f"Cannot restore '{module}.{name}': custom config classes must "
+            "subclass Layer, GraphVertex or InputPreProcessor")
+    return cls
 
 
 def _resolve(name):
@@ -44,8 +97,14 @@ def encode(obj):
             else [encode(o) for o in obj]
     if isinstance(obj, dict):
         return {"@dict": {str(k): encode(v) for k, v in obj.items()}}
-    # config object: class + public fields
+    # config object: class + public fields; user-defined classes (custom
+    # SameDiffLayer subclasses etc.) also record their defining module so
+    # decode can import it (≡ the reference's Jackson subtype registry —
+    # the class must be importable at restore time)
     d = {"@class": type(obj).__name__}
+    mod = type(obj).__module__
+    if mod not in _CONFIG_MODULES:
+        d["@module"] = mod
     for k, v in obj.__dict__.items():
         # skip functions/methods, but keep callable CONFIG OBJECTS
         # (e.g. LossMCXENT instances) — they encode via @class like any
@@ -67,10 +126,13 @@ def decode(obj):
         if "@dict" in obj:
             return {k: decode(v) for k, v in obj["@dict"].items()}
         if "@class" in obj:
-            cls = _resolve(obj["@class"])
+            if "@module" in obj:
+                cls = _resolve_custom(obj["@class"], obj["@module"])
+            else:
+                cls = _resolve(obj["@class"])
             inst = cls.__new__(cls)
             for k, v in obj.items():
-                if k != "@class":
+                if k not in ("@class", "@module"):
                     # object.__setattr__ so frozen dataclasses (InputType)
                     # decode too
                     object.__setattr__(inst, k, decode(v))

@@ -77,8 +77,8 @@ class TestSelfAttentionLayer:
     def test_trains(self):
         net = _mln(SelfAttentionLayer(nOut=16, nHeads=4))
         x = _seq()
-        y = np.zeros((B, 3, T), np.float32)  # label layout (B, C, T)
-        y[:, 0, :] = 1.0
+        y = np.zeros((B, T, 3), np.float32)  # label layout (B, T, C)
+        y[:, :, 0] = 1.0
         l0 = None
         for i in range(12):
             net.fit(x, y)
@@ -139,8 +139,8 @@ class TestLearnedSelfAttentionLayer:
     def test_trains(self):
         net = _mln(LearnedSelfAttentionLayer(nOut=8, nHeads=2, nQueries=4))
         x = _seq()
-        y = np.zeros((B, 3, 4), np.float32)
-        y[:, 1, :] = 1.0
+        y = np.zeros((B, 4, 3), np.float32)
+        y[:, :, 1] = 1.0
         net.fit(x, y)
         l0 = net.score()
         for _ in range(12):
@@ -170,8 +170,8 @@ class TestRecurrentAttentionLayer:
     def test_trains(self):
         net = _mln(RecurrentAttentionLayer(nOut=8, nHeads=1))
         x = _seq()
-        y = np.zeros((B, 3, T), np.float32)
-        y[:, 2, :] = 1.0
+        y = np.zeros((B, T, 3), np.float32)
+        y[:, :, 2] = 1.0
         net.fit(x, y)
         l0 = net.score()
         for _ in range(12):
@@ -214,8 +214,8 @@ class TestAttentionVertex:
     def test_vertex_params_train(self):
         net = self._graph(1)
         x = _seq()
-        y = np.zeros((B, 3, T), np.float32)
-        y[:, 0, :] = 1.0
+        y = np.zeros((B, T, 3), np.float32)
+        y[:, :, 0] = 1.0
         from deeplearning4j_tpu.datasets.dataset import DataSet
         w0 = np.asarray(net._params["attn"]["Wq"]).copy()
         for _ in range(5):
@@ -242,3 +242,30 @@ def test_selfattention_serialization_roundtrip(tmp_path):
     net.save(p)
     net2 = MultiLayerNetwork.load(p)
     np.testing.assert_allclose(net2.output(x).numpy(), want, atol=1e-6)
+
+
+def test_mask_propagates_through_time_reshaping_layers():
+    """Review regression: LearnedSelfAttentionLayer shortens T (12 -> 3);
+    a downstream LSTM must not receive the stale (B, 12) mask."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    net = _mln(LearnedSelfAttentionLayer(nOut=16, nHeads=2, nQueries=3),
+               LSTM(nOut=8))
+    x = _seq()
+    y = np.zeros((B, 3, 3), np.float32)
+    y[:, :, 0] = 1.0
+    d = DataSet(x, y)
+    d.featuresMask = _mask([7, 12, 5, 9])
+    net.fit(d)              # would raise a shape error before the fix
+    out = net.output(x, fmask=d.featuresMask).numpy()
+    assert out.shape == (B, 3, 3)
+
+
+def test_masked_rows_zero_after_nonzero_activation():
+    """Review regression: masked rows stay zero even when the activation
+    maps 0 to nonzero (sigmoid(0) = 0.5)."""
+    net = _mln(SelfAttentionLayer(nOut=16, nHeads=2, activation="sigmoid"))
+    x = _seq()
+    m = _mask([6, 12, 4, 9])
+    acts = net._forward(net._params, net._state, jnp.asarray(x), False,
+                        None, mask=jnp.asarray(m), collect=True)[3][0]
+    assert np.all(np.asarray(acts)[m == 0] == 0)

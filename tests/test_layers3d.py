@@ -1,0 +1,206 @@
+"""3D CNN family (round-3 VERDICT item 6: ≡ deeplearning4j-nn ::
+conf.layers.Convolution3D / Subsampling3DLayer / Upsampling3D / Cropping3D /
+ZeroPadding3DLayer / Cnn3DLossLayer, InputType.convolutional3D)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers3d import (Cnn3DLossLayer,
+                                                 Convolution3D, Cropping3D,
+                                                 Subsampling3DLayer,
+                                                 Upsampling3D,
+                                                 ZeroPadding3DLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+
+D, H, W, C = 6, 8, 8, 2
+
+
+def _vol(seed=0, b=2):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((b, D, H, W, C)).astype(np.float32)
+
+
+class TestConvolution3D:
+    def test_shapes_same_and_truncate(self):
+        layer = Convolution3D(nIn=C, nOut=4, kernelSize=(3, 3, 3),
+                              convolutionMode="same")
+        layer.apply_defaults({})
+        t = layer.output_type(InputType.convolutional3D(D, H, W, C))
+        assert t.shape() == (D, H, W, 4)
+        layer2 = Convolution3D(nIn=C, nOut=4, kernelSize=(3, 3, 3),
+                               stride=(2, 2, 2))
+        layer2.apply_defaults({})
+        t2 = layer2.output_type(InputType.convolutional3D(D, H, W, C))
+        assert t2.shape() == ((D - 3) // 2 + 1, (H - 3) // 2 + 1,
+                              (W - 3) // 2 + 1, 4)
+
+    def test_manual_oracle_1x1x1(self):
+        """A 1x1x1 conv is a per-voxel matmul — check against numpy."""
+        layer = Convolution3D(nIn=C, nOut=3, kernelSize=(1, 1, 1),
+                              convolutionMode="same", activation="identity")
+        layer.apply_defaults({})
+        params, _, _ = layer.initialize(
+            jax.random.PRNGKey(0), InputType.convolutional3D(D, H, W, C))
+        x = _vol()
+        y, _ = layer.apply(params, {}, jnp.asarray(x))
+        wmat = np.asarray(params["W"])[0, 0, 0]          # (C, 3)
+        want = x @ wmat + np.asarray(params["b"])
+        np.testing.assert_allclose(np.asarray(y), want, atol=1e-5, rtol=1e-5)
+
+    def test_gradcheck(self):
+        layer = Convolution3D(nIn=C, nOut=2, kernelSize=(2, 2, 2),
+                              convolutionMode="same", activation="tanh")
+        layer.apply_defaults({})
+        params, _, _ = layer.initialize(
+            jax.random.PRNGKey(1), InputType.convolutional3D(3, 4, 4, C))
+        x = jnp.asarray(np.random.default_rng(1)
+                        .standard_normal((1, 3, 4, 4, C)).astype(np.float32))
+
+        def loss(p):
+            y, _ = layer.apply(p, {}, x)
+            return jnp.sum(jnp.sin(y))
+
+        g = jax.grad(loss)(params)
+        eps = 1e-3
+        for k in ("W", "b"):
+            flat = np.asarray(params[k]).ravel()
+            i = min(2, flat.size - 1)
+            bump = np.zeros_like(flat)
+            bump[i] = eps
+            pp = dict(params)
+            pp[k] = jnp.asarray((flat + bump).reshape(params[k].shape))
+            pm = dict(params)
+            pm[k] = jnp.asarray((flat - bump).reshape(params[k].shape))
+            fd = (float(loss(pp)) - float(loss(pm))) / (2 * eps)
+            an = float(np.asarray(g[k]).ravel()[i])
+            assert abs(fd - an) < 1e-2, (k, fd, an)
+
+
+class TestPoolingAndShapes3D:
+    def test_maxpool_oracle(self):
+        layer = Subsampling3DLayer(poolingType="max", kernelSize=(2, 2, 2),
+                                   stride=(2, 2, 2))
+        layer.apply_defaults({})
+        x = _vol()
+        y, _ = layer.apply({}, {}, jnp.asarray(x))
+        want = x.reshape(2, D // 2, 2, H // 2, 2, W // 2, 2, C) \
+            .max(axis=(2, 4, 6))
+        np.testing.assert_allclose(np.asarray(y), want, atol=1e-6)
+
+    def test_avgpool_counts_edges(self):
+        layer = Subsampling3DLayer(poolingType="avg", kernelSize=(2, 2, 2),
+                                   stride=(2, 2, 2), convolutionMode="same")
+        layer.apply_defaults({})
+        x = np.ones((1, 3, 3, 3, 1), np.float32)
+        y, _ = layer.apply({}, {}, jnp.asarray(x))
+        # ones stay ones when partial windows divide by true count
+        np.testing.assert_allclose(np.asarray(y), np.ones_like(np.asarray(y)),
+                                   atol=1e-6)
+
+    def test_upsample_crop_pad_roundtrip(self):
+        up = Upsampling3D(size=2)
+        up.apply_defaults({})
+        x = _vol()
+        y, _ = up.apply({}, {}, jnp.asarray(x))
+        assert y.shape == (2, 2 * D, 2 * H, 2 * W, C)
+        np.testing.assert_allclose(np.asarray(y)[:, ::2, ::2, ::2], x)
+
+        pad = ZeroPadding3DLayer(padding=(1, 2, 0, 1, 3, 0))
+        pad.apply_defaults({})
+        z, _ = pad.apply({}, {}, jnp.asarray(x))
+        assert z.shape == (2, D + 3, H + 1, W + 3, C)
+
+        crop = Cropping3D(cropping=(1, 2, 0, 1, 3, 0))
+        crop.apply_defaults({})
+        back, _ = crop.apply({}, {}, z)
+        np.testing.assert_allclose(np.asarray(back), x)
+        t = crop.output_type(InputType.convolutional3D(D + 3, H + 1,
+                                                       W + 3, C))
+        assert t.shape() == (D, H, W, C)
+
+    def test_cropping_pairs_spelling(self):
+        c = Cropping3D(cropping=((1, 2), (3, 4), (5, 6)))
+        assert c.cropping == (1, 2, 3, 4, 5, 6)
+
+
+class TestTrain3D:
+    def test_classifier_trains(self):
+        """conv3d → pool3d → dense head (auto Cnn3D→FF preprocessor)."""
+        conf = (NeuralNetConfiguration.Builder().seed(4).updater(Adam(1e-2))
+                .weightInit("xavier").list()
+                .layer(Convolution3D(nOut=4, kernelSize=(3, 3, 3),
+                                     convolutionMode="same",
+                                     activation="relu"))
+                .layer(Subsampling3DLayer(kernelSize=(2, 2, 2),
+                                          stride=(2, 2, 2)))
+                .layer(DenseLayer(nOut=16, activation="relu"))
+                .layer(OutputLayer(lossFunction="mcxent", nOut=2,
+                                   activation="softmax"))
+                .setInputType(InputType.convolutional3D(D, H, W, C))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = _vol(b=8)
+        y = np.eye(2, dtype=np.float32)[
+            np.random.default_rng(0).integers(0, 2, 8)]
+        net.fit(x, y)
+        l0 = net.score()
+        for _ in range(15):
+            net.fit(x, y)
+        assert net.score() < l0 * 0.9
+        assert net.output(x).numpy().shape == (8, 2)
+
+    def test_voxel_segmentation_with_cnn3dloss(self):
+        conf = (NeuralNetConfiguration.Builder().seed(9).updater(Adam(1e-2))
+                .weightInit("xavier").list()
+                .layer(Convolution3D(nOut=4, kernelSize=(3, 3, 3),
+                                     convolutionMode="same",
+                                     activation="relu"))
+                .layer(Convolution3D(nOut=1, kernelSize=(1, 1, 1),
+                                     convolutionMode="same",
+                                     activation="identity"))
+                .layer(Cnn3DLossLayer(lossFunction="xent",
+                                      activation="sigmoid"))
+                .setInputType(InputType.convolutional3D(D, H, W, C))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = _vol(b=4)
+        # target: voxel is 1 where channel-0 input is positive
+        y = (x[..., :1] > 0).astype(np.float32)
+        net.fit(x, y)
+        l0 = net.score()
+        for _ in range(20):
+            net.fit(x, y)
+        assert net.score() < l0 * 0.8
+        out = net.output(x).numpy()
+        assert out.shape == (4, D, H, W, 1)
+
+    def test_serialization_roundtrip(self, tmp_path):
+        conf = (NeuralNetConfiguration.Builder().seed(4).updater(Adam(1e-3))
+                .weightInit("xavier").list()
+                .layer(Convolution3D(nOut=2, kernelSize=(2, 2, 2),
+                                     convolutionMode="same",
+                                     activation="relu"))
+                .layer(DenseLayer(nOut=4, activation="relu"))
+                .layer(OutputLayer(lossFunction="mcxent", nOut=2,
+                                   activation="softmax"))
+                .setInputType(InputType.convolutional3D(D, H, W, C))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = _vol()
+        want = net.output(x).numpy()
+        p = str(tmp_path / "net3d.zip")
+        net.save(p)
+        got = MultiLayerNetwork.load(p).output(x).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_2d_input_rejected(self):
+        with pytest.raises(ValueError, match="convolutional3D"):
+            (NeuralNetConfiguration.Builder().list()
+             .layer(Convolution3D(nOut=2))
+             .layer(OutputLayer(lossFunction="mcxent", nOut=2))
+             .setInputType(InputType.convolutional(8, 8, 2)).build())
