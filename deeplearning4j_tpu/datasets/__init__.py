@@ -1,6 +1,7 @@
 from deeplearning4j_tpu.datasets.dataset import DataSet, SplitTestAndTrain
 from deeplearning4j_tpu.datasets.iterators import (
     ArrayDataSetIterator, AsyncDataSetIterator, CifarDataSetIterator,
+    ListDataSetIterator,
     DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
     MnistDataSetIterator, SyntheticImageNetIterator)
 from deeplearning4j_tpu.datasets.normalizers import (
@@ -8,7 +9,7 @@ from deeplearning4j_tpu.datasets.normalizers import (
     NormalizerStandardize, VGG16ImagePreProcessor)
 
 __all__ = [
-    "DataSet", "SplitTestAndTrain", "ArrayDataSetIterator",
+    "DataSet", "SplitTestAndTrain", "ArrayDataSetIterator", "ListDataSetIterator",
     "AsyncDataSetIterator", "CifarDataSetIterator", "DataSetIterator",
     "EmnistDataSetIterator", "IrisDataSetIterator", "MnistDataSetIterator",
     "SyntheticImageNetIterator", "DataNormalization",

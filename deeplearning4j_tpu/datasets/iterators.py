@@ -425,3 +425,41 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def inputColumns(self):
         return self._base.inputColumns()
+
+
+class ListDataSetIterator(DataSetIterator):
+    """≡ ListDataSetIterator(list<DataSet>, batch) — re-batches a list of
+    DataSets into batches of exactly `batch` examples (merging across list
+    entries like the reference; all entries must share shapes/mask layout).
+    Default batch = the whole list as one batch."""
+
+    def __init__(self, datasets, batch_size=None):
+        datasets = list(datasets)
+        self._merged = (DataSet.merge(datasets) if len(datasets) > 1
+                        else datasets[0]) if datasets else None
+        n = self._merged.numExamples() if self._merged is not None else 0
+        super().__init__(batch_size if batch_size is not None else max(n, 1))
+
+    def numExamples(self):
+        return 0 if self._merged is None else self._merged.numExamples()
+
+    def totalOutcomes(self):
+        if self._merged is None or self._merged.labels is None:
+            return 0
+        return int(np.asarray(self._merged.labels).shape[-1])
+
+    def inputColumns(self):
+        if self._merged is None:
+            return 0
+        return int(np.prod(np.asarray(self._merged.features).shape[1:]))
+
+    def next(self, num=None):
+        self._check_has_next()
+        n = num or self._batch
+        m = self._merged
+        sl = slice(self._cursor, min(self._cursor + n, m.numExamples()))
+        self._cursor = sl.stop
+        pick = lambda a: None if a is None else a[sl]
+        ds = DataSet(m.features[sl], pick(m.labels), pick(m.featuresMask),
+                     pick(m.labelsMask))
+        return self._maybe_preprocess(ds)

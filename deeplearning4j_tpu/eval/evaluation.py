@@ -92,17 +92,71 @@ class Evaluation:
     def getConfusionMatrix(self):
         return self._cm.copy()
 
-    def stats(self):
+    def trueNegatives(self, cls):
+        return int(self._cm.sum() - self._cm[cls, :].sum()
+                   - self._cm[:, cls].sum() + self._cm[cls, cls])
+
+    def matthewsCorrelation(self, cls=None):
+        """Per-class MCC from the binarised confusion counts; cls=None
+        averages over classes with support (≡ Evaluation.matthewsCorrelation
+        / averageMatthewsCorrelation)."""
+        if cls is not None:
+            tp, fp = self.truePositives(cls), self.falsePositives(cls)
+            fn, tn = self.falseNegatives(cls), self.trueNegatives(cls)
+            denom = np.sqrt(float(tp + fp) * (tp + fn)
+                            * (tn + fp) * (tn + fn))
+            return (tp * tn - fp * fn) / denom if denom else 0.0
+        vals = [self.matthewsCorrelation(c) for c in range(self.num_classes)
+                if self._cm[c, :].sum() or self._cm[:, c].sum()]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def gMeasure(self, cls=None):
+        """√(precision·recall) (≡ Evaluation.gMeasure)."""
+        if cls is not None:
+            return float(np.sqrt(self.precision(cls) * self.recall(cls)))
+        vals = [self.gMeasure(c) for c in range(self.num_classes)
+                if self._cm[c, :].sum() or self._cm[:, c].sum()]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def falseAlarmRate(self, cls=None):
+        """(FPR + FNR)/2 per reference definition."""
+        if cls is None:
+            vals = [self.falseAlarmRate(c) for c in range(self.num_classes)
+                    if self._cm[c, :].sum() or self._cm[:, c].sum()]
+            return float(np.mean(vals)) if vals else 0.0
+        fp, tn = self.falsePositives(cls), self.trueNegatives(cls)
+        fn, tp = self.falseNegatives(cls), self.truePositives(cls)
+        fpr = fp / (fp + tn) if (fp + tn) else 0.0
+        fnr = fn / (fn + tp) if (fn + tp) else 0.0
+        return (fpr + fnr) / 2
+
+    def stats(self, suppressWarnings=False, includeConfusion=True):
+        """≡ Evaluation.stats(): headline metrics + the per-class
+        precision/recall/F1/MCC table + confusion matrix."""
         lines = ["========================Evaluation Metrics========================",
                  f" # of classes:    {self.num_classes}",
                  f" Accuracy:        {self.accuracy():.4f}",
                  f" Precision:       {self.precision():.4f}",
                  f" Recall:          {self.recall():.4f}",
-                 f" F1 Score:        {self.f1():.4f}"]
+                 f" F1 Score:        {self.f1():.4f}",
+                 f" MCC:             {self.matthewsCorrelation():.4f}",
+                 f" G-Measure:       {self.gMeasure():.4f}"]
         if self.top_n > 1:
             lines.append(f" Top-{self.top_n} Accuracy: {self.topNAccuracy():.4f}")
-        lines.append("=========================Confusion Matrix=========================")
-        lines.append(str(self._cm))
+        lines.append("")
+        lines.append(f" {'Class':>6} {'TP':>6} {'FP':>6} {'FN':>6} "
+                     f"{'Precision':>10} {'Recall':>8} {'F1':>8} {'MCC':>8}")
+        for c in range(self.num_classes):
+            if not (self._cm[c, :].sum() or self._cm[:, c].sum()):
+                continue
+            lines.append(
+                f" {c:>6d} {self.truePositives(c):>6d} "
+                f"{self.falsePositives(c):>6d} {self.falseNegatives(c):>6d} "
+                f"{self.precision(c):>10.4f} {self.recall(c):>8.4f} "
+                f"{self.f1(c):>8.4f} {self.matthewsCorrelation(c):>8.4f}")
+        if includeConfusion:
+            lines.append("=========================Confusion Matrix=========================")
+            lines.append(str(self._cm))
         return "\n".join(lines)
 
 

@@ -1,0 +1,201 @@
+"""Capsule-network layers (≡ deeplearning4j-nn :: conf.layers.CapsuleLayer /
+PrimaryCapsules / CapsuleStrengthLayer, Sabour et al. 2017) and the
+one-class OCNNOutputLayer (≡ conf.ocnn.OCNNOutputLayer, Chalapathy et al.).
+
+TPU-first shapes: capsule sets are (B, N, D) arrays (N capsules of
+dimension D), reusing the package's recurrent InputType (size=D, T=N);
+dynamic routing unrolls its fixed `routings` iterations at trace time —
+three einsums per iteration, all MXU work, no host loop."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (ConvolutionalType, InputType,
+                                               RecurrentType)
+from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer, Layer
+from deeplearning4j_tpu.nn.weights_init import init_weight
+
+
+def _squash(s, axis=-1, eps=1e-8):
+    """v = (|s|²/(1+|s|²)) · s/|s| — capsule nonlinearity."""
+    n2 = jnp.sum(jnp.square(s), axis=axis, keepdims=True)
+    return (n2 / (1.0 + n2)) * s * jax.lax.rsqrt(n2 + eps)
+
+
+class PrimaryCapsules(Layer):
+    """≡ conf.layers.PrimaryCapsules — conv → capsule groups → squash.
+    (B, H, W, C) → (B, N, capsuleDimensions) with
+    N = H'·W'·channels (conv output positions × capsule channels)."""
+
+    def __init__(self, capsuleDimensions=8, channels=8, kernelSize=(9, 9),
+                 stride=(2, 2), hasBias=True, **kw):
+        super().__init__(**kw)
+        self.capsuleDimensions = int(capsuleDimensions)
+        self.channels = int(channels)
+        self.kernelSize = (kernelSize if isinstance(kernelSize, (tuple, list))
+                           else (kernelSize, kernelSize))
+        self.stride = (stride if isinstance(stride, (tuple, list))
+                       else (stride, stride))
+        self.hasBias = hasBias
+
+    def _out_hw(self, input_type):
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        oh = (input_type.height - kh) // sh + 1
+        ow = (input_type.width - kw) // sw + 1
+        return oh, ow
+
+    def output_type(self, input_type):
+        if not isinstance(input_type, ConvolutionalType):
+            raise ValueError(
+                f"PrimaryCapsules '{self.name}' needs convolutional input, "
+                f"got {input_type}")
+        oh, ow = self._out_hw(input_type)
+        n = oh * ow * self.channels
+        return InputType.recurrent(self.capsuleDimensions, n)
+
+    def initialize(self, key, input_type):
+        kh, kw = self.kernelSize
+        c_out = self.channels * self.capsuleDimensions
+        w = init_weight(key, (kh, kw, input_type.channels, c_out),
+                        self.weightInit, self.dist)
+        params = {"W": w}
+        if self.hasBias:
+            params["b"] = jnp.zeros((c_out,), jnp.float32)
+        return params, {}, self.output_type(input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        y = jax.lax.conv_general_dilated(
+            x, params["W"].astype(x.dtype), self.stride, "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.hasBias:
+            y = y + params["b"].astype(x.dtype)
+        b = y.shape[0]
+        caps = y.reshape(b, -1, self.capsuleDimensions)
+        return _squash(caps), state
+
+
+class CapsuleLayer(Layer):
+    """≡ conf.layers.CapsuleLayer — fully-connected capsules with dynamic
+    routing-by-agreement: (B, N_in, D_in) → (B, capsules,
+    capsuleDimensions); `routings` fixed iterations unrolled at trace."""
+
+    def __init__(self, capsules=10, capsuleDimensions=16, routings=3, **kw):
+        super().__init__(**kw)
+        self.capsules = int(capsules)
+        self.capsuleDimensions = int(capsuleDimensions)
+        self.routings = int(routings)
+
+    def output_type(self, input_type):
+        if not isinstance(input_type, RecurrentType):
+            raise ValueError(
+                f"CapsuleLayer '{self.name}' needs capsule (B, N, D) input "
+                f"(recurrent InputType), got {input_type}")
+        return InputType.recurrent(self.capsuleDimensions, self.capsules)
+
+    def initialize(self, key, input_type):
+        n_in = int(input_type.timeSeriesLength)
+        d_in = int(input_type.size)
+        w = init_weight(key,
+                        (n_in, self.capsules, d_in, self.capsuleDimensions),
+                        self.weightInit, self.dist)
+        return {"W": w}, {}, self.output_type(input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        dt = x.dtype
+        w = params["W"].astype(dt)
+        # predictions û_{j|i}: (B, N_in, N_out, D_out)
+        u_hat = jnp.einsum("bnd,nmde->bnme", x, w)
+        logits = jnp.zeros(u_hat.shape[:3], jnp.float32)  # (B, N_in, N_out)
+        v = None
+        for it in range(self.routings):
+            c = jax.nn.softmax(logits, axis=2).astype(dt)
+            s = jnp.einsum("bnm,bnme->bme", c, u_hat)
+            v = _squash(s)                                # (B, N_out, D_out)
+            if it + 1 < self.routings:
+                # agreement: only the coupling logits update (the standard
+                # no-gradient-through-routing formulation)
+                agree = jnp.einsum("bnme,bme->bnm", u_hat,
+                                   jax.lax.stop_gradient(v))
+                logits = logits + agree.astype(jnp.float32)
+        return v, state
+
+
+class CapsuleStrengthLayer(Layer):
+    """≡ conf.layers.CapsuleStrengthLayer — capsule lengths:
+    (B, N, D) → (B, N) (the class-probability readout)."""
+
+    def output_type(self, input_type):
+        if not isinstance(input_type, RecurrentType):
+            raise ValueError(
+                f"CapsuleStrengthLayer '{self.name}' needs capsule input, "
+                f"got {input_type}")
+        return InputType.feedForward(input_type.timeSeriesLength)
+
+    def feed_forward_mask(self, mask):
+        return None
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=-1) + 1e-12), state
+
+
+class OCNNOutputLayer(BaseOutputLayer):
+    """≡ conf.ocnn.OCNNOutputLayer — one-class NN for anomaly detection:
+    score(x) = sigmoid(x·V)·w, trained with the OC-NN objective
+        L = (1/ν)·mean(relu(r − score)) − r
+    where r is a TRAINABLE scalar whose gradient (1 − fraction(score < r)/ν)
+    drives it to the ν-quantile of the score distribution. Labels are
+    ignored (one-class); output() returns the anomaly score (higher =
+    more normal under the training distribution)."""
+
+    #: feature-dependent-loss protocol — the loss needs params (for r)
+    needs_features = True
+
+    def __init__(self, hiddenLayerSize=10, nu=0.04, initialRValue=0.1, **kw):
+        kw.setdefault("lossFunction", "mcxent")  # unused; protocol filler
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.hiddenLayerSize = int(hiddenLayerSize)
+        self.nu = float(nu)
+        self.initialRValue = float(initialRValue)
+        self.nIn = kw.get("nIn")
+        self.nOut = 1
+
+    def validate(self):
+        Layer.validate(self)
+
+    def apply_defaults(self, defaults):
+        Layer.apply_defaults(self, defaults)
+        if self.activation is None:
+            self.activation = "identity"
+        return self
+
+    def output_type(self, input_type):
+        return InputType.feedForward(1)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.size
+        k1, k2 = jax.random.split(key)
+        return ({"V": init_weight(k1, (int(self.nIn), self.hiddenLayerSize),
+                                  self.weightInit, self.dist),
+                 "w": init_weight(k2, (self.hiddenLayerSize, 1),
+                                  self.weightInit, self.dist),
+                 "r": jnp.asarray(self.initialRValue, jnp.float32)},
+                {}, self.output_type(input_type))
+
+    def pre_activation(self, params, x):
+        h = jax.nn.sigmoid(x @ params["V"].astype(x.dtype))
+        return h @ params["w"].astype(x.dtype)            # (B, 1) score
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        return self.pre_activation(params, x), state
+
+    def compute_loss_with_features(self, params, labels, preact, feats,
+                                   mask=None):
+        r = params["r"]
+        score = preact[:, 0]
+        return jnp.mean(jax.nn.relu(r - score)) / self.nu - r

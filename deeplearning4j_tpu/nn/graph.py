@@ -336,16 +336,33 @@ class ComputationGraph:
         return self
 
     # -- evaluation ------------------------------------------------------
-    def evaluate(self, iterator):
-        from deeplearning4j_tpu.eval.evaluation import Evaluation
-        e = Evaluation()
+    def _eval_loop(self, iterator, evaluator):
         if hasattr(iterator, "reset"):
             iterator.reset()
         for ds in iterator:
             out = self.output(ds.features)
             out0 = out[0] if isinstance(out, list) else out
-            e.eval(ds.labels, out0.numpy(), mask=ds.labelsMask)
-        return e
+            evaluator.eval(ds.labels, out0.numpy(), mask=ds.labelsMask)
+        return evaluator
+
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        return self._eval_loop(iterator, Evaluation())
+
+    def evaluateROC(self, iterator, threshold_steps=0):
+        from deeplearning4j_tpu.eval.evaluation import ROC
+        return self._eval_loop(iterator, ROC(threshold_steps))
+
+    def evaluateROCMultiClass(self, iterator, threshold_steps=0):
+        from deeplearning4j_tpu.eval.evaluation import ROCMultiClass
+        return self._eval_loop(iterator, ROCMultiClass(threshold_steps))
+
+    def evaluateCalibration(self, iterator, reliabilityDiagNumBins=10,
+                            histogramNumBins=10):
+        from deeplearning4j_tpu.eval.evaluation import EvaluationCalibration
+        return self._eval_loop(
+            iterator, EvaluationCalibration(reliabilityDiagNumBins,
+                                            histogramNumBins))
 
     # -- listeners / misc ------------------------------------------------
     def setListeners(self, *listeners):

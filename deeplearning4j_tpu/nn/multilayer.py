@@ -483,6 +483,20 @@ class MultiLayerNetwork:
         self._eval_loop(iterator, e)
         return e
 
+    def evaluateROCMultiClass(self, iterator, threshold_steps=0):
+        from deeplearning4j_tpu.eval.evaluation import ROCMultiClass
+        roc = ROCMultiClass(threshold_steps)
+        self._eval_loop(iterator, roc)
+        return roc
+
+    def evaluateCalibration(self, iterator, reliabilityDiagNumBins=10,
+                            histogramNumBins=10):
+        """≡ MultiLayerNetwork.evaluateCalibration → EvaluationCalibration."""
+        from deeplearning4j_tpu.eval.evaluation import EvaluationCalibration
+        e = EvaluationCalibration(reliabilityDiagNumBins, histogramNumBins)
+        self._eval_loop(iterator, e)
+        return e
+
     def _eval_loop(self, iterator, evaluator):
         if hasattr(iterator, "reset"):
             iterator.reset()

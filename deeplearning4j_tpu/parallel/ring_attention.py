@@ -80,11 +80,103 @@ def blockwise_attention(q, k, v, block_size=512, causal=False):
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
-def make_ring_attention(mesh, axis_name="sp", causal=False):
+def _make_ring_flash(axis_name, block_q=128, block_k=128, interpret=None):
+    """Ring attention whose LOCAL block math is the Pallas flash kernel
+    pair: forward calls the fused fwd kernel per held K/V block and merges
+    the per-block (o, lse) partials with the associative logsumexp merge;
+    backward is a second ring pass driving the Pallas dQ / dK-dV kernels
+    with the GLOBAL lse (dk/dv partial sums ride around the ring with
+    their K/V blocks and arrive home after the full cycle). Noncausal —
+    the causal ring keeps the lax.scan path (block-offset masks)."""
+    from deeplearning4j_tpu.kernels.flash_attention import (_flash_backward,
+                                                            _flash_forward)
+
+    @jax.custom_vjp
+    def ring_flash(q, k, v):
+        o, _ = _ring_flash_fwd_pass(q, k, v)
+        return o.astype(q.dtype)
+
+    def _ring_flash_fwd_pass(q, k, v):
+        n = lax.psum(1, axis_name)
+        b, h, t_local, d = q.shape
+        perm = [(j, (j + 1) % n) for j in range(n)]
+
+        def step(carry, _):
+            o, lse, kblk, vblk = carry
+            ob, lse_b = _flash_forward(q, kblk, vblk, None, False, block_q,
+                                       block_k, interpret)
+            lse_b = lse_b[:, :t_local].reshape(b, h, t_local)
+            m = jnp.maximum(lse, lse_b)
+            w1 = jnp.exp(lse - m)
+            w2 = jnp.exp(lse_b - m)
+            s = jnp.maximum(w1 + w2, 1e-30)
+            o = (o * w1[..., None]
+                 + ob.astype(jnp.float32) * w2[..., None]) / s[..., None]
+            lse = m + jnp.log(s)
+            kblk = lax.ppermute(kblk, axis_name, perm)
+            vblk = lax.ppermute(vblk, axis_name, perm)
+            return (o, lse, kblk, vblk), None
+
+        o0 = jnp.zeros(q.shape, jnp.float32)
+        lse0 = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)
+        (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v), None, length=n)
+        return o, lse
+
+    def fwd(q, k, v):
+        o, lse = _ring_flash_fwd_pass(q, k, v)
+        out = o.astype(q.dtype)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, g):
+        q, k, v, o, lse = res
+        n = lax.psum(1, axis_name)
+        b, h, t_local, d = q.shape
+        lse2 = lse.reshape(b * h, t_local)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+
+        def step(carry, _):
+            dq, kblk, vblk, dkblk, dvblk = carry
+            dq_i, dk_i, dv_i = _flash_backward(
+                q, kblk, vblk, None, o, lse2, g, False, block_q, block_k,
+                interpret)
+            dq = dq + dq_i.astype(jnp.float32)
+            dkblk = dkblk + dk_i.astype(jnp.float32)
+            dvblk = dvblk + dv_i.astype(jnp.float32)
+            # dk/dv partials travel WITH their K/V blocks; after the full
+            # cycle every block (and its gradient sum) is home again
+            kblk = lax.ppermute(kblk, axis_name, perm)
+            vblk = lax.ppermute(vblk, axis_name, perm)
+            dkblk = lax.ppermute(dkblk, axis_name, perm)
+            dvblk = lax.ppermute(dvblk, axis_name, perm)
+            return (dq, kblk, vblk, dkblk, dvblk), None
+
+        z = jnp.zeros(q.shape, jnp.float32)
+        (dq, _, _, dk, dv), _ = lax.scan(
+            step, (z, k, v, z, z), None, length=n)
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    ring_flash.defvjp(fwd, bwd)
+    return ring_flash
+
+
+def make_ring_attention(mesh, axis_name="sp", causal=False, use_flash=None,
+                        block_q=128, block_k=128, interpret=None):
     """Build a ring-attention fn for q,k,v sharded over `axis_name` on the
     time dim. Returns f(q_local, k_local, v_local) usable INSIDE shard_map
     over `mesh` — each of the n devices holds (B, H, T/n, D) and K/V blocks
-    ppermute around the ring, one ICI hop per step."""
+    ppermute around the ring, one ICI hop per step.
+
+    use_flash (default: auto — on TPU, noncausal): local block math runs
+    the Pallas flash kernels (fwd + bwd) composed with the ring, so the sp
+    path gets the fused-kernel HBM profile instead of the lax.scan
+    accumulator."""
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu" and not causal
+    if use_flash:
+        if causal:
+            raise ValueError("flash ring path is noncausal; pass "
+                             "use_flash=False for causal ring attention")
+        return _make_ring_flash(axis_name, block_q, block_k, interpret)
 
     def ring_attn(q, k, v):
         n = lax.psum(1, axis_name)

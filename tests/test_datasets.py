@@ -114,3 +114,27 @@ def test_async_iterator_equivalent():
     assert len(buffered) == len(direct)
     for a, d in zip(buffered, direct):
         np.testing.assert_array_equal(a, d)
+
+
+class TestListDataSetIterator:
+    def test_rebatches_across_list_entries(self):
+        from deeplearning4j_tpu.datasets import ListDataSetIterator
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        singles = [DataSet(np.full((1, 3), i, np.float32),
+                           np.eye(2, dtype=np.float32)[[i % 2]])
+                   for i in range(10)]
+        it = ListDataSetIterator(singles, 4)
+        sizes = [ds.numExamples() for ds in it]
+        assert sizes == [4, 4, 2]
+        assert it.numExamples() == 10  # total examples, not list length
+        it.reset()
+        first = it.next()
+        np.testing.assert_allclose(first.features[:, 0], [0, 1, 2, 3])
+
+    def test_default_batch_is_whole_list(self):
+        from deeplearning4j_tpu.datasets import ListDataSetIterator
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        ds = DataSet(np.zeros((8, 2), np.float32),
+                     np.zeros((8, 3), np.float32))
+        it = ListDataSetIterator([ds])
+        assert it.next().numExamples() == 8
