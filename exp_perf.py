@@ -1,0 +1,47 @@
+"""Perf experiment matrix for the ResNet-50 bench step (dev tool).
+
+Runs the bench core under several configurations and prints one line per
+config: fused-BN default, batch sweep, XLA flag variants. Use when the
+TPU is reachable:  python exp_perf.py [configs...]
+"""
+import os
+import subprocess
+import sys
+import time
+
+CONFIGS = {
+    "base": {},
+    "b128": {"BENCH_BATCH": "128"},
+    "b384": {"BENCH_BATCH": "384"},
+    "b512": {"BENCH_BATCH": "512"},
+    "lhs": {"LIBTPU_INIT_ARGS": "--xla_tpu_enable_latency_hiding_scheduler=true"},
+    "flags1": {"LIBTPU_INIT_ARGS":
+               "--xla_tpu_aggressive_opt_barrier_removal=ENABLED"},
+}
+
+
+def run_one(name, env_extra):
+    env = dict(os.environ)
+    env.update(env_extra)
+    env["BENCH_CHILD"] = "1"
+    env.setdefault("BENCH_STEPS", "20")
+    env["BENCH_EXTRA"] = ""      # headline only
+    t0 = time.time()
+    bench = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "bench.py")
+    p = subprocess.run([sys.executable, bench], capture_output=True,
+                       text=True, timeout=500, env=env)
+    line = next((l for l in p.stdout.splitlines() if l.startswith("{")), "")
+    print(f"{name:8s} {line}  [{time.time()-t0:.0f}s]", flush=True)
+    for l in p.stderr.splitlines():
+        if l.startswith("#"):
+            print(f"         {l}", flush=True)
+
+
+if __name__ == "__main__":
+    picks = sys.argv[1:] or list(CONFIGS)
+    for n in picks:
+        try:
+            run_one(n, CONFIGS[n])
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            print(f"{n:8s} FAILED: {e}", flush=True)
