@@ -23,13 +23,15 @@ from deeplearning4j_tpu.nn.conf.graph_vertices import (ElementWiseVertex,
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
                                                BatchNormalization,
+                                               Convolution1DLayer,
                                                ConvolutionLayer, Cropping2D,
                                                DenseLayer,
                                                DepthwiseConvolution2D,
                                                DropoutLayer, EmbeddingLayer,
                                                GlobalPoolingLayer,
-                                               OutputLayer,
+                                               OutputLayer, PReLULayer,
                                                SeparableConvolution2D,
+                                               Subsampling1DLayer,
                                                SubsamplingLayer, Upsampling2D,
                                                ZeroPaddingLayer)
 from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer, SimpleRnn
@@ -88,6 +90,9 @@ def _loss_for_activation(act):
 
 def _keras_input_type(batch_shape):
     dims = [d for d in batch_shape[1:]]
+    if len(dims) == 4:
+        # volumetric NDHWC (channels_last, like all our conv layouts)
+        return InputType.convolutional3D(dims[0], dims[1], dims[2], dims[3])
     if len(dims) == 3:
         return InputType.convolutional(dims[0], dims[1], dims[2])
     if len(dims) == 2:
@@ -203,6 +208,92 @@ def _convert_layer(class_name, cfg, is_last=False):
     if class_name in ("SpatialDropout2D", "SpatialDropout1D"):
         # per-element dropout parity approximation; rate semantics match
         return DropoutLayer(dropOut=1.0 - float(cfg.get("rate", 0.5)))
+    if class_name == "Bidirectional":
+        inner_cfg = cfg.get("layer") or {}
+        inner = _convert_layer(inner_cfg.get("class_name"),
+                               inner_cfg.get("config", {}))
+        from deeplearning4j_tpu.nn.conf.recurrent import Bidirectional
+        mode = {"concat": "concat", "sum": "add", "ave": "average",
+                "mul": "mul", None: "concat"}.get(
+            cfg.get("merge_mode", "concat"), "concat")
+        return Bidirectional(layer=inner, mode=mode)
+    if class_name == "Conv1D":
+        return Convolution1DLayer(
+            nOut=cfg["filters"],
+            kernelSize=(cfg["kernel_size"][0]
+                        if isinstance(cfg.get("kernel_size"), (list, tuple))
+                        else cfg.get("kernel_size", 3)),
+            stride=(cfg.get("strides", [1])[0]
+                    if isinstance(cfg.get("strides"), (list, tuple))
+                    else cfg.get("strides", 1)),
+            convolutionMode=cfg.get("padding", "valid"),
+            activation=act, weightInit=init, hasBias=bias)
+    if class_name in ("MaxPooling1D", "AveragePooling1D"):
+        pool = "max" if class_name.startswith("Max") else "avg"
+        size = cfg.get("pool_size", 2)
+        size = size[0] if isinstance(size, (list, tuple)) else size
+        stride = cfg.get("strides") or size
+        stride = stride[0] if isinstance(stride, (list, tuple)) else stride
+        return Subsampling1DLayer(poolingType=pool, kernelSize=int(size),
+                                  stride=int(stride),
+                                  convolutionMode=cfg.get("padding", "valid"))
+    if class_name == "Conv3D":
+        from deeplearning4j_tpu.nn.conf.layers3d import Convolution3D
+        return Convolution3D(
+            nOut=cfg["filters"], kernelSize=tuple(cfg["kernel_size"]),
+            stride=tuple(cfg.get("strides", (1, 1, 1))),
+            convolutionMode=cfg.get("padding", "valid"),
+            activation=act, weightInit=init, hasBias=bias)
+    if class_name in ("MaxPooling3D", "AveragePooling3D"):
+        from deeplearning4j_tpu.nn.conf.layers3d import Subsampling3DLayer
+        pool = "max" if class_name.startswith("Max") else "avg"
+        size = tuple(cfg.get("pool_size", (2, 2, 2)))
+        return Subsampling3DLayer(
+            poolingType=pool, kernelSize=size,
+            stride=tuple(cfg.get("strides") or size),
+            convolutionMode=cfg.get("padding", "valid"))
+    if class_name == "UpSampling3D":
+        from deeplearning4j_tpu.nn.conf.layers3d import Upsampling3D
+        return Upsampling3D(size=tuple(cfg.get("size", (2, 2, 2))))
+    if class_name == "ZeroPadding3D":
+        # the layer constructor normalizes all three Keras spellings
+        from deeplearning4j_tpu.nn.conf.layers3d import ZeroPadding3DLayer
+        return ZeroPadding3DLayer(padding=cfg.get("padding", 1))
+    if class_name == "Cropping3D":
+        from deeplearning4j_tpu.nn.conf.layers3d import Cropping3D
+        return Cropping3D(cropping=cfg.get("cropping", 0))
+    if class_name == "LeakyReLU":
+        # Keras default alpha is 0.3 (ours is 0.01) — carry it explicitly
+        alpha = float(cfg.get("alpha", 0.3))
+        return ActivationLayer(activation=f"leakyrelu:{alpha}")
+    if class_name == "ELU":
+        return ActivationLayer(activation="elu")
+    if class_name == "ThresholdedReLU":
+        return ActivationLayer(
+            activation=f"thresholdedrelu:{float(cfg.get('theta', 1.0))}")
+    if class_name == "ReLU":
+        mv = cfg.get("max_value")
+        neg = float(cfg.get("negative_slope", 0.0) or 0.0)
+        thr = float(cfg.get("threshold", 0.0) or 0.0)
+        if thr != 0.0 or (mv is not None and neg != 0.0):
+            raise InvalidKerasConfigurationException(
+                f"ReLU(max_value={mv}, negative_slope={neg}, "
+                f"threshold={thr}) has no exact equivalent here")
+        if neg != 0.0:
+            return ActivationLayer(activation=f"leakyrelu:{neg}")
+        if mv is not None:
+            return ActivationLayer(activation=f"relucap:{float(mv)}")
+        return ActivationLayer(activation="relu")
+    if class_name == "PReLU":
+        return PReLULayer()
+    if class_name == "GaussianDropout":
+        from deeplearning4j_tpu.nn.dropout import GaussianDropout
+        return DropoutLayer(dropOut=GaussianDropout(
+            float(cfg.get("rate", 0.5))))
+    if class_name == "GaussianNoise":
+        from deeplearning4j_tpu.nn.dropout import GaussianNoise
+        return DropoutLayer(dropOut=GaussianNoise(
+            float(cfg.get("stddev", 0.1))))
     if class_name in ("Flatten", "Reshape", "InputLayer"):
         return None  # shape plumbing — the builder's InputType inference
     raise InvalidKerasConfigurationException(
@@ -232,18 +323,40 @@ class KerasModelImport:
             layer_cfgs = layer_cfgs["layers"]
         b = NeuralNetConfiguration.Builder().list()
         converted = []
+        pending_mask_value = None  # from a Keras Masking layer
         for i, lc in enumerate(layer_cfgs):
             cls, cfg = lc["class_name"], lc.get("config", {})
             if inputType is None and (
                     "batch_input_shape" in cfg or "batch_shape" in cfg):
                 inputType = _keras_input_type(
                     cfg.get("batch_input_shape") or cfg["batch_shape"])
+            if cls == "Masking":
+                # Keras Masking derives the time mask from in-band padding
+                # and propagates it to downstream RNNs — our equivalent
+                # wraps the NEXT recurrent layer in MaskZeroLayer
+                pending_mask_value = float(cfg.get("mask_value", 0.0))
+                continue
             layer = _convert_layer(cls, cfg,
                                    is_last=(i == len(layer_cfgs) - 1))
             if layer is not None:
+                if pending_mask_value is not None:
+                    # Masking must feed DIRECTLY into a recurrent layer —
+                    # any intervening transform would change the in-band
+                    # padding values the derived mask keys off
+                    if not getattr(layer, "is_recurrent", False):
+                        raise InvalidKerasConfigurationException(
+                            "Masking must be immediately followed by a "
+                            f"recurrent layer; found {cls}")
+                    from deeplearning4j_tpu.nn.conf.sequence_layers import \
+                        MaskZeroLayer
+                    layer = MaskZeroLayer(layer, pending_mask_value)
+                    pending_mask_value = None
                 layer.name = cfg.get("name", f"layer{i}")
                 converted.append(layer)
                 b.layer(layer)
+        if pending_mask_value is not None:
+            raise InvalidKerasConfigurationException(
+                "Masking layer has no recurrent layer after it")
         if inputType is None:
             raise InvalidKerasConfigurationException(
                 "No batch_input_shape in config; pass inputType=")
@@ -374,6 +487,12 @@ def _h5_layer_weights(weights_path):
             def visit(path, obj):
                 if hasattr(obj, "shape"):
                     leaf = path.split("/")[-1].split(":")[0]
+                    # keep Bidirectional direction info: Keras nests the
+                    # wrapped layers under forward_*/backward_* groups
+                    if "forward" in path:
+                        leaf = "forward/" + leaf
+                    elif "backward" in path:
+                        leaf = "backward/" + leaf
                     arrs.append((leaf, np.array(obj)))
             sub.visititems(visit)
             if arrs:
@@ -477,22 +596,55 @@ def _assign_keras_weights(layer_params, arrs, layer_state=None):
                     break
 
 
+def _np_tree(d):
+    return {k: (_np_tree(v) if isinstance(v, dict) else np.array(v))
+            for k, v in d.items()}
+
+
+def _jnp_tree(d):
+    import jax.numpy as jnp
+    return {k: (_jnp_tree(v) if isinstance(v, dict) else jnp.asarray(v))
+            for k, v in d.items()}
+
+
+def _assign_layer_weights(params, arrs, state):
+    """Assign one Keras layer group onto our (possibly NESTED) param dict.
+    Bidirectional wrappers nest {'fwd': ..., 'bwd': ...}; their Keras
+    datasets carry forward/ / backward/ prefixes from _h5_layer_weights."""
+    if any(isinstance(v, dict) for v in params.values()):
+        fwd = [(n.split("/", 1)[1], a) for n, a in arrs
+               if n.startswith("forward/")]
+        bwd = [(n.split("/", 1)[1], a) for n, a in arrs
+               if n.startswith("backward/")]
+        if isinstance(params.get("fwd"), dict) and fwd:
+            _assign_keras_weights(params["fwd"], fwd, None)
+        if isinstance(params.get("bwd"), dict) and bwd:
+            _assign_keras_weights(params["bwd"], bwd, None)
+        flat = [(n, a) for n, a in arrs if "/" not in n]
+        flat_params = {k: v for k, v in params.items()
+                       if not isinstance(v, dict)}
+        if flat and flat_params:
+            _assign_keras_weights(flat_params, flat, state)
+            params.update(flat_params)
+        return
+    # plain layers never carry direction prefixes; strip any stray ones
+    arrs = [(n.split("/", 1)[-1], a) for n, a in arrs]
+    _assign_keras_weights(params, arrs, state)
+
+
 def _load_h5_weights_multilayer(net, weights_path):
     by_name = _h5_layer_weights(weights_path)
     loaded = 0
     for li, lyr in enumerate(net.conf.layers):
         name = getattr(lyr, "name", None)
         if name in by_name and str(li) in net._params:
-            import jax.numpy as jnp
-            params = {k: np.array(v) for k, v in net._params[str(li)].items()}
+            params = _np_tree(net._params[str(li)])
             state = {k: np.array(v)
                      for k, v in net._state.get(str(li), {}).items()}
-            _assign_keras_weights(params, by_name[name], state)
-            net._params[str(li)] = {k: jnp.asarray(v)
-                                    for k, v in params.items()}
+            _assign_layer_weights(params, by_name[name], state)
+            net._params[str(li)] = _jnp_tree(params)
             if state:
-                net._state[str(li)] = {k: jnp.asarray(v)
-                                       for k, v in state.items()}
+                net._state[str(li)] = _jnp_tree(state)
             loaded += 1
     net._h5_layers_loaded = loaded  # callers needing strictness check this
     return net
@@ -500,18 +652,16 @@ def _load_h5_weights_multilayer(net, weights_path):
 
 def _load_h5_weights_graph(net, weights_path):
     by_name = _h5_layer_weights(weights_path)
-    import jax.numpy as jnp
     loaded = 0
     for name, arrs in by_name.items():
         if name in net._params:
-            params = {k: np.array(v) for k, v in net._params[name].items()}
+            params = _np_tree(net._params[name])
             state = {k: np.array(v)
                      for k, v in net._state.get(name, {}).items()}
-            _assign_keras_weights(params, arrs, state)
-            net._params[name] = {k: jnp.asarray(v) for k, v in params.items()}
+            _assign_layer_weights(params, arrs, state)
+            net._params[name] = _jnp_tree(params)
             if state:
-                net._state[name] = {k: jnp.asarray(v)
-                                    for k, v in state.items()}
+                net._state[name] = _jnp_tree(state)
             loaded += 1
     net._h5_layers_loaded = loaded
     return net

@@ -68,10 +68,27 @@ ACTIVATIONS = {
 
 
 def get_activation(name):
-    """Resolve an activation by ND4J enum name (case-insensitive) or callable."""
+    """Resolve an activation by ND4J enum name (case-insensitive) or
+    callable. Parameterized spellings stay JSON-serializable strings:
+    'leakyrelu:<alpha>', 'thresholdedrelu:<theta>', 'relucap:<max>'
+    (relu clipped to [0, max])."""
     if callable(name):
         return name
     key = str(name).lower()
+    if ":" in key:
+        base, _, arg = key.partition(":")
+        try:
+            v = float(arg)
+        except ValueError:
+            raise ValueError(f"Bad activation parameter in '{name}'")
+        if base == "leakyrelu":
+            return lambda x: jax.nn.leaky_relu(x, v)
+        if base == "thresholdedrelu":
+            return lambda x: _thresholdedrelu(x, v)
+        if base == "relucap":
+            return lambda x: jnp.clip(x, 0.0, v)
+        raise ValueError(
+            f"Activation '{base}' does not take a ':{arg}' parameter")
     if key not in ACTIVATIONS:
         raise ValueError(
             f"Unknown activation '{name}'. Available: {sorted(ACTIVATIONS)}")

@@ -374,3 +374,186 @@ class TestImportBreadth:
                 np.testing.assert_array_equal(W[:, :, 0, c * M + m],
                                               dk[:, :, c, m])
         np.testing.assert_array_equal(np.asarray(net._params["0"]["b"]), db)
+
+
+class TestRound3ImportBreadth:
+    """Round-3: Bidirectional, Masking→MaskZeroLayer, 1D/3D conv+pool,
+    advanced activations, Gaussian noise/dropout."""
+
+    def _seq_model(self, layers, input_shape):
+        return {"class_name": "Sequential",
+                "config": {"layers": [
+                    {"class_name": "InputLayer",
+                     "config": {"batch_input_shape": [None] + list(input_shape)}}
+                ] + layers}}
+
+    def test_bidirectional_lstm(self):
+        from deeplearning4j_tpu.nn.conf.recurrent import Bidirectional
+        m = self._seq_model([
+            {"class_name": "Bidirectional",
+             "config": {"merge_mode": "concat",
+                        "layer": {"class_name": "LSTM",
+                                  "config": {"units": 6,
+                                             "activation": "tanh"}}}},
+            {"class_name": "Dense",
+             "config": {"units": 3, "activation": "softmax"}},
+        ], [10, 4])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(m)
+        assert isinstance(net.layers[0], Bidirectional)
+        x = np.random.default_rng(0).standard_normal((2, 10, 4)).astype(np.float32)
+        assert net.output(x).numpy().shape == (2, 10, 3)
+        # concat mode doubles features into the next layer
+        assert int(net.layers[1].nIn) == 12
+
+    def test_masking_wraps_next_rnn(self):
+        from deeplearning4j_tpu.nn.conf.sequence_layers import MaskZeroLayer
+        m = self._seq_model([
+            {"class_name": "Masking", "config": {"mask_value": 0.0}},
+            {"class_name": "LSTM", "config": {"units": 5}},
+            {"class_name": "Dense",
+             "config": {"units": 2, "activation": "softmax"}},
+        ], [8, 3])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(m)
+        assert isinstance(net.layers[0], MaskZeroLayer)
+        assert net.layers[0].maskingValue == 0.0
+
+    def test_conv1d_pool1d_global1d(self):
+        m = self._seq_model([
+            {"class_name": "Conv1D",
+             "config": {"filters": 8, "kernel_size": [3], "padding": "same",
+                        "activation": "relu"}},
+            {"class_name": "MaxPooling1D", "config": {"pool_size": [2]}},
+            {"class_name": "GlobalAveragePooling1D", "config": {}},
+            {"class_name": "Dense",
+             "config": {"units": 2, "activation": "softmax"}},
+        ], [12, 4])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(m)
+        x = np.random.default_rng(0).standard_normal((2, 12, 4)).astype(np.float32)
+        assert net.output(x).numpy().shape == (2, 2)
+
+    def test_conv3d_pool3d(self):
+        m = self._seq_model([
+            {"class_name": "Conv3D",
+             "config": {"filters": 4, "kernel_size": [3, 3, 3],
+                        "padding": "same", "activation": "relu"}},
+            {"class_name": "MaxPooling3D", "config": {"pool_size": [2, 2, 2]}},
+            {"class_name": "Flatten", "config": {}},
+            {"class_name": "Dense",
+             "config": {"units": 2, "activation": "softmax"}},
+        ], [4, 6, 6, 2])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(m)
+        x = np.random.default_rng(0).standard_normal((2, 4, 6, 6, 2)).astype(np.float32)
+        assert net.output(x).numpy().shape == (2, 2)
+
+    def test_advanced_activations(self):
+        m = self._seq_model([
+            {"class_name": "Dense", "config": {"units": 6,
+                                               "activation": "linear"}},
+            {"class_name": "LeakyReLU", "config": {"alpha": 0.3}},
+            {"class_name": "ReLU", "config": {"max_value": 6.0}},
+            {"class_name": "ELU", "config": {}},
+            {"class_name": "Dense",
+             "config": {"units": 2, "activation": "softmax"}},
+        ], [5])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(m)
+        x = np.random.default_rng(0).standard_normal((3, 5)).astype(np.float32)
+        assert net.output(x).numpy().shape == (3, 2)
+        from deeplearning4j_tpu.nn.conf.layers import ActivationLayer
+        assert isinstance(net.layers[1], ActivationLayer)
+        assert net.layers[1].activation == "leakyrelu:0.3"  # Keras default
+        assert net.layers[2].activation == "relucap:6.0"
+
+    def test_gaussian_dropout_noise(self):
+        m = self._seq_model([
+            {"class_name": "Dense", "config": {"units": 6,
+                                               "activation": "relu"}},
+            {"class_name": "GaussianDropout", "config": {"rate": 0.3}},
+            {"class_name": "GaussianNoise", "config": {"stddev": 0.2}},
+            {"class_name": "Dense",
+             "config": {"units": 2, "activation": "softmax"}},
+        ], [5])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(m)
+        x = np.random.default_rng(0).standard_normal((3, 5)).astype(np.float32)
+        assert net.output(x).numpy().shape == (3, 2)
+
+
+class TestRound3ImportFixes:
+    """Review regressions: Bidirectional weights, parameterized
+    activations, Masking strictness."""
+
+    def test_bidirectional_weights_load(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        rng = np.random.default_rng(9)
+        units, nin = 5, 3
+        fk = rng.normal(size=(nin, 4 * units)).astype(np.float32)
+        fr = rng.normal(size=(units, 4 * units)).astype(np.float32)
+        fb = rng.normal(size=(4 * units,)).astype(np.float32)
+        bk = rng.normal(size=(nin, 4 * units)).astype(np.float32)
+        br = rng.normal(size=(units, 4 * units)).astype(np.float32)
+        bb = rng.normal(size=(4 * units,)).astype(np.float32)
+        p = tmp_path / "bidir.h5"
+        with h5py.File(p, "w") as f:
+            g = f.create_group("model_weights").create_group("bd")
+            fw = g.create_group("bd").create_group("forward_lstm")
+            fw.create_dataset("kernel:0", data=fk)
+            fw.create_dataset("recurrent_kernel:0", data=fr)
+            fw.create_dataset("bias:0", data=fb)
+            bw = g["bd"].create_group("backward_lstm")
+            bw.create_dataset("kernel:0", data=bk)
+            bw.create_dataset("recurrent_kernel:0", data=br)
+            bw.create_dataset("bias:0", data=bb)
+        model = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "InputLayer",
+             "config": {"batch_input_shape": [None, 6, nin]}},
+            {"class_name": "Bidirectional",
+             "config": {"name": "bd", "merge_mode": "concat",
+                        "layer": {"class_name": "LSTM",
+                                  "config": {"units": units}}}},
+        ]}}
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            model, str(p))
+        assert net._h5_layers_loaded == 1
+        # forward kernel landed on fwd branch with keras i,f,g,o -> i,f,o,g
+        from deeplearning4j_tpu.keras_import.keras_import import \
+            _remap_lstm_gates
+        np.testing.assert_allclose(np.asarray(net._params["0"]["fwd"]["W"]),
+                                   _remap_lstm_gates(fk))
+        np.testing.assert_allclose(np.asarray(net._params["0"]["bwd"]["U"]),
+                                   _remap_lstm_gates(br))
+        x = np.random.default_rng(0).standard_normal((2, 6, nin)) \
+            .astype(np.float32)
+        assert net.output(x).numpy().shape == (2, 6, 2 * units)
+
+    def test_leakyrelu_alpha_numerics(self):
+        model = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "InputLayer",
+             "config": {"batch_input_shape": [None, 4]}},
+            {"class_name": "LeakyReLU", "config": {"alpha": 0.5}},
+        ]}}
+        net = KerasModelImport.importKerasSequentialModelAndWeights(model)
+        x = np.array([[-2.0, -1.0, 1.0, 2.0]], np.float32)
+        got = net.output(x).numpy()
+        np.testing.assert_allclose(got, [[-1.0, -0.5, 1.0, 2.0]], atol=1e-6)
+
+    def test_relu_max_value_clips(self):
+        model = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "InputLayer",
+             "config": {"batch_input_shape": [None, 3]}},
+            {"class_name": "ReLU", "config": {"max_value": 1.5}},
+        ]}}
+        net = KerasModelImport.importKerasSequentialModelAndWeights(model)
+        x = np.array([[-1.0, 1.0, 5.0]], np.float32)
+        np.testing.assert_allclose(net.output(x).numpy(),
+                                   [[0.0, 1.0, 1.5]], atol=1e-6)
+
+    def test_masking_not_before_rnn_raises(self):
+        model = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "InputLayer",
+             "config": {"batch_input_shape": [None, 6, 3]}},
+            {"class_name": "Masking", "config": {"mask_value": 0.0}},
+            {"class_name": "Dense",
+             "config": {"units": 2, "activation": "softmax"}},
+        ]}}
+        with pytest.raises(InvalidKerasConfigurationException,
+                           match="recurrent"):
+            KerasModelImport.importKerasSequentialModelAndWeights(model)
