@@ -273,6 +273,142 @@ class _LossNamespace:
                                  self.sd._lift(x))
 
 
+def _pair2(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+class _CNNNamespace:
+    """≡ SameDiff.cnn() — conv/pool ops over NHWC (the reference is NCHW;
+    layouts invert like the rest of the rebuild)."""
+
+    def __init__(self, sd):
+        self.sd = sd
+
+    def conv2d(self, x, weights, bias=None, stride=(1, 1), padding="SAME",
+               dilation=(1, 1)):
+        """x (B,H,W,Cin), weights (kh,kw,Cin,Cout) HWIO."""
+        x = self.sd._lift(x)
+        weights = self.sd._lift(weights)
+        s, d = _pair2(stride), _pair2(dilation)
+
+        if bias is None:
+            def f(a, w):
+                return jax.lax.conv_general_dilated(
+                    a, w, s, padding, rhs_dilation=d,
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            return self.sd._op("conv2d", f, x, weights)
+
+        bias = self.sd._lift(bias)
+
+        def f(a, w, b):
+            y = jax.lax.conv_general_dilated(
+                a, w, s, padding, rhs_dilation=d,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            return y + b
+        return self.sd._op("conv2d", f, x, weights, bias)
+
+    def maxPooling2d(self, x, kernel=(2, 2), stride=(2, 2), padding="VALID"):
+        x = self.sd._lift(x)
+        k, s = _pair2(kernel), _pair2(stride)
+
+        def f(a):
+            return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max,
+                                         (1,) + k + (1,), (1,) + s + (1,),
+                                         padding)
+        return self.sd._op("maxpool2d", f, x)
+
+    def avgPooling2d(self, x, kernel=(2, 2), stride=(2, 2), padding="VALID"):
+        x = self.sd._lift(x)
+        k, s = _pair2(kernel), _pair2(stride)
+
+        def f(a):
+            dims, strides = (1,) + k + (1,), (1,) + s + (1,)
+            summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims,
+                                           strides, padding)
+            # divide by the TRUE window population so SAME padding zeros
+            # don't dilute edge averages (TF/Keras/reference semantics)
+            counts = jax.lax.reduce_window(jnp.ones_like(a), 0.0,
+                                           jax.lax.add, dims, strides,
+                                           padding)
+            return summed / counts
+        return self.sd._op("avgpool2d", f, x)
+
+    def upsampling2d(self, x, scale=2):
+        x = self.sd._lift(x)
+        s = int(scale)
+
+        def f(a):
+            return jnp.repeat(jnp.repeat(a, s, axis=1), s, axis=2)
+        return self.sd._op("upsampling2d", f, x)
+
+
+class _LinalgNamespace:
+    """≡ SameDiff.linalg() — jnp.linalg-backed decompositions."""
+
+    def __init__(self, sd):
+        self.sd = sd
+
+    def mmul(self, a, b):
+        return self.sd._lift(a).mmul(self.sd._lift(b))
+
+    def cholesky(self, x):
+        return self.sd._op("cholesky",
+                           lambda a: jnp.linalg.cholesky(a),
+                           self.sd._lift(x))
+
+    def qr(self, x):
+        return self.sd._op("qr", lambda a: jnp.linalg.qr(a)[0],
+                           self.sd._lift(x))
+
+    def svd(self, x):
+        """Singular values (the reference's Svd op surface)."""
+        return self.sd._op("svd",
+                           lambda a: jnp.linalg.svd(a, compute_uv=False),
+                           self.sd._lift(x))
+
+    def solve(self, a, b):
+        return self.sd._op("solve",
+                           lambda x, y: jnp.linalg.solve(x, y),
+                           self.sd._lift(a), self.sd._lift(b))
+
+
+class _RandomNamespace:
+    """≡ SameDiff.random() — sampling ops. FUNCTIONAL-JAX SEMANTICS: each
+    op node draws from a key fixed at construction (seeded by the graph's
+    deterministic RNG), so repeated eval() of the same node returns the
+    SAME array — reproducible by design, unlike the reference's
+    resample-per-execution ops. Create a new op (or a fresh graph seed)
+    for a fresh draw; stochastic TRAINING noise belongs to the dropout
+    machinery, which rekeys per step."""
+
+    def __init__(self, sd):
+        self.sd = sd
+
+    def _draw(self, opname, shape, sampler):
+        seed = int(self.sd._rng.integers(0, 2 ** 31 - 1))
+
+        def f():
+            return sampler(jax.random.PRNGKey(seed), tuple(shape))
+        return self.sd._op(opname, f)
+
+    def normal(self, mean, stddev, *shape):
+        m, s = float(mean), float(stddev)
+        return self._draw("random_normal", shape,
+                          lambda k, sh: m + s * jax.random.normal(k, sh))
+
+    def uniform(self, lo, hi, *shape):
+        lo, hi = float(lo), float(hi)
+        return self._draw("random_uniform", shape,
+                          lambda k, sh: jax.random.uniform(
+                              k, sh, minval=lo, maxval=hi))
+
+    def bernoulli(self, p, *shape):
+        p = float(p)
+        return self._draw("random_bernoulli", shape,
+                          lambda k, sh: jax.random.bernoulli(
+                              k, p, sh).astype(jnp.float32))
+
+
 class TrainingConfig:
     """≡ org.nd4j.autodiff.samediff.TrainingConfig.Builder."""
 
@@ -326,6 +462,9 @@ class SameDiff:
         self.math = _MathNamespace(self)
         self.nn = _NNNamespace(self)
         self.loss = _LossNamespace(self)
+        self.cnn = _CNNNamespace(self)
+        self.linalg = _LinalgNamespace(self)
+        self.random = _RandomNamespace(self)
 
     @staticmethod
     def create():

@@ -213,10 +213,16 @@ def _convert_layer(class_name, cfg, is_last=False):
         inner = _convert_layer(inner_cfg.get("class_name"),
                                inner_cfg.get("config", {}))
         from deeplearning4j_tpu.nn.conf.recurrent import Bidirectional
-        mode = {"concat": "concat", "sum": "add", "ave": "average",
-                "mul": "mul", None: "concat"}.get(
-            cfg.get("merge_mode", "concat"), "concat")
-        return Bidirectional(layer=inner, mode=mode)
+        mm = cfg.get("merge_mode", "concat")
+        modes = {"concat": "concat", "sum": "add", "ave": "average",
+                 "mul": "mul"}
+        if mm not in modes:
+            # merge_mode=None returns TWO sequences in Keras — structurally
+            # different; refuse rather than silently concat
+            raise InvalidKerasConfigurationException(
+                f"Bidirectional merge_mode={mm!r} unsupported (use "
+                "concat/sum/ave/mul)")
+        return Bidirectional(layer=inner, mode=modes[mm])
     if class_name == "Conv1D":
         return Convolution1DLayer(
             nOut=cfg["filters"],
@@ -338,6 +344,12 @@ class KerasModelImport:
                 continue
             layer = _convert_layer(cls, cfg,
                                    is_last=(i == len(layer_cfgs) - 1))
+            if layer is None and pending_mask_value is not None:
+                # Flatten/Reshape between Masking and the RNN would change
+                # which values the derived mask keys off — refuse
+                raise InvalidKerasConfigurationException(
+                    "Masking must be immediately followed by a recurrent "
+                    f"layer; found {cls}")
             if layer is not None:
                 if pending_mask_value is not None:
                     # Masking must feed DIRECTLY into a recurrent layer —
@@ -597,14 +609,14 @@ def _assign_keras_weights(layer_params, arrs, layer_state=None):
 
 
 def _np_tree(d):
-    return {k: (_np_tree(v) if isinstance(v, dict) else np.array(v))
-            for k, v in d.items()}
+    import jax
+    return jax.tree_util.tree_map(np.array, d)
 
 
 def _jnp_tree(d):
+    import jax
     import jax.numpy as jnp
-    return {k: (_jnp_tree(v) if isinstance(v, dict) else jnp.asarray(v))
-            for k, v in d.items()}
+    return jax.tree_util.tree_map(jnp.asarray, d)
 
 
 def _assign_layer_weights(params, arrs, state):

@@ -130,12 +130,17 @@ class ComputationGraph:
 
     # -- forward ---------------------------------------------------------
     def _forward(self, params, state, inputs, train, rng, fmasks=None,
-                 want=None):
+                 want=None, carries=None):
         """inputs: dict name->array. Returns (acts dict, preacts dict for
-        output layers, new_state)."""
+        output layers, new_state[, new_carries when carries given]).
+
+        carries: optional {node_name: carry} — recurrent layer nodes then
+        run via scan_apply so hidden state threads across calls
+        (≡ ComputationGraph.rnnTimeStep's stored state)."""
         acts = {}
         preacts = {}
         new_state = dict(state)
+        new_carries = {} if carries is not None else None
         mask0 = None
         if fmasks:
             mask0 = next((m for m in fmasks.values() if m is not None), None)
@@ -178,6 +183,22 @@ class ComputationGraph:
                 from deeplearning4j_tpu.nn.activations import get_activation
                 acts[name] = get_activation(layer.activation)(pre)
                 node_masks[name] = pmask
+            elif carries is not None and getattr(layer, "is_recurrent",
+                                                 False):
+                if not hasattr(layer, "scan_apply"):
+                    # Bidirectional/MaskZeroLayer etc. have no single
+                    # forward carry — silently stateless results would be
+                    # wrong (the reference throws here too)
+                    raise ValueError(
+                        f"rnnTimeStep: {type(layer).__name__} '{name}' "
+                        "cannot run step-by-step (no carried state "
+                        "protocol); use output() on whole sequences")
+                x = layer._dropout_in(x, ltrain, lrng)
+                y, carry = layer.scan_apply(p, x, carries.get(name), pmask)
+                acts[name] = y
+                new_carries[name] = carry
+                node_masks[name] = (layer.feed_forward_mask(pmask)
+                                    if pmask is not None else None)
             else:
                 y, ns = layer.apply(p, s, x, train=ltrain, rng=lrng,
                                     mask=pmask)
@@ -186,6 +207,8 @@ class ComputationGraph:
                     new_state[name] = ns
                 node_masks[name] = (layer.feed_forward_mask(pmask)
                                     if pmask is not None else None)
+        if carries is not None:
+            return acts, preacts, new_state, new_carries
         return acts, preacts, new_state
 
     def _as_input_dict(self, inputs):
@@ -215,6 +238,32 @@ class ComputationGraph:
         ins = self._as_input_dict(inputs)
         acts, _, _ = self._forward(self._params, self._state, ins, train, None)
         return {k: NDArray(v) for k, v in acts.items()}
+
+    # -- stateful RNN inference (≡ ComputationGraph.rnnTimeStep) ---------
+    def rnnTimeStep(self, *inputs):
+        if len(inputs) == 1:
+            inputs = inputs[0]
+        ins = self._as_input_dict(inputs)
+        squeeze = any(v.ndim == 2 for v in ins.values())
+        ins = {k: (v[:, None, :] if v.ndim == 2 else v)
+               for k, v in ins.items()}
+        if getattr(self, "_rnn_carries", None) is None:
+            self._rnn_carries = {}
+        acts, _, _, self._rnn_carries = self._forward(
+            self._params, self._state, ins, False, None,
+            carries=self._rnn_carries)
+        outs = []
+        for n in self.conf.output_names:
+            y = acts[n]
+            outs.append(NDArray(y[:, -1, :] if squeeze and y.ndim == 3
+                                else y))
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnnClearPreviousState(self):
+        self._rnn_carries = None
+
+    def rnnGetPreviousState(self, node_name):
+        return (getattr(self, "_rnn_carries", None) or {}).get(node_name)
 
     # -- loss ------------------------------------------------------------
     def _loss(self, params, state, inputs, labels, fmasks, lmasks, rng,

@@ -172,8 +172,12 @@ class MultiLayerNetwork:
                 preact = layer.pre_activation(p, layer._dropout_in(x, ltrain, lrng))
                 from deeplearning4j_tpu.nn.activations import get_activation
                 x = get_activation(layer.activation)(preact)
-            elif carries is not None and getattr(layer, "is_recurrent", False) \
-                    and hasattr(layer, "scan_apply"):
+            elif carries is not None and getattr(layer, "is_recurrent", False):
+                if not hasattr(layer, "scan_apply"):
+                    raise ValueError(
+                        f"rnnTimeStep/tbptt: {type(layer).__name__} (layer "
+                        f"{i}) cannot run step-by-step (no carried state "
+                        "protocol); use fit/output on whole sequences")
                 x = layer._dropout_in(x, ltrain, lrng)
                 x, carry = layer.scan_apply(p, x, carries.get(str(i)), mask)
                 new_carries[str(i)] = carry

@@ -179,3 +179,46 @@ class TestLastTimeStepVertex:
         out2 = g1.output(x2, fmasks={"in": mask}).numpy()
         np.testing.assert_allclose(out1, out2, atol=1e-5)
         assert out1.shape == (3, 2)
+
+
+def test_graph_rnn_time_step_matches_full_sequence():
+    """Round-3: ComputationGraph.rnnTimeStep threads hidden state so
+    feeding a sequence one step at a time equals the whole-sequence
+    forward (≡ the reference's rnnTimeStep contract)."""
+    from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+    g = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(1e-2))
+         .weightInit("xavier").graphBuilder()
+         .addInputs("in")
+         .setInputTypes(InputType.recurrent(3, 6)))
+    g.addLayer("lstm", LSTM(nOut=5, activation="tanh"), "in")
+    g.addLayer("out", RnnOutputLayer(lossFunction="mcxent", nOut=2,
+                                     activation="softmax"), "lstm")
+    g.setOutputs("out")
+    net = ComputationGraph(g.build()).init()
+    x = np.random.default_rng(0).standard_normal((2, 6, 3)).astype(np.float32)
+    full = net.output(x).numpy()
+    net.rnnClearPreviousState()
+    steps = [net.rnnTimeStep(x[:, t, :]).numpy() for t in range(6)]
+    np.testing.assert_allclose(np.stack(steps, axis=1), full,
+                               atol=1e-5, rtol=1e-5)
+    assert net.rnnGetPreviousState("lstm") is not None
+    net.rnnClearPreviousState()
+    assert net.rnnGetPreviousState("lstm") is None
+
+
+def test_graph_rnn_time_step_refuses_bidirectional():
+    from deeplearning4j_tpu.nn.conf.recurrent import (LSTM, Bidirectional,
+                                                      RnnOutputLayer)
+    g = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(1e-2))
+         .weightInit("xavier").graphBuilder()
+         .addInputs("in")
+         .setInputTypes(InputType.recurrent(3, 6)))
+    g.addLayer("bd", Bidirectional(LSTM(nOut=4)), "in")
+    g.addLayer("out", RnnOutputLayer(lossFunction="mcxent", nOut=2,
+                                     activation="softmax"), "bd")
+    g.setOutputs("out")
+    net = ComputationGraph(g.build()).init()
+    x = np.zeros((1, 3), np.float32)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="step-by-step"):
+        net.rnnTimeStep(x)
