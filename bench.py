@@ -141,8 +141,24 @@ def child_main():
 
     from deeplearning4j_tpu.models.zoo import ResNet50, VGG16
 
-    img_s, dt, compile_s, final_loss = _bench_zoo_model(
-        ResNet50, batch, steps, warmup)
+    fused = os.environ.get("DL4J_TPU_FUSE_CONV_BN", "off")
+    try:
+        img_s, dt, compile_s, final_loss = _bench_zoo_model(
+            ResNet50, batch, steps, warmup)
+    except Exception as e:  # noqa: BLE001
+        # the conv1x1+BN Pallas fusion is the newest moving part — if it
+        # fails on this chip/toolchain, record why and fall back to the
+        # pure-XLA path rather than zeroing the headline. Only applies
+        # when fusion was actually on; otherwise the failure is real.
+        from deeplearning4j_tpu.nn.fused import fusion_enabled
+        if not fusion_enabled():
+            raise
+        print(f"# fused path failed ({e}); retrying unfused",
+              file=sys.stderr, flush=True)
+        os.environ["DL4J_TPU_FUSE_CONV_BN"] = "0"
+        fused = f"fallback-unfused: {str(e)[:120]}"
+        img_s, dt, compile_s, final_loss = _bench_zoo_model(
+            ResNet50, batch, steps, warmup)
     # MFU accounting: ResNet-50 fwd+bwd ≈ 3 × 4.1 GFLOP/img = 12.3 GFLOP/img;
     # v5e peak 197 TFLOP/s bf16
     mfu = img_s * 12.3e9 / 197e12 * 100
@@ -153,6 +169,7 @@ def child_main():
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
         "mfu_pct": round(mfu, 1),
         "mfu_note": "img_s*12.3GFLOP/img / 197 TFLOP/s v5e bf16 peak",
+        "conv1x1_bn_fusion": fused,
     }
     print(f"# resnet50: batch={batch} steps={steps} "
           f"step_time={dt*1000:.1f}ms loss={final_loss:.3f} "

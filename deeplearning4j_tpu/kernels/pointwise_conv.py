@@ -1,0 +1,319 @@
+"""Pallas fused 1x1-convolution + BatchNorm training kernels.
+
+A 1x1 conv in NHWC is a GEMM over the flattened spatial axis:
+y[M, N] = x[M, K] @ w[K, N] with M = B*H*W. In ResNet-class nets every
+1x1 conv is immediately followed by BatchNorm, and the xplane profile of
+the ResNet-50 bench step (BENCH.md) shows the step is HBM-bound with the
+BN stat/grad passes around those GEMMs costing whole extra reads/writes
+of the largest activations. These kernels remove the removable passes
+(the reference instead hands conv+BN to cuDNN fused helpers —
+deeplearning4j-cuda :: CudnnConvolutionHelper/CudnnBatchNormalizationHelper;
+on TPU the fusion has to be authored, XLA will not fuse a reduction into
+a conv epilogue):
+
+- forward: ONE kernel computes y = x @ w AND accumulates per-channel
+  sum(y), sum(y^2) across the sequential TPU grid — the separate BN
+  stats pass over y disappears. The normalize+activation stays a plain
+  XLA elementwise pass (it needs the *global* stats, which only exist
+  after the full grid).
+- backward: after the unavoidable dgamma/dbeta reduction (one kernel,
+  reads y and dz), a SINGLE kernel streams (x, y, dz) once and emits
+  BOTH conv gradients: it reconstructs the BN input-gradient
+  dy = k1*dz - k2*(y - mu) - c on the fly in VMEM (relu mask folded in)
+  and contracts it twice on the MXU — dX = dy @ w^T per tile and
+  dW += x^T @ dy accumulated across the grid. The 3 reads + 1 write
+  replace XLA's dx-elementwise pass + two separate conv-grad reads of a
+  materialized dy (5 reads + 2 writes of M*N-class tensors).
+
+Used by the ComputationGraph conv1x1+BN fusion path (nn/fused.py); exact
+equality with the unfused composition is tested in tests/test_kernels.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _default_interpret():
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward: y = x @ w, plus per-channel sum / sumsq epilogue
+# ---------------------------------------------------------------------------
+def _fwd_kernel(x_ref, w_ref, y_ref, s1_ref, s2_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        s1_ref[...] = jnp.zeros_like(s1_ref)
+        s2_ref[...] = jnp.zeros_like(s2_ref)
+
+    x = x_ref[...]
+    w = w_ref[...]
+    y = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+    # stats accumulate over the cast value actually seen downstream
+    yc = y_ref[...].astype(jnp.float32)
+    s1_ref[...] += jnp.sum(yc, axis=0, keepdims=True)
+    s2_ref[...] += jnp.sum(yc * yc, axis=0, keepdims=True)
+
+
+def matmul_stats(x, w, block_m=256, interpret=None):
+    """(x @ w, sum over rows, sum of squares over rows) in one pass.
+
+    x: (M, K), w: (K, N) -> y (M, N) in x.dtype, s1/s2 (N,) float32.
+    M is padded to a block multiple internally (zero rows contribute
+    nothing to either stat)."""
+    if interpret is None:
+        interpret = _default_interpret()
+    m, k = x.shape
+    k2, n = w.shape
+    assert k == k2, (x.shape, w.shape)
+    bm = min(block_m, m)
+    pad = (-m) % bm
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    grid = (x.shape[0] // bm,)
+    y, s1, s2 = pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, n), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((x.shape[0], n), x.dtype),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, w)
+    return y[:m], s1[0], s2[0]
+
+
+# ---------------------------------------------------------------------------
+# backward phase 1: dgamma / dbeta reduction (reads y, dz once)
+# ---------------------------------------------------------------------------
+def _bwd_stats_kernel(y_ref, dz_ref, mu_ref, r_ref, dg_ref, db_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dg_ref[...] = jnp.zeros_like(dg_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    y = y_ref[...].astype(jnp.float32)
+    dz = dz_ref[...].astype(jnp.float32)
+    mu = mu_ref[...].astype(jnp.float32)
+    r = r_ref[...].astype(jnp.float32)
+    xhat = (y - mu) * r
+    db_ref[...] += jnp.sum(dz, axis=0, keepdims=True)
+    dg_ref[...] += jnp.sum(dz * xhat, axis=0, keepdims=True)
+
+
+def bn_grad_stats(y, dz, mu, r, block_m=256, interpret=None):
+    """dgamma = sum(dz * xhat), dbeta = sum(dz) in one read of (y, dz).
+
+    Any relu masking must already be folded into dz by the caller.
+    Zero-padded rows are harmless: dz = 0 kills both sums."""
+    if interpret is None:
+        interpret = _default_interpret()
+    m, n = y.shape
+    bm = min(block_m, m)
+    pad = (-m) % bm
+    if pad:
+        y = jnp.pad(y, ((0, pad), (0, 0)))
+        dz = jnp.pad(dz, ((0, pad), (0, 0)))
+    grid = (y.shape[0] // bm,)
+    dg, db = pl.pallas_call(
+        _bwd_stats_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(y, dz, mu.reshape(1, n), r.reshape(1, n))
+    return dg[0], db[0]
+
+
+# ---------------------------------------------------------------------------
+# backward phase 2: dX and dW from one streaming pass over (x, y, dz)
+# ---------------------------------------------------------------------------
+def _bwd_gemm_kernel(x_ref, y_ref, dz_ref, w_ref, k1_ref, k2_ref, c_ref,
+                     mu_ref, dx_ref, dw_ref):
+    # grid = (k_tiles, m_tiles): m is innermost, so the dw block for the
+    # current k-tile accumulates over consecutive steps and flushes once
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    y = y_ref[...].astype(jnp.float32)
+    dz = dz_ref[...].astype(jnp.float32)
+    k1 = k1_ref[...].astype(jnp.float32)
+    k2 = k2_ref[...].astype(jnp.float32)
+    c = c_ref[...].astype(jnp.float32)
+    mu = mu_ref[...].astype(jnp.float32)
+    # BN input-gradient reconstructed in VMEM — never touches HBM
+    dy = (k1 * dz - (y - mu) * k2 - c).astype(x_ref.dtype)
+    w = w_ref[...]
+    dx = jnp.dot(dy, w.T, preferred_element_type=jnp.float32)
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    x = x_ref[...]
+    dw_ref[...] += jnp.dot(x.T, dy, preferred_element_type=jnp.float32)
+
+
+def bn_conv_grads(x, y, dz, w, k1, k2, c, mu, block_m=256, interpret=None):
+    """One pass over (x, y, dz): returns (dX (M,K) in x.dtype, dW (K,N) f32)
+    where dy = k1*dz - k2*(y-mu) - c is formed on the fly.
+
+    K is tiled when the resident (w tile + f32 dW accumulator) would blow
+    the ~16 MB scoped-VMEM budget (ResNet res4/res5 pairs); the k-grid is
+    the OUTER dimension so each dW block still accumulates over
+    consecutive m-steps. The cost of a second k-tile is one extra read of
+    (y, dz) — small next to the passes the fusion removes."""
+    if interpret is None:
+        interpret = _default_interpret()
+    m, k = x.shape
+    n = y.shape[1]
+    bm = min(block_m, m)
+    pad = (-m) % bm
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        y = jnp.pad(y, ((0, pad), (0, 0)))
+        dz = jnp.pad(dz, ((0, pad), (0, 0)))
+    mp = x.shape[0]
+    # per-k-tile VMEM: w bf16 (2) + dW f32 (4) per bk*n, y/dz bf16 double-
+    # buffered per bm*n, x/dx per bm*bk; keep the resident set under ~10MB.
+    # K tiles first (cheap: one extra (y, dz) read per extra tile); if a
+    # very wide N still blows the budget, shrink the m-block too.
+    bk = k
+
+    def _vmem(bm_, bk_):
+        return bk_ * n * 6 + bm_ * n * 8 + bm_ * bk_ * 4
+
+    while bk > 128 and _vmem(bm, bk) > 10 * 2**20:
+        bk //= 2
+    while bm > 8 and _vmem(bm, bk) > 10 * 2**20:
+        bm //= 2
+    pad = (-m) % bm
+    if pad != (mp - m):  # bm shrank: re-pad rows to the new block size
+        x, y, dz = x[:m], y[:m], dz[:m]
+        if pad:
+            x = jnp.pad(x, ((0, pad), (0, 0)))
+            y = jnp.pad(y, ((0, pad), (0, 0)))
+            dz = jnp.pad(dz, ((0, pad), (0, 0)))
+        mp = x.shape[0]
+    padk = (-k) % bk
+    if padk:
+        x = jnp.pad(x, ((0, 0), (0, padk)))
+        w = jnp.pad(w, ((0, padk), (0, 0)))
+    kp = x.shape[1]
+    # Zero-padded rows yield dy_pad = mu*k2 - c (nonzero: y=0 makes
+    # -(y-mu)*k2 = +mu*k2), but they cannot corrupt anything: their x rows
+    # are zero so x^T @ dy gets no contribution, and their dx rows are
+    # sliced off below. Zero-padded k-columns only add zero rows to w /
+    # zero cols to x, sliced off dx/dw below.
+    dx, dw = pl.pallas_call(
+        _bwd_gemm_kernel,
+        grid=(kp // bk, mp // bm),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda j, i: (i, j)),
+            pl.BlockSpec((bm, n), lambda j, i: (i, 0)),
+            pl.BlockSpec((bm, n), lambda j, i: (i, 0)),
+            pl.BlockSpec((bk, n), lambda j, i: (j, 0)),
+            pl.BlockSpec((1, n), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, n), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, n), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, n), lambda j, i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bk), lambda j, i: (i, j)),
+            pl.BlockSpec((bk, n), lambda j, i: (j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, kp), x.dtype),
+            jax.ShapeDtypeStruct((kp, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, y, dz, w, k1.reshape(1, n), k2.reshape(1, n), c.reshape(1, n),
+      mu.reshape(1, n))
+    return dx[:m, :k], dw[:k]
+
+
+# ---------------------------------------------------------------------------
+# the fused op: z = act(bn_train(x @ w)), custom VJP
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def fused_conv1x1_bn(x, w, gamma, beta, eps=1e-5, act="identity",
+                     interpret=None):
+    """z = act(batchnorm_train(x @ w)); returns (z, mu, var).
+
+    x: (M, K) activations (M = B*H*W), w: (K, N) conv kernel reshaped,
+    gamma/beta: (N,) float32. act in {"identity", "relu"}. mu/var are the
+    batch statistics (for the running-average update). Gradients flow to
+    x, w, gamma, beta with BN's closed-form backward fused into the conv
+    gradient GEMMs."""
+    z, mu, var, _ = _fused_fwd_core(x, w, gamma, beta, eps, act, interpret)
+    return z, mu, var
+
+
+def _fused_fwd_core(x, w, gamma, beta, eps, act, interpret):
+    y, s1, s2 = matmul_stats(x, w, interpret=interpret)
+    m = x.shape[0]
+    mu = s1 / m
+    var = jnp.maximum(s2 / m - mu * mu, 0.0)
+    r = jax.lax.rsqrt(var + eps)
+    a = (gamma * r).astype(y.dtype)
+    b = (beta - gamma * mu * r).astype(y.dtype)
+    z = y * a + b
+    if act == "relu":
+        z = jnp.maximum(z, 0)
+    elif act != "identity":
+        raise ValueError(f"fused_conv1x1_bn: unsupported act {act!r}")
+    return z, mu, var, (y, r)
+
+
+def _fused_fwd_rule(x, w, gamma, beta, eps, act, interpret):
+    z, mu, var, (y, r) = _fused_fwd_core(x, w, gamma, beta, eps, act,
+                                         interpret)
+    return (z, mu, var), (x, w, gamma, y, z, mu, r)
+
+
+def _fused_bwd_rule(eps, act, interpret, res, cts):
+    x, w, gamma, y, z, mu, r = res
+    dz, _dmu, _dvar = cts  # stats feed only the (stop-grad) running avgs
+    if act == "relu":
+        dz = jnp.where(z > 0, dz, 0).astype(dz.dtype)
+    dgamma, dbeta = bn_grad_stats(y, dz, mu, r, interpret=interpret)
+    m = y.shape[0]
+    k1 = gamma * r
+    k2 = gamma * r * r * dgamma / m
+    c = gamma * r * dbeta / m
+    dx, dw = bn_conv_grads(x, y, dz, w, k1, k2, c, mu, interpret=interpret)
+    return dx, dw.astype(w.dtype), dgamma.astype(gamma.dtype), \
+        dbeta.astype(gamma.dtype)
+
+
+fused_conv1x1_bn.defvjp(_fused_fwd_rule, _fused_bwd_rule)

@@ -255,11 +255,11 @@ class ResNet50(ZooModel):
              .addInputs("input")
              .setInputTypes(InputType.convolutional(h, w, c)))
 
-        def conv_bn(name, inp, n_out, k, s, act="relu"):
+        def conv_bn(name, inp, n_out, k, s, act="relu", s2d=1):
             g.addLayer(f"{name}_conv",
                        ConvolutionLayer(kernelSize=k, stride=s, nOut=n_out,
                                         convolutionMode="same",
-                                        hasBias=False,
+                                        hasBias=False, spaceToDepth=s2d,
                                         activation="identity"), inp)
             g.addLayer(f"{name}_bn",
                        BatchNormalization(activation=act), f"{name}_conv")
@@ -280,7 +280,11 @@ class ResNet50(ZooModel):
                        f"{name}_add")
             return f"{name}_relu"
 
-        x = conv_bn("stem", "input", 64, (7, 7), (2, 2))
+        # Stem in space-to-depth form: 3 input channels starve the MXU's
+        # contraction lanes; folding 2x2 blocks gives an identical conv
+        # over 12 channels (the standard TPU conv0 optimization).
+        x = conv_bn("stem", "input", 64, (7, 7), (2, 2),
+                    s2d=2 if h % 2 == 0 and w % 2 == 0 else 1)
         g.addLayer("stem_pool",
                    SubsamplingLayer(poolingType="max", kernelSize=(3, 3),
                                     stride=(2, 2), convolutionMode="same"), x)

@@ -274,10 +274,54 @@ class EmbeddingSequenceLayer(Layer):
         return get_activation(self.activation)(y), state
 
 
+def _s2d_dim(k, s, lo, hi, size, b):
+    """Block-space conv geometry for one spatial dim under space-to-depth
+    factor b. Returns (r, Kb, sb, plb, phb): front zero-pad of the kernel,
+    block-kernel size, block stride, block pad lo/hi. Derivation: output i
+    reads rows n..n+k-1, n = i*s - lo; with s % b == 0, n mod b is the
+    constant r = (-lo) mod b, so tap t lands in relative block (r+t)//b at
+    phase (r+t) mod b — a conv over blocks with kernel ceil((r+k)/b)."""
+    r = (-lo) % b
+    Kb = -(-(r + k) // b)
+    sb = s // b
+    out = (size + lo + hi - k) // s + 1
+    plb = (lo + r) // b
+    phb = (out - 1) * sb + Kb - size // b - plb
+    return r, Kb, sb, plb, phb
+
+
+def _space_to_depth_conv(x, w, stride, padding, b):
+    """conv(x, w) (NHWC/HWIO, explicit padding) computed in space-to-depth
+    form: x folded to (B, H/b, W/b, b·b·C) and w zero-padded/regrouped to
+    match. Mathematically identical to the plain conv, but each MXU
+    contraction sees b·b·C input channels instead of C — the standard TPU
+    conv0 trick for tiny-C stems (ResNet: C=3 → 12). Requires H, W and the
+    strides divisible by b, dilation 1."""
+    B, H, W_, C = x.shape
+    kh, kw, _, O = w.shape
+    (lo_h, hi_h), (lo_w, hi_w) = padding
+    rh, Kh, sh, plh, phh = _s2d_dim(kh, stride[0], lo_h, hi_h, H, b)
+    rw, Kw, sw, plw, phw = _s2d_dim(kw, stride[1], lo_w, hi_w, W_, b)
+    if phh < 0 or phw < 0:
+        return None
+    wp = jnp.zeros((Kh * b, Kw * b, C, O), w.dtype)
+    wp = wp.at[rh:rh + kh, rw:rw + kw].set(w)
+    wp = wp.reshape(Kh, b, Kw, b, C, O).transpose(0, 2, 1, 3, 4, 5)
+    wp = wp.reshape(Kh, Kw, b * b * C, O)
+    xb = x.reshape(B, H // b, b, W_ // b, b, C).transpose(0, 1, 3, 2, 4, 5)
+    xb = xb.reshape(B, H // b, W_ // b, b * b * C)
+    return lax.conv_general_dilated(
+        xb, wp, window_strides=(sh, sw),
+        padding=((plh, phh), (plw, phw)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
 class ConvolutionLayer(Layer):
     """≡ conf.layers.ConvolutionLayer (2D). NHWC/HWIO, lax.conv lowering
     straight onto the MXU (replaces CudnnConvolutionHelper algo selection —
-    XLA picks the tiling)."""
+    XLA picks the tiling). spaceToDepth=b computes the same conv in
+    block-folded form (see _space_to_depth_conv) — parameters stay in the
+    canonical HWIO shape, so serialization/import are unaffected."""
 
     @classmethod
     def _builder_positional(cls, args):
@@ -289,18 +333,34 @@ class ConvolutionLayer(Layer):
 
     def __init__(self, nIn=None, nOut=None, kernelSize=(3, 3), stride=(1, 1),
                  padding=(0, 0), dilation=(1, 1), convolutionMode="truncate",
-                 hasBias=True, **kw):
+                 hasBias=True, spaceToDepth=1, **kw):
         super().__init__(**kw)
         self.nIn, self.nOut = nIn, nOut
         self.kernelSize, self.stride = _pair(kernelSize), _pair(stride)
         self.padding, self.dilation = _pair(padding), _pair(dilation)
         self.convolutionMode = convolutionMode
         self.hasBias = hasBias
+        self.spaceToDepth = int(spaceToDepth or 1)
 
     def _padding_arg(self):
         if str(self.convolutionMode).lower() == "same":
             return "SAME"
         return [(self.padding[0], self.padding[0]), (self.padding[1], self.padding[1])]
+
+    def _explicit_padding(self, h, w):
+        """Resolve 'SAME' to concrete (lo, hi) pairs (TF convention: the
+        extra pad goes on the high side)."""
+        if str(self.convolutionMode).lower() != "same":
+            return ((self.padding[0], self.padding[0]),
+                    (self.padding[1], self.padding[1]))
+        pads = []
+        for size, k, s, d in zip((h, w), self.kernelSize, self.stride,
+                                 self.dilation):
+            ke = (k - 1) * d + 1
+            out = -(-size // s)
+            total = max((out - 1) * s + ke - size, 0)
+            pads.append((total // 2, total - total // 2))
+        return tuple(pads)
 
     def output_type(self, input_type):
         if self.nOut is None:
@@ -333,12 +393,22 @@ class ConvolutionLayer(Layer):
         return params, {}, self.output_type(input_type)
 
     def pre_activation(self, params, x):
-        y = lax.conv_general_dilated(
-            x, params["W"].astype(x.dtype),
-            window_strides=self.stride,
-            padding=self._padding_arg(),
-            rhs_dilation=self.dilation,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        w = params["W"].astype(x.dtype)
+        b = getattr(self, "spaceToDepth", 1)
+        y = None
+        if (b > 1 and self.dilation == (1, 1)
+                and self.stride[0] % b == 0 and self.stride[1] % b == 0
+                and x.shape[1] % b == 0 and x.shape[2] % b == 0):
+            y = _space_to_depth_conv(x, w, self.stride,
+                                     self._explicit_padding(x.shape[1],
+                                                            x.shape[2]), b)
+        if y is None:
+            y = lax.conv_general_dilated(
+                x, w,
+                window_strides=self.stride,
+                padding=self._padding_arg(),
+                rhs_dilation=self.dilation,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if self.hasBias:
             y = y + params["b"].astype(x.dtype)
         return y

@@ -1,0 +1,142 @@
+"""Conv1x1 + BatchNorm fusion pass for ComputationGraph.
+
+The reference reaches fused conv+BN through cuDNN helper classes
+(deeplearning4j-cuda :: CudnnConvolutionHelper /
+CudnnBatchNormalizationHelper chosen per-layer at runtime). The TPU-native
+equivalent is a graph-level rewrite: a 1x1 convolution feeding only a
+BatchNormalization is executed as ONE fused Pallas op
+(kernels/pointwise_conv.fused_conv1x1_bn) — the conv becomes a GEMM with a
+BN-stats epilogue, and BN's closed-form backward is reconstructed inside
+the conv-gradient GEMMs instead of materializing the intermediate
+gradient (see kernels/pointwise_conv.py for the pass accounting).
+
+The rewrite is *execution-only*: node names, parameter trees, state
+trees, serialization, transfer learning and constraints are all
+unchanged — `mark_conv1x1_bn_fusions` just annotates node pairs, and the
+graph executor routes the pair through `fused_apply` at train time.
+
+OFF by default (opt in with DL4J_TPU_FUSE_CONV_BN=1): measured on the
+v5e ResNet-50 headline bench the fused step is SLOWER (179 ms vs 99 ms,
+BENCH.md "negative result") — Pallas custom-calls are fusion barriers,
+so the BN-apply/relu passes XLA used to merge with neighbours become
+standalone, and the row-major GEMM operands force relayout copies
+against XLA's batch-minor conv layouts. The kernels stay correct,
+tested, and available for graphs where XLA's fusion does worse.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def fusion_enabled():
+    env = os.environ.get("DL4J_TPU_FUSE_CONV_BN")
+    if env is None:
+        return False
+    return env.strip().lower() in ("1", "true", "on", "yes")
+
+
+def _eligible_conv(layer):
+    from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer
+    if type(layer) is not ConvolutionLayer:
+        return False
+    # explicit nonzero padding would change the output shape of a 1x1
+    # conv; the GEMM path only covers pad-free geometry ("same" for k=1
+    # is also pad-free)
+    pad_free = (str(layer.convolutionMode).lower() == "same"
+                or tuple(layer.padding) == (0, 0))
+    return (tuple(layer.kernelSize) == (1, 1)
+            and tuple(layer.dilation) == (1, 1)
+            and layer.stride[0] == layer.stride[1]
+            and pad_free
+            and not layer.hasBias
+            and str(layer.activation).lower() in ("identity", "linear")
+            and getattr(layer, "spaceToDepth", 1) == 1
+            and not getattr(layer, "frozen", False)
+            and (layer.dropOut is None or layer.dropOut >= 1.0))
+
+
+def _eligible_bn(layer):
+    from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
+    return (type(layer) is BatchNormalization
+            and str(layer.activation).lower() in ("identity", "linear",
+                                                  "relu")
+            and not layer.lockGammaBeta
+            and not getattr(layer, "frozen", False)
+            and (layer.dropOut is None or layer.dropOut >= 1.0))
+
+
+def find_conv1x1_bn_fusions(conf):
+    """Find eligible (conv1x1 -> batchnorm) node pairs in a built
+    ComputationGraphConfiguration.
+
+    Returns {bn_node_name: conv_node_name}. Pure query — the caller
+    (ComputationGraph.init) keeps the mapping on the *network instance*,
+    never on the shared conf, so two nets built from one conf can run
+    fused and unfused independently."""
+    nodes = conf.nodes
+    consumers = {}
+    for name in conf.topo_order:
+        node = nodes[name]
+        for p in getattr(node, "inputs", ()):
+            consumers.setdefault(p, []).append(name)
+    pairs = {}
+    for name in conf.topo_order:
+        conv = nodes[name]
+        if conv.kind != "layer" or not _eligible_conv(conv.ref):
+            continue
+        outs = consumers.get(name, [])
+        if len(outs) != 1 or name in conf.output_names:
+            continue
+        bn_name = outs[0]
+        bn = nodes[bn_name]
+        if (bn.kind != "layer" or not _eligible_bn(bn.ref)
+                or bn.preprocessor is not None
+                or bn_name in conf.output_names
+                or len(bn.inputs) != 1):
+            continue
+        pairs[bn_name] = name
+    return pairs
+
+
+def fused_apply(conv_layer, bn_layer, p_conv, p_bn, s_bn, x, train,
+                interpret=None):
+    """Execute act(batchnorm(conv1x1(x))) fused. x: (B, H, W, C) NHWC.
+
+    Returns (z, new_bn_state, y_conv) with semantics identical to running
+    conv_layer.apply then bn_layer.apply in train/eval mode; y_conv is
+    the intermediate conv output (already materialized by the kernel —
+    the graph records it so feedForward() still reports the conv node's
+    true activation)."""
+    s = conv_layer.stride[0]
+    if s > 1:
+        # 1x1 conv with stride s touches exactly the (::s, ::s) pixels
+        x = x[:, ::s, ::s, :]
+    b, h, w_, cin = x.shape
+    w = p_conv["W"].astype(x.dtype).reshape(cin, -1)
+    n = w.shape[1]
+    xf = x.reshape(b * h * w_, cin)
+    if train:
+        from deeplearning4j_tpu.kernels.pointwise_conv import (
+            fused_conv1x1_bn, matmul_stats)
+        gamma = p_bn.get("gamma")
+        beta = p_bn.get("beta")
+        act = str(bn_layer.activation).lower()
+        act = "identity" if act in ("identity", "linear") else act
+        z, mu, var = fused_conv1x1_bn(xf, w, gamma, beta, bn_layer.eps,
+                                      act, interpret)
+        d = bn_layer.decay
+        new_state = {"mean": d * s_bn["mean"] + (1 - d) * mu,
+                     "var": d * s_bn["var"] + (1 - d) * var}
+        # conv activation for feedForward reporting: recompute lazily —
+        # XLA DCEs this whole branch unless someone actually reads it
+        y = jnp.dot(xf, w, preferred_element_type=jnp.float32).astype(
+            x.dtype)
+    else:
+        y = jnp.dot(xf, w, preferred_element_type=jnp.float32).astype(
+            x.dtype)
+        z, new_state = bn_layer.apply(p_bn, s_bn, y, train=False)
+    return (z.reshape(b, h, w_, n), new_state,
+            y.reshape(b, h, w_, n))

@@ -34,6 +34,8 @@ class ComputationGraph:
         self._epoch = 0
         self._compute_dtype = resolve_dtype(conf.data_type) or jnp.float32
         self._rng_key = jax.random.PRNGKey(conf.seed)
+        self._fused_pairs = {}   # bn node -> conv node (nn/fused.py)
+        self._fused_convs = set()
 
     # layer-bearing node names in topo order
     @property
@@ -49,6 +51,12 @@ class ComputationGraph:
     def init(self):
         if not self.conf.node_output_types:
             raise ValueError("setInputTypes(...) required before init()")
+        from deeplearning4j_tpu.nn.fused import (find_conv1x1_bn_fusions,
+                                                 fusion_enabled)
+        # per-instance execution decision; the shared conf is never mutated
+        self._fused_pairs = (find_conv1x1_bn_fusions(self.conf)
+                             if fusion_enabled() else {})
+        self._fused_convs = set(self._fused_pairs.values())
         key = jax.random.PRNGKey(self.conf.seed)
         ps, ss = {}, {}
         for name in self.conf.topo_order:
@@ -99,6 +107,8 @@ class ComputationGraph:
 
     def clone(self):
         m = ComputationGraph(self.conf)
+        m._fused_pairs = dict(self._fused_pairs)
+        m._fused_convs = set(self._fused_convs)
         if self._params is not None:
             # real copies — the live net's jitted train step donates buffers
             m._params = jax.tree_util.tree_map(jnp.copy, self._params)
@@ -173,10 +183,32 @@ class ComputationGraph:
             pmask = parent_masks[0]
             if node.preprocessor is not None:
                 x = node.preprocessor.preProcess(x)
+            if name in getattr(self, "_fused_convs", ()):
+                # conv half of a conv1x1+BN fused pair (nn/fused.py):
+                # pass the input through; the BN node runs the fused
+                # kernel with both param groups and back-fills this
+                # node's true activation. li still advances so every
+                # layer keeps its rng stream slot.
+                acts[name] = x
+                node_masks[name] = pmask
+                li += 1
+                continue
             lrng = jax.random.fold_in(rng, li) if rng is not None else None
             li += 1
             p = params.get(name, {})
             s = state.get(name, {})
+            fc = getattr(self, "_fused_pairs", {}).get(name)
+            if fc is not None:
+                from deeplearning4j_tpu.nn.fused import fused_apply
+                y, ns, y_conv = fused_apply(self.nodes[fc].ref, layer,
+                                            params.get(fc, {}), p, s, x,
+                                            ltrain)
+                acts[name] = y
+                acts[fc] = y_conv  # feedForward sees the real conv output
+                if ns:
+                    new_state[name] = ns
+                node_masks[name] = pmask
+                continue
             if name in self.conf.output_names and hasattr(layer, "compute_loss"):
                 pre = layer.pre_activation(p, layer._dropout_in(x, ltrain, lrng))
                 preacts[name] = pre

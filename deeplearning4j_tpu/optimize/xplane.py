@@ -1,0 +1,116 @@
+"""XPlane (jax.profiler / XLA device trace) reader.
+
+≡ the reference's SystemInfo/profiling analysis surface (deeplearning4j-core
+:: util.ModelSerializer-adjacent perf tooling; nd4j OpExecutioner profiling
+mode): turns the xplane.pb protobuf written by `jax.profiler.trace` /
+ProfilerListener into per-op time tables, with no tensorboard/tensorflow
+dependency — the wire decoding rides the same minimal protobuf codec as the
+TF frozen-graph importer (autodiff/tfproto.py).
+
+Field numbers follow tensorflow/tsl/profiler/protobuf/xplane.proto:
+  XSpace.planes = 1
+  XPlane: id=1, name=2, lines=3, event_metadata=4 (map), stat_metadata=5
+  XLine:  id=1, name=2, timestamp_ns=3, events=4
+  XEvent: metadata_id=1, offset_ps=2, duration_ps=3
+  XEventMetadata: id=1, name=2, display_name=3
+
+Usage:
+  rows = op_breakdown("/tmp/trace")        # aggregated per-op-name
+  for name, ms, n in rows[:20]: print(f"{ms:8.2f} ms  x{n:<4d} {name}")
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+from deeplearning4j_tpu.autodiff.tfproto import parse_fields
+
+
+def _decode_map_entry(buf):
+    """protobuf map<int64, Message> entry -> (key, value_bytes)."""
+    f = parse_fields(buf)
+    key = f.get(1, [0])[0]
+    val = f.get(2, [b""])[0]
+    return key, val
+
+
+def find_xplane_files(trace_dir):
+    """All xplane.pb files under a jax.profiler trace directory."""
+    return sorted(glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.xplane.pb")))
+
+
+def parse_xspace(path):
+    """xplane.pb -> list of planes:
+    {"name": str, "lines": [{"name": str, "events": [(meta_name,
+    duration_ps)]}]}."""
+    with open(path, "rb") as f:
+        space = parse_fields(f.read())
+    planes = []
+    for praw in space.get(1, []):
+        pf = parse_fields(praw)
+        name = pf.get(2, [b""])[0].decode("utf-8", "replace")
+        metas = {}
+        for mraw in pf.get(4, []):
+            k, v = _decode_map_entry(mraw)
+            mf = parse_fields(v)
+            metas[k] = mf.get(2, [b""])[0].decode("utf-8", "replace")
+            # display_name (3) is the prettier name when present
+            disp = mf.get(3, [b""])[0]
+            if disp:
+                metas[k] = disp.decode("utf-8", "replace")
+        lines = []
+        for lraw in pf.get(3, []):
+            lf = parse_fields(lraw)
+            lname = lf.get(2, [b""])[0].decode("utf-8", "replace")
+            events = []
+            for eraw in lf.get(4, []):
+                ef = parse_fields(eraw)
+                mid = ef.get(1, [0])[0]
+                dur = ef.get(3, [0])[0]
+                events.append((metas.get(mid, str(mid)), dur))
+            lines.append({"name": lname, "events": events})
+        planes.append({"name": name, "lines": lines})
+    return planes
+
+
+def op_breakdown(trace_dir, device_substr="TPU", line_substr=None):
+    """Aggregate device-plane op durations across a trace directory.
+
+    Returns [(op_name, total_ms, count)] sorted by total time descending.
+    `device_substr` picks the device planes ("TPU", "GPU", or "" for
+    CPU-only traces where XLA ops land on host-thread planes).
+    `line_substr` picks activity lines within a plane; the default (None)
+    uses the serialized "XLA Ops" line when the plane has one — summing
+    every line would double-count, since "Steps" / "XLA Modules" /
+    "Async XLA Ops" events span the same wall time — and otherwise
+    falls back to all lines (CPU traces have per-thread lines instead).
+    """
+    totals, counts = {}, {}
+    for path in find_xplane_files(trace_dir):
+        for plane in parse_xspace(path):
+            pname = plane["name"]
+            if device_substr.lower() not in pname.lower():
+                continue
+            lines = plane["lines"]
+            if line_substr is not None:
+                lines = [l for l in lines if line_substr in l["name"]]
+            elif any(l["name"] == "XLA Ops" for l in lines):
+                lines = [l for l in lines if l["name"] == "XLA Ops"]
+            for line in lines:
+                for name, dur in line["events"]:
+                    totals[name] = totals.get(name, 0) + dur
+                    counts[name] = counts.get(name, 0) + 1
+    rows = [(n, t / 1e9, counts[n]) for n, t in totals.items()]
+    rows.sort(key=lambda r: -r[1])
+    return rows
+
+
+def print_breakdown(trace_dir, top=25, device_substr="TPU",
+                    line_substr=None, out=print):
+    rows = op_breakdown(trace_dir, device_substr, line_substr)
+    total = sum(r[1] for r in rows)
+    out(f"device total: {total:.2f} ms across {len(rows)} distinct ops")
+    for name, ms, n in rows[:top]:
+        out(f"{ms:9.3f} ms  x{n:<5d} {name[:90]}")
+    return rows

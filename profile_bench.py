@@ -55,6 +55,9 @@ def main():
     print(f"step={dt*1000:.1f}ms  {batch/dt:.1f} img/s", file=sys.stderr)
     print(f"trace in {trace_dir}", file=sys.stderr)
 
+    from deeplearning4j_tpu.optimize.xplane import print_breakdown
+    print_breakdown(trace_dir, top=int(os.environ.get("PROFILE_TOP", "30")))
+
 
 if __name__ == "__main__":
     main()

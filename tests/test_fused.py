@@ -1,0 +1,189 @@
+"""Conv1x1+BN fusion pass (nn/fused.py + kernels/pointwise_conv.py):
+execution-only rewrite must be numerically equivalent to the unfused
+graph — forward, loss, AND one full train step — with identical
+parameter trees and serialization."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.nn import (ActivationLayer, BatchNormalization, ConvolutionLayer,
+                                   GlobalPoolingLayer, InputType,
+                                   NeuralNetConfiguration, Nesterovs,
+                                   OutputLayer)
+from deeplearning4j_tpu.nn.conf.graph_vertices import ElementWiseVertex
+
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+def _resnetish_conf():
+    """Tiny bottleneck-ish graph: two fusable conv1x1+BN pairs (stride 1 +
+    relu, stride 2 + identity), one NON-fusable pair (conv output feeds
+    both BN and the residual add), a 3x3 conv, and a residual join."""
+    g = (NeuralNetConfiguration.Builder()
+         .seed(11).updater(Nesterovs(0.05, 0.9)).weightInit("relu")
+         .graphBuilder()
+         .addInputs("input")
+         .setInputTypes(InputType.convolutional(8, 8, 4)))
+    g.addLayer("c1", ConvolutionLayer(kernelSize=(1, 1), nOut=8,
+                                      hasBias=False, activation="identity"),
+               "input")
+    g.addLayer("bn1", BatchNormalization(activation="relu"), "c1")
+    g.addLayer("c2", ConvolutionLayer(kernelSize=(3, 3), nOut=8,
+                                      convolutionMode="same", hasBias=False,
+                                      activation="identity"), "bn1")
+    g.addLayer("bn2", BatchNormalization(activation="identity"), "c2")
+    # c3 feeds BOTH bn3 and the add vertex -> must NOT be fused
+    g.addLayer("c3", ConvolutionLayer(kernelSize=(1, 1), nOut=8,
+                                      hasBias=False, activation="identity"),
+               "bn2")
+    g.addLayer("bn3", BatchNormalization(activation="identity"), "c3")
+    g.addVertex("add", ElementWiseVertex("add"), "bn3", "c3")
+    g.addLayer("relu", ActivationLayer(activation="relu"), "add")
+    # stride-2 fusable pair
+    g.addLayer("c4", ConvolutionLayer(kernelSize=(1, 1), stride=(2, 2),
+                                      convolutionMode="same", nOut=12,
+                                      hasBias=False, activation="identity"),
+               "relu")
+    g.addLayer("bn4", BatchNormalization(activation="relu"), "c4")
+    g.addLayer("pool", GlobalPoolingLayer(poolingType="avg"), "bn4")
+    g.addLayer("out", OutputLayer(lossFunction="mcxent", nOut=3,
+                                  activation="softmax"), "pool")
+    g.setOutputs("out")
+    return g.build()
+
+
+def _data():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((16, 8, 8, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    return x, y
+
+
+def _nets(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FUSE_CONV_BN", "0")
+    plain = ComputationGraph(_resnetish_conf()).init()
+    monkeypatch.setenv("DL4J_TPU_FUSE_CONV_BN", "1")
+    fused = ComputationGraph(_resnetish_conf()).init()
+    return plain, fused
+
+
+def test_marking_picks_exactly_the_fusable_pairs(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FUSE_CONV_BN", "1")
+    net = ComputationGraph(_resnetish_conf()).init()
+    assert net._fused_pairs == {"bn1": "c1", "bn4": "c4"}
+    # c2 (3x3 kernel) and c3 (two consumers) must not be fused
+    assert net._fused_convs == {"c1", "c4"}
+
+
+def test_fused_forward_matches_unfused(monkeypatch):
+    plain, fused = _nets(monkeypatch)
+    x, _ = _data()
+    # same seed -> identical init params
+    for name in plain._params:
+        for k in plain._params[name]:
+            np.testing.assert_array_equal(
+                np.asarray(plain._params[name][k]),
+                np.asarray(fused._params[name][k]))
+    # inference path
+    np.testing.assert_allclose(np.asarray(plain.output(x).numpy()),
+                               np.asarray(fused.output(x).numpy()),
+                               atol=1e-5, rtol=1e-5)
+    # train-mode forward (batch stats through the Pallas kernels)
+    np.testing.assert_allclose(
+        np.asarray(plain.output(x, train=True).numpy()),
+        np.asarray(fused.output(x, train=True).numpy()),
+        atol=1e-4, rtol=1e-4)
+
+
+def test_fused_train_step_matches_unfused(monkeypatch):
+    plain, fused = _nets(monkeypatch)
+    x, y = _data()
+    ds = DataSet(x, y)
+    for _ in range(3):
+        plain.fit(ds)
+        fused.fit(ds)
+    assert np.isfinite(plain.score(ds)) and np.isfinite(fused.score(ds))
+    np.testing.assert_allclose(plain.score(ds), fused.score(ds),
+                               atol=2e-4, rtol=2e-4)
+    for name in plain._params:
+        for k in plain._params[name]:
+            np.testing.assert_allclose(
+                np.asarray(plain._params[name][k]),
+                np.asarray(fused._params[name][k]),
+                atol=2e-3, rtol=2e-3, err_msg=f"{name}/{k}")
+    # BN running stats updated identically through the fused path
+    for name in ("bn1", "bn4"):
+        for k in ("mean", "var"):
+            np.testing.assert_allclose(
+                np.asarray(plain._state[name][k]),
+                np.asarray(fused._state[name][k]),
+                atol=1e-4, rtol=1e-4, err_msg=f"{name}/{k}")
+
+
+def test_fused_net_serialization_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FUSE_CONV_BN", "1")
+    net = ComputationGraph(_resnetish_conf()).init()
+    x, y = _data()
+    net.fit(DataSet(x, y))
+    ref = net.output(x).numpy()
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+    path = str(tmp_path / "fused.zip")
+    ModelSerializer.writeModel(net, path, True)
+    loaded = ModelSerializer.restoreComputationGraph(path)
+    np.testing.assert_allclose(np.asarray(loaded.output(x).numpy()),
+                               np.asarray(ref), atol=1e-5)
+
+
+def test_padded_conv1x1_not_fused(monkeypatch):
+    # explicit nonzero padding changes a 1x1 conv's output shape; the
+    # GEMM path must refuse it (code-review finding)
+    monkeypatch.setenv("DL4J_TPU_FUSE_CONV_BN", "1")
+    g = (NeuralNetConfiguration.Builder()
+         .seed(3).updater(Nesterovs(0.05, 0.9))
+         .graphBuilder()
+         .addInputs("input")
+         .setInputTypes(InputType.convolutional(8, 8, 4)))
+    g.addLayer("c", ConvolutionLayer(kernelSize=(1, 1), padding=(1, 1),
+                                     nOut=8, hasBias=False,
+                                     activation="identity"), "input")
+    g.addLayer("bn", BatchNormalization(activation="relu"), "c")
+    g.addLayer("pool", GlobalPoolingLayer(poolingType="avg"), "bn")
+    g.addLayer("out", OutputLayer(lossFunction="mcxent", nOut=3,
+                                  activation="softmax"), "pool")
+    g.setOutputs("out")
+    net = ComputationGraph(g.build()).init()
+    assert net._fused_pairs == {}
+    x, _ = _data()
+    assert net.output(x).numpy().shape == (16, 3)
+
+
+def test_fusion_is_per_instance_not_per_conf(monkeypatch):
+    # two nets from ONE conf object: fusion is an instance-level
+    # execution decision, never shared-conf mutation (code-review finding)
+    conf = _resnetish_conf()
+    monkeypatch.setenv("DL4J_TPU_FUSE_CONV_BN", "1")
+    fused = ComputationGraph(conf).init()
+    assert fused._fused_pairs == {"bn1": "c1", "bn4": "c4"}
+    monkeypatch.setenv("DL4J_TPU_FUSE_CONV_BN", "0")
+    plain = ComputationGraph(conf).init()
+    assert plain._fused_pairs == {}
+    # the first net keeps its fused path
+    assert fused._fused_pairs == {"bn1": "c1", "bn4": "c4"}
+    # clone inherits the source net's decision
+    assert fused.clone()._fused_pairs == {"bn1": "c1", "bn4": "c4"}
+    assert plain.clone()._fused_pairs == {}
+
+
+def test_feedforward_reports_true_conv_activation(monkeypatch):
+    # the fused conv node's recorded activation must be the real conv
+    # output, not the passthrough input (code-review finding)
+    plain, fused = _nets(monkeypatch)
+    x, _ = _data()
+    af = fused.feedForward(x, train=True)
+    ap = plain.feedForward(x, train=True)
+    for node in ("c1", "bn1", "c4", "bn4"):
+        a, p = af[node].numpy(), ap[node].numpy()
+        assert a.shape == p.shape, node
+        np.testing.assert_allclose(a, p, atol=1e-4, rtol=1e-4, err_msg=node)

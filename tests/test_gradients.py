@@ -218,3 +218,80 @@ class TestFusedBatchNorm:
         r = 1.0 / np.sqrt(np.asarray(st["var"]) + 1e-5)
         want = (np.asarray(x) - np.asarray(st["mean"])) * r
         np.testing.assert_allclose(np.asarray(y), want, atol=1e-4, rtol=1e-4)
+
+
+class TestSpaceToDepthConv:
+    """_space_to_depth_conv must be bit-for-bit the same conv, fwd and bwd,
+    for every (kernel, stride, padding) geometry the stem path can hit."""
+
+    GEOMS = [
+        # (k, s, mode/padding, H, W)  — resnet stem shape class last
+        ((7, 7), (2, 2), "same", 16, 16),
+        ((3, 3), (2, 2), "same", 12, 10),
+        ((5, 5), (4, 4), "same", 16, 16),
+        ((7, 7), (2, 2), (3, 3), 16, 16),   # odd explicit pad → r=1 phase
+        ((4, 4), (2, 2), (1, 1), 10, 10),
+        ((7, 7), (2, 2), (0, 0), 18, 18),
+    ]
+
+    def _layers(self, k, s, pad):
+        kw = dict(kernelSize=k, stride=s, nOut=8, hasBias=False,
+                  activation="identity", nIn=3)
+        if pad == "same":
+            kw["convolutionMode"] = "same"
+        else:
+            kw["padding"] = pad
+        from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer
+        plain = ConvolutionLayer(**kw)
+        s2d = ConvolutionLayer(spaceToDepth=2, **kw)
+        for l in (plain, s2d):
+            l.apply_defaults({})
+        return plain, s2d
+
+    def test_forward_matches_plain_conv(self):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        for k, s, pad, H, W in self.GEOMS:
+            plain, s2d = self._layers(k, s, pad)
+            params, _, _ = plain.initialize(
+                jax.random.PRNGKey(0), InputType.convolutional(H, W, 3))
+            x = jax.random.normal(jax.random.PRNGKey(1), (2, H, W, 3),
+                                  jnp.float32)
+            ref = plain.pre_activation(params, x)
+            got = s2d.pre_activation(params, x)
+            assert got.shape == ref.shape, (k, s, pad)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       atol=1e-4, rtol=1e-4,
+                                       err_msg=str((k, s, pad)))
+
+    def test_gradients_match_plain_conv(self):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        k, s, pad, H, W = self.GEOMS[0]
+        plain, s2d = self._layers(k, s, pad)
+        params, _, _ = plain.initialize(
+            jax.random.PRNGKey(0), InputType.convolutional(H, W, 3))
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, H, W, 3),
+                              jnp.float32)
+
+        def loss(layer, w, xx):
+            return jnp.sum(jnp.tanh(layer.pre_activation({"W": w}, xx)))
+
+        gw_r, gx_r = jax.grad(lambda w, xx: loss(plain, w, xx), (0, 1))(
+            params["W"], x)
+        gw_s, gx_s = jax.grad(lambda w, xx: loss(s2d, w, xx), (0, 1))(
+            params["W"], x)
+        np.testing.assert_allclose(np.asarray(gw_s), np.asarray(gw_r),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(gx_s), np.asarray(gx_r),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_odd_spatial_falls_back(self):
+        # H not divisible by b → plain conv path, same result trivially
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        plain, s2d = self._layers((3, 3), (2, 2), "same")
+        params, _, _ = plain.initialize(
+            jax.random.PRNGKey(0), InputType.convolutional(9, 9, 3))
+        x = jax.random.normal(jax.random.PRNGKey(3), (2, 9, 9, 3),
+                              jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(s2d.pre_activation(params, x)),
+            np.asarray(plain.pre_activation(params, x)), atol=1e-5)

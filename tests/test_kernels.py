@@ -259,3 +259,83 @@ def test_flash_masked_under_jit():
     ref = _dense_masked(q, k, v, mask)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# fused 1x1-conv + BatchNorm kernels (kernels/pointwise_conv.py)
+# ---------------------------------------------------------------------------
+def _bn_ref(y, gamma, beta, eps):
+    yf = y.astype(jnp.float32)
+    mu = jnp.mean(yf, axis=0)
+    var = jnp.mean(yf * yf, axis=0) - mu * mu
+    r = jax.lax.rsqrt(var + eps)
+    return ((yf - mu) * r * gamma + beta).astype(y.dtype), mu, var
+
+
+def _fused_ref(x, w, gamma, beta, eps, act):
+    y = jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+    z, mu, var = _bn_ref(y, gamma, beta, eps)
+    if act == "relu":
+        z = jnp.maximum(z, 0)
+    return z, mu, var
+
+
+@pytest.mark.parametrize("act", ["identity", "relu"])
+@pytest.mark.parametrize("m", [256, 250])  # exact block and ragged-pad M
+def test_fused_conv1x1_bn_forward(act, m):
+    from deeplearning4j_tpu.kernels.pointwise_conv import fused_conv1x1_bn
+    k, n = 16, 24
+    kx, kw, kg = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(kx, (m, k), jnp.float32)
+    w = jax.random.normal(kw, (k, n), jnp.float32) * 0.2
+    gamma = jax.random.uniform(kg, (n,), jnp.float32, 0.5, 1.5)
+    beta = jnp.linspace(-1, 1, n)
+    z, mu, var = fused_conv1x1_bn(x, w, gamma, beta, 1e-5, act, True)
+    zr, mur, varr = _fused_ref(x, w, gamma, beta, 1e-5, act)
+    np.testing.assert_allclose(np.asarray(mu), np.asarray(mur),
+                               atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(varr),
+                               atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(z), np.asarray(zr),
+                               atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("act", ["identity", "relu"])
+def test_fused_conv1x1_bn_grads_match_unfused(act):
+    from deeplearning4j_tpu.kernels.pointwise_conv import fused_conv1x1_bn
+    m, k, n = 250, 8, 12
+    kx, kw, kg, kt = jax.random.split(jax.random.PRNGKey(1), 4)
+    x = jax.random.normal(kx, (m, k), jnp.float32)
+    w = jax.random.normal(kw, (k, n), jnp.float32) * 0.3
+    gamma = jax.random.uniform(kg, (n,), jnp.float32, 0.5, 1.5)
+    beta = jnp.linspace(-0.5, 0.5, n)
+    t = jax.random.normal(kt, (m, n), jnp.float32)
+
+    def loss_fused(x, w, g, b):
+        z, _, _ = fused_conv1x1_bn(x, w, g, b, 1e-5, act, True)
+        return jnp.sum(z * t)
+
+    def loss_ref(x, w, g, b):
+        z, _, _ = _fused_ref(x, w, g, b, 1e-5, act)
+        return jnp.sum(z * t)
+
+    gf = jax.grad(loss_fused, (0, 1, 2, 3))(x, w, gamma, beta)
+    gr = jax.grad(loss_ref, (0, 1, 2, 3))(x, w, gamma, beta)
+    for a, b_, name in zip(gf, gr, "x w gamma beta".split()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-3, rtol=2e-3, err_msg=name)
+
+
+def test_fused_conv1x1_bn_bf16():
+    from deeplearning4j_tpu.kernels.pointwise_conv import fused_conv1x1_bn
+    m, k, n = 128, 8, 16
+    x = jax.random.normal(jax.random.PRNGKey(2), (m, k), jnp.bfloat16)
+    w = (jax.random.normal(jax.random.PRNGKey(3), (k, n), jnp.float32)
+         * 0.2).astype(jnp.bfloat16)
+    gamma = jnp.ones((n,), jnp.float32)
+    beta = jnp.zeros((n,), jnp.float32)
+    z, mu, var = fused_conv1x1_bn(x, w, gamma, beta, 1e-5, "relu", True)
+    assert z.dtype == jnp.bfloat16
+    zr, _, _ = _fused_ref(x, w, gamma, beta, 1e-5, "relu")
+    np.testing.assert_allclose(np.asarray(z, np.float32),
+                               np.asarray(zr, np.float32), atol=0.1)

@@ -68,6 +68,18 @@ class TestProfiling:
             fields = parse_fields(f.read())
         assert fields, "xplane.pb did not parse as protobuf"
 
+        # full structural decode: planes -> lines -> named events with
+        # durations (the per-op table bench/profiling analysis rides on)
+        from deeplearning4j_tpu.optimize import xplane
+        planes = xplane.parse_xspace(paths[0])
+        assert planes and all("name" in p and "lines" in p for p in planes)
+        # on the CPU backend XLA op events land on host threads
+        rows = xplane.op_breakdown(trace_dir, device_substr="")
+        assert rows, "no op events decoded from the trace"
+        name, ms, n = rows[0]
+        assert isinstance(name, str) and ms >= 0 and n >= 1
+        assert rows == sorted(rows, key=lambda r: -r[1])
+
     def test_environment_information(self, capsys):
         info = OpExecutioner.getInstance().printEnvironmentInformation()
         assert info["backend"] == "cpu"
