@@ -74,7 +74,7 @@ def _flash_fwd_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
         if causal:
             mask &= q_pos >= k_pos
         if has_mask:
-            mask &= km_ref[...] > 0          # (1, block_k) broadcasts
+            mask &= km_ref[0] > 0            # (1, block_k) broadcasts
         s = jnp.where(mask, s, _NEG_INF)
         m_prev, l_prev = m_ref[...], l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -98,8 +98,8 @@ def _flash_fwd_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
     def _finalize():
         o_ref[0] = (acc_ref[...] /
                     jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[...] +
-                      jnp.log(jnp.maximum(l_ref[...], 1e-30)))[:, 0]
+        lse_ref[0, 0] = (m_ref[...] +
+                         jnp.log(jnp.maximum(l_ref[...], 1e-30)))[:, 0]
 
 
 def _pad_to(x, axis, mult):
@@ -117,9 +117,9 @@ def _block_sizes(t, block_q, block_k):
 
 
 def _prep_mask(mask, block_k):
-    """(B, T) truthy mask → int32 padded to the k tiling (zero padding =
-    invalid keys, matching the padded K/V rows it covers)."""
-    return _pad_to(mask.astype(jnp.int32), 1, block_k)
+    """(B, T) truthy mask → int32 (B, 1, T_padded) for (1, 1, block_k)
+    tiles (zero padding = invalid keys, matching the padded K/V rows)."""
+    return _pad_to(mask.astype(jnp.int32), 1, block_k)[:, None, :]
 
 
 def _flash_forward(q, k, v, mask, causal, block_q, block_k, interpret):
@@ -148,7 +148,7 @@ def _flash_forward(q, k, v, mask, causal, block_q, block_k, interpret):
     operands = [qp, kp, vp]
     if mask is not None:
         in_specs.append(
-            pl.BlockSpec((1, block_k), lambda bh, i, j: (bh // h, j)))
+            pl.BlockSpec((1, 1, block_k), lambda bh, i, j: (bh // h, 0, j)))
         operands.append(_prep_mask(mask, block_k))
     out, lse = pl.pallas_call(
         kernel,
@@ -156,11 +156,14 @@ def _flash_forward(q, k, v, mask, causal, block_q, block_k, interpret):
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+            # row vectors ride as (N, 1, T) with (1, 1, block) tiles:
+            # a 2-D (1, block) tile violates the Mosaic (8, 128) minimum
+            # unless the block covers the full array dim
+            pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, tq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, tq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -171,6 +174,7 @@ def _flash_forward(q, k, v, mask, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*operands)
+    lse = lse[:, 0]
     out = out[:, :t, :].reshape(b, h, t, d)
     if mask is not None:
         qvalid = mask.astype(bool)                      # (B, T)
@@ -203,9 +207,9 @@ def _recompute_p(q_ref, k_ref, lse_ref, km_ref, qi, kj, block_q, block_k,
     if causal:
         mask &= q_pos >= k_pos
     if km_ref is not None:
-        mask &= km_ref[...] > 0
+        mask &= km_ref[0] > 0
     s = jnp.where(mask, s, _NEG_INF)
-    return jnp.exp(s - lse_ref[0][:, None])
+    return jnp.exp(s - lse_ref[0, 0][:, None])
 
 
 def _flash_bwd_dq_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
@@ -232,7 +236,7 @@ def _flash_bwd_dq_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
             do, v_ref[0].astype(jnp.float32),
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)     # dO·Vᵀ (bq, bk)
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0, 0][:, None])
         dq_acc[...] += scale * jax.lax.dot_general(
             ds, k_ref[0].astype(jnp.float32),
             dimension_numbers=(((1,), (0,)), ((), ())),
@@ -277,7 +281,7 @@ def _flash_bwd_dkv_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
             do, v_ref[0].astype(jnp.float32),
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0, 0][:, None])
         dk_acc[...] += scale * jax.lax.dot_general(
             ds, q_ref[0].astype(jnp.float32),
             dimension_numbers=(((0,), (0,)), ((), ())),
@@ -309,16 +313,17 @@ def _flash_backward(q, k, v, mask, o, lse, g, causal, block_q, block_k,
 
     qp = _pad_to(q.reshape(b * h, t, d), 1, block_q)
     dop = _pad_to(g.reshape(b * h, t, d), 1, block_q)
-    deltap = _pad_to(delta.reshape(b * h, t), 1, block_q)
+    deltap = _pad_to(delta.reshape(b * h, t), 1, block_q)[:, None, :]
     kp = _pad_to(k.reshape(b * h, t, d), 1, block_k)
     vp = _pad_to(v.reshape(b * h, t, d), 1, block_k)
     tq, tk = qp.shape[1], kp.shape[1]
     # lse comes back from forward already padded to the q tiling
-    lsep = lse if lse.shape[1] == tq else _pad_to(lse, 1, block_q)
+    lsep = (lse if lse.shape[1] == tq
+            else _pad_to(lse, 1, block_q))[:, None, :]
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
     k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
-    row_spec = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i))
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i))
 
     kmp = _prep_mask(mask, block_k) if has_mask else None
     operands = [qp, kp, vp, dop, lsep, deltap]
@@ -326,7 +331,7 @@ def _flash_backward(q, k, v, mask, o, lse, g, causal, block_q, block_k,
     if has_mask:
         operands.append(kmp)
         in_specs.append(
-            pl.BlockSpec((1, block_k), lambda bh, i, j: (bh // h, j)))
+            pl.BlockSpec((1, 1, block_k), lambda bh, i, j: (bh // h, 0, j)))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
@@ -345,13 +350,13 @@ def _flash_backward(q, k, v, mask, o, lse, g, causal, block_q, block_k,
     # dk/dv: swap the roles — k tiles outer, q tiles innermost
     q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
     k_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
-    row_spec2 = pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i))
+    row_spec2 = pl.BlockSpec((1, 1, block_q), lambda bh, j, i: (bh, 0, i))
     operands2 = [qp, kp, vp, dop, lsep, deltap]
     in_specs2 = [q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2]
     if has_mask:
         operands2.append(kmp)
         in_specs2.append(
-            pl.BlockSpec((1, block_k), lambda bh, j, i: (bh // h, j)))
+            pl.BlockSpec((1, 1, block_k), lambda bh, j, i: (bh // h, 0, j)))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_k=block_k,
                           causal=causal, scale=scale, t_actual=t,

@@ -15,7 +15,7 @@ import numpy as np
 import optax
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
-from deeplearning4j_tpu.nn.multilayer import _l1l2_penalty
+from deeplearning4j_tpu.nn.multilayer import _apply_layer, _l1l2_penalty
 from deeplearning4j_tpu.nn.updaters import build_optimizer, same_updater
 from deeplearning4j_tpu.ops.ndarray import NDArray, as_jax, resolve_dtype
 
@@ -232,8 +232,7 @@ class ComputationGraph:
                 node_masks[name] = (layer.feed_forward_mask(pmask)
                                     if pmask is not None else None)
             else:
-                y, ns = layer.apply(p, s, x, train=ltrain, rng=lrng,
-                                    mask=pmask)
+                y, ns = _apply_layer(layer, p, s, x, ltrain, lrng, pmask)
                 acts[name] = y
                 if ns:
                     new_state[name] = ns

@@ -42,6 +42,21 @@ def _l1l2_penalty(layer_confs, params):
     return total
 
 
+def _apply_layer(layer, p, s, x, ltrain, lrng, mask):
+    """Run one layer, honouring its `remat` flag: remat=True wraps the
+    train-mode apply in jax.checkpoint so activations inside the layer are
+    recomputed during backward instead of stored — the DSL-level knob for
+    trading FLOPs against HBM on deep/long-sequence models (any layer
+    config accepts remat=True / .remat(True); ≡ the role of the
+    reference's workspace memory modes, but as a per-layer rematerialization
+    policy the XLA way)."""
+    if ltrain and getattr(layer, "remat", False):
+        def inner(p_, s_, x_, r_, m_):
+            return layer.apply(p_, s_, x_, train=True, rng=r_, mask=m_)
+        return jax.checkpoint(inner)(p, s, x, lrng, mask)
+    return layer.apply(p, s, x, train=ltrain, rng=lrng, mask=mask)
+
+
 class MultiLayerNetwork:
     def __init__(self, conf):
         self.conf = conf
@@ -182,7 +197,7 @@ class MultiLayerNetwork:
                 x, carry = layer.scan_apply(p, x, carries.get(str(i)), mask)
                 new_carries[str(i)] = carry
             else:
-                x, ns = layer.apply(p, s, x, train=ltrain, rng=lrng, mask=mask)
+                x, ns = _apply_layer(layer, p, s, x, ltrain, lrng, mask)
                 if ns:
                     new_state[str(i)] = ns
             if mask is not None:

@@ -14,10 +14,13 @@ from deeplearning4j_tpu.parallel.elastic import (ElasticCheckpointer,
 from deeplearning4j_tpu.parallel.pipeline import (make_pipeline_fn,
                                                   make_pipelined_loss,
                                                   stack_stage_params)
+from deeplearning4j_tpu.parallel.zero import (shard_optimizer_state,
+                                              state_memory_bytes)
 
 __all__ = ["DeviceMesh", "initialize_distributed", "ParallelWrapper",
            "ParameterAveragingTrainer", "ShardedTrainer",
            "blockwise_attention", "dense_attention", "make_ring_attention",
            "ring_attention", "encoded_updater", "threshold_encoding",
            "make_pipeline_fn", "make_pipelined_loss", "stack_stage_params",
-           "ElasticCheckpointer", "ElasticTrainer", "initialize_multihost"]
+           "ElasticCheckpointer", "ElasticTrainer", "initialize_multihost",
+           "shard_optimizer_state", "state_memory_bytes"]

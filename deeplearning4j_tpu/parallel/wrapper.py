@@ -25,7 +25,8 @@ from deeplearning4j_tpu.parallel.mesh import DeviceMesh
 
 class ParallelWrapper:
     def __init__(self, model, workers=None, prefetch_buffer=2,
-                 averaging_frequency=1, report_score=True, devices=None):
+                 averaging_frequency=1, report_score=True, devices=None,
+                 shard_optimizer_state=False):
         self.model = model
         devs = list(devices if devices is not None else jax.devices())
         n = workers or len(devs)
@@ -33,6 +34,7 @@ class ParallelWrapper:
         self.prefetch_buffer = prefetch_buffer
         self.averaging_frequency = averaging_frequency  # sync SPMD ⇒ always 1
         self.report_score = report_score
+        self.shard_optimizer_state = shard_optimizer_state  # ZeRO-1
 
     class Builder:
         def __init__(self, model):
@@ -55,6 +57,11 @@ class ParallelWrapper:
             self._kw["report_score"] = bool(flag)
             return self
 
+        def shardOptimizerState(self, flag=True):
+            """ZeRO-1: shard updater state over dp (parallel/zero.py)."""
+            self._kw["shard_optimizer_state"] = bool(flag)
+            return self
+
         def workspaceMode(self, *_):
             return self  # XLA buffer reuse; accepted for parity
 
@@ -68,7 +75,12 @@ class ParallelWrapper:
     def _shard_model(self):
         m = self.model
         m._params = self.mesh.replicate(m._params)
-        m._opt_state = self.mesh.replicate(m._opt_state)
+        if self.shard_optimizer_state:
+            from deeplearning4j_tpu.parallel.zero import \
+                shard_optimizer_state
+            m._opt_state = shard_optimizer_state(m._opt_state, self.mesh)
+        else:
+            m._opt_state = self.mesh.replicate(m._opt_state)
         if m._state:
             m._state = self.mesh.replicate(m._state)
 

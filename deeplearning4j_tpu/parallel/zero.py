@@ -1,0 +1,78 @@
+"""ZeRO-1 optimizer-state sharding over the data-parallel axis.
+
+The reference's data-parallel trainers (deeplearning4j-parallel-wrapper ::
+parallelism.ParallelWrapper, dl4j-spark :: SharedTrainingMaster) replicate
+the full updater state on every worker. On TPU the updater state for a
+large model (Adam: 2 fp32 moments + fp32 master = 12 bytes/param) is the
+dominant per-chip memory cost of data parallelism — ZeRO stage 1
+(Rajbhandari et al. 2019, arXiv:1910.02054) shards it across the dp axis
+instead.
+
+TPU-native inversion: no gradient bucketing or hand-written
+reduce-scatter. Each optimizer-state leaf is placed with a NamedSharding
+that splits its largest dp-divisible axis; parameters stay replicated.
+Inside the SAME jitted train step GSPMD then partitions the update math
+by the state sharding and inserts the reduce-scatter (for the gradient
+slice each device consumes) and the all-gather (to rebuild replicated
+updated params) as ICI collectives — the step stays ONE XLA program and
+the memory for moments drops by ~dp×.
+
+Usage:
+    pw = (ParallelWrapper.Builder(net).workers(8)
+          .shardOptimizerState(True).build())
+    pw.fit(iterator)
+or directly:
+    opt_state = shard_optimizer_state(opt_state, mesh, axis="dp")
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _leaf_spec(shape, n):
+    """PartitionSpec splitting the largest axis divisible by n; None if no
+    axis qualifies (small/scalar leaves stay replicated)."""
+    best = -1
+    for d, s in enumerate(shape):
+        if s % n == 0 and s >= n and (best < 0 or s > shape[best]):
+            best = d
+    if best < 0:
+        return None
+    return best
+
+
+def shard_optimizer_state(opt_state, mesh, axis="dp"):
+    """Place every array leaf of an optax state tree with its largest
+    dp-divisible axis sharded over `axis`; everything else replicated.
+
+    mesh: DeviceMesh or jax.sharding.Mesh."""
+    jmesh = getattr(mesh, "mesh", mesh)
+    n = dict(zip(jmesh.axis_names, jmesh.devices.shape))[axis]
+
+    def place(leaf):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            return leaf
+        d = _leaf_spec(shape, n)
+        if d is None:
+            sh = NamedSharding(jmesh, P())
+        else:
+            spec = [None] * len(shape)
+            spec[d] = axis
+            sh = NamedSharding(jmesh, P(*spec))
+        return jax.device_put(leaf, sh)
+
+    return jax.tree_util.tree_map(place, opt_state)
+
+
+def state_memory_bytes(opt_state):
+    """Total bytes of the state tree as addressed on THIS process — with
+    ZeRO sharding each process holds ~1/dp of the replicated size."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(opt_state):
+        if hasattr(leaf, "addressable_shards"):
+            total += sum(s.data.nbytes for s in leaf.addressable_shards)
+        elif hasattr(leaf, "nbytes"):
+            total += leaf.nbytes
+    return total

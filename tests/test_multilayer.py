@@ -188,3 +188,39 @@ def test_summary_prints():
     net = MultiLayerNetwork(_mlp_conf()).init()
     s = net.summary()
     assert "DenseLayer" in s and "Total params" in s
+
+
+def test_remat_layer_matches_plain():
+    """remat=True (jax.checkpoint around the layer apply) must be
+    numerically invisible: same outputs, same trained params."""
+    import jax
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer,
+                                       Sgd)
+    from deeplearning4j_tpu.datasets import DataSet
+
+    def _net(remat):
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(5).updater(Sgd(0.1)).activation("tanh")
+                .list()
+                .layer(DenseLayer.Builder().nOut(16).remat(remat).build())
+                .layer(DenseLayer.Builder().nOut(16).remat(remat).build())
+                .layer(OutputLayer.Builder("mcxent").nOut(3)
+                       .activation("softmax").build())
+                .setInputType(InputType.feedForward(6))
+                .build())
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((32, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    plain, remat = _net(False), _net(True)
+    ds = DataSet(x, y)
+    for _ in range(4):
+        plain.fit(ds)
+        remat.fit(ds)
+    np.testing.assert_allclose(plain.params().numpy(),
+                               remat.params().numpy(),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(plain.score(ds), remat.score(ds), rtol=1e-6)
