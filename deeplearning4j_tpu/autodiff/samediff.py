@@ -470,6 +470,32 @@ class SameDiff:
     def create():
         return SameDiff()
 
+    def summary(self):
+        """≡ SameDiff.summary(): table of variables (name, kind, shape)
+        and op nodes (name, op, inputs)."""
+        lines = ["--- SameDiff summary ---",
+                 f"{'Name':<24} {'Kind':<12} {'Shape/Op':<20} Inputs"]
+        n_vars = n_ops = 0
+        for name, v in self._nodes.items():
+            if v.vtype == VariableType.ARRAY:
+                n_ops += 1
+                op = getattr(v, "opname", None) or (
+                    v.fn.__name__ if v.fn is not None else "?")
+                if op in ("<lambda>", "?"):
+                    op = name.rsplit("_", 1)[0]  # node names carry the op
+                lines.append(f"{name:<24} {'op':<12} {op:<20} "
+                             f"{', '.join(v.inputs)}")
+            else:
+                n_vars += 1
+                shape = tuple(v.shape) if v.shape is not None else "?"
+                val = self._values.get(name)
+                if val is not None:
+                    shape = tuple(val.shape)
+                lines.append(f"{name:<24} {v.vtype:<12} {str(shape):<20}")
+        lines.append(f"--- {n_vars} variables, {n_ops} ops, "
+                     f"losses: {self._loss_names or '[]'} ---")
+        return "\n".join(lines)
+
     def _invalidate(self):
         self._exec_cache = {}
 

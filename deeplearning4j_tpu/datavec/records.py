@@ -59,30 +59,71 @@ class CollectionRecordReader(RecordReader):
 
 
 class CSVRecordReader(RecordReader):
-    """≡ datavec CSVRecordReader(skipLines, delimiter)."""
+    """≡ datavec CSVRecordReader(skipLines, delimiter).
+
+    All-numeric files additionally parse through the native C++ runtime in
+    one pass (runtime/native :: dl4j_csv_parse — the hot path the
+    reference keeps in datavec's native loaders); `numeric_matrix()` then
+    hands the whole float32 table to RecordReaderDataSetIterator without
+    the per-field Python float() loop. Record-level semantics (lists of
+    stripped strings from `next()`) are unchanged."""
 
     def __init__(self, skipNumLines=0, delimiter=","):
         self.skip = int(skipNumLines)
         self.delimiter = delimiter
-        self._rows = []
+        self._text = ""
+        self._rows = None    # parsed lazily: the bulk path never needs them
         self._i = 0
+        self._matrix = None
 
     def initialize(self, path_or_text):
         if isinstance(path_or_text, str) and os.path.exists(path_or_text):
             with open(path_or_text, newline="") as f:
-                rows = list(csv.reader(f, delimiter=self.delimiter))
+                text = f.read()
         else:
-            rows = list(csv.reader(io.StringIO(path_or_text),
-                                   delimiter=self.delimiter))
-        self._rows = rows[self.skip:]
+            text = path_or_text
+        self._text = text
+        self._rows = None
         self._i = 0
+        self._matrix = None
+        # native bulk parse is only trusted when it provably matches the
+        # record-level view: no interior blank lines after the skip (the
+        # native pass drops them; csv.reader yields [] rows), every field
+        # numeric (a single NaN falls back to the Python path)
+        body = text.split("\n")[self.skip:]
+        while body and not body[-1].strip():
+            body.pop()
+        if body and all(l.strip() for l in body):
+            try:
+                from deeplearning4j_tpu.runtime.native_lib import \
+                    csv_to_floats
+                import numpy as _np
+                m = csv_to_floats(text.encode(), self.delimiter, self.skip)
+                if (m is not None and m.size and m.shape[0] == len(body)
+                        and not _np.isnan(m).any()):
+                    self._matrix = m
+            except Exception:
+                self._matrix = None
         return self
 
+    def _ensure_rows(self):
+        if self._rows is None:
+            self._rows = list(csv.reader(
+                io.StringIO(self._text),
+                delimiter=self.delimiter))[self.skip:]
+        return self._rows
+
+    def numeric_matrix(self):
+        """float32 (rows, cols) for all-numeric files, else None. Only
+        valid on an unconsumed reader — after any next() the bulk view
+        would disagree with the remaining records."""
+        return self._matrix if self._i == 0 else None
+
     def hasNext(self):
-        return self._i < len(self._rows)
+        return self._i < len(self._ensure_rows())
 
     def next(self):
-        r = self._rows[self._i]
+        r = self._ensure_rows()[self._i]
         self._i += 1
         return [c.strip() for c in r]
 
@@ -326,16 +367,27 @@ class RecordReaderDataSetIterator(DataSetIterator):
     def __init__(self, reader, batch_size, labelIndex=None, numClasses=None,
                  regression=False):
         super().__init__(batch_size)
-        rows = [r for r in reader]
-        feats, labels = [], []
-        for r in rows:
-            vals = [float(v) for v in r]
+        mat = getattr(reader, "numeric_matrix", lambda: None)()
+        if mat is not None and mat.size:
+            # native bulk path: one C++ pass + numpy slicing, no per-field
+            # Python float() loop
             if labelIndex is None:
-                feats.append(vals)
+                feats, labels = mat, []
             else:
-                feats.append(vals[:labelIndex] + vals[labelIndex + 1:])
-                labels.append(vals[labelIndex])
-        self.features = np.asarray(feats, np.float32)
+                feats = np.delete(mat, labelIndex, axis=1)
+                labels = mat[:, labelIndex].tolist()
+            self.features = np.ascontiguousarray(feats, np.float32)
+        else:
+            rows = [r for r in reader]
+            feats, labels = [], []
+            for r in rows:
+                vals = [float(v) for v in r]
+                if labelIndex is None:
+                    feats.append(vals)
+                else:
+                    feats.append(vals[:labelIndex] + vals[labelIndex + 1:])
+                    labels.append(vals[labelIndex])
+            self.features = np.asarray(feats, np.float32)
         if labelIndex is None:
             self.labels = np.zeros((len(feats), 0), np.float32)
         elif regression:

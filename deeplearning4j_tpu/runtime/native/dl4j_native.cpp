@@ -56,6 +56,82 @@ void* dl4j_idx_read(const char* path, int64_t* dims, int32_t* ndim,
 void dl4j_free(void* p) { free(p); }
 
 // ---------------------------------------------------------------------------
+// Numeric CSV parsing (≡ datavec CSVRecordReader's hot path for all-numeric
+// tables). Single pass, no allocation per field, GIL released by ctypes.
+// ---------------------------------------------------------------------------
+// First pass over a NUL-terminated buffer: number of data rows (after
+// skip_rows, blank lines ignored) and columns of the first data row.
+void dl4j_csv_dims(const char* buf, char delim, int32_t skip_rows,
+                   int64_t* rows_out, int64_t* cols_out) {
+  int64_t rows = 0, cols = 0;
+  int32_t skipped = 0;
+  const char* p = buf;
+  while (*p) {
+    const char* line = p;
+    int64_t c = 1;
+    while (*p && *p != '\n') {
+      if (*p == delim) ++c;
+      ++p;
+    }
+    int64_t linelen = p - line;
+    if (*p) ++p;  // consume '\n'
+    // skip counts PHYSICAL lines (matching the Python path, where the
+    // csv module yields a row per line including blanks)
+    if (skipped < skip_rows) { ++skipped; continue; }
+    if (linelen == 0 || (linelen == 1 && line[0] == '\r')) continue;
+    if (rows == 0) cols = c;
+    ++rows;
+  }
+  *rows_out = rows;
+  *cols_out = cols;
+}
+
+// Second pass: fill out[rows*cols] float32. A field parses as a number
+// only when strtof consumes it EXACTLY (up to trailing spaces/'\r') —
+// empty, partial ("1.5abc"), and out-of-bounds parses all become NaN, so
+// the caller's NaN screen rejects files the Python float() path would
+// raise on. Short rows pad with NaN; long rows truncate. Returns values
+// written, or -1 if out would overflow.
+int64_t dl4j_csv_parse(const char* buf, char delim, int32_t skip_rows,
+                       int64_t rows, int64_t cols, float* out) {
+  int32_t skipped = 0;
+  int64_t r = 0, written = 0;
+  const char* p = buf;
+  while (*p && r < rows) {
+    const char* line = p;
+    while (*p && *p != '\n') ++p;
+    int64_t linelen = p - line;
+    const char* line_end = p;
+    if (*p) ++p;
+    if (skipped < skip_rows) { ++skipped; continue; }
+    if (linelen == 0 || (linelen == 1 && line[0] == '\r')) continue;
+    const char* q = line;
+    for (int64_t c = 0; c < cols; ++c) {
+      if (written >= rows * cols) return -1;
+      float v = __builtin_nanf("");
+      if (q <= line_end) {
+        // bound the field FIRST: strtof treats tabs/spaces as leading
+        // whitespace, so an empty whitespace-delimited field would
+        // otherwise swallow the next field's (or line's) number
+        const char* fe = q;
+        while (fe < line_end && *fe != delim) ++fe;
+        const char* te = fe;
+        while (te > q && (te[-1] == ' ' || te[-1] == '\r')) --te;
+        if (te > q) {
+          char* endp = nullptr;
+          float parsed = strtof(q, &endp);
+          if (endp > q && endp == te) v = parsed;  // exact consume only
+        }
+        q = (fe < line_end) ? fe + 1 : line_end + 1;
+      }
+      out[written++] = v;
+    }
+    ++r;
+  }
+  return written;
+}
+
+// ---------------------------------------------------------------------------
 // Buffer conversion / batch assembly
 // ---------------------------------------------------------------------------
 // uint8 -> float32 with affine scale: dst = src * scale + bias

@@ -60,6 +60,12 @@ def get_lib():
                                                c.c_int64, c.c_void_p]
         lib.dl4j_standardize.argtypes = [c.c_void_p, c.c_int64, c.c_int64,
                                          c.c_void_p, c.c_void_p]
+        lib.dl4j_csv_dims.argtypes = [c.c_char_p, c.c_char, c.c_int32,
+                                      c.POINTER(c.c_int64),
+                                      c.POINTER(c.c_int64)]
+        lib.dl4j_csv_parse.restype = c.c_int64
+        lib.dl4j_csv_parse.argtypes = [c.c_char_p, c.c_char, c.c_int32,
+                                       c.c_int64, c.c_int64, c.c_void_p]
         lib.dl4j_ring_create.restype = c.c_void_p
         lib.dl4j_ring_create.argtypes = [c.c_int64]
         lib.dl4j_ring_push.restype = c.c_int32
@@ -107,6 +113,37 @@ def idx_read(path):
     arr = np.frombuffer(buf, dtype=dtype).reshape(shape).copy()
     lib.dl4j_free(ptr)
     return arr
+
+
+def csv_to_floats(path_or_bytes, delimiter=",", skip_rows=0):
+    """Parse an all-numeric CSV natively into a float32 (rows, cols) array
+    (non-numeric/empty fields become NaN). Returns None when the native
+    lib is unavailable — callers fall back to the Python csv module."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    if isinstance(path_or_bytes, str) and os.path.exists(path_or_bytes):
+        with open(path_or_bytes, "rb") as f:
+            data = f.read()
+    elif isinstance(path_or_bytes, bytes):
+        data = path_or_bytes
+    else:
+        data = str(path_or_bytes).encode()
+    data = data + b"\0"
+    delim = delimiter.encode()[:1] or b","
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    lib.dl4j_csv_dims(data, delim, skip_rows,
+                      ctypes.byref(rows), ctypes.byref(cols))
+    r, c = rows.value, cols.value
+    if r <= 0 or c <= 0:
+        return np.empty((0, 0), np.float32)
+    out = np.empty((r, c), np.float32)
+    n = lib.dl4j_csv_parse(data, delim, skip_rows, r, c,
+                           out.ctypes.data_as(ctypes.c_void_p))
+    if n != r * c:
+        return None  # inconsistent parse: let the caller use the slow path
+    return out
 
 
 def gather_batch_u8(archive, indices, scale=1.0 / 255.0, bias=0.0, out=None):

@@ -17,20 +17,31 @@ CONFIGS = {
     "lhs": {"LIBTPU_INIT_ARGS": "--xla_tpu_enable_latency_hiding_scheduler=true"},
     "flags1": {"LIBTPU_INIT_ARGS":
                "--xla_tpu_aggressive_opt_barrier_removal=ENABLED"},
+    "vmem32": {"LIBTPU_INIT_ARGS": "--xla_tpu_scoped_vmem_limit_kib=32768"},
+    "vmem48": {"LIBTPU_INIT_ARGS": "--xla_tpu_scoped_vmem_limit_kib=49152"},
 }
 
 
 def run_one(name, env_extra):
     env = dict(os.environ)
-    env.update(env_extra)
-    env["BENCH_CHILD"] = "1"
+    env.pop("BENCH_CHILD", None)  # an inherited '1' would re-enable the
+    env.update(env_extra)         # in-process SIGKILL-wedge path
+    # NEVER set BENCH_CHILD here: running the measurement in-process and
+    # SIGKILLing it on timeout leaves the TPU tunnel's grant held and
+    # wedges the chip for hours (observed 2026-07-30, vmem-flag sweep).
+    # Go through bench.py's parent, which owns a kill-able child and a
+    # HARD deadline shorter than our subprocess timeout, so the bench
+    # process always exits cleanly on its own.
     env.setdefault("BENCH_STEPS", "20")
     env["BENCH_EXTRA"] = ""      # headline only
+    env.setdefault("BENCH_ATTEMPTS", "1")
+    env.setdefault("BENCH_ATTEMPT_TIMEOUT", "420")
+    env.setdefault("BENCH_DEADLINE", "440")
     t0 = time.time()
     bench = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "bench.py")
     p = subprocess.run([sys.executable, bench], capture_output=True,
-                       text=True, timeout=500, env=env)
+                       text=True, timeout=560, env=env)
     line = next((l for l in p.stdout.splitlines() if l.startswith("{")), "")
     print(f"{name:8s} {line}  [{time.time()-t0:.0f}s]", flush=True)
     for l in p.stderr.splitlines():

@@ -129,3 +129,103 @@ def test_executioner_profiling():
     stats = ex.getProfilingStats()
     assert stats["square_sum"]["count"] >= 1
     ex.setProfilingMode(False)
+
+
+class TestNativeCsv:
+    """dl4j_csv_parse: single-pass numeric CSV -> float32 matrix, exact
+    equality with the Python csv module on the same content."""
+
+    def test_numeric_matches_python_csv(self, tmp_path):
+        from deeplearning4j_tpu.runtime import native_lib
+        if not native_lib.available():
+            pytest.skip("native toolchain unavailable")
+        rng = np.random.default_rng(0)
+        arr = rng.standard_normal((37, 5)).astype(np.float32)
+        lines = ["h1,h2,h3,h4,h5"] + [
+            ",".join(f"{v:.6g}" for v in row) for row in arr]
+        path = tmp_path / "t.csv"
+        path.write_text("\n".join(lines) + "\n")
+        got = native_lib.csv_to_floats(str(path), ",", skip_rows=1)
+        assert got is not None and got.shape == (37, 5)
+        want = np.array([[float(x) for x in l.split(",")]
+                         for l in lines[1:]], np.float32)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_non_numeric_fields_become_nan(self):
+        from deeplearning4j_tpu.runtime import native_lib
+        if not native_lib.available():
+            pytest.skip("native toolchain unavailable")
+        got = native_lib.csv_to_floats(b"1.5,abc,3\n,2,\n")
+        assert got.shape == (2, 3)
+        assert got[0, 0] == 1.5 and np.isnan(got[0, 1]) and got[0, 2] == 3
+        # blank fields are NaN and must NOT swallow the next line's number
+        assert np.isnan(got[1, 0]) and got[1, 1] == 2 and np.isnan(got[1, 2])
+
+    def test_csv_reader_bulk_path_equivalence(self, tmp_path):
+        from deeplearning4j_tpu.datavec.records import (
+            CSVRecordReader, RecordReaderDataSetIterator)
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((24, 4)).astype(np.float32)
+        y = rng.integers(0, 3, 24)
+        rows = [",".join([f"{v:.6g}" for v in x[i]] + [str(y[i])])
+                for i in range(24)]
+        path = tmp_path / "d.csv"
+        path.write_text("\n".join(rows))
+        reader = CSVRecordReader().initialize(str(path))
+        it = RecordReaderDataSetIterator(reader, 8, labelIndex=4,
+                                         numClasses=3)
+        np.testing.assert_allclose(it.features,
+                                   np.array([[float(v) for v in r.split(",")[:4]]
+                                             for r in rows], np.float32),
+                                   rtol=1e-6)
+        assert it.labels.shape == (24, 3)
+        assert (it.labels.argmax(1) == y).all()
+        # string-labelled CSVs must keep the record-level slow path working
+        srows = [r + ",name" for r in rows]
+        sreader = CSVRecordReader().initialize("\n".join(srows))
+        assert sreader.numeric_matrix() is None
+        rec = sreader.next()
+        assert rec[-1] == "name"
+
+    def test_tab_delim_empty_field_stays_aligned(self):
+        # whitespace delimiter + empty field: strtof must not swallow the
+        # next field (bounded-field parse)
+        from deeplearning4j_tpu.runtime import native_lib
+        if not native_lib.available():
+            pytest.skip("native toolchain unavailable")
+        got = native_lib.csv_to_floats(b"1\t\t3\n4\t5\t6\n", "\t")
+        assert got.shape == (2, 3)
+        assert got[0, 0] == 1 and np.isnan(got[0, 1]) and got[0, 2] == 3
+        assert list(got[1]) == [4, 5, 6]
+
+    def test_trailing_garbage_is_nan_not_truncated(self):
+        from deeplearning4j_tpu.runtime import native_lib
+        if not native_lib.available():
+            pytest.skip("native toolchain unavailable")
+        got = native_lib.csv_to_floats(b"1.5abc,2\n3, 4 \n")
+        assert np.isnan(got[0, 0]) and got[0, 1] == 2
+        assert got[1, 0] == 3 and got[1, 1] == 4  # padded fields still parse
+
+    def test_skip_counts_physical_lines(self):
+        from deeplearning4j_tpu.runtime import native_lib
+        if not native_lib.available():
+            pytest.skip("native toolchain unavailable")
+        # blank first line consumes the skip, exactly like csv.reader slicing
+        got = native_lib.csv_to_floats(b"\n1,2\n3,4\n", skip_rows=1)
+        assert got.shape == (2, 2) and got[0, 0] == 1 and got[1, 1] == 4
+
+    def test_bulk_path_gates(self):
+        from deeplearning4j_tpu.datavec.records import CSVRecordReader
+        # interior blank line -> record/matrix views disagree -> no bulk
+        r = CSVRecordReader().initialize("1,2\n\n3,4\n")
+        assert r.numeric_matrix() is None
+        # partially-consumed reader -> no bulk matrix
+        r2 = CSVRecordReader().initialize("1,2\n3,4\n")
+        assert r2.numeric_matrix() is not None
+        r2.next()
+        assert r2.numeric_matrix() is None
+        r2.reset()
+        assert r2.numeric_matrix() is not None
+        # garbage suffix falls back to the Python path (which raises on use)
+        r3 = CSVRecordReader().initialize("1.5abc,2\n3,4\n")
+        assert r3.numeric_matrix() is None

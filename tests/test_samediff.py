@@ -214,3 +214,14 @@ class TestRound3Namespaces:
             sd.constant("x", xv), kernel=(2, 2), stride=(2, 2),
             padding="SAME").eval())
         np.testing.assert_allclose(out, np.ones_like(out), atol=1e-6)
+
+
+def test_summary_lists_variables_and_ops():
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", 4, 3)
+    w = sd.var("w", np.ones((3, 2), np.float32))
+    y = sd.nn.softmax(x.mmul(w))
+    s = sd.summary()
+    assert "placeholder" in s and "variable" in s
+    assert "mmul" in s and "softmax" in s
+    assert "2 variables, 2 ops" in s
