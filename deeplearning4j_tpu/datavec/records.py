@@ -93,7 +93,11 @@ class CSVRecordReader(RecordReader):
         body = text.split("\n")[self.skip:]
         while body and not body[-1].strip():
             body.pop()
-        if body and all(l.strip() for l in body):
+        # every row must have the SAME column count: the native parser
+        # truncates long rows / NaN-pads short ones, but the Python path
+        # raises on ragged tables — ragged input must take the strict path
+        widths = {l.count(self.delimiter) for l in body}
+        if body and len(widths) == 1 and all(l.strip() for l in body):
             try:
                 from deeplearning4j_tpu.runtime.native_lib import \
                     csv_to_floats

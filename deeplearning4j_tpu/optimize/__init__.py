@@ -7,7 +7,8 @@ from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
 from deeplearning4j_tpu.optimize.early_stopping import (  # noqa: F401
     BestScoreEpochTerminationCondition, ClassificationScoreCalculator,
     DataSetLossCalculator, EarlyStoppingConfiguration,
-    EarlyStoppingGraphTrainer, EarlyStoppingResult, EarlyStoppingTrainer,
+    EarlyStoppingGraphTrainer, EarlyStoppingParallelTrainer,
+    EarlyStoppingResult, EarlyStoppingTrainer,
     InMemoryModelSaver, InvalidScoreIterationTerminationCondition,
     LocalFileModelSaver, MaxEpochsTerminationCondition,
     MaxScoreIterationTerminationCondition,

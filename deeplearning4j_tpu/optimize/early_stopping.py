@@ -402,3 +402,46 @@ class EarlyStoppingTrainer:
 
 # Graph variant shares the implementation (same fit/score surface)
 EarlyStoppingGraphTrainer = EarlyStoppingTrainer
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """≡ deeplearning4j-parallel-wrapper ::
+    parallelism.EarlyStoppingParallelTrainer — early stopping over
+    data-parallel training. The reference coordinates worker threads;
+    here each epoch's fit runs the SPMD dp step via ParallelWrapper
+    (optionally with ZeRO-1 state sharding) and the scoring/termination
+    loop is inherited unchanged."""
+
+    def __init__(self, config, network, train_iterator, workers=None,
+                 shard_optimizer_state=False):
+        super().__init__(config, network, train_iterator)
+        if network._params is None:
+            network.init()
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        self._pw = ParallelWrapper(
+            network, workers=workers,
+            shard_optimizer_state=shard_optimizer_state)
+        self._pw._shard_model()
+        # route per-DataSet fits through the SAME dp inner loop as
+        # ParallelWrapper.fit (masks, padding, listeners included); every
+        # other attribute access — reads AND writes (epoch counters!) —
+        # passes straight through to the real network
+        self.net = _DpFitProxy(self._pw)
+
+
+class _DpFitProxy:
+    """Network stand-in whose fit(ds) is ParallelWrapper._fit_dataset;
+    everything else (including attribute writes like `_epoch += 1`)
+    operates on the wrapped network itself."""
+
+    def __init__(self, pw):
+        object.__setattr__(self, "_pw", pw)
+
+    def fit(self, ds):
+        return self._pw._fit_dataset(ds)
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_pw").model, name)
+
+    def __setattr__(self, name, value):
+        setattr(object.__getattribute__(self, "_pw").model, name, value)

@@ -42,8 +42,8 @@ def find_xplane_files(trace_dir):
 
 def parse_xspace(path):
     """xplane.pb -> list of planes:
-    {"name": str, "lines": [{"name": str, "events": [(meta_name,
-    duration_ps)]}]}."""
+    {"name": str, "lines": [{"name": str, "timestamp_ns": int,
+    "events": [(meta_name, duration_ps, offset_ps)]}]}."""
     with open(path, "rb") as f:
         space = parse_fields(f.read())
     planes = []
@@ -63,13 +63,16 @@ def parse_xspace(path):
         for lraw in pf.get(3, []):
             lf = parse_fields(lraw)
             lname = lf.get(2, [b""])[0].decode("utf-8", "replace")
+            ts_ns = lf.get(3, [0])[0]
             events = []
             for eraw in lf.get(4, []):
                 ef = parse_fields(eraw)
                 mid = ef.get(1, [0])[0]
+                off = ef.get(2, [0])[0]
                 dur = ef.get(3, [0])[0]
-                events.append((metas.get(mid, str(mid)), dur))
-            lines.append({"name": lname, "events": events})
+                events.append((metas.get(mid, str(mid)), dur, off))
+            lines.append({"name": lname, "timestamp_ns": ts_ns,
+                          "events": events})
         planes.append({"name": name, "lines": lines})
     return planes
 
@@ -98,7 +101,7 @@ def op_breakdown(trace_dir, device_substr="TPU", line_substr=None):
             elif any(l["name"] == "XLA Ops" for l in lines):
                 lines = [l for l in lines if l["name"] == "XLA Ops"]
             for line in lines:
-                for name, dur in line["events"]:
+                for name, dur, _off in line["events"]:
                     totals[name] = totals.get(name, 0) + dur
                     counts[name] = counts.get(name, 0) + 1
     rows = [(n, t / 1e9, counts[n]) for n, t in totals.items()]
@@ -114,3 +117,46 @@ def print_breakdown(trace_dir, top=25, device_substr="TPU",
     for name, ms, n in rows[:top]:
         out(f"{ms:9.3f} ms  x{n:<5d} {name[:90]}")
     return rows
+
+
+def to_chrome_trace(trace_dir, out_path, max_events=200000):
+    """Convert a jax.profiler trace directory into Chrome trace-event JSON
+    (open in chrome://tracing or ui.perfetto.dev — no TensorBoard needed;
+    ≡ the timeline view role of the reference's UI training dashboard).
+
+    One pid per XPlane, one tid per XLine; complete ('X') events with
+    microsecond timestamps. Returns the number of events written."""
+    import json
+
+    events = []
+    pid = 0
+    full = False
+    for path in find_xplane_files(trace_dir):
+        if full:
+            break
+        for plane in parse_xspace(path):
+            if full:
+                break
+            pid += 1
+            events.append({"ph": "M", "pid": pid, "name": "process_name",
+                           "args": {"name": plane["name"]}})
+            for tid, line in enumerate(plane["lines"], 1):
+                if full:
+                    break
+                events.append({"ph": "M", "pid": pid, "tid": tid,
+                               "name": "thread_name",
+                               "args": {"name": line["name"]}})
+                base_us = line["timestamp_ns"] / 1e3
+                for name, dur, off in line["events"]:
+                    if len(events) >= max_events:
+                        full = True
+                        break
+                    events.append({
+                        "ph": "X", "pid": pid, "tid": tid,
+                        "name": name.split(" = ")[0].lstrip("%"),
+                        "ts": base_us + off / 1e6,   # ps -> us
+                        "dur": max(dur / 1e6, 0.001),
+                    })
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return len(events)

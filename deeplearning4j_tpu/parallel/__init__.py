@@ -16,6 +16,8 @@ from deeplearning4j_tpu.parallel.pipeline import (make_pipeline_fn,
                                                   stack_stage_params)
 from deeplearning4j_tpu.parallel.zero import (shard_optimizer_state,
                                               state_memory_bytes)
+from deeplearning4j_tpu.parallel.inference import (InferenceMode,
+                                                   ParallelInference)
 
 __all__ = ["DeviceMesh", "initialize_distributed", "ParallelWrapper",
            "ParameterAveragingTrainer", "ShardedTrainer",
@@ -23,4 +25,5 @@ __all__ = ["DeviceMesh", "initialize_distributed", "ParallelWrapper",
            "ring_attention", "encoded_updater", "threshold_encoding",
            "make_pipeline_fn", "make_pipelined_loss", "stack_stage_params",
            "ElasticCheckpointer", "ElasticTrainer", "initialize_multihost",
-           "shard_optimizer_state", "state_memory_bytes"]
+           "shard_optimizer_state", "state_memory_bytes",
+           "InferenceMode", "ParallelInference"]

@@ -1,0 +1,198 @@
+"""ParallelInference (≡ deeplearning4j-parallel-wrapper ::
+inference.ParallelInference) — high-throughput shared-model inference.
+
+The reference keeps a pool of model replicas on worker threads and a
+batching queue in front of them (BATCHED mode: requests are coalesced up
+to batchLimit before a forward pass). TPU-native inversion: the model is
+ONE jitted executable that any thread may call (pure function of params),
+so replicas are pointless — the value is in the coalescing. A collector
+thread drains the request queue, groups compatible shapes, pads the
+batch dim to a power-of-two bucket (static shapes → no fresh XLA
+compiles per request count), runs a single forward, and scatters the
+rows back to their futures.
+
+Usage parity:
+    pi = (ParallelInference.Builder(net)
+          .inferenceMode(InferenceMode.BATCHED)
+          .batchLimit(32).queueLimit(256).build())
+    out = pi.output(x)          # thread-safe, blocks for the result
+    pi.shutdown()
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+
+class InferenceMode:
+    SEQUENTIAL = "SEQUENTIAL"   # direct call, no queue
+    BATCHED = "BATCHED"         # coalesce requests up to batchLimit
+    INPLACE = "INPLACE"         # reference alias: shared model, no copy —
+    #                             identical to BATCHED here (the jitted
+    #                             executable is already shared and pure)
+
+
+def _bucket(n):
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class _Request:
+    __slots__ = ("x", "event", "result", "error")
+
+    def __init__(self, x):
+        self.x = x
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class ParallelInference:
+    def __init__(self, model, inference_mode=InferenceMode.BATCHED,
+                 batch_limit=32, queue_limit=256, collect_timeout_ms=2.0):
+        self.model = model
+        self.mode = inference_mode
+        self.batch_limit = int(batch_limit)
+        self.collect_timeout = collect_timeout_ms / 1e3
+        self.model_calls = 0          # diagnostic: forwards actually run
+        self._queue = queue.Queue(maxsize=int(queue_limit))
+        self._shutdown = False
+        self._thread = None
+        if self.mode != InferenceMode.SEQUENTIAL:
+            self._thread = threading.Thread(target=self._collector,
+                                            daemon=True)
+            self._thread.start()
+
+    class Builder:
+        def __init__(self, model):
+            self._model = model
+            self._kw = {}
+
+        def inferenceMode(self, mode):
+            self._kw["inference_mode"] = mode
+            return self
+
+        def batchLimit(self, n):
+            self._kw["batch_limit"] = int(n)
+            return self
+
+        def queueLimit(self, n):
+            self._kw["queue_limit"] = int(n)
+            return self
+
+        def workers(self, *_):
+            return self  # one jitted executable serves all threads
+
+        def build(self):
+            return ParallelInference(self._model, **self._kw)
+
+    # -- client side -----------------------------------------------------
+    def output(self, x):
+        """Thread-safe inference. x: one example (features without batch
+        dim) or a batch; returns the model output with matching leading
+        dims."""
+        x = np.asarray(x, np.float32)
+        single = self._needs_batch(x)
+        if self.mode == InferenceMode.SEQUENTIAL or self._shutdown:
+            self.model_calls += 1
+            out = self.model.output(x[None] if single else x)
+            out = (out[0] if isinstance(out, list) else out).numpy()
+            return out[0] if single else out
+        req = _Request(x[None] if single else x)
+        self._queue.put(req)
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result[0] if single else req.result
+
+    def _needs_batch(self, x):
+        """True when x is ONE example (no batch dim): its rank equals the
+        model's expected feature rank."""
+        want = getattr(self.model, "_input_rank", None)
+        if want is None:
+            want = self._infer_input_rank()
+            self.model._input_rank = want
+        return x.ndim == want
+
+    def _infer_input_rank(self):
+        conf = getattr(self.model, "conf", None)
+        it = None
+        if conf is not None:
+            node_types = getattr(conf, "node_output_types", None)
+            input_names = getattr(conf, "input_names", None)
+            if node_types and input_names:
+                it = node_types.get(input_names[0])
+            else:
+                it = getattr(conf, "input_type", None)
+        from deeplearning4j_tpu.nn.conf.inputs import (ConvolutionalType,
+                                                       RecurrentType)
+        if isinstance(it, ConvolutionalType):
+            return 3
+        if isinstance(it, RecurrentType):
+            return 2
+        return 1
+
+    # -- collector thread ------------------------------------------------
+    def _collector(self):
+        while not self._shutdown:
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if first is None:
+                break
+            batch = [first]
+            total = first.x.shape[0]
+            # coalesce until batchLimit or a brief quiet period
+            while total < self.batch_limit:
+                try:
+                    nxt = self._queue.get(timeout=self.collect_timeout)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._shutdown = True
+                    break
+                if nxt.x.shape[1:] != first.x.shape[1:]:
+                    # incompatible feature shape: run it in its own pass
+                    self._run([nxt])
+                    continue
+                batch.append(nxt)
+                total += nxt.x.shape[0]
+            self._run(batch)
+
+    def _run(self, batch):
+        try:
+            xs = np.concatenate([r.x for r in batch], axis=0)
+            n = xs.shape[0]
+            nb = _bucket(n)
+            if nb != n:
+                # pad with copies of the last row: static bucket shapes
+                # keep XLA from compiling one executable per request count
+                xs = np.concatenate(
+                    [xs, np.repeat(xs[-1:], nb - n, axis=0)], axis=0)
+            self.model_calls += 1
+            out = self.model.output(xs)
+            out = (out[0] if isinstance(out, list) else out).numpy()[:n]
+            i = 0
+            for r in batch:
+                k = r.x.shape[0]
+                r.result = out[i:i + k]
+                i += k
+                r.event.set()
+        except Exception as e:  # noqa: BLE001 — deliver to the waiter
+            for r in batch:
+                r.error = e
+                r.event.set()
+
+    def shutdown(self):
+        if self._thread is not None and not self._shutdown:
+            self._shutdown = True
+            try:
+                self._queue.put_nowait(None)
+            except queue.Full:
+                pass
+            self._thread.join(timeout=5)

@@ -91,6 +91,51 @@ class ParallelWrapper:
         and value ranges valid, e.g. int label ids)."""
         return np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)])
 
+    def _fit_dataset(self, ds):
+        """One dp-sharded train step on a DataSet (the shared inner loop —
+        also driven by EarlyStoppingParallelTrainer)."""
+        feats = np.asarray(ds.features)
+        labs = np.asarray(ds.labels)
+        lm = None if ds.labelsMask is None \
+            else np.asarray(ds.labelsMask)
+        fm = None if ds.featuresMask is None \
+            else np.asarray(ds.featuresMask)
+        pad = (-feats.shape[0]) % self.mesh.size
+        if pad:
+            # Ragged final batch: pad rows to a multiple of the dp
+            # axis, and ZERO-WEIGHT them via the labels mask so the
+            # masked-mean loss (losses._apply_mask_mean) excludes
+            # them exactly — repeat-padding without a mask silently
+            # biased last-batch gradients (round-1 VERDICT).
+            b = feats.shape[0]
+            feats = self._pad_rows(feats, pad)
+            labs = self._pad_rows(labs, pad)
+            if lm is None:
+                mshape = labs.shape[:-1] if labs.ndim >= 2 \
+                    else labs.shape
+                lm = np.ones(mshape, np.float32)
+            else:
+                lm = self._pad_rows(lm, pad)
+            lm = lm.copy()
+            lm[b:] = 0.0
+            if fm is not None:
+                fm = self._pad_rows(fm, pad)
+        x = jax.device_put(feats, self.mesh.sharding("dp"))
+        y = jax.device_put(labs, self.mesh.sharding("dp"))
+        lmask = None if lm is None \
+            else jax.device_put(lm, self.mesh.sharding("dp"))
+        fmask = None if fm is None \
+            else jax.device_put(fm, self.mesh.sharding("dp"))
+        m = self.model
+        m._rng_key, sub = jax.random.split(m._rng_key)
+        m._params, m._opt_state, m._state, loss = m._train_step(
+            m._params, m._opt_state, m._state, x, y, fmask, lmask, sub)
+        m._score = float(loss)
+        m._iteration += 1
+        for listener in m._listeners:
+            listener.iterationDone(m, m._iteration, m._epoch)
+        return m._score
+
     def fit(self, iterator, epochs=1):
         """Data-parallel fit: same jitted train step as the wrapped model —
         input sharding makes it SPMD over the dp axis."""
@@ -105,46 +150,7 @@ class ParallelWrapper:
             if hasattr(it, "reset"):
                 it.reset()
             for ds in it:
-                feats = np.asarray(ds.features)
-                labs = np.asarray(ds.labels)
-                lm = None if ds.labelsMask is None \
-                    else np.asarray(ds.labelsMask)
-                fm = None if ds.featuresMask is None \
-                    else np.asarray(ds.featuresMask)
-                pad = (-feats.shape[0]) % self.mesh.size
-                if pad:
-                    # Ragged final batch: pad rows to a multiple of the dp
-                    # axis, and ZERO-WEIGHT them via the labels mask so the
-                    # masked-mean loss (losses._apply_mask_mean) excludes
-                    # them exactly — repeat-padding without a mask silently
-                    # biased last-batch gradients (round-1 VERDICT).
-                    b = feats.shape[0]
-                    feats = self._pad_rows(feats, pad)
-                    labs = self._pad_rows(labs, pad)
-                    if lm is None:
-                        mshape = labs.shape[:-1] if labs.ndim >= 2 \
-                            else labs.shape
-                        lm = np.ones(mshape, np.float32)
-                    else:
-                        lm = self._pad_rows(lm, pad)
-                    lm = lm.copy()
-                    lm[b:] = 0.0
-                    if fm is not None:
-                        fm = self._pad_rows(fm, pad)
-                x = jax.device_put(feats, self.mesh.sharding("dp"))
-                y = jax.device_put(labs, self.mesh.sharding("dp"))
-                lmask = None if lm is None \
-                    else jax.device_put(lm, self.mesh.sharding("dp"))
-                fmask = None if fm is None \
-                    else jax.device_put(fm, self.mesh.sharding("dp"))
-                m = self.model
-                m._rng_key, sub = jax.random.split(m._rng_key)
-                m._params, m._opt_state, m._state, loss = m._train_step(
-                    m._params, m._opt_state, m._state, x, y, fmask, lmask, sub)
-                m._score = float(loss)
-                m._iteration += 1
-                for listener in m._listeners:
-                    listener.iterationDone(m, m._iteration, m._epoch)
+                self._fit_dataset(ds)
             self.model._epoch += 1
         return self.model
 

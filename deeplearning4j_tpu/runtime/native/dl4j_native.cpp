@@ -118,9 +118,18 @@ int64_t dl4j_csv_parse(const char* buf, char delim, int32_t skip_rows,
         const char* te = fe;
         while (te > q && (te[-1] == ' ' || te[-1] == '\r')) --te;
         if (te > q) {
-          char* endp = nullptr;
-          float parsed = strtof(q, &endp);
-          if (endp > q && endp == te) v = parsed;  // exact consume only
+          // reject C99 hex floats: strtof accepts "0x1A" but Python's
+          // float() raises, and strict parity is the whole contract
+          const char* h = q;
+          while (h < te && (*h == ' ' || *h == '\t')) ++h;
+          if (h < te && (*h == '+' || *h == '-')) ++h;
+          bool hex = (h + 1 < te && h[0] == '0'
+                      && (h[1] == 'x' || h[1] == 'X'));
+          if (!hex) {
+            char* endp = nullptr;
+            float parsed = strtof(q, &endp);
+            if (endp > q && endp == te) v = parsed;  // exact consume only
+          }
         }
         q = (fe < line_end) ? fe + 1 : line_end + 1;
       }

@@ -34,9 +34,11 @@ def run_one(name, env_extra):
     # process always exits cleanly on its own.
     env.setdefault("BENCH_STEPS", "20")
     env["BENCH_EXTRA"] = ""      # headline only
-    env.setdefault("BENCH_ATTEMPTS", "1")
-    env.setdefault("BENCH_ATTEMPT_TIMEOUT", "420")
-    env.setdefault("BENCH_DEADLINE", "440")
+    # FORCE-set (not setdefault): an inherited larger deadline would let
+    # the subprocess timeout fire first — the SIGKILL-mid-claim wedge
+    env["BENCH_ATTEMPTS"] = "1"
+    env["BENCH_ATTEMPT_TIMEOUT"] = "420"
+    env["BENCH_DEADLINE"] = "440"
     t0 = time.time()
     bench = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "bench.py")

@@ -1,0 +1,89 @@
+"""ParallelInference (parallel/inference.py): concurrent clients get
+exactly the same answers as direct output(), and the engine actually
+coalesces requests into fewer forward passes."""
+import threading
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer, Sgd)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.inference import (InferenceMode,
+                                                   ParallelInference)
+
+
+def _net():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(3).updater(Sgd(0.1)).activation("tanh")
+            .list()
+            .layer(DenseLayer.Builder().nOut(8).build())
+            .layer(OutputLayer.Builder("mcxent").nOut(3)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_sequential_mode_matches_direct():
+    net = _net()
+    pi = ParallelInference.Builder(net).inferenceMode(
+        InferenceMode.SEQUENTIAL).build()
+    x = np.random.default_rng(0).standard_normal((4, 5)).astype(np.float32)
+    np.testing.assert_allclose(pi.output(x), net.output(x).numpy(),
+                               atol=1e-6)
+    # single example: no batch dim in, none out
+    np.testing.assert_allclose(pi.output(x[0]), net.output(x[:1]).numpy()[0],
+                               atol=1e-6)
+
+
+def test_batched_mode_concurrent_clients_exact():
+    net = _net()
+    pi = (ParallelInference.Builder(net)
+          .inferenceMode(InferenceMode.BATCHED)
+          .batchLimit(16).build())
+    rng = np.random.default_rng(1)
+    xs = rng.standard_normal((40, 5)).astype(np.float32)
+    want = net.output(xs).numpy()
+    got = [None] * 40
+    errs = []
+
+    def client(i):
+        try:
+            got[i] = pi.output(xs[i])
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(40)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    pi.shutdown()
+    assert not errs, errs
+    for i in range(40):
+        np.testing.assert_allclose(got[i], want[i], atol=1e-5, rtol=1e-5,
+                                   err_msg=str(i))
+    # coalescing happened: far fewer forwards than requests
+    assert pi.model_calls < 40, pi.model_calls
+
+
+def test_batch_requests_and_padding_buckets():
+    net = _net()
+    pi = (ParallelInference.Builder(net)
+          .inferenceMode(InferenceMode.BATCHED).batchLimit(8).build())
+    rng = np.random.default_rng(2)
+    x3 = rng.standard_normal((3, 5)).astype(np.float32)   # pads 3 -> 4
+    want = net.output(x3).numpy()
+    got = pi.output(x3)
+    pi.shutdown()
+    assert got.shape == (3, 3)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_shutdown_falls_back_to_direct():
+    net = _net()
+    pi = ParallelInference.Builder(net).build()
+    pi.shutdown()
+    x = np.zeros((2, 5), np.float32)
+    np.testing.assert_allclose(pi.output(x), net.output(x).numpy(),
+                               atol=1e-6)

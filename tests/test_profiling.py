@@ -80,6 +80,17 @@ class TestProfiling:
         assert isinstance(name, str) and ms >= 0 and n >= 1
         assert rows == sorted(rows, key=lambda r: -r[1])
 
+        # chrome-trace export: valid JSON with timed 'X' events
+        import json
+        out = os.path.join(os.path.dirname(paths[0]), "trace.json")
+        n_events = xplane.to_chrome_trace(trace_dir, out)
+        assert n_events > 0
+        with open(out) as f:
+            doc = json.load(f)
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert xs and all(e["dur"] > 0 for e in xs)
+        assert any(e["ph"] == "M" for e in doc["traceEvents"])
+
     def test_environment_information(self, capsys):
         info = OpExecutioner.getInstance().printEnvironmentInformation()
         assert info["backend"] == "cpu"

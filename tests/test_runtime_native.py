@@ -229,3 +229,15 @@ class TestNativeCsv:
         # garbage suffix falls back to the Python path (which raises on use)
         r3 = CSVRecordReader().initialize("1.5abc,2\n3,4\n")
         assert r3.numeric_matrix() is None
+
+    def test_hex_floats_and_ragged_rejected(self):
+        from deeplearning4j_tpu.runtime import native_lib
+        from deeplearning4j_tpu.datavec.records import CSVRecordReader
+        if not native_lib.available():
+            pytest.skip("native toolchain unavailable")
+        # hex parses in strtof but raises in Python float() -> must be NaN
+        got = native_lib.csv_to_floats(b"0x10,2\n3,4\n")
+        assert np.isnan(got[0, 0]) and got[0, 1] == 2
+        # ragged numeric rows: the bulk gate must refuse (Python raises)
+        r = CSVRecordReader().initialize("1\n2,3\n")
+        assert r.numeric_matrix() is None

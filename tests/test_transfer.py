@@ -367,3 +367,44 @@ class TestSaveLastModel:
         assert result.terminationReason == \
             TerminationReason.IterationTerminationCondition
         assert saver.getLatestModel() is not None
+
+
+def test_early_stopping_parallel_trainer(devices8):
+    """EarlyStoppingParallelTrainer: dp-sharded epochs under the inherited
+    scoring/termination loop, same best-model bookkeeping."""
+    import numpy as np
+    from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer,
+                                       Sgd)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.early_stopping import (
+        DataSetLossCalculator, EarlyStoppingConfiguration,
+        EarlyStoppingParallelTrainer, InMemoryModelSaver,
+        MaxEpochsTerminationCondition)
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(9).updater(Sgd(0.1)).activation("relu")
+            .list()
+            .layer(DenseLayer.Builder().nOut(8).build())
+            .layer(OutputLayer.Builder("mcxent").nOut(2)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((64, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    it = ArrayDataSetIterator(x, y, batch_size=32)
+
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epochTerminationConditions(MaxEpochsTerminationCondition(5))
+           .scoreCalculator(DataSetLossCalculator(
+               ArrayDataSetIterator(x, y, batch_size=32), average=True))
+           .modelSaver(InMemoryModelSaver())
+           .build())
+    result = EarlyStoppingParallelTrainer(cfg, net, it, workers=8).fit()
+    assert result.totalEpochs == 5
+    assert np.isfinite(result.bestModelScore)
+    first = list(result.scoreVsEpoch.values())[0]
+    assert result.bestModelScore <= first + 1e-9

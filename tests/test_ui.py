@@ -1,0 +1,85 @@
+"""UI subsystem (≡ deeplearning4j-ui: StatsListener -> StatsStorage ->
+dashboard server): training stats flow end-to-end into the live HTTP
+dashboard and the static HTML snapshot."""
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer, Sgd)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui.server import UIServer, render_static_html
+from deeplearning4j_tpu.ui.stats import (FileStatsStorage,
+                                         InMemoryStatsStorage, StatsListener)
+
+
+def _trained_storage(storage):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(1).updater(Sgd(0.1)).activation("relu")
+            .list()
+            .layer(DenseLayer.Builder().nOut(8).build())
+            .layer(OutputLayer.Builder("mcxent").nOut(2)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.setListeners(StatsListener(storage, frequency=1))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    for _ in range(5):
+        net.fit(DataSet(x, y))
+    return net
+
+
+def test_stats_listener_records_scores_and_params():
+    storage = InMemoryStatsStorage()
+    _trained_storage(storage)
+    records = storage.all()
+    assert len(records) == 5
+    assert all(np.isfinite(r["score"]) for r in records)
+    assert all(r["iteration"] == i + 1 for i, r in enumerate(records))
+    # per-param summaries present (mean magnitude of weights/updates)
+    assert any("params" in r and r["params"] for r in records)
+
+
+def test_file_storage_roundtrip(tmp_path):
+    path = str(tmp_path / "stats.jsonl")
+    _trained_storage(FileStatsStorage(path))
+    reloaded = FileStatsStorage(path)
+    assert len(reloaded.all()) == 5
+    with open(path) as f:
+        lines = [json.loads(l) for l in f if l.strip()]
+    assert len(lines) == 5 and "score" in lines[0]
+
+
+def test_dashboard_server_serves_stats():
+    storage = InMemoryStatsStorage()
+    _trained_storage(storage)
+    server = UIServer.getInstance()
+    server.attach(storage)
+    port = server.start(port=0) or getattr(server, "port", None)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        html = urllib.request.urlopen(base + "/", timeout=10).read().decode()
+        assert "<html" in html.lower()
+        data = json.loads(urllib.request.urlopen(
+            base + "/stats", timeout=10).read().decode())
+        assert isinstance(data, list) and len(data) == 5
+        assert all(np.isfinite(r["score"]) for r in data)
+    finally:
+        server.stop()
+        server.detach(storage)
+
+
+def test_static_html_snapshot(tmp_path):
+    storage = InMemoryStatsStorage()
+    _trained_storage(storage)
+    out = str(tmp_path / "dash.html")
+    render_static_html(storage, out)
+    html = open(out).read()
+    assert "<svg" in html and "score" in html.lower()
