@@ -243,6 +243,9 @@ def child_main():
                     VGG16, vbatch, max(steps // 2, 5), warmup, lr=0.01)
                 result["vgg16_img_s"] = round(v_img_s, 2)
                 result["vgg16_vs_baseline"] = round(v_img_s / 190.0, 3)
+                # VGG16 fwd ~15.5 GFLOP/img, fwd+bwd ~3x
+                result["vgg16_mfu_pct"] = round(
+                    v_img_s * 3 * 15.5e9 / 197e12 * 100, 1)
                 print(f"# vgg16: batch={vbatch} step={v_dt*1000:.1f}ms "
                       f"compile={v_c:.1f}s", file=sys.stderr, flush=True)
             except Exception as e:  # noqa: BLE001 — diagnostic field
@@ -258,6 +261,10 @@ def child_main():
                 result["bert_ft_steps_s"] = round(b_steps_s, 2)
                 result["bert_ft_note"] = ("BERT-base b32 seq128 masked "
                                           "flash attn")
+                # ~6 FLOP/param/token fwd+bwd (3x2), 110M params,
+                # 32*128 tokens/step
+                result["bert_ft_mfu_pct"] = round(
+                    b_steps_s * 6 * 110e6 * 32 * 128 / 197e12 * 100, 1)
                 print(f"# bert: step={b_dt*1000:.1f}ms compile={b_c:.1f}s",
                       file=sys.stderr, flush=True)
             except Exception as e:  # noqa: BLE001

@@ -626,6 +626,39 @@ class SameDiff:
     def outputSingle(self, placeholders, output):
         return self.output(placeholders, [output])[output]
 
+    def evaluate(self, iterator, outputVariable, evaluation=None):
+        """≡ SameDiff.evaluate(DataSetIterator, outputVariable,
+        Evaluation): feed each DataSet through the TrainingConfig's
+        dataSetFeatureMapping and accumulate predictions vs labels."""
+        tc = self._training_config
+        if tc is None or not getattr(tc, "dataSetFeatureMapping", None):
+            raise ValueError(
+                "evaluate() needs a TrainingConfig with "
+                "dataSetFeatureMapping/dataSetLabelMapping (call "
+                "setTrainingConfig first)")
+        if evaluation is None:
+            from deeplearning4j_tpu.eval.evaluation import Evaluation
+            evaluation = Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        for ds in iterator:
+            feats = ds.features if isinstance(ds, MultiDataSet) \
+                else [ds.features]
+            labs = ds.labels if isinstance(ds, MultiDataSet) \
+                else [ds.labels]
+            if len(feats) != len(tc.dataSetFeatureMapping):
+                raise ValueError(
+                    f"evaluate(): {len(feats)} feature arrays vs "
+                    f"{len(tc.dataSetFeatureMapping)} mapped placeholders")
+            phs = dict(zip(tc.dataSetFeatureMapping, feats))
+            preds = self.output(phs, [outputVariable])[outputVariable]
+            mask = getattr(ds, "labelsMask", None)
+            if isinstance(mask, (list, tuple)):
+                mask = mask[0] if mask else None
+            evaluation.eval(labs[0], preds, mask)
+        return evaluation
+
     def batchOutput(self):
         sd = self
 

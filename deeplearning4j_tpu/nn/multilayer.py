@@ -217,6 +217,29 @@ class MultiLayerNetwork:
                                    mask=fmask)
         return NDArray(y)
 
+    def predict(self, x):
+        """≡ Classifier.predict — argmax class index per example."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        if isinstance(x, DataSet):
+            x = x.features
+        out = self.output(x).numpy()
+        return np.argmax(out, axis=-1)
+
+    def f1Score(self, data, labels=None):
+        """≡ Classifier.f1Score(DataSet | (examples, labels)) — micro F1
+        via Evaluation over one forward pass."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        mask = None
+        if isinstance(data, DataSet):
+            feats, labels = data.features, data.labels
+            mask = data.labelsMask
+        else:
+            feats = data
+        ev = Evaluation()
+        ev.eval(labels, self.output(feats).numpy(), mask)
+        return ev.f1()
+
     def feedForward(self, x, train=False):
         x = as_jax(x)
         _, _, _, acts = self._forward(self._params, self._state, x, train,

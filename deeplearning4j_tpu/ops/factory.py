@@ -365,5 +365,43 @@ class _Nd:
     def fromNumpy(self, x):
         return NDArray(x)
 
+    # -- array file IO (≡ Nd4j.write/read/saveBinary/readBinary/
+    #    writeTxt/readTxt — npy is the interchange format here, matching
+    #    Nd4j.writeAsNumpy/createFromNpyFile) ---------------------------
+    def write(self, arr, path_or_stream):
+        a = np.asarray(as_jax(arr))
+        if isinstance(path_or_stream, str):
+            # np.save appends .npy to bare string paths — honour the
+            # exact path the caller asked for
+            with open(path_or_stream, "wb") as f:
+                np.save(f, a, allow_pickle=False)
+        else:
+            np.save(path_or_stream, a, allow_pickle=False)
+
+    saveBinary = write
+    writeAsNumpy = write
+
+    def read(self, path_or_stream):
+        return NDArray(np.load(path_or_stream, allow_pickle=False))
+
+    readBinary = read
+    createFromNpyFile = read
+
+    def writeTxt(self, arr, path):
+        a = np.asarray(as_jax(arr))
+        with open(path, "w") as f:
+            f.write(f"# shape={a.shape} dtype={a.dtype.name}\n")
+            np.savetxt(f, a.reshape(-1, a.shape[-1]) if a.ndim > 1
+                       else a[None, :], fmt="%.8g")
+
+    def readTxt(self, path):
+        with open(path) as f:
+            header = f.readline()
+            data = np.loadtxt(f, dtype=np.float64, ndmin=2)
+        import ast
+        shape = ast.literal_eval(header.split("shape=")[1].split(" dtype")[0])
+        dtype = np.dtype(header.split("dtype=")[1].strip())
+        return NDArray(data.reshape(shape).astype(dtype))
+
 
 nd = _Nd()

@@ -42,13 +42,14 @@ def _bucket(n):
 
 
 class _Request:
-    __slots__ = ("x", "event", "result", "error")
+    __slots__ = ("x", "event", "result", "error", "claimed")
 
     def __init__(self, x):
         self.x = x
         self.event = threading.Event()
         self.result = None
         self.error = None
+        self.claimed = False
 
 
 class ParallelInference:
@@ -60,6 +61,7 @@ class ParallelInference:
         self.collect_timeout = collect_timeout_ms / 1e3
         self.model_calls = 0          # diagnostic: forwards actually run
         self._queue = queue.Queue(maxsize=int(queue_limit))
+        self._claim_lock = threading.Lock()
         self._shutdown = False
         self._thread = None
         if self.mode != InferenceMode.SEQUENTIAL:
@@ -104,7 +106,15 @@ class ParallelInference:
             return out[0] if single else out
         req = _Request(x[None] if single else x)
         self._queue.put(req)
-        req.event.wait()
+        # wait with a shutdown escape: a request enqueued as the collector
+        # exits would otherwise block forever — claim it and serve direct
+        while not req.event.wait(0.25):
+            if self._shutdown:
+                with self._claim_lock:
+                    if not req.claimed:
+                        req.claimed = True
+                        self._run([req])
+                # claimed by the collector instead: keep waiting below
         if req.error is not None:
             raise req.error
         return req.result[0] if single else req.result
@@ -146,6 +156,7 @@ class ParallelInference:
             if first is None:
                 break
             batch = [first]
+            strays = []    # incompatible shapes: run AFTER the main batch
             total = first.x.shape[0]
             # coalesce until batchLimit or a brief quiet period
             while total < self.batch_limit:
@@ -157,11 +168,22 @@ class ParallelInference:
                     self._shutdown = True
                     break
                 if nxt.x.shape[1:] != first.x.shape[1:]:
-                    # incompatible feature shape: run it in its own pass
-                    self._run([nxt])
+                    strays.append(nxt)
                     continue
                 batch.append(nxt)
                 total += nxt.x.shape[0]
+            self._dispatch(batch)
+            for s in strays:
+                self._dispatch([s])
+
+    def _dispatch(self, batch):
+        """Claim-then-run: a request the fallback path already claimed
+        (shutdown race) must not be served twice."""
+        with self._claim_lock:
+            batch = [r for r in batch if not r.claimed]
+            for r in batch:
+                r.claimed = True
+        if batch:
             self._run(batch)
 
     def _run(self, batch):
@@ -196,3 +218,11 @@ class ParallelInference:
             except queue.Full:
                 pass
             self._thread.join(timeout=5)
+            # serve anything the collector left behind
+            while True:
+                try:
+                    r = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if r is not None:
+                    self._dispatch([r])

@@ -224,3 +224,34 @@ def test_remat_layer_matches_plain():
                                remat.params().numpy(),
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(plain.score(ds), remat.score(ds), rtol=1e-6)
+
+
+def test_predict_and_f1score():
+    """≡ Classifier.predict / f1Score conveniences."""
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer,
+                                       Sgd)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(2).updater(Sgd(0.2)).activation("relu")
+            .list()
+            .layer(DenseLayer.Builder().nOut(16).build())
+            .layer(OutputLayer.Builder("mcxent").nOut(2)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    ds = DataSet(x, y)
+    for _ in range(40):
+        net.fit(ds)
+    preds = net.predict(x)
+    assert preds.shape == (64,)
+    acc = (preds == y.argmax(1)).mean()
+    assert acc > 0.9
+    f1 = net.f1Score(ds)
+    assert 0.9 < f1 <= 1.0
+    assert abs(net.f1Score(x, y) - f1) < 1e-9

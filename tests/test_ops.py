@@ -348,3 +348,18 @@ class TestOpCatalogRound2:
         np.testing.assert_array_equal(Transforms.or_(t, u).numpy(), t | u)
         np.testing.assert_array_equal(Transforms.xor(t, u).numpy(), t ^ u)
         np.testing.assert_array_equal(Transforms.not_(t).numpy(), ~t)
+
+
+def test_nd4j_array_file_io(tmp_path):
+    """≡ Nd4j.write/read (npy interchange) + writeTxt/readTxt."""
+    from deeplearning4j_tpu.ops.factory import nd
+    a = nd.rand(3, 4, 5)
+    p = str(tmp_path / "a.npy")
+    nd.write(a, p)
+    b = nd.read(p)
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+    t = str(tmp_path / "a.txt")
+    nd.writeTxt(a, t)
+    c = nd.readTxt(t)
+    assert c.shape == a.shape
+    np.testing.assert_allclose(a.numpy(), c.numpy(), atol=1e-6)

@@ -225,3 +225,40 @@ def test_summary_lists_variables_and_ops():
     assert "placeholder" in s and "variable" in s
     assert "mmul" in s and "softmax" in s
     assert "2 variables, 2 ops" in s
+
+
+def test_evaluate_over_iterator():
+    """sd.evaluate(iterator, var, Evaluation) accumulates over batches
+    via the TrainingConfig data mappings (≡ SameDiff.evaluate)."""
+    from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn import Adam
+
+    rng = np.random.default_rng(7)
+    xs = rng.standard_normal((64, 4)).astype(np.float32)
+    labels_idx = (xs[:, 0] > 0).astype(int)
+    ys = np.eye(2, dtype=np.float32)[labels_idx]
+
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", (None, 4))
+    lab = sd.placeHolder("labels", (None, 2))
+    w = sd.var("w", 0.01 * rng.standard_normal((4, 2)).astype(np.float32))
+    b = sd.var("b", np.zeros((2,), np.float32))
+    probs = sd.nn.softmax(x.mmul(w).add(b))
+    probs.rename("probs")
+    sd.loss.softmaxCrossEntropy("loss", lab, x.mmul(w).add(b))
+    sd.setLossVariables("loss")
+    sd.setTrainingConfig(TrainingConfig.Builder()
+                         .updater(Adam(0.1))
+                         .dataSetFeatureMapping("x")
+                         .dataSetLabelMapping("labels")
+                         .build())
+    it = ArrayDataSetIterator(xs, ys, batch_size=16)
+    for _ in range(30):
+        it.reset()
+        for ds in it:
+            sd.fit(ds)
+    ev = sd.evaluate(ArrayDataSetIterator(xs, ys, batch_size=16), "probs")
+    assert ev.accuracy() > 0.9
+    # all 64 rows were accumulated across the 4 batches
+    assert sum(ev.truePositives(c) + ev.falseNegatives(c)
+               for c in range(2)) == 64
