@@ -653,7 +653,8 @@ class SameDiff:
                     f"{len(tc.dataSetFeatureMapping)} mapped placeholders")
             phs = dict(zip(tc.dataSetFeatureMapping, feats))
             preds = self.output(phs, [outputVariable])[outputVariable]
-            mask = getattr(ds, "labelsMask", None)
+            mask = getattr(ds, "labelsMask",
+                           getattr(ds, "labelsMasks", None))
             if isinstance(mask, (list, tuple)):
                 mask = mask[0] if mask else None
             evaluation.eval(labs[0], preds, mask)

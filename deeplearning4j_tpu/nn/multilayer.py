@@ -226,8 +226,9 @@ class MultiLayerNetwork:
         return np.argmax(out, axis=-1)
 
     def f1Score(self, data, labels=None):
-        """≡ Classifier.f1Score(DataSet | (examples, labels)) — micro F1
-        via Evaluation over one forward pass."""
+        """≡ Classifier.f1Score(DataSet | (examples, labels)) —
+        macro-averaged F1 (Evaluation.f1()'s default) over one forward
+        pass."""
         from deeplearning4j_tpu.datasets.dataset import DataSet
         from deeplearning4j_tpu.eval.evaluation import Evaluation
         mask = None

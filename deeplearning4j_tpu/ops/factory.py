@@ -391,8 +391,9 @@ class _Nd:
         a = np.asarray(as_jax(arr))
         with open(path, "w") as f:
             f.write(f"# shape={a.shape} dtype={a.dtype.name}\n")
-            np.savetxt(f, a.reshape(-1, a.shape[-1]) if a.ndim > 1
-                       else a[None, :], fmt="%.8g")
+            flat = a.reshape(1, 1) if a.ndim == 0 else (
+                a.reshape(-1, a.shape[-1]) if a.ndim > 1 else a[None, :])
+            np.savetxt(f, flat, fmt="%.8g")
 
     def readTxt(self, path):
         with open(path) as f:

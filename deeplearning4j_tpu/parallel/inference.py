@@ -111,10 +111,11 @@ class ParallelInference:
         while not req.event.wait(0.25):
             if self._shutdown:
                 with self._claim_lock:
-                    if not req.claimed:
-                        req.claimed = True
-                        self._run([req])
-                # claimed by the collector instead: keep waiting below
+                    mine = not req.claimed
+                    req.claimed = True
+                if mine:
+                    self._run([req])  # forward OUTSIDE the lock
+                # else the collector claimed it: keep waiting below
         if req.error is not None:
             raise req.error
         return req.result[0] if single else req.result

@@ -12,7 +12,11 @@ import platform
 
 
 def host_cpu_key() -> str:
-    """Short stable hash of this host's CPU feature flags."""
+    """Short stable hash of this host's CPU feature flags AND the jax/
+    python flavour. The AOT machine-code flavour depends on the compiling
+    jax build as well as the CPU (observed: two jax installs on one box
+    sharing a cache produce 'prefer-no-gather ... could lead to SIGILL'
+    load warnings), so both go into the key."""
     feats = platform.machine()
     try:
         with open("/proc/cpuinfo") as f:
@@ -22,6 +26,13 @@ def host_cpu_key() -> str:
                     break
     except OSError:
         pass
+    try:
+        from jax import version as _jv
+        feats += f" jax={_jv.__version__}"
+    except Exception:
+        pass
+    import sys
+    feats += f" py={sys.version_info[:2]} exe={sys.executable}"
     return hashlib.sha256(feats.encode()).hexdigest()[:12]
 
 

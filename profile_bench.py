@@ -7,9 +7,10 @@ import time
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.util.hostkey import cache_dir
+
 jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               ".jax_cache"))
+                  cache_dir(os.path.dirname(os.path.abspath(__file__))))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 

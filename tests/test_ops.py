@@ -363,3 +363,12 @@ def test_nd4j_array_file_io(tmp_path):
     c = nd.readTxt(t)
     assert c.shape == a.shape
     np.testing.assert_allclose(a.numpy(), c.numpy(), atol=1e-6)
+
+
+def test_writetxt_scalar_roundtrip(tmp_path):
+    from deeplearning4j_tpu.ops.factory import nd
+    p = str(tmp_path / "s.txt")
+    nd.writeTxt(nd.scalar(3.5), p)
+    out = nd.readTxt(p)
+    assert out.shape == ()
+    assert float(out.numpy()) == 3.5
