@@ -141,11 +141,12 @@ def _bench_char_lstm(batch=128, seq=128, hidden=512, steps=10, warmup=2):
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
     vocab = 80
+    unroll = int(os.environ.get("BENCH_LSTM_UNROLL", "1"))
     conf = (NeuralNetConfiguration.Builder()
             .seed(0).updater(RmsProp(1e-3)).weightInit("xavier")
             .list()
-            .layer(LSTM(nOut=hidden, activation="tanh"))
-            .layer(LSTM(nOut=hidden, activation="tanh"))
+            .layer(LSTM(nOut=hidden, activation="tanh", scanUnroll=unroll))
+            .layer(LSTM(nOut=hidden, activation="tanh", scanUnroll=unroll))
             .layer(RnnOutputLayer(nOut=vocab, lossFunction="mcxent",
                                   activation="softmax"))
             .setInputType(InputType.recurrent(vocab, seq))

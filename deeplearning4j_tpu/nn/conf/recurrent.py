@@ -27,11 +27,14 @@ class BaseRecurrentLayer(Layer):
     is_recurrent = True
 
     def __init__(self, nIn=None, nOut=None, forgetGateBiasInit=1.0,
-                 gateActivationFn="sigmoid", **kw):
+                 gateActivationFn="sigmoid", scanUnroll=1, **kw):
         super().__init__(**kw)
         self.nIn, self.nOut = nIn, nOut
         self.forgetGateBiasInit = float(forgetGateBiasInit)
         self.gateActivationFn = gateActivationFn
+        # lax.scan unroll factor: k step bodies per loop iteration — fewer
+        # loop overheads per timestep on TPU, identical numerics
+        self.scanUnroll = int(scanUnroll or 1)
 
     def apply_defaults(self, defaults):
         super().apply_defaults(defaults)
@@ -120,7 +123,7 @@ class LSTM(BaseRecurrentLayer):
             return (h, c), y
 
         xs = xw_t if mask_t is None else (xw_t, mask_t)
-        carryT, ys = lax.scan(step, carry0, xs)
+        carryT, ys = lax.scan(step, carry0, xs, unroll=self.scanUnroll)
         return jnp.swapaxes(ys, 0, 1), carryT
 
 
@@ -195,7 +198,7 @@ class SimpleRnn(BaseRecurrentLayer):
             return (h,), y
 
         xs = xw_t if mask_t is None else (xw_t, mask_t)
-        carryT, ys = lax.scan(step, carry0, xs)
+        carryT, ys = lax.scan(step, carry0, xs, unroll=self.scanUnroll)
         return jnp.swapaxes(ys, 0, 1), carryT
 
 
@@ -338,9 +341,10 @@ class GravesBidirectionalLSTM(Bidirectional):
                            **{k: v for k, v in kw.items()
                               if k in ("forgetGateBiasInit",
                                        "gateActivationFn", "activation",
-                                       "weightInit")})
+                                       "weightInit", "scanUnroll")})
         outer_kw = {k: v for k, v in kw.items()
-                    if k not in ("forgetGateBiasInit", "gateActivationFn")}
+                    if k not in ("forgetGateBiasInit", "gateActivationFn",
+                                 "scanUnroll")}
         super().__init__(layer=inner, mode=mode, **outer_kw)
 
     @property

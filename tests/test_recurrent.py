@@ -191,3 +191,29 @@ def test_tbptt_threads_hidden_state_across_segments():
     # and the carry itself is not zeros
     leaves = jax.tree_util.tree_leaves(carry_out)
     assert any(float(np.abs(np.asarray(l)).max()) > 0 for l in leaves)
+
+
+def test_scan_unroll_is_numerically_invisible():
+    """LSTM(scanUnroll=4) must produce identical outputs/carries to the
+    rolled scan (and works with masks)."""
+    import jax
+    from deeplearning4j_tpu.nn.conf.recurrent import LSTM
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    base = LSTM(nOut=12, activation="tanh")
+    fast = LSTM(nOut=12, activation="tanh", scanUnroll=4)
+    for l in (base, fast):
+        l.apply_defaults({})
+    params, _, _ = base.initialize(jax.random.PRNGKey(0),
+                                   InputType.recurrent(5, 7))
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 7, 5))
+    mask = (np.arange(7)[None, :] < np.array([7, 4, 6])[:, None]) \
+        .astype(np.float32)
+    import jax.numpy as jnp
+    for m in (None, jnp.asarray(mask)):
+        yb, cb = base.scan_apply(params, x, None, m)
+        yf, cf = fast.scan_apply(params, x, None, m)
+        np.testing.assert_allclose(np.asarray(yb), np.asarray(yf),
+                                   atol=1e-6)
+        for a, b in zip(cb, cf):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
