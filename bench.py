@@ -118,7 +118,7 @@ def _bench_bert_finetune(batch=None, seq=None, steps=10, warmup=2):
         params, opt, loss = step(params, opt, jax.random.fold_in(rng, 9 + i))
     float(loss)
     dt = (time.perf_counter() - t0) / steps
-    return 1.0 / dt, dt, compile_s
+    return 1.0 / dt, dt, compile_s, batch * seq
 
 
 def _bench_lenet(batch=256, steps=20, warmup=3):
@@ -258,14 +258,13 @@ def child_main():
             result["bert_error"] = "skipped: attempt time budget exhausted"
         else:
             try:
-                b_steps_s, b_dt, b_c = _bench_bert_finetune()
+                b_steps_s, b_dt, b_c, b_tokens = _bench_bert_finetune()
                 result["bert_ft_steps_s"] = round(b_steps_s, 2)
-                result["bert_ft_note"] = ("BERT-base b32 seq128 masked "
-                                          "flash attn")
-                # ~6 FLOP/param/token fwd+bwd (3x2), 110M params,
-                # 32*128 tokens/step
+                result["bert_ft_note"] = (
+                    f"BERT-base tokens/step={b_tokens} masked flash attn")
+                # ~6 FLOP/param/token fwd+bwd (3x2), 110M params
                 result["bert_ft_mfu_pct"] = round(
-                    b_steps_s * 6 * 110e6 * 32 * 128 / 197e12 * 100, 1)
+                    b_steps_s * 6 * 110e6 * b_tokens / 197e12 * 100, 1)
                 print(f"# bert: step={b_dt*1000:.1f}ms compile={b_c:.1f}s",
                       file=sys.stderr, flush=True)
             except Exception as e:  # noqa: BLE001

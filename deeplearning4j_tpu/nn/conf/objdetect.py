@@ -20,9 +20,122 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deeplearning4j_tpu.nn.conf.inputs import ConvolutionalType, InputType
 from deeplearning4j_tpu.nn.conf.layers import Layer
+
+
+class DetectedObject:
+    """One final detection (≡ deeplearning4j :: nn.layers.objdetect.
+    DetectedObject): center/size in GRID units plus the class
+    distribution. `exampleNumber` is the row in the minibatch."""
+
+    __slots__ = ("exampleNumber", "centerX", "centerY", "width", "height",
+                 "confidence", "classPredictions")
+
+    def __init__(self, exampleNumber, centerX, centerY, width, height,
+                 confidence, classPredictions):
+        self.exampleNumber = int(exampleNumber)
+        self.centerX = float(centerX)
+        self.centerY = float(centerY)
+        self.width = float(width)
+        self.height = float(height)
+        self.confidence = float(confidence)
+        self.classPredictions = np.asarray(classPredictions, np.float32)
+
+    def getPredictedClass(self):
+        return int(np.argmax(self.classPredictions))
+
+    def getCenterXY(self):
+        return (self.centerX, self.centerY)
+
+    def getTopLeftXY(self):
+        return (self.centerX - self.width / 2, self.centerY - self.height / 2)
+
+    def getBottomRightXY(self):
+        return (self.centerX + self.width / 2,
+                self.centerY + self.height / 2)
+
+    def getConfidence(self):
+        return self.confidence
+
+    def __repr__(self):
+        return (f"DetectedObject(example={self.exampleNumber}, "
+                f"xy=({self.centerX:.2f},{self.centerY:.2f}), "
+                f"wh=({self.width:.2f},{self.height:.2f}), "
+                f"conf={self.confidence:.3f}, "
+                f"cls={self.getPredictedClass()})")
+
+
+@jax.jit
+def _nms_keep(xy, wh, conf, cls_id, conf_threshold, iou_threshold):
+    """Greedy per-class NMS keep-mask, one example. xy/wh: (N, 2) in grid
+    units, conf: (N,), cls_id: (N,) int. Entirely inside jit: the O(N²)
+    IoU matrix is one fused elementwise block and the greedy sweep is a
+    `fori_loop` over score-sorted candidates — no host round-trips."""
+    iou = Yolo2OutputLayer._iou_xywh(xy[:, None, :], wh[:, None, :],
+                                     xy[None, :, :], wh[None, :, :])
+    suppress = (iou > iou_threshold) & (cls_id[:, None] == cls_id[None, :])
+    valid = conf >= conf_threshold
+    order = jnp.argsort(-conf)
+
+    def body(i, state):
+        keep, alive = state
+        idx = order[i]
+        take = alive[idx] & valid[idx]
+        keep = keep.at[idx].set(take)
+        # a taken box kills every lower-scored same-class overlap
+        # (including itself — already recorded in `keep`)
+        alive = alive & ~(take & suppress[idx])
+        return keep, alive
+
+    keep, _ = jax.lax.fori_loop(
+        0, xy.shape[0], body,
+        (jnp.zeros_like(valid), jnp.ones_like(valid)))
+    return keep
+
+
+class YoloUtils:
+    """≡ deeplearning4j :: nn.layers.objdetect.YoloUtils — final
+    detection extraction: confidence threshold + per-class greedy NMS."""
+
+    @staticmethod
+    def getPredictedObjects(boundingBoxPriors, networkOutput,
+                            confThreshold=0.5, nmsThreshold=0.4):
+        """Decode raw head output (B, H, W, A*(5+C)) to a list of
+        `DetectedObject` per example. The decode + threshold + NMS all run
+        batched on device; only the surviving boxes cross to host."""
+        layer = Yolo2OutputLayer(
+            boundingBoxes=[list(map(float, b)) for b in
+                           np.asarray(boundingBoxPriors, np.float32)])
+        return layer.getPredictedObjects(networkOutput, confThreshold,
+                                         nmsThreshold)
+
+    @staticmethod
+    def nms(objects, iouThreshold=0.4):
+        """Greedy per-class NMS over an existing DetectedObject list
+        (host-side convenience mirroring the reference's List API)."""
+        kept = []
+        for o in sorted(objects, key=lambda d: -d.confidence):
+            c = o.getPredictedClass()
+            if all(k.exampleNumber != o.exampleNumber
+                   or k.getPredictedClass() != c
+                   or _iou_np(k, o) <= iouThreshold for k in kept):
+                kept.append(o)
+        return kept
+
+
+def _iou_np(a, b):
+    ax1, ay1 = a.getTopLeftXY()
+    ax2, ay2 = a.getBottomRightXY()
+    bx1, by1 = b.getTopLeftXY()
+    bx2, by2 = b.getBottomRightXY()
+    iw = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+    ih = max(0.0, min(ay2, by2) - max(ay1, by1))
+    inter = iw * ih
+    ua = (ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) - inter
+    return inter / max(ua, 1e-9)
 
 
 class Yolo2OutputLayer(Layer):
@@ -81,6 +194,37 @@ class Yolo2OutputLayer(Layer):
         cls = jax.nn.softmax(p[..., 5:], axis=-1)
         return {"xy": jnp.stack([x, y], -1), "wh": jnp.stack([bw, bh], -1),
                 "confidence": conf, "classes": cls}
+
+    def getPredictedObjects(self, networkOutput, confThreshold=0.5,
+                            nmsThreshold=0.4):
+        """≡ YoloUtils.getPredictedObjects: decode → confidence threshold
+        → per-class greedy NMS → List[List[DetectedObject]] (one inner
+        list per minibatch example). All heavy work (decode, O(N²) IoU,
+        greedy sweep) runs in ONE jitted vmapped program; the decoded
+        tensors then cross to host once to build the per-box objects."""
+        pre = jnp.asarray(networkOutput, jnp.float32)
+        b, h, w, _ = pre.shape
+        dec = self.decode(pre)
+        n = h * w * self.numBoxes
+        xy = dec["xy"].reshape(b, n, 2)
+        wh = dec["wh"].reshape(b, n, 2)
+        conf = dec["confidence"].reshape(b, n)
+        cls = dec["classes"].reshape(b, n, -1)
+        cls_id = jnp.argmax(cls, -1)
+        keep = jax.vmap(_nms_keep, in_axes=(0, 0, 0, 0, None, None))(
+            xy, wh, conf, cls_id,
+            jnp.float32(confThreshold), jnp.float32(nmsThreshold))
+        keep, xy, wh, conf, cls = (np.asarray(t) for t in
+                                   (keep, xy, wh, conf, cls))
+        out = []
+        for i in range(b):
+            idx = np.nonzero(keep[i])[0]
+            idx = idx[np.argsort(-conf[i][idx])]
+            out.append([DetectedObject(i, xy[i, j, 0], xy[i, j, 1],
+                                       wh[i, j, 0], wh[i, j, 1],
+                                       conf[i, j], cls[i, j])
+                        for j in idx])
+        return out
 
     @staticmethod
     def _iou_xywh(xy1, wh1, xy2, wh2):

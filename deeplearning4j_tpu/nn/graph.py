@@ -265,6 +265,27 @@ class ComputationGraph:
         out = self.output(*inputs)
         return out[0] if isinstance(out, list) else out
 
+    def getOutputLayer(self, index=0):
+        """≡ ComputationGraph.getOutputLayer(idx) — conf object of the
+        idx-th output layer."""
+        return self._output_layers[index]
+
+    def getPredictedObjects(self, inputs, confThreshold=0.5,
+                            nmsThreshold=0.4):
+        """Detection convenience over a Yolo2OutputLayer output (≡
+        YoloUtils.getPredictedObjects). `inputs` is one array, or a
+        list/dict for multi-input graphs (NOT *args — thresholds stay
+        positional like the MultiLayerNetwork twin).
+        Returns List[List[DetectedObject]]."""
+        out_layer = self._output_layers[0]
+        if not hasattr(out_layer, "getPredictedObjects"):
+            raise TypeError(
+                f"output layer {type(out_layer).__name__} has no detection "
+                "decode — getPredictedObjects needs a Yolo2OutputLayer head")
+        y = self.outputSingle(inputs)
+        return out_layer.getPredictedObjects(as_jax(y), confThreshold,
+                                             nmsThreshold)
+
     def feedForward(self, inputs, train=False):
         ins = self._as_input_dict(inputs)
         acts, _, _ = self._forward(self._params, self._state, ins, train, None)

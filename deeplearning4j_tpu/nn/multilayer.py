@@ -217,6 +217,25 @@ class MultiLayerNetwork:
                                    mask=fmask)
         return NDArray(y)
 
+    def getOutputLayer(self):
+        """≡ MultiLayerNetwork.getOutputLayer — the last layer's conf
+        object (e.g. a Yolo2OutputLayer for detection post-processing)."""
+        return self.layers[-1]
+
+    def getPredictedObjects(self, x, confThreshold=0.5, nmsThreshold=0.4):
+        """Detection convenience (≡ YoloUtils.getPredictedObjects over
+        this net's output): forward + decode + threshold + per-class NMS.
+        Returns List[List[DetectedObject]], one inner list per example.
+        Requires the output layer to be a Yolo2OutputLayer."""
+        out_layer = self.layers[-1]
+        if not hasattr(out_layer, "getPredictedObjects"):
+            raise TypeError(
+                f"output layer {type(out_layer).__name__} has no detection "
+                "decode — getPredictedObjects needs a Yolo2OutputLayer head")
+        y = self.output(x)
+        return out_layer.getPredictedObjects(as_jax(y), confThreshold,
+                                             nmsThreshold)
+
     def predict(self, x):
         """≡ Classifier.predict — argmax class index per example."""
         from deeplearning4j_tpu.datasets.dataset import DataSet

@@ -393,15 +393,33 @@ class _Nd:
             f.write(f"# shape={a.shape} dtype={a.dtype.name}\n")
             flat = a.reshape(1, 1) if a.ndim == 0 else (
                 a.reshape(-1, a.shape[-1]) if a.ndim > 1 else a[None, :])
-            np.savetxt(f, flat, fmt="%.8g")
+            # value-exact round trip like the npy path: integers print as
+            # integers, floats with full precision (%.17g survives f64)
+            fmt = "%d" if np.issubdtype(a.dtype, np.integer) else "%.17g"
+            np.savetxt(f, flat, fmt=fmt)
 
     def readTxt(self, path):
         with open(path) as f:
             header = f.readline()
-            data = np.loadtxt(f, dtype=np.float64, ndmin=2)
-        import ast
-        shape = ast.literal_eval(header.split("shape=")[1].split(" dtype")[0])
-        dtype = np.dtype(header.split("dtype=")[1].strip())
+            import ast
+            shape = ast.literal_eval(
+                header.split("shape=")[1].split(" dtype")[0])
+            dtype = np.dtype(header.split("dtype=")[1].strip())
+            # parse integers as integers — routing them through float64
+            # would silently truncate values beyond 2**53. Files written
+            # before the integer fmt existed hold scientific notation, so
+            # fall back to the float path for those.
+            body = f.read()
+            if np.issubdtype(dtype, np.integer):
+                try:
+                    data = np.loadtxt(body.splitlines(), dtype=dtype,
+                                      ndmin=2)
+                except ValueError:
+                    data = np.loadtxt(body.splitlines(), dtype=np.float64,
+                                      ndmin=2)
+            else:
+                data = np.loadtxt(body.splitlines(), dtype=np.float64,
+                                  ndmin=2)
         return NDArray(data.reshape(shape).astype(dtype))
 
 

@@ -42,7 +42,7 @@ def _bucket(n):
 
 
 class _Request:
-    __slots__ = ("x", "event", "result", "error", "claimed")
+    __slots__ = ("x", "event", "result", "error", "claimed", "server")
 
     def __init__(self, x):
         self.x = x
@@ -50,6 +50,7 @@ class _Request:
         self.result = None
         self.error = None
         self.claimed = False
+        self.server = None      # thread that claimed it (set under lock)
 
 
 class ParallelInference:
@@ -109,13 +110,27 @@ class ParallelInference:
         # wait with a shutdown escape: a request enqueued as the collector
         # exits would otherwise block forever — claim it and serve direct
         while not req.event.wait(0.25):
-            if self._shutdown:
+            dead = self._thread is not None and not self._thread.is_alive()
+            if dead:
+                # collector is gone for good: flip to direct-serve mode so
+                # later calls stop enqueueing into a queue nobody drains
+                self._shutdown = True
+            if self._shutdown or dead:
                 with self._claim_lock:
-                    mine = not req.claimed
+                    # reclaim an unclaimed request, or one whose claiming
+                    # THREAD died before delivering (a claim held by a live
+                    # thread — e.g. shutdown()'s drain — stays theirs, so a
+                    # request is never served twice)
+                    orphaned = (req.claimed and req.server is not None
+                                and not req.server.is_alive()
+                                and not req.event.is_set())
+                    mine = not req.claimed or orphaned
                     req.claimed = True
+                    if mine:
+                        req.server = threading.current_thread()
                 if mine:
                     self._run([req])  # forward OUTSIDE the lock
-                # else the collector claimed it: keep waiting below
+                # else a live thread claimed it: keep waiting below
         if req.error is not None:
             raise req.error
         return req.result[0] if single else req.result
@@ -182,8 +197,10 @@ class ParallelInference:
         (shutdown race) must not be served twice."""
         with self._claim_lock:
             batch = [r for r in batch if not r.claimed]
+            me = threading.current_thread()
             for r in batch:
                 r.claimed = True
+                r.server = me
         if batch:
             self._run(batch)
 
@@ -206,10 +223,16 @@ class ParallelInference:
                 r.result = out[i:i + k]
                 i += k
                 r.event.set()
-        except Exception as e:  # noqa: BLE001 — deliver to the waiter
+        except BaseException as e:  # noqa: BLE001 — deliver to the waiter
+            # even KeyboardInterrupt/SystemExit must release the waiters
+            # before propagating, or output() blocks forever
+            err = e if isinstance(e, Exception) else RuntimeError(
+                f"inference worker died: {type(e).__name__}: {e}")
             for r in batch:
-                r.error = e
+                r.error = err
                 r.event.set()
+            if not isinstance(e, Exception):
+                raise
 
     def shutdown(self):
         if self._thread is not None and not self._shutdown:
