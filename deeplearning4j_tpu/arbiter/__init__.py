@@ -10,10 +10,15 @@ from deeplearning4j_tpu.arbiter.runner import (CandidateGenerator,
                                                OptimizationResult,
                                                RandomSearchGenerator,
                                                TPEGenerator)
+from deeplearning4j_tpu.arbiter.network_spaces import (
+    AdamSpace, ComputationGraphSpace, LayerSpace, MultiLayerSpace,
+    NesterovsSpace, SgdSpace, UpdaterSpace)
 
 __all__ = [
     "ContinuousParameterSpace", "DiscreteParameterSpace", "FixedValue",
     "IntegerParameterSpace", "ParameterSpace", "CandidateGenerator",
     "GridSearchCandidateGenerator", "LocalOptimizationRunner",
     "OptimizationResult", "RandomSearchGenerator", "TPEGenerator",
+    "AdamSpace", "ComputationGraphSpace", "LayerSpace", "MultiLayerSpace",
+    "NesterovsSpace", "SgdSpace", "UpdaterSpace",
 ]

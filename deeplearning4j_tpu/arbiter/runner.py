@@ -162,6 +162,10 @@ class LocalOptimizationRunner:
     def __init__(self, generator, model_builder, scorer, maxCandidates=10,
                  minimize=True, keep_models=False):
         self.generator = generator
+        # a declarative network space (MultiLayerSpace /
+        # ComputationGraphSpace) IS a model builder: no hand-written fn
+        if hasattr(model_builder, "model_builder"):
+            model_builder = model_builder.model_builder()
         self.model_builder = model_builder
         self.scorer = scorer
         self.maxCandidates = int(maxCandidates)

@@ -222,6 +222,12 @@ class NeuralNetConfiguration:
             self._defaults["dropOut"] = float(p)
             return self
 
+        def weightNoise(self, wn):
+            """≡ Builder.weightNoise — weight-space noise (WeightNoise /
+            DropConnect) applied to every layer's params at train time."""
+            self._defaults["weightNoise"] = wn
+            return self
+
         def constrainWeights(self, *constraints):
             """≡ Builder.constrainWeights — applied post-update to every
             layer's weight params (W/U/dW/pW), inside the jitted step."""

@@ -79,7 +79,7 @@ class Layer:
     INHERITED = ("activation", "weightInit", "biasInit", "l1", "l2",
                  "dropOut", "updater", "gradientNormalization",
                  "gradientNormalizationThreshold", "weightDecay",
-                 "constraints")
+                 "constraints", "weightNoise")
 
     @classmethod
     def _builder_positional(cls, args):
@@ -105,6 +105,7 @@ class Layer:
         self.gradientNormalizationThreshold = gradientNormalizationThreshold
         self.weightDecay = weightDecay
         self.constraints = constraints
+        self.weightNoise = kw.pop("weightNoise", None)
         cw = kw.pop("constrainWeights", None)  # builder-method spelling
         if cw is not None:
             self.constraints = (list(cw) if isinstance(cw, (list, tuple))
@@ -982,9 +983,14 @@ class BaseOutputLayer(Layer):
         self.lossFunction = lossFunction
 
     def apply_defaults(self, defaults):
-        super().apply_defaults(defaults)
-        if self.activation in (None, "identity") and "activation" not in defaults:
+        # classification default: an output layer whose activation was set
+        # NOWHERE (not on the layer, not in builder defaults) gets softmax.
+        # An EXPLICIT activation — including "identity" — always sticks:
+        # regression/MDN/Wasserstein heads need raw preactivations, and
+        # coercing identity to softmax would silently change the model.
+        if self.activation is None and "activation" not in defaults:
             self.activation = "softmax"
+        super().apply_defaults(defaults)
         return self
 
     def compute_loss(self, labels, preact, mask=None):
@@ -1001,9 +1007,11 @@ class OutputLayer(BaseOutputLayer, DenseLayer):
             self.activation = None
 
     def apply_defaults(self, defaults):
-        Layer.apply_defaults(self, defaults)
-        if self.activation == "identity":
+        # same rule as BaseOutputLayer: softmax only when activation was
+        # never set; explicit identity survives
+        if self.activation is None and "activation" not in defaults:
             self.activation = "softmax"
+        Layer.apply_defaults(self, defaults)
         return self
 
 
@@ -1097,3 +1105,106 @@ class Subsampling1DLayer(Layer):
             c = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims, strides, pad)
             y = s / c
         return y, state
+
+
+class CnnLossLayer(BaseOutputLayer):
+    """≡ conf.layers.CnnLossLayer — per-pixel loss over NHWC output, no
+    parameters (a preceding 1×1 conv supplies the channel logits; the 3D
+    twin is layers3d.Cnn3DLossLayer). Labels are (B, H, W, C); losses are
+    rank-agnostic so the per-pixel terms reduce in the standard masked
+    mean."""
+
+    def pre_activation(self, params, x):
+        return x
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        return get_activation(self.activation)(x), state
+
+    def output_type(self, input_type):
+        if not isinstance(input_type, ConvolutionalType):
+            raise ValueError(
+                f"CnnLossLayer '{self.name}' needs convolutional input, "
+                f"got {input_type} (use Cnn3DLossLayer for 5-D volumes)")
+        return input_type
+
+
+class ElementWiseMultiplicationLayer(Layer):
+    """≡ conf.layers.misc.ElementWiseMultiplicationLayer —
+    y = act(x ⊙ w + b) with a LEARNED per-feature scale w and bias b
+    (nOut == nIn). One fused elementwise op on TPU."""
+
+    def __init__(self, nIn=None, nOut=None, **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut = nIn, nOut
+
+    def output_type(self, input_type):
+        if (self.nOut is not None and self.nIn is not None
+                and int(self.nOut) != int(self.nIn)):
+            raise ValueError(
+                f"ElementWiseMultiplicationLayer '{self.name}': nIn "
+                f"({self.nIn}) must equal nOut ({self.nOut}) — it scales "
+                "features elementwise, it cannot resize")
+        n = self.nOut or self.nIn
+        if isinstance(input_type, RecurrentType):
+            return InputType.recurrent(n, input_type.timeSeriesLength)
+        return InputType.feedForward(n)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.size
+        if self.nOut is None:
+            self.nOut = self.nIn
+        out = self.output_type(input_type)
+        n = int(self.nIn)
+        params = {"W": jnp.ones((n,), jnp.float32),
+                  "b": jnp.full((n,), float(self.biasInit), jnp.float32)}
+        return params, {}, out
+
+    def pre_activation(self, params, x):
+        return x * params["W"].astype(x.dtype) + params["b"].astype(x.dtype)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        return (get_activation(self.activation)(
+            self.pre_activation(params, x)), state)
+
+
+def FrozenLayer(layer):
+    """≡ conf.layers.misc.FrozenLayer — freeze a layer conf: parameters
+    get NoOp updates and the layer always runs in INFERENCE mode during
+    training (dropout off, BN running stats pinned). Implemented by
+    flagging a deep copy (the flags ride the existing frozen machinery in
+    MultiLayerNetwork / transfer learning), so isinstance checks and
+    preprocessor inference still see the wrapped layer's real type."""
+    import copy
+
+    from deeplearning4j_tpu.nn.updaters import NoOp
+    if isinstance(layer, _Builder):
+        layer = layer.build()
+    layer = copy.deepcopy(layer)
+    layer.frozen = True
+    layer.updater = NoOp()
+    layer.l1 = 0.0
+    layer.l2 = 0.0
+    layer.weightDecay = 0.0
+    return layer
+
+
+def FrozenLayerWithBackprop(layer):
+    """≡ conf.layers.misc.FrozenLayerWithBackprop — parameters frozen
+    (NoOp updates + stop_gradient, so not even regularization moves
+    them) but, unlike FrozenLayer, the layer keeps its TRAIN-time
+    stochastic behavior (dropout stays active) and gradients still flow
+    through its outputs to everything upstream."""
+    import copy
+
+    from deeplearning4j_tpu.nn.updaters import NoOp
+    if isinstance(layer, _Builder):
+        layer = layer.build()
+    layer = copy.deepcopy(layer)
+    layer.frozen_params = True
+    layer.updater = NoOp()
+    layer.l1 = 0.0
+    layer.l2 = 0.0
+    layer.weightDecay = 0.0
+    return layer

@@ -236,3 +236,77 @@ class Cnn3DLossLayer(BaseOutputLayer):
 
     def output_type(self, input_type):
         return input_type
+
+
+class Deconvolution3D(Layer):
+    """≡ conf.layers.Deconvolution3D — transposed volumetric conv
+    (learned 3-D upsampling), NDHWC/DHWIO via lax.conv_transpose (the 2-D
+    twin is layers.Deconvolution2D)."""
+
+    def __init__(self, nIn=None, nOut=None, kernelSize=(2, 2, 2),
+                 stride=(2, 2, 2), padding=(0, 0, 0),
+                 convolutionMode="truncate", hasBias=True, **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut = nIn, nOut
+        self.kernelSize, self.stride = _triple(kernelSize), _triple(stride)
+        self.padding = _triple(padding)
+        self.convolutionMode = convolutionMode
+        self.hasBias = hasBias
+
+    def _same(self):
+        return str(self.convolutionMode).lower() == "same"
+
+    def _padding_arg(self):
+        if self._same():
+            return "SAME"
+        pd, ph, pw = self.padding
+        return ([(pd, pd), (ph, ph), (pw, pw)]
+                if (pd or ph or pw) else "VALID")
+
+    def output_type(self, input_type):
+        if not isinstance(input_type, Convolutional3DType):
+            raise ValueError(
+                f"Deconvolution3D '{self.name}' needs convolutional3D "
+                f"(D,H,W,C) input, got {input_type}")
+        if self.nOut is None:
+            raise ValueError(
+                f"Deconvolution3D '{self.name}': nOut is required")
+        kd, kh, kw = self.kernelSize
+        sd, sh, sw = self.stride
+        if self._same():
+            od = input_type.depth * sd
+            oh = input_type.height * sh
+            ow = input_type.width * sw
+        else:
+            pd, ph, pw = self.padding
+            od = sd * (input_type.depth - 1) + kd - 2 * pd
+            oh = sh * (input_type.height - 1) + kh - 2 * ph
+            ow = sw * (input_type.width - 1) + kw - 2 * pw
+        return InputType.convolutional3D(od, oh, ow, self.nOut)
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.channels
+        kd, kh, kw = self.kernelSize
+        w = init_weight(key, (kd, kh, kw, int(self.nIn), int(self.nOut)),
+                        self.weightInit, self.dist)
+        params = {"W": w}
+        if self.hasBias:
+            params["b"] = jnp.full((int(self.nOut),), float(self.biasInit),
+                                   jnp.float32)
+        return params, {}, self.output_type(input_type)
+
+    def pre_activation(self, params, x):
+        y = lax.conv_transpose(
+            x, params["W"].astype(x.dtype),
+            strides=self.stride,
+            padding=self._padding_arg(),
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.hasBias:
+            y = y + params["b"].astype(x.dtype)
+        return y
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        return get_activation(self.activation)(
+            self.pre_activation(params, x)), state

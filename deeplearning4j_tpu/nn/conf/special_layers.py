@@ -93,11 +93,13 @@ class LocallyConnected2D(Layer):
 class VariationalAutoencoder(Layer):
     """≡ conf.layers.variational.VariationalAutoencoder.
 
-    Gaussian q(z|x); reconstruction distribution 'gaussian' (mean+logvar
-    heads) or 'bernoulli' (logits). Supervised activate() returns the
-    latent mean (≡ reference's VAE activate); unsupervised training goes
-    through MultiLayerNetwork.pretrain/pretrainLayer maximizing the ELBO
-    as one jitted step.
+    Gaussian q(z|x); `reconstructionDistribution` is a name ('gaussian',
+    'bernoulli', 'exponential') or a ReconstructionDistribution object —
+    including CompositeReconstructionDistribution for per-feature-block
+    likelihoods (see nn.conf.variational). Supervised activate() returns
+    the latent mean (≡ reference's VAE activate); unsupervised training
+    goes through MultiLayerNetwork.pretrain/pretrainLayer maximizing the
+    ELBO as one jitted step.
     """
 
     def __init__(self, nIn=None, nOut=None, encoderLayerSizes=(256,),
@@ -142,16 +144,19 @@ class VariationalAutoencoder(Layer):
             params[f"dW{i}"] = init_weight(k, (a, b), self.weightInit,
                                            self.dist)
             params[f"db{i}"] = jnp.zeros((b,), jnp.float32)
-        key, k1, k2 = jax.random.split(key, 3)
+        key, k1 = jax.random.split(key)
         hd = sizes_d[-1]
-        params["rW"] = init_weight(k1, (hd, int(self.nIn)),
+        n_params = self._distribution().num_params(int(self.nIn))
+        params["rW"] = init_weight(k1, (hd, n_params),
                                    self.weightInit, self.dist)
-        params["rb"] = jnp.zeros((int(self.nIn),), jnp.float32)
-        if self.reconstructionDistribution == "gaussian":
-            params["rlvW"] = init_weight(k2, (hd, int(self.nIn)),
-                                         self.weightInit, self.dist)
-            params["rlvb"] = jnp.zeros((int(self.nIn),), jnp.float32)
+        params["rb"] = jnp.zeros((n_params,), jnp.float32)
         return params, {}, self.output_type(input_type)
+
+    def _distribution(self):
+        from deeplearning4j_tpu.nn.conf.variational import \
+            resolve_reconstruction_distribution
+        return resolve_reconstruction_distribution(
+            self.reconstructionDistribution)
 
     # -- encoder/decoder pieces ------------------------------------------
     def _encode(self, params, x):
@@ -180,25 +185,59 @@ class VariationalAutoencoder(Layer):
         mu, _ = self._encode(params, x)
         return mu, state
 
+    def _recon_params(self, params, h):
+        """Decoder head → the reconstruction distribution's params."""
+        expect = self._distribution().num_params(int(self.nIn))
+        got = params["rW"].shape[-1]
+        if got != expect:
+            raise ValueError(
+                f"VariationalAutoencoder '{self.name}': reconstruction "
+                f"head has {got} params but distribution "
+                f"'{self.reconstructionDistribution}' needs {expect} for "
+                f"nIn={self.nIn}. A checkpoint saved before the "
+                "distribution-object layout (separate rW/rlvW heads) "
+                "cannot be loaded into this layer — re-train or "
+                "concatenate the old rW|rlvW into one head.")
+        return h @ params["rW"].astype(h.dtype) \
+            + params["rb"].astype(h.dtype)
+
     def reconstruct(self, params, x):
         """Mean reconstruction through the latent mean (≡ reference
         reconstructionProbability-style usage, deterministic form)."""
         mu, _ = self._encode(params, x)
         h = self._decode(params, mu)
-        r = h @ params["rW"] + params["rb"]
-        if self.reconstructionDistribution == "bernoulli":
-            r = jax.nn.sigmoid(r)
-        return r
+        return self._distribution().mean(self._recon_params(params, h))
 
     def generateAtMeanGivenZ(self, params, z):
         h = self._decode(params, jnp.asarray(z))
-        r = h @ params["rW"] + params["rb"]
-        if self.reconstructionDistribution == "bernoulli":
-            r = jax.nn.sigmoid(r)
-        return r
+        return self._distribution().mean(self._recon_params(params, h))
+
+    def reconstructionLogProbability(self, params, x, rng=None,
+                                     numSamples=None):
+        """≡ VariationalAutoencoder.reconstructionLogProbability — MC
+        estimate of log p(x) via importance sampling from q(z|x):
+        log(1/S · Σ p(x|z_s)p(z_s)/q(z_s|x)). Per-example (B,)."""
+        dist = self._distribution()
+        mu, logvar = self._encode(params, x)
+        rng = jax.random.PRNGKey(0) if rng is None else rng
+        s_count = int(numSamples or self.numSamples)
+        log_ws = []
+        for s in range(s_count):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mu.shape,
+                                    mu.dtype)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+            h = self._decode(params, z)
+            log_px_z = dist.log_prob(x, self._recon_params(params, h))
+            log_pz = -0.5 * (z ** 2 + jnp.log(2 * jnp.pi)).sum(-1)
+            log_qz = -0.5 * (logvar + eps ** 2
+                             + jnp.log(2 * jnp.pi)).sum(-1)
+            log_ws.append(log_px_z + log_pz - log_qz)
+        return jax.scipy.special.logsumexp(
+            jnp.stack(log_ws), axis=0) - jnp.log(float(s_count))
 
     def pretrain_loss(self, params, x, rng):
         """-ELBO (one MC sample per numSamples), mean over batch."""
+        dist = self._distribution()
         mu, logvar = self._encode(params, x)
         total = 0.0
         for s in range(self.numSamples):
@@ -206,17 +245,7 @@ class VariationalAutoencoder(Layer):
                                     mu.dtype)
             z = mu + jnp.exp(0.5 * logvar) * eps
             h = self._decode(params, z)
-            rmu = h @ params["rW"].astype(x.dtype) \
-                + params["rb"].astype(x.dtype)
-            if self.reconstructionDistribution == "bernoulli":
-                ll = -(jnp.maximum(rmu, 0) - rmu * x
-                       + jnp.log1p(jnp.exp(-jnp.abs(rmu)))).sum(-1)
-            else:
-                rlv = h @ params["rlvW"].astype(x.dtype) \
-                    + params["rlvb"].astype(x.dtype)
-                ll = -0.5 * (rlv + (x - rmu) ** 2 / jnp.exp(rlv)
-                             + jnp.log(2 * jnp.pi)).sum(-1)
-            total = total + ll
+            total = total + dist.log_prob(x, self._recon_params(params, h))
         ll = total / self.numSamples
         kl = -0.5 * (1 + logvar - mu ** 2 - jnp.exp(logvar)).sum(-1)
         return jnp.mean(kl - ll)

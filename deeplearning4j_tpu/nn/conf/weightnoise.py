@@ -1,0 +1,93 @@
+"""Weight-space noise (≡ deeplearning4j-nn :: conf.weightnoise.
+{WeightNoise, DropConnect, IWeightNoise}).
+
+Unlike dropout (activation-space), these perturb the PARAMETERS each
+training step, inside the jitted train step: the noise sample is a pure
+function of the step rng, so the whole thing stays one compiled program
+— no host round-trip per step, no recompiles. Test-time forward uses the
+clean weights (inverted scaling for DropConnect keeps the train-time
+expectation equal to the clean weights, as the reference's inverted
+dropout on params does).
+
+Usage: layer kwarg or builder default `weightNoise=DropConnect(0.5)` /
+`WeightNoise({"type": "normal", "std": 0.01}, additive=True)`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _sample(distribution, rng, shape, dtype):
+    kind = (distribution or {}).get("type", "normal")
+    if kind == "normal":
+        return (distribution.get("mean", 0.0)
+                + distribution.get("std", 1.0)
+                * jax.random.normal(rng, shape, dtype))
+    if kind == "uniform":
+        return jax.random.uniform(rng, shape, dtype,
+                                  distribution.get("lower", -1.0),
+                                  distribution.get("upper", 1.0))
+    raise ValueError(f"Unknown weight-noise distribution type '{kind}'")
+
+
+class IWeightNoise:
+    """Contract: map a layer's params pytree to a noised pytree (train
+    only; the caller gates on `train`)."""
+
+    def apply_to_params(self, params, rng):
+        raise NotImplementedError
+
+
+def _is_bias(name):
+    return name == "b" or name.endswith("b") or "bias" in name.lower()
+
+
+class WeightNoise(IWeightNoise):
+    """≡ conf.weightnoise.WeightNoise — additive (W + ε) or
+    multiplicative (W · ε) noise from a distribution spec dict."""
+
+    def __init__(self, distribution=None, applyToBias=False, additive=True):
+        self.distribution = dict(distribution
+                                 or {"type": "normal", "std": 0.01})
+        self.applyToBias = bool(applyToBias)
+        self.additive = bool(additive)
+
+    def apply_to_params(self, params, rng):
+        out = {}
+        for i, (k, v) in enumerate(sorted(params.items())):
+            if _is_bias(k) and not self.applyToBias:
+                out[k] = v
+                continue
+            eps = _sample(self.distribution, jax.random.fold_in(rng, i),
+                          v.shape, v.dtype)
+            out[k] = v + eps if self.additive else v * eps
+        return out
+
+
+class DropConnect(IWeightNoise):
+    """≡ conf.weightnoise.DropConnect — inverted dropout on the weights:
+    W' = W · Bernoulli(p) / p with retain probability p (test time uses
+    the clean W, expectation preserved)."""
+
+    def __init__(self, weightRetainProb=0.5, applyToBias=False):
+        p = float(weightRetainProb)
+        if not 0.0 < p <= 1.0:
+            raise ValueError(
+                f"DropConnect: weightRetainProb must be in (0, 1], got {p}")
+        self.weightRetainProb = p
+        self.applyToBias = bool(applyToBias)
+
+    def apply_to_params(self, params, rng):
+        p = self.weightRetainProb
+        if p == 1.0:
+            return params
+        out = {}
+        for i, (k, v) in enumerate(sorted(params.items())):
+            if _is_bias(k) and not self.applyToBias:
+                out[k] = v
+                continue
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(rng, i), p, v.shape)
+            out[k] = jnp.where(keep, v / p, 0.0).astype(v.dtype)
+        return out

@@ -132,6 +132,141 @@ def mape(labels, preact, activation="identity", mask=None):
     return _apply_mask_mean(per, mask)
 
 
+def wasserstein(labels, preact, activation="identity", mask=None):
+    """≡ lossfunctions.impl.LossWasserstein — critic loss y·f(x) (labels
+    are ±1 for real/generated in the WGAN recipe)."""
+    labels, preact, mask = _flatten_time(labels, preact, mask)
+    out = get_activation(activation)(preact)
+    return _apply_mask_mean(labels * out / labels.shape[-1], mask)
+
+
+def multilabel(labels, preact, activation="identity", mask=None):
+    """≡ lossfunctions.impl.LossMultiLabel — BP-MLL pairwise ranking
+    loss (Zhang & Zhou): per example, mean over (positive, negative)
+    label pairs of exp(-(o_p - o_n)). Vectorized over the P×N pair grid
+    — no per-pair host loop; examples lacking a positive or a negative
+    contribute zero, as in the reference."""
+    labels, preact, mask = _flatten_time(labels, preact, mask)
+    out = get_activation(activation)(preact)
+    pos = (labels > 0.5).astype(out.dtype)                      # (B, L)
+    neg = 1.0 - pos
+    # exp(o_n - o_p) summed over the pair grid = (Σ_n e^{o_n} w_n)(Σ_p
+    # e^{-o_p} w_p) — O(L) instead of O(L²) via the product factorization
+    e_neg = jnp.sum(jnp.exp(out) * neg, axis=-1)
+    e_pos = jnp.sum(jnp.exp(-out) * pos, axis=-1)
+    n_pairs = pos.sum(-1) * neg.sum(-1)
+    per_ex = jnp.where(n_pairs > 0, e_neg * e_pos
+                       / jnp.maximum(n_pairs, 1.0), 0.0)
+    return _apply_mask_mean(per_ex[..., None], mask)
+
+
+class LossFMeasure:
+    """≡ lossfunctions.impl.LossFMeasure — differentiable 1 − F_β over
+    the WHOLE minibatch (soft TP/FP/FN from probabilities). Binary only:
+    one sigmoid column, or two softmax columns (positive = column 1)."""
+
+    def __init__(self, beta=1.0):
+        if beta <= 0:
+            raise ValueError(f"LossFMeasure: beta must be > 0, got {beta}")
+        self.beta = float(beta)
+
+    def __call__(self, labels, preact, activation=None, mask=None):
+        labels, preact, mask = _flatten_time(labels, preact, mask)
+        n_col = preact.shape[-1]
+        if n_col == 1:
+            p = get_activation(activation or "sigmoid")(preact)[..., 0]
+            y = labels[..., 0]
+        elif n_col == 2:
+            p = get_activation(activation or "softmax")(preact)[..., 1]
+            y = labels[..., 1]
+        else:
+            raise ValueError(
+                f"LossFMeasure supports 1 or 2 output columns, got {n_col}")
+        if mask is not None:
+            m = mask.reshape(y.shape).astype(p.dtype)
+            p, y = p * m, y * m
+        tp = jnp.sum(y * p)
+        fp = jnp.sum((1.0 - y) * p)
+        fn = jnp.sum(y * (1.0 - p))
+        b2 = self.beta ** 2
+        f = (1.0 + b2) * tp / jnp.maximum((1.0 + b2) * tp + b2 * fn + fp,
+                                          1e-8)
+        return 1.0 - f
+
+
+class LossMixtureDensity:
+    """≡ lossfunctions.impl.LossMixtureDensity — mixture-density-network
+    NLL (Bishop 1994). Network output layout per example:
+    [mixture logits (K) | log σ (K) | means (K·labelWidth)], i.e.
+    nOut = K·(labelWidth + 2); isotropic σ per component. The whole
+    K-component log-likelihood lowers to one logsumexp — no per-component
+    branching."""
+
+    def __init__(self, gaussians, labelWidth):
+        self.gaussians = int(gaussians)
+        self.labelWidth = int(labelWidth)
+
+    def nOut(self):
+        return self.gaussians * (self.labelWidth + 2)
+
+    def _split(self, preact):
+        k, d = self.gaussians, self.labelWidth
+        if preact.shape[-1] != k * (d + 2):
+            raise ValueError(
+                f"LossMixtureDensity: expected nOut = K(d+2) = {k * (d + 2)}"
+                f" (K={k} gaussians, labelWidth={d}), got "
+                f"{preact.shape[-1]}")
+        log_alpha = jax.nn.log_softmax(preact[..., :k], axis=-1)
+        log_sigma = jnp.clip(preact[..., k:2 * k], -10.0, 10.0)
+        mu = preact[..., 2 * k:].reshape(*preact.shape[:-1], k, d)
+        return log_alpha, log_sigma, mu
+
+    def log_prob(self, labels, preact):
+        """Per-example log p(y) under the mixture; (B,)."""
+        d = self.labelWidth
+        log_alpha, log_sigma, mu = self._split(preact)
+        sq = ((labels[..., None, :] - mu) ** 2).sum(-1)       # (B, K)
+        log_n = (-0.5 * sq / jnp.exp(2.0 * log_sigma)
+                 - d * log_sigma - 0.5 * d * jnp.log(2 * jnp.pi))
+        return jax.scipy.special.logsumexp(log_alpha + log_n, axis=-1)
+
+    def __call__(self, labels, preact, activation=None, mask=None):
+        # activation must stay identity: the loss owns its own
+        # softmax/exp parameterization of the mixture
+        labels, preact, mask = _flatten_time(labels, preact, mask)
+        return _apply_mask_mean(-self.log_prob(labels, preact)[..., None],
+                                mask)
+
+    def sample(self, preact, rng):
+        """Draw one y per example from the predicted mixture."""
+        log_alpha, log_sigma, mu = self._split(jnp.asarray(preact))
+        k_comp, k_eps = jax.random.split(rng)
+        comp = jax.random.categorical(k_comp, log_alpha, axis=-1)  # (B,)
+        sel = jnp.take_along_axis(
+            mu, comp[..., None, None].astype(jnp.int32), axis=-2)[..., 0, :]
+        sig = jnp.take_along_axis(jnp.exp(log_sigma),
+                                  comp[..., None].astype(jnp.int32),
+                                  axis=-1)
+        eps = jax.random.normal(k_eps, sel.shape, sel.dtype)
+        return sel + sig * eps
+
+
+class LossWasserstein:
+    """Object form of `wasserstein` (name parity with
+    lossfunctions.impl.LossWasserstein)."""
+
+    def __call__(self, labels, preact, activation=None, mask=None):
+        return wasserstein(labels, preact, activation or "identity", mask)
+
+
+class LossMultiLabel:
+    """Object form of `multilabel` (name parity with
+    lossfunctions.impl.LossMultiLabel)."""
+
+    def __call__(self, labels, preact, activation=None, mask=None):
+        return multilabel(labels, preact, activation or "identity", mask)
+
+
 LOSSES = {
     "mcxent": mcxent,
     "negativeloglikelihood": mcxent,  # ND4J aliases NLL to MCXENT semantics
@@ -149,6 +284,9 @@ LOSSES = {
     "cosine_proximity": cosine_proximity,
     "mean_absolute_percentage_error": mape,
     "mape": mape,
+    "wasserstein": wasserstein,
+    "multilabel": multilabel,
+    "fmeasure": LossFMeasure(),       # β=1; use LossFMeasure(beta=…) to tune
 }
 
 
@@ -175,6 +313,9 @@ class LossFunction:
     POISSON = "poisson"
     COSINE_PROXIMITY = "cosine_proximity"
     MEAN_ABSOLUTE_PERCENTAGE_ERROR = "mape"
+    WASSERSTEIN = "wasserstein"
+    MULTILABEL = "multilabel"
+    FMEASURE = "fmeasure"
 
 
 # -- configurable loss objects (≡ nd4j lossfunctions.impl.LossMCXENT /

@@ -182,6 +182,15 @@ class MultiLayerNetwork:
                 lrng = jax.random.fold_in(rng, i)
             p = params.get(str(i), {})
             s = state.get(str(i), {})
+            if getattr(layer, "frozen_params", False):
+                # ≡ FrozenLayerWithBackprop: params are constants to the
+                # grad (train-mode behavior and upstream gradients kept)
+                p = jax.tree_util.tree_map(jax.lax.stop_gradient, p)
+            wn = getattr(layer, "weightNoise", None)
+            if wn is not None and ltrain and lrng is not None:
+                # weight-space noise (WeightNoise/DropConnect): a pure
+                # function of the step rng — stays inside the jitted step
+                p = wn.apply_to_params(p, jax.random.fold_in(lrng, 0x57))
             if i == len(self.layers) - 1 and hasattr(layer, "compute_loss") \
                     and hasattr(layer, "pre_activation"):
                 preact = layer.pre_activation(p, layer._dropout_in(x, ltrain, lrng))

@@ -1,7 +1,8 @@
 """RL (≡ rl4j): MDPs, experience replay, sync DQN, batched-env A2C/A3C,
 async n-step Q — policy surfaces mirroring rl4j's learning classes."""
 from deeplearning4j_tpu.rl.mdp import (CartpoleNative, DiscreteSpace, MDP,
-                                       ObservationSpace, SimpleToy)
+                                       ObservationSpace, PixelGridWorld,
+                                       SimpleToy)
 from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
 from deeplearning4j_tpu.rl.dqn import (DQNDenseNetworkConfiguration,
                                        DQNFactoryStdDense, DQNPolicy,
@@ -9,12 +10,18 @@ from deeplearning4j_tpu.rl.dqn import (DQNDenseNetworkConfiguration,
                                        QLearningDiscreteDense)
 from deeplearning4j_tpu.rl.a3c import (A3CConfiguration, A3CDiscreteDense,
                                        AsyncNStepQLearningDiscreteDense)
+from deeplearning4j_tpu.rl.conv import (DQNConvNetworkConfiguration,
+                                        DQNFactoryStdConv, HistoryProcessor,
+                                        HistoryProcessorConfiguration,
+                                        QLearningDiscreteConv)
 
 __all__ = [
     "CartpoleNative", "DiscreteSpace", "MDP", "ObservationSpace",
-    "SimpleToy", "ExpReplay", "Transition",
+    "PixelGridWorld", "SimpleToy", "ExpReplay", "Transition",
     "DQNDenseNetworkConfiguration", "DQNFactoryStdDense", "DQNPolicy",
     "EpsGreedy", "QLearningConfiguration", "QLearningDiscreteDense",
     "A3CConfiguration", "A3CDiscreteDense",
     "AsyncNStepQLearningDiscreteDense",
+    "DQNConvNetworkConfiguration", "DQNFactoryStdConv", "HistoryProcessor",
+    "HistoryProcessorConfiguration", "QLearningDiscreteConv",
 ]

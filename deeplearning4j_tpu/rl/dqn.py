@@ -119,6 +119,28 @@ class DQNPolicy:
         return total
 
 
+def td_learn_batch(net, target, replay, conf):
+    """One (double-)DQN TD update on a replay batch — shared by the dense
+    and conv learners: bootstrap from the target net (argmax from the
+    online net when doubleDQN), clamp the TD error, fit on the patched
+    Q-table (the reference's QLearning.setTarget path)."""
+    obs, actions, rewards, next_obs, dones = replay.getBatch()
+    q_next_t = np.asarray(target.output(next_obs))
+    if conf.doubleDQN:
+        best = np.asarray(net.output(next_obs)).argmax(-1)
+        boot = q_next_t[np.arange(len(best)), best]
+    else:
+        boot = q_next_t.max(-1)
+    td_target = rewards * conf.rewardFactor \
+        + conf.gamma * boot * (1 - dones)
+    q = np.array(net.output(obs))  # copy: jax buffers are read-only
+    err = td_target - q[np.arange(len(actions)), actions]
+    if conf.errorClamp:
+        err = np.clip(err, -conf.errorClamp, conf.errorClamp)
+    q[np.arange(len(actions)), actions] += err
+    net.fit(obs, q)
+
+
 class QLearningDiscreteDense:
     """≡ QLearningDiscreteDense — sync DQN over an MDP with dense obs."""
 
@@ -143,21 +165,7 @@ class QLearningDiscreteDense:
         return DQNPolicy(self.net)
 
     def _learn_batch(self):
-        obs, actions, rewards, next_obs, dones = self.replay.getBatch()
-        c = self.conf
-        q_next_t = np.asarray(self.target.output(next_obs))
-        if c.doubleDQN:
-            best = np.asarray(self.net.output(next_obs)).argmax(-1)
-            boot = q_next_t[np.arange(len(best)), best]
-        else:
-            boot = q_next_t.max(-1)
-        td_target = rewards * c.rewardFactor + c.gamma * boot * (1 - dones)
-        q = np.array(self.net.output(obs))  # copy: jax buffers are read-only
-        err = td_target - q[np.arange(len(actions)), actions]
-        if c.errorClamp:
-            err = np.clip(err, -c.errorClamp, c.errorClamp)
-        q[np.arange(len(actions)), actions] += err
-        self.net.fit(obs, q)
+        td_learn_batch(self.net, self.target, self.replay, self.conf)
 
     def train(self):
         """Run until maxStep env steps; returns per-epoch reward list."""

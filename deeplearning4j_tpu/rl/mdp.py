@@ -139,3 +139,49 @@ class SimpleToy(MDP):
             self.done = True
             self.pos = self.length - 1
         return self._obs(), reward, self.done, {}
+
+
+class PixelGridWorld(MDP):
+    """Synthetic PIXEL MDP for the conv-DQN path (stands in for the
+    reference's ALE screens, zero egress): the agent is a bright square
+    on a 1-D track rendered as a (size·scale, size·scale) grayscale
+    frame. Every move costs −0.01; reaching the right edge pays +1.0 and
+    ends the episode. Optimal policy: always go right, as fast as
+    possible — learnable ONLY from the pixels."""
+
+    def __init__(self, size=6, scale=2, maxSteps=40, seed=0):
+        self.size = int(size)
+        self.scale = int(scale)
+        self.maxSteps = int(maxSteps)
+        px = self.size * self.scale
+        self.observation_space = ObservationSpace((px, px))
+        self.action_space = DiscreteSpace(2)
+        self._rng = np.random.default_rng(seed)
+        self.done = True
+        self.pos = 0
+        self._steps = 0
+
+    def _frame(self):
+        f = np.zeros((self.size, self.size), np.float32)
+        f[self.size // 2, self.pos] = 1.0
+        return np.kron(f, np.ones((self.scale, self.scale), np.float32))
+
+    def reset(self):
+        self.pos = 0
+        self.done = False
+        self._steps = 0
+        return self._frame()
+
+    def step(self, action):
+        self._steps += 1
+        reward = -0.01
+        if action == 1:
+            self.pos = min(self.pos + 1, self.size - 1)
+        else:
+            self.pos = max(self.pos - 1, 0)
+        if self.pos >= self.size - 1:
+            reward = 1.0
+            self.done = True
+        if self._steps >= self.maxSteps:
+            self.done = True
+        return self._frame(), reward, self.done, {}

@@ -12,6 +12,8 @@ import types
 _CONFIG_MODULES = [
     "deeplearning4j_tpu.nn.conf.layers",
     "deeplearning4j_tpu.nn.conf.special_layers",
+    "deeplearning4j_tpu.nn.conf.variational",
+    "deeplearning4j_tpu.nn.conf.weightnoise",
     "deeplearning4j_tpu.nn.conf.objdetect",
     "deeplearning4j_tpu.nn.losses",
     "deeplearning4j_tpu.nn.conf.inputs",

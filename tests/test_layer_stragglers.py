@@ -1,0 +1,270 @@
+"""Layer-catalog stragglers (VERDICT r3 #6): CnnLossLayer,
+ElementWiseMultiplicationLayer, Deconvolution3D, FrozenLayer /
+FrozenLayerWithBackprop, WeightNoise / DropConnect."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    CnnLossLayer, ConvolutionLayer, DenseLayer,
+    ElementWiseMultiplicationLayer, FrozenLayer, FrozenLayerWithBackprop,
+    OutputLayer)
+from deeplearning4j_tpu.nn.conf.layers3d import Convolution3D, Deconvolution3D
+from deeplearning4j_tpu.nn.conf.weightnoise import DropConnect, WeightNoise
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+
+
+def _rand(shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+class TestCnnLossLayer:
+    def _net(self):
+        return MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+            .weightInit("relu").list()
+            .layer(ConvolutionLayer(kernelSize=(3, 3), nOut=8,
+                                    convolutionMode="same",
+                                    activation="relu"))
+            .layer(ConvolutionLayer(kernelSize=(1, 1), nOut=3,
+                                    convolutionMode="same",
+                                    activation="identity"))
+            .layer(CnnLossLayer(lossFunction="mcxent",
+                                activation="softmax"))
+            .setInputType(InputType.convolutional(6, 6, 2)).build()).init()
+
+    def test_per_pixel_segmentation_trains(self):
+        net = self._net()
+        x = _rand((4, 6, 6, 2))
+        # labels: one-hot class per pixel driven by input sign
+        cls = (x[..., 0] > 0).astype(int) + (x[..., 1] > 0).astype(int)
+        lab = np.eye(3, dtype=np.float32)[cls]
+        for _ in range(80):
+            net.fit(x, lab)
+        out = np.asarray(net.output(x).numpy())
+        assert out.shape == (4, 6, 6, 3)
+        np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-4)
+        acc = (out.argmax(-1) == cls).mean()
+        assert acc > 0.8
+
+    def test_rejects_flat_input(self):
+        with pytest.raises(ValueError, match="convolutional input"):
+            MultiLayerNetwork(
+                NeuralNetConfiguration.Builder().list()
+                .layer(DenseLayer(nOut=4))
+                .layer(CnnLossLayer(lossFunction="mse"))
+                .setInputType(InputType.feedForward(3)).build()).init()
+
+
+class TestElementWiseMultiplication:
+    def test_oracle_and_learns_scale(self):
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(0).updater(Sgd(0.5))
+            .list()
+            .layer(ElementWiseMultiplicationLayer(activation="identity"))
+            .layer(OutputLayer(lossFunction="mse", nOut=4,
+                               activation="identity"))
+            .setInputType(InputType.feedForward(4)).build()).init()
+        # init: W=1, b=0 -> identity
+        x = _rand((8, 4))
+        l0 = np.asarray(
+            net.activateSelectedLayers(0, 0, x).jax())
+        np.testing.assert_allclose(l0, x, atol=1e-6)
+        # train to y = 3x (the output layer could do it alone; check the
+        # elementwise W moved off its 1.0 init too)
+        y = 3.0 * x
+        for _ in range(60):
+            net.fit(x, y)
+        out = np.asarray(net.output(x).numpy())
+        assert float(np.mean((out - y) ** 2)) < 0.05
+
+    def test_nin_nout_mismatch_raises(self):
+        with pytest.raises(ValueError, match="elementwise"):
+            MultiLayerNetwork(
+                NeuralNetConfiguration.Builder().list()
+                .layer(ElementWiseMultiplicationLayer(nIn=4, nOut=5))
+                .layer(OutputLayer(lossFunction="mse", nOut=2))
+                .setInputType(InputType.feedForward(4)).build()).init()
+
+
+class TestDeconvolution3D:
+    def test_shape_same_and_truncate(self):
+        lt = Deconvolution3D(nOut=5, kernelSize=(2, 2, 2), stride=(2, 2, 2))
+        lt.apply_defaults({})
+        ot = lt.output_type(InputType.convolutional3D(3, 4, 5, 2))
+        assert (ot.depth, ot.height, ot.width, ot.channels) == (6, 8, 10, 5)
+        ls = Deconvolution3D(nOut=4, kernelSize=(3, 3, 3), stride=(2, 2, 2),
+                             convolutionMode="same")
+        ls.apply_defaults({})
+        os_ = ls.output_type(InputType.convolutional3D(3, 4, 5, 2))
+        assert (os_.depth, os_.height, os_.width) == (6, 8, 10)
+
+    def test_inverts_conv3d_shape_and_gradcheck(self):
+        layer = Deconvolution3D(nIn=2, nOut=3, kernelSize=(2, 2, 2),
+                                stride=(2, 2, 2), activation="tanh")
+        layer.apply_defaults({})
+        params, _, _ = layer.initialize(
+            jax.random.PRNGKey(0), InputType.convolutional3D(2, 3, 3, 2))
+        x = jnp.asarray(_rand((1, 2, 3, 3, 2), 1))
+        y, _ = layer.apply(params, {}, x)
+        assert y.shape == (1, 4, 6, 6, 3)
+
+        def loss(p):
+            out, _ = layer.apply(p, {}, x)
+            return jnp.sum(jnp.sin(out))
+
+        g = jax.grad(loss)(params)
+        eps = 1e-3
+        flat = np.asarray(params["W"], np.float64).ravel()
+        i = 5
+        bump = np.zeros_like(flat)
+        bump[i] = eps
+        pp = dict(params)
+        pp["W"] = jnp.asarray((flat + bump).reshape(params["W"].shape),
+                              jnp.float32)
+        pm = dict(params)
+        pm["W"] = jnp.asarray((flat - bump).reshape(params["W"].shape),
+                              jnp.float32)
+        fd = (float(loss(pp)) - float(loss(pm))) / (2 * eps)
+        assert abs(float(np.asarray(g["W"]).ravel()[i]) - fd) < 2e-2
+
+    def test_trains_in_voxel_autoencoder(self):
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+            .weightInit("relu").list()
+            .layer(Convolution3D(kernelSize=(2, 2, 2), stride=(2, 2, 2),
+                                 nOut=4, activation="relu"))
+            .layer(Deconvolution3D(kernelSize=(2, 2, 2), stride=(2, 2, 2),
+                                   nOut=1, activation="identity"))
+            .layer(__import__("deeplearning4j_tpu.nn.conf.layers3d",
+                              fromlist=["Cnn3DLossLayer"]).Cnn3DLossLayer(
+                lossFunction="mse", activation="identity"))
+            .setInputType(InputType.convolutional3D(4, 4, 4, 1))
+            .build()).init()
+        x = _rand((2, 4, 4, 4, 1))
+        s0 = None
+        for _ in range(25):
+            net.fit(x, x)
+            if s0 is None:
+                s0 = float(net.score())
+        assert float(net.score()) < s0
+
+
+class TestFrozen:
+    def _fit_and_weights(self, wrap):
+        l0 = DenseLayer(nOut=8, activation="tanh", dropOut=0.5)
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(0).updater(Sgd(0.1))
+            .weightInit("xavier").list()
+            .layer(wrap(l0) if wrap else l0)
+            .layer(OutputLayer(nOut=2, activation="softmax",
+                               lossFunction="mcxent"))
+            .setInputType(InputType.feedForward(4)).build()).init()
+        w_before = np.asarray(net._params["0"]["W"]).copy()
+        w1_before = np.asarray(net._params["1"]["W"]).copy()
+        x = _rand((16, 4))
+        y = np.eye(2, dtype=np.float32)[
+            np.random.default_rng(0).integers(2, size=16)]
+        for _ in range(5):
+            net.fit(x, y)
+        return (w_before, np.asarray(net._params["0"]["W"]),
+                w1_before, np.asarray(net._params["1"]["W"]))
+
+    def test_frozen_layer_params_pinned_downstream_trains(self):
+        wb, wa, w1b, w1a = self._fit_and_weights(FrozenLayer)
+        np.testing.assert_array_equal(wb, wa)
+        assert not np.allclose(w1b, w1a)
+
+    def test_frozen_with_backprop_params_pinned(self):
+        wb, wa, w1b, w1a = self._fit_and_weights(FrozenLayerWithBackprop)
+        np.testing.assert_array_equal(wb, wa)
+        assert not np.allclose(w1b, w1a)
+
+    def test_frozen_runs_inference_mode_but_backprop_keeps_dropout(self):
+        """FrozenLayer disables the wrapped layer's dropout during
+        training; FrozenLayerWithBackprop keeps it."""
+        x = jnp.asarray(_rand((64, 4)))
+        rng = jax.random.PRNGKey(3)
+
+        def train_forward(wrap):
+            l0 = DenseLayer(nOut=8, activation="identity", dropOut=0.5)
+            net = MultiLayerNetwork(
+                NeuralNetConfiguration.Builder().seed(0).updater(Sgd(0.1))
+                .weightInit("xavier").list()
+                .layer(wrap(l0))
+                .layer(OutputLayer(nOut=2, activation="softmax"))
+                .setInputType(InputType.feedForward(4)).build()).init()
+            a, _, _, _ = net._forward(net._params, net._state, x, True, rng)
+            b, _, _, _ = net._forward(net._params, net._state, x, False,
+                                      None)
+            return np.asarray(a), np.asarray(b)
+
+        a, b = train_forward(FrozenLayer)
+        np.testing.assert_allclose(a, b, atol=1e-6)   # inference mode
+        a2, b2 = train_forward(FrozenLayerWithBackprop)
+        assert not np.allclose(a2, b2)                # dropout still live
+
+
+class TestWeightNoise:
+    def test_dropconnect_train_only_and_scaling(self):
+        dc = DropConnect(weightRetainProb=0.5)
+        params = {"W": jnp.ones((64, 64)), "b": jnp.ones((64,))}
+        noised = dc.apply_to_params(params, jax.random.PRNGKey(0))
+        w = np.asarray(noised["W"])
+        # surviving weights are scaled 1/p; bias untouched by default
+        vals = np.unique(w)
+        assert set(np.round(vals, 4)) <= {0.0, 2.0}
+        assert 0.3 < (w == 0).mean() < 0.7
+        np.testing.assert_array_equal(np.asarray(noised["b"]),
+                                      np.asarray(params["b"]))
+
+    def test_weight_noise_additive_and_multiplicative(self):
+        params = {"W": jnp.full((32, 32), 2.0)}
+        add = WeightNoise({"type": "normal", "std": 0.1}, additive=True)
+        mul = WeightNoise({"type": "normal", "mean": 1.0, "std": 0.1},
+                          additive=False)
+        wa = np.asarray(add.apply_to_params(params,
+                                            jax.random.PRNGKey(1))["W"])
+        wm = np.asarray(mul.apply_to_params(params,
+                                            jax.random.PRNGKey(1))["W"])
+        assert abs(wa.mean() - 2.0) < 0.05
+        assert abs(wm.mean() - 2.0) < 0.1
+        assert wa.std() < 0.2 and 0.05 < wm.std() < 0.4
+
+    def test_dropconnect_validation(self):
+        with pytest.raises(ValueError, match="weightRetainProb"):
+            DropConnect(weightRetainProb=0.0)
+
+    def test_network_trains_with_dropconnect_and_test_uses_clean_weights(
+            self):
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+            .weightInit("xavier").list()
+            .layer(DenseLayer(nOut=16, activation="tanh",
+                              weightNoise=DropConnect(0.8)))
+            .layer(OutputLayer(nOut=2, activation="softmax",
+                               lossFunction="mcxent"))
+            .setInputType(InputType.feedForward(4)).build()).init()
+        x = _rand((32, 4))
+        y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+        for _ in range(40):
+            net.fit(x, y)
+        # test-time forward is deterministic (clean weights)
+        o1 = np.asarray(net.output(x).numpy())
+        o2 = np.asarray(net.output(x).numpy())
+        np.testing.assert_array_equal(o1, o2)
+        acc = (o1.argmax(-1) == y.argmax(-1)).mean()
+        assert acc > 0.85
+
+    def test_builder_default_applies_to_all_layers(self):
+        conf = (NeuralNetConfiguration.Builder().seed(0)
+                .weightNoise(DropConnect(0.9)).list()
+                .layer(DenseLayer(nOut=8))
+                .layer(OutputLayer(nOut=2))
+                .setInputType(InputType.feedForward(4)).build())
+        assert isinstance(conf.layers[0].weightNoise, DropConnect)
+        assert isinstance(conf.layers[1].weightNoise, DropConnect)

@@ -1,0 +1,105 @@
+"""Pixel-input RL path (VERDICT r3 #7; ≡ rl4j HistoryProcessor /
+DQNFactoryStdConv / QLearningDiscreteConv tests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.rl import (DQNConvNetworkConfiguration,
+                                   DQNFactoryStdConv, HistoryProcessor,
+                                   HistoryProcessorConfiguration,
+                                   PixelGridWorld, QLearningConfiguration,
+                                   QLearningDiscreteConv)
+
+
+class TestHistoryProcessor:
+    def test_grayscale_crop_rescale(self):
+        conf = HistoryProcessorConfiguration(
+            historyLength=3, rescaledWidth=4, rescaledHeight=4,
+            croppingWidth=8, croppingHeight=8, offsetX=2, offsetY=2,
+            skipFrame=1)
+        hp = HistoryProcessor(conf)
+        frame = np.zeros((12, 12, 3), np.uint8)
+        frame[2:10, 2:10] = 255            # bright crop region
+        f = hp.preProcess(frame)
+        assert f.shape == (4, 4)
+        np.testing.assert_allclose(f, 1.0, atol=1e-6)   # RGB→luma→/255
+
+    def test_ring_cold_start_and_rotation(self):
+        conf = HistoryProcessorConfiguration(
+            historyLength=3, rescaledWidth=2, rescaledHeight=2, skipFrame=1)
+        hp = HistoryProcessor(conf)
+        with pytest.raises(RuntimeError, match="record"):
+            hp.getHistory()
+        hp.record(np.full((2, 2), 1.0, np.float32))
+        h = hp.getHistory()
+        # cold start: ring filled with the first frame
+        assert h.shape == (2, 2, 3)
+        np.testing.assert_array_equal(h, 1.0)
+        hp.record(np.full((2, 2), 0.5, np.float32))
+        h = hp.getHistory()
+        # newest frame rides in the LAST channel
+        np.testing.assert_array_equal(h[..., -1], 0.5)
+        np.testing.assert_array_equal(h[..., 0], 1.0)
+        hp.reset()
+        with pytest.raises(RuntimeError):
+            hp.getHistory()
+
+    def test_nearest_resize_downscale(self):
+        conf = HistoryProcessorConfiguration(
+            historyLength=1, rescaledWidth=3, rescaledHeight=3, skipFrame=1)
+        hp = HistoryProcessor(conf)
+        frame = np.arange(36, dtype=np.float32).reshape(6, 6) / 36.0
+        f = hp.preProcess(frame)
+        assert f.shape == (3, 3)
+        np.testing.assert_allclose(f, frame[::2, ::2], atol=1e-6)
+
+
+class TestConvFactory:
+    def test_builds_atari_shape_net(self):
+        net = DQNFactoryStdConv(DQNConvNetworkConfiguration(
+            filters=(16, 32), kernels=((8, 8), (4, 4)),
+            strides=((4, 4), (2, 2)), denseUnits=64)).buildDQN(
+                (84, 84, 4), 6, seed=0)
+        q = np.asarray(net.output(
+            np.zeros((2, 84, 84, 4), np.float32)).numpy())
+        assert q.shape == (2, 6)
+
+
+class TestQLearningDiscreteConv:
+    def test_pixel_dqn_reaches_learning_criterion(self):
+        """Synthetic pixel MDP → conv DQN → greedy policy reaches the
+        optimal return (VERDICT r3 #7 acceptance)."""
+        mdp = PixelGridWorld(size=6, scale=2, maxSteps=30)
+        hp = HistoryProcessorConfiguration(
+            historyLength=2, rescaledWidth=12, rescaledHeight=12,
+            skipFrame=1)
+        net = DQNConvNetworkConfiguration(
+            learningRate=1e-3, filters=(8,), kernels=((3, 3),),
+            strides=((2, 2),), denseUnits=32)
+        ql = QLearningConfiguration(
+            seed=1, maxEpochStep=30, maxStep=600, expRepMaxSize=5000,
+            batchSize=16, targetDqnUpdateFreq=50, updateStart=20,
+            gamma=0.95, minEpsilon=0.05, epsilonNbStep=300)
+        learn = QLearningDiscreteConv(mdp, net, hp, ql)
+        rewards = learn.train()
+        assert len(rewards) > 10
+        # optimal: 5 right moves = 4·(−0.01) + 1.0 = 0.96
+        play = learn.getPolicy().play(
+            PixelGridWorld(size=6, scale=2, maxSteps=30))
+        assert play > 0.9
+
+    def test_frame_skip_repeats_action(self):
+        mdp = PixelGridWorld(size=8, scale=1, maxSteps=50)
+        hp = HistoryProcessorConfiguration(
+            historyLength=2, rescaledWidth=8, rescaledHeight=8,
+            skipFrame=3)
+        ql = QLearningConfiguration(seed=0, maxEpochStep=4, maxStep=4,
+                                    updateStart=100, batchSize=4)
+        net = DQNConvNetworkConfiguration(
+            filters=(4,), kernels=((3, 3),), strides=((2, 2),),
+            denseUnits=8)
+        learn = QLearningDiscreteConv(mdp, net, hp, ql)
+        learn.train()
+        # each agent decision advances the env by `skipFrame` frames:
+        # replay holds one transition per DECISION, the env counts frames
+        assert 1 <= len(learn.replay) <= 4
+        assert mdp._steps == 3 * len(learn.replay) or mdp.isDone()
