@@ -34,13 +34,15 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
+def _flash_fwd_kernel(*refs, block_k, causal, scale, tk_actual, has_mask):
     """Grid (BH, q_tiles, k_tiles), k innermost: only one (block_k, d) K/V
     tile is VMEM-resident per step; o/l/m accumulate in VMEM scratch across
     the k dimension and the output tile is written on the last k step.
+    The q and k tilings are independent, so Tq ≠ Tk (cross-attention)
+    falls out of the same kernel.
 
-    With has_mask, an extra (1, block_k) int32 key-validity tile (from the
-    per-example (B, T) padding mask) masks scores; invalid QUERY rows are
+    With has_mask, an extra (1, block_k) int32 KEY-validity tile (from the
+    per-example (B, Tk) padding mask) masks scores; invalid QUERY rows are
     handled outside the kernel (outputs zeroed, lse forced to +inf so the
     backward recompute sees p == 0)."""
     if has_mask:
@@ -70,7 +72,7 @@ def _flash_fwd_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
             jnp.int32, (block_q, block_k), 0)
         k_pos = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        mask = k_pos < t_actual
+        mask = k_pos < tk_actual
         if causal:
             mask &= q_pos >= k_pos
         if has_mask:
@@ -112,8 +114,8 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, widths)
 
 
-def _block_sizes(t, block_q, block_k):
-    return min(block_q, max(t, 8)), min(block_k, max(t, 8))
+def _block_sizes(tq, tk, block_q, block_k):
+    return min(block_q, max(tq, 8)), min(block_k, max(tk, 8))
 
 
 def _prep_mask(mask, block_k):
@@ -122,34 +124,37 @@ def _prep_mask(mask, block_k):
     return _pad_to(mask.astype(jnp.int32), 1, block_k)[:, None, :]
 
 
-def _flash_forward(q, k, v, mask, causal, block_q, block_k, interpret):
-    """Returns (out (B,H,T,D), lse (B*H, T_padded)). `mask` is an optional
-    (B, T) token-validity mask (self-attention: keys AND queries at False
-    positions are padding) — invalid q rows come back zeroed with
-    lse = +1e30 so the backward kernels recompute p == 0 for them."""
-    b, h, t, d = q.shape
+def _flash_forward(q, k, v, q_mask, kv_mask, causal, block_q, block_k,
+                   interpret):
+    """Returns (out (B,H,Tq,D), lse (B*H, Tq_padded)). `kv_mask` is an
+    optional (B, Tk) KEY-validity mask; `q_mask` an optional (B, Tq)
+    QUERY-validity mask — invalid q rows come back zeroed with
+    lse = +1e30 so the backward kernels recompute p == 0 for them.
+    Self-attention passes the same (B, T) mask for both."""
+    b, h, tq_a, d = q.shape
+    tk_a = k.shape[2]
     scale = 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    block_q, block_k = _block_sizes(t, block_q, block_k)
-    qp = _pad_to(q.reshape(b * h, t, d), 1, block_q)
-    kp = _pad_to(k.reshape(b * h, t, d), 1, block_k)
-    vp = _pad_to(v.reshape(b * h, t, d), 1, block_k)
+    block_q, block_k = _block_sizes(tq_a, tk_a, block_q, block_k)
+    qp = _pad_to(q.reshape(b * h, tq_a, d), 1, block_q)
+    kp = _pad_to(k.reshape(b * h, tk_a, d), 1, block_k)
+    vp = _pad_to(v.reshape(b * h, tk_a, d), 1, block_k)
     tq = qp.shape[1]
     grid = (b * h, tq // block_q, kp.shape[1] // block_k)
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
-                               causal=causal, scale=scale, t_actual=t,
-                               has_mask=mask is not None)
+                               causal=causal, scale=scale, tk_actual=tk_a,
+                               has_mask=kv_mask is not None)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
     ]
     operands = [qp, kp, vp]
-    if mask is not None:
+    if kv_mask is not None:
         in_specs.append(
             pl.BlockSpec((1, 1, block_k), lambda bh, i, j: (bh // h, 0, j)))
-        operands.append(_prep_mask(mask, block_k))
+        operands.append(_prep_mask(kv_mask, block_k))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -175,9 +180,16 @@ def _flash_forward(q, k, v, mask, causal, block_q, block_k, interpret):
         interpret=interpret,
     )(*operands)
     lse = lse[:, 0]
-    out = out[:, :t, :].reshape(b, h, t, d)
-    if mask is not None:
-        qvalid = mask.astype(bool)                      # (B, T)
+    out = out[:, :tq_a, :].reshape(b, h, tq_a, d)
+    if q_mask is not None or kv_mask is not None:
+        qvalid = (jnp.ones((b, tq_a), bool) if q_mask is None
+                  else q_mask.astype(bool))             # (B, Tq)
+        if kv_mask is not None:
+            # an example with NO valid keys has no defined softmax: its
+            # query rows come back zeroed, and the lse = +1e30 sentinel
+            # makes the backward recompute p == 0 (no dk/dv leak into
+            # fully-padded K/V)
+            qvalid &= kv_mask.astype(bool).any(axis=1)[:, None]
         out = jnp.where(qvalid[:, None, :, None], out, 0)
         lse_valid = _pad_to(qvalid, 1, block_q)[:, None, :]  # (B, 1, tq)
         lse = jnp.where(
@@ -190,7 +202,7 @@ def _flash_forward(q, k, v, mask, causal, block_q, block_k, interpret):
 # backward kernels
 # ---------------------------------------------------------------------------
 def _recompute_p(q_ref, k_ref, lse_ref, km_ref, qi, kj, block_q, block_k,
-                 causal, scale, t_actual):
+                 causal, scale, tk_actual):
     """exp(S − L) for this (q, k) tile — the fwd tile re-derived in VMEM.
     Invalid q rows carry lse == +1e30 (set by the forward wrapper), so
     exp(finite − 1e30) underflows to exactly 0 without a q-side mask."""
@@ -203,7 +215,7 @@ def _recompute_p(q_ref, k_ref, lse_ref, km_ref, qi, kj, block_q, block_k,
         jnp.int32, (block_q, block_k), 0)
     k_pos = kj * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    mask = k_pos < t_actual
+    mask = k_pos < tk_actual
     if causal:
         mask &= q_pos >= k_pos
     if km_ref is not None:
@@ -212,7 +224,7 @@ def _recompute_p(q_ref, k_ref, lse_ref, km_ref, qi, kj, block_q, block_k,
     return jnp.exp(s - lse_ref[0, 0][:, None])
 
 
-def _flash_bwd_dq_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
+def _flash_bwd_dq_kernel(*refs, block_k, causal, scale, tk_actual, has_mask):
     """Grid (BH, q_tiles, k_tiles), k innermost; dq accumulates in VMEM."""
     if has_mask:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, km_ref,
@@ -230,7 +242,7 @@ def _flash_bwd_dq_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
 
     def _compute():
         p = _recompute_p(q_ref, k_ref, lse_ref, km_ref, qi, kj, block_q,
-                         block_k, causal, scale, t_actual)
+                         block_k, causal, scale, tk_actual)
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
             do, v_ref[0].astype(jnp.float32),
@@ -252,7 +264,8 @@ def _flash_bwd_dq_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
+def _flash_bwd_dkv_kernel(*refs, block_k, causal, scale, tk_actual,
+                          has_mask):
     """Grid (BH, k_tiles, q_tiles), q innermost; dk/dv accumulate in VMEM."""
     if has_mask:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, km_ref,
@@ -272,7 +285,7 @@ def _flash_bwd_dkv_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
 
     def _compute():
         p = _recompute_p(q_ref, k_ref, lse_ref, km_ref, qi, kj, block_q,
-                         block_k, causal, scale, t_actual)
+                         block_k, causal, scale, tk_actual)
         do = do_ref[0].astype(jnp.float32)
         dv_acc[...] += jax.lax.dot_general(
             p, do, dimension_numbers=(((0,), (0,)), ((), ())),
@@ -299,23 +312,24 @@ def _flash_bwd_dkv_kernel(*refs, block_k, causal, scale, t_actual, has_mask):
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, mask, o, lse, g, causal, block_q, block_k,
-                    interpret):
-    b, h, t, d = q.shape
+def _flash_backward(q, k, v, q_mask, kv_mask, o, lse, g, causal, block_q,
+                    block_k, interpret):
+    b, h, tq_a, d = q.shape
+    tk_a = k.shape[2]
     scale = 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    block_q, block_k = _block_sizes(t, block_q, block_k)
-    has_mask = mask is not None
+    block_q, block_k = _block_sizes(tq_a, tk_a, block_q, block_k)
+    has_mask = kv_mask is not None
 
     # D = rowsum(dO ∘ O) — one fused elementwise pass, O(T·D) traffic
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
 
-    qp = _pad_to(q.reshape(b * h, t, d), 1, block_q)
-    dop = _pad_to(g.reshape(b * h, t, d), 1, block_q)
-    deltap = _pad_to(delta.reshape(b * h, t), 1, block_q)[:, None, :]
-    kp = _pad_to(k.reshape(b * h, t, d), 1, block_k)
-    vp = _pad_to(v.reshape(b * h, t, d), 1, block_k)
+    qp = _pad_to(q.reshape(b * h, tq_a, d), 1, block_q)
+    dop = _pad_to(g.reshape(b * h, tq_a, d), 1, block_q)
+    deltap = _pad_to(delta.reshape(b * h, tq_a), 1, block_q)[:, None, :]
+    kp = _pad_to(k.reshape(b * h, tk_a, d), 1, block_k)
+    vp = _pad_to(v.reshape(b * h, tk_a, d), 1, block_k)
     tq, tk = qp.shape[1], kp.shape[1]
     # lse comes back from forward already padded to the q tiling
     lsep = (lse if lse.shape[1] == tq
@@ -325,7 +339,7 @@ def _flash_backward(q, k, v, mask, o, lse, g, causal, block_q, block_k,
     k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
     row_spec = pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i))
 
-    kmp = _prep_mask(mask, block_k) if has_mask else None
+    kmp = _prep_mask(kv_mask, block_k) if has_mask else None
     operands = [qp, kp, vp, dop, lsep, deltap]
     in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
     if has_mask:
@@ -335,7 +349,7 @@ def _flash_backward(q, k, v, mask, o, lse, g, causal, block_q, block_k,
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
-                          causal=causal, scale=scale, t_actual=t,
+                          causal=causal, scale=scale, tk_actual=tk_a,
                           has_mask=has_mask),
         grid=(b * h, tq // block_q, tk // block_k),
         in_specs=in_specs,
@@ -359,7 +373,7 @@ def _flash_backward(q, k, v, mask, o, lse, g, causal, block_q, block_k,
             pl.BlockSpec((1, 1, block_k), lambda bh, j, i: (bh // h, 0, j)))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_k=block_k,
-                          causal=causal, scale=scale, t_actual=t,
+                          causal=causal, scale=scale, tk_actual=tk_a,
                           has_mask=has_mask),
         grid=(b * h, tk // block_k, tq // block_q),
         in_specs=in_specs2,
@@ -373,59 +387,88 @@ def _flash_backward(q, k, v, mask, o, lse, g, causal, block_q, block_k,
         interpret=interpret,
     )(*operands2)
 
-    dq = dq[:, :t, :].reshape(b, h, t, d)
-    dk = dk[:, :t, :].reshape(b, h, t, d)
-    dv = dv[:, :t, :].reshape(b, h, t, d)
+    dq = dq[:, :tq_a, :].reshape(b, h, tq_a, d)
+    dk = dk[:, :tk_a, :].reshape(b, h, tk_a, d)
+    dv = dv[:, :tk_a, :].reshape(b, h, tk_a, d)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_attention_vjp(q, k, v, mask, causal, block_q, block_k, interpret):
-    out, _ = _flash_forward(q, k, v, mask, causal, block_q, block_k,
-                            interpret)
+def _zero_mask_cotangent(mask):
+    if mask is None:
+        return None
+    if jnp.issubdtype(mask.dtype, jnp.inexact):
+        # float masks (e.g. 0/1 float32 from DataSet masks) need a real
+        # zero cotangent — float0 is only valid for int/bool primals
+        return jnp.zeros(mask.shape, mask.dtype)
+    import numpy as np
+    return np.zeros(mask.shape, dtype=jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_attention_vjp(q, k, v, q_mask, kv_mask, causal, block_q, block_k,
+                         interpret):
+    out, _ = _flash_forward(q, k, v, q_mask, kv_mask, causal, block_q,
+                            block_k, interpret)
     return out
 
 
-def _flash_fwd_rule(q, k, v, mask, causal, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, mask, causal, block_q, block_k,
-                              interpret)
-    return out, (q, k, v, mask, out, lse)
+def _flash_fwd_rule(q, k, v, q_mask, kv_mask, causal, block_q, block_k,
+                    interpret):
+    out, lse = _flash_forward(q, k, v, q_mask, kv_mask, causal, block_q,
+                              block_k, interpret)
+    return out, (q, k, v, q_mask, kv_mask, out, lse)
 
 
 def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
-    q, k, v, mask, o, lse = res
-    dq, dk, dv = _flash_backward(q, k, v, mask, o, lse, g, causal, block_q,
-                                 block_k, interpret)
-    if mask is None:
-        dmask = None
-    elif jnp.issubdtype(mask.dtype, jnp.inexact):
-        # float masks (e.g. 0/1 float32 from DataSet masks) need a real
-        # zero cotangent — float0 is only valid for int/bool primals
-        dmask = jnp.zeros(mask.shape, mask.dtype)
-    else:
-        import numpy as np
-        dmask = np.zeros(mask.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, dmask
+    q, k, v, q_mask, kv_mask, o, lse = res
+    dq, dk, dv = _flash_backward(q, k, v, q_mask, kv_mask, o, lse, g,
+                                 causal, block_q, block_k, interpret)
+    return (dq, dk, dv, _zero_mask_cotangent(q_mask),
+            _zero_mask_cotangent(kv_mask))
 
 
 _flash_attention_vjp.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
-                    interpret=None, mask=None):
-    """Fused attention: softmax(QKᵀ/√d)·V without materialising (T,T).
+                    interpret=None, mask=None, kv_mask=None):
+    """Fused attention: softmax(QKᵀ/√d)·V without materialising (Tq,Tk).
 
     Pallas on TPU (interpret-mode elsewhere); differentiable — backward is
     the Pallas dQ / dK-dV kernel pair (flash-attention-2 style recompute
-    from the saved logsumexp), O(T) HBM in both directions.
+    from the saved logsumexp), O(T) HBM in both directions. The q and k
+    tilings are independent, so CROSS-attention (Tq ≠ Tk) uses the same
+    kernels.
 
-    `mask`: optional (B, T) token-validity mask for padded batches
-    (self-attention semantics: a False position is invalid as both key and
-    query — its keys are excluded from every softmax and its output rows
-    come back as zeros, matching a masked dense attention whose padded
-    rows are zeroed). Gradients flow to q/k/v only at valid positions.
+    Masks for padded batches:
+    - self-attention: pass `mask` (B, T) — a False position is invalid as
+      both key and query; its keys are excluded from every softmax and its
+      output rows come back as zeros, matching a masked dense attention
+      whose padded rows are zeroed.
+    - cross-attention: pass `kv_mask` (B, Tk) for key/value padding and
+      optionally `mask` (B, Tq) for query-row padding.
+    Gradients flow to q/k/v only at valid positions.
     """
+    tq, tk = q.shape[2], k.shape[2]
+    if causal and tq != tk:
+        raise ValueError(
+            f"causal flash attention requires Tq == Tk, got {tq} != {tk}")
     if mask is not None and mask.ndim != 2:
         raise ValueError(f"mask must be (batch, seq), got {mask.shape}")
-    return _flash_attention_vjp(q, k, v, mask, causal, block_q, block_k,
-                                interpret)
+    if kv_mask is not None and kv_mask.ndim != 2:
+        raise ValueError(
+            f"kv_mask must be (batch, kv_seq), got {kv_mask.shape}")
+    if kv_mask is None:
+        if mask is not None and tq != tk:
+            raise ValueError(
+                "a single (B, T) mask implies self-attention (Tq == Tk); "
+                f"got Tq={tq}, Tk={tk} — pass kv_mask for cross-attention")
+        kv_mask = mask
+    if mask is not None and mask.shape[1] != tq:
+        raise ValueError(
+            f"query mask length {mask.shape[1]} != Tq {tq}")
+    if kv_mask is not None and kv_mask.shape[1] != tk:
+        raise ValueError(
+            f"kv_mask length {kv_mask.shape[1]} != Tk {tk}")
+    return _flash_attention_vjp(q, k, v, mask, kv_mask, causal, block_q,
+                                block_k, interpret)

@@ -4,11 +4,13 @@ RecurrentAttentionLayer and conf.graph.AttentionVertex).
 
 The reference builds these on SameDiff dot-product-attention graph ops; the
 TPU-native build routes the scaled-dot-product core through the Pallas
-flash-attention kernel on TPU (O(T) HBM traffic, online softmax in VMEM)
-and a dense XLA einsum path elsewhere / for cross-length attention. All
-four are mask-aware: a (B, T) feature mask excludes padded positions as
-both keys and queries, matching the reference's mask semantics for
-attention layers.
+flash-attention kernel on TPU (O(T) HBM traffic, online softmax in VMEM;
+the q/k tilings are independent, so CROSS-length attention — learned
+queries, AttentionVertex with separate query/key inputs — uses the same
+kernels with a separate kv-side mask) and a dense XLA einsum path
+elsewhere. All four are mask-aware: a (B, T) feature mask excludes padded
+positions as both keys and queries, matching the reference's mask
+semantics for attention layers.
 
 Layout: batch-major (B, T, F) sequences like the rest of the package;
 heads are split/merged around the kernel as (B, H, T, Dh).
@@ -40,13 +42,25 @@ def _dense_attention(q, k, v, mask=None, q_mask=None):
     return o.astype(q.dtype)
 
 
-def _attend(q, k, v, mask=None):
-    """Self-attention core: flash kernel on TPU, dense einsum elsewhere.
-    q/k/v: (B, H, T, Dh); mask: optional (B, T) token validity."""
-    if jax.default_backend() == "tpu" and q.shape == k.shape:
+def _attend(q, k, v, mask=None, kv_mask=None):
+    """Attention core: flash kernel on TPU, dense einsum elsewhere.
+    q/k/v: (B, H, Tq/Tk, Dh). Self-attention: pass `mask` (B, T) gating
+    both sides. Cross-attention (Tq != Tk or separate sequences): pass
+    `kv_mask` (B, Tk) for key validity and optionally `mask` (B, Tq) for
+    query rows."""
+    if jax.default_backend() == "tpu":
         from deeplearning4j_tpu.kernels import flash_attention
-        return flash_attention(q, k, v, mask=mask)
-    return _dense_attention(q, k, v, mask=mask, q_mask=mask)
+        return flash_attention(q, k, v, mask=mask, kv_mask=kv_mask)
+    if kv_mask is None:
+        if mask is not None and q.shape[2] != k.shape[2]:
+            # same contract as the flash path: a lone (B, T) mask is
+            # ambiguous across lengths
+            raise ValueError(
+                "a single (B, T) mask implies self-attention (Tq == Tk); "
+                f"got Tq={q.shape[2]}, Tk={k.shape[2]} — pass kv_mask for "
+                "cross-attention")
+        return _dense_attention(q, k, v, mask=mask, q_mask=mask)
+    return _dense_attention(q, k, v, mask=kv_mask, q_mask=mask)
 
 
 def _split_heads(x, n_heads):
@@ -194,10 +208,11 @@ class LearnedSelfAttentionLayer(SelfAttentionLayer):
         q = jnp.broadcast_to(params["Q"].astype(dt)[None],
                              (b,) + params["Q"].shape)
         # learned queries are always valid; mask only gates the keys —
-        # cross-length, so the dense path (Tq = nQueries != Tk in general)
-        o = _dense_attention(_split_heads(q, self.nHeads),
-                             _split_heads(k, self.nHeads),
-                             _split_heads(v, self.nHeads), mask=mask)
+        # cross-length (Tq = nQueries != Tk in general), flash-backed on
+        # TPU via the separate kv-side mask
+        o = _attend(_split_heads(q, self.nHeads),
+                    _split_heads(k, self.nHeads),
+                    _split_heads(v, self.nHeads), kv_mask=mask)
         y = _merge_heads(o)
         if self.projectInput:
             y = y @ params["Wo"].astype(dt)
@@ -400,12 +415,12 @@ class AttentionVertex(GraphVertex):
         if self_attn:
             o = _attend(qh, kh, vh, mask)
         else:
-            # cross attention: the feature mask gates the KEY sequence; the
-            # kernel's (B, T) self-mask doesn't apply across lengths
+            # cross attention: the feature mask gates the KEY sequence,
+            # passed as the kernel's separate kv-side mask
             kmask = None
             if mask is not None and mask.shape[1] == k.shape[1]:
                 kmask = mask
-            o = _dense_attention(qh, kh, vh, mask=kmask)
+            o = _attend(qh, kh, vh, kv_mask=kmask)
         y = _merge_heads(o)
         if self.projectInput:
             y = y @ params["Wo"].astype(dt)

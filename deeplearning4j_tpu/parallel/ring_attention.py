@@ -103,8 +103,8 @@ def _make_ring_flash(axis_name, block_q=128, block_k=128, interpret=None):
 
         def step(carry, _):
             o, lse, kblk, vblk = carry
-            ob, lse_b = _flash_forward(q, kblk, vblk, None, False, block_q,
-                                       block_k, interpret)
+            ob, lse_b = _flash_forward(q, kblk, vblk, None, None, False,
+                                       block_q, block_k, interpret)
             lse_b = lse_b[:, :t_local].reshape(b, h, t_local)
             m = jnp.maximum(lse, lse_b)
             w1 = jnp.exp(lse - m)
@@ -137,8 +137,8 @@ def _make_ring_flash(axis_name, block_q=128, block_k=128, interpret=None):
         def step(carry, _):
             dq, kblk, vblk, dkblk, dvblk = carry
             dq_i, dk_i, dv_i = _flash_backward(
-                q, kblk, vblk, None, o, lse2, g, False, block_q, block_k,
-                interpret)
+                q, kblk, vblk, None, None, o, lse2, g, False, block_q,
+                block_k, interpret)
             dq = dq + dq_i.astype(jnp.float32)
             dkblk = dkblk + dk_i.astype(jnp.float32)
             dvblk = dvblk + dv_i.astype(jnp.float32)
