@@ -626,22 +626,38 @@ class SameDiff:
     def outputSingle(self, placeholders, output):
         return self.output(placeholders, [output])[output]
 
-    def evaluate(self, iterator, outputVariable, evaluation=None):
+    def evaluate(self, iterator, outputVariable, evaluation=None,
+                 labelIndex=None):
         """≡ SameDiff.evaluate(DataSetIterator, outputVariable,
         Evaluation): feed each DataSet through the TrainingConfig's
-        dataSetFeatureMapping and accumulate predictions vs labels."""
+        dataSetFeatureMapping and accumulate predictions vs labels.
+
+        Multi-output graphs (≡ SameDiff.evaluate(iterator,
+        variableEvals, predictionLabelMapping)): pass a DICT
+        {outputVariable: IEvaluation} — each variable scores against the
+        label array at `labelIndex[var]` (defaults to the variable's
+        position in the dict). All outputs come from ONE forward per
+        batch. Returns the dict."""
         tc = self._training_config
         if tc is None or not getattr(tc, "dataSetFeatureMapping", None):
             raise ValueError(
                 "evaluate() needs a TrainingConfig with "
                 "dataSetFeatureMapping/dataSetLabelMapping (call "
                 "setTrainingConfig first)")
-        if evaluation is None:
-            from deeplearning4j_tpu.eval.evaluation import Evaluation
-            evaluation = Evaluation()
+        if isinstance(outputVariable, dict):
+            var_evals = dict(outputVariable)
+            label_idx = {v: (labelIndex or {}).get(v, i)
+                         for i, v in enumerate(var_evals)}
+        else:
+            if evaluation is None:
+                from deeplearning4j_tpu.eval.evaluation import Evaluation
+                evaluation = Evaluation()
+            var_evals = {outputVariable: evaluation}
+            label_idx = {outputVariable: 0}
         if hasattr(iterator, "reset"):
             iterator.reset()
         from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        out_names = list(var_evals)
         for ds in iterator:
             feats = ds.features if isinstance(ds, MultiDataSet) \
                 else [ds.features]
@@ -652,13 +668,22 @@ class SameDiff:
                     f"evaluate(): {len(feats)} feature arrays vs "
                     f"{len(tc.dataSetFeatureMapping)} mapped placeholders")
             phs = dict(zip(tc.dataSetFeatureMapping, feats))
-            preds = self.output(phs, [outputVariable])[outputVariable]
-            mask = getattr(ds, "labelsMask",
-                           getattr(ds, "labelsMasks", None))
-            if isinstance(mask, (list, tuple)):
-                mask = mask[0] if mask else None
-            evaluation.eval(labs[0], preds, mask)
-        return evaluation
+            preds = self.output(phs, out_names)
+            masks = getattr(ds, "labelsMask",
+                            getattr(ds, "labelsMasks", None))
+            if not isinstance(masks, (list, tuple)):
+                masks = [masks] * len(labs)
+            for var, ev in var_evals.items():
+                li = label_idx[var]
+                if li >= len(labs):
+                    raise ValueError(
+                        f"evaluate(): output '{var}' maps to label index "
+                        f"{li} but the DataSet has {len(labs)} label "
+                        "arrays")
+                ev.eval(labs[li], preds[var],
+                        masks[li] if li < len(masks) else None)
+        return (var_evals if isinstance(outputVariable, dict)
+                else var_evals[outputVariable])
 
     def batchOutput(self):
         sd = self

@@ -11,8 +11,14 @@ Field numbers follow tensorflow/tsl/profiler/protobuf/xplane.proto:
   XSpace.planes = 1
   XPlane: id=1, name=2, lines=3, event_metadata=4 (map), stat_metadata=5
   XLine:  id=1, name=2, timestamp_ns=3, events=4
-  XEvent: metadata_id=1, offset_ps=2, duration_ps=3
-  XEventMetadata: id=1, name=2, display_name=3
+  XEvent: metadata_id=1, offset_ps=2, duration_ps=3, stats=4
+  XEventMetadata: id=1, name=2, display_name=3, stats=5
+  XStat:  metadata_id=1, double=2, uint64=3, int64=4, str=5, bytes=6, ref=7
+  XStatMetadata: id=1, name=2
+
+XStats carry XLA's per-op cost-analysis metrics ("bytes accessed",
+"flops", "memory_bandwidth", occupancy...) — memory_breakdown() turns
+them into the per-op bytes table the round-3 HBM-bound analysis needed.
 
 Usage:
   rows = op_breakdown("/tmp/trace")        # aggregated per-op-name
@@ -22,8 +28,29 @@ from __future__ import annotations
 
 import glob
 import os
+import struct
 
-from deeplearning4j_tpu.autodiff.tfproto import parse_fields
+from deeplearning4j_tpu.autodiff.tfproto import _signed, parse_fields
+
+
+def _decode_stat(raw, stat_metas):
+    """XStat bytes -> (name, value). The oneof: double(2)/uint64(3)/
+    int64(4)/str(5)/bytes(6)/ref(7 — index into stat_metadata)."""
+    f = parse_fields(raw)
+    name = stat_metas.get(f.get(1, [0])[0], str(f.get(1, [0])[0]))
+    if 2 in f:
+        return name, struct.unpack("<d", f[2][0])[0]
+    if 3 in f:
+        return name, f[3][0]
+    if 4 in f:
+        return name, _signed(f[4][0])
+    if 5 in f:
+        return name, f[5][0].decode("utf-8", "replace")
+    if 6 in f:
+        return name, f[6][0]
+    if 7 in f:
+        return name, stat_metas.get(f[7][0], str(f[7][0]))
+    return name, None
 
 
 def _decode_map_entry(buf):
@@ -40,17 +67,33 @@ def find_xplane_files(trace_dir):
         trace_dir, "plugins", "profile", "*", "*.xplane.pb")))
 
 
-def parse_xspace(path):
+def parse_xspace(path, with_stats=False, plane_substr=None):
     """xplane.pb -> list of planes:
     {"name": str, "lines": [{"name": str, "timestamp_ns": int,
-    "events": [(meta_name, duration_ps, offset_ps)]}]}."""
+    "events": [(meta_name, duration_ps, offset_ps)]}]}.
+
+    with_stats=True appends a 4th element to each event tuple — a
+    {stat_name: value} dict decoded from the event's XStats merged with
+    its event-METADATA stats (XLA puts static cost-analysis numbers like
+    "bytes accessed"/"flops" on the metadata, per-occurrence values on
+    the event). plane_substr skips non-matching planes BEFORE any event
+    decoding (host/thread planes dwarf the device plane in real traces)."""
     with open(path, "rb") as f:
         space = parse_fields(f.read())
     planes = []
     for praw in space.get(1, []):
         pf = parse_fields(praw)
         name = pf.get(2, [b""])[0].decode("utf-8", "replace")
+        if plane_substr is not None and \
+                plane_substr.lower() not in name.lower():
+            continue
+        stat_metas = {}
+        for mraw in pf.get(5, []):
+            k, v = _decode_map_entry(mraw)
+            mf = parse_fields(v)
+            stat_metas[k] = mf.get(2, [b""])[0].decode("utf-8", "replace")
         metas = {}
+        meta_stats = {}
         for mraw in pf.get(4, []):
             k, v = _decode_map_entry(mraw)
             mf = parse_fields(v)
@@ -59,6 +102,9 @@ def parse_xspace(path):
             disp = mf.get(3, [b""])[0]
             if disp:
                 metas[k] = disp.decode("utf-8", "replace")
+            if with_stats and 5 in mf:
+                meta_stats[k] = dict(
+                    _decode_stat(s, stat_metas) for s in mf[5])
         lines = []
         for lraw in pf.get(3, []):
             lf = parse_fields(lraw)
@@ -70,40 +116,78 @@ def parse_xspace(path):
                 mid = ef.get(1, [0])[0]
                 off = ef.get(2, [0])[0]
                 dur = ef.get(3, [0])[0]
-                events.append((metas.get(mid, str(mid)), dur, off))
+                if with_stats:
+                    stats = dict(meta_stats.get(mid, {}))
+                    for sraw in ef.get(4, []):
+                        sk, sv = _decode_stat(sraw, stat_metas)
+                        stats[sk] = sv
+                    events.append((metas.get(mid, str(mid)), dur, off,
+                                   stats))
+                else:
+                    events.append((metas.get(mid, str(mid)), dur, off))
             lines.append({"name": lname, "timestamp_ns": ts_ns,
                           "events": events})
         planes.append({"name": name, "lines": lines})
     return planes
 
 
-def op_breakdown(trace_dir, device_substr="TPU", line_substr=None):
-    """Aggregate device-plane op durations across a trace directory.
+def memory_breakdown(trace_dir, device_substr="TPU", line_substr=None):
+    """Per-op bytes-accessed table from the XStat cost-analysis metrics:
+    [(op_name, total_ms, bytes_accessed, GB_per_s)] sorted by bytes
+    descending. Rides the same plane/line selection as op_breakdown; ops
+    with no bytes stat report 0 (fusion roots carry the stat on TPU)."""
+    totals, nbytes = {}, {}
+    for line in _selected_lines(trace_dir, device_substr, line_substr,
+                                with_stats=True):
+        for ev in line["events"]:
+            name, dur, stats = ev[0], ev[1], ev[3]
+            b = 0
+            for k, v in stats.items():
+                if "bytes" in k.lower() and isinstance(v, int):
+                    b = max(b, v)
+            totals[name] = totals.get(name, 0) + dur
+            nbytes[name] = nbytes.get(name, 0) + b
+    rows = []
+    for n, b in nbytes.items():
+        ms = totals[n] / 1e9
+        gbps = (b / 1e9) / (ms / 1e3) if ms > 0 else 0.0
+        rows.append((n, ms, b, gbps))
+    rows.sort(key=lambda r: -r[2])
+    return rows
 
-    Returns [(op_name, total_ms, count)] sorted by total time descending.
+
+def _selected_lines(trace_dir, device_substr, line_substr, with_stats):
+    """Shared plane/line selection for the breakdown tables.
+
     `device_substr` picks the device planes ("TPU", "GPU", or "" for
     CPU-only traces where XLA ops land on host-thread planes).
     `line_substr` picks activity lines within a plane; the default (None)
     uses the serialized "XLA Ops" line when the plane has one — summing
     every line would double-count, since "Steps" / "XLA Modules" /
     "Async XLA Ops" events span the same wall time — and otherwise
-    falls back to all lines (CPU traces have per-thread lines instead).
-    """
-    totals, counts = {}, {}
+    falls back to all lines (CPU traces have per-thread lines instead)."""
     for path in find_xplane_files(trace_dir):
-        for plane in parse_xspace(path):
-            pname = plane["name"]
-            if device_substr.lower() not in pname.lower():
-                continue
+        for plane in parse_xspace(path, with_stats=with_stats,
+                                  plane_substr=device_substr or None):
             lines = plane["lines"]
             if line_substr is not None:
                 lines = [l for l in lines if line_substr in l["name"]]
             elif any(l["name"] == "XLA Ops" for l in lines):
                 lines = [l for l in lines if l["name"] == "XLA Ops"]
-            for line in lines:
-                for name, dur, _off in line["events"]:
-                    totals[name] = totals.get(name, 0) + dur
-                    counts[name] = counts.get(name, 0) + 1
+            yield from lines
+
+
+def op_breakdown(trace_dir, device_substr="TPU", line_substr=None):
+    """Aggregate device-plane op durations across a trace directory.
+
+    Returns [(op_name, total_ms, count)] sorted by total time descending;
+    see _selected_lines for the plane/line selection rules."""
+    totals, counts = {}, {}
+    for line in _selected_lines(trace_dir, device_substr, line_substr,
+                                with_stats=False):
+        for name, dur, _off in line["events"]:
+            totals[name] = totals.get(name, 0) + dur
+            counts[name] = counts.get(name, 0) + 1
     rows = [(n, t / 1e9, counts[n]) for n, t in totals.items()]
     rows.sort(key=lambda r: -r[1])
     return rows

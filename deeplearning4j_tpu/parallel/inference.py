@@ -96,16 +96,30 @@ class ParallelInference:
     # -- client side -----------------------------------------------------
     def output(self, x):
         """Thread-safe inference. x: one example (features without batch
-        dim) or a batch; returns the model output with matching leading
-        dims."""
-        x = np.asarray(x, np.float32)
-        single = self._needs_batch(x)
+        dim) or a batch; for multi-input ComputationGraphs a LIST/TUPLE
+        with one array per model input (coalesced per-input). Returns the
+        model output with matching leading dims."""
+        n_inputs = len(self._input_ranks())
+        if isinstance(x, (list, tuple)) and n_inputs > 1:
+            if len(x) != n_inputs:
+                raise ValueError(
+                    f"model has {n_inputs} inputs but output() got "
+                    f"{len(x)} arrays")
+            multi = True
+            xs = tuple(np.asarray(a, np.float32) for a in x)
+        else:
+            # single-input model: a list of rows is just a batch
+            multi = False
+            xs = (np.asarray(x, np.float32),)
+        single = self._needs_batch(xs)
+        if single:
+            xs = tuple(a[None] for a in xs)
         if self.mode == InferenceMode.SEQUENTIAL or self._shutdown:
             self.model_calls += 1
-            out = self.model.output(x[None] if single else x)
+            out = self.model.output(list(xs) if multi else xs[0])
             out = (out[0] if isinstance(out, list) else out).numpy()
             return out[0] if single else out
-        req = _Request(x[None] if single else x)
+        req = _Request(xs)
         self._queue.put(req)
         # wait with a shutdown escape: a request enqueued as the collector
         # exits would otherwise block forever — claim it and serve direct
@@ -135,32 +149,38 @@ class ParallelInference:
             raise req.error
         return req.result[0] if single else req.result
 
-    def _needs_batch(self, x):
-        """True when x is ONE example (no batch dim): its rank equals the
-        model's expected feature rank."""
-        want = getattr(self.model, "_input_rank", None)
+    def _input_ranks(self):
+        want = getattr(self.model, "_input_ranks", None)
         if want is None:
-            want = self._infer_input_rank()
-            self.model._input_rank = want
-        return x.ndim == want
+            want = self._infer_input_ranks()
+            self.model._input_ranks = want
+        return want
 
-    def _infer_input_rank(self):
+    def _needs_batch(self, xs):
+        """True when xs holds ONE example (no batch dim): the FIRST
+        input's rank equals the model's expected feature rank."""
+        return xs[0].ndim == self._input_ranks()[0]
+
+    def _infer_input_ranks(self):
+        """Expected FEATURE rank (no batch dim) per model input."""
+        from deeplearning4j_tpu.nn.conf.inputs import (ConvolutionalType,
+                                                       RecurrentType)
+
+        def rank(it):
+            if isinstance(it, ConvolutionalType):
+                return 3
+            if isinstance(it, RecurrentType):
+                return 2
+            return 1
+
         conf = getattr(self.model, "conf", None)
-        it = None
         if conf is not None:
             node_types = getattr(conf, "node_output_types", None)
             input_names = getattr(conf, "input_names", None)
             if node_types and input_names:
-                it = node_types.get(input_names[0])
-            else:
-                it = getattr(conf, "input_type", None)
-        from deeplearning4j_tpu.nn.conf.inputs import (ConvolutionalType,
-                                                       RecurrentType)
-        if isinstance(it, ConvolutionalType):
-            return 3
-        if isinstance(it, RecurrentType):
-            return 2
-        return 1
+                return [rank(node_types.get(n)) for n in input_names]
+            return [rank(getattr(conf, "input_type", None))]
+        return [1]
 
     # -- collector thread ------------------------------------------------
     def _collector(self):
@@ -173,7 +193,7 @@ class ParallelInference:
                 break
             batch = [first]
             strays = []    # incompatible shapes: run AFTER the main batch
-            total = first.x.shape[0]
+            total = first.x[0].shape[0]
             # coalesce until batchLimit or a brief quiet period
             while total < self.batch_limit:
                 try:
@@ -183,11 +203,13 @@ class ParallelInference:
                 if nxt is None:
                     self._shutdown = True
                     break
-                if nxt.x.shape[1:] != first.x.shape[1:]:
+                if (len(nxt.x) != len(first.x)
+                        or any(a.shape[1:] != b.shape[1:]
+                               for a, b in zip(nxt.x, first.x))):
                     strays.append(nxt)
                     continue
                 batch.append(nxt)
-                total += nxt.x.shape[0]
+                total += nxt.x[0].shape[0]
             self._dispatch(batch)
             for s in strays:
                 self._dispatch([s])
@@ -206,20 +228,25 @@ class ParallelInference:
 
     def _run(self, batch):
         try:
-            xs = np.concatenate([r.x for r in batch], axis=0)
-            n = xs.shape[0]
+            n_inputs = len(batch[0].x)
+            cols = []
+            for j in range(n_inputs):
+                xj = np.concatenate([r.x[j] for r in batch], axis=0)
+                cols.append(xj)
+            n = cols[0].shape[0]
             nb = _bucket(n)
             if nb != n:
                 # pad with copies of the last row: static bucket shapes
                 # keep XLA from compiling one executable per request count
-                xs = np.concatenate(
-                    [xs, np.repeat(xs[-1:], nb - n, axis=0)], axis=0)
+                cols = [np.concatenate(
+                    [xj, np.repeat(xj[-1:], nb - n, axis=0)], axis=0)
+                    for xj in cols]
             self.model_calls += 1
-            out = self.model.output(xs)
+            out = self.model.output(cols if n_inputs > 1 else cols[0])
             out = (out[0] if isinstance(out, list) else out).numpy()[:n]
             i = 0
             for r in batch:
-                k = r.x.shape[0]
+                k = r.x[0].shape[0]
                 r.result = out[i:i + k]
                 i += k
                 r.event.set()

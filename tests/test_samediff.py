@@ -262,3 +262,64 @@ def test_evaluate_over_iterator():
     # all 64 rows were accumulated across the 4 batches
     assert sum(ev.truePositives(c) + ev.falseNegatives(c)
                for c in range(2)) == 64
+
+
+def test_evaluate_multi_output_graph():
+    """Dict form: sd.evaluate(iter, {var: Evaluation}) scores EACH output
+    variable against its mapped label array in one forward per batch
+    (≡ SameDiff.evaluate(iterator, variableEvals, labelMapping))."""
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    from deeplearning4j_tpu.nn import Adam
+
+    rng = np.random.default_rng(3)
+    xs = rng.standard_normal((64, 4)).astype(np.float32)
+    y1 = np.eye(2, dtype=np.float32)[(xs[:, 0] > 0).astype(int)]
+    y2 = np.eye(3, dtype=np.float32)[(xs[:, 1] > 0).astype(int) * 2]
+
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", (None, 4))
+    l1 = sd.placeHolder("l1", (None, 2))
+    l2 = sd.placeHolder("l2", (None, 3))
+    w1 = sd.var("w1", 0.01 * rng.standard_normal((4, 2)).astype(np.float32))
+    w2 = sd.var("w2", 0.01 * rng.standard_normal((4, 3)).astype(np.float32))
+    p1 = sd.nn.softmax(x.mmul(w1))
+    p1.rename("p1")
+    p2 = sd.nn.softmax(x.mmul(w2))
+    p2.rename("p2")
+    sd.loss.softmaxCrossEntropy("loss1", l1, x.mmul(w1))
+    sd.loss.softmaxCrossEntropy("loss2", l2, x.mmul(w2))
+    sd.setLossVariables("loss1", "loss2")
+    sd.setTrainingConfig(TrainingConfig.Builder()
+                         .updater(Adam(0.1))
+                         .dataSetFeatureMapping("x")
+                         .dataSetLabelMapping("l1", "l2")
+                         .build())
+
+    class _It:
+        def reset(self):
+            self._i = 0
+
+        def __iter__(self):
+            for s in range(0, 64, 16):
+                yield MultiDataSet([xs[s:s + 16]],
+                                   [y1[s:s + 16], y2[s:s + 16]])
+
+    it = _It()
+    for _ in range(40):
+        for ds in it:
+            sd.fit(ds)
+    evals = sd.evaluate(it, {"p1": Evaluation(), "p2": Evaluation()})
+    assert set(evals) == {"p1", "p2"}
+    assert evals["p1"].accuracy() > 0.9
+    assert evals["p2"].accuracy() > 0.9
+    # every row accumulated for both heads
+    for ev, ncls in ((evals["p1"], 2), (evals["p2"], 3)):
+        assert sum(ev.truePositives(c) + ev.falseNegatives(c)
+                   for c in range(ncls)) == 64
+    # explicit labelIndex override: score p1 against the WRONG head's
+    # labels -> shape mismatch is the caller's problem, but a too-large
+    # index raises an actionable error
+    import pytest
+    with pytest.raises(ValueError, match="label index"):
+        sd.evaluate(it, {"p1": Evaluation()}, labelIndex={"p1": 5})
