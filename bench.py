@@ -142,8 +142,10 @@ def _bench_char_lstm(batch=128, seq=128, hidden=512, steps=10, warmup=2):
 
     vocab = 80
     unroll = int(os.environ.get("BENCH_LSTM_UNROLL", "1"))
+    dtype = os.environ.get("BENCH_LSTM_DTYPE", "float32")
     conf = (NeuralNetConfiguration.Builder()
             .seed(0).updater(RmsProp(1e-3)).weightInit("xavier")
+            .dataType(dtype)
             .list()
             .layer(LSTM(nOut=hidden, activation="tanh", scanUnroll=unroll))
             .layer(LSTM(nOut=hidden, activation="tanh", scanUnroll=unroll))

@@ -1,0 +1,54 @@
+"""Object detection end to end (≡ dl4j-examples :: TinyYoloHouseNumber
+style): train a YOLOv2 head on a synthetic scene, then extract final
+detections with confidence threshold + per-class NMS
+(YoloUtils.getPredictedObjects)."""
+import numpy as np
+
+from deeplearning4j_tpu.nn import (Adam, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer
+from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
+
+GRID, N_CLS = 8, 3
+ANCHORS = [[1.0, 1.0], [3.0, 3.0]]
+
+
+def scene():
+    """One image: a bright square; gt box centered on it, class 1."""
+    x = np.zeros((1, GRID, GRID, 3), np.float32)
+    x[0, 2:5, 3:6, :] = 1.0
+    lab = np.zeros((1, GRID, GRID, 4 + N_CLS), np.float32)
+    lab[0, 3, 4, :4] = [4.5, 3.5, 2.0, 2.0]    # (x, y, w, h) grid units
+    lab[0, 3, 4, 4 + 1] = 1.0
+    return x, lab
+
+
+def main():
+    net = MultiLayerNetwork(
+        NeuralNetConfiguration.Builder().seed(0).updater(Adam(5e-3))
+        .weightInit("relu").list()
+        .layer(ConvolutionLayer(kernelSize=(3, 3), nOut=32,
+                                convolutionMode="same", activation="relu"))
+        .layer(ConvolutionLayer(kernelSize=(1, 1),
+                                nOut=len(ANCHORS) * (5 + N_CLS),
+                                convolutionMode="same",
+                                activation="identity"))
+        .layer(Yolo2OutputLayer(boundingBoxes=ANCHORS))
+        .setInputType(InputType.convolutional(GRID, GRID, 3))
+        .build()).init()
+    x, lab = scene()
+    for i in range(150):
+        net.fit(x, lab)
+        if i % 50 == 49:
+            print(f"iter {i + 1}: loss {float(net.score()):.4f}")
+    dets = net.getPredictedObjects(x, confThreshold=0.3, nmsThreshold=0.4)
+    print(f"{len(dets[0])} detection(s):")
+    for d in dets[0]:
+        tl, br = d.getTopLeftXY(), d.getBottomRightXY()
+        print(f"  class={d.getPredictedClass()} conf={d.confidence:.2f} "
+              f"box=({tl[0]:.1f},{tl[1]:.1f})-({br[0]:.1f},{br[1]:.1f})")
+    assert dets[0] and dets[0][0].getPredictedClass() == 1
+
+
+if __name__ == "__main__":
+    main()

@@ -115,26 +115,41 @@ def mode_lstm():
     from bench import _bench_char_lstm
 
     results = []
-    for batch in (64, 128, 256):
-        for unroll in (1, 4, 8, 16):
-            os.environ["BENCH_LSTM_UNROLL"] = str(unroll)
-            try:
-                t0 = time.perf_counter()
-                chars_s, dt, compile_s = _bench_char_lstm(
-                    batch=batch, steps=6, warmup=2)
-                row = {"batch": batch, "unroll": unroll,
-                       "chars_s": round(chars_s, 0),
-                       "step_ms": round(dt * 1000, 1),
-                       "compile_s": round(compile_s, 1),
-                       "wall_s": round(time.perf_counter() - t0, 1)}
-            except Exception as e:  # noqa: BLE001
-                row = {"batch": batch, "unroll": unroll,
-                       "error": str(e)[:160]}
-            results.append(row)
-            _emit(row)
+    combos = [(b, u, dt) for b in (64, 128, 256)
+              for u in (1, 8, 16)
+              for dt in ("float32", "bfloat16")]
+    for batch, unroll, dtype in combos:
+        os.environ["BENCH_LSTM_UNROLL"] = str(unroll)
+        os.environ["BENCH_LSTM_DTYPE"] = dtype
+        try:
+            t0 = time.perf_counter()
+            chars_s, dt_s, compile_s = _bench_char_lstm(
+                batch=batch, steps=6, warmup=2)
+            row = {"batch": batch, "unroll": unroll, "dtype": dtype,
+                   "chars_s": round(chars_s, 0),
+                   "step_ms": round(dt_s * 1000, 1),
+                   "compile_s": round(compile_s, 1),
+                   "wall_s": round(time.perf_counter() - t0, 1)}
+        except Exception as e:  # noqa: BLE001
+            row = {"batch": batch, "unroll": unroll, "dtype": dtype,
+                   "error": str(e)[:160]}
+        results.append(row)
+        _emit(row)
     best = max((r for r in results if "chars_s" in r),
                key=lambda r: r["chars_s"], default=None)
     _emit({"best": best})
+    if os.environ.get("EXP_TRACE") and best:
+        # trace ONE step of the best config for the per-op table
+        import jax
+
+        os.environ["BENCH_LSTM_UNROLL"] = str(best["unroll"])
+        os.environ["BENCH_LSTM_DTYPE"] = best["dtype"]
+        trace_dir = os.environ.get("EXP_TRACE_DIR", "/tmp/r4_lstm_trace")
+        with jax.profiler.trace(trace_dir):
+            _bench_char_lstm(batch=best["batch"], steps=2, warmup=1)
+        from deeplearning4j_tpu.optimize.xplane import op_breakdown
+        for name, ms, n in op_breakdown(trace_dir)[:15]:
+            _emit({"op": name[:70], "ms": round(ms, 3), "n": n})
 
 
 def mode_resnet():
