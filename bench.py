@@ -39,9 +39,12 @@ def _bench_zoo_model(model_cls, batch, steps, warmup, input_hw=224,
 
     from deeplearning4j_tpu.nn.updaters import Nesterovs
 
+    # BENCH_MOMENTUM_DTYPE=bfloat16 halves optimizer-state HBM traffic
+    # (fp32 masters kept; loss parity tested in test_multilayer)
+    mdt = os.environ.get("BENCH_MOMENTUM_DTYPE") or None
     model = model_cls(numClasses=classes, dataType="bfloat16",
                       inputShape=(input_hw, input_hw, 3),
-                      updater=Nesterovs(lr, 0.9))
+                      updater=Nesterovs(lr, 0.9, momentumDtype=mdt))
     net = model.init()
     key = jax.random.PRNGKey(0)
     kx, ky = jax.random.split(key)

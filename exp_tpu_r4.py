@@ -23,16 +23,30 @@ import sys
 import threading
 import time
 
-DEADLINE_S = float(os.environ.get("EXP_DEADLINE", "360"))
+#: per-mode defaults — lstm is a 24-fresh-compile sweep (+1 trace pass)
+_DEFAULT_DEADLINES = {"smoke": 360, "lstm": 1800, "resnet": 600}
 
 
-def _arm_deadline():
+def _arm_deadline(mode):
+    deadline = float(os.environ.get(
+        "EXP_DEADLINE", _DEFAULT_DEADLINES.get(mode, 360)))
+
     def bail():
-        time.sleep(DEADLINE_S)
+        time.sleep(deadline)
         print(f"## {json.dumps({'error': 'internal deadline'})}", flush=True)
         os._exit(3)
 
     threading.Thread(target=bail, daemon=True).start()
+
+
+def _fresh_dir(path):
+    """Trace dirs must start empty: find_xplane_files globs EVERY
+    timestamped subdir, so a reused dir would sum stale runs into the
+    per-op tables."""
+    import shutil
+
+    shutil.rmtree(path, ignore_errors=True)
+    return path
 
 
 def _emit(obj):
@@ -116,7 +130,7 @@ def mode_lstm():
 
     results = []
     combos = [(b, u, dt) for b in (64, 128, 256)
-              for u in (1, 8, 16)
+              for u in (1, 4, 8, 16)       # 4 is the round-4-plan ask
               for dt in ("float32", "bfloat16")]
     for batch, unroll, dtype in combos:
         os.environ["BENCH_LSTM_UNROLL"] = str(unroll)
@@ -144,7 +158,8 @@ def mode_lstm():
 
         os.environ["BENCH_LSTM_UNROLL"] = str(best["unroll"])
         os.environ["BENCH_LSTM_DTYPE"] = best["dtype"]
-        trace_dir = os.environ.get("EXP_TRACE_DIR", "/tmp/r4_lstm_trace")
+        trace_dir = _fresh_dir(
+            os.environ.get("EXP_TRACE_DIR", "/tmp/r4_lstm_trace"))
         with jax.profiler.trace(trace_dir):
             _bench_char_lstm(batch=best["batch"], steps=2, warmup=1)
         from deeplearning4j_tpu.optimize.xplane import op_breakdown
@@ -160,8 +175,10 @@ def mode_resnet():
     from deeplearning4j_tpu.nn.updaters import Nesterovs
 
     batch = int(os.environ.get("EXP_BATCH", "256"))
+    mdt = os.environ.get("EXP_MOMENTUM_DTYPE") or None
     model = ResNet50(numClasses=1000, dataType="bfloat16",
-                     inputShape=(224, 224, 3), updater=Nesterovs(0.1, 0.9))
+                     inputShape=(224, 224, 3),
+                     updater=Nesterovs(0.1, 0.9, momentumDtype=mdt))
     net = model.init()
     kx, ky = jax.random.split(jax.random.PRNGKey(0))
     x = jax.random.uniform(kx, (batch, 224, 224, 3), jnp.float32)
@@ -193,7 +210,8 @@ def mode_resnet():
            "step_ms": round(dt * 1000, 1),
            "compile_s": round(compile_s, 1)})
     if os.environ.get("EXP_TRACE"):
-        trace_dir = os.environ.get("EXP_TRACE_DIR", "/tmp/r4_trace")
+        trace_dir = _fresh_dir(
+            os.environ.get("EXP_TRACE_DIR", "/tmp/r4_trace"))
         with jax.profiler.trace(trace_dir):
             for i in range(3):
                 params, opt, state, loss = step(
@@ -210,8 +228,8 @@ def mode_resnet():
 
 
 def main():
-    _arm_deadline()
     mode = sys.argv[1] if len(sys.argv) > 1 else "smoke"
+    _arm_deadline(mode)
     t0 = time.perf_counter()
     try:
         {"smoke": mode_smoke, "lstm": mode_lstm,

@@ -255,3 +255,38 @@ def test_predict_and_f1score():
     f1 = net.f1Score(ds)
     assert 0.9 < f1 <= 1.0
     assert abs(net.f1Score(x, y) - f1) < 1e-9
+
+
+def test_bf16_momentum_tracks_fp32_momentum():
+    """Nesterovs(momentumDtype='bfloat16') halves optimizer-state HBM
+    traffic; training must stay loss-parity-close to the fp32 buffer."""
+    import numpy as np
+
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, Nesterovs,
+                                       OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    def train(updater):
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(5).updater(updater)
+            .weightInit("xavier").list()
+            .layer(DenseLayer(nOut=32, activation="relu"))
+            .layer(OutputLayer(nOut=4, activation="softmax",
+                               lossFunction="mcxent"))
+            .setInputType(InputType.feedForward(8)).build()).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(4, size=64)]
+        losses = []
+        for _ in range(25):
+            net.fit(x, y)
+            losses.append(float(net.score()))
+        return losses
+
+    l32 = train(Nesterovs(0.05, 0.9))
+    l16 = train(Nesterovs(0.05, 0.9, momentumDtype="bfloat16"))
+    # same trajectory within bf16 rounding: final losses close, both
+    # decreasing
+    assert l16[-1] < l16[0] and l32[-1] < l32[0]
+    assert abs(l16[-1] - l32[-1]) < 0.05 * max(abs(l32[-1]), 0.1)
