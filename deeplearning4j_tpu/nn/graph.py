@@ -381,6 +381,17 @@ class ComputationGraph:
         from deeplearning4j_tpu.nn.constraints import apply_layer_constraints
         return apply_layer_constraints(pairs, params)
 
+    def _pack_single(self, x, y, fmask=None, lmask=None):
+        """THE single-input/single-output packing convention — the one
+        place that maps flat (x, y, masks) onto this graph's kwargs
+        (also used by ParallelWrapper's dp step)."""
+        ins = {self.conf.input_names[0]: x}
+        labels = [y]
+        fmasks = None if fmask is None \
+            else {self.conf.input_names[0]: fmask}
+        lmasks = None if lmask is None else [lmask]
+        return ins, labels, fmasks, lmasks
+
     def _unpack(self, ds):
         if isinstance(ds, MultiDataSet):
             ins = {n: jnp.asarray(f) for n, f in
@@ -396,12 +407,12 @@ class ComputationGraph:
                           for m in ds.labelsMasks]
             return ins, labels, fmasks, lmasks
         if isinstance(ds, DataSet):
-            ins = {self.conf.input_names[0]: jnp.asarray(ds.features)}
-            labels = [jnp.asarray(ds.labels)]
-            fmasks = None if ds.featuresMask is None else \
-                {self.conf.input_names[0]: jnp.asarray(ds.featuresMask)}
-            lmasks = None if ds.labelsMask is None else [jnp.asarray(ds.labelsMask)]
-            return ins, labels, fmasks, lmasks
+            return self._pack_single(
+                jnp.asarray(ds.features), jnp.asarray(ds.labels),
+                None if ds.featuresMask is None
+                else jnp.asarray(ds.featuresMask),
+                None if ds.labelsMask is None
+                else jnp.asarray(ds.labelsMask))
         raise TypeError(f"Cannot fit on {type(ds)}")
 
     def _fit_batch(self, ds):

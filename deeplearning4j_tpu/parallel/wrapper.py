@@ -91,15 +91,51 @@ class ParallelWrapper:
         and value ranges valid, e.g. int label ids)."""
         return np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)])
 
+    def _graph_model(self):
+        """Resolved ONCE per wrapper: is the wrapped model a (validated)
+        single-input/single-output ComputationGraph?"""
+        cached = getattr(self, "_is_graph", None)
+        if cached is not None:
+            return cached
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        self._is_graph = isinstance(self.model, ComputationGraph)
+        if self._is_graph and (len(self.model.conf.input_names) != 1
+                               or len(self.model.conf.output_names) != 1):
+            raise ValueError(
+                "ParallelWrapper needs a single-input/single-output "
+                "ComputationGraph (got "
+                f"{len(self.model.conf.input_names)} inputs, "
+                f"{len(self.model.conf.output_names)} outputs); use "
+                "ShardedTrainer for general graphs")
+        return self._is_graph
+
     def _fit_dataset(self, ds):
         """One dp-sharded train step on a DataSet (the shared inner loop —
         also driven by EarlyStoppingParallelTrainer)."""
-        feats = np.asarray(ds.features)
-        labs = np.asarray(ds.labels)
-        lm = None if ds.labelsMask is None \
-            else np.asarray(ds.labelsMask)
-        fm = None if ds.featuresMask is None \
-            else np.asarray(ds.featuresMask)
+        is_graph = self._graph_model()
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        if isinstance(ds, MultiDataSet):
+            # single-array MultiDataSet (the usual graph pairing) maps
+            # onto the same flat path; genuinely-multi needs ShardedTrainer
+            if len(ds.features) != 1 or len(ds.labels) != 1:
+                raise ValueError(
+                    "ParallelWrapper.fit got a MultiDataSet with "
+                    f"{len(ds.features)} feature / {len(ds.labels)} label "
+                    "arrays; only single-input/single-output data is "
+                    "supported — use ShardedTrainer for general graphs")
+            fms = ds.featuresMasks
+            lms = ds.labelsMasks
+            feats = np.asarray(ds.features[0])
+            labs = np.asarray(ds.labels[0])
+            fm = None if not fms or fms[0] is None else np.asarray(fms[0])
+            lm = None if not lms or lms[0] is None else np.asarray(lms[0])
+        else:
+            feats = np.asarray(ds.features)
+            labs = np.asarray(ds.labels)
+            lm = None if ds.labelsMask is None \
+                else np.asarray(ds.labelsMask)
+            fm = None if ds.featuresMask is None \
+                else np.asarray(ds.featuresMask)
         pad = (-feats.shape[0]) % self.mesh.size
         if pad:
             # Ragged final batch: pad rows to a multiple of the dp
@@ -128,8 +164,17 @@ class ParallelWrapper:
             else jax.device_put(fm, self.mesh.sharding("dp"))
         m = self.model
         m._rng_key, sub = jax.random.split(m._rng_key)
-        m._params, m._opt_state, m._state, loss = m._train_step(
-            m._params, m._opt_state, m._state, x, y, fmask, lmask, sub)
+        if is_graph:
+            # the reference's ParallelWrapper wraps ComputationGraph too;
+            # packing convention lives in ComputationGraph._pack_single
+            ins, labels, fmasks, lmasks = m._pack_single(x, y, fmask,
+                                                         lmask)
+            m._params, m._opt_state, m._state, loss = m._train_step(
+                m._params, m._opt_state, m._state, ins, labels, fmasks,
+                lmasks, sub)
+        else:
+            m._params, m._opt_state, m._state, loss = m._train_step(
+                m._params, m._opt_state, m._state, x, y, fmask, lmask, sub)
         m._score = float(loss)
         m._iteration += 1
         for listener in m._listeners:

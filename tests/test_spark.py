@@ -1,0 +1,136 @@
+"""Local-mode Spark training surface (≡ dl4j-spark ::
+SparkDl4jMultiLayer / SparkComputationGraph + TrainingMaster builders +
+RDD plumbing)."""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn import (Adam, DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.spark import (JavaSparkContext,
+                                      ParameterAveragingTrainingMaster,
+                                      SharedTrainingMaster, SparkConf,
+                                      SparkComputationGraph,
+                                      SparkDl4jMultiLayer)
+
+
+def _data(n=128, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[
+        (x[:, :3].argmax(-1)).astype(int)]
+    return x, y
+
+
+def _conf():
+    return (NeuralNetConfiguration.Builder().seed(0).updater(Adam(5e-3))
+            .weightInit("xavier").list()
+            .layer(DenseLayer(nOut=24, activation="tanh"))
+            .layer(OutputLayer(nOut=3, activation="softmax",
+                               lossFunction="mcxent"))
+            .setInputType(InputType.feedForward(6)).build())
+
+
+class TestRDD:
+    def test_parallelize_partitions_and_ops(self):
+        sc = JavaSparkContext(SparkConf().setMaster("local[*]")
+                              .setAppName("t"))
+        rdd = sc.parallelize(list(range(20)), numSlices=4)
+        assert rdd.getNumPartitions() == 4
+        assert sorted(rdd.collect()) == list(range(20))
+        assert rdd.count() == 20
+        assert sorted(rdd.map(lambda v: v * 2).collect()) == \
+            [v * 2 for v in range(20)]
+        assert rdd.filter(lambda v: v % 2 == 0).count() == 10
+        assert rdd.repartition(2).getNumPartitions() == 2
+        assert rdd.union(sc.parallelize([99])).count() == 21
+        seen = []
+        rdd.foreachPartition(lambda it: seen.append(sum(it)))
+        assert sum(seen) == sum(range(20))
+
+
+class TestTrainingMasters:
+    def test_builders(self):
+        tm = (ParameterAveragingTrainingMaster.Builder(32)
+              .averagingFrequency(5).workerPrefetchNumBatches(3)
+              .collectTrainingStats(True).build())
+        assert tm.batchSizePerWorker == 32
+        assert tm.averagingFrequency == 5
+        assert tm.workerPrefetchNumBatches == 3
+        # two-arg reference form (rddNumExamples, batchSizePerWorker)
+        tm2 = SharedTrainingMaster.Builder(1000, 16) \
+            .updatesThreshold(1e-4).build()
+        assert tm2.batchSizePerWorker == 16
+        assert tm2.updatesThreshold == 1e-4
+
+
+class TestSparkDl4jMultiLayer:
+    def test_fit_from_rdd_trains_and_evaluates(self):
+        x, y = _data()
+        datasets = [DataSet(x[i:i + 8], y[i:i + 8])
+                    for i in range(0, 128, 8)]
+        sc = JavaSparkContext()
+        rdd = sc.parallelize(datasets, numSlices=4)
+        tm = (ParameterAveragingTrainingMaster.Builder(32)
+              .averagingFrequency(1).build())
+        spark_net = SparkDl4jMultiLayer(sc, _conf(), tm)
+        for _ in range(25):
+            spark_net.fit(rdd)
+        ev = spark_net.evaluate(rdd)
+        assert ev.accuracy() > 0.85
+        assert np.isfinite(spark_net.getScore())
+        # the trained network is a plain MultiLayerNetwork
+        net = spark_net.getNetwork()
+        out = np.asarray(net.output(x[:4]).numpy())
+        assert out.shape == (4, 3)
+
+    def test_matches_plain_parallel_wrapper_training(self):
+        """Spark surface == ParallelWrapper over the same data: identical
+        final params (it IS the same SPMD step underneath)."""
+        from deeplearning4j_tpu.datasets.iterators import \
+            ListDataSetIterator
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+        x, y = _data(64, seed=3)
+        datasets = [DataSet(x[i:i + 8], y[i:i + 8])
+                    for i in range(0, 64, 8)]
+        sc = JavaSparkContext()
+        tm = ParameterAveragingTrainingMaster.Builder(16).build()
+        s_net = SparkDl4jMultiLayer(sc, _conf(), tm)
+        # numSlices=1 keeps RDD order == list order (multi-slice
+        # round-robin reorders batches, which is legal Spark semantics
+        # but breaks bit-exact comparison)
+        s_net.fit(sc.parallelize(datasets, numSlices=1), epochs=3)
+
+        p_net = MultiLayerNetwork(_conf()).init()
+        pw = (ParallelWrapper.Builder(p_net).workers(8)
+              .prefetchBuffer(2).build())
+        pw.fit(ListDataSetIterator(datasets, 16), epochs=3)
+        for k, layer in s_net.getNetwork()._params.items():
+            for name, v in layer.items():
+                np.testing.assert_allclose(
+                    np.asarray(v), np.asarray(p_net._params[k][name]),
+                    atol=1e-6, err_msg=f"{k}.{name}")
+
+
+class TestSparkComputationGraph:
+    def test_graph_fit_from_rdd(self):
+        x, y = _data(96, seed=5)
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(5e-3))
+                .weightInit("xavier").graphBuilder()
+                .addInputs("in")
+                .addLayer("h", DenseLayer(nOut=24, activation="tanh"), "in")
+                .addLayer("out", OutputLayer(nOut=3, activation="softmax"),
+                          "h")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(6))
+                .build())
+        datasets = [DataSet(x[i:i + 8], y[i:i + 8])
+                    for i in range(0, 96, 8)]
+        sc = JavaSparkContext()
+        tm = ParameterAveragingTrainingMaster.Builder(24).build()
+        sg = SparkComputationGraph(sc, conf, tm)
+        for _ in range(25):
+            sg.fit(sc.parallelize(datasets, numSlices=4))
+        ev = sg.evaluate(sc.parallelize(datasets))
+        assert ev.accuracy() > 0.85
