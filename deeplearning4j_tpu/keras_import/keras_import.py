@@ -243,6 +243,34 @@ def _convert_layer(class_name, cfg, is_last=False):
         return Subsampling1DLayer(poolingType=pool, kernelSize=int(size),
                                   stride=int(stride),
                                   convolutionMode=cfg.get("padding", "valid"))
+    if class_name in ("Conv2DTranspose", "Conv3DTranspose"):
+        # refuse silently-shape-changing options rather than approximate
+        # (same policy as Bidirectional merge_mode=None)
+        op = cfg.get("output_padding")
+        if op is not None and any(int(v) != 0 for v in
+                                  (op if isinstance(op, (list, tuple))
+                                   else [op])):
+            raise InvalidKerasConfigurationException(
+                f"{class_name} output_padding={op!r} unsupported — output "
+                "shape would silently differ from the source model")
+        dil = cfg.get("dilation_rate", 1)
+        if any(int(v) != 1 for v in
+               (dil if isinstance(dil, (list, tuple)) else [dil])):
+            raise InvalidKerasConfigurationException(
+                f"{class_name} dilation_rate={dil!r} unsupported")
+        if class_name == "Conv2DTranspose":
+            from deeplearning4j_tpu.nn.conf.layers import Deconvolution2D
+            return Deconvolution2D(
+                nOut=cfg["filters"], kernelSize=tuple(cfg["kernel_size"]),
+                stride=tuple(cfg.get("strides", (1, 1))),
+                convolutionMode=cfg.get("padding", "valid"),
+                activation=act, weightInit=init, hasBias=bias)
+        from deeplearning4j_tpu.nn.conf.layers3d import Deconvolution3D
+        return Deconvolution3D(
+            nOut=cfg["filters"], kernelSize=tuple(cfg["kernel_size"]),
+            stride=tuple(cfg.get("strides", (1, 1, 1))),
+            convolutionMode=cfg.get("padding", "valid"),
+            activation=act, weightInit=init, hasBias=bias)
     if class_name == "Conv3D":
         from deeplearning4j_tpu.nn.conf.layers3d import Convolution3D
         return Convolution3D(
@@ -558,7 +586,19 @@ def _remap_lstm_gates(arr):
     return np.concatenate([i, f, o, g], axis=-1)
 
 
-def _assign_keras_weights(layer_params, arrs, layer_state=None):
+def _is_deconv(layer):
+    """Transposed convs store Keras kernels as (..., OUT, IN) — the only
+    kernel layout that differs from ours (HWIO); everything else imports
+    natively."""
+    if layer is None:
+        return False
+    from deeplearning4j_tpu.nn.conf.layers import Deconvolution2D
+    from deeplearning4j_tpu.nn.conf.layers3d import Deconvolution3D
+    return isinstance(layer, (Deconvolution2D, Deconvolution3D))
+
+
+def _assign_keras_weights(layer_params, arrs, layer_state=None,
+                          deconv=False):
     """Assign Keras .h5 arrays onto our param/state dicts BY NAME.
 
     Shape-only matching mis-assigns any layer whose weights share a shape
@@ -579,6 +619,17 @@ def _assign_keras_weights(layer_params, arrs, layer_state=None):
             skey = None
         else:
             pkey, skey = _KERAS_WEIGHT_NAMES.get(name, (None, None))
+        if deconv and name == "kernel" and arr.ndim >= 3:
+            # Keras Conv*Transpose computes the GRADIENT-style transposed
+            # conv; our lax.conv_transpose(transpose_kernel=False) call
+            # needs the channel axes swapped ((..., out, in) → HWIO) AND
+            # every spatial axis flipped for identical outputs (verified
+            # against a hand oracle in test_keras_import). Must be
+            # unconditional for deconvs — a square in==out kernel would
+            # otherwise pass the shape check untransposed.
+            arr = arr.swapaxes(-1, -2)
+            arr = arr[tuple(slice(None, None, -1)
+                            for _ in range(arr.ndim - 2))]
         if pkey is not None and pkey in layer_params \
                 and tuple(layer_params[pkey].shape) == tuple(arr.shape):
             if is_lstm and pkey in ("W", "U", "b") and arr.shape[-1] % 4 == 0:
@@ -619,10 +670,11 @@ def _jnp_tree(d):
     return jax.tree_util.tree_map(jnp.asarray, d)
 
 
-def _assign_layer_weights(params, arrs, state):
+def _assign_layer_weights(params, arrs, state, layer=None):
     """Assign one Keras layer group onto our (possibly NESTED) param dict.
     Bidirectional wrappers nest {'fwd': ..., 'bwd': ...}; their Keras
     datasets carry forward/ / backward/ prefixes from _h5_layer_weights."""
+    deconv = _is_deconv(layer)
     if any(isinstance(v, dict) for v in params.values()):
         fwd = [(n.split("/", 1)[1], a) for n, a in arrs
                if n.startswith("forward/")]
@@ -641,7 +693,7 @@ def _assign_layer_weights(params, arrs, state):
         return
     # plain layers never carry direction prefixes; strip any stray ones
     arrs = [(n.split("/", 1)[-1], a) for n, a in arrs]
-    _assign_keras_weights(params, arrs, state)
+    _assign_keras_weights(params, arrs, state, deconv=deconv)
 
 
 def _load_h5_weights_multilayer(net, weights_path):
@@ -653,7 +705,7 @@ def _load_h5_weights_multilayer(net, weights_path):
             params = _np_tree(net._params[str(li)])
             state = {k: np.array(v)
                      for k, v in net._state.get(str(li), {}).items()}
-            _assign_layer_weights(params, by_name[name], state)
+            _assign_layer_weights(params, by_name[name], state, layer=lyr)
             net._params[str(li)] = _jnp_tree(params)
             if state:
                 net._state[str(li)] = _jnp_tree(state)
@@ -670,7 +722,10 @@ def _load_h5_weights_graph(net, weights_path):
             params = _np_tree(net._params[name])
             state = {k: np.array(v)
                      for k, v in net._state.get(name, {}).items()}
-            _assign_layer_weights(params, arrs, state)
+            _assign_layer_weights(params, arrs, state,
+                                  layer=getattr(net.nodes.get(name), "ref",
+                                                None)
+                                  if hasattr(net, "nodes") else None)
             net._params[name] = _jnp_tree(params)
             if state:
                 net._state[name] = _jnp_tree(state)

@@ -77,10 +77,16 @@ class RDD:
     def union(self, other):
         return RDD(self._parts + other._parts)
 
-    def repartition(self, n):
-        items = self.collect()
+    @staticmethod
+    def _chunk(items, n):
+        """CONTIGUOUS chunks — parallelize/collect must preserve element
+        order, as Spark's local mode does."""
         n = max(1, int(n))
-        return RDD([items[i::n] for i in range(n)])
+        size = -(-len(items) // n) if items else 1
+        return [items[i * size:(i + 1) * size] for i in range(n)]
+
+    def repartition(self, n):
+        return RDD(self._chunk(self.collect(), n))
 
     def foreachPartition(self, fn):
         for p in self._parts:
@@ -96,7 +102,7 @@ class JavaSparkContext:
     def parallelize(self, data, numSlices=None):
         data = list(data)
         n = max(1, int(numSlices) if numSlices else min(8, len(data) or 1))
-        return RDD([data[i::n] for i in range(n)])
+        return RDD(RDD._chunk(data, n))
 
     def stop(self):
         pass
@@ -106,12 +112,29 @@ SparkContext = JavaSparkContext
 
 
 class _TrainingMaster:
+    #: accepted config keys — a typo'd builder method must FAIL at
+    #: build(), like the reference's typed Java builders fail to compile
+    _KNOWN = {"batchSizePerWorker", "averagingFrequency",
+              "workerPrefetchNumBatches", "workers",
+              "rddDataSetNumExamples", "collectTrainingStats",
+              "rddTrainingApproach", "storageLevel", "repartionData",
+              "repartitionData", "repartitionStrategy"}
+
     def __init__(self, **kw):
-        self.batchSizePerWorker = int(kw.get("batchSizePerWorker", 32))
+        unknown = set(kw) - self._KNOWN
+        if unknown:
+            raise ValueError(
+                f"{type(self).__name__}: unknown option(s) "
+                f"{sorted(unknown)} — known: {sorted(self._KNOWN)}")
+        # reference default batch per worker is 16; batchSizePerWorker is
+        # a SETTER in dl4j-spark, never a Builder positional arg
+        self.batchSizePerWorker = int(kw.get("batchSizePerWorker", 16))
         self.averagingFrequency = int(kw.get("averagingFrequency", 1))
         self.workerPrefetchNumBatches = int(
             kw.get("workerPrefetchNumBatches", 2))
         self.workers = kw.get("workers")
+        self.rddDataSetNumExamples = int(
+            kw.get("rddDataSetNumExamples", 1))
         self.collectTrainingStats = bool(kw.get("collectTrainingStats",
                                                 False))
 
@@ -119,12 +142,19 @@ class _TrainingMaster:
         _cls = None
 
         def __init__(self, *args):
-            # reference builders take (batchSizePerWorker) or (rddDataSetNumExamples, batchSizePerWorker)
+            # reference Builder positional forms:
+            #   Builder(rddDataSetNumExamples)
+            #   Builder(numWorkers, rddDataSetNumExamples)
             self._kw = {}
             if len(args) == 1:
-                self._kw["batchSizePerWorker"] = args[0]
+                self._kw["rddDataSetNumExamples"] = int(args[0])
             elif len(args) == 2:
-                self._kw["batchSizePerWorker"] = args[1]
+                self._kw["workers"] = int(args[0])
+                self._kw["rddDataSetNumExamples"] = int(args[1])
+            elif args:
+                raise TypeError(
+                    "Builder takes (rddDataSetNumExamples) or "
+                    "(numWorkers, rddDataSetNumExamples)")
 
         def __getattr__(self, name):
             if name.startswith("_"):
@@ -159,8 +189,18 @@ class SharedTrainingMaster(_TrainingMaster):
     encoded gradient sharing). Thresholds are recorded; the mesh step
     all-reduces exact gradients every step — the threshold=0 limit."""
 
+    _KNOWN = _TrainingMaster._KNOWN | {"updatesThreshold",
+                                       "thresholdAlgorithm",
+                                       "batchSize"}
+
     def __init__(self, **kw):
-        super().__init__(**kw)
+        super().__init__(**{k: v for k, v in kw.items()
+                            if k in _TrainingMaster._KNOWN})
+        unknown = set(kw) - self._KNOWN
+        if unknown:
+            raise ValueError(
+                f"SharedTrainingMaster: unknown option(s) "
+                f"{sorted(unknown)}")
         self.updatesThreshold = float(kw.get("updatesThreshold", 1e-3))
         self.rddTrainingApproach = kw.get("rddTrainingApproach", "Export")
 
@@ -204,17 +244,25 @@ class SparkDl4jMultiLayer:
             raise ValueError("fit(): empty RDD")
         return ListDataSetIterator(data, self.tm.batchSizePerWorker)
 
-    def fit(self, rdd, epochs=1):
-        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
-        import jax
+    def _wrapper(self):
+        """Built once: mesh construction + param replication must not be
+        paid per fit() call (the epoch-loop idiom calls fit repeatedly)."""
+        pw = getattr(self, "_pw", None)
+        if pw is None:
+            import jax
 
-        n = self.tm.workers or len(jax.devices())
-        pw = (ParallelWrapper.Builder(self.net)
-              .workers(n)
-              .prefetchBuffer(self.tm.workerPrefetchNumBatches)
-              .averagingFrequency(self.tm.averagingFrequency)
-              .build())
-        pw.fit(self._iterator(rdd), epochs=epochs)
+            from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+            n = self.tm.workers or len(jax.devices())
+            pw = self._pw = (
+                ParallelWrapper.Builder(self.net)
+                .workers(n)
+                .prefetchBuffer(self.tm.workerPrefetchNumBatches)
+                .averagingFrequency(self.tm.averagingFrequency)
+                .build())
+        return pw
+
+    def fit(self, rdd, epochs=1):
+        self._wrapper().fit(self._iterator(rdd), epochs=epochs)
         return self.net
 
     def evaluate(self, rdd, evaluation=None):

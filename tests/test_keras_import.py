@@ -557,3 +557,101 @@ class TestRound3ImportFixes:
         with pytest.raises(InvalidKerasConfigurationException,
                            match="recurrent"):
             KerasModelImport.importKerasSequentialModelAndWeights(model)
+
+
+class TestConvTranspose:
+    def test_conv2d_transpose_import_and_weights(self, tmp_path):
+        """Conv2DTranspose maps to Deconvolution2D and its Keras kernel
+        (kh, kw, OUT, IN) transposes to our HWIO (kh, kw, IN, OUT) —
+        including the square in==out case that shape-matching alone would
+        silently mis-assign."""
+        h5py = pytest.importorskip("h5py")
+        model = json.dumps({
+            "class_name": "Sequential",
+            "config": {"name": "m", "layers": [
+                {"class_name": "InputLayer", "config": {
+                    "name": "in", "batch_input_shape": [None, 4, 4, 3]}},
+                {"class_name": "Conv2DTranspose", "config": {
+                    "name": "up", "filters": 3, "kernel_size": [2, 2],
+                    "strides": [2, 2], "padding": "valid",
+                    "activation": "linear", "use_bias": True}},
+            ]}})
+        rng = np.random.default_rng(0)
+        k = rng.normal(size=(2, 2, 3, 3)).astype(np.float32)  # out==in==3
+        b = rng.normal(size=(3,)).astype(np.float32)
+        p = tmp_path / "w.h5"
+        with h5py.File(p, "w") as f:
+            g = f.create_group("model_weights")
+            up = g.create_group("up").create_group("up")
+            up.create_dataset("kernel:0", data=k)
+            up.create_dataset("bias:0", data=b)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            model, str(p))
+        from deeplearning4j_tpu.nn.conf.layers import Deconvolution2D
+        assert isinstance(net.layers[-1], Deconvolution2D)
+        # our stored W must be the channel-swapped, spatially-flipped
+        # kernel (the lax.conv_transpose orientation)
+        w = np.asarray(net._params["0"]["W"])
+        assert np.allclose(w, k.swapaxes(-1, -2)[::-1, ::-1])
+        x = rng.normal(size=(1, 4, 4, 3)).astype(np.float32)
+        out = np.asarray(net.output(x).numpy())
+        assert out.shape == (1, 8, 8, 3)
+        # stride-2 kernel-2 VALID transpose conv oracle: output block
+        # (2i:2i+2, 2j:2j+2) = sum_c x[i,j,c] * K[:, :, ., c_out] with the
+        # Keras kernel indexed [kh, kw, out, in]
+        want = np.zeros((1, 8, 8, 3), np.float32)
+        for i in range(4):
+            for j in range(4):
+                for co in range(3):
+                    want[0, 2 * i:2 * i + 2, 2 * j:2 * j + 2, co] += (
+                        (k[:, :, co, :] * x[0, i, j, :]).sum(-1))
+        want += b
+        assert np.allclose(out, want, atol=1e-4)
+
+    def test_conv3d_transpose_import(self):
+        model = json.dumps({
+            "class_name": "Sequential",
+            "config": {"name": "m", "layers": [
+                {"class_name": "InputLayer", "config": {
+                    "name": "in",
+                    "batch_input_shape": [None, 2, 4, 4, 2]}},
+                {"class_name": "Conv3DTranspose", "config": {
+                    "name": "up3", "filters": 5, "kernel_size": [2, 2, 2],
+                    "strides": [2, 2, 2], "padding": "valid",
+                    "activation": "relu", "use_bias": True}},
+            ]}})
+        net = KerasModelImport.importKerasSequentialModelAndWeights(model)
+        from deeplearning4j_tpu.nn.conf.layers3d import Deconvolution3D
+        assert isinstance(net.layers[-1], Deconvolution3D)
+        x = np.zeros((1, 2, 4, 4, 2), np.float32)
+        out = np.asarray(net.output(x).numpy())
+        assert out.shape == (1, 4, 8, 8, 5)
+
+    def test_conv_transpose_refuses_output_padding_and_dilation(self):
+        from deeplearning4j_tpu.keras_import.keras_import import \
+            InvalidKerasConfigurationException
+
+        def mk(extra):
+            return json.dumps({
+                "class_name": "Sequential",
+                "config": {"name": "m", "layers": [
+                    {"class_name": "InputLayer", "config": {
+                        "name": "in",
+                        "batch_input_shape": [None, 4, 4, 3]}},
+                    {"class_name": "Conv2DTranspose", "config": dict({
+                        "name": "up", "filters": 2, "kernel_size": [3, 3],
+                        "strides": [2, 2], "padding": "valid",
+                        "activation": "linear"}, **extra)},
+                ]}})
+
+        with pytest.raises(InvalidKerasConfigurationException,
+                           match="output_padding"):
+            KerasModelImport.importKerasSequentialModelAndWeights(
+                mk({"output_padding": [1, 1]}))
+        with pytest.raises(InvalidKerasConfigurationException,
+                           match="dilation_rate"):
+            KerasModelImport.importKerasSequentialModelAndWeights(
+                mk({"dilation_rate": [2, 2]}))
+        # explicit zeros are fine
+        KerasModelImport.importKerasSequentialModelAndWeights(
+            mk({"output_padding": [0, 0]}))

@@ -36,9 +36,10 @@ class TestRDD:
                               .setAppName("t"))
         rdd = sc.parallelize(list(range(20)), numSlices=4)
         assert rdd.getNumPartitions() == 4
-        assert sorted(rdd.collect()) == list(range(20))
+        # Spark local mode preserves order through parallelize/collect
+        assert rdd.collect() == list(range(20))
         assert rdd.count() == 20
-        assert sorted(rdd.map(lambda v: v * 2).collect()) == \
+        assert rdd.map(lambda v: v * 2).collect() == \
             [v * 2 for v in range(20)]
         assert rdd.filter(lambda v: v % 2 == 0).count() == 10
         assert rdd.repartition(2).getNumPartitions() == 2
@@ -50,17 +51,30 @@ class TestRDD:
 
 class TestTrainingMasters:
     def test_builders(self):
-        tm = (ParameterAveragingTrainingMaster.Builder(32)
+        # reference form: Builder(rddDataSetNumExamples); batch size is a
+        # SETTER (default 16, as in dl4j-spark)
+        tm = (ParameterAveragingTrainingMaster.Builder(1)
+              .batchSizePerWorker(32)
               .averagingFrequency(5).workerPrefetchNumBatches(3)
               .collectTrainingStats(True).build())
+        assert tm.rddDataSetNumExamples == 1
         assert tm.batchSizePerWorker == 32
         assert tm.averagingFrequency == 5
         assert tm.workerPrefetchNumBatches == 3
-        # two-arg reference form (rddNumExamples, batchSizePerWorker)
-        tm2 = SharedTrainingMaster.Builder(1000, 16) \
-            .updatesThreshold(1e-4).build()
+        assert ParameterAveragingTrainingMaster.Builder(1).build() \
+            .batchSizePerWorker == 16
+        # two-arg reference form (numWorkers, rddDataSetNumExamples)
+        tm2 = SharedTrainingMaster.Builder(4, 1) \
+            .batchSizePerWorker(16).updatesThreshold(1e-4).build()
+        assert tm2.workers == 4
         assert tm2.batchSizePerWorker == 16
         assert tm2.updatesThreshold == 1e-4
+
+    def test_typoed_builder_method_fails_at_build(self):
+        import pytest
+        with pytest.raises(ValueError, match="averagingFrequancy"):
+            (ParameterAveragingTrainingMaster.Builder(1)
+             .averagingFrequancy(5).build())
 
 
 class TestSparkDl4jMultiLayer:
@@ -70,8 +84,8 @@ class TestSparkDl4jMultiLayer:
                     for i in range(0, 128, 8)]
         sc = JavaSparkContext()
         rdd = sc.parallelize(datasets, numSlices=4)
-        tm = (ParameterAveragingTrainingMaster.Builder(32)
-              .averagingFrequency(1).build())
+        tm = (ParameterAveragingTrainingMaster.Builder(1)
+              .batchSizePerWorker(32).averagingFrequency(1).build())
         spark_net = SparkDl4jMultiLayer(sc, _conf(), tm)
         for _ in range(25):
             spark_net.fit(rdd)
@@ -95,12 +109,12 @@ class TestSparkDl4jMultiLayer:
         datasets = [DataSet(x[i:i + 8], y[i:i + 8])
                     for i in range(0, 64, 8)]
         sc = JavaSparkContext()
-        tm = ParameterAveragingTrainingMaster.Builder(16).build()
+        tm = (ParameterAveragingTrainingMaster.Builder(1)
+              .batchSizePerWorker(16).build())
         s_net = SparkDl4jMultiLayer(sc, _conf(), tm)
-        # numSlices=1 keeps RDD order == list order (multi-slice
-        # round-robin reorders batches, which is legal Spark semantics
-        # but breaks bit-exact comparison)
-        s_net.fit(sc.parallelize(datasets, numSlices=1), epochs=3)
+        # contiguous chunking preserves order, so multi-slice RDDs give
+        # bit-exact parity with the plain iterator
+        s_net.fit(sc.parallelize(datasets, numSlices=2), epochs=3)
 
         p_net = MultiLayerNetwork(_conf()).init()
         pw = (ParallelWrapper.Builder(p_net).workers(8)
@@ -128,7 +142,8 @@ class TestSparkComputationGraph:
         datasets = [DataSet(x[i:i + 8], y[i:i + 8])
                     for i in range(0, 96, 8)]
         sc = JavaSparkContext()
-        tm = ParameterAveragingTrainingMaster.Builder(24).build()
+        tm = (ParameterAveragingTrainingMaster.Builder(1)
+              .batchSizePerWorker(24).build())
         sg = SparkComputationGraph(sc, conf, tm)
         for _ in range(25):
             sg.fit(sc.parallelize(datasets, numSlices=4))
