@@ -235,6 +235,11 @@ class Cnn3DLossLayer(BaseOutputLayer):
         return get_activation(self.activation)(x), state
 
     def output_type(self, input_type):
+        if not isinstance(input_type, Convolutional3DType):
+            raise ValueError(
+                f"Cnn3DLossLayer '{self.name}' needs convolutional3D "
+                f"(D,H,W,C) input, got {input_type} (use CnnLossLayer "
+                "for 2-D feature maps)")
         return input_type
 
 

@@ -55,6 +55,8 @@ def _eligible_conv(layer):
             and str(layer.activation).lower() in ("identity", "linear")
             and getattr(layer, "spaceToDepth", 1) == 1
             and not getattr(layer, "frozen", False)
+            and not getattr(layer, "frozen_params", False)
+            and getattr(layer, "weightNoise", None) is None
             and (layer.dropOut is None or layer.dropOut >= 1.0))
 
 

@@ -15,7 +15,8 @@ import numpy as np
 import optax
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
-from deeplearning4j_tpu.nn.multilayer import _apply_layer, _l1l2_penalty
+from deeplearning4j_tpu.nn.multilayer import (_apply_layer, _hook_params,
+                                              _l1l2_penalty)
 from deeplearning4j_tpu.nn.updaters import build_optimizer, same_updater
 from deeplearning4j_tpu.ops.ndarray import NDArray, as_jax, resolve_dtype
 
@@ -195,7 +196,7 @@ class ComputationGraph:
                 continue
             lrng = jax.random.fold_in(rng, li) if rng is not None else None
             li += 1
-            p = params.get(name, {})
+            p = _hook_params(layer, params.get(name, {}), ltrain, lrng)
             s = state.get(name, {})
             fc = getattr(self, "_fused_pairs", {}).get(name)
             if fc is not None:

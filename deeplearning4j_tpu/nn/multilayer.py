@@ -42,6 +42,23 @@ def _l1l2_penalty(layer_confs, params):
     return total
 
 
+def _hook_params(layer, p, ltrain, lrng):
+    """Per-layer param transforms shared by BOTH network classes' forward
+    loops (MultiLayerNetwork and ComputationGraph must never diverge):
+    - frozen_params (≡ FrozenLayerWithBackprop): params are constants to
+      the grad; train-mode behavior and upstream gradients kept.
+    - weightNoise (WeightNoise/DropConnect): weight-space noise as a pure
+      function of the step rng — stays inside the jitted step. The 0x57
+      fold_in tag keeps the noise stream distinct from the layer's
+      dropout stream (which uses lrng directly)."""
+    if getattr(layer, "frozen_params", False):
+        p = jax.tree_util.tree_map(jax.lax.stop_gradient, p)
+    wn = getattr(layer, "weightNoise", None)
+    if wn is not None and ltrain and lrng is not None:
+        p = wn.apply_to_params(p, jax.random.fold_in(lrng, 0x57))
+    return p
+
+
 def _apply_layer(layer, p, s, x, ltrain, lrng, mask):
     """Run one layer, honouring its `remat` flag: remat=True wraps the
     train-mode apply in jax.checkpoint so activations inside the layer are
@@ -180,17 +197,8 @@ class MultiLayerNetwork:
             lrng = None
             if rng is not None:
                 lrng = jax.random.fold_in(rng, i)
-            p = params.get(str(i), {})
+            p = _hook_params(layer, params.get(str(i), {}), ltrain, lrng)
             s = state.get(str(i), {})
-            if getattr(layer, "frozen_params", False):
-                # ≡ FrozenLayerWithBackprop: params are constants to the
-                # grad (train-mode behavior and upstream gradients kept)
-                p = jax.tree_util.tree_map(jax.lax.stop_gradient, p)
-            wn = getattr(layer, "weightNoise", None)
-            if wn is not None and ltrain and lrng is not None:
-                # weight-space noise (WeightNoise/DropConnect): a pure
-                # function of the step rng — stays inside the jitted step
-                p = wn.apply_to_params(p, jax.random.fold_in(lrng, 0x57))
             if i == len(self.layers) - 1 and hasattr(layer, "compute_loss") \
                     and hasattr(layer, "pre_activation"):
                 preact = layer.pre_activation(p, layer._dropout_in(x, ltrain, lrng))

@@ -268,3 +268,61 @@ class TestWeightNoise:
                 .setInputType(InputType.feedForward(4)).build())
         assert isinstance(conf.layers[0].weightNoise, DropConnect)
         assert isinstance(conf.layers[1].weightNoise, DropConnect)
+
+
+class TestWeightNoiseOnGraph:
+    def test_dropconnect_and_frozen_backprop_in_computation_graph(self):
+        """The weight-noise / frozen-params hooks must act in the GRAPH
+        forward too, not only MultiLayerNetwork."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Sgd(0.1))
+                .weightInit("xavier").graphBuilder()
+                .addInputs("in")
+                .addLayer("h", FrozenLayerWithBackprop(
+                    DenseLayer(nOut=8, activation="tanh")), "in")
+                .addLayer("n", DenseLayer(nOut=8, activation="tanh",
+                                          weightNoise=DropConnect(0.7)),
+                          "h")
+                .addLayer("out", OutputLayer(nOut=2, activation="softmax"),
+                          "n")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(4))
+                .build())
+        net = ComputationGraph(conf).init()
+        w_frozen = np.asarray(net._params["h"]["W"]).copy()
+        x = _rand((16, 4))
+        y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+        for _ in range(5):
+            net.fit([x], [y])
+        # frozen layer pinned; downstream trained
+        np.testing.assert_array_equal(w_frozen,
+                                      np.asarray(net._params["h"]["W"]))
+        # the stop_gradient hook itself: grads w.r.t. the frozen layer's
+        # params are EXACTLY zero (NoOp updater alone would also pin the
+        # values, so assert on the gradient, not the weights)
+        import jax
+
+        def loss(params):
+            return net._loss(params, net._state, {"in": jnp.asarray(x)},
+                             [jnp.asarray(y)], None, None,
+                             jax.random.PRNGKey(0))[0]
+
+        grads = jax.grad(loss)(net._params)
+        assert all(np.all(np.asarray(g) == 0)
+                   for g in grads["h"].values())
+        assert any(np.any(np.asarray(g) != 0)
+                   for g in grads["out"].values())
+        # weight noise: two TRAIN-mode forwards with different rng differ,
+        # test-time forwards are deterministic
+        import jax
+        a, _, _ = net._forward(net._params, net._state,
+                               {"in": jnp.asarray(x)}, True,
+                               jax.random.PRNGKey(0))
+        b, _, _ = net._forward(net._params, net._state,
+                               {"in": jnp.asarray(x)}, True,
+                               jax.random.PRNGKey(1))
+        assert not np.allclose(np.asarray(a["out"]), np.asarray(b["out"]))
+        o1 = np.asarray(net.output([x]).numpy())
+        o2 = np.asarray(net.output([x]).numpy())
+        np.testing.assert_array_equal(o1, o2)
