@@ -80,14 +80,22 @@ def blockwise_attention(q, k, v, block_size=512, causal=False):
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
-def _make_ring_flash(axis_name, block_q=128, block_k=128, interpret=None):
+def _make_ring_flash(axis_name, block_q=128, block_k=128, interpret=None,
+                     causal=False):
     """Ring attention whose LOCAL block math is the Pallas flash kernel
     pair: forward calls the fused fwd kernel per held K/V block and merges
     the per-block (o, lse) partials with the associative logsumexp merge;
     backward is a second ring pass driving the Pallas dQ / dK-dV kernels
     with the GLOBAL lse (dk/dv partial sums ride around the ring with
-    their K/V blocks and arrive home after the full cycle). Noncausal —
-    the causal ring keeps the lax.scan path (block-offset masks)."""
+    their K/V blocks and arrive home after the full cycle).
+
+    Causal (round-4): at ring step i, shard `my` holds the K/V block of
+    shard (my − i) mod n, so the block's GLOBAL position relative to the
+    queries is fully determined by the step: i == 0 → the diagonal block
+    (run the CAUSAL kernel), i ≤ my → strictly-past block (full kernel),
+    i > my → strictly-future block (skipped: lse = −inf in the merge,
+    zero grads in backward). lax.cond picks the kernel per step, so each
+    step still runs exactly one Pallas program."""
     from deeplearning4j_tpu.kernels.flash_attention import (_flash_backward,
                                                             _flash_forward)
 
@@ -96,15 +104,44 @@ def _make_ring_flash(axis_name, block_q=128, block_k=128, interpret=None):
         o, _ = _ring_flash_fwd_pass(q, k, v)
         return o.astype(q.dtype)
 
+    def _block_fwd(q, kblk, vblk, i, my):
+        """One local flash block, causal-aware; lse (B*H, tq_padded)."""
+        if not causal:
+            return _flash_forward(q, kblk, vblk, None, None, False,
+                                  block_q, block_k, interpret)
+
+        def diag(q, kb, vb):
+            return _flash_forward(q, kb, vb, None, None, True,
+                                  block_q, block_k, interpret)
+
+        def past(q, kb, vb):
+            return _flash_forward(q, kb, vb, None, None, False,
+                                  block_q, block_k, interpret)
+
+        def future(q, kb, vb):
+            # strictly-future block: SKIP the kernel — -inf lse zeroes
+            # its weight in the associative merge. Shapes must mirror
+            # _flash_forward's returns: out (B,H,T,D), lse (B*H, tq_pad).
+            b, h, t_local, d = q.shape
+            bq = min(block_q, max(t_local, 8))
+            tq_pad = -(-t_local // bq) * bq
+            return (jnp.zeros((b, h, t_local, d), q.dtype),
+                    jnp.full((b * h, tq_pad), -jnp.inf, jnp.float32))
+
+        return lax.cond(
+            i == 0, diag,
+            lambda q, kb, vb: lax.cond(i <= my, past, future, q, kb, vb),
+            q, kblk, vblk)
+
     def _ring_flash_fwd_pass(q, k, v):
         n = lax.psum(1, axis_name)
+        my = lax.axis_index(axis_name)
         b, h, t_local, d = q.shape
         perm = [(j, (j + 1) % n) for j in range(n)]
 
-        def step(carry, _):
+        def step(carry, i):
             o, lse, kblk, vblk = carry
-            ob, lse_b = _flash_forward(q, kblk, vblk, None, None, False,
-                                       block_q, block_k, interpret)
+            ob, lse_b = _block_fwd(q, kblk, vblk, i, my)
             lse_b = lse_b[:, :t_local].reshape(b, h, t_local)
             m = jnp.maximum(lse, lse_b)
             w1 = jnp.exp(lse - m)
@@ -119,7 +156,8 @@ def _make_ring_flash(axis_name, block_q=128, block_k=128, interpret=None):
 
         o0 = jnp.zeros(q.shape, jnp.float32)
         lse0 = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)
-        (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v), None, length=n)
+        (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v),
+                                     jnp.arange(n))
         return o, lse
 
     def fwd(q, k, v):
@@ -130,15 +168,41 @@ def _make_ring_flash(axis_name, block_q=128, block_k=128, interpret=None):
     def bwd(res, g):
         q, k, v, o, lse = res
         n = lax.psum(1, axis_name)
+        my = lax.axis_index(axis_name)
         b, h, t_local, d = q.shape
         lse2 = lse.reshape(b * h, t_local)
         perm = [(j, (j + 1) % n) for j in range(n)]
 
-        def step(carry, _):
+        def _block_bwd(i, kblk, vblk):
+            if not causal:
+                return _flash_backward(q, kblk, vblk, None, None, o, lse2,
+                                       g, False, block_q, block_k,
+                                       interpret)
+
+            def diag(kb, vb):
+                return _flash_backward(q, kb, vb, None, None, o, lse2, g,
+                                       True, block_q, block_k, interpret)
+
+            def past(kb, vb):
+                return _flash_backward(q, kb, vb, None, None, o, lse2, g,
+                                       False, block_q, block_k, interpret)
+
+            def future(kb, vb):
+                # the global-lse recompute would give NONZERO p for
+                # future blocks (they never entered the softmax) — their
+                # gradients are identically zero and must be skipped
+                return (jnp.zeros(q.shape, q.dtype),
+                        jnp.zeros(kb.shape, kb.dtype),
+                        jnp.zeros(vb.shape, vb.dtype))
+
+            return lax.cond(
+                i == 0, diag,
+                lambda kb, vb: lax.cond(i <= my, past, future, kb, vb),
+                kblk, vblk)
+
+        def step(carry, i):
             dq, kblk, vblk, dkblk, dvblk = carry
-            dq_i, dk_i, dv_i = _flash_backward(
-                q, kblk, vblk, None, None, o, lse2, g, False, block_q,
-                block_k, interpret)
+            dq_i, dk_i, dv_i = _block_bwd(i, kblk, vblk)
             dq = dq + dq_i.astype(jnp.float32)
             dkblk = dkblk + dk_i.astype(jnp.float32)
             dvblk = dvblk + dv_i.astype(jnp.float32)
@@ -152,7 +216,7 @@ def _make_ring_flash(axis_name, block_q=128, block_k=128, interpret=None):
 
         z = jnp.zeros(q.shape, jnp.float32)
         (dq, _, _, dk, dv), _ = lax.scan(
-            step, (z, k, v, z, z), None, length=n)
+            step, (z, k, v, z, z), jnp.arange(n))
         return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
     ring_flash.defvjp(fwd, bwd)
@@ -167,16 +231,18 @@ def make_ring_attention(mesh, axis_name="sp", causal=False, use_flash=None,
     ppermute around the ring, one ICI hop per step.
 
     use_flash (default: auto — on TPU, noncausal): local block math runs
-    the Pallas flash kernels (fwd + bwd) composed with the ring, so the sp
-    path gets the fused-kernel HBM profile instead of the lax.scan
-    accumulator."""
+    the Pallas flash kernels (fwd + bwd) composed with the ring, so the
+    sp path gets the fused-kernel HBM profile instead of the lax.scan
+    accumulator. Causal can ride the same kernels (round-4: diagonal ring
+    step → causal kernel, past steps → full kernel, future steps skipped)
+    but stays OPT-IN (use_flash=True) until it has an on-chip smoke run —
+    interpret-mode tests don't validate Mosaic lowering (BENCH.md
+    round-3 lesson)."""
     if use_flash is None:
         use_flash = jax.default_backend() == "tpu" and not causal
     if use_flash:
-        if causal:
-            raise ValueError("flash ring path is noncausal; pass "
-                             "use_flash=False for causal ring attention")
-        return _make_ring_flash(axis_name, block_q, block_k, interpret)
+        return _make_ring_flash(axis_name, block_q, block_k, interpret,
+                                causal=causal)
 
     def ring_attn(q, k, v):
         n = lax.psum(1, axis_name)

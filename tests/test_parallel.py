@@ -306,12 +306,34 @@ def test_ring_attention_flash_kernel_path(devices8):
                                    atol=2e-4, rtol=2e-4)
 
 
-def test_ring_attention_flash_causal_refused(devices8):
-    from jax.sharding import Mesh
-    from deeplearning4j_tpu.parallel.ring_attention import make_ring_attention
-    mesh = Mesh(np.array(devices8[:2]), ("sp",))
-    with pytest.raises(ValueError, match="noncausal"):
-        make_ring_attention(mesh, "sp", causal=True, use_flash=True)
+def test_ring_attention_flash_causal_matches_dense(devices8):
+    """Round-4: the CAUSAL ring now rides the flash kernels too — the
+    diagonal ring step runs the causal kernel, past steps the full
+    kernel, future steps are skipped. Forward AND gradients must equal
+    dense causal attention (4-way so diag/past/future all occur)."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from deeplearning4j_tpu.parallel.ring_attention import (
+        dense_attention, make_ring_attention)
+    mesh = Mesh(np.array(devices8[:4]), ("sp",))
+    B, H, T, D = 1, 2, 64, 16
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, T, D), jnp.float32) for kk in ks)
+    ring = make_ring_attention(mesh, "sp", causal=True, use_flash=True,
+                               block_q=16, block_k=16, interpret=True)
+    spec = P(None, None, "sp", None)
+    f = jax.shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec, check_vma=False)
+    want = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(f(q, k, v)), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    gf = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(f(q, k, v))),
+                  (0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda q, k, v: jnp.sum(
+        jnp.sin(dense_attention(q, k, v, causal=True))), (0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
 
 
 def test_zero1_sharded_optimizer_matches_replicated(devices8):
