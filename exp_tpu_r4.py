@@ -107,6 +107,26 @@ def mode_smoke():
     o2.block_until_ready()
     _emit({"self_masked_ok": bool(jnp.isfinite(o2).all())})
 
+    # causal flash ring, 1-device sp mesh: n=1 means only the diagonal
+    # (causal-kernel) step runs, but that IS the Mosaic-lowering risk —
+    # pallas inside lax.cond inside scan inside shard_map, on hardware
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel.ring_attention import (
+        dense_attention, make_ring_attention)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    ring = make_ring_attention(mesh, "sp", causal=True, use_flash=True,
+                               interpret=None)  # compiled on TPU
+    spec = P(None, None, "sp", None)
+    f = jax.shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec, check_vma=False)
+    qr = jax.random.normal(kq, (2, 2, 256, 64), jnp.float32)
+    got = f(qr, qr, qr)
+    want = dense_attention(qr, qr, qr, causal=True)
+    rerr = float(jnp.abs(got - want).max())
+    _emit({"causal_ring_flash_max_abs_err": rerr, "ok": rerr < 3e-3})
+
     # layer-level: LearnedSelfAttention now routes flash cross on TPU
     from deeplearning4j_tpu.nn.conf.attention import \
         LearnedSelfAttentionLayer
