@@ -234,3 +234,22 @@ class TestDetectionOutput:
         assert net.getOutputLayer() is net.layers[-1]
         with pytest.raises(TypeError, match="Yolo2OutputLayer"):
             net.getPredictedObjects(np.zeros((1, 4), np.float32))
+
+
+class TestGraphDetection:
+    def test_yolo2_graph_getPredictedObjects(self):
+        """ComputationGraph twin of the detection convenience: the YOLO2
+        zoo model (graph with Yolo2OutputLayer head) emits DetectedObject
+        lists end to end."""
+        m = YOLO2(numClasses=3, inputShape=(64, 64, 3))
+        net = m.init()
+        x = _rand((2, 64, 64, 3))
+        dets = net.getPredictedObjects(x, confThreshold=0.0,
+                                       nmsThreshold=0.5)
+        assert len(dets) == 2
+        # conf 0.0 keeps NMS survivors; every det is a DetectedObject in
+        # grid range (64/32 = 2 cells)
+        for d in dets[0]:
+            assert 0.0 <= d.centerX <= 2.0 and 0.0 <= d.centerY <= 2.0
+            assert 0 <= d.getPredictedClass() < 3
+        assert net.getOutputLayer().numBoxes == 5
