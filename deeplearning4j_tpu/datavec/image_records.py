@@ -83,11 +83,12 @@ class ImageRecordReader:
     """
 
     def __init__(self, height, width, channels=3, labelGenerator=None,
-                 imageTransform=None, seed=0):
+                 imageTransform=None, seed=0, nativeLoader=False):
         self.height, self.width = int(height), int(width)
         self.channels = int(channels)
         self.labelGenerator = labelGenerator or ParentPathLabelGenerator()
         self.imageTransform = imageTransform
+        self.nativeLoader = bool(nativeLoader)   # C++ bilinear resize
         self._rng = np.random.default_rng(seed)
         self._paths = []
         self._labels = []
@@ -122,11 +123,15 @@ class ImageRecordReader:
         return len(self._paths)
 
     def _load(self, path):
-        from PIL import Image
-        img = Image.open(path)
-        img = img.convert("RGB" if self.channels == 3 else "L")
-        img = img.resize((self.width, self.height))
-        arr = np.asarray(img, np.float32)
+        if self.nativeLoader:
+            arr = NativeImageLoader(self.height, self.width,
+                                    self.channels).asMatrix(path)[0]
+        else:
+            from PIL import Image
+            img = Image.open(path)
+            img = img.convert("RGB" if self.channels == 3 else "L")
+            img = img.resize((self.width, self.height))
+            arr = np.asarray(img, np.float32)
         if arr.ndim == 2:
             arr = arr[:, :, None]
         if self.imageTransform is not None:
@@ -183,3 +188,60 @@ class ImageRecordDataSetIterator:
 
     def reset(self):
         self.reader.reset()
+
+
+class NativeImageLoader:
+    """≡ datavec-data-image :: loader.NativeImageLoader — decode + resize
+    to (height, width, channels) float32 via the NATIVE runtime (C++
+    bilinear in runtime/native; strict-parity-gated numpy oracle when the
+    toolchain is absent — identical output either way). The reference is
+    NCHW via JavaCV; this stack is NHWC-native, and asMatrix returns
+    (1, H, W, C) ready for the conv layers."""
+
+    def __init__(self, height, width, channels=3):
+        self.height, self.width = int(height), int(width)
+        self.channels = int(channels)
+
+    def _decode(self, src):
+        if isinstance(src, np.ndarray):
+            arr = src
+            if np.issubdtype(arr.dtype, np.floating):
+                # normalized [0, 1] floats scale back to [0, 255];
+                # [0, 255] floats round — NEVER a silent truncating cast
+                scale = 255.0 if float(arr.max(initial=0.0)) <= 1.5 else 1.0
+                arr = np.rint(arr.astype(np.float32) * scale)
+        else:
+            from PIL import Image
+            img = Image.open(src)
+            img = img.convert("RGB" if self.channels == 3 else "L")
+            arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        have = arr.shape[-1]
+        if have != self.channels:
+            if self.channels == 1 and have >= 3:
+                # luminance, same weights as the reference's grayscale
+                arr = (arr[..., :3].astype(np.float32)
+                       @ np.array([0.299, 0.587, 0.114], np.float32)
+                       )[..., None]
+            elif self.channels == 1 and have == 2:
+                arr = arr[..., :1]           # LA: drop alpha
+            elif self.channels == 3 and have == 1:
+                arr = np.repeat(arr, 3, axis=-1)
+            elif self.channels == 3 and have > 3:
+                arr = arr[..., :3]           # RGBA: drop alpha
+            else:
+                raise ValueError(
+                    f"NativeImageLoader: cannot map {have} "
+                    f"channels to {self.channels}")
+        return np.clip(arr, 0, 255).astype(np.uint8)
+
+    def asMatrix(self, src):
+        """path | (H, W[, C]) array → (1, height, width, channels) f32."""
+        from deeplearning4j_tpu.runtime.native_lib import resize_bilinear_u8
+        u8 = self._decode(src)
+        out = resize_bilinear_u8(u8, self.height, self.width)
+        return out[None]
+
+    def asImageMatrix(self, src):
+        return self.asMatrix(src)

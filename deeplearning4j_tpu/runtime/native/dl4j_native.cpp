@@ -10,6 +10,7 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <cmath>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -291,5 +292,51 @@ void dl4j_arena_destroy(void* arena) {
   free(a->base);
   delete a;
 }
+
+
+// -- image ops (≡ datavec-data-image :: loader.NativeImageLoader — the
+// reference resizes via native JavaCV/OpenCV; hand-rolled here, zero
+// deps) -----------------------------------------------------------------
+// Half-pixel-center bilinear (align_corners=False), u8 HWC -> f32 HWC in
+// [0, 255]. Matches the numpy oracle in runtime/native_lib.py bit-for-bit
+// in float32 (same clamp, same lerp order) — the strict-parity gate
+// depends on that.
+void dl4j_resize_bilinear_u8(const uint8_t* src, int64_t sh, int64_t sw,
+                             int64_t c, float* dst, int64_t dh,
+                             int64_t dw) {
+  const float scale_y = (float)sh / (float)dh;
+  const float scale_x = (float)sw / (float)dw;
+  for (int64_t oy = 0; oy < dh; oy++) {
+    float fy = ((float)oy + 0.5f) * scale_y - 0.5f;
+    float fy0 = floorf(fy);
+    float wy = fy - fy0;
+    int64_t y0 = (int64_t)fy0;
+    int64_t y0c = y0 < 0 ? 0 : (y0 >= sh ? sh - 1 : y0);
+    int64_t y1 = y0 + 1;
+    int64_t y1c = y1 < 0 ? 0 : (y1 >= sh ? sh - 1 : y1);
+    for (int64_t ox = 0; ox < dw; ox++) {
+      float fx = ((float)ox + 0.5f) * scale_x - 0.5f;
+      float fx0 = floorf(fx);
+      float wx = fx - fx0;
+      int64_t x0 = (int64_t)fx0;
+      int64_t x0c = x0 < 0 ? 0 : (x0 >= sw ? sw - 1 : x0);
+      int64_t x1 = x0 + 1;
+      int64_t x1c = x1 < 0 ? 0 : (x1 >= sw ? sw - 1 : x1);
+      const uint8_t* r0 = src + (y0c * sw) * c;
+      const uint8_t* r1 = src + (y1c * sw) * c;
+      float* o = dst + (oy * dw + ox) * c;
+      for (int64_t ch = 0; ch < c; ch++) {
+        float v00 = (float)r0[x0c * c + ch];
+        float v01 = (float)r0[x1c * c + ch];
+        float v10 = (float)r1[x0c * c + ch];
+        float v11 = (float)r1[x1c * c + ch];
+        float top = v00 + (v01 - v00) * wx;
+        float bot = v10 + (v11 - v10) * wx;
+        o[ch] = top + (bot - top) * wy;
+      }
+    }
+  }
+}
+
 
 }  // extern "C"

@@ -58,6 +58,9 @@ def get_lib():
                                      c.c_int64, c.c_void_p]
         lib.dl4j_sub_channel_means.argtypes = [c.c_void_p, c.c_int64,
                                                c.c_int64, c.c_void_p]
+        lib.dl4j_resize_bilinear_u8.argtypes = [
+            c.c_void_p, c.c_int64, c.c_int64, c.c_int64,
+            c.c_void_p, c.c_int64, c.c_int64]
         lib.dl4j_standardize.argtypes = [c.c_void_p, c.c_int64, c.c_int64,
                                          c.c_void_p, c.c_void_p]
         lib.dl4j_csv_dims.argtypes = [c.c_char_p, c.c_char, c.c_int32,
@@ -195,6 +198,84 @@ def standardize_inplace(data, mean, std):
                          np.ascontiguousarray(std, np.float32).ctypes
                          .data_as(ctypes.c_void_p))
     return data
+
+
+def _resize_bilinear_oracle(img_u8, out_h, out_w):
+    """numpy reference with EXACTLY the C kernel's math (half-pixel
+    centers, clamped edges, float32 lerp order) — the parity gate and the
+    no-toolchain fallback are the same function."""
+    src = img_u8.astype(np.float32)
+    sh, sw, c = src.shape
+    scale_y = np.float32(sh) / np.float32(out_h)
+    scale_x = np.float32(sw) / np.float32(out_w)
+    fy = (np.arange(out_h, dtype=np.float32) + np.float32(0.5)) * scale_y \
+        - np.float32(0.5)
+    fx = (np.arange(out_w, dtype=np.float32) + np.float32(0.5)) * scale_x \
+        - np.float32(0.5)
+    y0 = np.floor(fy).astype(np.int64)
+    x0 = np.floor(fx).astype(np.int64)
+    wy = (fy - y0.astype(np.float32)).astype(np.float32)
+    wx = (fx - x0.astype(np.float32)).astype(np.float32)
+    y0c = np.clip(y0, 0, sh - 1)
+    y1c = np.clip(y0 + 1, 0, sh - 1)
+    x0c = np.clip(x0, 0, sw - 1)
+    x1c = np.clip(x0 + 1, 0, sw - 1)
+    v00 = src[y0c[:, None], x0c[None, :], :]
+    v01 = src[y0c[:, None], x1c[None, :], :]
+    v10 = src[y1c[:, None], x0c[None, :], :]
+    v11 = src[y1c[:, None], x1c[None, :], :]
+    wxb = wx[None, :, None]
+    top = v00 + (v01 - v00) * wxb
+    bot = v10 + (v11 - v10) * wxb
+    return (top + (bot - top) * wy[:, None, None]).astype(np.float32)
+
+
+def resize_bilinear_u8(img_u8, out_h, out_w):
+    """u8 (H, W, C) -> f32 (out_h, out_w, C) in [0, 255]: the native
+    kernel when available (strict-parity-gated against the numpy oracle
+    once per process), the oracle otherwise — identical output either
+    way."""
+    img_u8 = np.ascontiguousarray(img_u8, np.uint8)
+    if img_u8.ndim == 2:
+        img_u8 = img_u8[:, :, None]
+    lib = get_lib()
+    if lib is None or not _resize_parity_ok():
+        return _resize_bilinear_oracle(img_u8, out_h, out_w)
+    sh, sw, c = img_u8.shape
+    out = np.empty((int(out_h), int(out_w), c), np.float32)
+    lib.dl4j_resize_bilinear_u8(
+        img_u8.ctypes.data_as(ctypes.c_void_p), sh, sw, c,
+        out.ctypes.data_as(ctypes.c_void_p), int(out_h), int(out_w))
+    return out
+
+
+_resize_parity = None
+
+
+def _resize_parity_ok():
+    """One-time gate: the native kernel must match the oracle on a fixed
+    random probe (both up- and down-scale) or we never use it."""
+    global _resize_parity
+    if _resize_parity is not None:
+        return _resize_parity
+    lib = get_lib()
+    if lib is None:
+        _resize_parity = False
+        return False
+    rng = np.random.default_rng(0)
+    probe = rng.integers(0, 256, size=(13, 17, 3), dtype=np.uint8)
+    ok = True
+    for oh, ow in ((7, 9), (29, 31)):
+        want = _resize_bilinear_oracle(probe, oh, ow)
+        got = np.empty((oh, ow, 3), np.float32)
+        lib.dl4j_resize_bilinear_u8(
+            probe.ctypes.data_as(ctypes.c_void_p), 13, 17, 3,
+            got.ctypes.data_as(ctypes.c_void_p), oh, ow)
+        if not np.allclose(got, want, atol=1e-3):
+            ok = False
+            break
+    _resize_parity = ok
+    return ok
 
 
 class NativeArena:

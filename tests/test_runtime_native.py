@@ -241,3 +241,90 @@ class TestNativeCsv:
         # ragged numeric rows: the bulk gate must refuse (Python raises)
         r = CSVRecordReader().initialize("1\n2,3\n")
         assert r.numeric_matrix() is None
+
+
+class TestNativeImageOps:
+    def test_bilinear_matches_oracle_many_shapes(self):
+        from deeplearning4j_tpu.runtime import native_lib
+        if not native_lib.available():
+            import pytest
+            pytest.skip("native toolchain unavailable")
+        rng = np.random.default_rng(7)
+        for (sh, sw, c), (dh, dw) in [((8, 8, 3), (16, 16)),
+                                      ((64, 48, 3), (17, 29)),
+                                      ((5, 5, 1), (10, 3)),
+                                      ((224, 224, 3), (64, 64))]:
+            img = rng.integers(0, 256, size=(sh, sw, c), dtype=np.uint8)
+            got = native_lib.resize_bilinear_u8(img, dh, dw)
+            want = native_lib._resize_bilinear_oracle(img, dh, dw)
+            assert got.shape == (dh, dw, c)
+            np.testing.assert_allclose(got, want, atol=1e-3)
+
+    def test_identity_resize_is_exact(self):
+        from deeplearning4j_tpu.runtime import native_lib
+        rng = np.random.default_rng(3)
+        img = rng.integers(0, 256, size=(12, 9, 3), dtype=np.uint8)
+        out = native_lib.resize_bilinear_u8(img, 12, 9)
+        np.testing.assert_allclose(out, img.astype(np.float32), atol=1e-4)
+
+    def test_native_image_loader(self, tmp_path):
+        from deeplearning4j_tpu.datavec.image_records import \
+            NativeImageLoader
+        rng = np.random.default_rng(0)
+        arr = rng.integers(0, 256, size=(40, 30, 3), dtype=np.uint8)
+        # array source
+        m = NativeImageLoader(16, 16, 3).asMatrix(arr)
+        assert m.shape == (1, 16, 16, 3) and m.dtype == np.float32
+        assert 0 <= m.min() and m.max() <= 255
+        # file source via PIL round trip
+        from PIL import Image
+        p = tmp_path / "img.png"
+        Image.fromarray(arr).save(p)
+        m2 = NativeImageLoader(16, 16, 3).asMatrix(str(p))
+        np.testing.assert_allclose(m2, m, atol=1e-3)
+        # grayscale conversion
+        g = NativeImageLoader(8, 8, 1).asMatrix(arr)
+        assert g.shape == (1, 8, 8, 1)
+
+    def test_reader_native_loader_option(self, tmp_path):
+        from PIL import Image
+
+        from deeplearning4j_tpu.datavec.image_records import \
+            ImageRecordReader
+        rng = np.random.default_rng(1)
+        for cls in ("a", "b"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(2):
+                Image.fromarray(rng.integers(
+                    0, 256, size=(20, 20, 3), dtype=np.uint8)).save(
+                        d / f"{i}.png")
+        rr = ImageRecordReader(8, 8, 3, nativeLoader=True).initialize(
+            str(tmp_path))
+        img, lab = rr.next()
+        assert img.shape == (8, 8, 3) and img.dtype == np.float32
+        assert rr.getLabels() == ["a", "b"]
+
+    def test_loader_float_and_alpha_inputs(self):
+        from deeplearning4j_tpu.datavec.image_records import \
+            NativeImageLoader
+        rng = np.random.default_rng(5)
+        u8 = rng.integers(0, 256, size=(10, 10, 3), dtype=np.uint8)
+        base = NativeImageLoader(8, 8, 3).asMatrix(u8)
+        # normalized floats give the SAME image back (no truncation)
+        f01 = NativeImageLoader(8, 8, 3).asMatrix(
+            u8.astype(np.float32) / 255.0)
+        np.testing.assert_allclose(f01, base, atol=1.0)
+        assert f01.max() > 10         # not silently near-black
+        # [0,255] floats round
+        f255 = NativeImageLoader(8, 8, 3).asMatrix(u8.astype(np.float32))
+        np.testing.assert_allclose(f255, base, atol=1e-3)
+        # RGBA drops alpha; LA drops alpha for grayscale
+        rgba = np.concatenate([u8, np.full((10, 10, 1), 255, np.uint8)],
+                              -1)
+        np.testing.assert_allclose(
+            NativeImageLoader(8, 8, 3).asMatrix(rgba), base, atol=1e-3)
+        la = np.concatenate([u8[..., :1],
+                             np.full((10, 10, 1), 255, np.uint8)], -1)
+        g = NativeImageLoader(8, 8, 1).asMatrix(la)
+        assert g.shape == (1, 8, 8, 1)
