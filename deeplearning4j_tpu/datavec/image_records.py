@@ -128,9 +128,9 @@ class ImageRecordReader:
                                     self.channels).asMatrix(path)[0]
         else:
             from PIL import Image
-            img = Image.open(path)
-            img = img.convert("RGB" if self.channels == 3 else "L")
-            img = img.resize((self.width, self.height))
+            decoded = _pil_decode(path, self.channels)
+            img = Image.fromarray(decoded).resize(
+                (self.width, self.height))
             arr = np.asarray(img, np.float32)
         if arr.ndim == 2:
             arr = arr[:, :, None]
@@ -190,6 +190,15 @@ class ImageRecordDataSetIterator:
         self.reader.reset()
 
 
+def _pil_decode(path, channels):
+    """ONE file-decode path (PIL open + mode convert) shared by
+    ImageRecordReader and NativeImageLoader — decode fixes (EXIF,
+    palettes, ...) must never diverge between the two."""
+    from PIL import Image
+    img = Image.open(path)
+    return np.asarray(img.convert("RGB" if channels == 3 else "L"))
+
+
 class NativeImageLoader:
     """≡ datavec-data-image :: loader.NativeImageLoader — decode + resize
     to (height, width, channels) float32 via the NATIVE runtime (C++
@@ -206,24 +215,27 @@ class NativeImageLoader:
         if isinstance(src, np.ndarray):
             arr = src
             if np.issubdtype(arr.dtype, np.floating):
-                # normalized [0, 1] floats scale back to [0, 255];
+                if float(arr.min(initial=0.0)) < 0.0:
+                    raise ValueError(
+                        "NativeImageLoader: float image with negative "
+                        "values is ambiguous ([-1,1]-normalized?) — "
+                        "rescale to [0,1] or [0,255] first")
+                # [0, 1]-normalized floats scale back to [0, 255];
                 # [0, 255] floats round — NEVER a silent truncating cast
-                scale = 255.0 if float(arr.max(initial=0.0)) <= 1.5 else 1.0
+                scale = 255.0 if float(arr.max(initial=0.0)) <= 1.0 else 1.0
                 arr = np.rint(arr.astype(np.float32) * scale)
         else:
-            from PIL import Image
-            img = Image.open(src)
-            img = img.convert("RGB" if self.channels == 3 else "L")
-            arr = np.asarray(img)
+            arr = _pil_decode(src, self.channels)
         if arr.ndim == 2:
             arr = arr[:, :, None]
         have = arr.shape[-1]
         if have != self.channels:
             if self.channels == 1 and have >= 3:
                 # luminance, same weights as the reference's grayscale
-                arr = (arr[..., :3].astype(np.float32)
-                       @ np.array([0.299, 0.587, 0.114], np.float32)
-                       )[..., None]
+                arr = np.rint(
+                    arr[..., :3].astype(np.float32)
+                    @ np.array([0.299, 0.587, 0.114], np.float32)
+                )[..., None]
             elif self.channels == 1 and have == 2:
                 arr = arr[..., :1]           # LA: drop alpha
             elif self.channels == 3 and have == 1:

@@ -418,10 +418,12 @@ class TextGenerationLSTM(ZooModel):
     """≡ zoo.model.TextGenerationLSTM — char-RNN: stacked LSTMs +
     per-timestep softmax (the GravesLSTM char-modelling baseline config)."""
 
-    def __init__(self, numClasses=77, lstmLayerSize=256, **kw):
+    def __init__(self, numClasses=77, lstmLayerSize=256, scanUnroll=1,
+                 **kw):
         kw.setdefault("inputShape", (None, numClasses))
         super().__init__(numClasses=numClasses, **kw)
         self.lstmLayerSize = lstmLayerSize
+        self.scanUnroll = int(scanUnroll)   # lax.scan unroll (TPU perf)
 
     DEFAULT_INPUT = (None, 77)
 
@@ -432,8 +434,10 @@ class TextGenerationLSTM(ZooModel):
                 .weightInit("xavier")
                 .dataType(self.dataType)
                 .list()
-                .layer(LSTM(nOut=self.lstmLayerSize, activation="tanh"))
-                .layer(LSTM(nOut=self.lstmLayerSize, activation="tanh"))
+                .layer(LSTM(nOut=self.lstmLayerSize, activation="tanh",
+                            scanUnroll=self.scanUnroll))
+                .layer(LSTM(nOut=self.lstmLayerSize, activation="tanh",
+                            scanUnroll=self.scanUnroll))
                 .layer(RnnOutputLayer(lossFunction="mcxent",
                                       nOut=self.numClasses,
                                       activation="softmax"))

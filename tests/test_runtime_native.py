@@ -328,3 +328,13 @@ class TestNativeImageOps:
                              np.full((10, 10, 1), 255, np.uint8)], -1)
         g = NativeImageLoader(8, 8, 1).asMatrix(la)
         assert g.shape == (1, 8, 8, 1)
+
+    def test_loader_rejects_negative_floats(self):
+        import pytest
+
+        from deeplearning4j_tpu.datavec.image_records import \
+            NativeImageLoader
+        arr = np.random.default_rng(0).uniform(
+            -1, 1, size=(8, 8, 3)).astype(np.float32)
+        with pytest.raises(ValueError, match="negative"):
+            NativeImageLoader(4, 4, 3).asMatrix(arr)
