@@ -186,10 +186,8 @@ def child_main():
 
     import jax
 
-    from deeplearning4j_tpu.util.hostkey import cache_dir
-    jax.config.update("jax_compilation_cache_dir",
-                      cache_dir(os.path.dirname(os.path.abspath(__file__))))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from deeplearning4j_tpu.util.hostkey import enable_compile_cache
+    enable_compile_cache(os.path.dirname(os.path.abspath(__file__)))
 
     dev = jax.devices()[0]
     print(f"# device: {dev} platform={dev.platform}", file=sys.stderr, flush=True)
@@ -234,10 +232,19 @@ def child_main():
     # secondary BASELINE.md configs — extra JSON fields, headline unchanged;
     # a failing extra never takes down the headline number, and extras are
     # skipped when cold compiles already ate the attempt window
-    extra_deadline = float(os.environ.get("BENCH_EXTRA_DEADLINE", "260"))
+    extra_deadline = float(os.environ.get("BENCH_EXTRA_DEADLINE", "300"))
 
     def _over_budget():
         return time.perf_counter() - t_start > extra_deadline
+
+    def _emit_partial():
+        # Incremental checkpoint: if the parent (or the driver above it)
+        # kills this child mid-extras, the parent recovers the LAST of
+        # these lines instead of zeroing the whole artifact. Never starts
+        # with "{" so the success path (first "{" line) ignores it.
+        print(f"#partial# {json.dumps(result)}", flush=True)
+
+    _emit_partial()
 
     if "vgg16" in extras:
         if _over_budget():
@@ -256,6 +263,7 @@ def child_main():
                       f"compile={v_c:.1f}s", file=sys.stderr, flush=True)
             except Exception as e:  # noqa: BLE001 — diagnostic field
                 result["vgg16_error"] = str(e)[:200]
+    _emit_partial()
     # bert runs before the lower-value lenet/lstm rows so the time budget
     # never skips the flagship fine-tune number in their favour
     if "bert" in extras:
@@ -274,6 +282,7 @@ def child_main():
                       file=sys.stderr, flush=True)
             except Exception as e:  # noqa: BLE001
                 result["bert_error"] = str(e)[:200]
+    _emit_partial()
     if "lenet" in extras:
         if _over_budget():
             result["lenet_error"] = "skipped: attempt time budget exhausted"
@@ -285,6 +294,7 @@ def child_main():
                       file=sys.stderr, flush=True)
             except Exception as e:  # noqa: BLE001
                 result["lenet_error"] = str(e)[:200]
+    _emit_partial()
     if "lstm" in extras:
         if _over_budget():
             result["lstm_error"] = "skipped: attempt time budget exhausted"
@@ -301,7 +311,12 @@ def child_main():
 
 
 def _run_attempt(timeout_s: float):
-    """Run one child attempt; return (json_dict | None, diagnostic_str)."""
+    """Run one child attempt.
+
+    Returns (json_dict | None, diagnostic_str, partial_dict | None); the
+    diagnostic contains the literal "# device:" marker iff the child got
+    far enough to initialize the chip (distinguishes a slow measurement
+    from the tunnel-wedge init hang)."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
 
@@ -336,21 +351,40 @@ def _run_attempt(timeout_s: float):
         except (ProcessLookupError, PermissionError):
             proc.kill()
         out, err = proc.communicate()
-        return None, f"timeout after {timeout_s:.0f}s; stderr tail: {err[-500:]}"
+        dev = "yes" if "# device:" in err else "no"
+        return (None, f"timeout after {timeout_s:.0f}s; device_line={dev}; "
+                f"stderr tail: {err[-500:]}", _last_partial(out))
     finally:
         signal.signal(signal.SIGTERM, old_term)
         signal.signal(signal.SIGINT, old_int)
     if proc.returncode != 0:
-        return None, f"rc={proc.returncode}; stderr tail: {err[-500:]}"
+        return (None, f"rc={proc.returncode}; stderr tail: {err[-500:]}",
+                _last_partial(out))
     for line in out.splitlines():
         line = line.strip()
         if line.startswith("{"):
             try:
                 sys.stderr.write(err)
-                return json.loads(line), ""
+                return json.loads(line), "", None
             except json.JSONDecodeError:
                 continue
-    return None, f"no JSON line in child stdout; stdout: {out[-300:]!r}"
+    return (None, f"no JSON line in child stdout; stdout: {out[-300:]!r}",
+            _last_partial(out))
+
+
+def _last_partial(out: str):
+    """Most complete measurement checkpoint a killed/failed child printed
+    (see child_main's _emit_partial) — salvages the headline when the
+    attempt died mid-extras instead of zeroing the artifact."""
+    best = None
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("#partial# "):
+            try:
+                best = json.loads(line[len("#partial# "):])
+            except json.JSONDecodeError:
+                continue
+    return best
 
 
 def main():
@@ -359,11 +393,15 @@ def main():
         return
 
     attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
-    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "420"))
+    # must exceed the remote compile service's own ~500 s timeout: a
+    # SIGKILL while a compile RPC is in flight wedges the tunnel for hours
+    # (BENCH.md outage log), so let a slow compile fail on its own first
+    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "560"))
     deadline = time.monotonic() + float(os.environ.get("BENCH_DEADLINE", "1500"))
     backoff = 15.0
 
     errors = []
+    partial = None
     for i in range(attempts):
         remaining = deadline - time.monotonic()
         if remaining <= 5:
@@ -372,15 +410,28 @@ def main():
         t = min(attempt_timeout, remaining)
         print(f"# attempt {i + 1}/{attempts} (timeout {t:.0f}s)",
               file=sys.stderr, flush=True)
-        result, diag = _run_attempt(t)
+        result, diag, att_partial = _run_attempt(t)
         if result is not None:
             print(json.dumps(result))
             return
+        if att_partial is not None and (
+                partial is None or len(att_partial) >= len(partial)):
+            partial = att_partial
         errors.append(f"attempt {i + 1}: {diag}")
         print(f"# {errors[-1]}", file=sys.stderr, flush=True)
         if i + 1 < attempts and deadline - time.monotonic() > backoff:
             time.sleep(backoff)
             backoff *= 2
+
+    if partial is not None and partial.get("value"):
+        # a measured headline beats a zeroed artifact: report the last
+        # checkpoint of the furthest-along attempt, flagged as truncated
+        diag = " | ".join(e.split(";", 1)[0] for e in errors)
+        partial["note"] = ("attempt killed mid-extras; fields present were "
+                           "measured, missing extras were not reached — "
+                           + diag[-300:])
+        print(json.dumps(partial))
+        return
 
     out = {
         "metric": METRIC,
@@ -389,7 +440,9 @@ def main():
         "vs_baseline": 0.0,
         "error": " | ".join(errors)[-900:],
     }
-    if all("timeout" in e for e in errors if e.startswith("attempt")):
+    ran = [e for e in errors if e.startswith("attempt")]
+    if ran and all("timeout" in e and "device_line=yes" not in e
+                   for e in ran):
         # every attempt hung with no "# device:" line — the known axon
         # tunnel-wedge signature, not a framework failure (BENCH.md
         # outage log; last driver-verified run BENCH_r02.json, last local

@@ -221,9 +221,19 @@ class NativeImageLoader:
                         "values is ambiguous ([-1,1]-normalized?) — "
                         "rescale to [0,1] or [0,255] first")
                 # [0, 1]-normalized floats scale back to [0, 255];
-                # [0, 255] floats round — NEVER a silent truncating cast
-                scale = 255.0 if float(arr.max(initial=0.0)) <= 1.0 else 1.0
-                arr = np.rint(arr.astype(np.float32) * scale)
+                # [0, 255] floats round — NEVER a silent truncating cast.
+                # The 1e-2 slack absorbs bilinear/bicubic overshoot past
+                # 1.0; anything between that and 2.0 is ambiguous (a
+                # scaled-up normalized image would read near-black).
+                mx = float(arr.max(initial=0.0))
+                if 1.0 + 1e-2 < mx < 2.0:
+                    raise ValueError(
+                        "NativeImageLoader: float image with max "
+                        f"{mx:.4f} is ambiguous (overshot [0,1] or a "
+                        "dim [0,255] image?) — rescale explicitly")
+                scale = 255.0 if mx <= 1.0 + 1e-2 else 1.0
+                arr = np.rint(
+                    np.clip(arr.astype(np.float32) * scale, 0.0, 255.0))
         else:
             arr = _pil_decode(src, self.channels)
         if arr.ndim == 2:

@@ -39,3 +39,15 @@ def host_cpu_key() -> str:
 def cache_dir(root: str) -> str:
     """Per-host-flavour jax compilation cache dir under `root`."""
     return os.path.join(root, ".jax_cache", f"cpu-{host_cpu_key()}")
+
+
+def enable_compile_cache(root: str, min_compile_secs: float = 1.0) -> None:
+    """Point jax's persistent compilation cache at cache_dir(root).
+
+    Single definition shared by bench.py and exp_tpu_r4.py so the two
+    chip-facing entry points can never silently diverge on cache policy."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir(root))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)

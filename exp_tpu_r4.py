@@ -23,8 +23,12 @@ import sys
 import threading
 import time
 
-#: per-mode defaults — lstm is a 24-fresh-compile sweep (+1 trace pass)
-_DEFAULT_DEADLINES = {"smoke": 360, "lstm": 1800, "resnet": 600}
+#: per-mode defaults — lstm is a 24-fresh-compile sweep (+1 trace pass).
+#: Every deadline must exceed the remote compile service's own ~500 s
+#: timeout with slack: exiting (even cleanly, via os._exit) while a compile
+#: RPC is in flight wedges the tunnel exactly like a SIGKILL — observed
+#: 2026-07-30 ~19:51 UTC when a 360 s smoke deadline fired mid-compile.
+_DEFAULT_DEADLINES = {"smoke": 900, "lstm": 2400, "resnet": 900}
 
 
 def _arm_deadline(mode):
@@ -125,7 +129,10 @@ def mode_smoke():
     got = f(qr, qr, qr)
     want = dense_attention(qr, qr, qr, causal=True)
     rerr = float(jnp.abs(got - want).max())
-    _emit({"causal_ring_flash_max_abs_err": rerr, "ok": rerr < 3e-3})
+    # tol: MXU default-precision noise at T=256 — the dense oracle itself
+    # moves 2.1e-2 between default and highest matmul precision on chip,
+    # and the hardware-proven noncausal ring sits at the same 7e-3 level
+    _emit({"causal_ring_flash_max_abs_err": rerr, "ok": rerr < 2e-2})
 
     # layer-level: LearnedSelfAttention now routes flash cross on TPU
     from deeplearning4j_tpu.nn.conf.attention import \
@@ -250,6 +257,10 @@ def mode_resnet():
 def main():
     mode = sys.argv[1] if len(sys.argv) > 1 else "smoke"
     _arm_deadline(mode)
+    # without this every exp run recompiles every kernel from scratch
+    # (observed: back-to-back smoke runs paid identical compile time)
+    from deeplearning4j_tpu.util.hostkey import enable_compile_cache
+    enable_compile_cache(os.path.dirname(os.path.abspath(__file__)))
     t0 = time.perf_counter()
     try:
         {"smoke": mode_smoke, "lstm": mode_lstm,

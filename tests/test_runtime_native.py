@@ -329,6 +329,21 @@ class TestNativeImageOps:
         g = NativeImageLoader(8, 8, 1).asMatrix(la)
         assert g.shape == (1, 8, 8, 1)
 
+    def test_loader_float_overshoot_and_ambiguous(self):
+        import pytest
+
+        from deeplearning4j_tpu.datavec.image_records import \
+            NativeImageLoader
+        # bilinear/bicubic overshoot past 1.0 still reads as normalized
+        a = np.full((8, 8, 3), 0.5, np.float32)
+        a[0, 0, 0] = 1.004
+        m = NativeImageLoader(8, 8, 3).asMatrix(a)
+        assert m.max() > 100          # scaled by 255, not near-black
+        # max in (1.01, 2.0) is ambiguous and must fail loudly
+        bad = np.full((8, 8, 3), 1.5, np.float32)
+        with pytest.raises(ValueError, match="ambiguous"):
+            NativeImageLoader(8, 8, 3).asMatrix(bad)
+
     def test_loader_rejects_negative_floats(self):
         import pytest
 
