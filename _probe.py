@@ -1,0 +1,8 @@
+import os, threading, time
+def bail():
+    time.sleep(90); print("PROBE: init hang >90s (wedge signature)", flush=True); os._exit(3)
+threading.Thread(target=bail, daemon=True).start()
+t0 = time.time()
+import jax
+print("PROBE devices:", jax.devices(), f"{time.time()-t0:.1f}s", flush=True)
+os._exit(0)
