@@ -34,6 +34,7 @@ METRIC = "resnet50_imagenet_images_per_sec_per_chip"
 def _bench_zoo_model(model_cls, batch, steps, warmup, input_hw=224,
                      classes=1000, lr=0.1):
     """img/s for one zoo CNN: whole step = ONE jitted XLA executable."""
+    warmup = max(1, warmup)   # compile must finish before the timed window
     import jax
     import jax.numpy as jnp
 
@@ -82,6 +83,7 @@ def _bench_bert_finetune(batch=None, seq=None, steps=10, warmup=2):
     fwd + bwd + Adam in one jitted executable."""
     batch = batch or int(os.environ.get("BENCH_BERT_BATCH", "32"))
     seq = seq or int(os.environ.get("BENCH_BERT_SEQ", "128"))
+    warmup = max(1, warmup)   # compile must finish before the timed window
     import jax
     import jax.numpy as jnp
     import optax
@@ -142,6 +144,7 @@ def _bench_char_lstm(batch=128, seq=128, hidden=512, steps=10, warmup=2):
     from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
+    warmup = max(1, warmup)   # compile must finish before the timed window
     vocab = 80
     unroll = int(os.environ.get("BENCH_LSTM_UNROLL", "1"))
     dtype = os.environ.get("BENCH_LSTM_DTYPE", "float32")
@@ -415,6 +418,12 @@ def main():
         remaining = deadline - time.monotonic()
         if remaining <= 5:
             errors.append("wall-clock deadline reached")
+            break
+        if i > 0 and remaining < attempt_timeout:
+            # never truncate a RETRY below a full attempt window: killing
+            # the child under the ~500 s compile-RPC timeout risks the
+            # mid-compile SIGKILL wedge this harness exists to avoid
+            errors.append("remaining window shorter than a full attempt")
             break
         t = min(attempt_timeout, remaining)
         print(f"# attempt {i + 1}/{attempts} (timeout {t:.0f}s)",

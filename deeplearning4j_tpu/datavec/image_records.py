@@ -215,7 +215,9 @@ class NativeImageLoader:
         if isinstance(src, np.ndarray):
             arr = src
             if np.issubdtype(arr.dtype, np.floating):
-                if float(arr.min(initial=0.0)) < 0.0:
+                # matching slack below 0.0 for resize undershoot — the
+                # final clip maps it to 0; real [-1,1] images still fail
+                if float(arr.min(initial=0.0)) < -1e-2:
                     raise ValueError(
                         "NativeImageLoader: float image with negative "
                         "values is ambiguous ([-1,1]-normalized?) — "

@@ -39,13 +39,15 @@ def run_one(name, env_extra):
     # FORCE-set (not setdefault): an inherited larger deadline would let
     # the subprocess timeout fire first — the SIGKILL-mid-claim wedge
     env["BENCH_ATTEMPTS"] = "1"
-    env["BENCH_ATTEMPT_TIMEOUT"] = "420"
-    env["BENCH_DEADLINE"] = "440"
+    # stay ABOVE the remote compile service's ~500 s own timeout: a
+    # killpg below it can land mid-compile-RPC and wedge the tunnel
+    env["BENCH_ATTEMPT_TIMEOUT"] = "560"
+    env["BENCH_DEADLINE"] = "580"
     t0 = time.time()
     bench = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "bench.py")
     p = subprocess.run([sys.executable, bench], capture_output=True,
-                       text=True, timeout=560, env=env)
+                       text=True, timeout=700, env=env)
     line = next((l for l in p.stdout.splitlines() if l.startswith("{")), "")
     print(f"{name:8s} {line}  [{time.time()-t0:.0f}s]", flush=True)
     for l in p.stderr.splitlines():

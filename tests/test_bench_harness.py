@@ -1,0 +1,41 @@
+"""bench.py parent-harness unit tests — pure host logic, no device.
+
+The measurement child is exercised on the real chip by the driver; these
+cover the salvage path that turns a killed-mid-extras attempt into a
+partial artifact instead of a zeroed one (BENCH.md round-4 notes).
+"""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+import bench  # noqa: E402
+
+
+@pytest.mark.smoke
+class TestLastPartial:
+    def test_picks_last_checkpoint(self):
+        out = "\n".join([
+            "# noise",
+            '#partial# {"value": 1.0}',
+            'not json',
+            '#partial# {"value": 2.0, "vgg16_img_s": 3.0}',
+        ])
+        assert bench._last_partial(out) == {"value": 2.0,
+                                            "vgg16_img_s": 3.0}
+
+    def test_none_when_absent_or_malformed(self):
+        assert bench._last_partial("") is None
+        assert bench._last_partial("#partial# {bad json") is None
+
+    def test_final_json_line_not_confused_with_partial(self):
+        # the success path scans for lines starting "{" — partials must
+        # never match it, and _last_partial must never match the final line
+        final = json.dumps({"metric": "m", "value": 5.0})
+        out = '#partial# {"value": 4.0}\n' + final
+        assert bench._last_partial(out) == {"value": 4.0}
+        first_brace = next(line for line in out.splitlines()
+                           if line.strip().startswith("{"))
+        assert json.loads(first_brace)["value"] == 5.0
