@@ -215,19 +215,20 @@ class NativeImageLoader:
         if isinstance(src, np.ndarray):
             arr = src
             if np.issubdtype(arr.dtype, np.floating):
-                # matching slack below 0.0 for resize undershoot — the
-                # final clip maps it to 0; real [-1,1] images still fail
-                if float(arr.min(initial=0.0)) < -1e-2:
+                # [0, 1]-normalized floats scale back to [0, 255];
+                # [0, 255] floats round — NEVER a silent truncating cast.
+                # 1% slack each side absorbs bilinear/bicubic over/under-
+                # shoot, scaled to the detected range; the final clip maps
+                # undershoot to 0. Real [-1,1] images still fail loudly,
+                # as does the ambiguous (1.01, 2.0) band (a scaled-up
+                # normalized image would read near-black).
+                mx = float(arr.max(initial=0.0))
+                lo_tol = 1e-2 * (1.0 if mx <= 1.0 + 1e-2 else 255.0)
+                if float(arr.min(initial=0.0)) < -lo_tol:
                     raise ValueError(
                         "NativeImageLoader: float image with negative "
                         "values is ambiguous ([-1,1]-normalized?) — "
                         "rescale to [0,1] or [0,255] first")
-                # [0, 1]-normalized floats scale back to [0, 255];
-                # [0, 255] floats round — NEVER a silent truncating cast.
-                # The 1e-2 slack absorbs bilinear/bicubic overshoot past
-                # 1.0; anything between that and 2.0 is ambiguous (a
-                # scaled-up normalized image would read near-black).
-                mx = float(arr.max(initial=0.0))
                 if 1.0 + 1e-2 < mx < 2.0:
                     raise ValueError(
                         "NativeImageLoader: float image with max "

@@ -165,7 +165,7 @@ def mode_lstm():
         try:
             t0 = time.perf_counter()
             chars_s, dt_s, compile_s = _bench_char_lstm(
-                batch=batch, steps=6, warmup=2)
+                batch=batch, steps=20, warmup=2)
             row = {"batch": batch, "unroll": unroll, "dtype": dtype,
                    "chars_s": round(chars_s, 0),
                    "step_ms": round(dt_s * 1000, 1),
@@ -194,12 +194,40 @@ def mode_lstm():
             _emit({"op": name[:70], "ms": round(ms, 3), "n": n})
 
 
+def _measure_hbm_gbps():
+    """Achievable HBM bandwidth on THIS chip: time a saxpy over a buffer
+    far larger than VMEM (reads 2 arrays + writes 1 → 3x bytes moved).
+    Gives the denominator for a measured — not quoted — roofline bound."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 64 * 1024 * 1024          # 256 MB per fp32 array, 768 MB moved
+    a = jnp.ones((n,), jnp.float32)
+    b = jnp.full((n,), 2.0, jnp.float32)
+
+    @jax.jit
+    def saxpy(a, b):
+        return a * 1.5 + b
+
+    out = saxpy(a, b)
+    float(out[0])                  # compile + first run
+    t0 = time.perf_counter()
+    reps = 10
+    for _ in range(reps):
+        out = saxpy(out, b)
+    float(out[0])                  # transfer-sync closes the chain
+    dt = (time.perf_counter() - t0) / reps
+    return 3 * 4 * n / dt / 1e9
+
+
 def mode_resnet():
     import jax
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.models.zoo import ResNet50
     from deeplearning4j_tpu.nn.updaters import Nesterovs
+
+    _emit({"hbm_gbps_measured": round(_measure_hbm_gbps(), 1)})
 
     batch = int(os.environ.get("EXP_BATCH", "256"))
     mdt = os.environ.get("EXP_MOMENTUM_DTYPE") or None
@@ -239,8 +267,9 @@ def mode_resnet():
     if os.environ.get("EXP_TRACE"):
         trace_dir = _fresh_dir(
             os.environ.get("EXP_TRACE_DIR", "/tmp/r4_trace"))
+        trace_steps = 3
         with jax.profiler.trace(trace_dir):
-            for i in range(3):
+            for i in range(trace_steps):
                 params, opt, state, loss = step(
                     params, opt, state, ins, labs, None, None,
                     jax.random.fold_in(rng, 200 + i))
@@ -249,9 +278,16 @@ def mode_resnet():
                                                         op_breakdown)
         for name, ms, n in op_breakdown(trace_dir)[:12]:
             _emit({"op": name[:70], "ms": round(ms, 3), "n": n})
-        for name, ms, b, gbps in memory_breakdown(trace_dir)[:12]:
+        rows = memory_breakdown(trace_dir)
+        for name, ms, b, gbps in rows[:12]:
             _emit({"op": name[:70], "ms": round(ms, 3), "bytes": b,
                    "GBps": round(gbps, 1)})
+        # roofline: XLA bytes-accessed per step over MEASURED saxpy
+        # bandwidth — both numbers from this chip, this session
+        total_b = sum(r[2] for r in rows) / trace_steps
+        _emit({"step_bytes_est": int(total_b),
+               "roofline_note": "bound_ms = step_bytes_est / hbm_gbps_"
+                                "measured; compare to step_ms above"})
 
 
 def main():
