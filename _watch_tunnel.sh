@@ -1,13 +1,10 @@
 #!/bin/bash
-# Probe the axon tunnel every 15 min; exit 0 the moment it is healthy.
-# The probe self-deadlines (os._exit) and never holds the chip while hung:
-# a hung init is waiting in the relay queue, not holding a grant.
 cd /root/repo
 for i in $(seq 1 40); do
   date -u +"probe %H:%M:%S"
   if timeout 130 python _probe.py 2>&1 | grep -q "PROBE devices"; then
-    echo "TUNNEL HEALTHY at $(date -u)"
-    exit 0
+    echo "TUNNEL HEALTHY at $(date -u) — launching campaign"
+    exec /root/repo/_campaign.sh
   fi
   sleep 780
 done

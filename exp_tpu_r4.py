@@ -181,8 +181,6 @@ def mode_lstm():
     _emit({"best": best})
     if os.environ.get("EXP_TRACE") and best:
         # trace ONE step of the best config for the per-op table
-        import jax
-
         os.environ["BENCH_LSTM_UNROLL"] = str(best["unroll"])
         os.environ["BENCH_LSTM_DTYPE"] = best["dtype"]
         trace_dir = _fresh_dir(
