@@ -16,6 +16,12 @@ W = rng.normal(size=(10, 3)).astype(np.float32)
 Y = np.eye(3, dtype=np.float32)[(X @ W).argmax(-1)]
 
 
+def train_and_score(net, epochs=30):
+    for _ in range(epochs):
+        net.fit(X, Y)
+    return net.score()
+
+
 def build_and_score(params):
     net = MultiLayerNetwork(
         NeuralNetConfiguration.Builder().seed(1)
@@ -24,9 +30,7 @@ def build_and_score(params):
         .layer(OutputLayer(lossFunction="mcxent", nOut=3,
                            activation="softmax"))
         .setInputType(InputType.feedForward(10)).build()).init()
-    for _ in range(30):
-        net.fit(X, Y)
-    return net.score()
+    return train_and_score(net)
 
 
 def main():
@@ -40,5 +44,35 @@ def main():
     print("best:", best.params, "loss:", round(best.score, 4))
 
 
+def main_declarative():
+    """Same search through the declarative network-space DSL (≡
+    arbiter-deeplearning4j :: MultiLayerSpace) — no hand-written
+    model_builder: the space compiles sampled candidates into real
+    configurations itself."""
+    from deeplearning4j_tpu.arbiter import (AdamSpace, LayerSpace,
+                                            MultiLayerSpace,
+                                            RandomSearchGenerator)
+
+    mls = (MultiLayerSpace.Builder()
+           .seed(1)
+           .updater(AdamSpace(ContinuousParameterSpace(1e-4, 1e-1,
+                                                       log=True)))
+           .weightInit("xavier")
+           .addLayer(LayerSpace(DenseLayer,
+                                nOut=IntegerParameterSpace(4, 64),
+                                activation="relu"))
+           .addLayer(LayerSpace(OutputLayer, lossFunction="mcxent",
+                                nOut=3, activation="softmax"))
+           .setInputType(InputType.feedForward(10))
+           .build())
+
+    runner = LocalOptimizationRunner(
+        RandomSearchGenerator(mls.collectLeaves(), seed=5),
+        model_builder=mls, scorer=train_and_score, maxCandidates=8)
+    best = runner.execute()
+    print("declarative best:", best.params, "loss:", round(best.score, 4))
+
+
 if __name__ == "__main__":
     main()
+    main_declarative()
