@@ -143,6 +143,7 @@ def _bench_char_lstm(batch=128, seq=128, hidden=512, steps=None, warmup=2):
     round-trip must be amortized over many steps or it dominates dt."""
     if steps is None:
         steps = int(os.environ.get("BENCH_LSTM_STEPS", "50"))
+    batch = int(os.environ.get("BENCH_LSTM_BATCH", batch))
     import jax
     import numpy as np
 

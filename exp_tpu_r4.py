@@ -155,6 +155,9 @@ def mode_lstm():
 
     from bench import _bench_char_lstm
 
+    # the sweep owns batch explicitly; an inherited env override would
+    # silently collapse all batch rows to one value
+    os.environ.pop("BENCH_LSTM_BATCH", None)
     results = []
     combos = [(b, u, dt) for b in (64, 128, 256)
               for u in (1, 4, 8, 16)       # 4 is the round-4-plan ask
