@@ -28,7 +28,8 @@ import time
 #: timeout with slack: exiting (even cleanly, via os._exit) while a compile
 #: RPC is in flight wedges the tunnel exactly like a SIGKILL — observed
 #: 2026-07-30 ~19:51 UTC when a 360 s smoke deadline fired mid-compile.
-_DEFAULT_DEADLINES = {"smoke": 900, "lstm": 2400, "resnet": 900}
+_DEFAULT_DEADLINES = {"probe": 90, "smoke": 900, "lstm": 2400,
+                      "resnet": 900}
 
 
 def _arm_deadline(mode):
@@ -55,6 +56,20 @@ def _fresh_dir(path):
 
 def _emit(obj):
     print("## " + json.dumps(obj), flush=True)
+
+
+def mode_probe():
+    """Tunnel-health check: device init + one tiny matmul. The 90 s
+    deadline fires only while WAITING for a relay grant (not holding
+    one), so bailing is safe — see BENCH.md outage log."""
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    x = jnp.ones((8, 128)) @ jnp.ones((128, 128))
+    _emit({"devices": str(devs), "matmul_ok": float(x.sum()) == 8 * 128,
+           "init_s": round(time.perf_counter() - t0, 1)})
 
 
 def mode_smoke():
@@ -300,7 +315,7 @@ def main():
     enable_compile_cache(os.path.dirname(os.path.abspath(__file__)))
     t0 = time.perf_counter()
     try:
-        {"smoke": mode_smoke, "lstm": mode_lstm,
+        {"probe": mode_probe, "smoke": mode_smoke, "lstm": mode_lstm,
          "resnet": mode_resnet}[mode]()
     except Exception as e:  # noqa: BLE001
         _emit({"mode": mode, "error": f"{type(e).__name__}: {e}"[:400]})
