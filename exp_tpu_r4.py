@@ -217,21 +217,23 @@ def _measure_hbm_gbps():
     import jax
     import jax.numpy as jnp
 
+    from jax import lax
+
     n = 64 * 1024 * 1024          # 256 MB per fp32 array, 768 MB moved
     a = jnp.ones((n,), jnp.float32)
     b = jnp.full((n,), 2.0, jnp.float32)
+    # ALL reps inside one dispatch: a host-side python loop measures the
+    # tunnel's per-call latency (~10 ms), not HBM — observed 67.9 "GB/s"
+    # for an op whose own XStat rate is ~800 GB/s
+    reps = 100                     # ~95 ms device time >> ~10 ms tunnel RTT
 
     @jax.jit
-    def saxpy(a, b):
-        return a * 1.5 + b
+    def sweep(a, b):
+        return lax.fori_loop(0, reps, lambda i, x: x * 1.5 + b, a)
 
-    out = saxpy(a, b)
-    float(out[0])                  # compile + first run
+    float(sweep(a, b)[0])          # compile + first run
     t0 = time.perf_counter()
-    reps = 10
-    for _ in range(reps):
-        out = saxpy(out, b)
-    float(out[0])                  # transfer-sync closes the chain
+    float(sweep(a, b)[0])
     dt = (time.perf_counter() - t0) / reps
     return 3 * 4 * n / dt / 1e9
 
