@@ -102,6 +102,11 @@ def _bench_zoo_model(model_cls, batch, steps, warmup, input_hw=224,
                     "pct_of_hbm_bound": round(bound_ms / (dt * 1e3) * 100,
                                               1),
                 })
+            else:
+                # keep the artifact self-describing: absent fields must
+                # be distinguishable from a never-attempted roofline
+                roofline_out["roofline_error"] = \
+                    "cost_analysis had no 'bytes accessed'"
         except Exception as e:  # noqa: BLE001 — cost analysis is
             # best-effort; never let it take down the measurement
             roofline_out["roofline_error"] = str(e)[:160]
@@ -266,7 +271,7 @@ def child_main():
         os.environ["DL4J_TPU_FUSE_CONV_BN"] = "0"
         fused = f"fallback-unfused: {str(e)[:120]}"
         img_s, dt, compile_s, final_loss = _bench_zoo_model(
-            ResNet50, batch, steps, warmup)
+            ResNet50, batch, steps, warmup, roofline_out=roofline)
     # MFU accounting: ResNet-50 fwd+bwd ≈ 3 × 4.1 GFLOP/img = 12.3 GFLOP/img;
     # v5e peak 197 TFLOP/s bf16
     mfu = img_s * 12.3e9 / 197e12 * 100
