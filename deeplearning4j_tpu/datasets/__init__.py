@@ -1,7 +1,8 @@
 from deeplearning4j_tpu.datasets.dataset import DataSet, SplitTestAndTrain
 from deeplearning4j_tpu.datasets.iterators import (
     ArrayDataSetIterator, AsyncDataSetIterator, CifarDataSetIterator,
-    ListDataSetIterator,
+    ListDataSetIterator, ListMultiDataSetIterator,
+    SingletonMultiDataSetIterator,
     DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
     MnistDataSetIterator, SyntheticImageNetIterator)
 from deeplearning4j_tpu.datasets.normalizers import (
@@ -12,7 +13,8 @@ __all__ = [
     "DataSet", "SplitTestAndTrain", "ArrayDataSetIterator", "ListDataSetIterator",
     "AsyncDataSetIterator", "CifarDataSetIterator", "DataSetIterator",
     "EmnistDataSetIterator", "IrisDataSetIterator", "MnistDataSetIterator",
-    "SyntheticImageNetIterator", "DataNormalization",
+    "SyntheticImageNetIterator", "ListMultiDataSetIterator",
+    "SingletonMultiDataSetIterator", "DataNormalization",
     "ImagePreProcessingScaler", "NormalizerMinMaxScaler",
     "NormalizerStandardize", "VGG16ImagePreProcessor",
 ]

@@ -463,3 +463,59 @@ class ListDataSetIterator(DataSetIterator):
         ds = DataSet(m.features[sl], pick(m.labels), pick(m.featuresMask),
                      pick(m.labelsMask))
         return self._maybe_preprocess(ds)
+
+
+class ListMultiDataSetIterator:
+    """MultiDataSet iterator over an in-memory list (≡ nd4j-api ::
+    dataset.api.iterator.MultiDataSetIterator implementations such as
+    IteratorMultiDataSetIterator): yields the stored MultiDataSets in
+    order without re-batching (multi-input batches cannot be merged
+    generically — input arities/shapes differ per entry)."""
+
+    def __init__(self, multidatasets):
+        self._sets = list(multidatasets)
+        self._cursor = 0
+        self._preprocessor = None
+
+    def setPreProcessor(self, pp):
+        self._preprocessor = pp
+
+    def reset(self):
+        self._cursor = 0
+
+    def resetSupported(self):
+        return True
+
+    def asyncSupported(self):
+        return False
+
+    def hasNext(self):
+        return self._cursor < len(self._sets)
+
+    def next(self):
+        if not self.hasNext():
+            raise StopIteration
+        mds = self._sets[self._cursor]
+        self._cursor += 1
+        if self._preprocessor is not None:
+            # preprocessors mutate in place (DataNormalization convention);
+            # hand them a fresh shell so the stored sets never accumulate
+            # repeated normalization across epochs
+            from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+            mds = MultiDataSet(mds.features, mds.labels, mds.featuresMasks,
+                               mds.labelsMasks)
+            self._preprocessor.preProcess(mds)
+        return mds
+
+    def __iter__(self):
+        self.reset()
+        while self.hasNext():
+            yield self.next()
+
+
+class SingletonMultiDataSetIterator(ListMultiDataSetIterator):
+    """≡ nd4j :: SingletonMultiDataSetIterator — iterates exactly one
+    MultiDataSet."""
+
+    def __init__(self, mds):
+        super().__init__([mds])

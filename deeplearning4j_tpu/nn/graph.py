@@ -417,7 +417,10 @@ class ComputationGraph:
         raise TypeError(f"Cannot fit on {type(ds)}")
 
     def _fit_batch(self, ds):
-        ins, labels, fmasks, lmasks = self._unpack(ds)
+        self._fit_unpacked(self._unpack(ds))
+
+    def _fit_unpacked(self, unpacked):
+        ins, labels, fmasks, lmasks = unpacked
         self._rng_key, sub = jax.random.split(self._rng_key)
         self._params, self._opt_state, self._state, loss = self._train_step(
             self._params, self._opt_state, self._state, ins, labels, fmasks,
@@ -427,7 +430,67 @@ class ComputationGraph:
         for listener in self._listeners:
             listener.iterationDone(self, self._iteration, self._epoch)
 
-    def fit(self, data, labels=None, epochs=None):
+    @functools.cached_property
+    def _train_scan(self):
+        """K graph train steps in ONE lax.scan dispatch (see
+        MultiLayerNetwork._train_scan for the rationale): the scan body is
+        the same update as _train_step over stacked input/label/mask
+        pytrees, so k scanned steps == k sequential steps exactly."""
+        tx = self._tx
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def scan_steps(params, opt_state, state, ins, labels, fmasks,
+                       lmasks, rngs):
+            def body(carry, inp):
+                p, o, s = carry
+                i_, l_, fm, lm, rng = inp
+                (loss, ns), grads = jax.value_and_grad(
+                    lambda pp: self._loss(pp, s, i_, l_, fm, lm, rng),
+                    has_aux=True)(p)
+                updates, o = tx.update(grads, o, p)
+                p = optax.apply_updates(p, updates)
+                p = self._apply_constraints(p)
+                return (p, o, ns), loss
+
+            (params, opt_state, state), losses = jax.lax.scan(
+                body, (params, opt_state, state),
+                (ins, labels, fmasks, lmasks, rngs))
+            return params, opt_state, state, losses
+
+        return scan_steps
+
+    def _fit_batches_scanned(self, unpacked):
+        """Flush a group of already-unpacked same-structure batches. Only
+        full groups go through the scan — sub-k remainders run singly so
+        lax.scan is traced for exactly ONE length per batch shape (each
+        distinct scan length is a fresh compile)."""
+        subs = []
+        for _ in unpacked:  # identical key stream to sequential _fit_batch
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            subs.append(sub)
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                         *unpacked)
+        ins, labels, fmasks, lmasks = stacked
+        (self._params, self._opt_state, self._state,
+         losses) = self._train_scan(self._params, self._opt_state,
+                                    self._state, ins, labels, fmasks,
+                                    lmasks, jnp.stack(subs))
+        for loss in jax.device_get(losses):
+            self._score = float(loss)
+            self._iteration += 1
+            for listener in self._listeners:
+                listener.iterationDone(self, self._iteration, self._epoch)
+
+    @staticmethod
+    def _batch_sig(unpacked_or_ds):
+        leaves, treedef = jax.tree_util.tree_flatten(unpacked_or_ds)
+        return (str(treedef), tuple(jnp.shape(x) for x in leaves))
+
+    def fit(self, data, labels=None, epochs=None, stepsPerDispatch=1):
+        """stepsPerDispatch > 1 (iterator form): group consecutive
+        same-structure batches into one scanned dispatch — numerically
+        identical to the sequential loop (tested); ragged/odd batches
+        flush the group early and run singly."""
         if self._params is None:
             self.init()
         if labels is not None:
@@ -436,12 +499,33 @@ class ComputationGraph:
         if isinstance(data, (DataSet, MultiDataSet)):
             self._fit_batch(data)
             return self
+        k = max(1, int(stepsPerDispatch))
         n_epochs = int(epochs) if epochs is not None else 1
+
+        def flush(group):
+            if len(group) == k:
+                self._fit_batches_scanned(group)
+            else:        # sub-k remainder: avoid a fresh per-length trace
+                for unpacked in group:
+                    self._fit_unpacked(unpacked)
+
         for _ in range(n_epochs):
             if hasattr(data, "reset"):
                 data.reset()
+            group, group_sig = [], None
             for ds in data:
-                self._fit_batch(ds)
+                if k == 1:
+                    self._fit_batch(ds)
+                    continue
+                unpacked = self._unpack(ds)
+                sig = self._batch_sig(unpacked)
+                if group and (sig != group_sig or len(group) >= k):
+                    flush(group)
+                    group = []
+                group_sig = sig
+                group.append(unpacked)
+            if group:
+                flush(group)
             self._epoch += 1
             for listener in self._listeners:
                 if hasattr(listener, "onEpochEnd"):

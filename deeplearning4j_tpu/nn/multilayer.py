@@ -400,6 +400,71 @@ class MultiLayerNetwork:
         return step
 
     @functools.cached_property
+    def _train_scan(self):
+        """K train steps in ONE dispatch: lax.scan over stacked batches.
+
+        TPU-first replacement for the reference's per-batch fit loop
+        (MultiLayerNetwork.fit → one Solver step per DataSet): on a
+        tunnelled/remote chip each dispatch costs ~10 ms of host round-trip,
+        which dominates sub-20 ms steps (measured, BENCH.md round 4). The
+        scan body is the SAME update as _train_step, consuming one stacked
+        batch slice and one pre-split rng per iteration, so k scanned steps
+        are bit-identical to k sequential _train_step calls."""
+        tx = self._tx
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def scan_steps(params, opt_state, state, xs, ys, fmasks, lmasks,
+                       rngs):
+            def body(carry, inp):
+                p, o, s = carry
+                x, y, fm, lm, rng = inp
+                (loss, (ns, _)), grads = jax.value_and_grad(
+                    lambda pp: self._loss(pp, s, x, y, fm, lm, rng),
+                    has_aux=True)(p)
+                updates, o = tx.update(grads, o, p)
+                p = optax.apply_updates(p, updates)
+                p = self._apply_constraints(p)
+                return (p, o, ns), loss
+
+            (params, opt_state, state), losses = jax.lax.scan(
+                body, (params, opt_state, state),
+                (xs, ys, fmasks, lmasks, rngs))
+            return params, opt_state, state, losses
+
+        return scan_steps
+
+    def _fit_batches_scanned(self, group):
+        """Flush a same-shape batch group through ONE scanned dispatch.
+        Callers only send FULL groups here (sub-k remainders run singly)
+        so lax.scan is traced for exactly one length per batch shape."""
+        subs = []
+        for _ in group:   # identical key stream to sequential _fit_batch
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            subs.append(sub)
+        xs = jnp.stack([jnp.asarray(f) for f, _, _, _ in group])
+        ys = jnp.stack([jnp.asarray(l) for _, l, _, _ in group])
+        lms = (None if group[0][2] is None
+               else jnp.stack([jnp.asarray(m) for _, _, m, _ in group]))
+        fms = (None if group[0][3] is None
+               else jnp.stack([jnp.asarray(m) for _, _, _, m in group]))
+        (self._params, self._opt_state, self._state,
+         losses) = self._train_scan(self._params, self._opt_state,
+                                    self._state, xs, ys, fms, lms,
+                                    jnp.stack(subs))
+        for loss in jax.device_get(losses):
+            self._score = float(loss)
+            self._iteration += 1
+            for listener in self._listeners:
+                listener.iterationDone(self, self._iteration, self._epoch)
+
+    @staticmethod
+    def _batch_sig(ds):
+        def sig(a):
+            return None if a is None else tuple(np.shape(a))
+        return (sig(ds.features), sig(ds.labels), sig(ds.labelsMask),
+                sig(ds.featuresMask))
+
+    @functools.cached_property
     def _train_step_tbptt(self):
         """TBPTT segment step: gradients truncate at segment boundaries,
         hidden state (carries) threads across segments
@@ -519,7 +584,13 @@ class MultiLayerNetwork:
             self.pretrainLayer(i, data, epochs)
         return self
 
-    def fit(self, data, labels=None, epochs=None):
+    def fit(self, data, labels=None, epochs=None, stepsPerDispatch=1):
+        """stepsPerDispatch > 1 (iterator form only): group consecutive
+        same-shape batches and run each group as ONE lax.scan dispatch —
+        numerically identical to the sequential loop (tested), but pays
+        the host→device round-trip once per group instead of per batch.
+        Groups flush early on a shape change, so ragged tails stay exact.
+        TBPTT configs ignore it (the segment loop owns the dispatch)."""
         if self._params is None:
             self.init()
         if labels is not None:  # fit(features, labels)
@@ -530,13 +601,37 @@ class MultiLayerNetwork:
                             data.featuresMask)
             return self
         # iterator
+        from deeplearning4j_tpu.nn.conf.builders import BackpropType
+        k = max(1, int(stepsPerDispatch))
+        if self.conf.backprop_type == BackpropType.TruncatedBPTT:
+            k = 1
         n_epochs = int(epochs) if epochs is not None else 1
+
+        def flush(group):
+            if len(group) == k:
+                self._fit_batches_scanned(group)
+            else:        # sub-k remainder: avoid a fresh per-length trace
+                for f, l, lm, fm in group:
+                    self._fit_batch(f, l, lm, fm)
+
         for _ in range(n_epochs):
             if hasattr(data, "reset"):
                 data.reset()
+            group, group_sig = [], None
             for ds in data:
-                self._fit_batch(ds.features, ds.labels, ds.labelsMask,
-                                ds.featuresMask)
+                if k == 1:
+                    self._fit_batch(ds.features, ds.labels, ds.labelsMask,
+                                    ds.featuresMask)
+                    continue
+                sig = self._batch_sig(ds)
+                if group and (sig != group_sig or len(group) >= k):
+                    flush(group)
+                    group = []
+                group_sig = sig
+                group.append((ds.features, ds.labels, ds.labelsMask,
+                              ds.featuresMask))
+            if group:
+                flush(group)
             self._epoch += 1
             for listener in self._listeners:
                 if hasattr(listener, "onEpochEnd"):

@@ -138,3 +138,31 @@ class TestListDataSetIterator:
                      np.zeros((8, 3), np.float32))
         it = ListDataSetIterator([ds])
         assert it.next().numExamples() == 8
+
+
+def test_list_multidataset_iterator_preprocessor_no_mutation():
+    """A preprocessor set on ListMultiDataSetIterator must not mutate the
+    stored MultiDataSets (else multi-epoch fit re-normalizes cumulatively)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.datasets.iterators import (
+        ListMultiDataSetIterator, SingletonMultiDataSetIterator)
+
+    x = np.full((4, 3), 10.0, np.float32)
+    y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+    mds = MultiDataSet([x], [y])
+    it = ListMultiDataSetIterator([mds])
+
+    class Halve:
+        def preProcess(self, m):
+            m.features = [f * 0.5 for f in m.features]
+
+    it.setPreProcessor(Halve())
+    for _ in range(3):          # three epochs
+        got = [m for m in it]
+        np.testing.assert_allclose(got[0].features[0], 5.0)  # halved ONCE
+    np.testing.assert_allclose(mds.features[0], 10.0)        # untouched
+
+    single = SingletonMultiDataSetIterator(mds)
+    assert [m for m in single][0] is mds     # no preprocessor: passthrough

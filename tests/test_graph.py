@@ -222,3 +222,31 @@ def test_graph_rnn_time_step_refuses_bidirectional():
     import pytest as _pytest
     with _pytest.raises(ValueError, match="step-by-step"):
         net.rnnTimeStep(x)
+
+
+def test_graph_steps_per_dispatch_matches_sequential():
+    """fit(it, stepsPerDispatch=k) on a two-input graph == sequential fit:
+    same rng stream, same update order, exact params."""
+    from deeplearning4j_tpu.datasets.iterators import \
+        ListMultiDataSetIterator
+
+    rng = np.random.default_rng(9)
+
+    def mk(b):
+        a = rng.standard_normal((b, 4)).astype(np.float32)
+        c = rng.standard_normal((b, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(3, size=b)]
+        return MultiDataSet([a, c], [y])
+
+    sets = [mk(8) for _ in range(5)] + [mk(3)]       # ragged tail
+
+    seq, scan = _two_tower(), _two_tower()
+    for ds in sets:
+        seq.fit(ds)
+    scan.fit(ListMultiDataSetIterator(sets), stepsPerDispatch=4)
+    assert scan._iteration == 6
+    for k in seq._params:
+        for n, v in seq._params[k].items():
+            np.testing.assert_allclose(
+                np.asarray(v), np.asarray(scan._params[k][n]),
+                rtol=0, atol=1e-6, err_msg=f"{k}/{n}")

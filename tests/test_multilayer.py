@@ -290,3 +290,106 @@ def test_bf16_momentum_tracks_fp32_momentum():
     # decreasing
     assert l16[-1] < l16[0] and l32[-1] < l32[0]
     assert abs(l16[-1] - l32[-1]) < 0.05 * max(abs(l32[-1]), 0.1)
+
+
+def test_steps_per_dispatch_matches_sequential_fit():
+    """fit(it, stepsPerDispatch=k) == plain fit(it): the scanned dispatch
+    consumes the same rng subkey stream and applies the same update order,
+    so params, score history, and iteration counts must match exactly."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, Adam,
+                                       OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+
+    rng = np.random.default_rng(3)
+    sets = [DataSet(rng.normal(size=(16, 6)).astype(np.float32),
+                    np.eye(3, dtype=np.float32)[rng.integers(3, size=16)])
+            for _ in range(6)]
+
+    def build():
+        return MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(11).updater(Adam(1e-2))
+            .weightInit("xavier").list()
+            .layer(DenseLayer(nOut=24, activation="tanh"))
+            .layer(OutputLayer(nOut=3, activation="softmax",
+                               lossFunction="mcxent"))
+            .setInputType(InputType.feedForward(6)).build()).init()
+
+    seq, scan = build(), build()
+    seq_scores, scan_scores = [], []
+    seq.setListeners(ScoreIterationListener(1))
+    seq.fit(ListDataSetIterator(sets, 16), epochs=2)
+    # re-walk sequentially recording scores for comparison
+    seq2 = build()
+    it = ListDataSetIterator(sets, 16)
+    for _ in range(2):
+        it.reset()
+        for ds in it:
+            seq2.fit(ds)
+            seq_scores.append(seq2.score())
+
+    class Rec:
+        def iterationDone(self, net, iteration, epoch):
+            scan_scores.append(net.score())
+
+    scan.setListeners(Rec())
+    scan.fit(ListDataSetIterator(sets, 16), epochs=2, stepsPerDispatch=4)
+
+    import jax
+    for k in seq._params:
+        for n, v in seq._params[k].items():
+            np.testing.assert_allclose(
+                np.asarray(v), np.asarray(scan._params[k][n]),
+                rtol=0, atol=1e-6, err_msg=f"{k}/{n}")
+    assert scan._iteration == 12          # 6 batches x 2 epochs
+    assert len(scan_scores) == 12
+    np.testing.assert_allclose(scan_scores, seq_scores, rtol=1e-5, atol=1e-6)
+
+
+def test_steps_per_dispatch_ragged_tail_and_masks():
+    """Shape changes flush the group early: a ragged final batch and
+    mask-carrying sequence data must train identically to sequential."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn import (InputType, NeuralNetConfiguration,
+                                       RmsProp)
+    from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.default_rng(7)
+
+    def mkset(b):
+        x = rng.normal(size=(b, 5, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(2, size=(b, 5))]
+        lm = (rng.random((b, 5)) > 0.3).astype(np.float32)
+        return DataSet(x, y, featuresMask=lm, labelsMask=lm)
+
+    sets = [mkset(8), mkset(8), mkset(8), mkset(3)]   # ragged tail
+
+    def build():
+        return MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(2).updater(RmsProp(1e-2))
+            .weightInit("xavier").list()
+            .layer(LSTM(nOut=8, activation="tanh"))
+            .layer(RnnOutputLayer(nOut=2, activation="softmax",
+                                  lossFunction="mcxent"))
+            .setInputType(InputType.recurrent(4, 5)).build()).init()
+
+    seq, scan = build(), build()
+    it = ListDataSetIterator(sets, 8)
+    for ds in it:
+        seq.fit(ds)
+    scan.fit(ListDataSetIterator(sets, 8), stepsPerDispatch=3)
+    for k in seq._params:
+        for n, v in seq._params[k].items():
+            np.testing.assert_allclose(
+                np.asarray(v), np.asarray(scan._params[k][n]),
+                rtol=0, atol=1e-6, err_msg=f"{k}/{n}")
+    assert scan._iteration == 4
