@@ -29,7 +29,7 @@ import time
 #: RPC is in flight wedges the tunnel exactly like a SIGKILL — observed
 #: 2026-07-30 ~19:51 UTC when a 360 s smoke deadline fired mid-compile.
 _DEFAULT_DEADLINES = {"probe": 90, "smoke": 900, "lstm": 2400,
-                      "resnet": 900}
+                      "resnet": 900, "spd": 900, "longseq": 1200}
 
 
 def _arm_deadline(mode):
@@ -308,6 +308,101 @@ def mode_resnet():
                                 "measured; compare to step_ms above"})
 
 
+def mode_spd():
+    """stepsPerDispatch A/B on the real chip: per-batch wall time of
+    fit(iterator) vs fit(iterator, stepsPerDispatch=8) for a small-step
+    model (LeNet b256 — the dispatch-latency-bound bench row)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.models.zoo import LeNet
+    from deeplearning4j_tpu.nn.updaters import Nesterovs
+
+    rng = np.random.default_rng(0)
+    n_batches, b = 32, 256
+    sets = [DataSet(rng.random((b, 28, 28, 1), dtype=np.float32),
+                    np.eye(10, dtype=np.float32)[
+                        rng.integers(10, size=b)])
+            for _ in range(n_batches)]
+
+    for k in (1, 8):
+        model = LeNet(numClasses=10, dataType="bfloat16",
+                      inputShape=(28, 28, 1), updater=Nesterovs(0.01, 0.9))
+        net = model.init()
+        it = ListDataSetIterator(sets, b)
+        t0 = time.perf_counter()
+        net.fit(it, stepsPerDispatch=k)          # includes compile
+        compile_epoch_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        net.fit(it, epochs=2, stepsPerDispatch=k)
+        dt = (time.perf_counter() - t0) / (2 * n_batches)
+        _emit({"stepsPerDispatch": k, "ms_per_batch": round(dt * 1e3, 2),
+               "img_s": round(b / dt, 0),
+               "first_epoch_s": round(compile_epoch_s, 1)})
+
+
+def mode_longseq():
+    """Long-context attention on chip: masked Pallas flash vs dense at
+    growing sequence length (the seq-parallel/ring story's single-chip
+    leg). Dense is expected to OOM/blow up first; flash should scale."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.kernels import flash_attention
+
+    b, h, d = 4, 8, 64
+    for seq in (2048, 4096, 8192, 16384):
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(seq), 3)
+        q = jax.random.normal(kq, (b, h, seq, d), jnp.bfloat16)
+        k = jax.random.normal(kk, (b, h, seq, d), jnp.bfloat16)
+        v = jax.random.normal(kv, (b, h, seq, d), jnp.bfloat16)
+        mask = (jnp.arange(seq)[None, :]
+                < jnp.asarray([seq] * (b - 1) + [seq // 2])[:, None]
+                ).astype(jnp.int32)
+        row = {"seq": seq}
+
+        def timed(fn, *args):
+            def loss(*a):
+                return jnp.sum(fn(*a).astype(jnp.float32) ** 2)
+            g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            t0 = time.perf_counter()
+            out = g(*args)
+            jax.block_until_ready(out)
+            compile_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            reps = 5
+            for _ in range(reps):
+                out = g(*args)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / reps * 1e3, compile_s
+
+        try:
+            ms, cs = timed(
+                lambda q, k, v: flash_attention(q, k, v, mask=mask), q, k, v)
+            row["flash_fwdbwd_ms"] = round(ms, 1)
+            row["flash_compile_s"] = round(cs, 1)
+        except Exception as e:  # noqa: BLE001
+            row["flash_error"] = str(e)[:120]
+
+        def dense(q, k, v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                           k.astype(jnp.float32)) / (d ** 0.5)
+            s = jnp.where(mask[:, None, None, :] > 0, s, -1e30)
+            return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1),
+                              v.astype(jnp.float32))
+
+        if seq <= 8192:
+            try:
+                ms, cs = timed(dense, q, k, v)
+                row["dense_fwdbwd_ms"] = round(ms, 1)
+            except Exception as e:  # noqa: BLE001
+                row["dense_error"] = str(e)[:120]
+        else:
+            row["dense_skipped"] = "O(seq^2) scores would exceed HBM"
+        _emit(row)
+
+
 def main():
     mode = sys.argv[1] if len(sys.argv) > 1 else "smoke"
     _arm_deadline(mode)
@@ -318,7 +413,8 @@ def main():
     t0 = time.perf_counter()
     try:
         {"probe": mode_probe, "smoke": mode_smoke, "lstm": mode_lstm,
-         "resnet": mode_resnet}[mode]()
+         "resnet": mode_resnet, "spd": mode_spd,
+         "longseq": mode_longseq}[mode]()
     except Exception as e:  # noqa: BLE001
         _emit({"mode": mode, "error": f"{type(e).__name__}: {e}"[:400]})
         os._exit(1)
