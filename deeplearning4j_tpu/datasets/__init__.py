@@ -4,7 +4,8 @@ from deeplearning4j_tpu.datasets.iterators import (
     ListDataSetIterator, ListMultiDataSetIterator,
     SingletonMultiDataSetIterator,
     DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
-    MnistDataSetIterator, SyntheticImageNetIterator)
+    MnistDataSetIterator, SvhnDataSetIterator, SyntheticImageNetIterator,
+    TinyImageNetDataSetIterator, UciSequenceDataSetIterator)
 from deeplearning4j_tpu.datasets.normalizers import (
     DataNormalization, ImagePreProcessingScaler, NormalizerMinMaxScaler,
     NormalizerStandardize, VGG16ImagePreProcessor)
@@ -13,7 +14,9 @@ __all__ = [
     "DataSet", "SplitTestAndTrain", "ArrayDataSetIterator", "ListDataSetIterator",
     "AsyncDataSetIterator", "CifarDataSetIterator", "DataSetIterator",
     "EmnistDataSetIterator", "IrisDataSetIterator", "MnistDataSetIterator",
-    "SyntheticImageNetIterator", "ListMultiDataSetIterator",
+    "SyntheticImageNetIterator", "SvhnDataSetIterator",
+    "TinyImageNetDataSetIterator", "UciSequenceDataSetIterator",
+    "ListMultiDataSetIterator",
     "SingletonMultiDataSetIterator", "DataNormalization",
     "ImagePreProcessingScaler", "NormalizerMinMaxScaler",
     "NormalizerStandardize", "VGG16ImagePreProcessor",

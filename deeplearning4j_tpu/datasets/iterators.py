@@ -519,3 +519,91 @@ class SingletonMultiDataSetIterator(ListMultiDataSetIterator):
 
     def __init__(self, mds):
         super().__init__([mds])
+
+
+class SvhnDataSetIterator(CifarDataSetIterator):
+    """≡ deeplearning4j-datasets :: SvhnDataSetIterator — Street View
+    House Numbers, (B, 32, 32, 3) NHWC in [0,1], 10 classes. Zero-egress
+    environment: parses nothing from disk (the reference downloads .mat
+    files); deterministic synthetic data with the SVHN shape/classes."""
+
+    def __init__(self, batch_size, train=True, seed=321, num_examples=None):
+        DataSetIterator.__init__(self, batch_size)
+        n = num_examples or (4000 if train else 1000)
+        self._images, self._labels = _synthetic_images(
+            n, self.H, self.W, 3, self.NUM_CLASSES,
+            seed if train else seed + 1)
+
+
+class TinyImageNetDataSetIterator(CifarDataSetIterator):
+    """≡ deeplearning4j-datasets :: TinyImageNetDataSetIterator —
+    (B, 64, 64, 3) NHWC in [0,1], 200 classes; synthetic under zero
+    egress. Shares CifarDataSetIterator's batch/next machinery (only the
+    shape constants and the synthetic source differ)."""
+
+    H = W = 64
+    NUM_CLASSES = 200
+
+    def __init__(self, batch_size, train=True, seed=777, num_examples=None):
+        DataSetIterator.__init__(self, batch_size)
+        n = num_examples or (2000 if train else 500)
+        self._images, self._labels = _synthetic_images(
+            n, self.H, self.W, 3, self.NUM_CLASSES,
+            seed if train else seed + 1)
+
+
+class UciSequenceDataSetIterator(DataSetIterator):
+    """≡ deeplearning4j-datasets :: UciSequenceDataSetIterator — the UCI
+    synthetic-control time-series classification set: 600 univariate
+    sequences of length 60, 6 classes. The real set IS synthetic
+    (Alcock & Manolopoulos generators); we generate the same six pattern
+    families deterministically (normal / cyclic / increasing / decreasing
+    / upward-shift / downward-shift), so training behaves like the
+    reference's. Yields (B, 60, 1) features + one-hot labels."""
+
+    SEQ_LEN = 60
+    NUM_CLASSES = 6
+
+    def __init__(self, batch_size, train=True, seed=1066):
+        super().__init__(batch_size)
+        rng = np.random.default_rng(seed if train else seed + 1)
+        per = 80 if train else 20
+        xs, ys = [], []
+        t = np.arange(self.SEQ_LEN, dtype=np.float32)
+        for cls in range(self.NUM_CLASSES):
+            for _ in range(per):
+                base = 30.0 + 2.0 * rng.standard_normal(self.SEQ_LEN).astype(np.float32)
+                if cls == 1:    # cyclic
+                    amp, period = rng.uniform(10, 15), rng.uniform(10, 15)
+                    base += amp * np.sin(2 * np.pi * t / period)
+                elif cls == 2:  # increasing trend
+                    base += rng.uniform(0.2, 0.5) * t
+                elif cls == 3:  # decreasing trend
+                    base -= rng.uniform(0.2, 0.5) * t
+                elif cls in (4, 5):  # up/down shift at a random time
+                    at = rng.integers(self.SEQ_LEN // 3, 2 * self.SEQ_LEN // 3)
+                    shift = rng.uniform(7.5, 20)
+                    base[at:] += shift if cls == 4 else -shift
+                xs.append(base)
+                ys.append(cls)
+        order = rng.permutation(len(xs))
+        self._x = np.stack(xs)[order][:, :, None].astype(np.float32)
+        self._y = np.asarray(ys)[order]
+
+    def numExamples(self):
+        return len(self._x)
+
+    def totalOutcomes(self):
+        return self.NUM_CLASSES
+
+    def inputColumns(self):
+        return 1
+
+    def next(self, num=None):
+        self._check_has_next()
+        n = num or self._batch
+        x = self._x[self._cursor:self._cursor + n]
+        y = self._y[self._cursor:self._cursor + n]
+        self._cursor += len(x)
+        return self._maybe_preprocess(
+            DataSet(x, _one_hot(y, self.NUM_CLASSES)))

@@ -198,6 +198,9 @@ def _convert_layer(class_name, cfg, is_last=False):
             activation=act, weightInit=dw_init, hasBias=bias)
     if class_name == "Cropping2D":
         return Cropping2D(cropping=cfg.get("cropping", ((0, 0), (0, 0))))
+    if class_name == "UpSampling1D":
+        from deeplearning4j_tpu.nn.conf.layers import Upsampling1D
+        return Upsampling1D(size=int(cfg.get("size", 2)))
     if class_name == "TimeDistributed":
         # our Dense/Output layers already broadcast over (B, T, F); unwrap
         # the inner layer (≡ KerasTimeDistributed flattening to the wrapped

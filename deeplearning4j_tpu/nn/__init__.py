@@ -11,7 +11,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     EmbeddingLayer, EmbeddingSequenceLayer,
     GlobalPoolingLayer, LossLayer, OutputLayer, PReLULayer,
     SeparableConvolution2D, Subsampling1DLayer, SubsamplingLayer,
-    Upsampling2D, ZeroPaddingLayer)
+    TimeDistributed, Upsampling1D, Upsampling2D, ZeroPaddingLayer)
 from deeplearning4j_tpu.nn.conf.special_layers import (
     CenterLossOutputLayer, LocallyConnected2D, VariationalAutoencoder)
 from deeplearning4j_tpu.nn.constraints import (MaxNormConstraint,
@@ -36,7 +36,8 @@ __all__ = [
     "DepthwiseConvolution2D", "DropoutLayer", "EmbeddingLayer",
     "EmbeddingSequenceLayer", "GlobalPoolingLayer", "LossLayer",
     "OutputLayer", "PReLULayer", "SeparableConvolution2D",
-    "Subsampling1DLayer", "SubsamplingLayer", "Upsampling2D",
+    "Subsampling1DLayer", "SubsamplingLayer", "TimeDistributed",
+    "Upsampling1D", "Upsampling2D",
     "ZeroPaddingLayer", "CenterLossOutputLayer", "LocallyConnected2D",
     "VariationalAutoencoder", "LossBinaryXENT", "LossMCXENT", "LossMSE",
     "LossNegativeLogLikelihood",

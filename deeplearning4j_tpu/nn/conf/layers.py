@@ -1081,6 +1081,85 @@ class Convolution1DLayer(Layer):
         return get_activation(self.activation)(y), state
 
 
+class Upsampling1D(Layer):
+    """≡ conf.layers.Upsampling1D — nearest-neighbour repeat along time,
+    (B, T, F) convention like the other 1D layers here."""
+
+    def __init__(self, size=2, **kw):
+        super().__init__(**kw)
+        self.size = int(size if not isinstance(size, (list, tuple))
+                        else size[0])
+
+    def output_type(self, input_type):
+        t = input_type.timeSeriesLength
+        return InputType.recurrent(input_type.size,
+                                   None if t is None else t * self.size)
+
+    def feed_forward_mask(self, mask):
+        return None if mask is None else jnp.repeat(mask, self.size, axis=1)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        return jnp.repeat(x, self.size, axis=1), state
+
+
+class TimeDistributed(Layer):
+    """≡ conf.layers.recurrent.TimeDistributed — applies a feed-forward
+    layer independently at every timestep of (B, T, F) input by folding
+    time into the batch (the reference reshapes NCW↔NW the same way; no
+    per-step python loop, one big batched op for the MXU)."""
+
+    @classmethod
+    def _builder_positional(cls, args):
+        return {"underlying": args[0]} if args else {}
+
+    def __init__(self, underlying=None, **kw):
+        super().__init__(**kw)
+        if underlying is None:
+            raise ValueError("TimeDistributed needs an underlying layer")
+        self.underlying = underlying
+
+    def apply_defaults(self, defaults):
+        # dropout is applied ONCE, by the inner layer (same elements either
+        # side of the time fold); forward an explicitly-set wrapper dropOut
+        if self.dropOut is not None and self.underlying.dropOut is None:
+            self.underlying.dropOut = self.dropOut
+        self.underlying.apply_defaults(defaults)
+        out = super().apply_defaults(defaults)
+        # the network reads training knobs from the OUTER layer while the
+        # params belong to the inner one — mirror every per-layer hook the
+        # two network classes consult, or the wrapped layer's configured
+        # l1/l2/weight-noise/frozen/constraints silently stop applying
+        u = self.underlying
+        if self.constraints is None:
+            self.constraints = getattr(u, "constraints", None)
+        if getattr(self, "weightNoise", None) is None:
+            self.weightNoise = getattr(u, "weightNoise", None)
+        if getattr(u, "frozen_params", False):
+            self.frozen_params = True
+        return out
+
+    def regularization_terms(self):
+        return self.underlying.regularization_terms()
+
+    def output_type(self, input_type):
+        inner = self.underlying.output_type(
+            InputType.feedForward(input_type.size))
+        return InputType.recurrent(inner.size, input_type.timeSeriesLength)
+
+    def initialize(self, key, input_type):
+        params, state, inner_out = self.underlying.initialize(
+            key, InputType.feedForward(input_type.size))
+        return params, state, InputType.recurrent(
+            inner_out.size, input_type.timeSeriesLength)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        b, t = x.shape[0], x.shape[1]
+        y, state = self.underlying.apply(
+            params, state, x.reshape((b * t,) + x.shape[2:]), train=train,
+            rng=rng)
+        return y.reshape((b, t) + y.shape[1:]), state
+
+
 class Subsampling1DLayer(Layer):
     """≡ conf.layers.Subsampling1DLayer — (B, T, F) pooling."""
 

@@ -393,3 +393,56 @@ def test_steps_per_dispatch_ragged_tail_and_masks():
                 np.asarray(v), np.asarray(scan._params[k][n]),
                 rtol=0, atol=1e-6, err_msg=f"{k}/{n}")
     assert scan._iteration == 4
+
+
+def test_upsampling1d_and_time_distributed():
+    """Upsampling1D repeats timesteps (mask too); TimeDistributed applies
+    a Dense layer per step == manual loop oracle, and trains."""
+    import numpy as np
+
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, Adam,
+                                       TimeDistributed, Upsampling1D)
+    from deeplearning4j_tpu.nn.conf.recurrent import RnnOutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    net = MultiLayerNetwork(
+        NeuralNetConfiguration.Builder().seed(4).updater(Adam(1e-2))
+        .weightInit("xavier").list()
+        .layer(Upsampling1D(size=2))
+        .layer(TimeDistributed(DenseLayer(nOut=6, activation="tanh")))
+        .layer(RnnOutputLayer(nOut=2, activation="softmax",
+                              lossFunction="mcxent"))
+        .setInputType(InputType.recurrent(3, 4)).build()).init()
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(5, 4, 3)).astype(np.float32)
+    out = net.output(x).numpy()
+    assert out.shape == (5, 8, 2)          # time 4 -> 8
+
+    # oracle: upsample then per-step dense with the initialized weights
+    w = np.asarray(net._params["1"]["W"])
+    b = np.asarray(net._params["1"]["b"])
+    up = np.repeat(x, 2, axis=1)
+    hid = np.tanh(up @ w + b)
+    np.testing.assert_allclose(
+        np.asarray(net.activateSelectedLayers(0, 1, x).numpy()), hid,
+        rtol=2e-5, atol=2e-5)
+
+    y = np.eye(2, dtype=np.float32)[rng.integers(2, size=(5, 8))]
+    s0 = None
+    for _ in range(30):
+        net.fit(x, y)
+        s0 = s0 or net.score()
+    assert net.score() < s0
+
+
+def test_time_distributed_delegates_regularization():
+    """l2 on the wrapped layer must reach the penalty (review r4 finding):
+    the network reads terms from the wrapper while params are the inner
+    layer's."""
+    from deeplearning4j_tpu.nn import DenseLayer, TimeDistributed
+
+    td = TimeDistributed(DenseLayer(nOut=4, l2=0.5))
+    td.apply_defaults({})
+    assert td.regularization_terms() == (0.0, 0.5)
