@@ -29,7 +29,7 @@ import time
 #: RPC is in flight wedges the tunnel exactly like a SIGKILL — observed
 #: 2026-07-30 ~19:51 UTC when a 360 s smoke deadline fired mid-compile.
 _DEFAULT_DEADLINES = {"probe": 90, "smoke": 900, "lstm": 2400,
-                      "resnet": 900, "spd": 900, "longseq": 1200}
+                      "resnet": 900, "spd": 900, "longseq": 1200, "bert": 1500}
 
 
 def _arm_deadline(mode):
@@ -342,6 +342,26 @@ def mode_spd():
                "first_epoch_s": round(compile_epoch_s, 1)})
 
 
+def mode_bert():
+    """BERT-base fine-tune MFU vs batch at seq 128 (the baseline row is
+    b32; larger batches fill the MXU rows better — informational)."""
+    from bench import _bench_bert_finetune
+
+    for batch in (32, 64, 128):
+        os.environ["BENCH_BERT_BATCH"] = str(batch)
+        try:
+            steps_s, dt, compile_s, tokens = _bench_bert_finetune(
+                steps=10, warmup=2)
+            mfu = steps_s * 6 * 110e6 * tokens / 197e12 * 100
+            _emit({"batch": batch, "steps_s": round(steps_s, 2),
+                   "step_ms": round(dt * 1e3, 1),
+                   "tokens_s": round(steps_s * tokens, 0),
+                   "mfu_pct": round(mfu, 1),
+                   "compile_s": round(compile_s, 1)})
+        except Exception as e:  # noqa: BLE001
+            _emit({"batch": batch, "error": str(e)[:200]})
+
+
 def mode_longseq():
     """Long-context attention on chip: masked Pallas flash vs dense at
     growing sequence length (the seq-parallel/ring story's single-chip
@@ -414,7 +434,7 @@ def main():
     try:
         {"probe": mode_probe, "smoke": mode_smoke, "lstm": mode_lstm,
          "resnet": mode_resnet, "spd": mode_spd,
-         "longseq": mode_longseq}[mode]()
+         "longseq": mode_longseq, "bert": mode_bert}[mode]()
     except Exception as e:  # noqa: BLE001
         _emit({"mode": mode, "error": f"{type(e).__name__}: {e}"[:400]})
         os._exit(1)
