@@ -113,6 +113,12 @@ def _bench_zoo_model(model_cls, batch, steps, warmup, input_hw=224,
     return batch / dt, dt, compile_s, final_loss
 
 
+def bert_mfu_pct(steps_s, tokens_per_step):
+    """~6 FLOP/param/token fwd+bwd (3x2), 110M params, 197 TFLOP/s v5e
+    bf16 peak — the ONE place this formula lives (exp_tpu_r4 imports it)."""
+    return steps_s * 6 * 110e6 * tokens_per_step / 197e12 * 100
+
+
 def _bench_bert_finetune(batch=None, seq=None, steps=10, warmup=2):
     """BERT-base classification fine-tune steps/s (flash attention on TPU):
     fwd + bwd + Adam in one jitted executable."""
@@ -336,9 +342,8 @@ def child_main():
                 result["bert_ft_steps_s"] = round(b_steps_s, 2)
                 result["bert_ft_note"] = (
                     f"BERT-base tokens/step={b_tokens} masked flash attn")
-                # ~6 FLOP/param/token fwd+bwd (3x2), 110M params
                 result["bert_ft_mfu_pct"] = round(
-                    b_steps_s * 6 * 110e6 * b_tokens / 197e12 * 100, 1)
+                    bert_mfu_pct(b_steps_s, b_tokens), 1)
                 print(f"# bert: step={b_dt*1000:.1f}ms compile={b_c:.1f}s",
                       file=sys.stderr, flush=True)
             except Exception as e:  # noqa: BLE001

@@ -1,0 +1,274 @@
+"""KMeans clustering (≡ deeplearning4j-clustering ::
+org.deeplearning4j.clustering.kmeans.KMeansClustering +
+cluster.Point/Cluster/ClusterSet/PointClassification).
+
+Reference shape: ``KMeansClustering.setup(k, maxIter, distanceFn)`` →
+``applyTo(List<Point>)`` → ``ClusterSet`` (iterative Lloyd refinement on
+the JVM, one distance computation per point per cluster per iteration,
+optionally ``useKmeansPlusPlus`` seeding).
+
+TPU-first inversion: the whole Lloyd loop is ONE jitted
+``lax.while_loop`` over static-shape tensors. The (N, K) distance matrix
+is a single ``X @ Cᵀ`` GEMM on the MXU per iteration (‖x‖² − 2x·c + ‖c‖²
+for euclidean), assignments are an argmin, and the new centers are a
+segment-sum (one-hot matmul — also MXU) — no per-point host loop exists
+anywhere. k-means++ seeding runs as a ``lax.fori_loop`` of K distance
+updates on device with a seeded PRNG stream.
+
+Convergence matches the reference's ``ClusteringStrategy`` surface:
+either a fixed ``maxIterationCount`` or a ``minDistributionVariationRate``
+(fraction of points that changed cluster between iterations).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Point", "Cluster", "ClusterSet", "PointClassification",
+           "KMeansClustering"]
+
+
+class Point:
+    """≡ clustering.cluster.Point — an id/label-carrying vector."""
+
+    def __init__(self, array, id=None, label=None):
+        self.array = np.asarray(array, np.float32).reshape(-1)
+        self.id = id
+        self.label = label
+
+    def getArray(self):
+        return self.array
+
+    def getId(self):
+        return self.id
+
+    def getLabel(self):
+        return self.label
+
+    @staticmethod
+    def toPoints(matrix):
+        """≡ Point.toPoints(INDArray): one Point per row."""
+        m = np.asarray(matrix, np.float32)
+        return [Point(row, id=str(i)) for i, row in enumerate(m)]
+
+
+class Cluster:
+    def __init__(self, id, center):
+        self.id = id
+        self._center = np.asarray(center, np.float32)
+        self._points = []
+
+    def getCenter(self):
+        return self._center
+
+    def getPoints(self):
+        return self._points
+
+    def getId(self):
+        return self.id
+
+    def addPoint(self, point):
+        self._points.append(point)
+
+
+class PointClassification:
+    """≡ cluster.PointClassification (cluster, distance, moved-flag)."""
+
+    def __init__(self, cluster, distance, new_location):
+        self._cluster = cluster
+        self._distance = float(distance)
+        self._new_location = bool(new_location)
+
+    def getCluster(self):
+        return self._cluster
+
+    def getDistanceFromCenter(self):
+        return self._distance
+
+    def isNewLocation(self):
+        return self._new_location
+
+
+def _pairwise(x, c, distance):
+    """(N, D) x (K, D) -> (N, K) distances. euclidean rides the MXU."""
+    if distance in ("euclidean", "sqeuclidean"):
+        x2 = jnp.sum(x * x, -1, keepdims=True)           # (N, 1)
+        c2 = jnp.sum(c * c, -1)                          # (K,)
+        d2 = jnp.maximum(x2 - 2.0 * (x @ c.T) + c2, 0.0)
+        return d2 if distance == "sqeuclidean" else jnp.sqrt(d2)
+    if distance == "manhattan":
+        return jnp.abs(x[:, None, :] - c[None, :, :]).sum(-1)
+    if distance == "cosinesimilarity":  # distance = 1 - cosine
+        xn = x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+        cn = c / jnp.maximum(jnp.linalg.norm(c, axis=-1, keepdims=True), 1e-12)
+        return 1.0 - xn @ cn.T
+    if distance == "dot":
+        return -(x @ c.T)
+    raise ValueError(f"unknown distance function: {distance!r}")
+
+
+@functools.partial(jax.jit, static_argnames=("k", "distance"))
+def _kmeanspp_init(x, key, k, distance):
+    """k-means++ seeding as a fori_loop of device distance updates."""
+    n = x.shape[0]
+    k0, key = jax.random.split(key)
+    first = jax.random.randint(k0, (), 0, n)
+    centers = jnp.zeros((k, x.shape[1]), x.dtype).at[0].set(x[first])
+    d2 = _pairwise(x, centers[:1], "sqeuclidean")[:, 0]
+
+    def body(i, state):
+        centers, d2, key = state
+        key, sub = jax.random.split(key)
+        p = d2 / jnp.maximum(d2.sum(), 1e-12)
+        idx = jax.random.choice(sub, n, p=p)
+        centers = centers.at[i].set(x[idx])
+        nd = _pairwise(x, x[idx][None, :], "sqeuclidean")[:, 0]
+        return centers, jnp.minimum(d2, nd), key
+
+    centers, _, _ = jax.lax.fori_loop(1, k, body, (centers, d2, key))
+    del distance  # seeding always uses squared euclidean, as the reference
+    return centers
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "distance", "max_iter", "min_var"))
+def _lloyd(x, centers0, k, distance, max_iter, min_var):
+    """Whole Lloyd refinement as ONE while_loop; returns (centers, assign,
+    iterations). Empty clusters keep their previous center (reference's
+    allowEmptyClusters=True behavior; False is handled by the caller via
+    farthest-point reseeding between convergence checks)."""
+    n = x.shape[0]
+
+    def assign_of(c):
+        return jnp.argmin(_pairwise(x, c, distance), axis=-1)
+
+    def cond(state):
+        _, _, changed_rate, it = state
+        return jnp.logical_and(it < max_iter, changed_rate > min_var)
+
+    def body(state):
+        centers, assign, _, it = state
+        onehot = jax.nn.one_hot(assign, k, dtype=x.dtype)   # (N, K)
+        counts = onehot.sum(0)                              # (K,)
+        sums = onehot.T @ x                                 # (K, D) on MXU
+        new_centers = jnp.where(counts[:, None] > 0,
+                                sums / jnp.maximum(counts[:, None], 1.0),
+                                centers)
+        new_assign = assign_of(new_centers)
+        changed = jnp.mean((new_assign != assign).astype(jnp.float32))
+        return new_centers, new_assign, changed, it + 1
+
+    a0 = assign_of(centers0)
+    centers, assign, _, it = jax.lax.while_loop(
+        cond, body, (centers0, a0, jnp.float32(1.0), jnp.int32(0)))
+    return centers, assign, it
+
+
+class ClusterSet:
+    def __init__(self, clusters, distance):
+        self._clusters = clusters
+        self._distance = distance
+
+    def getClusters(self):
+        return self._clusters
+
+    def getClusterCount(self):
+        return len(self._clusters)
+
+    def classifyPoint(self, point):
+        """≡ ClusterSet.classifyPoint: nearest cluster + distance."""
+        arr = point.array if isinstance(point, Point) else \
+            np.asarray(point, np.float32).reshape(-1)
+        centers = np.stack([c.getCenter() for c in self._clusters])
+        d = np.asarray(_pairwise(jnp.asarray(arr[None, :]),
+                                 jnp.asarray(centers), self._distance))[0]
+        idx = int(d.argmin())
+        return PointClassification(self._clusters[idx], d[idx], True)
+
+
+class KMeansClustering:
+    """≡ kmeans.KMeansClustering. Build via ``setup(...)``, run via
+    ``applyTo(points)`` where points is a list[Point] or an (N, D) array."""
+
+    def __init__(self, k, max_iter, distance, inverse=False,
+                 min_distribution_variation_rate=0.0,
+                 allow_empty_clusters=True, use_kmeans_plus_plus=False,
+                 seed=123):
+        self.k = int(k)
+        self.max_iter = int(max_iter)
+        # reference distance-function names are e.g. "euclidean",
+        # "cosinesimilarity", "manhattan"; `inverse` marks similarity fns
+        self.distance = str(distance).lower()
+        if inverse and self.distance not in ("cosinesimilarity", "dot"):
+            raise ValueError("inverse=True expects a similarity function")
+        self.min_var = float(min_distribution_variation_rate)
+        self.allow_empty = bool(allow_empty_clusters)
+        self.use_pp = bool(use_kmeans_plus_plus)
+        self.seed = int(seed)
+
+    # -- reference factory surface --------------------------------------
+    @staticmethod
+    def setup(clusterCount, maxIterationCount=None, distanceFunction="euclidean",
+              inverse=False, minDistributionVariationRate=None,
+              allowEmptyClusters=True, useKMeansPlusPlus=False, seed=123):
+        """≡ KMeansClustering.setup overloads: pass maxIterationCount for
+        fixed-iteration mode, or minDistributionVariationRate for
+        variation-converged mode (both is fine — first bound wins)."""
+        if maxIterationCount is None and minDistributionVariationRate is None:
+            raise ValueError("need maxIterationCount or "
+                             "minDistributionVariationRate")
+        return KMeansClustering(
+            clusterCount,
+            maxIterationCount if maxIterationCount is not None else 1000,
+            distanceFunction, inverse=inverse,
+            min_distribution_variation_rate=(
+                minDistributionVariationRate or 0.0),
+            allow_empty_clusters=allowEmptyClusters,
+            use_kmeans_plus_plus=useKMeansPlusPlus, seed=seed)
+
+    def applyTo(self, points):
+        pts = points
+        if isinstance(points, (list, tuple)):
+            x_np = np.stack([p.array for p in points])
+        else:
+            x_np = np.asarray(points, np.float32)
+            pts = None
+        if x_np.shape[0] < self.k:
+            raise ValueError(
+                f"need >= k={self.k} points, got {x_np.shape[0]}")
+        x = jnp.asarray(x_np)
+        key = jax.random.PRNGKey(self.seed)
+        if self.use_pp:
+            centers0 = _kmeanspp_init(x, key, self.k, self.distance)
+        else:
+            perm = jax.random.permutation(key, x_np.shape[0])[: self.k]
+            centers0 = x[perm]
+        centers, assign, _ = _lloyd(x, centers0, self.k, self.distance,
+                                    self.max_iter, self.min_var)
+        if not self.allow_empty:
+            # reseed any empty cluster at the globally farthest point,
+            # then run one more refinement (reference's repair pass)
+            assign_np = np.asarray(assign)
+            counts = np.bincount(assign_np, minlength=self.k)
+            if (counts == 0).any():
+                centers_np = np.asarray(centers)
+                d = np.asarray(_pairwise(x, jnp.asarray(centers_np),
+                                         self.distance))
+                far = np.argsort(-d.min(-1))
+                empties = np.flatnonzero(counts == 0)
+                for j, ci in enumerate(empties):
+                    centers_np[ci] = x_np[far[j]]
+                centers, assign, _ = _lloyd(
+                    x, jnp.asarray(centers_np), self.k, self.distance,
+                    self.max_iter, self.min_var)
+        centers_np = np.asarray(centers)
+        assign_np = np.asarray(assign)
+        clusters = [Cluster(i, centers_np[i]) for i in range(self.k)]
+        if pts is None:
+            pts = Point.toPoints(x_np)
+        for p, a in zip(pts, assign_np):
+            clusters[int(a)].addPoint(p)
+        return ClusterSet(clusters, self.distance)

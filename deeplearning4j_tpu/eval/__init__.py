@@ -1,6 +1,6 @@
 from deeplearning4j_tpu.eval.evaluation import (Evaluation, EvaluationBinary,
                                                 RegressionEvaluation, ROC,
-                                                ROCMultiClass)
+                                                ROCBinary, ROCMultiClass)
 
 __all__ = ["Evaluation", "EvaluationBinary", "RegressionEvaluation", "ROC",
-           "ROCMultiClass"]
+           "ROCBinary", "ROCMultiClass"]

@@ -261,6 +261,52 @@ class ROCMultiClass:
         return float(np.mean([r.calculateAUC() for r in self._rocs.values()]))
 
 
+class ROCBinary:
+    """≡ evaluation.classification.ROCBinary — an independent binary ROC
+    per output column (multi-label sigmoid heads), unlike ROCMultiClass's
+    one-vs-rest over a softmax. Supports a per-output (N, C) mask."""
+
+    def __init__(self, threshold_steps=0):
+        self._rocs = {}
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            b, t, c = labels.shape
+            labels = labels.reshape(b * t, c)
+            predictions = predictions.reshape(b * t, c)
+            if mask is not None:
+                mask = np.asarray(mask)
+                mask = (mask.reshape(b * t, c) if mask.ndim == 3
+                        else mask.reshape(b * t))
+        m = None if mask is None else np.asarray(mask)
+        for c in range(labels.shape[-1]):
+            if m is None:
+                sel = slice(None)
+            elif m.ndim == 1:
+                sel = m.astype(bool)
+            else:  # per-output mask
+                sel = m[:, c].astype(bool)
+            roc = self._rocs.setdefault(c, ROC())
+            roc._scores.append(predictions[sel, c])
+            roc._labels.append(labels[sel, c])
+
+    def numLabels(self):
+        return len(self._rocs)
+
+    def calculateAUC(self, outputNum):
+        return self._rocs[outputNum].calculateAUC()
+
+    def calculateAverageAUC(self):
+        return float(np.mean([r.calculateAUC() for r in self._rocs.values()]))
+
+    def stats(self):
+        aucs = ", ".join(f"{c}: {r.calculateAUC():.4f}"
+                         for c, r in sorted(self._rocs.items()))
+        return f"ROCBinary(avgAUC={self.calculateAverageAUC():.4f}; {aucs})"
+
+
 class EvaluationCalibration:
     """≡ evaluation.calibration.EvaluationCalibration — reliability
     diagrams + prediction-probability histograms per class."""

@@ -1,0 +1,156 @@
+"""DeepWalk vertex embeddings (≡ deeplearning4j-graph ::
+org.deeplearning4j.graph.models.deepwalk.DeepWalk + GraphVectors).
+
+Reference shape: random walks over the graph feed a skip-gram model;
+the reference trains it with hierarchical softmax over a Huffman tree
+built from vertex-visit frequencies (``GraphHuffman``), updating one
+pair at a time on the JVM.
+
+TPU-first inversion: walks are generated host-side into fixed-shape
+(center, context) int32 batches and trained with the SAME jitted
+skip-gram negative-sampling executable the Word2Vec module uses
+(``nlp.word2vec._sgns_step`` — embedding gathers + log-sigmoid loss +
+SGD in one donated XLA program). Negative sampling replaces
+hierarchical softmax: it is the batched-hardware-native formulation of
+the same objective (the reference itself moved to it in sequencevectors),
+and degree^0.75 negatives mirror the unigram^0.75 table.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.graph.graph import RandomWalkIterator
+from deeplearning4j_tpu.nlp.word2vec import _sgns_step
+
+__all__ = ["DeepWalk", "GraphVectors"]
+
+
+class GraphVectors:
+    """Lookup surface (≡ models.embeddings.GraphVectors)."""
+
+    def getVertexVector(self, idx):
+        return np.asarray(self.params["syn0"], np.float32)[int(idx)]
+
+    def numVertices(self):
+        return int(np.asarray(self.params["syn0"]).shape[0])
+
+    def getVectorSize(self):
+        return int(np.asarray(self.params["syn0"]).shape[1])
+
+    def similarity(self, v1, v2):
+        a, b = self.getVertexVector(v1), self.getVertexVector(v2)
+        na, nb = np.linalg.norm(a), np.linalg.norm(b)
+        if na == 0 or nb == 0:
+            return 0.0
+        return float(a @ b / (na * nb))
+
+    def verticesNearest(self, idx, top=5):
+        tab = np.asarray(self.params["syn0"], np.float32)
+        v = tab[int(idx)]
+        sims = tab @ v / np.maximum(
+            np.linalg.norm(tab, axis=1) * max(np.linalg.norm(v), 1e-12),
+            1e-12)
+        order = [i for i in np.argsort(-sims) if i != int(idx)]
+        return np.array(order[:top], np.int32)
+
+
+class DeepWalk(GraphVectors):
+    class Builder:
+        def __init__(self):
+            self._window = 4
+            self._vector_size = 100
+            self._lr = 0.025
+            self._seed = 123
+            self._negative = 5
+            self._batch = 1024
+            self._epochs = 1
+
+        def windowSize(self, v):
+            self._window = int(v); return self
+
+        def vectorSize(self, v):
+            self._vector_size = int(v); return self
+
+        def learningRate(self, v):
+            self._lr = float(v); return self
+
+        def seed(self, v):
+            self._seed = int(v); return self
+
+        def negativeSample(self, v):
+            self._negative = int(v); return self
+
+        def batchSize(self, v):
+            self._batch = int(v); return self
+
+        def epochs(self, v):
+            self._epochs = int(v); return self
+
+        def build(self):
+            return DeepWalk(self)
+
+    def __init__(self, b):
+        self.b = b
+        self.params = None
+        self._neg_table = None
+
+    def initialize(self, graph):
+        """≡ DeepWalk.initialize(IGraph): allocate tables."""
+        n = graph.numVertices()
+        rng = np.random.RandomState(self.b._seed)
+        d = self.b._vector_size
+        self.params = {
+            "syn0": jnp.asarray((rng.rand(n, d).astype(np.float32) - 0.5) / d),
+            "syn1": jnp.asarray(np.zeros((n, d), np.float32)),
+        }
+        deg = np.array([max(graph.getVertexDegree(i), 1) for i in range(n)],
+                       np.float64) ** 0.75
+        self._neg_table = (deg / deg.sum()).astype(np.float64)
+
+    def fit(self, graph_or_iter, walk_length=None):
+        """≡ fit(IGraph, walkLength) or fit(GraphWalkIterator)."""
+        if walk_length is not None:
+            it = RandomWalkIterator(graph_or_iter, walk_length,
+                                    seed=self.b._seed)
+            graph = graph_or_iter
+        else:
+            it = graph_or_iter
+            graph = it.graph
+        if self.params is None:
+            self.initialize(graph)
+        rng = np.random.RandomState(self.b._seed + 1)
+        for _ in range(self.b._epochs):
+            it.reset()
+            centers, contexts = [], []
+            while it.hasNext():
+                walk = it.next()
+                for i, c in enumerate(walk):
+                    lo = max(0, i - self.b._window)
+                    hi = min(len(walk), i + self.b._window + 1)
+                    for j in range(lo, hi):
+                        if j != i:
+                            centers.append(c)
+                            contexts.append(walk[j])
+            centers = np.array(centers, np.int32)
+            contexts = np.array(contexts, np.int32)
+            order = rng.permutation(len(centers))
+            centers, contexts = centers[order], contexts[order]
+            bsz, k = self.b._batch, self.b._negative
+            n_vocab = len(self._neg_table)
+            for s in range(0, len(centers), bsz):
+                c = centers[s:s + bsz]
+                t = contexts[s:s + bsz]
+                m = len(c)
+                if m < bsz:  # pad to the jitted batch shape, mask the tail
+                    c = np.pad(c, (0, bsz - m))
+                    t = np.pad(t, (0, bsz - m))
+                negs = rng.choice(n_vocab, size=(bsz, k),
+                                  p=self._neg_table).astype(np.int32)
+                w = np.zeros(bsz, np.float32)
+                w[:m] = 1.0
+                self.params, _ = _sgns_step(
+                    self.params, jnp.float32(self.b._lr),
+                    jnp.asarray(c), jnp.asarray(t), jnp.asarray(negs),
+                    jnp.asarray(w))
+        return self

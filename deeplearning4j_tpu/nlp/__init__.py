@@ -16,6 +16,8 @@ from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.fasttext import FastText, char_ngrams
 from deeplearning4j_tpu.nlp.serializer import (StaticWordVectors,
                                                WordVectorSerializer)
+from deeplearning4j_tpu.nlp.cnn_sentence_iterator import (
+    CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider)
 
 __all__ = [
     "WordVectorSerializer", "StaticWordVectors",
@@ -24,4 +26,5 @@ __all__ = [
     "SentenceIterator", "Tokenizer", "TokenizerFactory", "VocabCache",
     "build_vocab", "Word2Vec", "WordVectors", "LabelledDocument",
     "ParagraphVectors", "Glove", "FastText", "char_ngrams",
+    "CnnSentenceDataSetIterator", "CollectionLabeledSentenceProvider",
 ]

@@ -1,0 +1,5 @@
+from deeplearning4j_tpu.util.model_guesser import (ModelGuesser,
+                                                   ModelGuesserException)
+from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+__all__ = ["ModelSerializer", "ModelGuesser", "ModelGuesserException"]

@@ -1,0 +1,57 @@
+"""ModelGuesser (≡ deeplearning4j-core ::
+org.deeplearning4j.util.ModelGuesser / ModelGuesserException).
+
+Loads "whatever model file this is": tries the DL4J zip archive first
+(MultiLayerNetwork, then ComputationGraph), then a Keras JSON config
+(sequential, then functional) — the same fall-through order the
+reference uses.
+"""
+from __future__ import annotations
+
+import zipfile
+
+from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+__all__ = ["ModelGuesser", "ModelGuesserException"]
+
+
+class ModelGuesserException(Exception):
+    pass
+
+
+class ModelGuesser:
+    @staticmethod
+    def loadModelGuess(path, inputType=None):
+        """Returns a MultiLayerNetwork, ComputationGraph, or Keras-imported
+        network; raises ModelGuesserException when nothing matches."""
+        errors = []
+        if zipfile.is_zipfile(path):
+            for restore in (ModelSerializer.restoreMultiLayerNetwork,
+                            ModelSerializer.restoreComputationGraph):
+                try:
+                    return restore(path)
+                except Exception as e:  # try the next format
+                    errors.append(f"{restore.__name__}: {e}")
+        else:
+            from deeplearning4j_tpu.keras_import.keras_import import \
+                KerasModelImport
+            try:
+                return KerasModelImport.importKerasSequentialModelAndWeights(
+                    path, inputType=inputType)
+            except Exception as e:
+                errors.append(f"keras sequential: {e}")
+            try:
+                return KerasModelImport.importKerasModelAndWeights(path)
+            except Exception as e:
+                errors.append(f"keras functional: {e}")
+        raise ModelGuesserException(
+            f"could not load {path!r} as any known model format: "
+            + "; ".join(errors))
+
+    @staticmethod
+    def loadNormalizer(path):
+        """≡ ModelGuesser.loadNormalizer — normalizer from a model zip."""
+        try:
+            return ModelSerializer.restoreNormalizerFromFile(path)
+        except Exception as e:
+            raise ModelGuesserException(str(e))

@@ -345,14 +345,13 @@ def mode_spd():
 def mode_bert():
     """BERT-base fine-tune MFU vs batch at seq 128 (the baseline row is
     b32; larger batches fill the MXU rows better — informational)."""
-    from bench import _bench_bert_finetune
+    from bench import _bench_bert_finetune, bert_mfu_pct
 
     for batch in (32, 64, 128):
-        os.environ["BENCH_BERT_BATCH"] = str(batch)
         try:
             steps_s, dt, compile_s, tokens = _bench_bert_finetune(
-                steps=10, warmup=2)
-            mfu = steps_s * 6 * 110e6 * tokens / 197e12 * 100
+                batch=batch, steps=10, warmup=2)
+            mfu = bert_mfu_pct(steps_s, tokens)
             _emit({"batch": batch, "steps_s": round(steps_s, 2),
                    "step_ms": round(dt * 1e3, 1),
                    "tokens_s": round(steps_s * tokens, 0),

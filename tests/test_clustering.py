@@ -1,0 +1,171 @@
+"""Clustering / VPTree / t-SNE tests (≡ deeplearning4j-clustering tests +
+BarnesHutTsne sanity checks)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (BarnesHutTsne, DataPoint,
+                                           KMeansClustering, Point, VPTree,
+                                           knn)
+
+
+def _blobs(n_per=40, centers=((0, 0), (8, 8), (-8, 8)), seed=0, scale=0.5):
+    rng = np.random.RandomState(seed)
+    xs, ys = [], []
+    for i, c in enumerate(centers):
+        xs.append(rng.randn(n_per, len(c)) * scale + np.asarray(c))
+        ys.append(np.full(n_per, i))
+    return np.concatenate(xs).astype(np.float32), np.concatenate(ys)
+
+
+class TestKMeans:
+    def test_recovers_separated_blobs(self):
+        x, y = _blobs()
+        cs = KMeansClustering.setup(3, maxIterationCount=50).applyTo(
+            Point.toPoints(x))
+        assert cs.getClusterCount() == 3
+        # each result cluster must be pure wrt blob membership
+        for cl in cs.getClusters():
+            ids = [int(p.getId()) for p in cl.getPoints()]
+            assert len(ids) > 0
+            assert len(set(y[ids])) == 1
+        # centers near blob means
+        centers = sorted(tuple(np.round(c.getCenter()).astype(int))
+                         for c in cs.getClusters())
+        assert centers == [(-8, 8), (0, 0), (8, 8)]
+
+    def test_kmeans_plus_plus_and_array_input(self):
+        x, y = _blobs(seed=3)
+        cs = KMeansClustering.setup(
+            3, maxIterationCount=50, useKMeansPlusPlus=True).applyTo(x)
+        for cl in cs.getClusters():
+            ids = [int(p.getId()) for p in cl.getPoints()]
+            assert len(set(y[ids])) == 1
+
+    def test_variation_rate_convergence_mode(self):
+        x, _ = _blobs(seed=1)
+        cs = KMeansClustering.setup(
+            3, minDistributionVariationRate=0.0).applyTo(x)
+        assert sum(len(c.getPoints()) for c in cs.getClusters()) == len(x)
+
+    def test_classify_point(self):
+        x, _ = _blobs()
+        cs = KMeansClustering.setup(3, maxIterationCount=50).applyTo(x)
+        pc = cs.classifyPoint(Point([8.2, 7.9]))
+        np.testing.assert_allclose(pc.getCluster().getCenter(), [8, 8],
+                                   atol=0.5)
+        assert pc.getDistanceFromCenter() < 1.0
+
+    def test_cosine_and_manhattan(self):
+        x, _ = _blobs(centers=((10, 0), (0, 10)), seed=2)
+        for fn, inv in [("manhattan", False), ("cosinesimilarity", True)]:
+            cs = KMeansClustering.setup(
+                2, maxIterationCount=30, distanceFunction=fn,
+                inverse=inv).applyTo(x)
+            sizes = sorted(len(c.getPoints()) for c in cs.getClusters())
+            assert sizes == [40, 40]
+
+    def test_empty_cluster_repair(self):
+        # k=3 over 2 tight blobs: random init can leave an empty cluster;
+        # allowEmptyClusters=False must reseed so every cluster is non-empty
+        x, _ = _blobs(centers=((0, 0), (20, 20)), n_per=30)
+        cs = KMeansClustering.setup(
+            3, maxIterationCount=50, allowEmptyClusters=False).applyTo(x)
+        assert all(len(c.getPoints()) > 0 for c in cs.getClusters())
+
+    def test_rejects_bad_args(self):
+        with pytest.raises(ValueError):
+            KMeansClustering.setup(2)
+        with pytest.raises(ValueError):
+            KMeansClustering.setup(2, 10, "euclidean", inverse=True)
+        with pytest.raises(ValueError):
+            KMeansClustering.setup(5, 10).applyTo(np.zeros((3, 2)))
+
+
+class TestVPTree:
+    def _oracle(self, items, q, k):
+        d = np.sqrt(((items - q) ** 2).sum(-1))
+        idx = np.argsort(d)[:k]
+        return idx, d[idx]
+
+    def test_search_matches_bruteforce(self):
+        rng = np.random.RandomState(0)
+        items = rng.randn(200, 8).astype(np.float32)
+        tree = VPTree(items)
+        for qi in range(5):
+            q = rng.randn(8).astype(np.float32)
+            results, dists = tree.search(q, 7)
+            oidx, od = self._oracle(items, q, 7)
+            assert [r.getIndex() for r in results] == list(oidx)
+            np.testing.assert_allclose(dists, od, rtol=1e-5)
+
+    def test_search_correct_under_tied_distances(self):
+        # many duplicate points force degenerate (all-on-median) splits;
+        # pruning must still return the true nearest neighbors
+        rng = np.random.RandomState(2)
+        base = rng.randn(12, 3).astype(np.float32)
+        items = np.repeat(base, 6, axis=0)          # every point x6
+        tree = VPTree(items)
+        for qi in range(4):
+            q = rng.randn(3).astype(np.float32)
+            results, dists = tree.search(q, 6)
+            oidx, od = self._oracle(items, q, 6)
+            np.testing.assert_allclose(sorted(dists), sorted(od), rtol=1e-5)
+
+    def test_search_fills_provided_lists(self):
+        items = np.eye(4, dtype=np.float32)
+        tree = VPTree([DataPoint(i, r) for i, r in enumerate(items)])
+        results, dists = [], []
+        tree.search(items[2], 1, results, dists)
+        assert results[0].getIndex() == 2 and dists[0] == 0.0
+
+    def test_device_knn_matches_oracle(self):
+        rng = np.random.RandomState(1)
+        items = rng.randn(100, 5).astype(np.float32)
+        qs = rng.randn(6, 5).astype(np.float32)
+        idx, d = knn(qs, items, 4)
+        assert idx.shape == (6, 4) and d.shape == (6, 4)
+        for r in range(6):
+            oidx, od = self._oracle(items, qs[r], 4)
+            assert list(idx[r]) == list(oidx)
+            np.testing.assert_allclose(d[r], od, rtol=1e-4, atol=1e-5)
+
+    def test_cosine_knn(self):
+        items = np.array([[1, 0], [0, 1], [-1, 0], [0.9, 0.1]], np.float32)
+        idx, d = knn(np.array([1.0, 0.0]), items, 2,
+                     similarity_function="cosinesimilarity")
+        assert set(idx[0]) == {0, 3}
+        assert d[0][0] == pytest.approx(0.0, abs=1e-6)
+
+
+class TestTsne:
+    def test_preserves_blob_structure(self):
+        x, y = _blobs(n_per=15, seed=5)
+        t = (BarnesHutTsne.Builder().setMaxIter(300).perplexity(10)
+             .stopLyingIteration(100).setSwitchMomentumIteration(100)
+             .seed(0).build())
+        t.fit(x)
+        emb = t.getData()
+        assert emb.shape == (45, 2)
+        assert np.isfinite(emb).all()
+        # same-blob mean distance < cross-blob mean distance
+        d = np.sqrt(((emb[:, None] - emb[None, :]) ** 2).sum(-1))
+        same = d[y[:, None] == y[None, :]]
+        diff = d[y[:, None] != y[None, :]]
+        assert same.mean() < 0.5 * diff.mean()
+
+    def test_save_as_file(self, tmp_path):
+        x, y = _blobs(n_per=5)
+        t = (BarnesHutTsne.Builder().setMaxIter(20).perplexity(3)
+             .numDimension(3).build())
+        t.fit(x)
+        path = tmp_path / "tsne.txt"
+        t.saveAsFile([str(v) for v in y], str(path))
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) == 15 and len(lines[0].split()) == 4
+
+    def test_adagrad_mode_runs(self):
+        x, _ = _blobs(n_per=8)
+        t = (BarnesHutTsne.Builder().setMaxIter(30).useAdaGrad(True)
+             .learningRate(0.5).build())
+        t.fit(x)
+        assert np.isfinite(t.getData()).all()

@@ -3,7 +3,8 @@ EvaluationTests / ROCTest / RegressionEvalTest)."""
 import numpy as np
 
 from deeplearning4j_tpu.eval import (Evaluation, EvaluationBinary,
-                                     RegressionEvaluation, ROC, ROCMultiClass)
+                                     RegressionEvaluation, ROC, ROCBinary,
+                                     ROCMultiClass)
 
 
 def test_evaluation_accuracy_and_confusion():
@@ -73,6 +74,57 @@ def test_roc_multiclass():
                       [0.7, 0.2, 0.1]])
     r.eval(labels, preds)
     assert r.calculateAverageAUC() == 1.0
+
+
+def test_roc_binary_per_output_auc():
+    """ROCBinary: independent binary problem per column (multi-label)."""
+    r = ROCBinary()
+    labels = np.array([[1, 1], [1, 0], [0, 1], [0, 0]], np.float32)
+    # col 0 is perfectly ranked; col 1 is the 0.75-AUC oracle from
+    # test_roc_known_auc (labels 1,0,1,0 with scores .8,.7,.6,.2)
+    preds = np.array([[0.9, 0.8], [0.8, 0.7], [0.2, 0.6], [0.1, 0.2]],
+                     np.float32)
+    r.eval(labels, preds)
+    assert r.numLabels() == 2
+    assert abs(r.calculateAUC(0) - 1.0) < 1e-9
+    assert abs(r.calculateAUC(1) - 0.75) < 1e-9
+    assert abs(r.calculateAverageAUC() - 0.875) < 1e-9
+    assert "avgAUC=0.8750" in r.stats()
+
+
+def test_roc_binary_per_output_mask():
+    """A (N, C) mask drops examples per-output: masking the one
+    mis-ranked example in column 1 lifts its AUC to 1."""
+    labels = np.array([[1, 1], [1, 0], [0, 1], [0, 0]], np.float32)
+    preds = np.array([[0.9, 0.8], [0.8, 0.7], [0.2, 0.6], [0.1, 0.2]],
+                     np.float32)
+    mask = np.array([[1, 1], [1, 0], [1, 1], [1, 1]], np.float32)
+    r = ROCBinary()
+    r.eval(labels, preds, mask=mask)
+    assert abs(r.calculateAUC(0) - 1.0) < 1e-9
+    assert abs(r.calculateAUC(1) - 1.0) < 1e-9
+
+
+def test_roc_binary_timeseries_fold():
+    r = ROCBinary()
+    labels = np.array([[[1], [0]], [[1], [0]]], np.float32)   # (B,T,C)
+    preds = np.array([[[0.9], [0.1]], [[0.8], [0.4]]], np.float32)
+    r.eval(labels, preds)
+    assert abs(r.calculateAUC(0) - 1.0) < 1e-9
+
+
+def test_roc_binary_timeseries_per_output_mask():
+    # (B,T,C) labels with a (B,T,C) per-output mask must fold together
+    r = ROCBinary()
+    labels = np.array([[[1, 1], [0, 0]], [[1, 0], [0, 1]]], np.float32)
+    preds = np.array([[[0.9, 0.3], [0.1, 0.7]],
+                      [[0.8, 0.6], [0.4, 0.9]]], np.float32)
+    mask = np.ones_like(labels)
+    mask[1, :, 1] = 0.0           # drop example 1's second output entirely
+    r.eval(labels, preds, mask=mask)
+    assert abs(r.calculateAUC(0) - 1.0) < 1e-9
+    # col 1 kept only (label, score) = (1,0.3), (0,0.7) -> AUC 0
+    assert abs(r.calculateAUC(1) - 0.0) < 1e-9
 
 
 def test_evaluation_binary():

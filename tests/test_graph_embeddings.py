@@ -1,0 +1,133 @@
+"""deeplearning4j-graph parity tests: structure, random walks, DeepWalk."""
+import numpy as np
+
+from deeplearning4j_tpu.graph import (DeepWalk, Graph, RandomWalkIterator,
+                                      WeightedRandomWalkIterator)
+
+
+def _barbell(k=6):
+    """Two k-cliques joined by a single bridge edge."""
+    g = Graph(2 * k)
+    for base in (0, k):
+        for i in range(k):
+            for j in range(i + 1, k):
+                g.addEdge(base + i, base + j)
+    g.addEdge(k - 1, k)
+    return g
+
+
+class TestGraphStructure:
+    def test_undirected_edges_symmetric(self):
+        g = Graph(4)
+        g.addEdge(0, 1)
+        g.addEdge(1, 2, directed=True)
+        assert g.numEdges() == 2
+        assert list(g.getConnectedVertexIndices(0)) == [1]
+        assert list(g.getConnectedVertexIndices(1)) == [0, 2]
+        assert list(g.getConnectedVertexIndices(2)) == []  # directed in-edge
+        assert g.getVertexDegree(1) == 2
+
+    def test_duplicate_edges_ignored_unless_multi(self):
+        g = Graph(3)
+        g.addEdge(0, 1)
+        g.addEdge(0, 1)
+        assert g.numEdges() == 1
+        gm = Graph(3, allow_multiple_edges=True)
+        gm.addEdge(0, 1)
+        gm.addEdge(0, 1)
+        assert gm.numEdges() == 2
+
+    def test_mixed_directed_undirected_no_duplicates(self):
+        # undirected over an existing directed edge upgrades it in place
+        g = Graph(3)
+        g.addEdge(0, 1, directed=True)
+        g.addEdge(1, 0, directed=False)
+        assert [t for t, _ in g.getEdgesOut(0)] == [1]   # no duplicate
+        assert [t for t, _ in g.getEdgesOut(1)] == [0]   # reverse added
+        g2 = Graph(3)
+        g2.addEdge(0, 1, directed=True)
+        g2.addEdge(0, 1, directed=False)
+        assert [t for t, _ in g2.getEdgesOut(1)] == [0]  # not dropped
+
+    def test_out_of_range_rejected(self):
+        g = Graph(2)
+        try:
+            g.addEdge(0, 5)
+            assert False, "expected ValueError"
+        except ValueError:
+            pass
+
+    def test_load_edge_list(self, tmp_path):
+        p = tmp_path / "edges.txt"
+        p.write_text("# comment\n0 1\n1 2 3.5\n\n2 3\n")
+        g = Graph.loadEdgeList(str(p), 4, weighted=True)
+        assert g.numEdges() == 3
+        assert g.getEdgesOut(1) == [(0, 1.0), (2, 3.5)]
+
+
+class TestRandomWalks:
+    def test_walks_follow_edges(self):
+        g = _barbell()
+        it = RandomWalkIterator(g, walk_length=10, seed=7)
+        starts = set()
+        while it.hasNext():
+            walk = it.next()
+            assert len(walk) == 11
+            starts.add(int(walk[0]))
+            for a, b in zip(walk[:-1], walk[1:]):
+                assert b in set(g.getConnectedVertexIndices(int(a)))
+        assert starts == set(range(12))  # one walk per vertex per pass
+
+    def test_isolated_vertex_self_loops(self):
+        g = Graph(2)
+        g.addEdge(0, 0)  # vertex 1 isolated
+        it = RandomWalkIterator(g, walk_length=4, seed=0)
+        it.reset()
+        while it.hasNext():
+            w = it.next()
+            if w[0] == 1:
+                assert (w == 1).all()
+
+    def test_weighted_walk_prefers_heavy_edge(self):
+        g = Graph(3, allow_multiple_edges=True)
+        g.addEdge(0, 1, 100.0)
+        g.addEdge(0, 2, 0.01)
+        it = WeightedRandomWalkIterator(g, walk_length=1, seed=3)
+        hits = {1: 0, 2: 0}
+        for _ in range(30):
+            it.reset()
+            while it.hasNext():
+                w = it.next()
+                if w[0] == 0:
+                    hits[int(w[1])] += 1
+        assert hits[1] > hits[2] * 5
+
+
+class TestDeepWalk:
+    def test_communities_embed_closer(self):
+        g = _barbell()
+        dw = (DeepWalk.Builder().vectorSize(16).windowSize(3)
+              .learningRate(0.5).epochs(50).batchSize(256).seed(11).build())
+        dw.fit(g, walk_length=12)
+        assert dw.numVertices() == 12 and dw.getVectorSize() == 16
+        # mean intra-community similarity should beat inter-community
+        intra, inter = [], []
+        for i in range(12):
+            for j in range(i + 1, 12):
+                s = dw.similarity(i, j)
+                (intra if (i < 6) == (j < 6) else inter).append(s)
+        assert np.mean(intra) > np.mean(inter) + 0.1
+
+    def test_vertices_nearest_stays_in_community(self):
+        g = _barbell()
+        dw = (DeepWalk.Builder().vectorSize(16).windowSize(3)
+              .learningRate(0.5).epochs(50).batchSize(256).seed(4).build())
+        dw.fit(g, walk_length=12)
+        near = dw.verticesNearest(0, top=3)
+        assert all(v < 6 for v in near)
+
+    def test_fit_from_iterator(self):
+        g = _barbell()
+        dw = (DeepWalk.Builder().vectorSize(8).epochs(2).seed(1).build())
+        dw.fit(RandomWalkIterator(g, walk_length=8, seed=2))
+        assert dw.getVertexVector(0).shape == (8,)

@@ -237,3 +237,88 @@ class TestWordVectorSerializer:
         idx = np.array([back.vocab.indexOf("fox")], np.int32)
         emb = net.feedForward(idx)[0].numpy()[0]
         np.testing.assert_allclose(emb, back.getWordVector("fox"), atol=1e-5)
+
+
+class TestCnnSentenceIterator:
+    def _wv(self):
+        from deeplearning4j_tpu.nlp import StaticWordVectors
+        words = ["good", "bad", "movie", "great", "awful", "unk"]
+        rng = np.random.RandomState(0)
+        return StaticWordVectors(rng.randn(6, 8).astype(np.float32), words)
+
+    def _provider(self):
+        from deeplearning4j_tpu.nlp import CollectionLabeledSentenceProvider
+        return CollectionLabeledSentenceProvider(
+            ["good movie", "great movie", "awful movie", "bad bad movie"],
+            ["pos", "pos", "neg", "neg"])
+
+    def test_cnn2d_layout_and_mask(self):
+        from deeplearning4j_tpu.nlp import CnnSentenceDataSetIterator
+        wv = self._wv()
+        it = (CnnSentenceDataSetIterator.Builder("CNN2D")
+              .sentenceProvider(self._provider()).wordVectors(wv)
+              .minibatchSize(4).maxSentenceLength(16).build())
+        ds = it.next()
+        assert ds.features.shape == (4, 1, 3, 8)   # longest sentence: 3
+        assert ds.labels.shape == (4, 2)
+        # mask marks real words; "good movie" has 2
+        np.testing.assert_array_equal(ds.featuresMask[0], [1, 1, 0])
+        # first word of first sentence is the "good" vector
+        np.testing.assert_allclose(ds.features[0, 0, 0],
+                                   wv.getWordVector("good"))
+        assert it.getLabels() == ["neg", "pos"]
+        assert not it.hasNext()
+        it.reset()
+        assert it.hasNext()
+
+    def test_rnn_layout_channels_first(self):
+        from deeplearning4j_tpu.nlp import CnnSentenceDataSetIterator
+        it = (CnnSentenceDataSetIterator.Builder("RNN")
+              .sentenceProvider(self._provider()).wordVectors(self._wv())
+              .minibatchSize(2).build())
+        ds = it.next()
+        assert ds.features.shape == (2, 8, 2)      # (B, vecSize, maxLen)
+
+    def test_unknown_word_handling(self):
+        from deeplearning4j_tpu.nlp import (CnnSentenceDataSetIterator,
+                                            CollectionLabeledSentenceProvider)
+        wv = self._wv()
+        prov = CollectionLabeledSentenceProvider(["good zzz movie"], ["pos"])
+        # RemoveWord (default): zzz skipped -> 2 tokens
+        it = (CnnSentenceDataSetIterator.Builder()
+              .sentenceProvider(prov).wordVectors(wv).build())
+        assert it.next().features.shape[2] == 2
+        # UseUnknown: zzz -> the "unk" vector, 3 tokens
+        prov.reset()
+        it2 = (CnnSentenceDataSetIterator.Builder()
+               .sentenceProvider(prov).wordVectors(wv)
+               .useUnknown("unk").build())
+        ds = it2.next()
+        assert ds.features.shape[2] == 3
+        np.testing.assert_allclose(ds.features[0, 0, 1],
+                                   wv.getWordVector("unk"))
+
+    def test_preprocessor_applied(self):
+        from deeplearning4j_tpu.nlp import CnnSentenceDataSetIterator
+        it = (CnnSentenceDataSetIterator.Builder()
+              .sentenceProvider(self._provider()).wordVectors(self._wv())
+              .minibatchSize(4).build())
+
+        class Doubler:
+            def preProcess(self, ds):
+                ds.features = ds.features * 2.0
+
+        base = it.next().features
+        it.reset()
+        it.setPreProcessor(Doubler())
+        np.testing.assert_allclose(it.next().features, base * 2.0)
+
+    def test_max_sentence_length_caps(self):
+        from deeplearning4j_tpu.nlp import (CnnSentenceDataSetIterator,
+                                            CollectionLabeledSentenceProvider)
+        prov = CollectionLabeledSentenceProvider(
+            ["good " * 10 + "movie"], ["pos"])
+        it = (CnnSentenceDataSetIterator.Builder()
+              .sentenceProvider(prov).wordVectors(self._wv())
+              .maxSentenceLength(4).build())
+        assert it.next().features.shape[2] == 4

@@ -115,6 +115,56 @@ class TestModelSerializer:
         assert ModelSerializer.restoreNormalizerFromFile(p) is not None
 
 
+class TestModelGuesser:
+    def test_guesses_multilayer(self, tmp_path):
+        from deeplearning4j_tpu.util import ModelGuesser
+        net = _mlp()
+        p = str(tmp_path / "m.zip")
+        ModelSerializer.writeModel(net, p)
+        loaded = ModelGuesser.loadModelGuess(p)
+        assert isinstance(loaded, MultiLayerNetwork)
+        assert np.array_equal(np.asarray(net.output(X)),
+                              np.asarray(loaded.output(X)))
+
+    def test_guesses_graph(self, tmp_path):
+        from deeplearning4j_tpu.util import ModelGuesser
+        g = _graph()
+        p = str(tmp_path / "g.zip")
+        ModelSerializer.writeModel(g, p)
+        assert isinstance(ModelGuesser.loadModelGuess(p), ComputationGraph)
+
+    def test_guesses_keras_json(self, tmp_path):
+        import json
+        from deeplearning4j_tpu.util import ModelGuesser
+        cfg = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "Dense",
+             "config": {"units": 3, "activation": "softmax",
+                        "batch_input_shape": [None, 4]}}]}}
+        p = tmp_path / "model.json"
+        p.write_text(json.dumps(cfg))
+        net = ModelGuesser.loadModelGuess(str(p))
+        assert np.asarray(net.output(X)).shape == (8, 3)
+
+    def test_unknown_format_raises(self, tmp_path):
+        from deeplearning4j_tpu.util import (ModelGuesser,
+                                             ModelGuesserException)
+        p = tmp_path / "junk.bin"
+        p.write_bytes(b"not a model")
+        with pytest.raises(ModelGuesserException):
+            ModelGuesser.loadModelGuess(str(p))
+
+    def test_load_normalizer(self, tmp_path):
+        from deeplearning4j_tpu.util import ModelGuesser
+        net = _mlp()
+        p = str(tmp_path / "m.zip")
+        ModelSerializer.writeModel(net, p)
+        norm = NormalizerStandardize()
+        norm.fit(DataSet(X, Y))
+        ModelSerializer.addNormalizerToModel(p, norm)
+        restored = ModelGuesser.loadNormalizer(p)
+        assert restored is not None
+
+
 class TestCheckpointListener:
     def test_keeps_last_n(self, tmp_path):
         net = _mlp()
