@@ -249,11 +249,15 @@ class KMeansClustering:
         centers, assign, _ = _lloyd(x, centers0, self.k, self.distance,
                                     self.max_iter, self.min_var)
         if not self.allow_empty:
-            # reseed any empty cluster at the globally farthest point,
-            # then run one more refinement (reference's repair pass)
-            assign_np = np.asarray(assign)
-            counts = np.bincount(assign_np, minlength=self.k)
-            if (counts == 0).any():
+            # reseed any empty cluster at the globally farthest point and
+            # re-refine; RE-CHECK because refinement can re-empty a
+            # cluster. Bounded retries, then a forced reassignment that
+            # guarantees the contract.
+            for _ in range(3):
+                assign_np = np.asarray(assign)
+                counts = np.bincount(assign_np, minlength=self.k)
+                if not (counts == 0).any():
+                    break
                 centers_np = np.asarray(centers)
                 d = np.asarray(_pairwise(x, jnp.asarray(centers_np),
                                          self.distance))
@@ -264,6 +268,25 @@ class KMeansClustering:
                 centers, assign, _ = _lloyd(
                     x, jnp.asarray(centers_np), self.k, self.distance,
                     self.max_iter, self.min_var)
+            assign_np = np.asarray(assign)
+            counts = np.bincount(assign_np, minlength=self.k)
+            if (counts == 0).any():
+                # forced repair: hand each empty cluster the point that is
+                # farthest from its current center, taken from a cluster
+                # that can spare one; centers become those points
+                centers_np = np.asarray(centers)
+                d = np.asarray(_pairwise(x, jnp.asarray(centers_np),
+                                         self.distance))
+                for ci in np.flatnonzero(counts == 0):
+                    own = d[np.arange(len(assign_np)),
+                            assign_np]            # dist to assigned center
+                    donors = counts[assign_np] > 1
+                    pick = int(np.argmax(np.where(donors, own, -np.inf)))
+                    counts[assign_np[pick]] -= 1
+                    assign_np[pick] = ci
+                    counts[ci] = 1
+                    centers_np[ci] = x_np[pick]
+                centers, assign = jnp.asarray(centers_np), assign_np
         centers_np = np.asarray(centers)
         assign_np = np.asarray(assign)
         clusters = [Cluster(i, centers_np[i]) for i in range(self.k)]

@@ -23,13 +23,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.clustering.kmeans import _pairwise
+
 __all__ = ["BarnesHutTsne", "Tsne"]
 
 
 def _sq_dists(x):
-    x2 = jnp.sum(x * x, -1)
-    d2 = x2[:, None] - 2.0 * (x @ x.T) + x2[None, :]
-    return jnp.maximum(d2, 0.0)
+    return _pairwise(x, x, "sqeuclidean")   # shared impl (kmeans)
 
 
 @functools.partial(jax.jit, static_argnames=("perplexity", "iters"))

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.clustering.kmeans import _pairwise
+
 __all__ = ["DataPoint", "VPTree", "knn"]
 
 
@@ -58,22 +60,7 @@ def _dist_np(x, items, fn):
 
 @functools.partial(jax.jit, static_argnames=("k", "fn"))
 def _knn_device(queries, items, k, fn):
-    if fn == "euclidean":
-        q2 = jnp.sum(queries * queries, -1, keepdims=True)
-        i2 = jnp.sum(items * items, -1)
-        d = jnp.sqrt(jnp.maximum(q2 - 2.0 * (queries @ items.T) + i2, 0.0))
-    elif fn == "manhattan":
-        d = jnp.abs(queries[:, None, :] - items[None, :, :]).sum(-1)
-    elif fn == "cosinesimilarity":
-        qn = queries / jnp.maximum(
-            jnp.linalg.norm(queries, axis=-1, keepdims=True), 1e-12)
-        iN = items / jnp.maximum(
-            jnp.linalg.norm(items, axis=-1, keepdims=True), 1e-12)
-        d = 1.0 - qn @ iN.T
-    elif fn == "dot":
-        d = -(queries @ items.T)
-    else:
-        raise ValueError(f"unknown similarity function: {fn!r}")
+    d = _pairwise(queries, items, fn)   # shared with kmeans — one impl
     neg, idx = jax.lax.top_k(-d, k)
     return idx, -neg
 
@@ -91,13 +78,15 @@ def knn(queries, items, k, similarity_function="euclidean"):
 
 
 class _Node:
-    __slots__ = ("index", "threshold", "inside", "outside")
+    __slots__ = ("index", "threshold", "inside", "outside", "bucket")
 
-    def __init__(self, index, threshold=0.0, inside=None, outside=None):
+    def __init__(self, index, threshold=0.0, inside=None, outside=None,
+                 bucket=None):
         self.index = index
         self.threshold = threshold
         self.inside = inside
         self.outside = outside
+        self.bucket = bucket  # leaf: indices scanned linearly at query
 
 
 class VPTree:
@@ -130,11 +119,11 @@ class VPTree:
         med = float(np.median(d))
         inside = [rest[i] for i in range(len(rest)) if d[i] < med]
         outside = [rest[i] for i in range(len(rest)) if d[i] >= med]
-        if not inside or not outside:  # degenerate split: keep linear —
-            # threshold must still bound ALL of `inside` or the search
-            # prune (d - tau <= threshold) would skip true neighbors
-            inside, outside = rest, []
-            med = float(np.nextafter(d.max(), np.inf))
+        if not inside or not outside:
+            # degenerate split (all points on the median, e.g. duplicates):
+            # recursing with only the vp removed would be O(N)-deep, so
+            # store the rest as a flat leaf bucket scanned at query time
+            return _Node(vp, bucket=rest)
         return _Node(vp, med, self._build(inside), self._build(outside))
 
     def search(self, target, k, results=None, distances=None):
@@ -146,15 +135,23 @@ class VPTree:
         import heapq
         heap = []  # (-distance, index)
 
+        def consider(idx, d):
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, idx))
+            elif d < -heap[0][0]:
+                heapq.heapreplace(heap, (-d, idx))
+
         def visit(node):
             if node is None:
                 return
             d = float(_dist_np(target, self.items[node.index][None, :],
                                self.fn)[0])
-            if len(heap) < k:
-                heapq.heappush(heap, (-d, node.index))
-            elif d < -heap[0][0]:
-                heapq.heapreplace(heap, (-d, node.index))
+            consider(node.index, d)
+            if node.bucket is not None:  # degenerate leaf: vectorized scan
+                ds = _dist_np(target, self.items[node.bucket], self.fn)
+                for i, bd in zip(node.bucket, ds):
+                    consider(i, float(bd))
+                return
             tau = -heap[0][0] if len(heap) == k else np.inf
             if node.inside is None and node.outside is None:
                 return

@@ -281,6 +281,13 @@ class ROCBinary:
                 mask = (mask.reshape(b * t, c) if mask.ndim == 3
                         else mask.reshape(b * t))
         m = None if mask is None else np.asarray(mask)
+        if m is not None and m.ndim == 2 and m.shape[1] == 1:
+            m = m[:, 0]          # (N, 1) = per-example column convention
+        if m is not None and m.ndim == 2 and m.shape[1] != labels.shape[-1]:
+            raise ValueError(
+                f"mask has {m.shape[1]} columns but labels have "
+                f"{labels.shape[-1]} outputs; pass (N,), (N, 1) for "
+                f"per-example or (N, C) for per-output masking")
         for c in range(labels.shape[-1]):
             if m is None:
                 sel = slice(None)

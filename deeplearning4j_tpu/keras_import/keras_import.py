@@ -96,7 +96,9 @@ def _keras_input_type(batch_shape):
     if len(dims) == 3:
         return InputType.convolutional(dims[0], dims[1], dims[2])
     if len(dims) == 2:
-        return InputType.recurrent(dims[1])
+        # keep the static sequence length when keras declares one —
+        # length-dependent layers (LocallyConnected1D) need it
+        return InputType.recurrent(dims[1], dims[0])
     if len(dims) == 1:
         return InputType.feedForward(dims[0])
     raise InvalidKerasConfigurationException(
@@ -209,8 +211,30 @@ def _convert_layer(class_name, cfg, is_last=False):
         return _convert_layer(inner.get("class_name"),
                               inner.get("config", {}), is_last=is_last)
     if class_name in ("SpatialDropout2D", "SpatialDropout1D"):
-        # per-element dropout parity approximation; rate semantics match
-        return DropoutLayer(dropOut=1.0 - float(cfg.get("rate", 0.5)))
+        # real channel-wise dropout (≡ KerasSpatialDropout): whole feature
+        # maps drop together; keras rate = drop prob, ours = retain
+        from deeplearning4j_tpu.nn.dropout import SpatialDropout
+        return DropoutLayer(
+            dropOut=SpatialDropout(1.0 - float(cfg.get("rate", 0.5))))
+    if class_name == "LocallyConnected2D":
+        from deeplearning4j_tpu.nn.conf.special_layers import \
+            LocallyConnected2D
+        return LocallyConnected2D(
+            nOut=cfg["filters"], kernelSize=tuple(cfg["kernel_size"]),
+            stride=tuple(cfg.get("strides", (1, 1))),
+            convolutionMode=cfg.get("padding", "valid"),
+            activation=act, weightInit=init, hasBias=bias)
+    if class_name == "LocallyConnected1D":
+        from deeplearning4j_tpu.nn.conf.special_layers import \
+            LocallyConnected1D
+        ks = cfg["kernel_size"]
+        st = cfg.get("strides", 1)
+        return LocallyConnected1D(
+            nOut=cfg["filters"],
+            kernelSize=ks[0] if isinstance(ks, (list, tuple)) else ks,
+            stride=st[0] if isinstance(st, (list, tuple)) else st,
+            convolutionMode=cfg.get("padding", "valid"),
+            activation=act, weightInit=init, hasBias=bias)
     if class_name == "Bidirectional":
         inner_cfg = cfg.get("layer") or {}
         inner = _convert_layer(inner_cfg.get("class_name"),

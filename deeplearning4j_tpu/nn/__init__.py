@@ -13,7 +13,11 @@ from deeplearning4j_tpu.nn.conf.layers import (
     SeparableConvolution2D, Subsampling1DLayer, SubsamplingLayer,
     TimeDistributed, Upsampling1D, Upsampling2D, ZeroPaddingLayer)
 from deeplearning4j_tpu.nn.conf.special_layers import (
-    CenterLossOutputLayer, LocallyConnected2D, VariationalAutoencoder)
+    CenterLossOutputLayer, LocallyConnected1D, LocallyConnected2D,
+    VariationalAutoencoder)
+from deeplearning4j_tpu.nn.dropout import (AlphaDropout, Dropout,
+                                           GaussianDropout, GaussianNoise,
+                                           SpatialDropout)
 from deeplearning4j_tpu.nn.constraints import (MaxNormConstraint,
                                                MinMaxNormConstraint,
                                                NonNegativeConstraint,
@@ -38,7 +42,9 @@ __all__ = [
     "OutputLayer", "PReLULayer", "SeparableConvolution2D",
     "Subsampling1DLayer", "SubsamplingLayer", "TimeDistributed",
     "Upsampling1D", "Upsampling2D",
-    "ZeroPaddingLayer", "CenterLossOutputLayer", "LocallyConnected2D",
+    "ZeroPaddingLayer", "CenterLossOutputLayer", "LocallyConnected1D",
+    "LocallyConnected2D", "AlphaDropout", "Dropout", "GaussianDropout",
+    "GaussianNoise", "SpatialDropout",
     "VariationalAutoencoder", "LossBinaryXENT", "LossMCXENT", "LossMSE",
     "LossNegativeLogLikelihood",
     "LossFunction", "MultiLayerNetwork", "AMSGrad",

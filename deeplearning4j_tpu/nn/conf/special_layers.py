@@ -90,6 +90,70 @@ class LocallyConnected2D(Layer):
             self.pre_activation(params, x)), state)
 
 
+class LocallyConnected1D(Layer):
+    """≡ conf.layers.LocallyConnected1D — temporal convolution with
+    UNSHARED weights: each output time position owns its own filter.
+    Input is the internal (B, T, F) sequence layout; the contraction is
+    one einsum (a batched matmul per position), like the 2D variant.
+    Needs a static timeSeriesLength on the input type."""
+
+    def __init__(self, nIn=None, nOut=None, kernelSize=3, stride=1,
+                 convolutionMode="truncate", hasBias=True, **kw):
+        super().__init__(**kw)
+        self.nIn, self.nOut = nIn, nOut
+        self.kernelSize, self.stride = int(kernelSize), int(stride)
+        self.convolutionMode = convolutionMode
+        self.hasBias = hasBias
+
+    def _out_t(self, t):
+        if str(self.convolutionMode).lower() == "same":
+            return -(-t // self.stride)
+        return (t - self.kernelSize) // self.stride + 1
+
+    def output_type(self, input_type):
+        t = getattr(input_type, "timeSeriesLength", None)
+        if t is None:
+            raise ValueError(
+                f"LocallyConnected1D '{self.name}' needs recurrent input "
+                f"with a known timeSeriesLength, got {input_type}")
+        return InputType.recurrent(self.nOut, self._out_t(t))
+
+    def feed_forward_mask(self, mask):
+        if mask is None:
+            return None
+        m = mask[:, ::self.stride]
+        return m[:, : self._out_t(mask.shape[1])]
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.size
+        if self.nOut is None:
+            raise ValueError(f"LocallyConnected1D '{self.name}': nOut not set")
+        ot = self._out_t(input_type.timeSeriesLength)
+        w = init_weight(key, (ot, self.kernelSize * int(self.nIn),
+                              int(self.nOut)), self.weightInit, self.dist)
+        params = {"W": w}
+        if self.hasBias:
+            params["b"] = jnp.full((ot, int(self.nOut)),
+                                   float(self.biasInit), jnp.float32)
+        return params, {}, self.output_type(input_type)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        k, s = self.kernelSize, self.stride
+        ot = params["W"].shape[0]
+        if str(self.convolutionMode).lower() == "same":
+            pad = max(0, (ot - 1) * s + k - x.shape[1])
+            x = jnp.pad(x, ((0, 0), (pad // 2, pad - pad // 2), (0, 0)))
+        # static unrolled patch extraction: (B, ot, k*F)
+        patches = [x[:, d:d + ot * s:s, :] for d in range(k)]
+        xp = jnp.concatenate(patches, axis=-1)
+        y = jnp.einsum("btp,tpo->bto", xp, params["W"].astype(x.dtype))
+        if self.hasBias:
+            y = y + params["b"].astype(x.dtype)
+        return get_activation(self.activation)(y), state
+
+
 class VariationalAutoencoder(Layer):
     """≡ conf.layers.variational.VariationalAutoencoder.
 

@@ -77,3 +77,21 @@ class AlphaDropout(IDropout):
         keep = jax.random.bernoulli(rng, p, x.shape)
         y = jnp.where(keep, x, jnp.asarray(alpha_p, x.dtype))
         return (a * y + b).astype(x.dtype)
+
+
+class SpatialDropout(IDropout):
+    """≡ conf.dropout.SpatialDropout — drops ENTIRE feature maps: one
+    Bernoulli draw per (example, channel), broadcast over the spatial or
+    time axes. Internal layouts are channels-LAST (NHWC conv, (B, T, F)
+    sequences), so the mask is (B, 1, ..., 1, C). p = retain probability,
+    inverted scaling, matching the reference's convention."""
+
+    def __init__(self, p):
+        self.p = float(p)
+
+    def apply(self, x, rng):
+        if self.p <= 0.0 or self.p >= 1.0 or x.ndim < 2:
+            return x
+        mask_shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+        keep = jax.random.bernoulli(rng, self.p, mask_shape)
+        return jnp.where(keep, x / self.p, 0.0).astype(x.dtype)

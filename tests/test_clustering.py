@@ -72,6 +72,19 @@ class TestKMeans:
             3, maxIterationCount=50, allowEmptyClusters=False).applyTo(x)
         assert all(len(c.getPoints()) > 0 for c in cs.getClusters())
 
+    def test_forced_repair_guarantees_contract(self):
+        # k far larger than the natural cluster count: reseed+Lloyd alone
+        # keeps collapsing clusters, so the forced-reassignment fallback
+        # must deliver the allowEmptyClusters=False contract
+        rng = np.random.RandomState(7)
+        x = np.concatenate([rng.randn(20, 2) * 0.01,
+                            rng.randn(20, 2) * 0.01 + 50]).astype(np.float32)
+        cs = KMeansClustering.setup(
+            6, maxIterationCount=20, allowEmptyClusters=False).applyTo(x)
+        sizes = [len(c.getPoints()) for c in cs.getClusters()]
+        assert all(s > 0 for s in sizes)
+        assert sum(sizes) == 40
+
     def test_rejects_bad_args(self):
         with pytest.raises(ValueError):
             KMeansClustering.setup(2)
@@ -110,6 +123,16 @@ class TestVPTree:
             results, dists = tree.search(q, 6)
             oidx, od = self._oracle(items, q, 6)
             np.testing.assert_allclose(sorted(dists), sorted(od), rtol=1e-5)
+
+    def test_duplicate_heavy_corpus_builds_and_searches(self):
+        # 1500 identical vectors: construction must not recurse O(N) deep
+        items = np.tile(np.array([[1.0, 2.0, 3.0]], np.float32), (1500, 1))
+        items[0] = [9.0, 9.0, 9.0]
+        tree = VPTree(items)
+        results, dists = tree.search(np.array([1, 2, 3], np.float32), 5)
+        assert len(results) == 5
+        np.testing.assert_allclose(dists, 0.0, atol=1e-6)
+        assert all(r.getIndex() != 0 for r in results)
 
     def test_search_fills_provided_lists(self):
         items = np.eye(4, dtype=np.float32)

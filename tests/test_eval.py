@@ -105,6 +105,25 @@ def test_roc_binary_per_output_mask():
     assert abs(r.calculateAUC(1) - 1.0) < 1e-9
 
 
+def test_roc_binary_per_example_column_mask():
+    # (N, 1) mask is the per-example column convention, not per-output
+    labels = np.array([[1, 1], [1, 0], [0, 1], [0, 0]], np.float32)
+    preds = np.array([[0.9, 0.8], [0.8, 0.7], [0.2, 0.6], [0.1, 0.2]],
+                     np.float32)
+    r = ROCBinary()
+    r.eval(labels, preds, mask=np.array([[1], [0], [1], [1]], np.float32))
+    # dropping example 1 removes col 1's mis-ranked pair -> both AUC 1
+    assert abs(r.calculateAUC(0) - 1.0) < 1e-9
+    assert abs(r.calculateAUC(1) - 1.0) < 1e-9
+    # a 2D mask whose width matches neither 1 nor C is an error
+    r2 = ROCBinary()
+    try:
+        r2.eval(labels, preds, mask=np.ones((4, 3), np.float32))
+        assert False, "expected ValueError"
+    except ValueError as e:
+        assert "mask" in str(e)
+
+
 def test_roc_binary_timeseries_fold():
     r = ROCBinary()
     labels = np.array([[[1], [0]], [[1], [0]]], np.float32)   # (B,T,C)

@@ -655,3 +655,66 @@ class TestConvTranspose:
         # explicit zeros are fine
         KerasModelImport.importKerasSequentialModelAndWeights(
             mk({"output_padding": [0, 0]}))
+
+
+class TestRound4Session4Import:
+    """SpatialDropout -> real channel-wise dropout; LocallyConnected1D/2D."""
+
+    def _seq_model(self, layers, input_shape):
+        return {"class_name": "Sequential",
+                "config": {"layers": [
+                    {"class_name": "InputLayer",
+                     "config": {"batch_input_shape": [None] + list(input_shape)}}
+                ] + layers}}
+
+    def test_spatial_dropout_imports_channelwise(self):
+        from deeplearning4j_tpu.nn.conf.layers import DropoutLayer
+        from deeplearning4j_tpu.nn.dropout import SpatialDropout
+        m = self._seq_model([
+            {"class_name": "SpatialDropout2D", "config": {"rate": 0.3}},
+            {"class_name": "Conv2D",
+             "config": {"filters": 4, "kernel_size": [3, 3],
+                        "padding": "same", "activation": "relu"}},
+            {"class_name": "Flatten", "config": {}},
+            {"class_name": "Dense",
+             "config": {"units": 2, "activation": "softmax"}},
+        ], [8, 8, 3])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(m)
+        assert isinstance(net.layers[0], DropoutLayer)
+        assert isinstance(net.layers[0].dropOut, SpatialDropout)
+        assert abs(net.layers[0].dropOut.p - 0.7) < 1e-9  # retain = 1-rate
+
+    def test_locally_connected_2d(self):
+        from deeplearning4j_tpu.nn.conf.special_layers import \
+            LocallyConnected2D
+        m = self._seq_model([
+            {"class_name": "LocallyConnected2D",
+             "config": {"filters": 5, "kernel_size": [3, 3],
+                        "strides": [1, 1], "padding": "valid",
+                        "activation": "relu"}},
+            {"class_name": "Flatten", "config": {}},
+            {"class_name": "Dense",
+             "config": {"units": 2, "activation": "softmax"}},
+        ], [7, 7, 2])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(m)
+        assert isinstance(net.layers[0], LocallyConnected2D)
+        x = np.random.default_rng(1).standard_normal((2, 7, 7, 2)).astype(
+            np.float32)
+        assert net.output(x).numpy().shape == (2, 2)
+
+    def test_locally_connected_1d(self):
+        from deeplearning4j_tpu.nn.conf.special_layers import \
+            LocallyConnected1D
+        m = self._seq_model([
+            {"class_name": "LocallyConnected1D",
+             "config": {"filters": 6, "kernel_size": [3],
+                        "activation": "tanh"}},
+            {"class_name": "GlobalAveragePooling1D", "config": {}},
+            {"class_name": "Dense",
+             "config": {"units": 2, "activation": "softmax"}},
+        ], [9, 4])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(m)
+        assert isinstance(net.layers[0], LocallyConnected1D)
+        x = np.random.default_rng(2).standard_normal((2, 9, 4)).astype(
+            np.float32)
+        assert net.output(x).numpy().shape == (2, 2)

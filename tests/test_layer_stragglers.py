@@ -326,3 +326,95 @@ class TestWeightNoiseOnGraph:
         o1 = np.asarray(net.output([x]).numpy())
         o2 = np.asarray(net.output([x]).numpy())
         np.testing.assert_array_equal(o1, o2)
+
+
+class TestSpatialDropout:
+    def test_drops_whole_channels(self):
+        from deeplearning4j_tpu.nn.dropout import SpatialDropout
+        x = jnp.ones((4, 5, 5, 16), jnp.float32)
+        y = np.asarray(SpatialDropout(0.5).apply(x, jax.random.PRNGKey(0)))
+        # every (example, channel) slab is constant: all 0 or all 1/p
+        for b in range(4):
+            for c in range(16):
+                slab = y[b, :, :, c]
+                assert slab.min() == slab.max()
+                assert slab.max() in (0.0, 2.0)
+        # some dropped, some kept
+        flat = y[:, 0, 0, :]
+        assert (flat == 0).any() and (flat == 2.0).any()
+
+    def test_sequence_layout_and_noop_outside_train(self):
+        from deeplearning4j_tpu.nn.dropout import SpatialDropout
+        x = jnp.ones((2, 7, 8), jnp.float32)          # (B, T, F)
+        y = np.asarray(SpatialDropout(0.5).apply(x, jax.random.PRNGKey(1)))
+        assert (y.min(axis=1) == y.max(axis=1)).all()  # constant over T
+        assert np.array_equal(
+            np.asarray(SpatialDropout(1.0).apply(x, jax.random.PRNGKey(1))),
+            np.asarray(x))
+
+    def test_network_trains_with_spatial_dropout(self):
+        from deeplearning4j_tpu.nn.dropout import SpatialDropout
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(ConvolutionLayer(nOut=4, kernelSize=(3, 3),
+                                        activation="relu",
+                                        dropOut=SpatialDropout(0.8)))
+                .layer(OutputLayer(lossFunction="mse", nOut=2,
+                                   activation="identity"))
+                .setInputType(InputType.convolutionalFlat(8, 8, 1)).build())
+        net = MultiLayerNetwork(conf).init()
+        x, y = _rand((8, 64)), _rand((8, 2), 1)
+        net.fit(x, y)
+        # inference is deterministic (no dropout outside train)
+        assert np.array_equal(np.asarray(net.output(x)),
+                              np.asarray(net.output(x)))
+
+
+class TestLocallyConnected1D:
+    def _net(self, mode="truncate", k=3, s=1):
+        from deeplearning4j_tpu.nn.conf.special_layers import \
+            LocallyConnected1D
+        from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+        conf = (NeuralNetConfiguration.Builder().seed(2).updater(Adam(1e-2))
+                .list()
+                .layer(LocallyConnected1D(nOut=5, kernelSize=k, stride=s,
+                                          convolutionMode=mode,
+                                          activation="identity"))
+                .layer(GlobalPoolingLayer("avg"))
+                .layer(OutputLayer(lossFunction="mse", nOut=2,
+                                   activation="identity"))
+                .setInputType(InputType.recurrent(4, 9)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_unshared_weights_oracle(self):
+        net = self._net()
+        x = _rand((2, 9, 4), 3)
+        W = np.asarray(net._params["0"]["W"])      # (ot, k*F, out)
+        b = np.asarray(net._params["0"]["b"])
+        acts = np.asarray(net.feedForward(x)[0])   # layer-0 output
+        ot = W.shape[0]
+        assert acts.shape == (2, 7, 5)             # (9 - 3) // 1 + 1
+        for t in range(ot):
+            patch = x[:, t:t + 3, :].reshape(2, -1)
+            np.testing.assert_allclose(acts[:, t], patch @ W[t] + b[t],
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_same_mode_shape_and_training(self):
+        net = self._net(mode="same", s=1)
+        x, y = _rand((4, 9, 4)), _rand((4, 2), 1)
+        assert np.asarray(net.feedForward(x)[0]).shape == (4, 9, 5)
+        losses = []
+        for _ in range(30):
+            net.fit(x, y)
+            losses.append(net.score())
+        assert losses[-1] < losses[0] * 0.7
+
+    def test_requires_known_length(self):
+        from deeplearning4j_tpu.nn.conf.special_layers import \
+            LocallyConnected1D
+        with pytest.raises(ValueError, match="timeSeriesLength"):
+            (NeuralNetConfiguration.Builder().list()
+             .layer(LocallyConnected1D(nOut=5))
+             .layer(OutputLayer(lossFunction="mse", nOut=2,
+                                activation="identity"))
+             .setInputType(InputType.recurrent(4)).build())
