@@ -1,0 +1,71 @@
+"""Unsupervised analysis toolchain (≡ dl4j-examples usage of
+deeplearning4j-clustering KMeansClustering, VPTree nearest neighbors,
+BarnesHutTsne visualization, and deeplearning4j-graph DeepWalk):
+cluster a feature set, find nearest neighbors, project to 2-D, and embed
+a graph's vertices — all on the accelerator (the Lloyd loop, the kNN
+distance matrix, and the t-SNE descent each run as one jitted program).
+"""
+import numpy as np
+
+from deeplearning4j_tpu.clustering import (BarnesHutTsne, KMeansClustering,
+                                           Point, VPTree, knn)
+from deeplearning4j_tpu.graph import DeepWalk, Graph
+
+
+def make_blobs(n_per=60, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = np.array([[0, 0, 0, 0], [6, 6, 0, 0], [0, 0, 6, 6]], np.float32)
+    x = np.concatenate([rng.randn(n_per, 4).astype(np.float32) * 0.6 + c
+                        for c in centers])
+    return x, np.repeat(np.arange(3), n_per)
+
+
+def main():
+    x, true_labels = make_blobs()
+
+    # 1. KMeans: whole Lloyd refinement is one jitted while_loop
+    kmc = KMeansClustering.setup(3, maxIterationCount=50,
+                                 useKMeansPlusPlus=True)
+    cluster_set = kmc.applyTo(Point.toPoints(x))
+    for cl in cluster_set.getClusters():
+        print(f"cluster {cl.getId()}: {len(cl.getPoints())} points, "
+              f"center {np.round(cl.getCenter(), 1)}")
+    pc = cluster_set.classifyPoint(Point([6.1, 5.8, 0.2, -0.1]))
+    print(f"query point -> cluster {pc.getCluster().getId()} "
+          f"(distance {pc.getDistanceFromCenter():.2f})")
+
+    # 2. Nearest neighbors: batched exact kNN = one GEMM + top-k on device
+    idx, dist = knn(x[:5], x, k=4)
+    print("kNN of point 0 (self first):", idx[0], np.round(dist[0], 2))
+    # ... and the API-parity host-side VPTree for trickle queries
+    tree = VPTree(x, "euclidean")
+    results, dists = tree.search(x[0], 4)
+    assert [r.getIndex() for r in results] == list(idx[0])
+
+    # 3. t-SNE: exact O(N^2) gradients on the MXU, one jitted descent
+    tsne = (BarnesHutTsne.Builder().setMaxIter(400).perplexity(20)
+            .learningRate(200).seed(0).build())
+    emb = tsne.fit(x).getData()
+    d = np.sqrt(((emb[:, None] - emb[None, :]) ** 2).sum(-1))
+    same = d[true_labels[:, None] == true_labels[None, :]].mean()
+    diff = d[true_labels[:, None] != true_labels[None, :]].mean()
+    print(f"t-SNE 2-D embedding: intra-blob dist {same:.2f} "
+          f"vs inter-blob {diff:.2f}")
+
+    # 4. DeepWalk: random walks host-side, skip-gram updates on device
+    g = Graph(16)
+    for base in (0, 8):                      # two 8-cliques + one bridge
+        for i in range(8):
+            for j in range(i + 1, 8):
+                g.addEdge(base + i, base + j)
+    g.addEdge(7, 8)
+    dw = (DeepWalk.Builder().vectorSize(16).windowSize(4)
+          .learningRate(0.5).epochs(40).batchSize(256).seed(1).build())
+    dw.fit(g, walk_length=10)
+    print(f"DeepWalk: sim(0,3) same community = {dw.similarity(0, 3):.2f}, "
+          f"sim(0,12) across bridge = {dw.similarity(0, 12):.2f}")
+    print("nearest to vertex 0:", dw.verticesNearest(0, top=4))
+
+
+if __name__ == "__main__":
+    main()
