@@ -3,9 +3,10 @@ deeplearning4j-nearestneighbors, org.deeplearning4j.plot)."""
 from deeplearning4j_tpu.clustering.kmeans import (Cluster, ClusterSet,
                                                   KMeansClustering, Point,
                                                   PointClassification)
+from deeplearning4j_tpu.clustering.nn_server import NearestNeighborsServer
 from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne, Tsne
 from deeplearning4j_tpu.clustering.vptree import DataPoint, VPTree, knn
 
 __all__ = ["KMeansClustering", "Point", "Cluster", "ClusterSet",
            "PointClassification", "BarnesHutTsne", "Tsne", "VPTree",
-           "DataPoint", "knn"]
+           "DataPoint", "knn", "NearestNeighborsServer"]

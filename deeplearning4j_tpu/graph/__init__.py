@@ -1,8 +1,10 @@
 """Graph embeddings (≡ deeplearning4j-graph)."""
-from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors
+from deeplearning4j_tpu.graph.deepwalk import (DeepWalk, GraphVectors,
+                                               GraphVectorsSerializer)
 from deeplearning4j_tpu.graph.graph import (Edge, Graph, RandomWalkIterator,
                                             Vertex,
                                             WeightedRandomWalkIterator)
 
 __all__ = ["Graph", "Vertex", "Edge", "RandomWalkIterator",
-           "WeightedRandomWalkIterator", "DeepWalk", "GraphVectors"]
+           "WeightedRandomWalkIterator", "DeepWalk", "GraphVectors",
+           "GraphVectorsSerializer"]

@@ -154,3 +154,37 @@ class DeepWalk(GraphVectors):
                     jnp.asarray(c), jnp.asarray(t), jnp.asarray(negs),
                     jnp.asarray(w))
         return self
+
+
+class GraphVectorsSerializer:
+    """≡ deeplearning4j-graph :: models.embeddings.GraphVectorsSerializer.
+    Vertex embeddings in word2vec C format with vertex ids as the words —
+    interoperable with WordVectorSerializer/loadStaticModel tooling."""
+
+    @staticmethod
+    def writeGraphVectors(deepwalk, path, binary=False):
+        from deeplearning4j_tpu.nlp.serializer import (StaticWordVectors,
+                                                       WordVectorSerializer)
+        table = np.asarray(deepwalk.params["syn0"], np.float32)
+        shim = StaticWordVectors(table,
+                                 [str(i) for i in range(table.shape[0])])
+        WordVectorSerializer.writeWord2VecModel(shim, path, binary=binary)
+
+    @staticmethod
+    def readGraphVectors(path, binary=None):
+        """Returns a GraphVectors with vertex i at table row i. The file
+        must use contiguous integer vertex ids as its words."""
+        from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+        sv = WordVectorSerializer.readWord2VecModel(path, binary=binary)
+        table = np.asarray(sv._table(), np.float32)
+        order = []
+        for i in range(table.shape[0]):
+            idx = sv.vocab.indexOf(str(i))
+            if idx < 0:
+                raise ValueError(
+                    f"not a graph-vectors file: vertex id {i} missing "
+                    f"(words must be the contiguous ids 0..{table.shape[0] - 1})")
+            order.append(idx)
+        gv = GraphVectors()
+        gv.params = {"syn0": table[order]}
+        return gv

@@ -192,3 +192,76 @@ class TestTsne:
              .learningRate(0.5).build())
         t.fit(x)
         assert np.isfinite(t.getData()).all()
+
+
+class TestNearestNeighborsServer:
+    def _corpus(self):
+        rng = np.random.RandomState(0)
+        return rng.randn(50, 4).astype(np.float32)
+
+    def test_query_core_matches_oracle(self):
+        from deeplearning4j_tpu.clustering import NearestNeighborsServer
+        pts = self._corpus()
+        srv = NearestNeighborsServer(pts)
+        res = srv.query_index(3, 4)
+        d = np.sqrt(((pts - pts[3]) ** 2).sum(-1))
+        oracle = [i for i in np.argsort(d) if i != 3][:4]
+        assert [r["index"] for r in res] == oracle
+        # new-vector query, batched
+        out = srv.query_vectors(pts[:2], 3)
+        assert len(out) == 2 and out[0][0]["index"] == 0
+        single = srv.query_vectors(pts[5], 2)
+        assert single[0]["index"] == 5 and single[0]["distance"] == 0.0
+
+    def test_http_endpoints(self):
+        import json
+        import urllib.request
+        from deeplearning4j_tpu.clustering import NearestNeighborsServer
+        pts = self._corpus()
+        srv = NearestNeighborsServer(pts, port=0).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(f"{base}/status") as r:
+                st = json.loads(r.read())
+            assert st == {"points": 50, "dim": 4, "similarity": "euclidean"}
+
+            def post(path, payload):
+                req = urllib.request.Request(
+                    base + path, json.dumps(payload).encode(),
+                    {"Content-Type": "application/json"})
+                with urllib.request.urlopen(req) as r:
+                    return json.loads(r.read())
+
+            knn_res = post("/knn", {"index": 3, "k": 2})["results"]
+            assert len(knn_res) == 2 and knn_res[0]["distance"] > 0
+            new_res = post("/knnnew", {"arr": pts[7].tolist(), "k": 1})
+            assert new_res["results"][0]["index"] == 7
+            # bad request reports the error instead of crashing
+            try:
+                post("/knn", {"k": 2})
+                assert False, "expected HTTP 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            srv.stop()
+
+    def test_vptree_backend_agrees(self):
+        from deeplearning4j_tpu.clustering import NearestNeighborsServer
+        pts = self._corpus()
+        gemm = NearestNeighborsServer(pts)
+        tree = NearestNeighborsServer(pts, useVpTree=True)
+        for q in range(3):
+            a = gemm.query_index(q, 5)
+            b = tree.query_index(q, 5)
+            assert [r["index"] for r in a] == [r["index"] for r in b]
+
+    def test_negative_and_out_of_range_index(self):
+        from deeplearning4j_tpu.clustering import NearestNeighborsServer
+        pts = self._corpus()
+        srv = NearestNeighborsServer(pts)
+        # -1 means the last point, and it must still exclude itself
+        res = srv.query_index(-1, 3)
+        assert all(r["index"] != len(pts) - 1 for r in res)
+        assert res == srv.query_index(len(pts) - 1, 3)
+        with pytest.raises(IndexError):
+            srv.query_index(len(pts), 2)

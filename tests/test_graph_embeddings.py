@@ -1,4 +1,5 @@
 """deeplearning4j-graph parity tests: structure, random walks, DeepWalk."""
+import pytest
 import numpy as np
 
 from deeplearning4j_tpu.graph import (DeepWalk, Graph, RandomWalkIterator,
@@ -131,3 +132,32 @@ class TestDeepWalk:
         dw = (DeepWalk.Builder().vectorSize(8).epochs(2).seed(1).build())
         dw.fit(RandomWalkIterator(g, walk_length=8, seed=2))
         assert dw.getVertexVector(0).shape == (8,)
+
+
+class TestGraphVectorsSerializer:
+    def test_roundtrip_exact(self, tmp_path):
+        from deeplearning4j_tpu.graph.deepwalk import GraphVectorsSerializer
+        g = _barbell()
+        dw = (DeepWalk.Builder().vectorSize(8).learningRate(0.5).epochs(10)
+              .batchSize(128).seed(5).build())
+        dw.fit(g, walk_length=8)
+        p = str(tmp_path / "gv.txt")
+        GraphVectorsSerializer.writeGraphVectors(dw, p)
+        back = GraphVectorsSerializer.readGraphVectors(p)
+        assert back.numVertices() == 12 and back.getVectorSize() == 8
+        for v in range(12):
+            np.testing.assert_allclose(back.getVertexVector(v),
+                                       dw.getVertexVector(v), atol=1e-4)
+        assert back.similarity(0, 1) == pytest.approx(dw.similarity(0, 1),
+                                                      abs=1e-4)
+
+    def test_rejects_non_graph_word2vec_file(self, tmp_path):
+        from deeplearning4j_tpu.graph import GraphVectorsSerializer
+        from deeplearning4j_tpu.nlp.serializer import (StaticWordVectors,
+                                                       WordVectorSerializer)
+        p = str(tmp_path / "words.txt")
+        WordVectorSerializer.writeWord2VecModel(
+            StaticWordVectors(np.eye(3, dtype=np.float32),
+                              ["0", "1", "cat"]), p)
+        with pytest.raises(ValueError, match="vertex id 2 missing"):
+            GraphVectorsSerializer.readGraphVectors(p)
