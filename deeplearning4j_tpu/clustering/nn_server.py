@@ -36,10 +36,11 @@ __all__ = ["NearestNeighborsServer"]
 
 class NearestNeighborsServer:
     def __init__(self, points, similarity_function="euclidean", port=9000,
-                 useVpTree=False):
+                 useVpTree=False, host="127.0.0.1"):
         self.points = np.asarray(points, np.float32)
         self.fn = str(similarity_function).lower()
         self.port = int(port)
+        self.host = str(host)    # "0.0.0.0" to serve non-local clients
         self._tree = (VPTree(self.points, self.fn) if useVpTree else None)
         self._httpd = None
         self._thread = None
@@ -117,7 +118,7 @@ class NearestNeighborsServer:
                 except Exception as e:  # noqa: BLE001 — report to client
                     self._send(400, {"error": str(e)})
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]   # resolves port=0
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)

@@ -23,7 +23,7 @@ import numpy as np
 from deeplearning4j_tpu.graph.graph import RandomWalkIterator
 from deeplearning4j_tpu.nlp.word2vec import _sgns_step
 
-__all__ = ["DeepWalk", "GraphVectors"]
+__all__ = ["DeepWalk", "GraphVectors", "GraphVectorsSerializer"]
 
 
 class GraphVectors:

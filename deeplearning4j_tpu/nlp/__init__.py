@@ -18,6 +18,8 @@ from deeplearning4j_tpu.nlp.serializer import (StaticWordVectors,
                                                WordVectorSerializer)
 from deeplearning4j_tpu.nlp.cnn_sentence_iterator import (
     CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider)
+from deeplearning4j_tpu.nlp.sequence_vectors import (AbstractSequenceIterator,
+                                                     SequenceVectors)
 
 __all__ = [
     "WordVectorSerializer", "StaticWordVectors",
@@ -27,4 +29,5 @@ __all__ = [
     "build_vocab", "Word2Vec", "WordVectors", "LabelledDocument",
     "ParagraphVectors", "Glove", "FastText", "char_ngrams",
     "CnnSentenceDataSetIterator", "CollectionLabeledSentenceProvider",
+    "SequenceVectors", "AbstractSequenceIterator",
 ]

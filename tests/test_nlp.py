@@ -322,3 +322,69 @@ class TestCnnSentenceIterator:
               .sentenceProvider(prov).wordVectors(self._wv())
               .maxSentenceLength(4).build())
         assert it.next().features.shape[2] == 4
+
+
+class TestSequenceVectors:
+    def test_embeds_arbitrary_elements(self):
+        from deeplearning4j_tpu.nlp import (AbstractSequenceIterator,
+                                            SequenceVectors)
+        # product-id style elements (spaces + punctuation allowed: no
+        # tokenizer is involved); two co-occurrence groups
+        rng = np.random.default_rng(0)
+        group_a = [f"item A{i}" for i in range(6)]
+        group_b = [f"item B{i}" for i in range(6)]
+        seqs = []
+        for _ in range(300):
+            g = group_a if rng.random() < 0.5 else group_b
+            seqs.append(list(rng.choice(g, size=6)))
+        sv = (SequenceVectors.Builder()
+              .layerSize(32).windowSize(3).epochs(30).seed(7)
+              .learningRate(0.3).batchSize(512).sampling(0)
+              .iterate(AbstractSequenceIterator(seqs))
+              .build().fit())
+        assert sv.vocabSize() == 12
+        assert sv.hasWord("item A1")
+        # same criterion as TestWord2Vec: nearest neighbors are dominated
+        # by the element's own co-occurrence group
+        for probe in ("item A1", "item B1", "item A3", "item B4"):
+            near = sv.wordsNearest(probe, topN=3)
+            assert probe not in near
+            group = probe[:6]
+            assert all(w.startswith(group) for w in near), (probe, near)
+
+    def test_plain_list_input(self):
+        from deeplearning4j_tpu.nlp import SequenceVectors
+        sv = (SequenceVectors.Builder().layerSize(8).epochs(1).seed(0)
+              .iterate([["x", "y", "z"], ["x", "z"]]).build().fit())
+        assert sv.vocabSize() == 3
+        assert sv.getWordVector("x").shape == (8,)
+
+    def test_numerically_identical_to_word2vec(self):
+        """Same corpus, same hyperparameters: SequenceVectors must produce
+        the EXACT Word2Vec embedding table (it is the same pipeline)."""
+        from deeplearning4j_tpu.nlp import (AbstractSequenceIterator,
+                                            SequenceVectors)
+        rng = np.random.default_rng(4)
+        words = [f"w{i}" for i in range(8)]
+        seqs = [list(rng.choice(words, size=5)) for _ in range(40)]
+        kw = dict(layerSize=12, seed=9, epochs=2)
+        w2v = (Word2Vec.Builder().minWordFrequency(1).layerSize(12).seed(9)
+               .windowSize(3).epochs(2).sampling(0).learningRate(0.05)
+               .batchSize(128)
+               .iterate(CollectionSentenceIterator(
+                   [" ".join(s) for s in seqs]))
+               .tokenizerFactory(DefaultTokenizerFactory()).build().fit())
+        sv = (SequenceVectors.Builder().layerSize(12).seed(9).windowSize(3)
+              .epochs(2).sampling(0).learningRate(0.05).batchSize(128)
+              .iterate(AbstractSequenceIterator(seqs)).build().fit())
+        assert sv.vocab.words() == w2v.vocab.words()
+        np.testing.assert_array_equal(np.asarray(sv.params["syn0"]),
+                                      np.asarray(w2v.params["syn0"]))
+
+    def test_rejects_raw_strings(self):
+        from deeplearning4j_tpu.nlp import (AbstractSequenceIterator,
+                                            SequenceVectors)
+        with pytest.raises(TypeError, match="ELEMENTS"):
+            AbstractSequenceIterator(["a b c", "d e"])
+        with pytest.raises(TypeError, match="ELEMENTS"):
+            (SequenceVectors.Builder().iterate(["a b c"]).build().fit())
