@@ -20,6 +20,8 @@ from deeplearning4j_tpu.nlp.cnn_sentence_iterator import (
     CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider)
 from deeplearning4j_tpu.nlp.sequence_vectors import (AbstractSequenceIterator,
                                                      SequenceVectors)
+from deeplearning4j_tpu.nlp.vectorizers import (BagOfWordsVectorizer,
+                                                TfidfVectorizer)
 
 __all__ = [
     "WordVectorSerializer", "StaticWordVectors",
@@ -30,4 +32,5 @@ __all__ = [
     "ParagraphVectors", "Glove", "FastText", "char_ngrams",
     "CnnSentenceDataSetIterator", "CollectionLabeledSentenceProvider",
     "SequenceVectors", "AbstractSequenceIterator",
+    "BagOfWordsVectorizer", "TfidfVectorizer",
 ]

@@ -388,3 +388,90 @@ class TestSequenceVectors:
             AbstractSequenceIterator(["a b c", "d e"])
         with pytest.raises(TypeError, match="ELEMENTS"):
             (SequenceVectors.Builder().iterate(["a b c"]).build().fit())
+
+
+class TestVectorizers:
+    DOCS = ["cat dog cat", "dog mouse", "cat cat cat", "mouse mouse dog"]
+
+    def test_bag_of_words_counts(self):
+        from deeplearning4j_tpu.nlp import BagOfWordsVectorizer
+        v = (BagOfWordsVectorizer.Builder().minWordFrequency(1)
+             .iterate(self.DOCS).build().fit())
+        assert v.vocabSize() == 3
+        row = v.transform("cat dog cat")
+        assert row[v.vocab.indexOf("cat")] == 2.0
+        assert row[v.vocab.indexOf("dog")] == 1.0
+        assert row[v.vocab.indexOf("mouse")] == 0.0
+        # OOV words are ignored
+        assert v.transform("zebra zebra").sum() == 0.0
+        assert v.transformAll(self.DOCS).shape == (4, 3)
+
+    def test_tfidf_oracle(self):
+        import math
+        from deeplearning4j_tpu.nlp import TfidfVectorizer
+        v = (TfidfVectorizer.Builder().minWordFrequency(1)
+             .iterate(self.DOCS).build().fit())
+        # df: cat=2, dog=3, mouse=2 over 4 docs; idf = log(1 + N/df)
+        row = v.transform("cat dog cat")
+        idf_cat = math.log(1 + 4 / 2)
+        idf_dog = math.log(1 + 4 / 3)
+        assert row[v.vocab.indexOf("cat")] == pytest.approx(
+            (2 / 3) * idf_cat, rel=1e-6)
+        assert row[v.vocab.indexOf("dog")] == pytest.approx(
+            (1 / 3) * idf_dog, rel=1e-6)
+        assert v.tfidfWord("cat", ["cat", "dog", "cat"]) == pytest.approx(
+            (2 / 3) * idf_cat, rel=1e-6)
+
+    def test_vectorize_to_dataset_and_training(self):
+        from deeplearning4j_tpu.nlp import TfidfVectorizer
+        docs = self.DOCS * 8
+        labels = ["feline" if "cat" in d else "other" for d in docs]
+        v = (TfidfVectorizer.Builder().minWordFrequency(1)
+             .iterate(docs).labels(labels).build().fit())
+        ds = v.vectorize("cat cat dog", "feline")
+        assert ds.features.shape == (1, 3) and ds.labels.shape == (1, 2)
+        assert ds.labels[0, 0] == 1.0  # "feline" < "other" alphabetically
+        # the (N, V) matrix trains a dense classifier end to end
+        from deeplearning4j_tpu.nn import (Adam, InputType,
+                                           MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        x = v.transformAll(docs)
+        y = np.eye(2, dtype=np.float32)[
+            [0 if l == "feline" else 1 for l in labels]]
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(nOut=8, activation="relu"))
+            .layer(OutputLayer(lossFunction="mcxent", nOut=2,
+                               activation="softmax"))
+            .setInputType(InputType.feedForward(3)).build()).init()
+        for _ in range(30):
+            net.fit(x, y)
+        acc = (np.asarray(net.output(x)).argmax(-1) == y.argmax(-1)).mean()
+        assert acc == 1.0
+
+    def test_min_word_frequency_prunes(self):
+        from deeplearning4j_tpu.nlp import BagOfWordsVectorizer
+        v = (BagOfWordsVectorizer.Builder().minWordFrequency(3)
+             .iterate(self.DOCS).build().fit())
+        # cat appears 5x, dog 3x, mouse 3x -> all kept at min 3
+        assert v.vocabSize() == 3
+        v2 = (BagOfWordsVectorizer.Builder().minWordFrequency(4)
+              .iterate(self.DOCS).build().fit())
+        assert v2.vocab.words() == ["cat"]
+
+    def test_guards_and_tokenized_input(self):
+        from deeplearning4j_tpu.nlp import TfidfVectorizer
+        unfit = TfidfVectorizer.Builder().iterate(self.DOCS).build()
+        with pytest.raises(ValueError, match="fit"):
+            unfit.transform("cat")
+        with pytest.raises(ValueError, match="fit"):
+            unfit.tfidfWord("cat", ["cat"])
+        v = unfit.fit()
+        # tuple/list of tokens both accepted as pre-tokenized input
+        np.testing.assert_array_equal(v.transform(("cat", "dog")),
+                                      v.transform(["cat", "dog"]))
+        with pytest.raises(ValueError, match="unknown label"):
+            (TfidfVectorizer.Builder().iterate(self.DOCS)
+             .labels(["a", "b"]).build().fit().vectorize("cat", "zzz"))
