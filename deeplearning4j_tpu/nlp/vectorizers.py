@@ -49,7 +49,10 @@ class _BaseVectorizer:
     def __init__(self, b):
         self.b = b
         self.vocab = None
-        self._labels_list = (sorted(set(b._labels)) if b._labels else None)
+        # declaration order, as the reference's LabelsSource.indexOf —
+        # sorting would silently permute one-hot columns
+        self._labels_list = (list(dict.fromkeys(b._labels))
+                             if b._labels else None)
 
     def _tokens(self, text):
         return self.b._tok.create(text).getTokens()
@@ -58,12 +61,14 @@ class _BaseVectorizer:
         sentences = sentences if sentences is not None else self.b._iter
         if sentences is None:
             raise ValueError("no corpus: pass sentences or Builder.iterate")
-        docs = [self._tokens(s) for s in sentences]
+        self._fit_docs_impl([self._tokens(s) for s in sentences])
+        return self
+
+    def _fit_docs_impl(self, docs):
         self.vocab = build_vocab(docs, self.b._min_count)
         if self.vocab.numWords() == 0:
             raise ValueError("empty vocabulary after min-count pruning")
         self._post_fit(docs)   # docs stay local — not retained past fit
-        return self
 
     def _post_fit(self, docs):
         pass
@@ -106,8 +111,9 @@ class _BaseVectorizer:
         return DataSet(self.transform(text)[None, :], y)
 
     def fitTransform(self, sentences):
-        self.fit(sentences)
-        return self.transformAll(sentences)
+        docs = [self._tokens(s) for s in sentences]   # tokenize ONCE
+        self._fit_docs_impl(docs)
+        return np.stack([self.transform(d) for d in docs])
 
 
 class BagOfWordsVectorizer(_BaseVectorizer):

@@ -14,6 +14,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from deeplearning4j_tpu.util.crash_reporting import \
+    with_crash_dump
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.nn.multilayer import (_apply_layer, _hook_params,
                                               _l1l2_penalty)
@@ -250,6 +252,7 @@ class ComputationGraph:
             inputs = [inputs]
         return {n: as_jax(v) for n, v in zip(self.conf.input_names, inputs)}
 
+    @with_crash_dump
     def output(self, *inputs, train=False, fmasks=None):
         if len(inputs) == 1:
             inputs = inputs[0]
@@ -486,6 +489,7 @@ class ComputationGraph:
         leaves, treedef = jax.tree_util.tree_flatten(unpacked_or_ds)
         return (str(treedef), tuple(jnp.shape(x) for x in leaves))
 
+    @with_crash_dump
     def fit(self, data, labels=None, epochs=None, stepsPerDispatch=1):
         """stepsPerDispatch > 1 (iterator form): group consecutive
         same-structure batches into one scanned dispatch — numerically

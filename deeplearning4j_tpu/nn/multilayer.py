@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from deeplearning4j_tpu.util.crash_reporting import \
+    with_crash_dump
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.nn.updaters import Updater, build_optimizer, same_updater
 from deeplearning4j_tpu.ops.ndarray import NDArray, as_jax, resolve_dtype
@@ -227,6 +229,7 @@ class MultiLayerNetwork:
             return x, preact, new_state, acts, new_carries
         return x, preact, new_state, acts
 
+    @with_crash_dump
     def output(self, x, train=False, fmask=None):
         x = as_jax(x)
         fmask = None if fmask is None else as_jax(fmask)
@@ -584,6 +587,7 @@ class MultiLayerNetwork:
             self.pretrainLayer(i, data, epochs)
         return self
 
+    @with_crash_dump
     def fit(self, data, labels=None, epochs=None, stepsPerDispatch=1):
         """stepsPerDispatch > 1 (iterator form only): group consecutive
         same-shape batches and run each group as ONE lax.scan dispatch —

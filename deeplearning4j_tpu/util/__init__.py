@@ -1,5 +1,7 @@
+from deeplearning4j_tpu.util.crash_reporting import CrashReportingUtil
 from deeplearning4j_tpu.util.model_guesser import (ModelGuesser,
                                                    ModelGuesserException)
 from deeplearning4j_tpu.util.model_serializer import ModelSerializer
 
-__all__ = ["ModelSerializer", "ModelGuesser", "ModelGuesserException"]
+__all__ = ["ModelSerializer", "ModelGuesser", "ModelGuesserException",
+           "CrashReportingUtil"]

@@ -430,7 +430,7 @@ class TestVectorizers:
              .iterate(docs).labels(labels).build().fit())
         ds = v.vectorize("cat cat dog", "feline")
         assert ds.features.shape == (1, 3) and ds.labels.shape == (1, 2)
-        assert ds.labels[0, 0] == 1.0  # "feline" < "other" alphabetically
+        assert ds.labels[0, 0] == 1.0  # "feline" first in declaration order
         # the (N, V) matrix trains a dense classifier end to end
         from deeplearning4j_tpu.nn import (Adam, InputType,
                                            MultiLayerNetwork,
@@ -475,3 +475,17 @@ class TestVectorizers:
         with pytest.raises(ValueError, match="unknown label"):
             (TfidfVectorizer.Builder().iterate(self.DOCS)
              .labels(["a", "b"]).build().fit().vectorize("cat", "zzz"))
+
+    def test_label_declaration_order_preserved(self):
+        from deeplearning4j_tpu.nlp import BagOfWordsVectorizer
+        v = (BagOfWordsVectorizer.Builder().iterate(self.DOCS)
+             .labels(["positive", "negative"]).build().fit())
+        # NOT alphabetical: column 0 must be "positive" as declared
+        assert v.vectorize("cat", "positive").labels[0, 0] == 1.0
+        assert v.vectorize("cat", "negative").labels[0, 1] == 1.0
+
+    def test_fit_transform_matches_fit_then_transform(self):
+        from deeplearning4j_tpu.nlp import TfidfVectorizer
+        a = (TfidfVectorizer.Builder().build()).fitTransform(self.DOCS)
+        v = TfidfVectorizer.Builder().iterate(self.DOCS).build().fit()
+        np.testing.assert_array_equal(a, v.transformAll(self.DOCS))

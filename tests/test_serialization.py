@@ -213,3 +213,97 @@ class TestLossObjectSerde:
             size=(4, 5)).astype(np.float32))
         assert np.isclose(float(LossMSE(weights=[1.] * 5)(lab, pre)),
                           float(mse(lab, pre)))
+
+
+class TestCrashReporting:
+    def test_oom_detection_and_dump_contents(self, tmp_path):
+        from deeplearning4j_tpu.util.crash_reporting import CrashReportingUtil
+        net = _mlp()
+        net.fit(X, Y)
+        err = RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating "
+                           "8589934592 bytes")
+        assert CrashReportingUtil.is_oom(err)
+        assert not CrashReportingUtil.is_oom(ValueError("bad shape"))
+        p = CrashReportingUtil.writeMemoryCrashDump(
+            net, err, str(tmp_path / "dump.txt"))
+        text = open(p).read()
+        assert "RESOURCE_EXHAUSTED" in text
+        assert "TOTAL params" in text
+        assert "updater state" in text
+        assert "remat" in text and "ZeRO-1" in text
+
+    def test_fit_writes_dump_on_oom_and_reraises(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.util.crash_reporting import CrashReportingUtil
+        net = _mlp()
+        CrashReportingUtil.crashDumpOutputDirectory(str(tmp_path))
+        try:
+            def boom(*a, **k):
+                raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+            monkeypatch.setattr(net, "_fit_batch", boom)
+            with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+                net.fit(X, Y)
+            dumps = list(tmp_path.glob("dl4j-tpu-memory-crash-dump-*.txt"))
+            assert len(dumps) == 1
+        finally:
+            CrashReportingUtil.crashDumpOutputDirectory(".")
+
+    def test_non_oom_errors_write_nothing(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.util.crash_reporting import CrashReportingUtil
+        net = _mlp()
+        CrashReportingUtil.crashDumpOutputDirectory(str(tmp_path))
+        try:
+            def boom(*a, **k):
+                raise ValueError("shape mismatch")
+            monkeypatch.setattr(net, "_fit_batch", boom)
+            with pytest.raises(ValueError):
+                net.fit(X, Y)
+            assert not list(tmp_path.glob("*.txt"))
+        finally:
+            CrashReportingUtil.crashDumpOutputDirectory(".")
+
+    def test_disable_flag(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.util.crash_reporting import CrashReportingUtil
+        net = _mlp()
+        CrashReportingUtil.crashDumpOutputDirectory(str(tmp_path))
+        CrashReportingUtil.crashDumpsEnabled(False)
+        try:
+            def boom(*a, **k):
+                raise RuntimeError("RESOURCE_EXHAUSTED")
+            monkeypatch.setattr(net, "_fit_batch", boom)
+            with pytest.raises(RuntimeError):
+                net.fit(X, Y)
+            assert not list(tmp_path.glob("*.txt"))
+        finally:
+            CrashReportingUtil.crashDumpsEnabled(True)
+            CrashReportingUtil.crashDumpOutputDirectory(".")
+
+    def test_is_oom_word_boundary(self):
+        from deeplearning4j_tpu.util.crash_reporting import CrashReportingUtil
+        assert not CrashReportingUtil.is_oom(
+            ValueError("bad shape for BLOOM_head tensor"))
+        assert CrashReportingUtil.is_oom(RuntimeError("device OOM hit"))
+
+    def test_one_dump_per_exception_object(self, tmp_path):
+        from deeplearning4j_tpu.util.crash_reporting import CrashReportingUtil
+        net = _mlp()
+        CrashReportingUtil.crashDumpOutputDirectory(str(tmp_path))
+        try:
+            err = RuntimeError("RESOURCE_EXHAUSTED")
+            assert CrashReportingUtil.maybe_dump(net, err) is not None
+            # nested decorated frames see the same exception object
+            assert CrashReportingUtil.maybe_dump(net, err) is None
+            assert len(list(tmp_path.glob("*.txt"))) == 1
+        finally:
+            CrashReportingUtil.crashDumpOutputDirectory(".")
+
+    def test_same_second_dumps_do_not_collide(self, tmp_path):
+        from deeplearning4j_tpu.util.crash_reporting import CrashReportingUtil
+        net = _mlp()
+        CrashReportingUtil.crashDumpOutputDirectory(str(tmp_path))
+        try:
+            for _ in range(2):   # fresh exception objects, same second
+                CrashReportingUtil.maybe_dump(
+                    net, RuntimeError("RESOURCE_EXHAUSTED"))
+            assert len(list(tmp_path.glob("*.txt"))) == 2
+        finally:
+            CrashReportingUtil.crashDumpOutputDirectory(".")
