@@ -4,6 +4,7 @@ from deeplearning4j_tpu.models.zoo.models import (AlexNet, LeNet, ResNet50,
                                                   TinyYOLO, UNet, VGG16,
                                                   ZooModel)
 from deeplearning4j_tpu.models.zoo.models2 import (Darknet19,
+                                                   EfficientNet,
                                                    FaceNetNN4Small2,
                                                    InceptionResNetV1,
                                                    NASNet, SqueezeNet, VGG19,
@@ -12,4 +13,5 @@ from deeplearning4j_tpu.models.zoo.models2 import (Darknet19,
 __all__ = ["AlexNet", "LeNet", "ResNet50", "SimpleCNN",
            "TextGenerationLSTM", "TinyYOLO", "UNet", "VGG16", "ZooModel",
            "Darknet19", "InceptionResNetV1", "SqueezeNet", "VGG19",
-           "Xception", "YOLO2", "FaceNetNN4Small2", "NASNet"]
+           "Xception", "YOLO2", "FaceNetNN4Small2", "NASNet",
+           "EfficientNet"]

@@ -574,3 +574,124 @@ class NASNet(ZooModel):
                                       activation="softmax"), "drop")
         g.setOutputs("out")
         return g.build()
+
+
+class EfficientNet(ZooModel):
+    """≡ zoo.model.EfficientNet (1.0.0-M1 zoo addition) — MBConv stacks
+    with squeeze-and-excitation, swish activations, and compound
+    width/depth/resolution scaling (variants B0-B7).
+
+    TPU-first notes: depthwise convs use the grouped-conv MXU path
+    (DepthwiseConvolution2D), SE channel gating is a broadcasted
+    ElementWiseVertex product against a (1, 1, C) ReshapeVertex output
+    (XLA fuses the gap→dense→dense→scale chain into the block), and the
+    whole network remains one jitted program like every zoo model."""
+
+    #: variant -> (width_mult, depth_mult, default resolution, dropout)
+    VARIANTS = {"B0": (1.0, 1.0, 224, 0.2), "B1": (1.0, 1.1, 240, 0.2),
+                "B2": (1.1, 1.2, 260, 0.3), "B3": (1.2, 1.4, 300, 0.3),
+                "B4": (1.4, 1.8, 380, 0.4), "B5": (1.6, 2.2, 456, 0.4),
+                "B6": (1.8, 2.6, 528, 0.5), "B7": (2.0, 3.1, 600, 0.5)}
+
+    #: base (B0) stage spec: expand, channels, repeats, stride, kernel
+    STAGES = ((1, 16, 1, 1, 3), (6, 24, 2, 2, 3), (6, 40, 2, 2, 5),
+              (6, 80, 3, 2, 3), (6, 112, 3, 1, 5), (6, 192, 4, 2, 5),
+              (6, 320, 1, 1, 3))
+
+    def __init__(self, variant="B0", **kw):
+        if variant not in self.VARIANTS:
+            raise ValueError(f"unknown EfficientNet variant {variant!r}; "
+                             f"pick one of {sorted(self.VARIANTS)}")
+        self.variant = variant
+        w, d, res, drop = self.VARIANTS[variant]
+        self.width_mult, self.depth_mult = w, d
+        self.dropout_rate = drop   # reference scales dropout with size
+        self.DEFAULT_INPUT = (res, res, 3)
+        super().__init__(**kw)
+
+    @staticmethod
+    def _round_filters(filters, width_mult, divisor=8):
+        """Reference filter rounding: scale, snap to divisor, never drop
+        below 90% of the scaled value."""
+        f = filters * width_mult
+        new = max(divisor, int(f + divisor / 2) // divisor * divisor)
+        if new < 0.9 * f:
+            new += divisor
+        return int(new)
+
+    @staticmethod
+    def _round_repeats(repeats, depth_mult):
+        import math
+        return int(math.ceil(repeats * depth_mult))
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.graph_vertices import ReshapeVertex
+        from deeplearning4j_tpu.nn.conf.layers import \
+            DepthwiseConvolution2D
+        h, w, c = self.inputShape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater or Adam(1e-3))
+             .weightInit("relu")
+             .dataType(self.dataType)
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        def conv_bn(name, inp, n_out, k, s, act="swish", groups_dw=False):
+            layer = (DepthwiseConvolution2D(
+                         kernelSize=k, stride=s, hasBias=False,
+                         convolutionMode="same", activation="identity")
+                     if groups_dw else
+                     ConvolutionLayer(
+                         kernelSize=k, stride=s, nOut=n_out, hasBias=False,
+                         convolutionMode="same", activation="identity"))
+            g.addLayer(f"{name}_c", layer, inp)
+            g.addLayer(f"{name}_bn", BatchNormalization(activation=act),
+                       f"{name}_c")
+            return f"{name}_bn"
+
+        def mbconv(name, inp, cin, cout, expand, k, stride):
+            cexp = cin * expand
+            x = inp
+            if expand != 1:
+                x = conv_bn(f"{name}_e", x, cexp, (1, 1), (1, 1))
+            x = conv_bn(f"{name}_d", x, cexp, (k, k), (stride, stride),
+                        groups_dw=True)
+            # squeeze-and-excitation: ratio 0.25 of the block INPUT chans
+            se_ch = max(1, int(cin * 0.25))
+            g.addLayer(f"{name}_se_gap",
+                       GlobalPoolingLayer(poolingType="avg"), x)
+            g.addLayer(f"{name}_se_r", DenseLayer(
+                nOut=se_ch, activation="swish"), f"{name}_se_gap")
+            g.addLayer(f"{name}_se_x", DenseLayer(
+                nOut=cexp, activation="sigmoid"), f"{name}_se_r")
+            g.addVertex(f"{name}_se_rs", ReshapeVertex(-1, 1, 1, cexp),
+                        f"{name}_se_x")
+            g.addVertex(f"{name}_se_mul", ElementWiseVertex("product"),
+                        x, f"{name}_se_rs")
+            x = conv_bn(f"{name}_p", f"{name}_se_mul", cout, (1, 1), (1, 1),
+                        act="identity")
+            if stride == 1 and cin == cout:
+                g.addVertex(f"{name}_add", ElementWiseVertex("add"), x, inp)
+                return f"{name}_add", cout
+            return x, cout
+
+        stem_ch = self._round_filters(32, self.width_mult)
+        x = conv_bn("stem", "input", stem_ch, (3, 3), (2, 2))
+        cin = stem_ch
+        for si, (expand, ch, reps, stride, k) in enumerate(self.STAGES):
+            cout = self._round_filters(ch, self.width_mult)
+            for r in range(self._round_repeats(reps, self.depth_mult)):
+                x, cin = mbconv(f"s{si}r{r}", x, cin, cout, expand, k,
+                                stride if r == 0 else 1)
+        head_ch = self._round_filters(1280, self.width_mult)
+        x = conv_bn("head", x, head_ch, (1, 1), (1, 1))
+        g.addLayer("gap", GlobalPoolingLayer(poolingType="avg"), x)
+        g.addLayer("drop", DropoutLayer(dropOut=1.0 - self.dropout_rate),
+                   "gap")
+        g.addLayer("out", OutputLayer(lossFunction="mcxent",
+                                      nOut=self.numClasses,
+                                      activation="softmax"), "drop")
+        g.setOutputs("out")
+        return g.build()

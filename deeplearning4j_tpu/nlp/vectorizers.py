@@ -113,7 +113,7 @@ class _BaseVectorizer:
     def fitTransform(self, sentences):
         docs = [self._tokens(s) for s in sentences]   # tokenize ONCE
         self._fit_docs_impl(docs)
-        return np.stack([self.transform(d) for d in docs])
+        return self.transformAll(docs)   # transform accepts token lists
 
 
 class BagOfWordsVectorizer(_BaseVectorizer):

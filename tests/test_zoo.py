@@ -110,3 +110,54 @@ class TestNASNet:
         assert y.shape == (2, 4)
         net.fit(x, _onehot(2, 4))
         assert np.isfinite(float(net.score()))
+
+
+class TestEfficientNet:
+    def test_b0_builds_forwards_and_trains(self):
+        from deeplearning4j_tpu.models.zoo import EfficientNet
+        net = EfficientNet("B0", numClasses=4,
+                           inputShape=(64, 64, 3)).init()
+        x = _rand((2, 64, 64, 3))
+        y = np.asarray(net.output(x))
+        assert y.shape == (2, 4)
+        assert np.allclose(y.sum(-1), 1.0, atol=1e-4)
+        net.fit(x, _onehot(2, 4))
+        assert np.isfinite(float(net.score()))
+
+    def test_compound_scaling(self):
+        from deeplearning4j_tpu.models.zoo import EfficientNet
+        # filter rounding matches the reference rule (divisor 8, >=90%)
+        assert EfficientNet._round_filters(32, 1.0) == 32
+        assert EfficientNet._round_filters(32, 1.1) == 32   # 35.2 -> 32
+        assert EfficientNet._round_filters(320, 1.4) == 448
+        assert EfficientNet._round_repeats(3, 1.8) == 6      # ceil(5.4)
+        # B2 widens and deepens vs B0
+        b0 = EfficientNet("B0", numClasses=3, inputShape=(32, 32, 3)).conf()
+        b2 = EfficientNet("B2", numClasses=3, inputShape=(32, 32, 3)).conf()
+        assert len(b2.nodes) > len(b0.nodes)
+        assert EfficientNet("B4", numClasses=2).DEFAULT_INPUT == (380, 380, 3)
+
+    def test_unknown_variant_rejected(self):
+        from deeplearning4j_tpu.models.zoo import EfficientNet
+        with pytest.raises(ValueError, match="variant"):
+            EfficientNet("B9")
+
+    def test_se_gating_present(self):
+        from deeplearning4j_tpu.models.zoo import EfficientNet
+        conf = EfficientNet("B0", numClasses=3,
+                            inputShape=(32, 32, 3)).conf()
+        muls = [n for n in conf.nodes if n.endswith("_se_mul")]
+        adds = [n for n in conf.nodes if n.endswith("_add")]
+        # B0: 16 MBConv blocks, each SE-gated; residuals where stride-1
+        assert len(muls) == 16
+        assert len(adds) == 9   # repeats beyond the first of each stage
+
+    def test_variant_dropout_scales(self):
+        from deeplearning4j_tpu.models.zoo import EfficientNet
+        assert EfficientNet("B0", numClasses=2).dropout_rate == 0.2
+        assert EfficientNet("B7", numClasses=2).dropout_rate == 0.5
+        for variant, retain in (("B0", 0.8), ("B7", 0.5)):
+            conf = EfficientNet(variant, numClasses=3,
+                                inputShape=(32, 32, 3)).conf()
+            drop = conf.nodes["drop"].ref   # retain probability = 1 - rate
+            assert abs(drop.dropOut - retain) < 1e-9
