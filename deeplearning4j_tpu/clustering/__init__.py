@@ -3,10 +3,11 @@ deeplearning4j-nearestneighbors, org.deeplearning4j.plot)."""
 from deeplearning4j_tpu.clustering.kmeans import (Cluster, ClusterSet,
                                                   KMeansClustering, Point,
                                                   PointClassification)
+from deeplearning4j_tpu.clustering.kdtree import KDTree
 from deeplearning4j_tpu.clustering.nn_server import NearestNeighborsServer
 from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne, Tsne
 from deeplearning4j_tpu.clustering.vptree import DataPoint, VPTree, knn
 
 __all__ = ["KMeansClustering", "Point", "Cluster", "ClusterSet",
            "PointClassification", "BarnesHutTsne", "Tsne", "VPTree",
-           "DataPoint", "knn", "NearestNeighborsServer"]
+           "DataPoint", "knn", "NearestNeighborsServer", "KDTree"]

@@ -265,3 +265,55 @@ class TestNearestNeighborsServer:
         assert res == srv.query_index(len(pts) - 1, 3)
         with pytest.raises(IndexError):
             srv.query_index(len(pts), 2)
+
+
+class TestKDTree:
+    def test_knn_matches_bruteforce(self):
+        from deeplearning4j_tpu.clustering import KDTree
+        rng = np.random.RandomState(3)
+        pts = rng.randn(300, 4).astype(np.float32)
+        tree = KDTree(4)
+        for p in pts:
+            tree.insert(p)
+        assert tree.size() == 300
+        for qi in range(5):
+            q = rng.randn(4).astype(np.float32)
+            res = tree.knn(q, 6)
+            d = np.sqrt(((pts - q) ** 2).sum(-1))
+            oracle = np.sort(d)[:6]
+            np.testing.assert_allclose([r[1] for r in res], oracle,
+                                       rtol=1e-5)
+            assert all(r[1] <= res[i + 1][1]
+                       for i, r in enumerate(res[:-1]))
+
+    def test_nn_and_validation(self):
+        from deeplearning4j_tpu.clustering import KDTree
+        tree = KDTree(2)
+        assert tree.knn([0, 0], 3) == []
+        tree.insert([1.0, 1.0])
+        tree.insert([5.0, 5.0])
+        pt, d = tree.nn([1.2, 1.0])
+        np.testing.assert_allclose(pt, [1.0, 1.0])
+        assert d == pytest.approx(0.2, abs=1e-6)
+        with pytest.raises(ValueError, match="dims"):
+            tree.insert([1.0, 2.0, 3.0])
+
+    def test_sorted_inserts_no_recursion_error(self):
+        # pathological O(n)-deep tree: iterative search must still work
+        from deeplearning4j_tpu.clustering import KDTree
+        tree = KDTree(1)
+        for i in range(5000):
+            tree.insert([float(i)])
+        res = tree.knn([2500.2], 3)
+        np.testing.assert_allclose(sorted(r[0][0] for r in res),
+                                   [2499, 2500, 2501])
+
+    def test_query_validation_and_k_zero(self):
+        from deeplearning4j_tpu.clustering import KDTree
+        tree = KDTree(2)
+        tree.insert([1.0, 2.0])
+        with pytest.raises(ValueError, match="dims"):
+            tree.knn([1.0], 1)
+        with pytest.raises(ValueError, match="dims"):
+            tree.nn([1.0, 2.0, 3.0])
+        assert tree.knn([0.0, 0.0], 0) == []
