@@ -57,8 +57,8 @@ class KDTree:
             node, depth = child, depth + 1
 
     @staticmethod
-    def _dist(a, b):
-        return float(np.sqrt(((a - b) ** 2).sum()))
+    def _dist2(a, b):
+        return float(((a - b) ** 2).sum())
 
     def nn(self, point):
         """Nearest neighbor: returns (point, distance)."""
@@ -74,32 +74,33 @@ class KDTree:
         k = min(int(k), self._size)
         if self._root is None or k <= 0:
             return []
-        heap = []  # max-heap of (-dist, counter, point)
+        heap = []  # max-heap of (-squared_dist, counter, point)
         counter = 0
         # explicit stack (no recursion — a sorted-insert tree is O(n)
-        # deep); `plane` is the split-plane distance that must beat the
-        # current kth-best for the subtree to matter, re-checked at pop
-        # time when tau is tightest
+        # deep); `plane2` is the SQUARED split-plane distance that must
+        # beat the current kth-best for the subtree to matter, re-checked
+        # at pop time when tau is tightest. Comparisons stay in squared
+        # space; sqrt only touches the final k results.
         stack = [(self._root, 0, None)]
         while stack:
-            node, depth, plane = stack.pop()
+            node, depth, plane2 = stack.pop()
             if node is None:
                 continue
-            tau = -heap[0][0] if len(heap) == k else float("inf")
-            if plane is not None and plane > tau:
+            tau2 = -heap[0][0] if len(heap) == k else float("inf")
+            if plane2 is not None and plane2 > tau2:
                 continue
-            d = self._dist(q, node.point)
+            d2 = self._dist2(q, node.point)
             if len(heap) < k:
-                heapq.heappush(heap, (-d, counter, node.point))
+                heapq.heappush(heap, (-d2, counter, node.point))
                 counter += 1
-            elif d < -heap[0][0]:
-                heapq.heapreplace(heap, (-d, counter, node.point))
+            elif d2 < -heap[0][0]:
+                heapq.heapreplace(heap, (-d2, counter, node.point))
                 counter += 1
             axis = depth % self.dims
-            delta = q[axis] - node.point[axis]
+            delta = float(q[axis] - node.point[axis])
             near, far = ((node.left, node.right) if delta < 0
                          else (node.right, node.left))
-            stack.append((far, depth + 1, abs(float(delta))))
+            stack.append((far, depth + 1, delta * delta))
             stack.append((near, depth + 1, None))   # popped first
-        out = sorted(((-nd, pt) for nd, _, pt in heap), key=lambda t: t[0])
-        return [(pt, d) for d, pt in out]
+        out = sorted(((-nd2, pt) for nd2, _, pt in heap), key=lambda t: t[0])
+        return [(pt, float(np.sqrt(d2))) for d2, pt in out]

@@ -13,8 +13,8 @@ from deeplearning4j_tpu.nn.conf.layers import (
     SeparableConvolution2D, Subsampling1DLayer, SubsamplingLayer,
     TimeDistributed, Upsampling1D, Upsampling2D, ZeroPaddingLayer)
 from deeplearning4j_tpu.nn.conf.special_layers import (
-    CenterLossOutputLayer, LocallyConnected1D, LocallyConnected2D,
-    VariationalAutoencoder)
+    AutoEncoder, CenterLossOutputLayer, LocallyConnected1D,
+    LocallyConnected2D, VariationalAutoencoder)
 from deeplearning4j_tpu.nn.dropout import (AlphaDropout, Dropout,
                                            GaussianDropout, GaussianNoise,
                                            SpatialDropout)
@@ -42,7 +42,8 @@ __all__ = [
     "OutputLayer", "PReLULayer", "SeparableConvolution2D",
     "Subsampling1DLayer", "SubsamplingLayer", "TimeDistributed",
     "Upsampling1D", "Upsampling2D",
-    "ZeroPaddingLayer", "CenterLossOutputLayer", "LocallyConnected1D",
+    "ZeroPaddingLayer", "AutoEncoder", "CenterLossOutputLayer",
+    "LocallyConnected1D",
     "LocallyConnected2D", "AlphaDropout", "Dropout", "GaussianDropout",
     "GaussianNoise", "SpatialDropout",
     "VariationalAutoencoder", "LossBinaryXENT", "LossMCXENT", "LossMSE",

@@ -315,6 +315,84 @@ class VariationalAutoencoder(Layer):
         return jnp.mean(kl - ll)
 
 
+class AutoEncoder(DenseLayer):
+    """≡ conf.layers.AutoEncoder — denoising autoencoder with tied
+    weights: encode act(xW + b), decode act(hWᵀ + vb). Supervised
+    activate() is the encoder (like the reference mid-network);
+    unsupervised training goes through MultiLayerNetwork.pretrain/
+    pretrainLayer, reconstructing from a binomially corrupted input
+    (``corruptionLevel`` = drop probability, pretrain only) with an
+    optional ``sparsity`` penalty on mean hidden activation — one jitted
+    step like the VAE's ELBO path.
+
+    Subclasses DenseLayer so the builder treats it as a feed-forward
+    layer (auto CnnToFeedForward preprocessor, conv-input validation) —
+    the reference's AutoEncoder extends FeedForwardLayer the same way.
+    """
+
+    #: lossFunction aliases -> the two implemented reconstruction losses
+    _LOSSES = {"mse": "mse", "l2": "mse", "squared_loss": "mse",
+               "xent": "xent", "binaryxent": "xent",
+               "reconstruction_crossentropy": "xent"}
+
+    def __init__(self, nIn=None, nOut=None, corruptionLevel=0.3,
+                 sparsity=0.0, lossFunction="mse", **kw):
+        super().__init__(nIn=nIn, nOut=nOut, **kw)
+        self.corruptionLevel = float(corruptionLevel)
+        self.sparsity = float(sparsity)
+        key = str(lossFunction).lower()
+        if key not in self._LOSSES:
+            raise ValueError(
+                f"AutoEncoder lossFunction {lossFunction!r} not supported; "
+                f"use one of {sorted(set(self._LOSSES))}")
+        self.lossFunction = self._LOSSES[key]
+
+    def initialize(self, key, input_type):
+        if self.nIn is None:
+            self.nIn = input_type.size
+        if self.nOut is None:
+            raise ValueError(f"AutoEncoder '{self.name}': nOut not set")
+        w = init_weight(key, (int(self.nIn), int(self.nOut)),
+                        self.weightInit, self.dist)
+        params = {"W": w,
+                  "b": jnp.zeros((int(self.nOut),), jnp.float32),
+                  "vb": jnp.zeros((int(self.nIn),), jnp.float32)}
+        return params, {}, self.output_type(input_type)
+
+    def _encode(self, params, x):
+        act = get_activation(self.activation)
+        return act(x @ params["W"].astype(x.dtype)
+                   + params["b"].astype(x.dtype))
+
+    def _decode(self, params, h):
+        act = get_activation(self.activation)
+        return act(h @ params["W"].astype(h.dtype).T
+                   + params["vb"].astype(h.dtype))
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        return self._encode(params, x), state
+
+    def pretrain_loss(self, params, x, rng):
+        if self.corruptionLevel > 0.0:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruptionLevel,
+                                        x.shape)
+            x_in = jnp.where(keep, x, 0.0).astype(x.dtype)
+        else:
+            x_in = x
+        h = self._encode(params, x_in)
+        recon = self._decode(params, h)
+        if self.lossFunction in ("xent", "binaryxent"):
+            eps = 1e-7
+            r = jnp.clip(recon, eps, 1.0 - eps)
+            loss = -(x * jnp.log(r) + (1.0 - x) * jnp.log(1.0 - r)).sum(-1)
+        else:   # mse / squared loss
+            loss = ((recon - x) ** 2).sum(-1)
+        if self.sparsity > 0.0:
+            loss = loss + self.sparsity * jnp.abs(h).mean(-1)
+        return jnp.mean(loss)
+
+
 class CenterLossOutputLayer(BaseOutputLayer, DenseLayer):
     """≡ conf.layers.CenterLossOutputLayer — softmax loss plus
     0.5·λ·||f−c_y||² pulling features toward per-class centers (the

@@ -165,3 +165,87 @@ class TestWeightedLosses:
         loss = LossBinaryXENT(labelSmoothing=0.2)
         lab = jnp.asarray([[0.0, 1.0]])
         assert np.allclose(np.asarray(loss._smooth(lab)), [[0.1, 0.9]])
+
+
+class TestAutoEncoder:
+    def _net(self, **kw):
+        from deeplearning4j_tpu.nn import AutoEncoder
+        return MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+            .weightInit("xavier").activation("sigmoid").list()
+            .layer(AutoEncoder(nOut=6, **kw))
+            .layer(OutputLayer(lossFunction="mse", nOut=2,
+                               activation="identity"))
+            .setInputType(InputType.feedForward(12)).build()).init()
+
+    def test_activate_is_encoder(self):
+        net = self._net()
+        x = _rand((5, 12))
+        h = np.asarray(net.activateSelectedLayers(0, 0, x).jax())
+        assert h.shape == (5, 6)
+        assert (h >= 0).all() and (h <= 1).all()   # sigmoid code
+
+    def test_pretrain_reduces_reconstruction_error(self):
+        import jax
+        net = self._net(corruptionLevel=0.3)
+        layer = net.layers[0]
+        x = (np.random.default_rng(1).random((64, 12)) > 0.5
+             ).astype(np.float32)
+        loss0 = float(layer.pretrain_loss(net._params["0"], x,
+                                          jax.random.PRNGKey(0)))
+        net.pretrainLayer(0, x, epochs=300)
+        loss1 = float(layer.pretrain_loss(net._params["0"], x,
+                                          jax.random.PRNGKey(0)))
+        assert loss1 < loss0 * 0.8
+
+    def test_tied_weights_and_params(self):
+        net = self._net()
+        p = net._params["0"]
+        assert set(p) == {"W", "b", "vb"}          # tied decoder: W.T
+        assert p["W"].shape == (12, 6)
+        assert p["vb"].shape == (12,)
+
+    def test_xent_loss_and_sparsity_run(self):
+        import jax
+        net = self._net(lossFunction="xent", sparsity=0.1,
+                        corruptionLevel=0.0)
+        layer = net.layers[0]
+        x = (np.random.default_rng(2).random((8, 12)) > 0.5
+             ).astype(np.float32)
+        l = float(layer.pretrain_loss(net._params["0"], x,
+                                      jax.random.PRNGKey(0)))
+        assert np.isfinite(l) and l > 0
+
+    def test_supervised_path_trains_after_pretrain(self):
+        net = self._net()
+        x = _rand((16, 12))
+        y = _rand((16, 2), seed=9)
+        net.pretrain(x, epochs=3)
+        net.fit(x, y)
+        assert np.isfinite(float(net.score()))
+
+    def test_conv_input_gets_preprocessor(self):
+        # AutoEncoder extends DenseLayer, so the builder auto-inserts the
+        # CnnToFeedForward preprocessor for convolutional input
+        from deeplearning4j_tpu.nn import AutoEncoder
+        from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+            .activation("sigmoid").list()
+            .layer(ConvolutionLayer(nOut=2, kernelSize=(3, 3),
+                                    convolutionMode="same",
+                                    activation="relu"))
+            .layer(AutoEncoder(nOut=5))
+            .layer(OutputLayer(lossFunction="mse", nOut=2,
+                               activation="identity"))
+            .setInputType(InputType.convolutionalFlat(6, 6, 1))
+            .build()).init()
+        x = _rand((3, 36))
+        assert np.asarray(net.output(x)).shape == (3, 2)
+        assert net._params["1"]["W"].shape == (72, 5)   # 6*6*2 flattened
+
+    def test_unknown_loss_rejected(self):
+        from deeplearning4j_tpu.nn import AutoEncoder
+        import pytest
+        with pytest.raises(ValueError, match="lossFunction"):
+            AutoEncoder(nOut=4, lossFunction="wasserstein")
