@@ -29,7 +29,8 @@ import time
 #: RPC is in flight wedges the tunnel exactly like a SIGKILL — observed
 #: 2026-07-30 ~19:51 UTC when a 360 s smoke deadline fired mid-compile.
 _DEFAULT_DEADLINES = {"probe": 90, "smoke": 900, "lstm": 2400,
-                      "resnet": 900, "spd": 900, "longseq": 1200, "bert": 1500}
+                      "resnet": 900, "spd": 900, "longseq": 1200,
+                      "bert": 1500, "clustering": 1200}
 
 
 def _arm_deadline(mode):
@@ -433,7 +434,8 @@ def main():
     try:
         {"probe": mode_probe, "smoke": mode_smoke, "lstm": mode_lstm,
          "resnet": mode_resnet, "spd": mode_spd,
-         "longseq": mode_longseq, "bert": mode_bert}[mode]()
+         "longseq": mode_longseq, "bert": mode_bert,
+         "clustering": mode_clustering}[mode]()
     except Exception as e:  # noqa: BLE001
         _emit({"mode": mode, "error": f"{type(e).__name__}: {e}"[:400]})
         os._exit(1)
@@ -443,3 +445,44 @@ def main():
 
 if __name__ == "__main__":
     main()
+
+
+def mode_clustering():
+    """Session-4 informational numbers: the new clustering stack ON CHIP.
+    KMeans (one jitted Lloyd while_loop) and exact t-SNE at sizes where
+    the reference's CPU implementations take minutes."""
+    import numpy as np
+    import time as _t
+
+    from deeplearning4j_tpu.clustering import BarnesHutTsne, KMeansClustering
+    from deeplearning4j_tpu.clustering.vptree import knn
+
+    rng = np.random.RandomState(0)
+
+    # KMeans: 200k points x 64 dims, k=100 — the (N, K) GEMM rides the MXU
+    x = rng.randn(200_000, 64).astype(np.float32)
+    kmc = KMeansClustering.setup(100, maxIterationCount=30)
+    t0 = _t.perf_counter()
+    cs = kmc.applyTo(x)
+    t_km = _t.perf_counter() - t0
+    _emit({"kmeans_points": 200_000, "dims": 64, "k": 100, "iters_max": 30,
+           "wall_s": round(t_km, 2),
+           "nonempty": sum(1 for c in cs.getClusters() if c.getPoints())})
+
+    # batched exact kNN: 1k queries over 200k corpus
+    t0 = _t.perf_counter()
+    idx, dist = knn(x[:1000], x, 10)
+    t_knn = _t.perf_counter() - t0
+    _emit({"knn_queries": 1000, "corpus": 200_000, "k": 10,
+           "wall_s": round(t_knn, 2), "self_hit": bool((idx[:, 0] ==
+                                                        np.arange(1000)).all())})
+
+    # exact t-SNE: 5k points (the Barnes-Hut regime) — one jitted descent
+    xt = rng.randn(5000, 32).astype(np.float32)
+    t0 = _t.perf_counter()
+    emb = (BarnesHutTsne.Builder().setMaxIter(500).perplexity(30)
+           .seed(0).build().fit(xt).getData())
+    t_ts = _t.perf_counter() - t0
+    _emit({"tsne_points": 5000, "dims": 32, "iters": 500,
+           "wall_s": round(t_ts, 2),
+           "finite": bool(np.isfinite(emb).all())})
