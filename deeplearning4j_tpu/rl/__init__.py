@@ -8,7 +8,8 @@ from deeplearning4j_tpu.rl.dqn import (DQNDenseNetworkConfiguration,
                                        DQNFactoryStdDense, DQNPolicy,
                                        EpsGreedy, QLearningConfiguration,
                                        QLearningDiscreteDense)
-from deeplearning4j_tpu.rl.a3c import (A3CConfiguration, A3CDiscreteDense,
+from deeplearning4j_tpu.rl.a3c import (A3CConfiguration, A3CDiscreteConv,
+                                       A3CDiscreteDense,
                                        AsyncNStepQLearningDiscreteDense)
 from deeplearning4j_tpu.rl.conv import (DQNConvNetworkConfiguration,
                                         DQNFactoryStdConv, HistoryProcessor,
@@ -20,7 +21,7 @@ __all__ = [
     "PixelGridWorld", "SimpleToy", "ExpReplay", "Transition",
     "DQNDenseNetworkConfiguration", "DQNFactoryStdDense", "DQNPolicy",
     "EpsGreedy", "QLearningConfiguration", "QLearningDiscreteDense",
-    "A3CConfiguration", "A3CDiscreteDense",
+    "A3CConfiguration", "A3CDiscreteConv", "A3CDiscreteDense",
     "AsyncNStepQLearningDiscreteDense",
     "DQNConvNetworkConfiguration", "DQNFactoryStdConv", "HistoryProcessor",
     "HistoryProcessorConfiguration", "QLearningDiscreteConv",

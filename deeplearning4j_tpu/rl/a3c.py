@@ -83,21 +83,27 @@ class A3CDiscreteDense:
         self._update = self._build_update()
 
     # -- jitted policy/value ---------------------------------------------
+    def _features(self, params, obs):
+        """Shared feature extractor — the ONLY thing subclasses override
+        (dense: body MLP; conv: conv torso)."""
+        return _mlp_apply(params["body"], obs)
+
     @functools.partial(jax.jit, static_argnums=0)
     def _logits_values(self, params, obs):
-        h = _mlp_apply(params["body"], obs)
+        h = self._features(params, obs)
         return _mlp_apply(params["pi"], h), _mlp_apply(params["v"], h)[..., 0]
 
     def _build_update(self):
         c = self.conf
         tx = self.tx
+        features = self._features
 
         @jax.jit
         def update(params, opt_state, obs, actions, returns):
-            """obs: (N*T, D); returns: n-step bootstrapped targets."""
+            """obs: (N*T, ...); returns: n-step bootstrapped targets."""
 
             def loss_fn(p):
-                h = _mlp_apply(p["body"], obs)
+                h = features(p, obs)
                 logits = _mlp_apply(p["pi"], h)
                 values = _mlp_apply(p["v"], h)[..., 0]
                 logp = jax.nn.log_softmax(logits)
@@ -219,3 +225,114 @@ class AsyncNStepQLearningDiscreteDense(A3CDiscreteDense):
         actions[explore] = self._rng.integers(
             self.num_actions, size=int(explore.sum()))
         return actions, q.max(-1)
+
+
+class _PixelEnvAdapter:
+    """Wraps a pixel MDP with the HistoryProcessor pipeline + frame-skip
+    action repeat so the batched A2C rollout sees processed (H, W, hist)
+    stacks — the conv twin of the dense envs."""
+
+    def __init__(self, mdp, hp_conf=None):
+        from deeplearning4j_tpu.rl.conv import (HistoryProcessor,
+                                                HistoryProcessorConfiguration)
+        self.mdp = mdp
+        self.hp = HistoryProcessor(hp_conf or
+                                   HistoryProcessorConfiguration())
+        self.skip = max(1, self.hp.conf.skipFrame)
+
+    def getActionSpace(self):
+        return self.mdp.getActionSpace()
+
+    def getObservationSpace(self):
+        class _Space:
+            shape = (self.hp.conf.rescaledHeight,
+                     self.hp.conf.rescaledWidth,
+                     self.hp.conf.historyLength)
+        return _Space()
+
+    def reset(self):
+        frame = self.mdp.reset()
+        self.hp.reset()
+        self.hp.record(frame)
+        return self.hp.getHistory()
+
+    def step(self, action):
+        reward, done, frame = 0.0, False, None
+        for _ in range(self.skip):
+            frame, r, done, _ = self.mdp.step(int(action))
+            reward += r
+            if done:
+                break
+        self.hp.record(frame)
+        return self.hp.getHistory(), reward, done, {}
+
+
+class A3CDiscreteConv(A3CDiscreteDense):
+    """≡ rl4j :: a3c.discrete.A3CDiscreteConv +
+    ActorCriticFactoryCompGraphStdConv — batched-env A2C over a PIXEL
+    MDP: shared conv torso (NHWC convs on the MXU) feeding policy and
+    value heads, observations from the HistoryProcessor frame pipeline
+    with frame-skip action repeat. Reuses the dense trainer's rollout/
+    update machinery; only the network and the env adapter differ."""
+
+    def __init__(self, mdp_factory, conf=None, hp_conf=None, net_conf=None):
+        from deeplearning4j_tpu.rl.conv import DQNConvNetworkConfiguration
+        self.conf = c = conf or A3CConfiguration()
+        self.net_conf = nc = net_conf or DQNConvNetworkConfiguration()
+        self._hp_conf = hp_conf
+        self.envs = [_PixelEnvAdapter(mdp_factory(), hp_conf)
+                     for _ in range(c.numEnvs)]
+        h, w, ch = self.envs[0].getObservationSpace().shape
+        self.num_actions = self.envs[0].getActionSpace().getSize()
+        key = jax.random.PRNGKey(c.seed)
+        conv_params, cin = [], ch
+        oh, ow = h, w
+        for f, khw, s in zip(nc.filters, nc.kernels, nc.strides):
+            key, k = jax.random.split(key)
+            fan_in = khw[0] * khw[1] * cin
+            conv_params.append({
+                "w": jax.random.normal(k, (khw[0], khw[1], cin, f))
+                * jnp.sqrt(2.0 / fan_in),
+                "b": jnp.zeros((f,))})
+            oh = (oh - khw[0]) // s[0] + 1
+            ow = (ow - khw[1]) // s[1] + 1
+            cin = f
+        flat = oh * ow * cin
+        k1, k2, k3 = jax.random.split(key, 3)
+        self.params = {
+            "conv": conv_params,
+            "body": _mlp_init(k1, [flat, nc.denseUnits]),
+            "pi": _mlp_init(k2, [nc.denseUnits, self.num_actions]),
+            "v": _mlp_init(k3, [nc.denseUnits, 1]),
+        }
+        self.tx = optax.rmsprop(c.learningRate, decay=0.99, eps=1e-5)
+        self.opt_state = self.tx.init(self.params)
+        self._rng = np.random.default_rng(c.seed)
+        self.step_count = 0
+        self.episode_rewards = []
+        self._ep_acc = np.zeros(c.numEnvs)
+        self._update = self._build_update()
+
+    def _features(self, params, obs):
+        x = obs
+        for lyr, s in zip(params["conv"], self.net_conf.strides):
+            x = jax.lax.conv_general_dilated(
+                x, lyr["w"], window_strides=tuple(s), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC")) + lyr["b"]
+            x = jax.nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        return jax.nn.relu(_mlp_apply(params["body"], x))
+
+    def play(self, mdp, max_steps=10000):
+        """Greedy play on a RAW pixel MDP: frames go through the same
+        HistoryProcessor pipeline the trainer used (≡ the DQN path's
+        _ConvDQNPolicy)."""
+        env = _PixelEnvAdapter(mdp, self._hp_conf)
+        obs = env.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            obs, r, done, _ = env.step(self.nextAction(obs))
+            total += r
+            if done:
+                break
+        return total

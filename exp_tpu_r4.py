@@ -69,7 +69,8 @@ def mode_probe():
     t0 = time.perf_counter()
     devs = jax.devices()
     x = jnp.ones((8, 128)) @ jnp.ones((128, 128))
-    _emit({"devices": str(devs), "matmul_ok": float(x.sum()) == 8 * 128,
+    _emit({"devices": str(devs),
+           "matmul_ok": float(x.sum()) == 8 * 128 * 128,
            "init_s": round(time.perf_counter() - t0, 1)})
 
 
@@ -443,8 +444,6 @@ def main():
     os._exit(0)
 
 
-if __name__ == "__main__":
-    main()
 
 
 def mode_clustering():
@@ -486,3 +485,7 @@ def mode_clustering():
     _emit({"tsne_points": 5000, "dims": 32, "iters": 500,
            "wall_s": round(t_ts, 2),
            "finite": bool(np.isfinite(emb).all())})
+
+
+if __name__ == "__main__":
+    main()
