@@ -389,6 +389,161 @@ class OnnxGraphMapper:
             sd._op_named(out, "clip",
                          lambda x, *_r, lo=lo, hi=hi: jnp.clip(x, lo, hi),
                          *ins)
+        elif op == "LeakyRelu":
+            alpha = float(_attr(node, "alpha", 0.01))
+            sd._op_named(out, "leakyrelu",
+                         lambda x, alpha=alpha: jnp.where(x > 0, x,
+                                                          alpha * x), *ins)
+        elif op == "Elu":
+            alpha = float(_attr(node, "alpha", 1.0))
+            sd._op_named(out, "elu",
+                         lambda x, alpha=alpha: jnp.where(
+                             x > 0, x, alpha * (jnp.exp(x) - 1.0)), *ins)
+        elif op == "Softplus":
+            sd._op_named(out, "softplus", jax.nn.softplus, *ins)
+        elif op == "HardSigmoid":
+            alpha = float(_attr(node, "alpha", 0.2))
+            beta = float(_attr(node, "beta", 0.5))
+            sd._op_named(out, "hardsigmoid",
+                         lambda x, a=alpha, b=beta: jnp.clip(
+                             a * x + b, 0.0, 1.0), *ins)
+        elif op == "ConvTranspose":
+            strides = tuple(node.attrs.get("strides") or (1, 1))
+            dil = tuple(node.attrs.get("dilations") or (1, 1))
+            groups = int(_attr(node, "group", 1))
+            out_pad = tuple(node.attrs.get("output_padding") or (0, 0))
+            if groups != 1:
+                raise UnsupportedOnnxOpError(
+                    f"{out}: grouped ConvTranspose unsupported")
+            auto_pad = node.attrs.get("auto_pad", b"NOTSET")
+            auto_pad = (auto_pad.decode() if isinstance(
+                auto_pad, (bytes, bytearray)) else str(auto_pad))
+            if auto_pad not in ("NOTSET", ""):
+                raise UnsupportedOnnxOpError(
+                    f"{out}: ConvTranspose auto_pad={auto_pad!r} "
+                    f"unsupported (export with explicit pads)")
+            if node.attrs.get("output_shape") is not None:
+                raise UnsupportedOnnxOpError(
+                    f"{out}: ConvTranspose output_shape unsupported "
+                    f"(export with explicit pads)")
+            pads = node.attrs.get("pads")
+
+            def convt(x, w, *b, strides=strides, dil=dil, pads=pads,
+                      out_pad=out_pad):
+                # ONNX weights are (Cin, Cout, kH, kW); the fractionally-
+                # strided equivalent conv wants (Cout, Cin, kH, kW) with
+                # spatially flipped taps and lhs_dilation = stride
+                wf = jnp.flip(w, axis=(-2, -1)).swapaxes(0, 1)
+                kh = (w.shape[2] - 1) * dil[0] + 1
+                kw = (w.shape[3] - 1) * dil[1] + 1
+                p = pads or (0, 0, 0, 0)   # (top, left, bottom, right)
+                pad_arg = [(kh - 1 - p[0], kh - 1 - p[2] + out_pad[0]),
+                           (kw - 1 - p[1], kw - 1 - p[3] + out_pad[1])]
+                y = jax.lax.conv_general_dilated(
+                    x, wf.astype(x.dtype), window_strides=(1, 1),
+                    padding=pad_arg, lhs_dilation=strides,
+                    rhs_dilation=dil,
+                    dimension_numbers=("NCHW", "OIHW", "NCHW"))
+                return y + b[0].reshape(1, -1, 1, 1) if b else y
+            sd._op_named(out, "conv_transpose", convt, *ins)
+        elif op == "Pad":
+            mode = node.attrs.get("mode", b"constant")
+            mode = (mode.decode() if isinstance(mode, (bytes, bytearray))
+                    else str(mode))
+            pads = node.attrs.get("pads")
+            if pads is None:          # opset-11+: pads as input[1]
+                pv = const_val(1)
+                if pv is None:
+                    raise UnsupportedOnnxOpError(
+                        f"{out}: dynamic Pad unsupported")
+                pads = np.asarray(pv).reshape(-1).tolist()
+            if len(node.inputs) > 3 and node.inputs[3]:
+                raise UnsupportedOnnxOpError(
+                    f"{out}: opset-18 Pad axes input unsupported "
+                    f"(pads must cover every dimension)")
+            cval = 0.0
+            if len(node.inputs) > 2 and node.inputs[2]:
+                cv = const_val(2)
+                if cv is None:
+                    raise UnsupportedOnnxOpError(
+                        f"{out}: non-constant Pad value unsupported")
+                cval = float(np.asarray(cv).reshape(()))
+            pads = [int(p) for p in pads]
+            jmode = {"constant": "constant", "reflect": "reflect",
+                     "edge": "edge"}.get(mode)
+            if jmode is None:
+                raise UnsupportedOnnxOpError(f"{out}: Pad mode {mode!r}")
+
+            def pad(x, *_r, pads=pads, jmode=jmode, cval=cval, name=out):
+                n = x.ndim
+                if len(pads) != 2 * n:
+                    raise UnsupportedOnnxOpError(
+                        f"{name}: Pad expects {2 * n} widths for rank-{n} "
+                        f"input, got {len(pads)}")
+                width = [(pads[i], pads[i + n]) for i in range(n)]
+                if jmode == "constant":
+                    return jnp.pad(x, width, constant_values=cval)
+                return jnp.pad(x, width, mode=jmode)
+            sd._op_named(out, "pad", pad, *ins)
+        elif op in ("Resize", "Upsample"):
+            mode = node.attrs.get("mode", b"nearest")
+            mode = (mode.decode() if isinstance(mode, (bytes, bytearray))
+                    else str(mode))
+            if mode != "nearest":
+                raise UnsupportedOnnxOpError(
+                    f"{out}: Resize mode {mode!r} unsupported (nearest "
+                    f"only)")
+            # input layouts differ: Upsample = [X, scales] (or a scales
+            # attr at opset 7); Resize = [X, roi, scales, sizes], where
+            # scales may be an EMPTY name with sizes given instead —
+            # never guess by tensor size, index by position
+            scales = node.attrs.get("scales")
+            sizes = None
+            scales_idx = 1 if op == "Upsample" else 2
+            if scales is None and len(node.inputs) > scales_idx \
+                    and node.inputs[scales_idx]:
+                cv = const_val(scales_idx)
+                if cv is None:
+                    raise UnsupportedOnnxOpError(
+                        f"{out}: non-constant {op} scales unsupported")
+                scales = np.asarray(cv).reshape(-1).tolist()
+            if scales is None and op == "Resize" and \
+                    len(node.inputs) > 3 and node.inputs[3]:
+                cv = const_val(3)
+                if cv is None:
+                    raise UnsupportedOnnxOpError(
+                        f"{out}: non-constant Resize sizes unsupported")
+                sizes = [int(s) for s in np.asarray(cv).reshape(-1)]
+            if scales is None and sizes is None:
+                raise UnsupportedOnnxOpError(
+                    f"{out}: {op} needs constant NCHW scales or sizes")
+            if scales is not None:
+                if float(scales[0]) != 1.0 or float(scales[1]) != 1.0:
+                    raise UnsupportedOnnxOpError(
+                        f"{out}: {op} batch/channel scales must be 1, "
+                        f"got {scales[:2]}")
+                sh, sw = float(scales[2]), float(scales[3])
+                if sh != int(sh) or sw != int(sw) or sh < 1 or sw < 1:
+                    raise UnsupportedOnnxOpError(
+                        f"{out}: non-integer upsample scales ({sh}, {sw})")
+
+            def resize(x, *_r, scales=scales, sizes=sizes, name=out):
+                if scales is not None:
+                    sh, sw = int(scales[2]), int(scales[3])
+                else:
+                    if sizes[0] != x.shape[0] or sizes[1] != x.shape[1]:
+                        raise UnsupportedOnnxOpError(
+                            f"{name}: Resize sizes may not change "
+                            f"batch/channel dims")
+                    if sizes[2] % x.shape[2] or sizes[3] % x.shape[3]:
+                        raise UnsupportedOnnxOpError(
+                            f"{name}: Resize sizes {sizes[2:]} are not "
+                            f"integer multiples of input "
+                            f"{x.shape[2:]}")
+                    sh = sizes[2] // x.shape[2]
+                    sw = sizes[3] // x.shape[3]
+                return jnp.repeat(jnp.repeat(x, sh, axis=2), sw, axis=3)
+            sd._op_named(out, "resize", resize, *ins)
         else:
             raise UnsupportedOnnxOpError(
                 f"ONNX op '{op}' (node '{out}') is not in the import set")

@@ -74,6 +74,12 @@ class A3CDiscreteDense:
             "pi": _mlp_init(k2, [c.hiddenNodes, self.num_actions]),
             "v": _mlp_init(k3, [c.hiddenNodes, 1]),
         }
+        self._init_trainer_state()
+
+    def _init_trainer_state(self):
+        """Optimizer + rollout bookkeeping — ONE definition shared by the
+        dense and conv trainers (self.conf and self.params must be set)."""
+        c = self.conf
         self.tx = optax.rmsprop(c.learningRate, decay=0.99, eps=1e-5)
         self.opt_state = self.tx.init(self.params)
         self._rng = np.random.default_rng(c.seed)
@@ -305,13 +311,7 @@ class A3CDiscreteConv(A3CDiscreteDense):
             "pi": _mlp_init(k2, [nc.denseUnits, self.num_actions]),
             "v": _mlp_init(k3, [nc.denseUnits, 1]),
         }
-        self.tx = optax.rmsprop(c.learningRate, decay=0.99, eps=1e-5)
-        self.opt_state = self.tx.init(self.params)
-        self._rng = np.random.default_rng(c.seed)
-        self.step_count = 0
-        self.episode_rewards = []
-        self._ep_acc = np.zeros(c.numEnvs)
-        self._update = self._build_update()
+        self._init_trainer_state()
 
     def _features(self, params, obs):
         x = obs

@@ -253,3 +253,144 @@ class TestOnnxImport:
         x = rng.normal(size=(2, 3, 4)).astype(np.float32)
         got = np.asarray(sd.outputSingle({"x": x}, "y").jax())
         assert np.allclose(got.sum(-1), 1.0, atol=1e-5)
+
+
+class TestRound4Session4Ops:
+    """ConvTranspose, Pad, Resize/Upsample, LeakyRelu/Elu family."""
+
+    def test_leakyrelu_elu_softplus_hardsigmoid(self):
+        x = np.array([[-2.0, -0.5, 0.5, 2.0]], np.float32)
+        model = onnx_model(
+            [onnx_node("LeakyRelu", ["x"], ["a"], alpha=0.1),
+             onnx_node("Elu", ["a"], ["b"], alpha=1.0),
+             onnx_node("Softplus", ["b"], ["c"]),
+             onnx_node("HardSigmoid", ["c"], ["y"], alpha=0.2, beta=0.5)],
+            {}, {"x": [1, 4]}, ["y"])
+        sd = importOnnx(model)
+        got = np.asarray(sd.outputSingle({"x": x}, "y").jax())
+        a = np.where(x > 0, x, 0.1 * x)
+        b = np.where(a > 0, a, np.exp(a) - 1.0)
+        c = np.log1p(np.exp(b))
+        want = np.clip(0.2 * c + 0.5, 0.0, 1.0)
+        assert np.allclose(got, want, atol=1e-5)
+
+    def test_conv_transpose_inverts_shape(self):
+        rng = np.random.default_rng(2)
+        # (Cin=3, Cout=2, 3, 3), stride 2, pads (1,1,1,1), out_pad (1,1):
+        # H' = 2*(H-1) + 3 - 2 + 1 = 2H  (the U-Net upsample shape)
+        w = rng.normal(size=(3, 2, 3, 3)).astype(np.float32)
+        bias = rng.normal(size=(2,)).astype(np.float32)
+        model = onnx_model(
+            [onnx_node("ConvTranspose", ["x", "w", "b"], ["y"],
+                       strides=[2, 2], pads=[1, 1, 1, 1],
+                       output_padding=[1, 1])],
+            {"w": w, "b": bias}, {"x": [1, 3, 5, 5]}, ["y"])
+        sd = importOnnx(model)
+        x = rng.normal(size=(1, 3, 5, 5)).astype(np.float32)
+        got = np.asarray(sd.outputSingle({"x": x}, "y").jax())
+        assert got.shape == (1, 2, 10, 10)
+        # oracle: scatter-accumulate definition of transposed conv
+        want = np.zeros((1, 2, 12, 12), np.float32)  # padded output canvas
+        for ci in range(3):
+            for co in range(2):
+                for i in range(5):
+                    for j in range(5):
+                        want[0, co, 2 * i:2 * i + 3, 2 * j:2 * j + 3] += \
+                            x[0, ci, i, j] * w[ci, co]
+        want = want[:, :, 1:11, 1:11] + bias.reshape(1, -1, 1, 1)
+        assert np.allclose(got, want, atol=1e-3)
+
+    def test_pad_constant_and_reflect(self):
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+        pads = np.array([0, 0, 1, 1, 0, 0, 1, 1], np.int64)
+        model = onnx_model(
+            [onnx_node("Pad", ["x", "p"], ["y"], mode="constant")],
+            {"p": pads}, {"x": [1, 1, 2, 2]}, ["y"])
+        got = np.asarray(importOnnx(model).outputSingle(
+            {"x": x}, "y").jax())
+        want = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+        assert np.array_equal(got, want)
+        model2 = onnx_model(
+            [onnx_node("Pad", ["x", "p"], ["y"], mode="reflect")],
+            {"p": pads}, {"x": [1, 1, 2, 2]}, ["y"])
+        got2 = np.asarray(importOnnx(model2).outputSingle(
+            {"x": x}, "y").jax())
+        assert np.array_equal(
+            got2, np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)],
+                         mode="reflect"))
+
+    def test_resize_nearest_and_upsample(self):
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+        scales = np.array([1.0, 1.0, 2.0, 2.0], np.float32)
+        model = onnx_model(
+            [onnx_node("Resize", ["x", "", "s"], ["y"], mode="nearest")],
+            {"s": scales}, {"x": [1, 1, 2, 2]}, ["y"])
+        got = np.asarray(importOnnx(model).outputSingle(
+            {"x": x}, "y").jax())
+        want = x.repeat(2, axis=2).repeat(2, axis=3)
+        assert np.array_equal(got, want)
+        # deprecated Upsample spells the same thing
+        model2 = onnx_model(
+            [onnx_node("Upsample", ["x", "s"], ["y"], mode="nearest")],
+            {"s": scales}, {"x": [1, 1, 2, 2]}, ["y"])
+        got2 = np.asarray(importOnnx(model2).outputSingle(
+            {"x": x}, "y").jax())
+        assert np.array_equal(got2, want)
+
+    def test_unsupported_modes_raise(self):
+        x_dims = {"x": [1, 1, 2, 2]}
+        model = onnx_model(
+            [onnx_node("Resize", ["x", "", "s"], ["y"], mode="linear")],
+            {"s": np.array([1, 1, 2, 2], np.float32)}, x_dims, ["y"])
+        with pytest.raises(UnsupportedOnnxOpError, match="linear"):
+            importOnnx(model)
+
+    def test_resize_sizes_input(self):
+        # Resize with EMPTY scales name and a sizes tensor: [X,roi,'',sizes]
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+        sizes = np.array([1, 1, 6, 4], np.int64)
+        model = onnx_model(
+            [onnx_node("Resize", ["x", "", "", "sz"], ["y"],
+                       mode="nearest")],
+            {"sz": sizes}, {"x": [1, 1, 2, 2]}, ["y"])
+        got = np.asarray(importOnnx(model).outputSingle(
+            {"x": x}, "y").jax())
+        assert got.shape == (1, 1, 6, 4)
+        np.testing.assert_array_equal(got, x.repeat(3, 2).repeat(2, 3))
+
+    def test_resize_channel_scale_rejected(self):
+        model = onnx_model(
+            [onnx_node("Resize", ["x", "", "s"], ["y"], mode="nearest")],
+            {"s": np.array([1, 2, 2, 2], np.float32)},
+            {"x": [1, 1, 2, 2]}, ["y"])
+        with pytest.raises(UnsupportedOnnxOpError, match="batch/channel"):
+            importOnnx(model)
+
+    def test_conv_transpose_auto_pad_rejected(self):
+        w = np.zeros((1, 1, 3, 3), np.float32)
+        model = onnx_model(
+            [onnx_node("ConvTranspose", ["x", "w"], ["y"],
+                       auto_pad="SAME_UPPER", strides=[2, 2])],
+            {"w": w}, {"x": [1, 1, 4, 4]}, ["y"])
+        with pytest.raises(UnsupportedOnnxOpError, match="auto_pad"):
+            importOnnx(model)
+
+    def test_pad_axes_input_rejected(self):
+        pads = np.array([1, 1, 1, 1], np.int64)
+        axes = np.array([2, 3], np.int64)
+        model = onnx_model(
+            [onnx_node("Pad", ["x", "p", "", "ax"], ["y"],
+                       mode="constant")],
+            {"p": pads, "ax": axes}, {"x": [1, 1, 2, 2]}, ["y"])
+        with pytest.raises(UnsupportedOnnxOpError, match="axes"):
+            importOnnx(model)
+
+    def test_pad_nonconstant_value_rejected(self):
+        pads = np.array([0, 0, 1, 1, 0, 0, 1, 1], np.int64)
+        cval = np.array(5.0, np.float32)
+        model = onnx_model(
+            [onnx_node("Identity", ["cv"], ["cv2"]),
+             onnx_node("Pad", ["x", "p", "cv2"], ["y"], mode="constant")],
+            {"p": pads, "cv": cval}, {"x": [1, 1, 2, 2]}, ["y"])
+        with pytest.raises(UnsupportedOnnxOpError, match="non-constant"):
+            importOnnx(model)
