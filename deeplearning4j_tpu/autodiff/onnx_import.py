@@ -79,6 +79,15 @@ def _parse_attr(buf):
         return name, parse_onnx_tensor(f[5][0])[1]
     if 8 in f:                                   # ints
         return name, _packed_int64s(f[8])
+    if 7 in f:                                   # floats (opset-7 Upsample
+        import struct                            # scales live here)
+        out = []
+        for v in f[7]:
+            if isinstance(v, bytes):             # packed blob of f32s
+                out.extend(struct.unpack(f"<{len(v) // 4}f", v))
+            else:                                # unpacked fixed32
+                out.append(struct.unpack("<f", struct.pack("<I", v))[0])
+        return name, out
     return name, None
 
 
@@ -499,7 +508,9 @@ class OnnxGraphMapper:
             # never guess by tensor size, index by position
             scales = node.attrs.get("scales")
             sizes = None
-            scales_idx = 1 if op == "Upsample" else 2
+            # opset-10 Resize is [X, scales]; opset-11+ adds roi at idx 1
+            scales_idx = (1 if op == "Upsample" or len(node.inputs) == 2
+                          else 2)
             if scales is None and len(node.inputs) > scales_idx \
                     and node.inputs[scales_idx]:
                 cv = const_val(scales_idx)

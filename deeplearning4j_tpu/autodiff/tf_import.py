@@ -161,10 +161,17 @@ class TFGraphMapper:
                          lambda x, dims=dims: jnp.squeeze(
                              x, None if not dims else tuple(dims)), *ins)
         elif op in ("ConcatV2", "Concat"):
-            axis = _axis_from([const_val(len(ins) - 1)], 0, 0)
+            # ConcatV2: axis is the LAST input; v1 Concat: the FIRST
+            axis_idx = len(in_refs) - 1 if op == "ConcatV2" else 0
+            av = const_val(axis_idx)
+            if av is None:
+                raise UnsupportedTFOpError(
+                    f"{name}: dynamic Concat axis unsupported")
+            axis = int(np.asarray(av).reshape(()))
+            data_ins = (ins[:-1] if op == "ConcatV2" else ins[1:])
             sd._op_named(name, "concat",
-                         lambda *xs, axis=axis: jnp.concatenate(
-                             xs[:-1], axis=axis), *ins)
+                         lambda *xs, axis=axis: jnp.concatenate(xs, axis),
+                         *data_ins)
         elif op in ("GatherV2", "Gather"):
             axis = 0
             if op == "GatherV2" and len(ins) > 2:
@@ -226,6 +233,100 @@ class TFGraphMapper:
             sd._op_named(name, "one_hot",
                          lambda i, *_r, depth=depth: jax.nn.one_hot(
                              i.astype(jnp.int32), depth), *ins)
+        elif op in ("Conv2D", "DepthwiseConv2dNative"):
+            fmt = node.attrs.get("data_format", "NHWC")
+            if fmt != "NHWC":
+                raise UnsupportedTFOpError(
+                    f"{name}: data_format {fmt!r} unsupported (NHWC only)")
+            strides = tuple(node.attrs.get("strides") or (1, 1, 1, 1))[1:3]
+            dil = tuple(node.attrs.get("dilations") or (1, 1, 1, 1))[1:3]
+            padding = node.attrs.get("padding", "VALID")
+            if padding == "EXPLICIT":
+                ep = node.attrs.get("explicit_paddings") or []
+                if len(ep) != 8:
+                    raise UnsupportedTFOpError(
+                        f"{name}: padding=EXPLICIT needs 8 "
+                        f"explicit_paddings values, got {len(ep)}")
+                # NHWC order: take the H and W begin/end pairs
+                padding = [(int(ep[2]), int(ep[3])),
+                           (int(ep[4]), int(ep[5]))]
+            depthwise = op == "DepthwiseConv2dNative"
+
+            def conv(x, w, strides=strides, dil=dil, padding=padding,
+                     depthwise=depthwise):
+                # TF weights are HWIO; depthwise weights (H, W, C, M) run
+                # as a grouped conv with feature_group_count = C
+                groups = 1
+                if depthwise:
+                    h_, w_, cin, mult = w.shape
+                    w = w.reshape(h_, w_, 1, cin * mult)
+                    groups = cin
+                return jax.lax.conv_general_dilated(
+                    x, w.astype(x.dtype), window_strides=strides,
+                    padding=padding, rhs_dilation=dil,
+                    feature_group_count=groups,
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            sd._op_named(name, "conv2d", conv, *ins)
+        elif op in ("MaxPool", "AvgPool"):
+            fmt = node.attrs.get("data_format", "NHWC")
+            if fmt != "NHWC":
+                raise UnsupportedTFOpError(
+                    f"{name}: data_format {fmt!r} unsupported (NHWC only)")
+            ksize = tuple(node.attrs.get("ksize") or (1, 2, 2, 1))
+            strides = tuple(node.attrs.get("strides") or ksize)
+            padding = node.attrs.get("padding", "VALID")
+            if padding not in ("SAME", "VALID"):
+                raise UnsupportedTFOpError(
+                    f"{name}: pool padding {padding!r} unsupported")
+            if op == "MaxPool":
+                sd._op_named(name, "maxpool",
+                             lambda x, ksize=ksize, strides=strides,
+                             padding=padding: jax.lax.reduce_window(
+                                 x, -jnp.inf, jax.lax.max, ksize, strides,
+                                 padding), *ins)
+            else:
+                def avg(x, ksize=ksize, strides=strides, padding=padding):
+                    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, ksize,
+                                              strides, padding)
+                    n = jax.lax.reduce_window(jnp.ones_like(x), 0.0,
+                                              jax.lax.add, ksize, strides,
+                                              padding)
+                    return s / n
+                sd._op_named(name, "avgpool", avg, *ins)
+        elif op in ("FusedBatchNorm", "FusedBatchNormV2",
+                    "FusedBatchNormV3"):
+            # frozen-graph inference form: inputs x, gamma, beta, mean, var
+            if node.attrs.get("is_training"):
+                raise UnsupportedTFOpError(
+                    f"{name}: FusedBatchNorm with is_training=True "
+                    f"unsupported (freeze the graph for inference)")
+            eps = float(node.attrs.get("epsilon", 1e-3))
+            fmt = node.attrs.get("data_format", "NHWC")
+            if fmt != "NHWC":
+                raise UnsupportedTFOpError(
+                    f"{name}: data_format {fmt!r} unsupported (NHWC only)")
+
+            def fbn(x, gamma, beta, mean, var, eps=eps):
+                return ((x - mean) * jax.lax.rsqrt(var + eps)
+                        * gamma + beta)
+            sd._op_named(name, "fused_batch_norm", fbn, *ins)
+        elif op in ("Pad", "PadV2"):
+            pv = const_val(1)
+            if pv is None:
+                raise UnsupportedTFOpError(
+                    f"{name}: dynamic Pad unsupported")
+            width = [tuple(int(v) for v in row)
+                     for row in np.asarray(pv).reshape(-1, 2)]
+            cval = 0.0
+            if op == "PadV2" and len(in_refs) > 2:
+                cv = const_val(2)
+                if cv is None:
+                    raise UnsupportedTFOpError(
+                        f"{name}: non-constant PadV2 value unsupported")
+                cval = float(np.asarray(cv).reshape(()))
+            sd._op_named(name, "pad",
+                         lambda x, *_r, width=width, cval=cval: jnp.pad(
+                             x, width, constant_values=cval), *ins)
         else:
             raise UnsupportedTFOpError(
                 f"TF op '{op}' (node '{name}') is not in the import op set")

@@ -394,3 +394,34 @@ class TestRound4Session4Ops:
             {"p": pads, "cv": cval}, {"x": [1, 1, 2, 2]}, ["y"])
         with pytest.raises(UnsupportedOnnxOpError, match="non-constant"):
             importOnnx(model)
+
+    def test_resize_opset10_two_input_form(self):
+        # opset-10 Resize is [X, scales] — no roi input
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+        model = onnx_model(
+            [onnx_node("Resize", ["x", "s"], ["y"], mode="nearest")],
+            {"s": np.array([1, 1, 2, 3], np.float32)},
+            {"x": [1, 1, 2, 2]}, ["y"])
+        got = np.asarray(importOnnx(model).outputSingle(
+            {"x": x}, "y").jax())
+        np.testing.assert_array_equal(got, x.repeat(2, 2).repeat(3, 3))
+
+    def test_upsample_opset7_scales_attr(self):
+        # opset-7 Upsample: scales as a repeated-float ATTRIBUTE
+        import struct as _struct
+        from deeplearning4j_tpu.autodiff.tfproto import _field
+        attr = bytearray()
+        _put_bytes(attr, 1, b"scales")
+        for v in (1.0, 1.0, 2.0, 2.0):
+            _field(attr, 7, 5)                  # floats, fixed32 wire
+            attr.extend(_struct.pack("<f", v))
+        node = bytearray()
+        _put_bytes(node, 1, b"x")
+        _put_bytes(node, 2, b"y")
+        _put_bytes(node, 4, b"Upsample")
+        _put_bytes(node, 5, bytes(attr))
+        model = onnx_model([bytes(node)], {}, {"x": [1, 1, 2, 2]}, ["y"])
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+        got = np.asarray(importOnnx(model).outputSingle(
+            {"x": x}, "y").jax())
+        np.testing.assert_array_equal(got, x.repeat(2, 2).repeat(2, 3))

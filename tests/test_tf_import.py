@@ -213,3 +213,138 @@ class TestControlFlow:
         out = sd.outputSingle({"x": np.asarray([1.5], np.float32)},
                               outs[0].name)
         assert float(out.jax()[0]) == 12.0
+
+
+class TestFrozenCnnOps:
+    """Round-4 session 4: the frozen-CNN op tail — Conv2D, pools,
+    FusedBatchNorm, ConcatV2, Pad, DepthwiseConv2dNative."""
+
+    def test_conv_bn_pool_stack(self):
+        rng = np.random.default_rng(3)
+        w = rng.normal(size=(3, 3, 2, 4)).astype(np.float32)   # HWIO
+        gamma = rng.normal(size=(4,)).astype(np.float32)
+        beta = rng.normal(size=(4,)).astype(np.float32)
+        mean = rng.normal(size=(4,)).astype(np.float32)
+        var = np.abs(rng.normal(size=(4,))).astype(np.float32) + 0.5
+        data = tfproto.encode_graphdef([
+            ("x", "Placeholder", [], {}),
+            ("w", "Const", [], {"value": w}),
+            ("g", "Const", [], {"value": gamma}),
+            ("b", "Const", [], {"value": beta}),
+            ("m", "Const", [], {"value": mean}),
+            ("v", "Const", [], {"value": var}),
+            ("conv", "Conv2D", ["x", "w"],
+             {"strides": [1, 1, 1, 1], "padding": "SAME"}),
+            ("bn", "FusedBatchNormV3", ["conv", "g", "b", "m", "v"],
+             {"epsilon": 1e-3}),
+            ("act", "Relu", ["bn"], {}),
+            ("pool", "MaxPool", ["act"],
+             {"ksize": [1, 2, 2, 1], "strides": [1, 2, 2, 1],
+              "padding": "VALID"}),
+        ])
+        sd = importFrozenTF(data)
+        x = rng.normal(size=(2, 6, 6, 2)).astype(np.float32)
+        got = np.asarray(sd.outputSingle({"x": x}, "pool").jax())
+        assert got.shape == (2, 3, 3, 4)
+        # numpy oracle
+        import jax
+        import jax.numpy as jnp
+        conv = np.asarray(jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")))
+        bn = (conv - mean) / np.sqrt(var + 1e-3) * gamma + beta
+        act = np.maximum(bn, 0)
+        want = act.reshape(2, 3, 2, 3, 2, 4).max(axis=(2, 4))
+        assert np.allclose(got, want, atol=1e-4)
+
+    def test_depthwise_and_avgpool(self):
+        rng = np.random.default_rng(4)
+        w = rng.normal(size=(3, 3, 2, 2)).astype(np.float32)  # (H,W,C,M)
+        data = tfproto.encode_graphdef([
+            ("x", "Placeholder", [], {}),
+            ("w", "Const", [], {"value": w}),
+            ("dw", "DepthwiseConv2dNative", ["x", "w"],
+             {"strides": [1, 1, 1, 1], "padding": "SAME"}),
+            ("ap", "AvgPool", ["dw"],
+             {"ksize": [1, 4, 4, 1], "strides": [1, 4, 4, 1],
+              "padding": "VALID"}),
+        ])
+        sd = importFrozenTF(data)
+        x = rng.normal(size=(1, 4, 4, 2)).astype(np.float32)
+        got = np.asarray(sd.outputSingle({"x": x}, "ap").jax())
+        assert got.shape == (1, 1, 1, 4)   # C*M output channels
+        # channel 0 of the depthwise out uses ONLY input channel 0
+        x2 = x.copy()
+        x2[..., 1] = 0.0
+        got2 = np.asarray(sd.outputSingle({"x": x2}, "ap").jax())
+        assert np.allclose(got[..., :2], got2[..., :2], atol=1e-5)
+
+    def test_concat_and_pad(self):
+        data = tfproto.encode_graphdef([
+            ("a", "Placeholder", [], {}),
+            ("b", "Placeholder", [], {}),
+            ("axis", "Const", [], {"value": np.int32(-1)}),
+            ("cat", "ConcatV2", ["a", "b", "axis"], {}),
+            ("p", "Const", [],
+             {"value": np.array([[0, 0], [1, 2]], np.int32)}),
+            ("out", "Pad", ["cat", "p"], {}),
+        ])
+        sd = importFrozenTF(data)
+        a = np.ones((2, 2), np.float32)
+        b = 2 * np.ones((2, 3), np.float32)
+        got = np.asarray(sd.outputSingle({"a": a, "b": b}, "out").jax())
+        want = np.pad(np.concatenate([a, b], -1), [(0, 0), (1, 2)])
+        assert np.array_equal(got, want)
+
+    def test_nchw_rejected(self):
+        data = tfproto.encode_graphdef([
+            ("x", "Placeholder", [], {}),
+            ("w", "Const", [], {"value": np.zeros((1, 1, 1, 1),
+                                                  np.float32)}),
+            ("conv", "Conv2D", ["x", "w"],
+             {"strides": [1, 1, 1, 1], "padding": "SAME",
+              "data_format": "NCHW"}),
+        ])
+        with pytest.raises(UnsupportedTFOpError, match="NHWC"):
+            importFrozenTF(data)
+
+    def test_concat_v1_axis_first(self):
+        # v1 Concat: axis is the FIRST input
+        data = tfproto.encode_graphdef([
+            ("axis", "Const", [], {"value": np.int32(1)}),
+            ("a", "Placeholder", [], {}),
+            ("b", "Placeholder", [], {}),
+            ("cat", "Concat", ["axis", "a", "b"], {}),
+        ])
+        sd = importFrozenTF(data)
+        a = np.ones((2, 2), np.float32)
+        b = 2 * np.ones((2, 3), np.float32)
+        got = np.asarray(sd.outputSingle({"a": a, "b": b}, "cat").jax())
+        np.testing.assert_array_equal(got, np.concatenate([a, b], 1))
+
+    def test_explicit_padding_conv(self):
+        w = np.ones((2, 2, 1, 1), np.float32)
+        data = tfproto.encode_graphdef([
+            ("x", "Placeholder", [], {}),
+            ("w", "Const", [], {"value": w}),
+            ("conv", "Conv2D", ["x", "w"],
+             {"strides": [1, 1, 1, 1], "padding": "EXPLICIT",
+              "explicit_paddings": [0, 0, 1, 0, 2, 0, 0, 0]}),
+        ])
+        sd = importFrozenTF(data)
+        x = np.ones((1, 3, 3, 1), np.float32)
+        got = np.asarray(sd.outputSingle({"x": x}, "conv").jax())
+        # padded input is 4x5 -> VALID 2x2 conv gives 3x4
+        assert got.shape == (1, 3, 4, 1)
+
+    def test_training_mode_bn_rejected(self):
+        z = np.zeros(1, np.float32)
+        data = tfproto.encode_graphdef([
+            ("x", "Placeholder", [], {}),
+            ("g", "Const", [], {"value": z}), ("b", "Const", [], {"value": z}),
+            ("m", "Const", [], {"value": z}), ("v", "Const", [], {"value": z}),
+            ("bn", "FusedBatchNormV3", ["x", "g", "b", "m", "v"],
+             {"is_training": True}),
+        ])
+        with pytest.raises(UnsupportedTFOpError, match="is_training"):
+            importFrozenTF(data)
