@@ -81,12 +81,11 @@ def _parse_attr(buf):
         return name, _packed_int64s(f[8])
     if 7 in f:                                   # floats (opset-7 Upsample
         import struct                            # scales live here)
+        # parse_fields stores every wire-type-5 value as 4-byte chunks and
+        # packed lists as one blob — both land here as bytes
         out = []
         for v in f[7]:
-            if isinstance(v, bytes):             # packed blob of f32s
-                out.extend(struct.unpack(f"<{len(v) // 4}f", v))
-            else:                                # unpacked fixed32
-                out.append(struct.unpack("<f", struct.pack("<I", v))[0])
+            out.extend(struct.unpack(f"<{len(v) // 4}f", v))
         return name, out
     return name, None
 

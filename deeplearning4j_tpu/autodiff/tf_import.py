@@ -247,6 +247,10 @@ class TFGraphMapper:
                     raise UnsupportedTFOpError(
                         f"{name}: padding=EXPLICIT needs 8 "
                         f"explicit_paddings values, got {len(ep)}")
+                if any(int(v) for v in (*ep[:2], *ep[6:])):
+                    raise UnsupportedTFOpError(
+                        f"{name}: EXPLICIT padding on batch/channel "
+                        f"dims unsupported ({list(ep)})")
                 # NHWC order: take the H and W begin/end pairs
                 padding = [(int(ep[2]), int(ep[3])),
                            (int(ep[4]), int(ep[5]))]

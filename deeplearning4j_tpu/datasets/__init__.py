@@ -7,7 +7,8 @@ from deeplearning4j_tpu.datasets.iterators import (
     MnistDataSetIterator, SvhnDataSetIterator, SyntheticImageNetIterator,
     TinyImageNetDataSetIterator, UciSequenceDataSetIterator)
 from deeplearning4j_tpu.datasets.normalizers import (
-    DataNormalization, ImagePreProcessingScaler, NormalizerMinMaxScaler,
+    DataNormalization, ImagePreProcessingScaler, MultiNormalizerMinMaxScaler,
+    MultiNormalizerStandardize, NormalizerMinMaxScaler,
     NormalizerStandardize, VGG16ImagePreProcessor)
 
 __all__ = [
@@ -18,6 +19,7 @@ __all__ = [
     "TinyImageNetDataSetIterator", "UciSequenceDataSetIterator",
     "ListMultiDataSetIterator",
     "SingletonMultiDataSetIterator", "DataNormalization",
-    "ImagePreProcessingScaler", "NormalizerMinMaxScaler",
+    "ImagePreProcessingScaler", "MultiNormalizerMinMaxScaler",
+    "MultiNormalizerStandardize", "NormalizerMinMaxScaler",
     "NormalizerStandardize", "VGG16ImagePreProcessor",
 ]

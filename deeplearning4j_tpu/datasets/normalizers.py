@@ -149,3 +149,90 @@ class VGG16ImagePreProcessor(DataNormalization):
 
     def revert_array(self, x):
         return x + self.MEANS
+
+
+class _MultiNormalizer:
+    """Base for MultiDataSet normalizers (≡ nd4j
+    preprocessor.MultiNormalizerStandardize / MultiNormalizerMinMaxScaler):
+    one independent per-input normalizer, fit jointly from a
+    MultiDataSetIterator, applied via preProcess like the reference's
+    MultiDataNormalization."""
+
+    _single_cls = None
+
+    def __init__(self):
+        self._normalizers = None
+
+    def fit(self, iterator_or_mds):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        if isinstance(iterator_or_mds, MultiDataSet):
+            n_inputs = iterator_or_mds.numFeatureArrays()
+            self._normalizers = [self._single_cls()
+                                 for _ in range(n_inputs)]
+            for i, norm in enumerate(self._normalizers):
+                norm._fit_batches([iterator_or_mds.features[i]])
+            return self
+        # iterator: one STREAMING pass per input (like the single-input
+        # normalizer) instead of materializing the whole dataset
+        it = iterator_or_mds
+        it.reset()
+        first = next(iter(it), None)
+        if first is None:
+            raise ValueError("empty MultiDataSet iterator")
+        n_inputs = first.numFeatureArrays()
+        self._normalizers = [self._single_cls() for _ in range(n_inputs)]
+        for i, norm in enumerate(self._normalizers):
+            it.reset()
+            norm._fit_batches(mds.features[i] for mds in it)
+        it.reset()
+        return self
+
+    def _check_fit(self, mds):
+        if self._normalizers is None:
+            raise ValueError("call fit() first")
+        if mds.numFeatureArrays() != len(self._normalizers):
+            raise ValueError(
+                f"MultiDataSet has {mds.numFeatureArrays()} inputs, "
+                f"normalizer was fit on {len(self._normalizers)}")
+
+    def preProcess(self, mds):
+        self._check_fit(mds)
+        mds.features = [n.transform_array(f)
+                        for n, f in zip(self._normalizers, mds.features)]
+        return mds
+
+    transform = preProcess
+
+    def revert(self, mds):
+        self._check_fit(mds)
+        mds.features = [n.revert_array(f)
+                        for n, f in zip(self._normalizers, mds.features)]
+        return mds
+
+    def getInputNormalizer(self, i):
+        return self._normalizers[i]
+
+    # serialization (ModelSerializer normalizer slot / pickle)
+    def state_dict(self):
+        return {"per_input": [n.state_dict() for n in self._normalizers]
+                if self._normalizers is not None else None}
+
+    def load_state_dict(self, d):
+        per = d.get("per_input")
+        if per is None:
+            self._normalizers = None
+        else:
+            self._normalizers = []
+            for nd in per:
+                n = self._single_cls()
+                n.load_state_dict(nd)
+                self._normalizers.append(n)
+        return self
+
+
+class MultiNormalizerStandardize(_MultiNormalizer):
+    _single_cls = NormalizerStandardize
+
+
+class MultiNormalizerMinMaxScaler(_MultiNormalizer):
+    _single_cls = NormalizerMinMaxScaler

@@ -1,5 +1,6 @@
 """Dataset/iterator/normalizer tests (SURVEY.md §4)."""
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.datasets import (ArrayDataSetIterator,
                                          AsyncDataSetIterator,
@@ -202,3 +203,72 @@ def test_svhn_tinyimagenet_uci_iterators():
     # deterministic across constructions
     again = UciSequenceDataSetIterator(600).next()
     np.testing.assert_array_equal(ds.features, again.features)
+
+
+class TestMultiNormalizers:
+    def _iter(self):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        from deeplearning4j_tpu.datasets.iterators import \
+            ListMultiDataSetIterator
+        rng = np.random.RandomState(0)
+        sets = [MultiDataSet(
+            [rng.randn(8, 3).astype(np.float32) * 5 + 10,
+             rng.rand(8, 2).astype(np.float32) * 100],
+            [np.ones((8, 1), np.float32)]) for _ in range(4)]
+        return ListMultiDataSetIterator(sets)
+
+    def test_standardize_per_input(self):
+        from deeplearning4j_tpu.datasets import MultiNormalizerStandardize
+        it = self._iter()
+        norm = MultiNormalizerStandardize().fit(it)
+        it.reset()
+        all0, all1 = [], []
+        for mds in it:
+            norm.preProcess(mds)
+            all0.append(mds.features[0])
+            all1.append(mds.features[1])
+        f0 = np.concatenate(all0)
+        f1 = np.concatenate(all1)
+        # each INPUT standardized with its own statistics
+        np.testing.assert_allclose(f0.mean(0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(f0.std(0), 1.0, atol=1e-2)
+        np.testing.assert_allclose(f1.mean(0), 0.0, atol=1e-4)
+
+    def test_minmax_and_revert_roundtrip(self):
+        from deeplearning4j_tpu.datasets import MultiNormalizerMinMaxScaler
+        it = self._iter()
+        norm = MultiNormalizerMinMaxScaler().fit(it)
+        it.reset()
+        mds = it.next()
+        orig = [f.copy() for f in mds.features]
+        norm.preProcess(mds)
+        for f in mds.features:
+            assert f.min() >= -1e-6 and f.max() <= 1.0 + 1e-6
+        norm.revert(mds)
+        for f, o in zip(mds.features, orig):
+            np.testing.assert_allclose(f, o, atol=1e-4)
+
+    def test_guards_and_serde(self):
+        import pickle
+        from deeplearning4j_tpu.datasets import MultiNormalizerStandardize
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        norm = MultiNormalizerStandardize()
+        mds = MultiDataSet([np.ones((2, 3), np.float32)],
+                           [np.ones((2, 1), np.float32)])
+        with pytest.raises(ValueError, match="fit"):
+            norm.preProcess(mds)
+        it = self._iter()
+        norm.fit(it)
+        with pytest.raises(ValueError, match="inputs"):
+            norm.preProcess(mds)   # 1 input vs fit on 2
+        # state round-trip preserves behavior
+        clone = MultiNormalizerStandardize().load_state_dict(
+            pickle.loads(pickle.dumps(norm.state_dict())))
+        it.reset()
+        a = it.next()
+        b = MultiDataSet([f.copy() for f in a.features],
+                         [l.copy() for l in a.labels])
+        norm.preProcess(a)
+        clone.preProcess(b)
+        for fa, fb in zip(a.features, b.features):
+            np.testing.assert_allclose(fa, fb)

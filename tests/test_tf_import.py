@@ -348,3 +348,15 @@ class TestFrozenCnnOps:
         ])
         with pytest.raises(UnsupportedTFOpError, match="is_training"):
             importFrozenTF(data)
+
+    def test_explicit_batch_padding_rejected(self):
+        w = np.ones((2, 2, 1, 1), np.float32)
+        data = tfproto.encode_graphdef([
+            ("x", "Placeholder", [], {}),
+            ("w", "Const", [], {"value": w}),
+            ("conv", "Conv2D", ["x", "w"],
+             {"strides": [1, 1, 1, 1], "padding": "EXPLICIT",
+              "explicit_paddings": [1, 0, 1, 0, 2, 0, 0, 0]}),
+        ])
+        with pytest.raises(UnsupportedTFOpError, match="batch/channel"):
+            importFrozenTF(data)
