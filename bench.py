@@ -520,11 +520,13 @@ def main():
                    for e in ran):
         # every attempt hung with no "# device:" line — the known axon
         # tunnel-wedge signature, not a framework failure (BENCH.md
-        # outage log; last driver-verified run BENCH_r02.json, last local
-        # measurements BENCH_r03_local.json)
+        # outage log; last driver-verified run BENCH_r02.json, freshest
+        # local measurements BENCH_r04_local.json)
         out["note"] = ("axon TPU tunnel outage signature (init hang, no "
                        "device line) — see BENCH.md outage log; code-side "
-                       "measurements preserved in BENCH_r03_local.json")
+                       "measurements preserved in BENCH_r04_local.json "
+                       "(green full-extras run earlier this round, "
+                       "pre-wedge)")
     print(json.dumps(out))
 
 
