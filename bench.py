@@ -23,22 +23,93 @@ from __future__ import annotations
 import json
 import os
 import signal
+import statistics
 import subprocess
 import sys
 import time
+
+def _want_tpu():
+    """Declare this process a legitimate TPU consumer BEFORE the framework
+    import, so the package-level attach guard
+    (deeplearning4j_tpu.__init__._tpu_attach_guard) lets it through.
+    Called from the RUN paths (main/child_main), not at module import:
+    scripts that merely import bench helpers (exp_tpu_r4 imports
+    bert_mfu_pct) must not inherit the opt-in as a side effect."""
+    os.environ.setdefault("DL4J_TPU_WANT_TPU", "1")
+
 
 BASELINE_IMG_S = 360.0
 METRIC = "resnet50_imagenet_images_per_sec_per_chip"
 
 
+def _median_of_windows(run_window, k=5, max_k=9, spread_limit=0.20):
+    """Median over k independent timed windows.
+
+    VERDICT r4 #2: the sub-20 ms-step rows (LeNet, char-LSTM) swing ~2x
+    between back-to-back single-window runs — a point sample of that
+    distribution is not a measurement. Runs k windows, keeps adding
+    windows while the spread ((max-min)/median) exceeds spread_limit (up
+    to max_k), and returns (median, all_window_values, spread)."""
+    vals = [run_window(i) for i in range(k)]
+    while True:
+        med = statistics.median(vals)
+        spread = (max(vals) - min(vals)) / med
+        if spread <= spread_limit or len(vals) >= max_k:
+            return med, vals, spread
+        vals.append(run_window(len(vals)))
+
+
+def _windowed_rate(step, carry0, step_args, rng_key, steps, units,
+                   start_index, k_windows, windows_out):
+    """The timed-window protocol shared by every bench row.
+
+    Threads (params, opt_state, net_state) through `steps` enqueued train
+    steps per window with ONE device->host sync (float(loss)) closing each
+    window; with k_windows>1, takes the median over independent windows
+    (_median_of_windows) and records the window values + spread into
+    windows_out. `units` = work items per step (images, chars). Returns
+    (units_per_sec, final_loss, final_carry)."""
+    import jax
+
+    carry = {"t": carry0, "loss": None, "i": start_index}
+
+    def timed_window(_w):
+        p, o, s = carry["t"]
+        i0 = carry["i"]
+        t0 = time.perf_counter()
+        for i in range(steps):
+            p, o, s, loss = step(p, o, s, *step_args, None, None,
+                                 jax.random.fold_in(rng_key, i0 + i))
+        lv = float(loss)   # ONE device->host sync closes the window
+        dtw = (time.perf_counter() - t0) / steps
+        carry.update(t=(p, o, s), loss=lv, i=i0 + steps)
+        return units / dtw
+
+    if k_windows > 1:
+        rate, vals, spread = _median_of_windows(timed_window, k=k_windows)
+        if windows_out is not None:
+            windows_out["windows"] = [round(v, 1) for v in vals]
+            windows_out["spread_pct"] = round(spread * 100, 1)
+    else:
+        rate = timed_window(0)
+    return rate, carry["loss"], carry["t"]
+
+
 def _bench_zoo_model(model_cls, batch, steps, warmup, input_hw=224,
-                     classes=1000, lr=0.1, roofline_out=None):
+                     classes=1000, lr=0.1, roofline_out=None,
+                     k_windows=1, windows_out=None):
     """img/s for one zoo CNN: whole step = ONE jitted XLA executable.
 
     roofline_out: optional dict filled with XLA cost-analysis roofline
     fields (step bytes-accessed, HBM-bound step time) so the artifact can
     state how close the measured step is to the memory bound — the r3/r4
-    profiles show ResNet-50 at batch 256 is HBM-bandwidth dominated."""
+    profiles show ResNet-50 at batch 256 is HBM-bandwidth dominated.
+
+    k_windows>1: report the MEDIAN img/s over k independent timed windows
+    of `steps` steps each (one device sync per window), recording the
+    window values + spread into windows_out — the statistically
+    defensible form for sub-20 ms steps whose single-window numbers swing
+    with tunnel dispatch jitter."""
     warmup = max(1, warmup)   # compile must finish before the timed window
     import jax
     import jax.numpy as jnp
@@ -74,12 +145,11 @@ def _bench_zoo_model(model_cls, batch, steps, warmup, input_hw=224,
                                         None, jax.random.fold_in(rng, i))
     float(loss)
     compile_s = time.perf_counter() - t_compile
-    t0 = time.perf_counter()
-    for i in range(steps):
-        params, opt, state, loss = step(params, opt, state, ins, labs, None,
-                                        None, jax.random.fold_in(rng, 100 + i))
-    final_loss = float(loss)
-    dt = (time.perf_counter() - t0) / steps
+
+    rate, final_loss, (params, opt, state) = _windowed_rate(
+        step, (params, opt, state), (ins, labs), rng, steps, batch,
+        100, k_windows, windows_out)
+    dt = batch / rate
     if roofline_out is not None:
         try:
             # bytes-accessed from the compiled executable's cost analysis
@@ -167,16 +237,19 @@ def _bench_bert_finetune(batch=None, seq=None, steps=10, warmup=2):
     return 1.0 / dt, dt, compile_s, batch * seq
 
 
-def _bench_lenet(batch=256, steps=60, warmup=3):
+def _bench_lenet(batch=256, steps=60, warmup=3, windows_out=None):
     """LeNet-5 MNIST-shape img/s (BASELINE.md: sub-second synthetic epoch).
-    60 steps: sub-10ms steps need the one end-of-window sync round-trip
-    amortized over many steps or it dominates the average."""
+    60 steps per window (sub-10ms steps need the one end-of-window sync
+    round-trip amortized over many steps), median of >=5 windows with the
+    spread recorded in the artifact (VERDICT r4 #2)."""
     from deeplearning4j_tpu.models.zoo import LeNet
     return _bench_zoo_model(LeNet, batch, steps, warmup, input_hw=28,
-                            classes=10, lr=0.01)
+                            classes=10, lr=0.01, k_windows=5,
+                            windows_out=windows_out)
 
 
-def _bench_char_lstm(batch=256, seq=128, hidden=512, steps=None, warmup=2):
+def _bench_char_lstm(batch=256, seq=128, hidden=512, steps=None, warmup=2,
+                     windows_out=None, k_windows=5):
     """GravesLSTM char-RNN training: chars/s through a 2-layer LSTM built
     on the builder DSL (BASELINE.md row: jitted lax.scan ≥ parity).
 
@@ -232,17 +305,18 @@ def _bench_char_lstm(batch=256, seq=128, hidden=512, steps=None, warmup=2):
                                         None, jax.random.fold_in(key, i))
     float(loss)
     compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    for i in range(steps):
-        params, opt, state, loss = step(params, opt, state, xd, yd, None,
-                                        None, jax.random.fold_in(key, 99 + i))
-    float(loss)
-    dt = (time.perf_counter() - t0) / steps
-    return batch * seq / dt, dt, compile_s
+
+    # median of >=5 independent windows + recorded spread (VERDICT r4 #2);
+    # sweep/trace callers (exp_tpu_r4) pass k_windows=1 for single-window
+    rate, _, _ = _windowed_rate(step, (params, opt, state), (xd, yd), key,
+                                steps, batch * seq, 99, k_windows,
+                                windows_out)
+    return rate, batch * seq / rate, compile_s
 
 
 def child_main():
     """The actual measurement (runs in a kill-able subprocess)."""
+    _want_tpu()
     t_start = time.perf_counter()
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
@@ -354,10 +428,13 @@ def child_main():
             result["lenet_error"] = "skipped: attempt time budget exhausted"
         else:
             try:
-                l_img_s, l_dt, l_c, _ = _bench_lenet()
+                lw = {}
+                l_img_s, l_dt, l_c, _ = _bench_lenet(windows_out=lw)
                 result["lenet_img_s"] = round(l_img_s, 2)
-                print(f"# lenet: step={l_dt*1000:.2f}ms compile={l_c:.1f}s",
-                      file=sys.stderr, flush=True)
+                result["lenet_windows"] = lw.get("windows")
+                result["lenet_spread_pct"] = lw.get("spread_pct")
+                print(f"# lenet: step={l_dt*1000:.2f}ms compile={l_c:.1f}s "
+                      f"windows={lw}", file=sys.stderr, flush=True)
             except Exception as e:  # noqa: BLE001
                 result["lenet_error"] = str(e)[:200]
     _emit_partial()
@@ -366,10 +443,14 @@ def child_main():
             result["lstm_error"] = "skipped: attempt time budget exhausted"
         else:
             try:
-                c_s, c_dt, c_c = _bench_char_lstm()
+                cw = {}
+                c_s, c_dt, c_c = _bench_char_lstm(windows_out=cw)
                 result["char_lstm_chars_s"] = round(c_s, 2)
+                result["char_lstm_windows"] = cw.get("windows")
+                result["char_lstm_spread_pct"] = cw.get("spread_pct")
                 print(f"# char-lstm: step={c_dt*1000:.1f}ms "
-                      f"compile={c_c:.1f}s", file=sys.stderr, flush=True)
+                      f"compile={c_c:.1f}s windows={cw}",
+                      file=sys.stderr, flush=True)
             except Exception as e:  # noqa: BLE001
                 result["lstm_error"] = str(e)[:200]
 
@@ -454,6 +535,7 @@ def _last_partial(out: str):
 
 
 def main():
+    _want_tpu()
     if os.environ.get("BENCH_CHILD") == "1":
         child_main()
         return

@@ -17,4 +17,82 @@ collectives over ICI/DCN, not explicit messaging.
 
 __version__ = "0.1.0"
 
-from deeplearning4j_tpu.ops import nd  # noqa: F401
+
+def _tpu_attach_guard():
+    """Make TPU attachment EXPLICIT (opt-in), never accidental.
+
+    This container's sitecustomize registers the axon TPU PJRT plugin in
+    every python process and presets JAX_PLATFORMS=axon, so any script
+    importing this package would silently attach to the tunnelled TPU.
+    Killing such a process mid-RPC wedges the tunnel for hours (BENCH.md
+    outage log, rounds 3+4) — and "a CPU-side helper script forgot the env
+    scrub" has now caused a multi-hour outage twice. Defense in depth:
+    unless the process asserts `DL4J_TPU_WANT_TPU=1` *before* importing
+    this package (bench.py and __graft_entry__.entry do), importing the
+    framework pins jax to the CPU backend. jax.config.update applied
+    before any backend initialization reliably overrides the plugin's
+    platform hook (the same mechanism __graft_entry__.dryrun_multichip has
+    used since round 2); if a backend is already live we leave it alone —
+    the importer already owns its platform choice.
+    """
+    import os
+
+    if os.environ.get("DL4J_TPU_WANT_TPU") == "1":
+        return
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return  # no tunnelled-TPU plugin in this environment
+    import sys
+
+    import jax
+
+    global _CPU_PINNED, _GUARD_PREV_PLATFORMS
+    try:
+        _GUARD_PREV_PLATFORMS = jax.config.jax_platforms
+        jax.config.update("jax_platforms", "cpu")
+        _CPU_PINNED = True
+        print("deeplearning4j_tpu: axon TPU plugin detected but "
+              "DL4J_TPU_WANT_TPU!=1 — pinning this process to CPU "
+              "(set DL4J_TPU_WANT_TPU=1 before import, or call "
+              "unpin_cpu(), for the chip)",
+              file=sys.stderr, flush=True)
+    except Exception as e:  # noqa: BLE001
+        # A dead guard reopens the attach-and-wedge hazard — never die
+        # silent. Expected cause: a jax backend initialized before this
+        # import (the importer owns its platform); anything else (e.g. a
+        # renamed config option after a jax upgrade) needs investigating.
+        print("deeplearning4j_tpu: TPU attach guard could NOT pin CPU "
+              f"({type(e).__name__}: {e}) — if no jax backend was "
+              "initialized before this import, the guard is broken and "
+              "this process may attach to the tunnelled TPU",
+              file=sys.stderr, flush=True)
+
+
+#: True when the attach guard redirected this process to CPU; the platform
+#: value it displaced is kept so unpin_cpu() can restore it.
+_CPU_PINNED = False
+_GUARD_PREV_PLATFORMS = None
+
+
+def unpin_cpu():
+    """Undo the attach guard's CPU pin for a legitimate TPU consumer that
+    imported the package before declaring DL4J_TPU_WANT_TPU=1 (e.g. the
+    driver importing __graft_entry__ ahead of calling entry()). Returns
+    True if the process can now see the TPU platform, False if a backend
+    was already initialized on CPU (too late — set the env var before the
+    first import instead)."""
+    global _CPU_PINNED
+    if not _CPU_PINNED:
+        return True
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", _GUARD_PREV_PLATFORMS)
+        _CPU_PINNED = False
+        return True
+    except Exception:  # noqa: BLE001 — backend already initialized
+        return False
+
+
+_tpu_attach_guard()
+
+from deeplearning4j_tpu.ops import nd  # noqa: F401, E402

@@ -338,6 +338,22 @@ class OnnxGraphMapper:
             window = (1, 1) + ksize
             strd = (1, 1) + strides
             ones = (1,) * len(ksize)
+            # Module convention: silently-wrong output is worse than a
+            # loud unsupported error (ADVICE r4). ceil_mode=1 (common in
+            # torch exports) changes output SHAPES; pool dilations change
+            # the window footprint — neither maps onto this lowering.
+            if int(_attr(node, "ceil_mode", 0)) != 0:
+                raise UnsupportedOnnxOpError(
+                    f"{out}: {op} ceil_mode=1 unsupported (re-export with "
+                    "ceil_mode=0 / torch.onnx ceil_mode=False)")
+            pdil = tuple(node.attrs.get("dilations") or ones)
+            if any(d != 1 for d in pdil):
+                raise UnsupportedOnnxOpError(
+                    f"{out}: {op} dilations={pdil} unsupported")
+            # count_include_pad=1: divide by the FULL kernel size
+            # everywhere (padded zeros count); default 0 divides by the
+            # number of real elements under each window.
+            include_pad = int(_attr(node, "count_include_pad", 0)) != 0
 
             def pool_pads(x, node=node, ksize=ksize, strides=strides,
                           ones=ones):
@@ -350,10 +366,13 @@ class OnnxGraphMapper:
                                  x, -jnp.inf, jax.lax.max, window, strd,
                                  pool_pads(x)), *ins)
             else:
-                def avg(x, window=window, strd=strd, pool_pads=pool_pads):
+                def avg(x, window=window, strd=strd, pool_pads=pool_pads,
+                        include_pad=include_pad, ksize=ksize):
                     pad_arg = pool_pads(x)
                     s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window,
                                               strd, pad_arg)
+                    if include_pad:
+                        return s / float(np.prod(ksize))
                     n = jax.lax.reduce_window(jnp.ones_like(x), 0.0,
                                               jax.lax.add, window, strd,
                                               pad_arg)

@@ -304,7 +304,9 @@ class TFGraphMapper:
                 raise UnsupportedTFOpError(
                     f"{name}: FusedBatchNorm with is_training=True "
                     f"unsupported (freeze the graph for inference)")
-            eps = float(node.attrs.get("epsilon", 1e-3))
+            # TF OpDef default is 1e-4 — a frozen graph stripped of
+            # default-valued attrs must not import with a 10x epsilon
+            eps = float(node.attrs.get("epsilon", 1e-4))
             fmt = node.attrs.get("data_format", "NHWC")
             if fmt != "NHWC":
                 raise UnsupportedTFOpError(

@@ -41,6 +41,15 @@ class NearestNeighborsServer:
         self.fn = str(similarity_function).lower()
         self.port = int(port)
         self.host = str(host)    # "0.0.0.0" to serve non-local clients
+        # VPTree refuses 'dot' (not a metric — tree pruning would be
+        # inexact); degrade to the exact batched GEMM path instead of
+        # failing server construction.
+        if useVpTree and self.fn == "dot":
+            import sys
+            print("NearestNeighborsServer: useVpTree ignored for 'dot' "
+                  "(not a metric); serving via the exact batched knn path",
+                  file=sys.stderr, flush=True)
+            useVpTree = False
         self._tree = (VPTree(self.points, self.fn) if useVpTree else None)
         self._httpd = None
         self._thread = None

@@ -105,6 +105,26 @@ class VPTree:
         self.fn = str(similarity_function).lower()
         if invert and self.fn not in ("cosinesimilarity", "dot"):
             raise ValueError("invert=True expects a similarity function")
+        # Tree search is only EXACT for true metrics — the branch-and-bound
+        # pruning rule IS the triangle inequality (ADVICE r4). 'dot' has no
+        # metric form: refuse it here (knn() below is the exact batched
+        # path for it). 'cosinesimilarity' (1-cos) is not a metric either,
+        # but chord distance ||x̂-ŷ|| on the unit sphere is, and it ranks
+        # identically (chord² = 2·(1-cos)): the tree internally uses
+        # euclidean over normalized vectors and converts reported
+        # distances back to the 1-cos form.
+        if self.fn == "dot":
+            raise ValueError(
+                "VPTree: 'dot' is not a metric, so tree pruning would "
+                "return inexact neighbors — use clustering.vptree.knn() "
+                "(exact batched GEMM + top-k) for dot-product similarity")
+        if self.fn == "cosinesimilarity":
+            self._tree_items = self.items / np.maximum(
+                np.linalg.norm(self.items, axis=-1, keepdims=True), 1e-12)
+            self._tree_fn = "euclidean"
+        else:
+            self._tree_items = self.items
+            self._tree_fn = self.fn
         self._rng = np.random.RandomState(seed)
         self._root = self._build(list(range(self.items.shape[0])))
 
@@ -115,7 +135,8 @@ class VPTree:
             return _Node(idxs[0])
         vp = idxs[self._rng.randint(len(idxs))]
         rest = [i for i in idxs if i != vp]
-        d = _dist_np(self.items[vp], self.items[rest], self.fn)
+        d = _dist_np(self._tree_items[vp], self._tree_items[rest],
+                     self._tree_fn)
         med = float(np.median(d))
         inside = [rest[i] for i in range(len(rest)) if d[i] < med]
         outside = [rest[i] for i in range(len(rest)) if d[i] >= med]
@@ -130,6 +151,8 @@ class VPTree:
         """≡ VPTree.search: fills `results` (DataPoint) and `distances`
         lists, nearest first; also returns (results, distances)."""
         target = np.asarray(target, np.float32).reshape(-1)
+        if self.fn == "cosinesimilarity":   # search in the metric space
+            target = target / max(np.linalg.norm(target), 1e-12)
         k = min(int(k), self.items.shape[0])
         # best-first branch-and-bound with a simple max-heap of size k
         import heapq
@@ -144,11 +167,12 @@ class VPTree:
         def visit(node):
             if node is None:
                 return
-            d = float(_dist_np(target, self.items[node.index][None, :],
-                               self.fn)[0])
+            d = float(_dist_np(target, self._tree_items[node.index][None, :],
+                               self._tree_fn)[0])
             consider(node.index, d)
             if node.bucket is not None:  # degenerate leaf: vectorized scan
-                ds = _dist_np(target, self.items[node.bucket], self.fn)
+                ds = _dist_np(target, self._tree_items[node.bucket],
+                              self._tree_fn)
                 for i, bd in zip(node.bucket, ds):
                     consider(i, float(bd))
                 return
@@ -172,5 +196,7 @@ class VPTree:
             distances = []
         for d, i in order:
             results.append(DataPoint(i, self.items[i]))
-            distances.append(float(d))
+            # report in the caller's distance form: chord² = 2·(1-cos)
+            distances.append(float(d * d / 2.0)
+                             if self.fn == "cosinesimilarity" else float(d))
         return results, distances

@@ -2,6 +2,8 @@
 autodiff BN, and a conv-only (no-BN) ceiling. Dev tool, not shipped."""
 import functools
 import os
+
+os.environ.setdefault("DL4J_TPU_WANT_TPU", "1")  # TPU dev tool: explicit chip opt-in
 import sys
 import time
 

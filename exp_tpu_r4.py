@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import json
 import os
+
+os.environ.setdefault("DL4J_TPU_WANT_TPU", "1")  # TPU dev tool: explicit chip opt-in
 import sys
 import threading
 import time
@@ -185,7 +187,7 @@ def mode_lstm():
         try:
             t0 = time.perf_counter()
             chars_s, dt_s, compile_s = _bench_char_lstm(
-                batch=batch, steps=20, warmup=2)
+                batch=batch, steps=20, warmup=2, k_windows=1)
             row = {"batch": batch, "unroll": unroll, "dtype": dtype,
                    "chars_s": round(chars_s, 0),
                    "step_ms": round(dt_s * 1000, 1),
@@ -206,7 +208,8 @@ def mode_lstm():
         trace_dir = _fresh_dir(
             os.environ.get("EXP_TRACE_DIR", "/tmp/r4_lstm_trace"))
         with jax.profiler.trace(trace_dir):
-            _bench_char_lstm(batch=best["batch"], steps=2, warmup=1)
+            _bench_char_lstm(batch=best["batch"], steps=2, warmup=1,
+                             k_windows=1)
         from deeplearning4j_tpu.optimize.xplane import op_breakdown
         for name, ms, n in op_breakdown(trace_dir)[:15]:
             _emit({"op": name[:70], "ms": round(ms, 3), "n": n})

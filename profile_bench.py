@@ -1,6 +1,8 @@
 """Profile the ResNet-50 bench step on the real TPU and print a per-op
 time breakdown parsed from the xplane trace. Dev tool, not shipped API."""
 import os
+
+os.environ.setdefault("DL4J_TPU_WANT_TPU", "1")  # TPU dev tool: explicit chip opt-in
 import sys
 import time
 

@@ -159,6 +159,31 @@ class TestVPTree:
         assert set(idx[0]) == {0, 3}
         assert d[0][0] == pytest.approx(0.0, abs=1e-6)
 
+    def test_cosine_tree_search_exact(self):
+        # ADVICE r4: 1-cos is not a metric, so raw triangle-inequality
+        # pruning can drop true neighbors; the tree must search in the
+        # chord-metric space and still REPORT 1-cos distances. Wildly
+        # varying norms exercise the normalization.
+        rng = np.random.RandomState(7)
+        items = (rng.randn(300, 6) *
+                 rng.uniform(0.01, 100, (300, 1))).astype(np.float32)
+        tree = VPTree(items, similarity_function="cosinesimilarity")
+        it = items / np.linalg.norm(items, axis=-1, keepdims=True)
+        for qi in range(8):
+            q = (rng.randn(6) * 10 ** rng.uniform(-2, 2)).astype(np.float32)
+            results, dists = tree.search(q, 5)
+            od = 1.0 - it @ (q / np.linalg.norm(q))
+            oidx = np.argsort(od, kind="stable")[:5]
+            np.testing.assert_allclose(dists, od[oidx], atol=1e-5)
+            assert {r.getIndex() for r in results} == set(
+                np.argsort(od)[:5]) or np.allclose(
+                dists, od[[r.getIndex() for r in results]], atol=1e-6)
+
+    def test_dot_rejected_in_tree_path(self):
+        items = np.eye(3, dtype=np.float32)
+        with pytest.raises(ValueError, match="knn"):
+            VPTree(items, similarity_function="dot", invert=True)
+
 
 class TestTsne:
     def test_preserves_blob_structure(self):

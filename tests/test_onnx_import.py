@@ -227,6 +227,53 @@ class TestOnnxImport:
         # last row/col window covers the (padded) edge: max is the corner
         assert got[0, 0, 2, 2] == 24.0
 
+    def test_pool_ceil_mode_rejected(self):
+        # ADVICE r4: ceil_mode=1 (common in torch exports) changes output
+        # shapes — importing it silently wrong is worse than refusing
+        model = onnx_model(
+            [onnx_node("MaxPool", ["x"], ["y"], kernel_shape=[2, 2],
+                       strides=[2, 2], ceil_mode=1)],
+            {}, {"x": [1, 1, 5, 5]}, ["y"])
+        with pytest.raises(UnsupportedOnnxOpError, match="ceil_mode"):
+            importOnnx(model)
+
+    def test_pool_dilations_rejected(self):
+        model = onnx_model(
+            [onnx_node("AveragePool", ["x"], ["y"], kernel_shape=[2, 2],
+                       dilations=[2, 2])],
+            {}, {"x": [1, 1, 5, 5]}, ["y"])
+        with pytest.raises(UnsupportedOnnxOpError, match="dilations"):
+            importOnnx(model)
+
+    def test_avgpool_count_include_pad(self):
+        # padded zeros COUNT in the denominator when the attr is 1
+        model = onnx_model(
+            [onnx_node("AveragePool", ["x"], ["y"], kernel_shape=[2, 2],
+                       strides=[2, 2], pads=[1, 1, 0, 0],
+                       count_include_pad=1)],
+            {}, {"x": [1, 1, 3, 3]}, ["y"])
+        sd = importOnnx(model)
+        x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+        got = np.asarray(sd.outputSingle({"x": x}, "y").jax())
+        assert got.shape == (1, 1, 2, 2)
+        # top-right window: real elements 1,2 + two pad zeros -> /4
+        assert got[0, 0, 0, 1] == pytest.approx((1 + 2) / 4)
+        # bottom-left window: real elements 3,6 + two pad zeros -> /4
+        assert got[0, 0, 1, 0] == pytest.approx((3 + 6) / 4)
+        # interior window: 4,5,7,8 -> /4 either way
+        assert got[0, 0, 1, 1] == pytest.approx((4 + 5 + 7 + 8) / 4)
+
+    def test_avgpool_default_excludes_pad(self):
+        model = onnx_model(
+            [onnx_node("AveragePool", ["x"], ["y"], kernel_shape=[2, 2],
+                       strides=[2, 2], pads=[1, 1, 0, 0])],
+            {}, {"x": [1, 1, 3, 3]}, ["y"])
+        sd = importOnnx(model)
+        x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+        got = np.asarray(sd.outputSingle({"x": x}, "y").jax())
+        # bottom-left window has two REAL elements (3, 6)
+        assert got[0, 0, 1, 0] == pytest.approx((3 + 6) / 2)
+
     def test_softmax_opset12_flatten_semantics(self):
         """opset <13 Softmax: default axis=1, coerce-to-2D (softmax over
         ALL trailing dims together) — not per-last-axis."""

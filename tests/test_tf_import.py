@@ -337,6 +337,29 @@ class TestFrozenCnnOps:
         # padded input is 4x5 -> VALID 2x2 conv gives 3x4
         assert got.shape == (1, 3, 4, 1)
 
+    def test_bn_epsilon_default_matches_tf_opdef(self):
+        # ADVICE r4: the TF OpDef default is 1e-4; a frozen graph with the
+        # default-valued attr stripped must not import with a 10x epsilon
+        rng = np.random.RandomState(3)
+        gamma = rng.rand(2).astype(np.float32) + 0.5
+        beta = rng.randn(2).astype(np.float32)
+        mean = rng.randn(2).astype(np.float32)
+        var = rng.rand(2).astype(np.float32) * 1e-3   # tiny var: eps matters
+        data = tfproto.encode_graphdef([
+            ("x", "Placeholder", [], {}),
+            ("g", "Const", [], {"value": gamma}),
+            ("b", "Const", [], {"value": beta}),
+            ("m", "Const", [], {"value": mean}),
+            ("v", "Const", [], {"value": var}),
+            ("bn", "FusedBatchNormV3", ["x", "g", "b", "m", "v"], {}),
+        ])
+        sd = importFrozenTF(data)
+        x = rng.normal(size=(2, 3, 3, 2)).astype(np.float32)
+        got = np.asarray(sd.outputSingle({"x": x}, "bn").jax())
+        want = (x - mean) / np.sqrt(var + 1e-4) * gamma + beta
+        assert np.allclose(got, want, atol=1e-4), \
+            np.abs(got - want).max()
+
     def test_training_mode_bn_rejected(self):
         z = np.zeros(1, np.float32)
         data = tfproto.encode_graphdef([
