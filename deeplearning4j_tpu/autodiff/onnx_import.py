@@ -95,17 +95,25 @@ def _attr(node, name, default):
     return default if v is None else v
 
 
-def _resolve_pads(node, k, s, d, spatial):
+def _pads_params(node):
+    """The (auto_pad, pads) attribute pair as plain JSON values — what a
+    serialized conv/pool node needs to re-resolve its padding at trace
+    time (graph_serde: params must be data, not objects)."""
+    auto = _attr(node, "auto_pad", "NOTSET")
+    if isinstance(auto, bytes):
+        auto = auto.decode()
+    pads = node.attrs.get("pads")
+    return auto, (None if pads is None else [int(p) for p in pads])
+
+
+def _resolve_pads(auto, pads, k, s, d, spatial, name=""):
     """Effective ((lo, hi), ...) spatial padding for Conv/pools, honoring
     `auto_pad` (SAME_UPPER/SAME_LOWER/VALID) over the explicit `pads`
     attribute — older exporters still emit auto_pad, and ignoring it
     silently imported zero padding (round-1 ADVICE).  `spatial` is the
     static input spatial shape (known at trace time)."""
-    auto = _attr(node, "auto_pad", "NOTSET")
-    if isinstance(auto, bytes):
-        auto = auto.decode()
     if auto in ("NOTSET", ""):
-        pads = node.attrs.get("pads") or [0] * (2 * len(spatial))
+        pads = pads or [0] * (2 * len(spatial))
         n = len(spatial)
         return [(int(pads[i]), int(pads[i + n])) for i in range(n)]
     if auto == "VALID":
@@ -121,7 +129,7 @@ def _resolve_pads(node, k, s, d, spatial):
             out.append((hi, lo) if auto == "SAME_LOWER" else (lo, hi))
         return out
     raise UnsupportedOnnxOpError(
-        f"{node.name}: unsupported auto_pad value {auto!r}")
+        f"{name}: unsupported auto_pad value {auto!r}")
 
 
 class OnnxNode:
@@ -185,6 +193,244 @@ _ONNX_ELEMENTWISE = {
     "Ceil": jnp.ceil, "Sign": jnp.sign,
 }
 
+# -- serializable op builders (graph_serde registry, "onnx." namespace) --
+# Every imported node lowers to (opname, params) with params plain JSON, so
+# an imported-then-saved graph restores with no ONNX file and no user code
+# (VERDICT r4 #3: the import paths must be durable).
+from deeplearning4j_tpu.autodiff.graph_serde import op_builder  # noqa: E402
+
+for _opn, _fn in _ONNX_ELEMENTWISE.items():
+    op_builder("onnx." + _opn.lower())((lambda f: lambda: f)(_fn))
+op_builder("onnx.matmul")(lambda: jnp.matmul)
+op_builder("onnx.softplus")(lambda: jax.nn.softplus)
+op_builder("onnx.gap")(
+    lambda: lambda x: jnp.mean(x, axis=(2, 3), keepdims=True))
+
+
+@op_builder("onnx.gemm")
+def _b_gemm(alpha=1.0, beta=1.0, ta=0, tb=0):
+    def gemm(a, b, *c):
+        a = a.T if ta else a
+        b = b.T if tb else b
+        y = alpha * (a @ b)
+        return y + beta * c[0] if c else y
+    return gemm
+
+
+@op_builder("onnx.softmax")
+def _b_softmax(axis=-1):
+    return lambda x: jax.nn.softmax(x, axis=axis)
+
+
+@op_builder("onnx.softmax_2d")
+def _b_softmax_2d(axis=1):
+    # opset <13 coerce-to-2D semantics: softmax over ALL dims from `axis`
+    # on, flattened together
+    def softmax_2d(x):
+        ax = axis if axis >= 0 else x.ndim + axis
+        lead = int(np.prod(x.shape[:ax])) if ax else 1
+        y = jax.nn.softmax(x.reshape(lead, -1), axis=-1)
+        return y.reshape(x.shape)
+    return softmax_2d
+
+
+@op_builder("onnx.reshape")
+def _b_reshape(shape):
+    return lambda x, *_r: jnp.reshape(x, tuple(shape))
+
+
+@op_builder("onnx.transpose")
+def _b_transpose(perm=None):
+    p = None if perm is None else tuple(perm)
+    return lambda x: jnp.transpose(x, p)
+
+
+@op_builder("onnx.concat")
+def _b_concat(axis=0):
+    return lambda *xs: jnp.concatenate(xs, axis)
+
+
+@op_builder("onnx.gather")
+def _b_gather(axis=0):
+    return lambda p, i: jnp.take(p, i.astype(jnp.int32), axis=axis)
+
+
+@op_builder("onnx.flatten")
+def _b_flatten(axis=1):
+    return lambda x: x.reshape((int(np.prod(x.shape[:axis])), -1))
+
+
+@op_builder("onnx.squeeze")
+def _b_squeeze(axes=()):
+    ax = tuple(axes)
+    return lambda x, *_r: jnp.squeeze(x, ax or None)
+
+
+@op_builder("onnx.unsqueeze")
+def _b_unsqueeze(axes=()):
+    def unsq(x, *_r):
+        for a in sorted(axes):
+            x = jnp.expand_dims(x, a)
+        return x
+    return unsq
+
+
+@op_builder("onnx.reduce_mean")
+def _b_reduce_mean(axes=(), keep=1):
+    ax = tuple(axes)
+    return lambda x, *_r: jnp.mean(x, axis=ax or None, keepdims=bool(keep))
+
+
+@op_builder("onnx.conv")
+def _b_conv(strides=(1, 1), dil=(1, 1), groups=1, auto_pad="NOTSET",
+            pads=None, name=""):
+    st, dl = tuple(strides), tuple(dil)
+
+    def conv(x, w, *b):
+        # pads resolved at trace time: auto_pad=SAME_* depends on the
+        # (static) input spatial shape
+        pad_arg = _resolve_pads(auto_pad, pads, w.shape[2:], st, dl,
+                                x.shape[2:], name)
+        y = jax.lax.conv_general_dilated(
+            x, w.astype(x.dtype), window_strides=st,
+            padding=pad_arg, rhs_dilation=dl,
+            feature_group_count=groups,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return y + b[0].reshape(1, -1, 1, 1) if b else y
+    return conv
+
+
+@op_builder("onnx.maxpool")
+def _b_maxpool(ksize, strides, auto_pad="NOTSET", pads=None, name=""):
+    k, s = tuple(ksize), tuple(strides)
+    window, strd = (1, 1) + k, (1, 1) + s
+    ones = (1,) * len(k)
+
+    def f(x):
+        pad_arg = [(0, 0), (0, 0)] + _resolve_pads(auto_pad, pads, k, s,
+                                                   ones, x.shape[2:], name)
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                     strd, pad_arg)
+    return f
+
+
+@op_builder("onnx.avgpool")
+def _b_avgpool(ksize, strides, auto_pad="NOTSET", pads=None,
+               include_pad=False, name=""):
+    k, s = tuple(ksize), tuple(strides)
+    window, strd = (1, 1) + k, (1, 1) + s
+    ones = (1,) * len(k)
+
+    def avg(x):
+        pad_arg = [(0, 0), (0, 0)] + _resolve_pads(auto_pad, pads, k, s,
+                                                   ones, x.shape[2:], name)
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strd,
+                                       pad_arg)
+        if include_pad:
+            # padded zeros COUNT: divide by the full kernel size
+            return summed / float(np.prod(k))
+        n = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                  window, strd, pad_arg)
+        return summed / n
+    return avg
+
+
+@op_builder("onnx.batchnorm")
+def _b_batchnorm(eps=1e-5):
+    def bn(x, gamma, beta, mean, var):
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        return ((x - mean.reshape(shape))
+                * jax.lax.rsqrt(var.reshape(shape) + eps)
+                * gamma.reshape(shape) + beta.reshape(shape))
+    return bn
+
+
+@op_builder("onnx.cast")
+def _b_cast(to=1):
+    np_dt = _ONNX_DTYPES.get(int(to), np.float32)
+    return lambda x: x.astype(np_dt)
+
+
+@op_builder("onnx.clip")
+def _b_clip(lo, hi):
+    # open bounds travel as null (strict-JSON artifact), not Infinity
+    l = -np.inf if lo is None else lo
+    h = np.inf if hi is None else hi
+    return lambda x, *_r: jnp.clip(x, l, h)
+
+
+@op_builder("onnx.leakyrelu")
+def _b_leakyrelu(alpha=0.01):
+    return lambda x: jnp.where(x > 0, x, alpha * x)
+
+
+@op_builder("onnx.elu")
+def _b_elu(alpha=1.0):
+    return lambda x: jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+@op_builder("onnx.hardsigmoid")
+def _b_hardsigmoid(alpha=0.2, beta=0.5):
+    return lambda x: jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+@op_builder("onnx.conv_transpose")
+def _b_conv_transpose(strides=(1, 1), dil=(1, 1), pads=None,
+                      out_pad=(0, 0)):
+    st, dl, op_ = tuple(strides), tuple(dil), tuple(out_pad)
+
+    def convt(x, w, *b):
+        # ONNX weights are (Cin, Cout, kH, kW); the fractionally-strided
+        # equivalent conv wants (Cout, Cin, kH, kW) with spatially flipped
+        # taps and lhs_dilation = stride
+        wf = jnp.flip(w, axis=(-2, -1)).swapaxes(0, 1)
+        kh = (w.shape[2] - 1) * dl[0] + 1
+        kw = (w.shape[3] - 1) * dl[1] + 1
+        p = pads or (0, 0, 0, 0)   # (top, left, bottom, right)
+        pad_arg = [(kh - 1 - p[0], kh - 1 - p[2] + op_[0]),
+                   (kw - 1 - p[1], kw - 1 - p[3] + op_[1])]
+        y = jax.lax.conv_general_dilated(
+            x, wf.astype(x.dtype), window_strides=(1, 1),
+            padding=pad_arg, lhs_dilation=st, rhs_dilation=dl,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return y + b[0].reshape(1, -1, 1, 1) if b else y
+    return convt
+
+
+@op_builder("onnx.pad")
+def _b_pad(pads, jmode="constant", cval=0.0, name=""):
+    def pad(x, *_r):
+        n = x.ndim
+        if len(pads) != 2 * n:
+            raise UnsupportedOnnxOpError(
+                f"{name}: Pad expects {2 * n} widths for rank-{n} "
+                f"input, got {len(pads)}")
+        width = [(pads[i], pads[i + n]) for i in range(n)]
+        if jmode == "constant":
+            return jnp.pad(x, width, constant_values=cval)
+        return jnp.pad(x, width, mode=jmode)
+    return pad
+
+
+@op_builder("onnx.resize")
+def _b_resize(scales=None, sizes=None, name=""):
+    def resize(x, *_r):
+        if scales is not None:
+            sh, sw = int(scales[2]), int(scales[3])
+        else:
+            if sizes[0] != x.shape[0] or sizes[1] != x.shape[1]:
+                raise UnsupportedOnnxOpError(
+                    f"{name}: Resize sizes may not change "
+                    f"batch/channel dims")
+            if sizes[2] % x.shape[2] or sizes[3] % x.shape[3]:
+                raise UnsupportedOnnxOpError(
+                    f"{name}: Resize sizes {sizes[2:]} are not "
+                    f"integer multiples of input {x.shape[2:]}")
+            sh = sizes[2] // x.shape[2]
+            sw = sizes[3] // x.shape[3]
+        return jnp.repeat(jnp.repeat(x, sh, axis=2), sw, axis=3)
+    return resize
+
 
 class OnnxGraphMapper:
     @staticmethod
@@ -223,83 +469,53 @@ class OnnxGraphMapper:
             sd.constant(out, np.asarray(val))
             return
         if op in _ONNX_ELEMENTWISE:
-            sd._op_named(out, op.lower(), _ONNX_ELEMENTWISE[op], *ins)
+            sd._op_named(out, "onnx." + op.lower(), None, *ins, params={})
         elif op == "MatMul":
-            sd._op_named(out, "matmul", jnp.matmul, *ins)
+            sd._op_named(out, "onnx.matmul", None, *ins, params={})
         elif op == "Gemm":
-            alpha = float(_attr(node, "alpha", 1.0))
-            beta = float(_attr(node, "beta", 1.0))
-            ta = int(_attr(node, "transA", 0))
-            tb = int(_attr(node, "transB", 0))
-
-            def gemm(a, b, *c, alpha=alpha, beta=beta, ta=ta, tb=tb):
-                a = a.T if ta else a
-                b = b.T if tb else b
-                y = alpha * (a @ b)
-                return y + beta * c[0] if c else y
-            sd._op_named(out, "gemm", gemm, *ins)
+            sd._op_named(out, "onnx.gemm", None, *ins, params={
+                "alpha": float(_attr(node, "alpha", 1.0)),
+                "beta": float(_attr(node, "beta", 1.0)),
+                "ta": int(_attr(node, "transA", 0)),
+                "tb": int(_attr(node, "transB", 0))})
         elif op == "Softmax":
             if opset < 13:
-                # opset <13: default axis=1 with coerce-to-2D semantics —
-                # softmax over ALL dims from `axis` on, flattened together.
-                axis = int(_attr(node, "axis", 1))
-
-                def softmax_2d(x, axis=axis):
-                    ax = axis if axis >= 0 else x.ndim + axis
-                    lead = int(np.prod(x.shape[:ax])) if ax else 1
-                    y = jax.nn.softmax(x.reshape(lead, -1), axis=-1)
-                    return y.reshape(x.shape)
-                sd._op_named(out, "softmax", softmax_2d, *ins)
+                sd._op_named(out, "onnx.softmax_2d", None, *ins,
+                             params={"axis": int(_attr(node, "axis", 1))})
             else:
-                axis = int(_attr(node, "axis", -1))
-                sd._op_named(out, "softmax",
-                             lambda x, axis=axis: jax.nn.softmax(
-                                 x, axis=axis), *ins)
+                sd._op_named(out, "onnx.softmax", None, *ins,
+                             params={"axis": int(_attr(node, "axis", -1))})
         elif op == "Reshape":
             shp = const_val(1)
             if shp is None:
                 raise UnsupportedOnnxOpError(
                     f"{out}: dynamic Reshape unsupported")
-            shp = tuple(int(s) for s in np.asarray(shp).reshape(-1))
-            sd._op_named(out, "reshape",
-                         lambda x, _s, shp=shp: jnp.reshape(x, shp), *ins)
+            shp = [int(s) for s in np.asarray(shp).reshape(-1)]
+            sd._op_named(out, "onnx.reshape", None, *ins,
+                         params={"shape": shp})
         elif op == "Transpose":
             perm = node.attrs.get("perm")
-            perm = None if perm is None else tuple(int(p) for p in perm)
-            sd._op_named(out, "transpose",
-                         lambda x, perm=perm: jnp.transpose(x, perm), *ins)
+            perm = None if perm is None else [int(p) for p in perm]
+            sd._op_named(out, "onnx.transpose", None, *ins,
+                         params={"perm": perm})
         elif op == "Concat":
-            axis = int(_attr(node, "axis", 0))
-            sd._op_named(out, "concat",
-                         lambda *xs, axis=axis: jnp.concatenate(xs, axis),
-                         *ins)
+            sd._op_named(out, "onnx.concat", None, *ins,
+                         params={"axis": int(_attr(node, "axis", 0))})
         elif op == "Gather":
-            axis = int(_attr(node, "axis", 0))
-            sd._op_named(out, "gather",
-                         lambda p, i, axis=axis: jnp.take(
-                             p, i.astype(jnp.int32), axis=axis), *ins)
+            sd._op_named(out, "onnx.gather", None, *ins,
+                         params={"axis": int(_attr(node, "axis", 0))})
         elif op == "Flatten":
-            axis = int(_attr(node, "axis", 1))
-            sd._op_named(out, "flatten",
-                         lambda x, axis=axis: x.reshape(
-                             (int(np.prod(x.shape[:axis])), -1)), *ins)
+            sd._op_named(out, "onnx.flatten", None, *ins,
+                         params={"axis": int(_attr(node, "axis", 1))})
         elif op in ("Squeeze", "Unsqueeze"):
             axes = node.attrs.get("axes")
             if axes is None and len(node.inputs) > 1:
                 av = const_val(1)
                 axes = None if av is None else np.asarray(
                     av).reshape(-1).tolist()
-            axes = tuple(int(a) for a in (axes or []))
-            if op == "Squeeze":
-                sd._op_named(out, "squeeze",
-                             lambda x, *_r, axes=axes: jnp.squeeze(
-                                 x, axes or None), *ins)
-            else:
-                def unsq(x, *_r, axes=axes):
-                    for a in sorted(axes):
-                        x = jnp.expand_dims(x, a)
-                    return x
-                sd._op_named(out, "unsqueeze", unsq, *ins)
+            axes = [int(a) for a in (axes or [])]
+            sd._op_named(out, "onnx." + op.lower(), None, *ins,
+                         params={"axes": axes})
         elif op == "ReduceMean":
             axes = node.attrs.get("axes")
             if axes is None and len(node.inputs) > 1:   # opset-18: input
@@ -308,36 +524,23 @@ class OnnxGraphMapper:
                     raise UnsupportedOnnxOpError(
                         f"{out}: dynamic ReduceMean axes unsupported")
                 axes = np.asarray(av).reshape(-1).tolist()
-            axes = tuple(int(a) for a in (axes or []))
-            keep = int(_attr(node, "keepdims", 1))
-            sd._op_named(out, "reduce_mean",
-                         lambda x, *_r, axes=axes, keep=keep: jnp.mean(
-                             x, axis=axes or None, keepdims=bool(keep)),
-                         *ins)
+            sd._op_named(out, "onnx.reduce_mean", None, *ins, params={
+                "axes": [int(a) for a in (axes or [])],
+                "keep": int(_attr(node, "keepdims", 1))})
         elif op == "Conv":
-            strides = tuple(node.attrs.get("strides") or (1, 1))
-            dil = tuple(node.attrs.get("dilations") or (1, 1))
-            groups = int(_attr(node, "group", 1))
-
-            def conv(x, w, *b, strides=strides, dil=dil, groups=groups,
-                     node=node):
-                # pads resolved at trace time: auto_pad=SAME_* depends on
-                # the (static) input spatial shape
-                pad_arg = _resolve_pads(node, w.shape[2:], strides, dil,
-                                        x.shape[2:])
-                y = jax.lax.conv_general_dilated(
-                    x, w.astype(x.dtype), window_strides=strides,
-                    padding=pad_arg, rhs_dilation=dil,
-                    feature_group_count=groups,
-                    dimension_numbers=("NCHW", "OIHW", "NCHW"))
-                return y + b[0].reshape(1, -1, 1, 1) if b else y
-            sd._op_named(out, "conv", conv, *ins)
+            auto, pads = _pads_params(node)
+            sd._op_named(out, "onnx.conv", None, *ins, params={
+                "strides": [int(s) for s in
+                            (node.attrs.get("strides") or (1, 1))],
+                "dil": [int(d) for d in
+                        (node.attrs.get("dilations") or (1, 1))],
+                "groups": int(_attr(node, "group", 1)),
+                "auto_pad": auto, "pads": pads, "name": out})
         elif op in ("MaxPool", "AveragePool"):
-            ksize = tuple(node.attrs.get("kernel_shape") or (2, 2))
-            strides = tuple(node.attrs.get("strides") or ksize)
-            window = (1, 1) + ksize
-            strd = (1, 1) + strides
-            ones = (1,) * len(ksize)
+            ksize = [int(k) for k in
+                     (node.attrs.get("kernel_shape") or (2, 2))]
+            strides = [int(s) for s in
+                       (node.attrs.get("strides") or ksize)]
             # Module convention: silently-wrong output is worse than a
             # loud unsupported error (ADVICE r4). ceil_mode=1 (common in
             # torch exports) changes output SHAPES; pool dilations change
@@ -346,56 +549,30 @@ class OnnxGraphMapper:
                 raise UnsupportedOnnxOpError(
                     f"{out}: {op} ceil_mode=1 unsupported (re-export with "
                     "ceil_mode=0 / torch.onnx ceil_mode=False)")
-            pdil = tuple(node.attrs.get("dilations") or ones)
+            pdil = tuple(node.attrs.get("dilations") or (1,) * len(ksize))
             if any(d != 1 for d in pdil):
                 raise UnsupportedOnnxOpError(
                     f"{out}: {op} dilations={pdil} unsupported")
-            # count_include_pad=1: divide by the FULL kernel size
-            # everywhere (padded zeros count); default 0 divides by the
-            # number of real elements under each window.
-            include_pad = int(_attr(node, "count_include_pad", 0)) != 0
-
-            def pool_pads(x, node=node, ksize=ksize, strides=strides,
-                          ones=ones):
-                return [(0, 0), (0, 0)] + _resolve_pads(
-                    node, ksize, strides, ones, x.shape[2:])
+            auto, pads = _pads_params(node)
+            params = {"ksize": ksize, "strides": strides,
+                      "auto_pad": auto, "pads": pads, "name": out}
             if op == "MaxPool":
-                sd._op_named(out, "maxpool",
-                             lambda x, window=window, strd=strd,
-                             pool_pads=pool_pads: jax.lax.reduce_window(
-                                 x, -jnp.inf, jax.lax.max, window, strd,
-                                 pool_pads(x)), *ins)
+                sd._op_named(out, "onnx.maxpool", None, *ins, params=params)
             else:
-                def avg(x, window=window, strd=strd, pool_pads=pool_pads,
-                        include_pad=include_pad, ksize=ksize):
-                    pad_arg = pool_pads(x)
-                    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window,
-                                              strd, pad_arg)
-                    if include_pad:
-                        return s / float(np.prod(ksize))
-                    n = jax.lax.reduce_window(jnp.ones_like(x), 0.0,
-                                              jax.lax.add, window, strd,
-                                              pad_arg)
-                    return s / n
-                sd._op_named(out, "avgpool", avg, *ins)
+                # count_include_pad=1: divide by the FULL kernel size
+                # everywhere (padded zeros count); default 0 divides by
+                # the number of real elements under each window.
+                params["include_pad"] = \
+                    int(_attr(node, "count_include_pad", 0)) != 0
+                sd._op_named(out, "onnx.avgpool", None, *ins, params=params)
         elif op == "GlobalAveragePool":
-            sd._op_named(out, "gap",
-                         lambda x: jnp.mean(x, axis=(2, 3), keepdims=True),
-                         *ins)
+            sd._op_named(out, "onnx.gap", None, *ins, params={})
         elif op == "BatchNormalization":
-            eps = float(_attr(node, "epsilon", 1e-5))
-
-            def bn(x, gamma, beta, mean, var, eps=eps):
-                shape = (1, -1) + (1,) * (x.ndim - 2)
-                return ((x - mean.reshape(shape))
-                        * jax.lax.rsqrt(var.reshape(shape) + eps)
-                        * gamma.reshape(shape) + beta.reshape(shape))
-            sd._op_named(out, "batchnorm", bn, *ins)
+            sd._op_named(out, "onnx.batchnorm", None, *ins, params={
+                "eps": float(_attr(node, "epsilon", 1e-5))})
         elif op == "Cast":
-            to = int(_attr(node, "to", 1))
-            np_dt = _ONNX_DTYPES.get(to, np.float32)
-            sd._op_named(out, "cast",
-                         lambda x, np_dt=np_dt: x.astype(np_dt), *ins)
+            sd._op_named(out, "onnx.cast", None, *ins,
+                         params={"to": int(_attr(node, "to", 1))})
         elif op == "Clip":
             lo = _attr(node, "min", None)
             hi = _attr(node, "max", None)
@@ -411,29 +588,21 @@ class OnnxGraphMapper:
                     raise UnsupportedOnnxOpError(
                         f"{out}: dynamic Clip max unsupported")
                 hi = float(np.asarray(cv).reshape(()))
-            lo = -np.inf if lo is None else float(lo)
-            hi = np.inf if hi is None else float(hi)
-            sd._op_named(out, "clip",
-                         lambda x, *_r, lo=lo, hi=hi: jnp.clip(x, lo, hi),
-                         *ins)
+            sd._op_named(out, "onnx.clip", None, *ins, params={
+                "lo": None if lo is None else float(lo),
+                "hi": None if hi is None else float(hi)})
         elif op == "LeakyRelu":
-            alpha = float(_attr(node, "alpha", 0.01))
-            sd._op_named(out, "leakyrelu",
-                         lambda x, alpha=alpha: jnp.where(x > 0, x,
-                                                          alpha * x), *ins)
+            sd._op_named(out, "onnx.leakyrelu", None, *ins,
+                         params={"alpha": float(_attr(node, "alpha", 0.01))})
         elif op == "Elu":
-            alpha = float(_attr(node, "alpha", 1.0))
-            sd._op_named(out, "elu",
-                         lambda x, alpha=alpha: jnp.where(
-                             x > 0, x, alpha * (jnp.exp(x) - 1.0)), *ins)
+            sd._op_named(out, "onnx.elu", None, *ins,
+                         params={"alpha": float(_attr(node, "alpha", 1.0))})
         elif op == "Softplus":
-            sd._op_named(out, "softplus", jax.nn.softplus, *ins)
+            sd._op_named(out, "onnx.softplus", None, *ins, params={})
         elif op == "HardSigmoid":
-            alpha = float(_attr(node, "alpha", 0.2))
-            beta = float(_attr(node, "beta", 0.5))
-            sd._op_named(out, "hardsigmoid",
-                         lambda x, a=alpha, b=beta: jnp.clip(
-                             a * x + b, 0.0, 1.0), *ins)
+            sd._op_named(out, "onnx.hardsigmoid", None, *ins, params={
+                "alpha": float(_attr(node, "alpha", 0.2)),
+                "beta": float(_attr(node, "beta", 0.5))})
         elif op == "ConvTranspose":
             strides = tuple(node.attrs.get("strides") or (1, 1))
             dil = tuple(node.attrs.get("dilations") or (1, 1))
@@ -454,25 +623,11 @@ class OnnxGraphMapper:
                     f"{out}: ConvTranspose output_shape unsupported "
                     f"(export with explicit pads)")
             pads = node.attrs.get("pads")
-
-            def convt(x, w, *b, strides=strides, dil=dil, pads=pads,
-                      out_pad=out_pad):
-                # ONNX weights are (Cin, Cout, kH, kW); the fractionally-
-                # strided equivalent conv wants (Cout, Cin, kH, kW) with
-                # spatially flipped taps and lhs_dilation = stride
-                wf = jnp.flip(w, axis=(-2, -1)).swapaxes(0, 1)
-                kh = (w.shape[2] - 1) * dil[0] + 1
-                kw = (w.shape[3] - 1) * dil[1] + 1
-                p = pads or (0, 0, 0, 0)   # (top, left, bottom, right)
-                pad_arg = [(kh - 1 - p[0], kh - 1 - p[2] + out_pad[0]),
-                           (kw - 1 - p[1], kw - 1 - p[3] + out_pad[1])]
-                y = jax.lax.conv_general_dilated(
-                    x, wf.astype(x.dtype), window_strides=(1, 1),
-                    padding=pad_arg, lhs_dilation=strides,
-                    rhs_dilation=dil,
-                    dimension_numbers=("NCHW", "OIHW", "NCHW"))
-                return y + b[0].reshape(1, -1, 1, 1) if b else y
-            sd._op_named(out, "conv_transpose", convt, *ins)
+            sd._op_named(out, "onnx.conv_transpose", None, *ins, params={
+                "strides": [int(s) for s in strides],
+                "dil": [int(d) for d in dil],
+                "pads": None if pads is None else [int(p) for p in pads],
+                "out_pad": [int(p) for p in out_pad]})
         elif op == "Pad":
             mode = node.attrs.get("mode", b"constant")
             mode = (mode.decode() if isinstance(mode, (bytes, bytearray))
@@ -500,18 +655,8 @@ class OnnxGraphMapper:
                      "edge": "edge"}.get(mode)
             if jmode is None:
                 raise UnsupportedOnnxOpError(f"{out}: Pad mode {mode!r}")
-
-            def pad(x, *_r, pads=pads, jmode=jmode, cval=cval, name=out):
-                n = x.ndim
-                if len(pads) != 2 * n:
-                    raise UnsupportedOnnxOpError(
-                        f"{name}: Pad expects {2 * n} widths for rank-{n} "
-                        f"input, got {len(pads)}")
-                width = [(pads[i], pads[i + n]) for i in range(n)]
-                if jmode == "constant":
-                    return jnp.pad(x, width, constant_values=cval)
-                return jnp.pad(x, width, mode=jmode)
-            sd._op_named(out, "pad", pad, *ins)
+            sd._op_named(out, "onnx.pad", None, *ins, params={
+                "pads": pads, "jmode": jmode, "cval": cval, "name": out})
         elif op in ("Resize", "Upsample"):
             mode = node.attrs.get("mode", b"nearest")
             mode = (mode.decode() if isinstance(mode, (bytes, bytearray))
@@ -556,23 +701,10 @@ class OnnxGraphMapper:
                     raise UnsupportedOnnxOpError(
                         f"{out}: non-integer upsample scales ({sh}, {sw})")
 
-            def resize(x, *_r, scales=scales, sizes=sizes, name=out):
-                if scales is not None:
-                    sh, sw = int(scales[2]), int(scales[3])
-                else:
-                    if sizes[0] != x.shape[0] or sizes[1] != x.shape[1]:
-                        raise UnsupportedOnnxOpError(
-                            f"{name}: Resize sizes may not change "
-                            f"batch/channel dims")
-                    if sizes[2] % x.shape[2] or sizes[3] % x.shape[3]:
-                        raise UnsupportedOnnxOpError(
-                            f"{name}: Resize sizes {sizes[2:]} are not "
-                            f"integer multiples of input "
-                            f"{x.shape[2:]}")
-                    sh = sizes[2] // x.shape[2]
-                    sw = sizes[3] // x.shape[3]
-                return jnp.repeat(jnp.repeat(x, sh, axis=2), sw, axis=3)
-            sd._op_named(out, "resize", resize, *ins)
+            sd._op_named(out, "onnx.resize", None, *ins, params={
+                "scales": None if scales is None else [float(s)
+                                                      for s in scales],
+                "sizes": sizes, "name": out})
         else:
             raise UnsupportedOnnxOpError(
                 f"ONNX op '{op}' (node '{out}') is not in the import set")

@@ -41,71 +41,71 @@ class SDVariable:
         self.inputs = list(inputs)      # parent variable names
 
     # -- fluent math (mirrors SDVariable's operator surface) -------------
-    def _bin(self, other, fn, opname):
+    def _bin(self, other, opname):
         other = self.sd._lift(other)
-        return self.sd._op(opname, fn, self, other)
+        return self.sd._op(opname, None, self, other, params={})
 
     def add(self, o):
-        return self._bin(o, jnp.add, "add")
+        return self._bin(o, "add")
 
     def sub(self, o):
-        return self._bin(o, jnp.subtract, "sub")
+        return self._bin(o, "sub")
 
     def mul(self, o):
-        return self._bin(o, jnp.multiply, "mul")
+        return self._bin(o, "mul")
 
     def div(self, o):
-        return self._bin(o, jnp.divide, "div")
+        return self._bin(o, "div")
 
     def rsub(self, o):
-        return self.sd._lift(o)._bin(self, jnp.subtract, "rsub")
+        return self.sd._lift(o)._bin(self, "sub")
 
     def rdiv(self, o):
-        return self.sd._lift(o)._bin(self, jnp.divide, "rdiv")
+        return self.sd._lift(o)._bin(self, "div")
 
     def mmul(self, o):
-        return self._bin(o, jnp.matmul, "mmul")
+        return self._bin(o, "mmul")
 
     def pow(self, p):
-        return self.sd._op("pow", lambda a: jnp.power(a, p), self)
+        return self.sd._op("pow", None, self, params={"p": float(p)})
 
     def neg(self):
-        return self.sd._op("neg", jnp.negative, self)
+        return self.sd._op("neg", None, self, params={})
 
     def transpose(self, *axes):
-        ax = axes or None
-        return self.sd._op("transpose",
-                           lambda a: jnp.transpose(a, ax), self)
+        ax = list(axes) if axes else None
+        return self.sd._op("transpose", None, self, params={"axes": ax})
 
     def reshape(self, *shape):
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
             shape = tuple(shape[0])
-        return self.sd._op("reshape", lambda a: jnp.reshape(a, shape), self)
+        return self.sd._op("reshape", None, self,
+                           params={"shape": [int(s) for s in shape]})
 
-    def _reduce(self, fn, opname, dims, keepdims):
+    def _reduce(self, opname, dims, keepdims):
         ax = None
         if dims:
-            ax = dims[0] if len(dims) == 1 else tuple(dims)
-        return self.sd._op(opname,
-                           lambda a: fn(a, axis=ax, keepdims=keepdims), self)
+            ax = int(dims[0]) if len(dims) == 1 else [int(d) for d in dims]
+        return self.sd._op(opname, None, self,
+                           params={"axis": ax, "keepdims": bool(keepdims)})
 
     def sum(self, *dims, keepdims=False):
-        return self._reduce(jnp.sum, "sum", dims, keepdims)
+        return self._reduce("sum", dims, keepdims)
 
     def mean(self, *dims, keepdims=False):
-        return self._reduce(jnp.mean, "mean", dims, keepdims)
+        return self._reduce("mean", dims, keepdims)
 
     def max(self, *dims, keepdims=False):
-        return self._reduce(jnp.max, "max", dims, keepdims)
+        return self._reduce("max", dims, keepdims)
 
     def min(self, *dims, keepdims=False):
-        return self._reduce(jnp.min, "min", dims, keepdims)
+        return self._reduce("min", dims, keepdims)
 
     def std(self, *dims, keepdims=False):
-        return self._reduce(jnp.std, "std", dims, keepdims)
+        return self._reduce("std", dims, keepdims)
 
     def argmax(self, dim=-1):
-        return self.sd._op("argmax", lambda a: jnp.argmax(a, axis=dim), self)
+        return self.sd._op("argmax", None, self, params={"dim": int(dim)})
 
     # python operators
     __add__ = add
@@ -151,38 +151,43 @@ class _MathNamespace:
     def __init__(self, sd):
         self.sd = sd
 
-    def _u(self, opname, fn, x):
-        return self.sd._op(opname, fn, self.sd._lift(x))
+    def _u(self, opname, x, params=None):
+        return self.sd._op(opname, None, self.sd._lift(x),
+                           params=params or {})
 
     def exp(self, x):
-        return self._u("exp", jnp.exp, x)
+        return self._u("exp", x)
 
     def log(self, x):
-        return self._u("log", jnp.log, x)
+        return self._u("log", x)
 
     def sqrt(self, x):
-        return self._u("sqrt", jnp.sqrt, x)
+        return self._u("sqrt", x)
 
     def square(self, x):
-        return self._u("square", jnp.square, x)
+        return self._u("square", x)
 
     def abs(self, x):
-        return self._u("abs", jnp.abs, x)
+        return self._u("abs", x)
 
     def sin(self, x):
-        return self._u("sin", jnp.sin, x)
+        return self._u("sin", x)
 
     def cos(self, x):
-        return self._u("cos", jnp.cos, x)
+        return self._u("cos", x)
 
     def tanh(self, x):
-        return self._u("tanh", jnp.tanh, x)
+        return self._u("tanh", x)
 
     def sigmoid(self, x):
-        return self._u("sigmoid", jax.nn.sigmoid, x)
+        return self._u("sigmoid", x)
 
     def clip(self, x, lo, hi):
-        return self._u("clip", lambda a: jnp.clip(a, lo, hi), x)
+        # open bounds travel as null: the artifact is strict JSON
+        # (allow_nan=False), so ±inf must not reach params
+        return self._u("clip", x, {
+            "lo": None if lo == -np.inf else float(lo),
+            "hi": None if hi == np.inf else float(hi)})
 
 
 class _NNNamespace:
@@ -190,30 +195,28 @@ class _NNNamespace:
         self.sd = sd
 
     def relu(self, x):
-        return self.sd._op("relu", jax.nn.relu, self.sd._lift(x))
+        return self.sd._op("relu", None, self.sd._lift(x), params={})
 
     def gelu(self, x):
-        return self.sd._op("gelu", jax.nn.gelu, self.sd._lift(x))
+        return self.sd._op("gelu", None, self.sd._lift(x), params={})
 
     def softmax(self, x, axis=-1):
-        return self.sd._op("softmax",
-                           lambda a: jax.nn.softmax(a, axis=axis),
-                           self.sd._lift(x))
+        return self.sd._op("softmax", None, self.sd._lift(x),
+                           params={"axis": int(axis)})
 
     def logSoftmax(self, x, axis=-1):
-        return self.sd._op("log_softmax",
-                           lambda a: jax.nn.log_softmax(a, axis=axis),
-                           self.sd._lift(x))
+        return self.sd._op("log_softmax", None, self.sd._lift(x),
+                           params={"axis": int(axis)})
 
     def tanh(self, x):
-        return self.sd._op("tanh", jnp.tanh, self.sd._lift(x))
+        return self.sd._op("tanh", None, self.sd._lift(x), params={})
 
     def sigmoid(self, x):
-        return self.sd._op("sigmoid", jax.nn.sigmoid, self.sd._lift(x))
+        return self.sd._op("sigmoid", None, self.sd._lift(x), params={})
 
     def dropout(self, x, keep_prob):
         # inference identity; train-time dropout arrives via fit rngs
-        return self.sd._op("dropout_id", lambda a: a, self.sd._lift(x))
+        return self.sd._op("dropout_id", None, self.sd._lift(x), params={})
 
     def linear(self, input, weights, bias=None):
         if bias is None:
@@ -222,21 +225,15 @@ class _NNNamespace:
 
     def layerNorm(self, x, gain, bias=None, eps=1e-5, axis=-1):
         x, gain = self.sd._lift(x), self.sd._lift(gain)
-
-        def f(a, g, *b):
-            mu = jnp.mean(a, axis=axis, keepdims=True)
-            var = jnp.var(a, axis=axis, keepdims=True)
-            y = (a - mu) * jax.lax.rsqrt(var + eps) * g
-            return y + b[0] if b else y
-
         ins = (x, gain) + ((self.sd._lift(bias),) if bias is not None else ())
-        return self.sd._op("layer_norm", f, *ins)
+        return self.sd._op("layer_norm", None, *ins,
+                           params={"eps": float(eps), "axis": int(axis)})
 
     def batchNorm(self, x, mean, var, gamma, beta, eps=1e-5):
-        def f(a, m, v, g, b):
-            return (a - m) * jax.lax.rsqrt(v + eps) * g + b
-        return self.sd._op("batch_norm", f, *(self.sd._lift(v) for v in
-                                              (x, mean, var, gamma, beta)))
+        return self.sd._op("batch_norm", None,
+                           *(self.sd._lift(v) for v in
+                             (x, mean, var, gamma, beta)),
+                           params={"eps": float(eps)})
 
 
 class _LossNamespace:
@@ -245,32 +242,22 @@ class _LossNamespace:
 
     def softmaxCrossEntropy(self, name, labels, logits):
         labels, logits = self.sd._lift(labels), self.sd._lift(logits)
-
-        def f(y, z):
-            return -jnp.mean(jnp.sum(y * jax.nn.log_softmax(z, -1), -1))
-
-        return self.sd._op_named(name, "softmax_xent", f, labels, logits)
+        return self.sd._op_named(name, "softmax_xent", None, labels, logits,
+                                 params={})
 
     def sigmoidCrossEntropy(self, name, labels, logits):
         labels, logits = self.sd._lift(labels), self.sd._lift(logits)
-
-        def f(y, z):
-            per = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
-            return jnp.mean(jnp.sum(per, -1))
-
-        return self.sd._op_named(name, "sigmoid_xent", f, labels, logits)
+        return self.sd._op_named(name, "sigmoid_xent", None, labels, logits,
+                                 params={})
 
     def meanSquaredError(self, name, labels, predictions):
         labels, predictions = self.sd._lift(labels), self.sd._lift(predictions)
-
-        def f(y, p):
-            return jnp.mean((y - p) ** 2)
-
-        return self.sd._op_named(name, "mse", f, labels, predictions)
+        return self.sd._op_named(name, "mse", None, labels, predictions,
+                                 params={})
 
     def l2Loss(self, name, x):
-        return self.sd._op_named(name, "l2", lambda a: 0.5 * jnp.sum(a * a),
-                                 self.sd._lift(x))
+        return self.sd._op_named(name, "l2", None, self.sd._lift(x),
+                                 params={})
 
 
 def _pair2(v):
@@ -289,57 +276,31 @@ class _CNNNamespace:
         """x (B,H,W,Cin), weights (kh,kw,Cin,Cout) HWIO."""
         x = self.sd._lift(x)
         weights = self.sd._lift(weights)
-        s, d = _pair2(stride), _pair2(dilation)
-
-        if bias is None:
-            def f(a, w):
-                return jax.lax.conv_general_dilated(
-                    a, w, s, padding, rhs_dilation=d,
-                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
-            return self.sd._op("conv2d", f, x, weights)
-
-        bias = self.sd._lift(bias)
-
-        def f(a, w, b):
-            y = jax.lax.conv_general_dilated(
-                a, w, s, padding, rhs_dilation=d,
-                dimension_numbers=("NHWC", "HWIO", "NHWC"))
-            return y + b
-        return self.sd._op("conv2d", f, x, weights, bias)
+        params = {"stride": list(_pair2(stride)),
+                  "padding": padding if isinstance(padding, str)
+                  else [list(p) for p in padding],
+                  "dilation": list(_pair2(dilation))}
+        ins = (x, weights) if bias is None else (x, weights,
+                                                self.sd._lift(bias))
+        return self.sd._op("conv2d", None, *ins, params=params)
 
     def maxPooling2d(self, x, kernel=(2, 2), stride=(2, 2), padding="VALID"):
-        x = self.sd._lift(x)
-        k, s = _pair2(kernel), _pair2(stride)
-
-        def f(a):
-            return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max,
-                                         (1,) + k + (1,), (1,) + s + (1,),
-                                         padding)
-        return self.sd._op("maxpool2d", f, x)
+        return self.sd._op("maxpool2d", None, self.sd._lift(x),
+                           params={"kernel": list(_pair2(kernel)),
+                                   "stride": list(_pair2(stride)),
+                                   "padding": padding})
 
     def avgPooling2d(self, x, kernel=(2, 2), stride=(2, 2), padding="VALID"):
-        x = self.sd._lift(x)
-        k, s = _pair2(kernel), _pair2(stride)
-
-        def f(a):
-            dims, strides = (1,) + k + (1,), (1,) + s + (1,)
-            summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims,
-                                           strides, padding)
-            # divide by the TRUE window population so SAME padding zeros
-            # don't dilute edge averages (TF/Keras/reference semantics)
-            counts = jax.lax.reduce_window(jnp.ones_like(a), 0.0,
-                                           jax.lax.add, dims, strides,
-                                           padding)
-            return summed / counts
-        return self.sd._op("avgpool2d", f, x)
+        # divides by the TRUE window population so SAME padding zeros
+        # don't dilute edge averages (TF/Keras/reference semantics)
+        return self.sd._op("avgpool2d", None, self.sd._lift(x),
+                           params={"kernel": list(_pair2(kernel)),
+                                   "stride": list(_pair2(stride)),
+                                   "padding": padding})
 
     def upsampling2d(self, x, scale=2):
-        x = self.sd._lift(x)
-        s = int(scale)
-
-        def f(a):
-            return jnp.repeat(jnp.repeat(a, s, axis=1), s, axis=2)
-        return self.sd._op("upsampling2d", f, x)
+        return self.sd._op("upsampling2d", None, self.sd._lift(x),
+                           params={"scale": int(scale)})
 
 
 class _LinalgNamespace:
@@ -352,24 +313,18 @@ class _LinalgNamespace:
         return self.sd._lift(a).mmul(self.sd._lift(b))
 
     def cholesky(self, x):
-        return self.sd._op("cholesky",
-                           lambda a: jnp.linalg.cholesky(a),
-                           self.sd._lift(x))
+        return self.sd._op("cholesky", None, self.sd._lift(x), params={})
 
     def qr(self, x):
-        return self.sd._op("qr", lambda a: jnp.linalg.qr(a)[0],
-                           self.sd._lift(x))
+        return self.sd._op("qr", None, self.sd._lift(x), params={})
 
     def svd(self, x):
         """Singular values (the reference's Svd op surface)."""
-        return self.sd._op("svd",
-                           lambda a: jnp.linalg.svd(a, compute_uv=False),
-                           self.sd._lift(x))
+        return self.sd._op("svd", None, self.sd._lift(x), params={})
 
     def solve(self, a, b):
-        return self.sd._op("solve",
-                           lambda x, y: jnp.linalg.solve(x, y),
-                           self.sd._lift(a), self.sd._lift(b))
+        return self.sd._op("solve", None, self.sd._lift(a),
+                           self.sd._lift(b), params={})
 
 
 class _RandomNamespace:
@@ -384,29 +339,21 @@ class _RandomNamespace:
     def __init__(self, sd):
         self.sd = sd
 
-    def _draw(self, opname, shape, sampler):
+    def _draw(self, opname, shape, extra):
         seed = int(self.sd._rng.integers(0, 2 ** 31 - 1))
-
-        def f():
-            return sampler(jax.random.PRNGKey(seed), tuple(shape))
-        return self.sd._op(opname, f)
+        params = {"seed": seed, "shape": [int(s) for s in shape], **extra}
+        return self.sd._op(opname, None, params=params)
 
     def normal(self, mean, stddev, *shape):
-        m, s = float(mean), float(stddev)
         return self._draw("random_normal", shape,
-                          lambda k, sh: m + s * jax.random.normal(k, sh))
+                          {"mean": float(mean), "stddev": float(stddev)})
 
     def uniform(self, lo, hi, *shape):
-        lo, hi = float(lo), float(hi)
         return self._draw("random_uniform", shape,
-                          lambda k, sh: jax.random.uniform(
-                              k, sh, minval=lo, maxval=hi))
+                          {"lo": float(lo), "hi": float(hi)})
 
     def bernoulli(self, p, *shape):
-        p = float(p)
-        return self._draw("random_bernoulli", shape,
-                          lambda k, sh: jax.random.bernoulli(
-                              k, p, sh).astype(jnp.float32))
+        return self._draw("random_bernoulli", shape, {"p": float(p)})
 
 
 class TrainingConfig:
@@ -546,12 +493,25 @@ class SameDiff:
         return self.constant(self._fresh("lit"), x)
 
     # -- op recording ----------------------------------------------------
-    def _op(self, opname, fn, *inputs):
-        return self._op_named(self._fresh(opname), opname, fn, *inputs)
+    def _op(self, opname, fn, *inputs, params=None):
+        return self._op_named(self._fresh(opname), opname, fn, *inputs,
+                              params=params)
 
-    def _op_named(self, name, opname, fn, *inputs):
+    def _op_named(self, name, opname, fn, *inputs, params=None):
+        """Record one op node. fn=None (the serializable form) builds the
+        fn from graph_serde.OP_BUILDERS[opname](**params) — opname+params
+        then fully describe the node, and save() can persist it. An
+        explicit fn (control flow, ad-hoc callables) executes fine but
+        marks the node non-serializable."""
+        serializable = fn is None
+        if fn is None:
+            from deeplearning4j_tpu.autodiff.graph_serde import build_fn
+            fn = build_fn(opname, params)
         v = SDVariable(self, name, VariableType.ARRAY, None, fn,
                        [i.name for i in inputs])
+        v.opname = opname
+        v.params = params
+        v.serializable = serializable
         self._nodes[name] = v
         self._invalidate()
         return v
@@ -907,19 +867,53 @@ class SameDiff:
     def grad(self, name):
         raise RuntimeError("Use calculateGradients(placeholders, names...)")
 
-    # -- persistence -----------------------------------------------------
-    def save(self, path, save_updater=False):
-        import pickle
-        blob = {"values": {k: np.asarray(v) for k, v in self._values.items()},
-                "loss_names": self._loss_names}
-        with open(path, "wb") as f:
-            pickle.dump(blob, f)
+    # -- persistence (≡ SameDiff.save/load: the WHOLE graph — ops, shapes,
+    # values — restores with no defining source; see graph_serde) --------
+    def save(self, path, save_updater=False, values_only=False):
+        """Write the self-contained zip artifact (samediff.json +
+        values.npz). save_updater is accepted for reference-API parity;
+        optimizer state is re-initialized after load (set the training
+        config's updater and fit resumes from the saved values).
+
+        values_only=True writes just the values.npz leg — the persistence
+        path for graphs containing non-serializable nodes (control flow,
+        ad-hoc callables): re-build the graph in code and load_values()."""
+        from deeplearning4j_tpu.autodiff.graph_serde import save_samediff
+        save_samediff(self, path, values_only=values_only)
+
+    @staticmethod
+    def load(path):
+        """Rebuild the full graph from a save() artifact in a fresh
+        process — no defining Python needed (op fns come from the
+        graph_serde builder registry)."""
+        from deeplearning4j_tpu.autodiff.graph_serde import load_samediff
+        return load_samediff(path)
 
     def load_values(self, path):
-        import pickle
-        with open(path, "rb") as f:
-            blob = pickle.load(f)
-        for k, v in blob["values"].items():
+        """Load ONLY the values from a save() artifact into THIS graph
+        (the old partial-restore surface, kept for API compatibility;
+        also reads values_only=True artifacts and legacy pre-r5 pickle
+        checkpoints written by this module's old save())."""
+        import io
+        import zipfile
+
+        if zipfile.is_zipfile(path):
+            with zipfile.ZipFile(path) as zf:
+                vals = np.load(io.BytesIO(zf.read("values.npz")))
+                values = {k: vals[k] for k in vals.files}
+        else:
+            with open(path, "rb") as f:
+                magic = f.read(2)
+            if not magic.startswith(b"\x80"):
+                raise ValueError(
+                    f"{path!r} is neither a samediff zip artifact nor a "
+                    "legacy pickle checkpoint")
+            # one-time migration path for checkpoints written by the
+            # pre-round-5 pickle save(); new artifacts are pickle-free
+            import pickle
+            with open(path, "rb") as f:
+                values = pickle.load(f)["values"]
+        for k, v in values.items():
             if k in self._values:
                 self._values[k] = jnp.asarray(v)
         self._invalidate()

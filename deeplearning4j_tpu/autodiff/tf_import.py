@@ -54,6 +54,159 @@ _ELEMENTWISE = {
     "Floor": jnp.floor, "Sign": jnp.sign,
 }
 
+# -- serializable op builders ("tf." namespace in the graph_serde
+# registry): imported nodes carry (opname, JSON params) so a frozen-graph
+# import saved via SameDiff.save restores with no .pb and no user code --
+from deeplearning4j_tpu.autodiff.graph_serde import op_builder  # noqa: E402
+
+for _opn, _fn in _ELEMENTWISE.items():
+    op_builder("tf." + _opn.lower())((lambda f: lambda: f)(_fn))
+op_builder("tf.softmax")(lambda: lambda x: jax.nn.softmax(x, axis=-1))
+op_builder("tf.shape")(lambda: lambda x: jnp.asarray(x.shape, jnp.int32))
+op_builder("tf.rsqrt")(lambda: jax.lax.rsqrt)
+
+
+@op_builder("tf.matmul")
+def _b_matmul(ta=False, tb=False):
+    def mm(a, b):
+        a = a.T if ta else a
+        b = b.T if tb else b
+        return a @ b
+    return mm
+
+
+@op_builder("tf.batch_matmul")
+def _b_batch_matmul(ta=False, tb=False):
+    def bmm(a, b):
+        a = jnp.swapaxes(a, -1, -2) if ta else a
+        b = jnp.swapaxes(b, -1, -2) if tb else b
+        return a @ b
+    return bmm
+
+
+def _tf_reduce_builder(fn):
+    def build(axis=None, keep=False):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return lambda x, _a: fn(x, axis=ax, keepdims=keep)
+    return build
+
+
+for _opn, _fn in [("mean", jnp.mean), ("sum", jnp.sum), ("max", jnp.max),
+                  ("min", jnp.min)]:
+    op_builder("tf." + _opn)(_tf_reduce_builder(_fn))
+
+
+@op_builder("tf.reshape")
+def _b_reshape(shape):
+    return lambda x, _s: jnp.reshape(x, tuple(shape))
+
+
+@op_builder("tf.transpose")
+def _b_transpose(perm):
+    return lambda x, _p: jnp.transpose(x, tuple(perm))
+
+
+@op_builder("tf.expand_dims")
+def _b_expand_dims(axis=0):
+    return lambda x, _a: jnp.expand_dims(x, axis)
+
+
+@op_builder("tf.squeeze")
+def _b_squeeze(dims=None):
+    return lambda x: jnp.squeeze(x, None if not dims else tuple(dims))
+
+
+@op_builder("tf.concat")
+def _b_concat(axis=0):
+    return lambda *xs: jnp.concatenate(xs, axis)
+
+
+@op_builder("tf.gather")
+def _b_gather(axis=0):
+    return lambda p, i, *rest: jnp.take(p, i.astype(jnp.int32), axis=axis)
+
+
+@op_builder("tf.cast")
+def _b_cast(dtype="float32"):
+    np_dt = np.dtype(dtype)
+    return lambda x: x.astype(np_dt)
+
+
+@op_builder("tf.stack")
+def _b_stack(axis=0):
+    return lambda *xs: jnp.stack(xs, axis=axis)
+
+
+@op_builder("tf.tile")
+def _b_tile(reps):
+    return lambda x, _r: jnp.tile(x, tuple(reps))
+
+
+@op_builder("tf.strided_slice")
+def _b_strided_slice(sl):
+    # JSON form: int = rank-reducing index; [lo, hi, step] = slice
+    # (None encoded as JSON null)
+    slt = tuple(s if isinstance(s, int) else slice(*s) for s in sl)
+    return lambda x, *_r: x[slt]
+
+
+@op_builder("tf.one_hot")
+def _b_one_hot(depth):
+    return lambda i, *_r: jax.nn.one_hot(i.astype(jnp.int32), depth)
+
+
+@op_builder("tf.conv2d")
+def _b_conv2d(strides, dil, padding, depthwise=False):
+    st, dl = tuple(strides), tuple(dil)
+    pd = padding if isinstance(padding, str) else [tuple(p)
+                                                  for p in padding]
+
+    def conv(x, w):
+        # TF weights are HWIO; depthwise weights (H, W, C, M) run as a
+        # grouped conv with feature_group_count = C
+        groups = 1
+        if depthwise:
+            h_, w_, cin, mult = w.shape
+            w = w.reshape(h_, w_, 1, cin * mult)
+            groups = cin
+        return jax.lax.conv_general_dilated(
+            x, w.astype(x.dtype), window_strides=st, padding=pd,
+            rhs_dilation=dl, feature_group_count=groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return conv
+
+
+@op_builder("tf.maxpool")
+def _b_maxpool(ksize, strides, padding):
+    k, s = tuple(ksize), tuple(strides)
+    return lambda x: jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, k, s,
+                                           padding)
+
+
+@op_builder("tf.avgpool")
+def _b_avgpool(ksize, strides, padding):
+    k, s = tuple(ksize), tuple(strides)
+
+    def avg(x):
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, k, s, padding)
+        n = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add, k, s,
+                                  padding)
+        return summed / n
+    return avg
+
+
+@op_builder("tf.fused_batch_norm")
+def _b_fused_batch_norm(eps=1e-4):
+    def fbn(x, gamma, beta, mean, var):
+        return (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+    return fbn
+
+
+@op_builder("tf.pad")
+def _b_pad(width, cval=0.0):
+    w = [tuple(row) for row in width]
+    return lambda x, *_r: jnp.pad(x, w, constant_values=cval)
+
 
 class TFGraphMapper:
     @staticmethod
@@ -98,68 +251,47 @@ class TFGraphMapper:
         ins = [sd.getVariable(r) for r in in_refs]
 
         if op in _ELEMENTWISE:
-            fn = _ELEMENTWISE[op]
-            sd._op_named(name, op.lower(), fn, *ins)
+            sd._op_named(name, "tf." + op.lower(), None, *ins, params={})
         elif op == "MatMul":
-            ta = bool(node.attrs.get("transpose_a", False))
-            tb = bool(node.attrs.get("transpose_b", False))
-
-            def mm(a, b, ta=ta, tb=tb):
-                a = a.T if ta else a
-                b = b.T if tb else b
-                return a @ b
-            sd._op_named(name, "matmul", mm, *ins)
+            sd._op_named(name, "tf.matmul", None, *ins, params={
+                "ta": bool(node.attrs.get("transpose_a", False)),
+                "tb": bool(node.attrs.get("transpose_b", False))})
         elif op in ("BatchMatMul", "BatchMatMulV2"):
-            ta = bool(node.attrs.get("adj_x", False))
-            tb = bool(node.attrs.get("adj_y", False))
-
-            def bmm(a, b, ta=ta, tb=tb):
-                a = jnp.swapaxes(a, -1, -2) if ta else a
-                b = jnp.swapaxes(b, -1, -2) if tb else b
-                return a @ b
-            sd._op_named(name, "batch_matmul", bmm, *ins)
+            sd._op_named(name, "tf.batch_matmul", None, *ins, params={
+                "ta": bool(node.attrs.get("adj_x", False)),
+                "tb": bool(node.attrs.get("adj_y", False))})
         elif op == "Softmax":
-            sd._op_named(name, "softmax",
-                         lambda x: jax.nn.softmax(x, axis=-1), *ins)
+            sd._op_named(name, "tf.softmax", None, *ins, params={})
         elif op in ("Mean", "Sum", "Max", "Min"):
-            red = {"Mean": jnp.mean, "Sum": jnp.sum, "Max": jnp.max,
-                   "Min": jnp.min}[op]
             if const_val(1) is None:
                 raise UnsupportedTFOpError(
                     f"{name}: dynamic {op} axes unsupported (axis input "
                     "must trace to a Const)")
             axis = _axis_from([const_val(1)], 0)
-            keep = bool(node.attrs.get("keep_dims", False))
-            sd._op_named(name, op.lower(),
-                         lambda x, _a, red=red, axis=axis, keep=keep:
-                         red(x, axis=axis, keepdims=keep), *ins)
+            sd._op_named(name, "tf." + op.lower(), None, *ins, params={
+                "axis": list(axis) if isinstance(axis, tuple) else axis,
+                "keep": bool(node.attrs.get("keep_dims", False))})
         elif op == "Reshape":
             shp = const_val(1)
             if shp is None:
                 raise UnsupportedTFOpError(
                     f"{name}: dynamic Reshape target shape unsupported")
-            shp = tuple(int(s) for s in np.asarray(shp).reshape(-1))
-            sd._op_named(name, "reshape",
-                         lambda x, _s, shp=shp: jnp.reshape(x, shp), *ins)
+            sd._op_named(name, "tf.reshape", None, *ins, params={
+                "shape": [int(s) for s in np.asarray(shp).reshape(-1)]})
         elif op == "Transpose":
             if const_val(1) is None:
                 raise UnsupportedTFOpError(
                     f"{name}: dynamic Transpose perm unsupported")
-            perm = tuple(int(p)
-                         for p in np.asarray(const_val(1)).reshape(-1))
-            sd._op_named(name, "transpose",
-                         lambda x, _p, perm=perm: jnp.transpose(x, perm),
-                         *ins)
+            sd._op_named(name, "tf.transpose", None, *ins, params={
+                "perm": [int(p)
+                         for p in np.asarray(const_val(1)).reshape(-1)]})
         elif op == "ExpandDims":
-            axis = _axis_from([const_val(1)], 0, 0)
-            sd._op_named(name, "expand_dims",
-                         lambda x, _a, axis=axis: jnp.expand_dims(x, axis),
-                         *ins)
+            sd._op_named(name, "tf.expand_dims", None, *ins, params={
+                "axis": _axis_from([const_val(1)], 0, 0)})
         elif op == "Squeeze":
             dims = node.attrs.get("squeeze_dims") or None
-            sd._op_named(name, "squeeze",
-                         lambda x, dims=dims: jnp.squeeze(
-                             x, None if not dims else tuple(dims)), *ins)
+            sd._op_named(name, "tf.squeeze", None, *ins, params={
+                "dims": None if not dims else [int(d) for d in dims]})
         elif op in ("ConcatV2", "Concat"):
             # ConcatV2: axis is the LAST input; v1 Concat: the FIRST
             axis_idx = len(in_refs) - 1 if op == "ConcatV2" else 0
@@ -167,39 +299,32 @@ class TFGraphMapper:
             if av is None:
                 raise UnsupportedTFOpError(
                     f"{name}: dynamic Concat axis unsupported")
-            axis = int(np.asarray(av).reshape(()))
             data_ins = (ins[:-1] if op == "ConcatV2" else ins[1:])
-            sd._op_named(name, "concat",
-                         lambda *xs, axis=axis: jnp.concatenate(xs, axis),
-                         *data_ins)
+            sd._op_named(name, "tf.concat", None, *data_ins, params={
+                "axis": int(np.asarray(av).reshape(()))})
         elif op in ("GatherV2", "Gather"):
             axis = 0
             if op == "GatherV2" and len(ins) > 2:
                 axis = _axis_from([const_val(2)], 0, 0)
-            sd._op_named(name, "gather",
-                         lambda p, i, *rest, axis=axis: jnp.take(
-                             p, i.astype(jnp.int32), axis=axis), *ins)
+            sd._op_named(name, "tf.gather", None, *ins,
+                         params={"axis": axis})
         elif op == "Cast":
             dst = node.attrs.get("DstT")
             np_dt = tfproto._DTYPES.get(
                 dst[1] if isinstance(dst, tuple) else dst, np.float32)
-            sd._op_named(name, "cast",
-                         lambda x, np_dt=np_dt: x.astype(np_dt), *ins)
+            sd._op_named(name, "tf.cast", None, *ins,
+                         params={"dtype": np.dtype(np_dt).name})
         elif op == "Pack":
-            axis = int(node.attrs.get("axis", 0) or 0)
-            sd._op_named(name, "stack",
-                         lambda *xs, axis=axis: jnp.stack(xs, axis=axis),
-                         *ins)
+            sd._op_named(name, "tf.stack", None, *ins, params={
+                "axis": int(node.attrs.get("axis", 0) or 0)})
         elif op == "Shape":
-            sd._op_named(name, "shape",
-                         lambda x: jnp.asarray(x.shape, jnp.int32), *ins)
+            sd._op_named(name, "tf.shape", None, *ins, params={})
         elif op == "Rsqrt":
-            sd._op_named(name, "rsqrt", jax.lax.rsqrt, *ins)
+            sd._op_named(name, "tf.rsqrt", None, *ins, params={})
         elif op == "Tile":
             reps = const_val(1)
-            reps = tuple(int(r) for r in np.asarray(reps).reshape(-1))
-            sd._op_named(name, "tile",
-                         lambda x, _r, reps=reps: jnp.tile(x, reps), *ins)
+            sd._op_named(name, "tf.tile", None, *ins, params={
+                "reps": [int(r) for r in np.asarray(reps).reshape(-1)]})
         elif op == "StridedSlice":
             b = const_val(1)
             e = const_val(2)
@@ -224,15 +349,12 @@ class TFGraphMapper:
                     continue
                 lo = None if begin_mask & (1 << d) else int(bi)
                 hi = None if end_mask & (1 << d) else int(ei)
-                sl.append(slice(lo, hi, int(si)))
-            sl = tuple(sl)
-            sd._op_named(name, "strided_slice",
-                         lambda x, *_r, sl=sl: x[sl], *ins)
+                sl.append([lo, hi, int(si)])    # JSON slice triple
+            sd._op_named(name, "tf.strided_slice", None, *ins,
+                         params={"sl": sl})
         elif op == "OneHot":
-            depth = int(np.asarray(const_val(1)).reshape(()))
-            sd._op_named(name, "one_hot",
-                         lambda i, *_r, depth=depth: jax.nn.one_hot(
-                             i.astype(jnp.int32), depth), *ins)
+            sd._op_named(name, "tf.one_hot", None, *ins, params={
+                "depth": int(np.asarray(const_val(1)).reshape(()))})
         elif op in ("Conv2D", "DepthwiseConv2dNative"):
             fmt = node.attrs.get("data_format", "NHWC")
             if fmt != "NHWC":
@@ -254,23 +376,12 @@ class TFGraphMapper:
                 # NHWC order: take the H and W begin/end pairs
                 padding = [(int(ep[2]), int(ep[3])),
                            (int(ep[4]), int(ep[5]))]
-            depthwise = op == "DepthwiseConv2dNative"
-
-            def conv(x, w, strides=strides, dil=dil, padding=padding,
-                     depthwise=depthwise):
-                # TF weights are HWIO; depthwise weights (H, W, C, M) run
-                # as a grouped conv with feature_group_count = C
-                groups = 1
-                if depthwise:
-                    h_, w_, cin, mult = w.shape
-                    w = w.reshape(h_, w_, 1, cin * mult)
-                    groups = cin
-                return jax.lax.conv_general_dilated(
-                    x, w.astype(x.dtype), window_strides=strides,
-                    padding=padding, rhs_dilation=dil,
-                    feature_group_count=groups,
-                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
-            sd._op_named(name, "conv2d", conv, *ins)
+            sd._op_named(name, "tf.conv2d", None, *ins, params={
+                "strides": [int(s) for s in strides],
+                "dil": [int(d) for d in dil],
+                "padding": padding if isinstance(padding, str)
+                else [list(p) for p in padding],
+                "depthwise": op == "DepthwiseConv2dNative"})
         elif op in ("MaxPool", "AvgPool"):
             fmt = node.attrs.get("data_format", "NHWC")
             if fmt != "NHWC":
@@ -282,21 +393,11 @@ class TFGraphMapper:
             if padding not in ("SAME", "VALID"):
                 raise UnsupportedTFOpError(
                     f"{name}: pool padding {padding!r} unsupported")
-            if op == "MaxPool":
-                sd._op_named(name, "maxpool",
-                             lambda x, ksize=ksize, strides=strides,
-                             padding=padding: jax.lax.reduce_window(
-                                 x, -jnp.inf, jax.lax.max, ksize, strides,
-                                 padding), *ins)
-            else:
-                def avg(x, ksize=ksize, strides=strides, padding=padding):
-                    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, ksize,
-                                              strides, padding)
-                    n = jax.lax.reduce_window(jnp.ones_like(x), 0.0,
-                                              jax.lax.add, ksize, strides,
-                                              padding)
-                    return s / n
-                sd._op_named(name, "avgpool", avg, *ins)
+            params = {"ksize": [int(k) for k in ksize],
+                      "strides": [int(s) for s in strides],
+                      "padding": padding}
+            sd._op_named(name, "tf.maxpool" if op == "MaxPool"
+                         else "tf.avgpool", None, *ins, params=params)
         elif op in ("FusedBatchNorm", "FusedBatchNormV2",
                     "FusedBatchNormV3"):
             # frozen-graph inference form: inputs x, gamma, beta, mean, var
@@ -312,16 +413,14 @@ class TFGraphMapper:
                 raise UnsupportedTFOpError(
                     f"{name}: data_format {fmt!r} unsupported (NHWC only)")
 
-            def fbn(x, gamma, beta, mean, var, eps=eps):
-                return ((x - mean) * jax.lax.rsqrt(var + eps)
-                        * gamma + beta)
-            sd._op_named(name, "fused_batch_norm", fbn, *ins)
+            sd._op_named(name, "tf.fused_batch_norm", None, *ins,
+                         params={"eps": eps})
         elif op in ("Pad", "PadV2"):
             pv = const_val(1)
             if pv is None:
                 raise UnsupportedTFOpError(
                     f"{name}: dynamic Pad unsupported")
-            width = [tuple(int(v) for v in row)
+            width = [[int(v) for v in row]
                      for row in np.asarray(pv).reshape(-1, 2)]
             cval = 0.0
             if op == "PadV2" and len(in_refs) > 2:
@@ -330,9 +429,8 @@ class TFGraphMapper:
                     raise UnsupportedTFOpError(
                         f"{name}: non-constant PadV2 value unsupported")
                 cval = float(np.asarray(cv).reshape(()))
-            sd._op_named(name, "pad",
-                         lambda x, *_r, width=width, cval=cval: jnp.pad(
-                             x, width, constant_values=cval), *ins)
+            sd._op_named(name, "tf.pad", None, *ins,
+                         params={"width": width, "cval": cval})
         else:
             raise UnsupportedTFOpError(
                 f"TF op '{op}' (node '{name}') is not in the import op set")

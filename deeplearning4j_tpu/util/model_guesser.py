@@ -26,6 +26,14 @@ class ModelGuesser:
         network; raises ModelGuesserException when nothing matches."""
         errors = []
         if zipfile.is_zipfile(path):
+            with zipfile.ZipFile(path) as zf:
+                names = set(zf.namelist())
+            if "samediff.json" in names:   # SameDiff full-graph artifact
+                from deeplearning4j_tpu.autodiff.samediff import SameDiff
+                try:
+                    return SameDiff.load(path)
+                except Exception as e:
+                    errors.append(f"samediff: {e}")
             for restore in (ModelSerializer.restoreMultiLayerNetwork,
                             ModelSerializer.restoreComputationGraph):
                 try:

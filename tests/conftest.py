@@ -94,7 +94,9 @@ _SMOKE_MODULES = {"test_ops.py", "test_multilayer.py", "test_eval.py",
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
-        if item.fspath.basename in _SMOKE_MODULES:
+        # minutes-long scale checks never belong in the smoke signal
+        if item.fspath.basename in _SMOKE_MODULES \
+                and "memory_bounded" not in item.name:
             item.add_marker(pytest.mark.smoke)
     if os.environ.get("DL4J_TPU_TEST_TIER", "full").lower() != "smoke":
         return

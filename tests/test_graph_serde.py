@@ -1,0 +1,273 @@
+"""SameDiff FULL-GRAPH save/load (VERDICT r4 #3; ≡ nd4j SameDiff.save/load
+FlatBuffers round-trip: ops + shapes + values, restored with no defining
+source). The load legs run in a SUBPROCESS — a genuinely fresh process
+with no access to the Python that built the graph."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.autodiff.graph_serde import registerSerializableOp
+from deeplearning4j_tpu.nn.updaters import Adam
+
+_LOADER = """
+import sys
+import numpy as np
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+artifact, x_npy, out_name, y_npy = sys.argv[1:5]
+sd = SameDiff.load(artifact)
+x = np.load(x_npy)
+y = sd.outputSingle({"x": x}, out_name)
+np.save(y_npy, np.asarray(y.jax() if hasattr(y, "jax") else y))
+"""
+
+
+def _subprocess_output(artifact, x, out_name, tmp_path):
+    x_npy = os.path.join(tmp_path, "x.npy")
+    y_npy = os.path.join(tmp_path, "y.npy")
+    np.save(x_npy, x)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, "-c", _LOADER, str(artifact), x_npy, out_name,
+         y_npy], capture_output=True, text=True, timeout=600,
+        cwd=repo_root)
+    assert p.returncode == 0, p.stderr[-1500:]
+    return np.load(y_npy)
+
+
+def test_native_graph_roundtrip_in_fresh_process(tmp_path):
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", None, 6)
+    w1 = sd.var("w1", np.random.RandomState(0).randn(6, 8).astype(np.float32))
+    b1 = sd.var("b1", np.zeros(8, np.float32))
+    g = sd.var("g", np.ones(8, np.float32))
+    h = sd.nn.relu(sd.nn.linear(x, w1, b1))
+    hn = sd.nn.layerNorm(h, g, eps=1e-5)
+    w2 = sd.var("w2", np.random.RandomState(1).randn(8, 3).astype(np.float32))
+    logits = hn.mmul(w2).rename("logits")
+    probs = sd.nn.softmax(logits).rename("probs")
+    labels = sd.placeHolder("labels", None, 3)
+    sd.loss.softmaxCrossEntropy("loss", labels, logits)
+    sd.setLossVariables("loss")
+    sd.setTrainingConfig(TrainingConfig(updater=Adam(1e-2),
+                                        dataSetFeatureMapping=["x"],
+                                        dataSetLabelMapping=["labels"]))
+
+    rng = np.random.RandomState(2)
+    xs = rng.randn(16, 6).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+    for _ in range(3):
+        sd.fit(xs, ys)
+
+    want = np.asarray(sd.outputSingle({"x": xs}, "probs").jax())
+    art = tmp_path / "model.sdz"
+    sd.save(art)
+    got = _subprocess_output(art, xs, "probs", tmp_path)
+    np.testing.assert_array_equal(got, want)   # bit-exact
+
+    # and training RESUMES from the artifact (config + updater persisted)
+    sd2 = SameDiff.load(art)
+    l0 = sd2.fit(xs, ys)
+    assert np.isfinite(l0)
+
+
+def test_onnx_unet_tail_roundtrip_in_fresh_process(tmp_path):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_onnx_import import onnx_model, onnx_node, onnx_tensor  # noqa
+
+    rng = np.random.RandomState(3)
+    w1 = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.2     # Conv OIHW
+    wct = rng.randn(4, 2, 2, 2).astype(np.float32) * 0.2    # ConvTranspose
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32)
+    mean = rng.randn(4).astype(np.float32)
+    var = rng.rand(4).astype(np.float32) + 0.1
+    model = onnx_model(
+        [onnx_node("Conv", ["x", "w1"], ["c1"], kernel_shape=[3, 3],
+                   pads=[1, 1, 1, 1]),
+         onnx_node("BatchNormalization", ["c1", "g", "b", "m", "v"],
+                   ["bn"], epsilon=1e-5),
+         onnx_node("LeakyRelu", ["bn"], ["act"], alpha=0.1),
+         onnx_node("MaxPool", ["act"], ["p"], kernel_shape=[2, 2],
+                   strides=[2, 2]),
+         onnx_node("ConvTranspose", ["p", "wct"], ["up"], strides=[2, 2]),
+         onnx_node("Concat", ["up", "bn"], ["cat"], axis=1),
+         onnx_node("GlobalAveragePool", ["cat"], ["y"])],
+        {"w1": w1, "wct": wct, "g": gamma, "b": beta, "m": mean, "v": var},
+        {"x": [1, 3, 8, 8]}, ["y"])
+
+    from deeplearning4j_tpu.autodiff.onnx_import import importOnnx
+    sd = importOnnx(model)
+    x = rng.randn(1, 3, 8, 8).astype(np.float32)
+    want = np.asarray(sd.outputSingle({"x": x}, "y").jax())
+    art = tmp_path / "unet.sdz"
+    sd.save(art)
+    got = _subprocess_output(art, x, "y", tmp_path)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tf_frozen_cnn_roundtrip(tmp_path):
+    from deeplearning4j_tpu.autodiff import tfproto
+    from deeplearning4j_tpu.autodiff.tf_import import importFrozenTF
+
+    rng = np.random.RandomState(4)
+    w = rng.randn(3, 3, 1, 4).astype(np.float32) * 0.3
+    z = rng.rand(4).astype(np.float32)
+    gdef = tfproto.encode_graphdef([
+        ("x", "Placeholder", [], {}),
+        ("w", "Const", [], {"value": w}),
+        ("g", "Const", [], {"value": z + 0.5}),
+        ("b", "Const", [], {"value": z - 0.5}),
+        ("m", "Const", [], {"value": z}),
+        ("v", "Const", [], {"value": z + 0.1}),
+        ("conv", "Conv2D", ["x", "w"], {"strides": [1, 1, 1, 1],
+                                        "padding": "SAME"}),
+        ("bn", "FusedBatchNormV3", ["conv", "g", "b", "m", "v"], {}),
+        ("act", "Relu", ["bn"], {}),
+        ("pool", "MaxPool", ["act"], {"ksize": [1, 2, 2, 1],
+                                      "strides": [1, 2, 2, 1],
+                                      "padding": "VALID"}),
+    ])
+    sd = importFrozenTF(gdef)
+    x = rng.randn(2, 6, 6, 1).astype(np.float32)
+    want = np.asarray(sd.outputSingle({"x": x}, "pool").jax())
+    art = tmp_path / "tfcnn.sdz"
+    sd.save(art)
+    got = _subprocess_output(art, x, "pool", tmp_path)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_control_flow_save_raises_actionable(tmp_path):
+    import jax.numpy as jnp
+
+    sd = SameDiff.create()
+    a = sd.var("a", np.ones(3, np.float32))
+    sd.ifCond("branch", sd.constant("p", np.float32(1.0)), [a],
+              lambda t: t * 2, lambda t: t)
+    with pytest.raises(ValueError, match="registerSerializableOp") as ei:
+        sd.save(tmp_path / "cf.sdz")
+    assert "branch" in str(ei.value)   # names the offending node
+
+
+def test_values_only_checkpoint_for_control_flow_graph(tmp_path):
+    def build():
+        sd = SameDiff.create()
+        a = sd.var("a", np.ones(3, np.float32))
+        outs = sd.forLoop("loop", 3, [a], lambda i, t: (t * 2,))
+        outs[0].rename("doubled")
+        return sd
+
+    sd = build()
+    sd.getVariable("a").setArray(np.array([1.0, 2.0, 3.0], np.float32))
+    art = tmp_path / "cf_vals.sdz"
+    sd.save(art, values_only=True)   # the escape hatch save() points at
+    sd2 = build()                    # graph re-built in code
+    sd2.load_values(art)
+    np.testing.assert_array_equal(
+        np.asarray(sd2.outputSingle({}, "doubled").jax()),
+        np.array([8.0, 16.0, 24.0], np.float32))
+
+
+def test_legacy_pickle_checkpoint_still_loads(tmp_path):
+    import pickle
+
+    sd = SameDiff.create()
+    sd.var("w", np.zeros(4, np.float32))
+    legacy = tmp_path / "old.bin"
+    with open(legacy, "wb") as f:   # the pre-r5 save() blob layout
+        pickle.dump({"values": {"w": np.arange(4, dtype=np.float32)},
+                     "loss_names": []}, f)
+    sd.load_values(legacy)
+    np.testing.assert_array_equal(np.asarray(sd._values["w"]),
+                                  np.arange(4, dtype=np.float32))
+    with pytest.raises(ValueError, match="neither"):
+        bad = tmp_path / "junk.bin"
+        bad.write_bytes(b"not a checkpoint")
+        sd.load_values(bad)
+
+
+def test_clip_open_bound_stays_strict_json(tmp_path):
+    import json
+    import zipfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_onnx_import import onnx_model, onnx_node  # noqa
+
+    model = onnx_model(
+        [onnx_node("Clip", ["x"], ["y"], min=0.5)],   # open upper bound
+        {}, {"x": [2, 3]}, ["y"])
+    from deeplearning4j_tpu.autodiff.onnx_import import importOnnx
+    sd = importOnnx(model)
+    art = tmp_path / "clip.sdz"
+    sd.save(art)
+    with zipfile.ZipFile(art) as zf:
+        raw = zf.read("samediff.json").decode()
+    json.loads(raw, parse_constant=lambda c: (_ for _ in ()).throw(
+        ValueError(f"non-strict JSON constant {c}")))   # jq-grade strict
+    x = np.array([[0.0, 1.0, 9.0]] * 2, np.float32)
+    got = np.asarray(SameDiff.load(art).outputSingle({"x": x}, "y").jax())
+    np.testing.assert_array_equal(got, np.clip(x, 0.5, np.inf))
+
+
+def test_custom_op_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    registerSerializableOp(
+        "test.scale_shift",
+        lambda scale=1.0, shift=0.0: lambda x: x * scale + shift)
+    sd = SameDiff.create()
+    v = sd.var("v", np.arange(4, dtype=np.float32))
+    sd._op_named("out", "test.scale_shift", None, v,
+                 params={"scale": 3.0, "shift": -1.0})
+    want = np.asarray(sd.outputSingle({}, "out").jax())
+    art = tmp_path / "custom.sdz"
+    sd.save(art)
+    # same-process load (the builder registration is module-lifetime —
+    # a fresh process must re-register, per the documented contract)
+    sd2 = SameDiff.load(art)
+    got = np.asarray(sd2.outputSingle({}, "out").jax())
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_allclose(want, np.arange(4) * 3.0 - 1.0)
+
+
+def test_math_clip_open_bound_saves(tmp_path):
+    sd = SameDiff.create()
+    v = sd.var("v", np.array([-5.0, 0.0, 5.0], np.float32))
+    sd.math.clip(v, -np.inf, 1.0).rename("c")
+    art = tmp_path / "mclip.sdz"
+    sd.save(art)   # must not trip the strict-JSON (allow_nan=False) writer
+    got = np.asarray(SameDiff.load(art).outputSingle({}, "c").jax())
+    np.testing.assert_array_equal(got, np.array([-5.0, 0.0, 1.0],
+                                                np.float32))
+
+
+def test_random_ops_reproduce_after_roundtrip(tmp_path):
+    sd = SameDiff.create()
+    sd.random.normal(0.0, 1.0, 4, 5).rename("draw")
+    want = np.asarray(sd.outputSingle({}, "draw").jax())
+    art = tmp_path / "rand.sdz"
+    sd.save(art)
+    sd2 = SameDiff.load(art)
+    got = np.asarray(sd2.outputSingle({}, "draw").jax())
+    np.testing.assert_array_equal(got, want)   # seed is part of the node
+
+
+def test_model_guesser_loads_samediff_artifact(tmp_path):
+    from deeplearning4j_tpu.util import ModelGuesser
+
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", None, 4)
+    w = sd.var("w", np.random.RandomState(5).randn(4, 2).astype(np.float32))
+    x.mmul(w).rename("y")
+    art = str(tmp_path / "guessme.sdz")
+    sd.save(art)
+    loaded = ModelGuesser.loadModelGuess(art)
+    assert isinstance(loaded, SameDiff)
+    xs = np.ones((3, 4), np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(loaded.outputSingle({"x": xs}, "y").jax()),
+        np.asarray(sd.outputSingle({"x": xs}, "y").jax()))
