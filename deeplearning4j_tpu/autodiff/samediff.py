@@ -183,11 +183,11 @@ class _MathNamespace:
         return self._u("sigmoid", x)
 
     def clip(self, x, lo, hi):
-        # open bounds travel as null: the artifact is strict JSON
-        # (allow_nan=False), so ±inf must not reach params
+        # open bounds (None or ±inf) travel as null: the artifact is
+        # strict JSON (allow_nan=False), so ±inf must not reach params
         return self._u("clip", x, {
-            "lo": None if lo == -np.inf else float(lo),
-            "hi": None if hi == np.inf else float(hi)})
+            "lo": None if lo is None or lo == -np.inf else float(lo),
+            "hi": None if hi is None or hi == np.inf else float(hi)})
 
 
 class _NNNamespace:

@@ -1,5 +1,7 @@
 """Keras model import (≡ deeplearning4j-modelimport)."""
 from deeplearning4j_tpu.keras_import.keras_import import (
-    InvalidKerasConfigurationException, KerasModelImport)
+    InvalidKerasConfigurationException, KerasModelImport, clearLambdas,
+    registerCustomLayer, registerLambda)
 
-__all__ = ["InvalidKerasConfigurationException", "KerasModelImport"]
+__all__ = ["InvalidKerasConfigurationException", "KerasModelImport",
+           "registerCustomLayer", "registerLambda", "clearLambdas"]

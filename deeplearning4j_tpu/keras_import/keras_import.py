@@ -124,6 +124,26 @@ def clearCustomLayers():
     _CUSTOM_LAYER_CONVERTERS.clear()
 
 
+#: Lambda implementations by Keras layer NAME (≡ modelimport.keras ::
+#: KerasLambda): Keras JSON stores only marshaled Python for Lambda
+#: layers, so the reference requires the user to register the
+#: implementation before import — same contract here.
+_LAMBDA_IMPLS = {}
+
+
+def registerLambda(layer_name, fn):
+    """Register the implementation for a Keras Lambda layer by its layer
+    name: fn(x) -> array (a pure jax function). Must be called before
+    importing a model whose JSON contains that Lambda."""
+    if not callable(fn):
+        raise TypeError("fn must be a callable (pure jax function)")
+    _LAMBDA_IMPLS[str(layer_name)] = fn
+
+
+def clearLambdas():
+    _LAMBDA_IMPLS.clear()
+
+
 def _convert_layer(class_name, cfg, is_last=False):
     """One Keras layer config → our layer instance (or None to skip)."""
     if class_name in _CUSTOM_LAYER_CONVERTERS:
@@ -235,6 +255,22 @@ def _convert_layer(class_name, cfg, is_last=False):
             stride=st[0] if isinstance(st, (list, tuple)) else st,
             convolutionMode=cfg.get("padding", "valid"),
             activation=act, weightInit=init, hasBias=bias)
+    if class_name == "Permute":
+        from deeplearning4j_tpu.nn.conf.special_layers import PermuteLayer
+        return PermuteLayer(dims=tuple(cfg["dims"]))
+    if class_name == "Lambda":
+        fn = _LAMBDA_IMPLS.get(cfg.get("name"))
+        if fn is None:
+            raise InvalidKerasConfigurationException(
+                f"Lambda layer {cfg.get('name')!r}: Keras JSON stores only "
+                "marshaled Python for Lambda layers, so the implementation "
+                "must be supplied at import time — call "
+                "registerLambda(name, fn) first (≡ the reference's "
+                "KerasLambda contract), or registerCustomLayer('Lambda', "
+                "converter) for full control")
+        from deeplearning4j_tpu.nn.conf.samediff_layers import \
+            SameDiffLambdaLayer
+        return SameDiffLambdaLayer(fn=fn)
     if class_name == "Bidirectional":
         inner_cfg = cfg.get("layer") or {}
         inner = _convert_layer(inner_cfg.get("class_name"),
@@ -472,8 +508,15 @@ class KerasModelImport:
                 g.addVertex(name, MergeVertex(), *inbound)
                 continue
             layer = _convert_layer(cls, c, is_last=is_output)
-            if layer is None:  # Flatten etc: alias to its input
-                g.addVertex(name, _IdentityAlias(), *inbound)
+            if layer is None:
+                if cls == "Flatten":
+                    # real (B, ...) -> (B, prod) flatten: downstream
+                    # layers must see a feed-forward type (a CNN input
+                    # also rides the same reshape — NHWC order matches
+                    # Keras channels_last)
+                    g.addVertex(name, _FlattenVertex(), *inbound)
+                else:   # Reshape/InputLayer: alias to input
+                    g.addVertex(name, _IdentityAlias(), *inbound)
                 continue
             g.addLayer(name, layer, *inbound)
         g.addInputs(*input_names)
@@ -532,6 +575,28 @@ class _IdentityAlias:
 
     def apply(self, *xs, mask=None):
         return xs[0]
+
+    def feed_forward_mask(self, *parent_masks):
+        # Flatten/Reshape collapse the axis a (B, T) mask indexes — a
+        # stale mask downstream would zero the wrong positions
+        return None
+
+
+class _FlattenVertex:
+    """Keras Flatten in the functional graph: (B, ...) -> (B, prod)."""
+
+    def output_type(self, *input_types):
+        import numpy as _np
+
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        return InputType.feedForward(int(_np.prod(input_types[0].shape())))
+
+    def apply(self, *xs, mask=None):
+        x = xs[0]
+        return x.reshape(x.shape[0], -1)
+
+    def feed_forward_mask(self, *parent_masks):
+        return None
 
 
 # -- .h5 weight loading (gated on h5py, which this image ships) ----------

@@ -433,3 +433,56 @@ class CenterLossOutputLayer(BaseOutputLayer, DenseLayer):
         center_pull = 0.5 * self.alpha * jnp.mean(
             ((sg(features) - cy) ** 2).sum(-1))
         return base + feat_pull + center_pull
+
+
+class PermuteLayer(Layer):
+    """≡ Keras Permute (imported via KerasModelImport) / nd4j Permute as a
+    layer: reorders the NON-batch dimensions. ``dims`` is 1-indexed over
+    the non-batch axes, Keras-style — PermuteLayer(dims=(2, 1)) swaps the
+    two non-batch axes of a (B, T, F) sequence. No parameters.
+
+    Note: permuting a sequence's time axis de-aligns any feature mask;
+    masks are intentionally not propagated through a non-identity
+    permute."""
+
+    @classmethod
+    def _builder_positional(cls, args):
+        if len(args) == 1:
+            return {"dims": args[0]}
+        return {}
+
+    def __init__(self, dims=None, **kw):
+        super().__init__(**kw)
+        if dims is None:
+            raise ValueError("PermuteLayer requires dims, e.g. dims=(2, 1)")
+        self.dims = tuple(int(d) for d in dims)
+        if sorted(self.dims) != list(range(1, len(self.dims) + 1)):
+            raise ValueError(
+                f"PermuteLayer dims must be a permutation of "
+                f"1..{len(self.dims)} (1-indexed, batch excluded), "
+                f"got {self.dims}")
+
+    def output_type(self, input_type):
+        shp = input_type.shape()
+        if len(self.dims) != len(shp):
+            raise ValueError(
+                f"PermuteLayer '{self.name}': dims {self.dims} has "
+                f"{len(self.dims)} axes but the input has {len(shp)} "
+                f"non-batch axes ({input_type})")
+        new = tuple(shp[d - 1] for d in self.dims)
+        from deeplearning4j_tpu.nn.conf.inputs import (Convolutional3DType,
+                                                       RecurrentType)
+        if isinstance(input_type, RecurrentType):
+            return InputType.recurrent(new[1], new[0])
+        if isinstance(input_type, Convolutional3DType):
+            return InputType.convolutional3D(new[0], new[1], new[2], new[3])
+        if isinstance(input_type, ConvolutionalType):
+            return InputType.convolutional(new[0], new[1], new[2])
+        return input_type   # feedForward: dims == (1,), identity
+
+    def feed_forward_mask(self, mask):
+        return None if self.dims != tuple(
+            range(1, len(self.dims) + 1)) else mask
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        return jnp.transpose(x, (0,) + self.dims), state

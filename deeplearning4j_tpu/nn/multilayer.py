@@ -454,6 +454,7 @@ class MultiLayerNetwork:
          losses) = self._train_scan(self._params, self._opt_state,
                                     self._state, xs, ys, fms, lms,
                                     jnp.stack(subs))
+        self._last_features = group[-1][0]
         for loss in jax.device_get(losses):
             self._score = float(loss)
             self._iteration += 1
@@ -530,6 +531,10 @@ class MultiLayerNetwork:
                 lmask, sub)
             self._score = float(loss)
         self._iteration += 1
+        # most recent training batch, for listeners that inspect
+        # activations (StatsListener histograms — ≡ the reference
+        # dashboard's activation charts over the last minibatch)
+        self._last_features = x
         for listener in self._listeners:
             listener.iterationDone(self, self._iteration, self._epoch)
 

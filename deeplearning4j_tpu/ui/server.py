@@ -27,7 +27,14 @@ svg{width:100%;height:220px}
 <div class="meta" id="meta">waiting for stats…</div>
 <div class="chart"><h2>Score vs iteration</h2><svg id="score"></svg></div>
 <div class="chart"><h2>Iteration time (ms)</h2><svg id="time"></svg></div>
+<div class="chart"><h2>log10 update:parameter ratio</h2>
+<svg id="ratios"></svg><div class="meta" id="ratiokeys"></div></div>
+<div class="chart"><h2>Activation histograms (latest)</h2>
+<div id="hists"></div></div>
+<div class="chart"><h2>t-SNE</h2><svg id="tsne" style="height:320px">
+</svg><div class="meta" id="tsnemeta">no t-SNE data attached</div></div>
 <script>
+const COLORS = ['#0a6','#06a','#a06','#a60','#60a','#6a0','#066','#660'];
 function poly(svg, xs, ys, color){
   const el = document.getElementById(svg);
   if (xs.length < 2){ return; }
@@ -41,6 +48,33 @@ function poly(svg, xs, ys, color){
     points="${pts}"/><text x="4" y="12" font-size="11">${ymax.toFixed(4)}
     </text><text x="4" y="${H-6}" font-size="11">${ymin.toFixed(4)}</text>`;
 }
+function multiPoly(svg, series){   // series: [{name, xs, ys, color}]
+  const el = document.getElementById(svg);
+  const all = series.flatMap(s=>s.ys);
+  if (!all.length){ return; }
+  const W = el.clientWidth || 600, H = 220, P = 30;
+  const xs = series.flatMap(s=>s.xs);
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  const ymin = Math.min(...all), ymax = Math.max(...all);
+  const sx = x => P + (x - xmin) / (xmax - xmin || 1) * (W - 2*P);
+  const sy = y => H - P - (y - ymin) / (ymax - ymin || 1) * (H - 2*P);
+  el.innerHTML = series.map(s=>`<polyline fill="none" stroke="${s.color}"
+    stroke-width="1.2" points="${s.xs.map((x,i)=>sx(x)+","+sy(s.ys[i]))
+    .join(" ")}"/>`).join("") +
+    `<text x="4" y="12" font-size="11">${ymax.toFixed(2)}</text>
+     <text x="4" y="${H-6}" font-size="11">${ymin.toFixed(2)}</text>`;
+}
+function histSvg(h, title, color){
+  const W = 300, H = 120, n = h.counts.length;
+  const cmax = Math.max(...h.counts, 1);
+  const bars = h.counts.map((c,i)=>`<rect x="${i*W/n}" width="${W/n-1}"
+    y="${H-20-(H-24)*c/cmax}" height="${(H-24)*c/cmax}"
+    fill="${color}"/>`).join("");
+  return `<svg viewBox="0 0 ${W} ${H}" style="width:300px;height:120px">
+    ${bars}<text x="2" y="${H-6}" font-size="10">${h.min.toFixed(2)}</text>
+    <text x="${W-40}" y="${H-6}" font-size="10">${h.max.toFixed(2)}</text>
+    <text x="2" y="10" font-size="10">${title}</text></svg>`;
+}
 async function tick(){
   const r = await fetch('/stats'); const recs = await r.json();
   if (recs.length){
@@ -51,6 +85,39 @@ async function tick(){
     poly('score', recs.map(r=>r.iteration), recs.map(r=>r.score), '#0a6');
     const t = recs.filter(r=>r.iterationTimeMs != null);
     poly('time', t.map(r=>r.iteration), t.map(r=>r.iterationTimeMs), '#06a');
+    const withR = recs.filter(r=>r.updateRatios &&
+                              Object.keys(r.updateRatios).length);
+    if (withR.length){
+      const keys = Object.keys(withR[withR.length-1].updateRatios);
+      multiPoly('ratios', keys.map((k,i)=>({name:k,
+        xs: withR.filter(r=>k in r.updateRatios).map(r=>r.iteration),
+        ys: withR.filter(r=>k in r.updateRatios)
+          .map(r=>Math.log10(r.updateRatios[k]+1e-12)),
+        color: COLORS[i % COLORS.length]})));
+      document.getElementById('ratiokeys').innerHTML = keys.map((k,i)=>
+        `<span style="color:${COLORS[i%COLORS.length]}">${k}</span>`)
+        .join(" · ");
+    }
+    const ah = last.activationHistograms || {};
+    document.getElementById('hists').innerHTML = Object.keys(ah)
+      .map((k,i)=>histSvg(ah[k], k, COLORS[i % COLORS.length])).join("");
+  }
+  const tr = await fetch('/tsne'); const td = await tr.json();
+  if (td.points && td.points.length){
+    const el = document.getElementById('tsne');
+    const W = el.clientWidth || 600, H = 320, P = 20;
+    const xs = td.points.map(p=>p[0]), ys = td.points.map(p=>p[1]);
+    const xmin=Math.min(...xs), xmax=Math.max(...xs);
+    const ymin=Math.min(...ys), ymax=Math.max(...ys);
+    const labs = td.labels || [];
+    const lset = [...new Set(labs)];
+    el.innerHTML = td.points.map((p,i)=>`<circle
+      cx="${P+(p[0]-xmin)/(xmax-xmin||1)*(W-2*P)}"
+      cy="${H-P-(p[1]-ymin)/(ymax-ymin||1)*(H-2*P)}" r="2.5"
+      fill="${COLORS[lset.indexOf(labs[i]) % COLORS.length]}"/>`).join("");
+    document.getElementById('tsnemeta').textContent =
+      `${td.points.length} points` + (lset.length>1 ?
+      ` · classes: ${lset.join(", ")}` : "");
   }
 }
 setInterval(tick, 1000); tick();
@@ -67,6 +134,7 @@ class UIServer:
         self._httpd = None
         self._thread = None
         self.port = None
+        self._tsne = {"points": [], "labels": []}
 
     @classmethod
     def getInstance(cls):
@@ -82,10 +150,32 @@ class UIServer:
         self._storages.remove(storage)
         return self
 
+    def attachTsne(self, vectors, labels=None, maxIter=300, perplexity=30.0,
+                   seed=0):
+        """t-SNE tab (≡ the reference UI's word-vector t-SNE view): pass
+        2-D coords directly, or higher-dim vectors to embed here via
+        clustering.tsne (exact MXU gradients)."""
+        import numpy as _np
+        vectors = _np.asarray(vectors, _np.float32)
+        if vectors.ndim != 2:
+            raise ValueError(f"attachTsne expects (N, D), got "
+                             f"{vectors.shape}")
+        if vectors.shape[1] != 2:
+            from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne
+            vectors = (BarnesHutTsne.Builder().setMaxIter(int(maxIter))
+                       .perplexity(perplexity).seed(seed).build()
+                       .fit(vectors).getData())
+        self._tsne = {
+            "points": [[float(a), float(b)] for a, b in vectors],
+            "labels": [str(l) for l in labels] if labels is not None else [],
+        }
+        return self
+
     def start(self, port=9000):
         if self._httpd is not None:
             return self
         storages = self._storages
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
@@ -94,6 +184,9 @@ class UIServer:
                     for s in storages:
                         recs.extend(s.all())
                     body = json.dumps(recs).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/tsne"):
+                    body = json.dumps(server._tsne).encode()
                     ctype = "application/json"
                 else:
                     body = _PAGE.encode()
@@ -123,8 +216,12 @@ class UIServer:
         return self
 
 
-def render_static_html(storage, path):
-    """Static dashboard snapshot: inline-SVG score/time charts."""
+def render_static_html(storage, path, tsne=None):
+    """Static dashboard snapshot: inline-SVG score/time charts plus the
+    round-5 panels — log10 update:parameter ratios, latest activation
+    histograms, and an optional t-SNE scatter (tsne=(coords, labels))."""
+    import math
+
     recs = storage.all()
 
     def svg_line(xs, ys, color):
@@ -156,6 +253,57 @@ def render_static_html(storage, path):
     if times:
         html += "<h2>Iteration time (ms)</h2>" + svg_line(
             [t[0] for t in times], [t[1] for t in times], "#06a")
+
+    colors = ["#0a6", "#06a", "#a06", "#a60", "#60a", "#6a0", "#066"]
+    with_r = [r for r in recs if r.get("updateRatios")]
+    if with_r:
+        keys = sorted(with_r[-1]["updateRatios"])
+        html += "<h2>log10 update:parameter ratio</h2>"
+        for i, k in enumerate(keys):
+            pts = [(r["iteration"],
+                    math.log10(r["updateRatios"][k] + 1e-12))
+                   for r in with_r if k in r["updateRatios"]]
+            html += (f'<div>{k}</div>'
+                     + svg_line([p[0] for p in pts], [p[1] for p in pts],
+                                colors[i % len(colors)]))
+    ah = next((r["activationHistograms"] for r in reversed(recs)
+               if r.get("activationHistograms")), None)
+    if ah:
+        html += "<h2>Activation histograms (latest)</h2>"
+        for i, (k, h) in enumerate(sorted(ah.items())):
+            cmax = max(h["counts"]) or 1
+            W, H, n = 300, 120, len(h["counts"])
+            bars = "".join(
+                f'<rect x="{j * W / n:.1f}" width="{W / n - 1:.1f}" '
+                f'y="{H - 20 - (H - 24) * c / cmax:.1f}" '
+                f'height="{(H - 24) * c / cmax:.1f}" '
+                f'fill="{colors[i % len(colors)]}"/>'
+                for j, c in enumerate(h["counts"]))
+            html += (f'<h3>{k}</h3><svg viewBox="0 0 {W} {H}" '
+                     f'width="{W}" height="{H}">{bars}'
+                     f'<text x="2" y="{H - 6}" font-size="10">'
+                     f'{h["min"]:.2f}</text>'
+                     f'<text x="{W - 44}" y="{H - 6}" font-size="10">'
+                     f'{h["max"]:.2f}</text></svg>')
+    if tsne is not None:
+        coords, labels = (tsne if isinstance(tsne, tuple)
+                          else (tsne, None))
+        import numpy as _np
+        coords = _np.asarray(coords, _np.float32)
+        lset = sorted(set(map(str, labels))) if labels is not None else []
+        W, H, P = 640, 360, 20
+        xmin, ymin = coords.min(0)
+        xmax, ymax = coords.max(0)
+        dots = "".join(
+            f'<circle cx="{P + (cx - xmin) / ((xmax - xmin) or 1) * (W - 2 * P):.1f}" '
+            f'cy="{H - P - (cy - ymin) / ((ymax - ymin) or 1) * (H - 2 * P):.1f}" '
+            f'r="2.5" fill="'
+            + (colors[lset.index(str(labels[i])) % len(colors)]
+               if lset else colors[0]) + '"/>'
+            for i, (cx, cy) in enumerate(coords))
+        html += (f"<h2>t-SNE ({len(coords)} points)</h2>"
+                 f'<svg viewBox="0 0 {W} {H}" width="{W}" '
+                 f'height="{H}">{dots}</svg>')
     html += "</body></html>"
     with open(path, "w") as f:
         f.write(html)

@@ -51,24 +51,81 @@ class FileStatsStorage(InMemoryStatsStorage):
 
 
 class StatsListener(TrainingListener):
-    """≡ StatsListener(statsStorage, frequency)."""
+    """≡ StatsListener(statsStorage, frequency).
 
-    def __init__(self, storage=None, frequency=1):
+    Round-5 depth (≡ the reference dashboard's TrainModule data): each
+    record also carries per-layer-param update:parameter mean-magnitude
+    RATIOS (the learning-rate-tuning chart; computed from the param delta
+    since the previous record) and per-layer ACTIVATION histograms
+    (forward pass over the most recent training batch, inference mode).
+    Both can be disabled for minimal overhead."""
+
+    def __init__(self, storage=None, frequency=1, collectRatios=True,
+                 collectActivations=True, histogramBins=20):
         self.storage = storage if storage is not None \
             else InMemoryStatsStorage()
         self.frequency = max(1, int(frequency))
+        self.collectRatios = bool(collectRatios)
+        self.collectActivations = bool(collectActivations)
+        self.histogramBins = int(histogramBins)
         self._last_time = None
+        self._prev_params = None
 
-    def _param_summaries(self, model):
-        out = {}
+    def _flat_params(self, model):
+        """ONE device->host transfer of the parameter set; summaries and
+        ratios both derive from this host copy."""
         params = getattr(model, "_params", None) or {}
-        for lname, p in params.items():
-            for pname, v in p.items():
-                arr = np.asarray(v)
-                out[f"{lname}_{pname}"] = {
-                    "meanMagnitude": float(np.abs(arr).mean()),
-                    "stdev": float(arr.std()),
-                }
+        return {f"{ln}_{pn}": np.asarray(v)
+                for ln, p in params.items() for pn, v in p.items()}
+
+    @staticmethod
+    def _param_summaries(flat):
+        return {k: {"meanMagnitude": float(np.abs(arr).mean()),
+                    "stdev": float(arr.std())}
+                for k, arr in flat.items()}
+
+    def _update_ratios(self, flat):
+        """mean|Δparam| / mean|param| per layer param — the reference
+        dashboard's update:parameter ratio chart (healthy ≈ 1e-3)."""
+        prev, self._prev_params = self._prev_params, flat
+        if prev is None:
+            return {}
+        out = {}
+        for k, arr in flat.items():
+            p0 = prev.get(k)
+            if p0 is None or p0.shape != arr.shape:
+                continue
+            pm = float(np.abs(arr).mean())
+            out[k] = float(np.abs(arr - p0).mean() / (pm + 1e-12))
+        return out
+
+    def _activation_histograms(self, model):
+        x = getattr(model, "_last_features", None)
+        ff = getattr(model, "feedForward", None)
+        if x is None or ff is None:
+            return {}
+        out = {}
+        try:
+            acts = ff(x)
+            for i, a in enumerate(acts):
+                arr = np.asarray(a.jax() if hasattr(a, "jax") else a,
+                                 np.float32).ravel()
+                finite = arr[np.isfinite(arr)]
+                if finite.size == 0:   # diverged layer: record, don't die
+                    out[f"layer{i}"] = {"min": 0.0, "max": 0.0,
+                                        "counts": [0] * self.histogramBins,
+                                        "nonFinite": int(arr.size)}
+                    continue
+                lo, hi = float(finite.min()), float(finite.max())
+                counts, _ = np.histogram(
+                    finite, bins=self.histogramBins,
+                    range=(lo, hi if hi > lo else lo + 1))
+                h = {"min": lo, "max": hi, "counts": counts.tolist()}
+                if finite.size != arr.size:
+                    h["nonFinite"] = int(arr.size - finite.size)
+                out[f"layer{i}"] = h
+        except Exception:   # noqa: BLE001 — stats must never kill training
+            return out
         return out
 
     def iterationDone(self, model, iteration, epoch):
@@ -78,14 +135,20 @@ class StatsListener(TrainingListener):
         dt_ms = None if self._last_time is None else (
             (now - self._last_time) * 1000.0 / self.frequency)
         self._last_time = now
+        flat = self._flat_params(model)
         record = {
             "iteration": int(iteration),
             "epoch": int(epoch),
             "timestamp": time.time(),
             "score": float(model.score()),
             "iterationTimeMs": dt_ms,
-            "params": self._param_summaries(model),
+            "params": self._param_summaries(flat),
         }
+        if self.collectRatios:
+            record["updateRatios"] = self._update_ratios(flat)
+        if self.collectActivations:
+            record["activationHistograms"] = \
+                self._activation_histograms(model)
         self.storage.put(record)
 
     # -- convenience ------------------------------------------------------

@@ -83,3 +83,95 @@ def test_static_html_snapshot(tmp_path):
     render_static_html(storage, out)
     html = open(out).read()
     assert "<svg" in html and "score" in html.lower()
+
+
+def test_update_ratios_and_activation_histograms_recorded():
+    """Round-5 depth (VERDICT r4 #8): ratio + histogram series flow
+    through StatsListener."""
+    storage = InMemoryStatsStorage()
+    _trained_storage(storage)
+    recs = storage.all()
+    # first record has no previous params -> no ratios; later ones do
+    with_r = [r for r in recs if r.get("updateRatios")]
+    assert with_r, "no updateRatios recorded"
+    for r in with_r:
+        for k, v in r["updateRatios"].items():
+            assert np.isfinite(v) and v >= 0, (k, v)
+    assert any(v > 0 for r in with_r for v in r["updateRatios"].values())
+    with_h = [r for r in recs if r.get("activationHistograms")]
+    assert with_h, "no activation histograms recorded"
+    h = with_h[-1]["activationHistograms"]
+    assert len(h) >= 2   # dense + output layers
+    for k, d in h.items():
+        assert sum(d["counts"]) > 0 and d["max"] >= d["min"]
+
+
+def test_dashboard_serves_tsne_tab():
+    storage = InMemoryStatsStorage()
+    server = UIServer.getInstance()
+    server.attach(storage)
+    rng = np.random.default_rng(1)
+    coords = np.concatenate([rng.normal(0, 1, (10, 2)),
+                             rng.normal(8, 1, (10, 2))]).astype(np.float32)
+    labels = ["a"] * 10 + ["b"] * 10
+    server.attachTsne(coords, labels)   # 2-D passthrough (no re-embed)
+    server.start(port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        td = json.loads(urllib.request.urlopen(
+            base + "/tsne", timeout=10).read().decode())
+        assert len(td["points"]) == 20 and td["labels"].count("a") == 10
+        html = urllib.request.urlopen(base + "/", timeout=10).read().decode()
+        for panel in ("update:parameter ratio", "Activation histograms",
+                      "t-SNE"):
+            assert panel in html, panel
+    finally:
+        server.stop()
+        server.detach(storage)
+
+
+def test_attach_tsne_embeds_high_dim_vectors():
+    rng = np.random.default_rng(2)
+    vecs = np.concatenate([rng.normal(0, 1, (15, 8)),
+                           rng.normal(7, 1, (15, 8))]).astype(np.float32)
+    server = UIServer.getInstance()
+    server.attachTsne(vecs, ["x"] * 15 + ["y"] * 15, maxIter=80,
+                      perplexity=8)
+    pts = np.asarray(server._tsne["points"])
+    assert pts.shape == (30, 2) and np.isfinite(pts).all()
+
+
+def test_static_html_has_new_panels(tmp_path):
+    storage = InMemoryStatsStorage()
+    _trained_storage(storage)
+    rng = np.random.default_rng(3)
+    coords = rng.normal(size=(12, 2)).astype(np.float32)
+    out = str(tmp_path / "dash5.html")
+    render_static_html(storage, out, tsne=(coords, ["a", "b"] * 6))
+    html = open(out).read()
+    for panel in ("update:parameter ratio", "Activation histograms",
+                  "t-SNE"):
+        assert panel in html, panel
+    assert html.count("<rect") >= 20      # histogram bars
+    assert html.count("<circle") == 12    # t-SNE dots
+
+
+def test_histograms_survive_nonfinite_activations():
+    """Stats must never kill training, even when the model diverges."""
+    class FakeModel:
+        _params = {"0": {"W": np.ones((2, 2), np.float32)}}
+        _last_features = np.ones((2, 2), np.float32)
+
+        def score(self):
+            return float("nan")
+
+        def feedForward(self, x):
+            return [np.full((2, 2), np.nan, np.float32),
+                    np.array([[1.0, np.inf], [2.0, 3.0]], np.float32)]
+
+    storage = InMemoryStatsStorage()
+    lst = StatsListener(storage)
+    lst.iterationDone(FakeModel(), 1, 0)   # must not raise
+    h = storage.all()[0]["activationHistograms"]
+    assert h["layer0"]["nonFinite"] == 4
+    assert h["layer1"]["nonFinite"] == 1 and sum(h["layer1"]["counts"]) == 3
