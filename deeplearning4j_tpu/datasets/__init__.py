@@ -1,6 +1,7 @@
 from deeplearning4j_tpu.datasets.dataset import DataSet, SplitTestAndTrain
 from deeplearning4j_tpu.datasets.iterators import (
-    ArrayDataSetIterator, AsyncDataSetIterator, CifarDataSetIterator,
+    ArrayDataSetIterator, AsyncDataSetIterator, Cifar100DataSetIterator,
+    CifarDataSetIterator, LFWDataSetIterator,
     ListDataSetIterator, ListMultiDataSetIterator,
     SingletonMultiDataSetIterator,
     DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
@@ -13,7 +14,8 @@ from deeplearning4j_tpu.datasets.normalizers import (
 
 __all__ = [
     "DataSet", "SplitTestAndTrain", "ArrayDataSetIterator", "ListDataSetIterator",
-    "AsyncDataSetIterator", "CifarDataSetIterator", "DataSetIterator",
+    "AsyncDataSetIterator", "Cifar100DataSetIterator",
+    "CifarDataSetIterator", "DataSetIterator", "LFWDataSetIterator",
     "EmnistDataSetIterator", "IrisDataSetIterator", "MnistDataSetIterator",
     "SyntheticImageNetIterator", "SvhnDataSetIterator",
     "TinyImageNetDataSetIterator", "UciSequenceDataSetIterator",

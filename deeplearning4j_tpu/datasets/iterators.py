@@ -142,20 +142,29 @@ def _one_hot(y, n):
 def _synthetic_images(n, h, w, c, n_classes, seed):
     """Deterministic, linearly-separable-ish synthetic image set: each class
     has a characteristic frequency pattern plus noise (so LeNet-class models
-    reach high accuracy, exercising the real training dynamics)."""
+    reach high accuracy, exercising the real training dynamics).
+
+    Pattern parameters use independent x/y frequencies plus a golden-angle
+    phase, so classes stay visually distinct up to hundreds of classes
+    (the old freq=cls%5 form aliased classes 45 apart — indistinguishable
+    under the noise). Noise is generated float32 per class slice: peak
+    memory stays O(dataset), not O(dataset) x2 in float64."""
     rng = np.random.default_rng(seed)
     y = rng.integers(0, n_classes, size=n)
     yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
     imgs = np.zeros((n, h, w, c), np.float32)
     for cls in range(n_classes):
         m = y == cls
-        freq = 1 + cls % 5
-        phase = (cls // 5) * 0.7
-        pattern = 0.5 + 0.5 * np.sin(freq * 2 * np.pi * xx / w + phase) \
-            * np.cos(freq * 2 * np.pi * yy / h + phase)
-        imgs[m] = pattern[None, :, :, None]
-    imgs += 0.15 * rng.standard_normal(imgs.shape).astype(np.float32)
-    imgs = np.clip(imgs, 0, 1)
+        if not m.any():
+            continue
+        fx = 1 + cls % 6
+        fy = 1 + (cls // 6) % 6
+        phase = cls * 2.39996323   # golden angle: no periodic aliasing
+        pattern = 0.5 + 0.5 * np.sin(fx * 2 * np.pi * xx / w + phase) \
+            * np.cos(fy * 2 * np.pi * yy / h + 0.5 * phase)
+        imgs[m] = pattern[None, :, :, None] + 0.15 * rng.standard_normal(
+            (int(m.sum()), h, w, c), dtype=np.float32)
+    np.clip(imgs, 0, 1, out=imgs)
     return (imgs * 255).astype(np.uint8), y
 
 
@@ -281,6 +290,59 @@ class CifarDataSetIterator(DataSetIterator):
         return self._maybe_preprocess(
             DataSet(img.astype(np.float32) / 255.0,
                     _one_hot(lab, self.NUM_CLASSES)))
+
+
+class Cifar100DataSetIterator(CifarDataSetIterator):
+    """≡ deeplearning4j-datasets :: Cifar100DataSetIterator —
+    (B, 32, 32, 3) NHWC in [0,1]; fine (100) or coarse (20) labels.
+    Parses the real cifar-100-binary layout when files exist (one coarse
+    + one fine label byte, then 3072 CHW pixels per record);
+    deterministic synthetic otherwise (zero-egress policy)."""
+
+    def __init__(self, batch_size, train=True, useCoarseLabels=False,
+                 seed=222, root=None, num_examples=None):
+        DataSetIterator.__init__(self, batch_size)
+        self.NUM_CLASSES = 20 if useCoarseLabels else 100
+        root = root or os.path.expanduser("~/.deeplearning4j/cifar100")
+        path = os.path.join(root, "cifar-100-binary",
+                            "train.bin" if train else "test.bin")
+        if os.path.exists(path):
+            raw = np.fromfile(path, np.uint8).reshape(-1, 3074)
+            self._labels = raw[:, 0 if useCoarseLabels else 1].copy()
+            self._images = raw[:, 2:].reshape(-1, 3, 32, 32).transpose(
+                0, 2, 3, 1)
+        else:
+            n = num_examples or (4000 if train else 800)
+            self._images, self._labels = _synthetic_images(
+                n, self.H, self.W, 3, self.NUM_CLASSES,
+                seed if train else seed + 1)
+        if num_examples:
+            self._images = self._images[:num_examples]
+            self._labels = self._labels[:num_examples]
+
+
+class LFWDataSetIterator(CifarDataSetIterator):
+    """≡ deeplearning4j-datasets :: LFWDataSetIterator — Labeled Faces
+    in the Wild-shaped face-identification batches: (B, H, W, C) NHWC in
+    [0,1] with one class per identity (reference defaults 250x250x3).
+    Zero-egress environment: deterministic synthetic faces with the
+    requested geometry/identity count (the reference downloads the
+    tarball)."""
+
+    def __init__(self, batch_size, num_examples=None, imgDim=(250, 250, 3),
+                 numLabels=40, train=True, seed=542):
+        DataSetIterator.__init__(self, batch_size)
+        h, w, c = (int(d) for d in imgDim)
+        self.H, self.W, self.C = h, w, c
+        self.NUM_CLASSES = int(numLabels)
+        # modest default at the 250x250 reference geometry (200 examples
+        # ≈ 150 MB float32); pass num_examples for more
+        n = num_examples or (200 if train else 50)
+        self._images, self._labels = _synthetic_images(
+            n, h, w, c, self.NUM_CLASSES, seed if train else seed + 1)
+
+    def inputColumns(self):
+        return self.H * self.W * self.C
 
 
 class IrisDataSetIterator(DataSetIterator):

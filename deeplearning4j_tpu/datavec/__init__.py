@@ -1,6 +1,8 @@
 from deeplearning4j_tpu.datavec.image_records import (
-    FlipImageTransform, ImageRecordDataSetIterator, ImageRecordReader,
-    ParentPathLabelGenerator, PipelineImageTransform, ResizeImageTransform)
+    ColorConversionTransform, CropImageTransform, FlipImageTransform,
+    ImageRecordDataSetIterator, ImageRecordReader, ParentPathLabelGenerator,
+    PipelineImageTransform, RandomCropTransform, ResizeImageTransform,
+    RotateImageTransform)
 from deeplearning4j_tpu.datavec.sequence import (
     AnalyzeLocal, CollectionSequenceRecordReader, CSVSequenceRecordReader,
     DataAnalysis, Join, SequenceRecordReader,
@@ -19,4 +21,6 @@ __all__ = [
            "RecordReader", "RecordReaderDataSetIterator", "Schema",
            "TransformProcess", "FlipImageTransform", "ImageRecordDataSetIterator",
            "ImageRecordReader", "ParentPathLabelGenerator",
-           "PipelineImageTransform", "ResizeImageTransform"]
+           "PipelineImageTransform", "ResizeImageTransform",
+           "ColorConversionTransform", "CropImageTransform",
+           "RandomCropTransform", "RotateImageTransform"]

@@ -32,8 +32,11 @@ class ResizeImageTransform(ImageTransform):
 
     def transform(self, img, rng):
         from PIL import Image
-        pil = Image.fromarray(img.astype(np.uint8))
-        return np.asarray(pil.resize((self.w, self.h)), np.float32)
+        squeeze = img.ndim == 3 and img.shape[2] == 1
+        src = img[:, :, 0] if squeeze else img   # PIL: gray is 2-D
+        pil = Image.fromarray(src.astype(np.uint8))
+        out = np.asarray(pil.resize((self.w, self.h)), np.float32)
+        return out[:, :, None] if squeeze else out
 
 
 class FlipImageTransform(ImageTransform):
@@ -62,13 +65,106 @@ class CropImageTransform(ImageTransform):
         return np.pad(out, pad, mode="edge")
 
 
-class PipelineImageTransform(ImageTransform):
-    def __init__(self, *transforms):
-        self.transforms = list(transforms)
+class RotateImageTransform(ImageTransform):
+    """≡ transform.RotateImageTransform(angle): rotate by a uniform random
+    angle in [-angle, +angle] degrees about the image center (bilinear,
+    same output size, edge value 0 — the reference's warpAffine
+    default)."""
+
+    def __init__(self, angle):
+        self.angle = float(angle)
 
     def transform(self, img, rng):
-        for t in self.transforms:
-            img = t.transform(img, rng)
+        from PIL import Image
+        deg = float(rng.uniform(-self.angle, self.angle))
+        squeeze = img.ndim == 3 and img.shape[2] == 1
+        src = img[:, :, 0] if squeeze else img
+        pil = Image.fromarray(src.astype(np.uint8))
+        out = np.asarray(pil.rotate(deg, resample=Image.BILINEAR,
+                                    expand=False, fillcolor=0), np.float32)
+        return out[:, :, None] if squeeze else out
+
+
+class RandomCropTransform(ImageTransform):
+    """≡ transform.RandomCropTransform(height, width): crop a random
+    (height, width) window — the output is SMALLER than the input (the
+    augmentation form of cropping, unlike CropImageTransform's
+    crop-and-pad)."""
+
+    def __init__(self, height, width):
+        self.h, self.w = int(height), int(width)
+
+    def transform(self, img, rng):
+        h, w = img.shape[:2]
+        if self.h > h or self.w > w:
+            raise ValueError(
+                f"RandomCropTransform({self.h}, {self.w}): crop larger "
+                f"than the {h}x{w} input")
+        top = int(rng.integers(0, h - self.h + 1))
+        left = int(rng.integers(0, w - self.w + 1))
+        return img[top:top + self.h, left:left + self.w]
+
+
+class ColorConversionTransform(ImageTransform):
+    """≡ transform.ColorConversionTransform: the common conversions by
+    name instead of OpenCV integer codes — 'RGB2GRAY' (1 channel),
+    'BGR2RGB'/'RGB2BGR' (channel reversal), 'RGB2HSV'/'HSV2RGB'."""
+
+    _ITU_R = np.array([0.299, 0.587, 0.114], np.float32)  # BT.601 luma
+
+    def __init__(self, conversion="RGB2GRAY"):
+        conv = str(conversion).upper()
+        if conv not in ("RGB2GRAY", "BGR2RGB", "RGB2BGR", "RGB2HSV",
+                        "HSV2RGB"):
+            raise ValueError(f"unsupported conversion {conversion!r}")
+        self.conversion = conv
+
+    def transform(self, img, rng):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        if self.conversion == "RGB2GRAY":
+            if img.shape[2] == 1:
+                return img
+            if img.shape[2] < 3:
+                raise ValueError(
+                    f"RGB2GRAY needs 1 or >=3 channels, got "
+                    f"{img.shape[2]}")
+            return (img[:, :, :3] @ self._ITU_R)[:, :, None]
+        if img.shape[2] != 3:
+            # exactly 3: silently reversing RGBA would move alpha into a
+            # color plane, and PIL's HSV path would die cryptically
+            raise ValueError(
+                f"{self.conversion} needs exactly 3 channels, got "
+                f"{img.shape[2]} (slice [:, :, :3] first)")
+        if self.conversion in ("BGR2RGB", "RGB2BGR"):
+            return img[:, :, ::-1]
+        from PIL import Image
+        mode_in, mode_out = (("RGB", "HSV")
+                             if self.conversion == "RGB2HSV"
+                             else ("HSV", "RGB"))
+        pil = Image.fromarray(img.astype(np.uint8), mode=mode_in)
+        return np.asarray(pil.convert(mode_out), np.float32)
+
+
+class PipelineImageTransform(ImageTransform):
+    """≡ transform.PipelineImageTransform: a chain of transforms, each
+    optionally gated by a probability — pass plain transforms or
+    (transform, probability) pairs; shuffle=True applies them in a random
+    order per image (the reference's shuffle flag)."""
+
+    def __init__(self, *transforms, shuffle=False):
+        self.transforms = [t if isinstance(t, tuple) else (t, 1.0)
+                           for t in transforms]
+        self.shuffle = bool(shuffle)
+
+    def transform(self, img, rng):
+        order = list(range(len(self.transforms)))
+        if self.shuffle:
+            rng.shuffle(order)
+        for i in order:
+            t, prob = self.transforms[i]
+            if prob >= 1.0 or rng.random() < prob:
+                img = t.transform(img, rng)
         return img
 
 

@@ -577,9 +577,10 @@ class _IdentityAlias:
         return xs[0]
 
     def feed_forward_mask(self, *parent_masks):
-        # Flatten/Reshape collapse the axis a (B, T) mask indexes — a
-        # stale mask downstream would zero the wrong positions
-        return None
+        # the alias is a pure identity (Reshape/InputLayer): the tensor
+        # and its time axis are unchanged, so the mask stays valid
+        # (Flatten, which collapses the masked axis, has _FlattenVertex)
+        return next((m for m in parent_masks if m is not None), None)
 
 
 class _FlattenVertex:

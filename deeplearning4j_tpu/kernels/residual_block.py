@@ -1,0 +1,115 @@
+"""Pallas fused ResNet bottleneck block — the round-5 pass-removal
+experiment (VERDICT r4 weak #3 / BENCH.md "remaining headroom").
+
+Hypothesis: the measured ResNet-50 step runs at ~95% of the HBM bound for
+the graph XLA BUILT, but that graph still round-trips every intermediate
+activation of each bottleneck block through HBM. One kernel that keeps
+the whole block's intermediates in VMEM — batch-tiled, weights resident —
+reads x once and writes the output once:
+
+    h1 = relu(x @ W1 + b1)            (1x1 reduce,  C -> M)
+    h2 = relu(conv3x3(h1, W2) + b2)   (9 shifted GEMMs, M -> M)
+    y  = relu(h2 @ W3 + b3 + x)       (1x1 expand,  M -> C, residual)
+
+HBM traffic per block ≈ |x| + |y| + |W| instead of XLA's
+|x|·2 + |h1|·2 + |h2|·2 + |y| (+ the residual re-read) — roughly 2x less
+for the 14x14x1024/256 stage shape. BN is assumed FOLDED into the conv
+scale/bias (inference form — the standard deployment transform); the
+training-step integration would additionally need the custom-VJP
+treatment pointwise_conv.py gives the 1x1+BN pair.
+
+The on-chip A/B against the identical XLA composition is exp_tpu_r5.py;
+correctness (exact equality vs the XLA reference) is
+tests/test_kernels.py on the interpret path.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _default_interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _block_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref, b3_ref,
+                  o_ref):
+    x = x_ref[...]                                  # (bt, H, W, C)
+    bt, h, w, c = x.shape
+    mid = w1_ref.shape[1]
+    xf = x.reshape(bt * h * w, c)
+    h1 = jnp.maximum(
+        jnp.dot(xf, w1_ref[...], preferred_element_type=jnp.float32)
+        + b1_ref[...], 0.0).astype(x.dtype)
+    h1 = h1.reshape(bt, h, w, mid)
+    h1p = jnp.pad(h1, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    acc = jnp.zeros((bt * h * w, mid), jnp.float32)
+    for dy in range(3):                             # 9 shifted GEMMs ==
+        for dx in range(3):                         # SAME 3x3 conv
+            win = h1p[:, dy:dy + h, dx:dx + w, :].reshape(bt * h * w, mid)
+            acc += jnp.dot(win, w2_ref[dy, dx],
+                           preferred_element_type=jnp.float32)
+    h2 = jnp.maximum(acc + b2_ref[...], 0.0).astype(x.dtype)
+    h3 = jnp.dot(h2, w3_ref[...], preferred_element_type=jnp.float32) \
+        + b3_ref[...]
+    y = jnp.maximum(h3.reshape(bt, h, w, c) + x.astype(jnp.float32), 0.0)
+    o_ref[...] = y.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_b", "interpret"))
+def _run(x, w1, b1, w2, b2, w3, b3, block_b, interpret):
+    b, h, w, c = x.shape
+    mid = w1.shape[1]
+    grid = (b // block_b,)
+    return pl.pallas_call(
+        _block_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_b, h, w, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((c, mid), lambda i: (0, 0)),
+            pl.BlockSpec((1, mid), lambda i: (0, 0)),
+            pl.BlockSpec((3, 3, mid, mid), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((1, mid), lambda i: (0, 0)),
+            pl.BlockSpec((mid, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_b, h, w, c), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, c), x.dtype),
+        interpret=interpret,
+    )(x, w1, b1, w2, b2, w3, b3)
+
+
+def bottleneck_block(x, w1, b1, w2, b2, w3, b3, block_b=8, interpret=None):
+    """Fused bottleneck forward. x (B,H,W,C) NHWC; w1 (C,M), w2 (3,3,M,M),
+    w3 (M,C); biases (M,)/(M,)/(C,) — BN folded. B % block_b == 0."""
+    if interpret is None:
+        interpret = _default_interpret()
+    b = x.shape[0]
+    if b % block_b:
+        raise ValueError(f"batch {b} not divisible by block_b={block_b}")
+    return _run(x, w1, b1.reshape(1, -1), w2, b2.reshape(1, -1), w3,
+                b3.reshape(1, -1), block_b, interpret)
+
+
+def bottleneck_block_xla(x, w1, b1, w2, b2, w3, b3):
+    """The identical math as plain XLA ops (the A/B baseline and the
+    correctness oracle)."""
+    dn = ("NHWC", "HWIO", "NHWC")
+    h1 = jax.nn.relu(
+        jax.lax.conv_general_dilated(
+            x, w1[None, None].astype(x.dtype), (1, 1), "SAME",
+            dimension_numbers=dn,
+            preferred_element_type=jnp.float32) + b1)
+    h1 = h1.astype(x.dtype)
+    h2 = jax.nn.relu(
+        jax.lax.conv_general_dilated(
+            h1, w2.astype(x.dtype), (1, 1), "SAME", dimension_numbers=dn,
+            preferred_element_type=jnp.float32) + b2)
+    h2 = h2.astype(x.dtype)
+    h3 = jax.lax.conv_general_dilated(
+        h2, w3[None, None].astype(x.dtype), (1, 1), "SAME",
+        dimension_numbers=dn, preferred_element_type=jnp.float32) + b3
+    return jax.nn.relu(h3 + x.astype(jnp.float32)).astype(x.dtype)

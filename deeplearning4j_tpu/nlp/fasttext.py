@@ -76,6 +76,11 @@ class FastText(Word2Vec):
             self._max_n = int(v); return self
 
         def build(self):
+            if getattr(self, "_hs", False):
+                raise ValueError(
+                    "FastText's subword step trains negative sampling; "
+                    "useHierarchicSoftmax is supported on "
+                    "Word2Vec/SequenceVectors (the shared SGNS pipeline)")
             return FastText(self)
 
     def __init__(self, builder):

@@ -93,6 +93,11 @@ class ParagraphVectors(Word2Vec):
             return self
 
         def build(self):
+            if getattr(self, "_hs", False):
+                raise ValueError(
+                    "ParagraphVectors trains PV-DBOW/PV-DM with negative "
+                    "sampling; useHierarchicSoftmax is supported on "
+                    "Word2Vec/SequenceVectors (the shared SGNS pipeline)")
             return ParagraphVectors(self)
 
     def __init__(self, builder):

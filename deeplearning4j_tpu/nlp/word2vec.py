@@ -22,6 +22,78 @@ from deeplearning4j_tpu.nlp.tokenization import (CollectionSentenceIterator,
 from deeplearning4j_tpu.nlp.vocab import VocabCache, build_vocab
 
 
+def _build_huffman(counts):
+    """Huffman tree over word counts (≡ the reference's
+    VocabConstructor/Huffman pass) -> per-word padded path tables:
+    points (V, L) int32 inner-node ids root-first, codes (V, L) float32
+    binary codes, mask (V, L) float32 validity. Frequent words get short
+    codes (prefix-free by construction)."""
+    import heapq
+
+    v = len(counts)
+    if v <= 1:
+        return (np.zeros((v, 1), np.int32), np.zeros((v, 1), np.float32),
+                np.zeros((v, 1), np.float32))
+    heap = [(int(c), i) for i, c in enumerate(counts)]
+    heapq.heapify(heap)
+    parent, side = {}, {}
+    nxt = v
+    while len(heap) > 1:
+        c1, n1 = heapq.heappop(heap)
+        c2, n2 = heapq.heappop(heap)
+        parent[n1], parent[n2] = nxt, nxt
+        side[n1], side[n2] = 0, 1
+        heapq.heappush(heap, (c1 + c2, nxt))
+        nxt += 1
+    root = heap[0][1]
+    paths, codes = [], []
+    max_len = 1
+    for w in range(v):
+        p, c = [], []
+        node = w
+        while node != root:
+            c.append(side[node])
+            p.append(parent[node] - v)      # inner-node id, 0..V-2
+            node = parent[node]
+        p.reverse()
+        c.reverse()
+        paths.append(p)
+        codes.append(c)
+        max_len = max(max_len, len(p))
+    points = np.zeros((v, max_len), np.int32)
+    cod = np.zeros((v, max_len), np.float32)
+    mask = np.zeros((v, max_len), np.float32)
+    for w in range(v):
+        n = len(paths[w])
+        points[w, :n] = paths[w]
+        cod[w, :n] = codes[w]
+        mask[w, :n] = 1.0
+    return points, cod, mask
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _hs_step(params, lr, center, context, points, codes, mask, weights):
+    """One hierarchical-softmax SGD step (≡ the reference's
+    HierarchicSoftmax learning algorithm), batched: every pair touches
+    only its context word's ~log2(V) Huffman inner nodes, gathered as one
+    (B, L, D) read — the batched-hardware-native form of the JVM's
+    per-node scalar loop."""
+
+    def loss_fn(p):
+        v = p["syn0"][center]                       # (B, D)
+        pts = points[context]                       # (B, L)
+        u = p["syn1"][pts]                          # (B, L, D)
+        s = jnp.einsum("bd,bld->bl", v, u)
+        sign = 1.0 - 2.0 * codes[context]
+        ll = jax.nn.log_sigmoid(sign * s) * mask[context]
+        denom = jnp.maximum(weights.sum(), 1.0)
+        return -jnp.sum(ll.sum(-1) * weights) / denom
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+    return params, loss
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _sgns_step(params, lr, center, context, negatives, weights):
     """One skip-gram-negative-sampling SGD step (whole batch, one XLA exec).
@@ -107,6 +179,7 @@ class Word2Vec(WordVectors):
             self._window = 5
             self._lr = 0.025
             self._negative = 5
+            self._hs = False
             self._sample = 1e-3
             self._batch = 1024
             self._iter = None
@@ -135,6 +208,12 @@ class Word2Vec(WordVectors):
 
         def negativeSample(self, v):
             self._negative = int(v); return self
+
+        def useHierarchicSoftmax(self, flag=True):
+            """≡ Word2Vec.Builder.useHierarchicSoftmax: train against the
+            Huffman-tree output layer instead of negative sampling (each
+            pair updates its context's ~log2(V) inner nodes)."""
+            self._hs = bool(flag); return self
 
         def sampling(self, v):
             self._sample = float(v); return self
@@ -175,7 +254,15 @@ class Word2Vec(WordVectors):
         v, d = self.vocab.numWords(), self.b._layer_size
         key = jax.random.PRNGKey(self.b._seed)
         syn0 = (jax.random.uniform(key, (v, d), jnp.float32) - 0.5) / d
-        self.params = {"syn0": syn0, "syn1": jnp.zeros((v, d), jnp.float32)}
+        # hierarchical softmax trains V-1 inner-node vectors instead of
+        # per-word output vectors
+        rows = max(v - 1, 1) if self.b._hs else v
+        self.params = {"syn0": syn0,
+                       "syn1": jnp.zeros((rows, d), jnp.float32)}
+        if self.b._hs:
+            pts, codes, mask = _build_huffman(self.vocab.counts)
+            self._hs_tables = (jnp.asarray(pts), jnp.asarray(codes),
+                               jnp.asarray(mask))
 
     def _pairs(self, sentences_ids):
         """Skip-gram pairs with dynamic window + subsampling (host side)."""
@@ -215,19 +302,29 @@ class Word2Vec(WordVectors):
             [np.ones(n, np.float32), np.zeros(pad, np.float32)])
         centers = np.concatenate([centers, np.zeros(pad, np.int32)])
         contexts = np.concatenate([contexts, np.zeros(pad, np.int32)])
-        negs = self._rng.choice(self.vocab.numWords(),
-                                size=(len(centers), K),
-                                p=neg_p).astype(np.int32)
+        if getattr(self.b, "_hs", False):   # HS path never reads them
+            negs = np.zeros((len(centers), 1), np.int32)
+        else:
+            negs = self._rng.choice(self.vocab.numWords(),
+                                    size=(len(centers), K),
+                                    p=neg_p).astype(np.int32)
         for s in range(0, len(centers), B):
             yield (centers[s:s + B], contexts[s:s + B],
                    negs[s:s + B], weights[s:s + B])
 
     def _run_epochs(self, centers_contexts_fn, epochs):
+        hs = getattr(self.b, "_hs", False)
         for _ in range(epochs):
             centers, contexts = centers_contexts_fn()
             for cen, ctx, negs, w in self._batches(centers, contexts):
-                self.params, _ = _sgns_step(self.params, self.b._lr,
-                                            cen, ctx, negs, w)
+                if hs:
+                    pts, codes, mask = self._hs_tables
+                    self.params, _ = _hs_step(self.params, self.b._lr,
+                                              cen, ctx, pts, codes, mask,
+                                              w)
+                else:
+                    self.params, _ = _sgns_step(self.params, self.b._lr,
+                                                cen, ctx, negs, w)
 
     def fit(self):
         toks = self._tokenized()

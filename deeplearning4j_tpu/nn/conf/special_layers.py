@@ -473,6 +473,14 @@ class PermuteLayer(Layer):
         from deeplearning4j_tpu.nn.conf.inputs import (Convolutional3DType,
                                                        RecurrentType)
         if isinstance(input_type, RecurrentType):
+            if new[1] is None:
+                # time moved into the feature axis: downstream nIn
+                # inference needs a static length
+                raise ValueError(
+                    f"PermuteLayer '{self.name}': permuting the time axis "
+                    "into the feature position needs a known "
+                    "timeSeriesLength — use "
+                    "InputType.recurrent(size, timeSeriesLength)")
             return InputType.recurrent(new[1], new[0])
         if isinstance(input_type, Convolutional3DType):
             return InputType.convolutional3D(new[0], new[1], new[2], new[3])

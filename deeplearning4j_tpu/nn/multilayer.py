@@ -455,6 +455,7 @@ class MultiLayerNetwork:
                                     self._state, xs, ys, fms, lms,
                                     jnp.stack(subs))
         self._last_features = group[-1][0]
+        self._params_version = getattr(self, "_params_version", 0) + 1
         for loss in jax.device_get(losses):
             self._score = float(loss)
             self._iteration += 1
@@ -533,8 +534,11 @@ class MultiLayerNetwork:
         self._iteration += 1
         # most recent training batch, for listeners that inspect
         # activations (StatsListener histograms — ≡ the reference
-        # dashboard's activation charts over the last minibatch)
+        # dashboard's activation charts over the last minibatch);
+        # _params_version counts REAL updates (the scanned path fires k
+        # listener calls per single update)
         self._last_features = x
+        self._params_version = getattr(self, "_params_version", 0) + 1
         for listener in self._listeners:
             listener.iterationDone(self, self._iteration, self._epoch)
 

@@ -114,7 +114,9 @@ async function tick(){
     el.innerHTML = td.points.map((p,i)=>`<circle
       cx="${P+(p[0]-xmin)/(xmax-xmin||1)*(W-2*P)}"
       cy="${H-P-(p[1]-ymin)/(ymax-ymin||1)*(H-2*P)}" r="2.5"
-      fill="${COLORS[lset.indexOf(labs[i]) % COLORS.length]}"/>`).join("");
+      fill="${lset.length ?
+        COLORS[((lset.indexOf(labs[i]) % COLORS.length) + COLORS.length)
+               % COLORS.length] : COLORS[0]}"/>`).join("");
     document.getElementById('tsnemeta').textContent =
       `${td.points.length} points` + (lset.length>1 ?
       ` · classes: ${lset.join(", ")}` : "");

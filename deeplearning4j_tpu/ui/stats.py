@@ -61,15 +61,22 @@ class StatsListener(TrainingListener):
     Both can be disabled for minimal overhead."""
 
     def __init__(self, storage=None, frequency=1, collectRatios=True,
-                 collectActivations=True, histogramBins=20):
+                 collectActivations=True, activationFrequency=10,
+                 histogramBins=20):
         self.storage = storage if storage is not None \
             else InMemoryStatsStorage()
         self.frequency = max(1, int(frequency))
         self.collectRatios = bool(collectRatios)
         self.collectActivations = bool(collectActivations)
+        # histograms cost an extra forward + host transfer: collect every
+        # activationFrequency-th RECORD (first record included) so the
+        # default overhead is ~1/10 of a forward pass, not 1x
+        self.activationFrequency = max(1, int(activationFrequency))
         self.histogramBins = int(histogramBins)
         self._last_time = None
         self._prev_params = None
+        self._record_idx = 0
+        self._params_version_seen = None
 
     def _flat_params(self, model):
         """ONE device->host transfer of the parameter set; summaries and
@@ -144,11 +151,23 @@ class StatsListener(TrainingListener):
             "iterationTimeMs": dt_ms,
             "params": self._param_summaries(flat),
         }
-        if self.collectRatios:
+        # scanned fit() (stepsPerDispatch=k) fires k iterationDone calls
+        # after ONE real param update; _params_version marks actual
+        # updates so the k-1 inner records don't log zero ratios and
+        # duplicate histograms
+        version = getattr(model, "_params_version", None)
+        params_fresh = version is None or \
+            version != self._params_version_seen
+        self._params_version_seen = version
+        if self.collectRatios and params_fresh:
             record["updateRatios"] = self._update_ratios(flat)
-        if self.collectActivations:
-            record["activationHistograms"] = \
-                self._activation_histograms(model)
+        if self.collectActivations and params_fresh:
+            # count FRESH records only, so the rate stays one histogram
+            # per activationFrequency real updates under scanned fit too
+            if self._record_idx % self.activationFrequency == 0:
+                record["activationHistograms"] = \
+                    self._activation_histograms(model)
+            self._record_idx += 1
         self.storage.put(record)
 
     # -- convenience ------------------------------------------------------

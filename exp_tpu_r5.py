@@ -1,0 +1,139 @@
+"""Round-5 on-chip experiments — ONE serialized chip session per mode.
+
+Follows the tunnel-safety pattern (tests/conftest.py + bench.py): the
+process sets its own internal deadline and ALWAYS exits on its own —
+never SIGKILL a TPU-holding process, never run two TPU consumers
+concurrently.
+
+Modes:
+  resblock — the pass-removal A/B (VERDICT r4 weak #3): fused Pallas
+             bottleneck vs the identical XLA composition at the
+             ResNet-50 stage-3 shape (B=256, 14x14, 1024/256, bf16),
+             plus a smaller stage-4-like shape. Forward pass (BN folded,
+             inference form) — the traffic hypothesis test.
+  tsne     — t-SNE N>=20k on-chip smoke (VERDICT r4 weak #4 done
+             criterion): row-blocked passes at N=20k and N=30k.
+
+Prints '##'-prefixed JSON lines.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("DL4J_TPU_WANT_TPU", "1")  # explicit chip opt-in
+
+DEADLINES = {"resblock": 900, "tsne": 900}
+
+
+def _emit(obj):
+    print("## " + json.dumps(obj), flush=True)
+
+
+def _install_deadline(seconds):
+    def bail():
+        time.sleep(seconds)
+        print(f"## DEADLINE {seconds}s — clean exit", flush=True)
+        os._exit(9)
+    threading.Thread(target=bail, daemon=True).start()
+
+
+def _sync(x):
+    import numpy as np
+    return float(np.asarray(x).ravel()[0])
+
+
+def mode_resblock():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.kernels.residual_block import (
+        bottleneck_block, bottleneck_block_xla)
+    from deeplearning4j_tpu.util.hostkey import enable_compile_cache
+    enable_compile_cache(os.path.dirname(os.path.abspath(__file__)))
+
+    rng = np.random.default_rng(0)
+    shapes = [  # (B, H, W, C, M, block_b) — ResNet-50 stage 3 and 4
+        (256, 14, 14, 1024, 256, 8),
+        (256, 7, 7, 2048, 512, 16),
+    ]
+    for B, H, W, C, M, bb in shapes:
+        x = jnp.asarray(rng.normal(size=(B, H, W, C)), jnp.bfloat16)
+        w1 = jnp.asarray(rng.normal(size=(C, M)) * 0.05, jnp.bfloat16)
+        w2 = jnp.asarray(rng.normal(size=(3, 3, M, M)) * 0.05, jnp.bfloat16)
+        w3 = jnp.asarray(rng.normal(size=(M, C)) * 0.05, jnp.bfloat16)
+        b1 = jnp.zeros((M,), jnp.float32)
+        b2 = jnp.zeros((M,), jnp.float32)
+        b3 = jnp.zeros((C,), jnp.float32)
+        args = (x, w1, b1, w2, b2, w3, b3)
+
+        fused = jax.jit(lambda *a: bottleneck_block(*a, block_b=bb,
+                                                    interpret=False))
+        plain = jax.jit(bottleneck_block_xla)
+        row = {"shape": [B, H, W, C, M], "block_b": bb}
+        for name, fn in (("xla", plain), ("pallas", fused)):
+            try:
+                t0 = time.perf_counter()
+                y = fn(*args)
+                _sync(y[0, 0, 0, :1])
+                row[f"{name}_compile_s"] = round(time.perf_counter() - t0, 1)
+                steps = 30
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    y = fn(*args)
+                _sync(y[0, 0, 0, :1])
+                ms = (time.perf_counter() - t0) / steps * 1e3
+                row[f"{name}_ms"] = round(ms, 3)
+            except Exception as e:  # noqa: BLE001 — record, keep going
+                row[f"{name}_error"] = str(e)[:300]
+        if "pallas_ms" in row and "xla_ms" in row:
+            row["speedup_vs_xla"] = round(row["xla_ms"] / row["pallas_ms"],
+                                          3)
+            # correctness on-chip (bf16: loose tolerance)
+            ya = np.asarray(plain(*args), np.float32)
+            yb = np.asarray(fused(*args), np.float32)
+            denom = np.abs(ya).max() or 1.0
+            row["max_rel_err"] = float(np.abs(ya - yb).max() / denom)
+        _emit(row)
+
+
+def mode_tsne():
+    import numpy as np
+
+    from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne
+    from deeplearning4j_tpu.util.hostkey import enable_compile_cache
+    enable_compile_cache(os.path.dirname(os.path.abspath(__file__)))
+
+    for n, iters in ((20_000, 50), (30_000, 20)):
+        rng = np.random.RandomState(0)
+        x = np.concatenate([rng.randn(n // 2, 16),
+                            rng.randn(n // 2, 16) + 8]).astype(np.float32)
+        t0 = time.perf_counter()
+        try:
+            t = (BarnesHutTsne.Builder().setMaxIter(iters).perplexity(30)
+                 .seed(0).rowBlockSize(4096).build())
+            emb = t.fit(x).getData()
+            _emit({"tsne_n": n, "iters": iters,
+                   "wall_s": round(time.perf_counter() - t0, 1),
+                   "finite": bool(np.isfinite(emb).all()),
+                   "shape": list(emb.shape)})
+        except Exception as e:  # noqa: BLE001
+            _emit({"tsne_n": n, "error": str(e)[:300]})
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "resblock"
+    _install_deadline(DEADLINES.get(mode, 900))
+    import jax
+    dev = jax.devices()[0]
+    _emit({"mode": mode, "device": str(dev), "platform": dev.platform})
+    {"resblock": mode_resblock, "tsne": mode_tsne}[mode]()
+    _emit({"mode": mode, "done": True})
+
+
+if __name__ == "__main__":
+    main()

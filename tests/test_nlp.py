@@ -489,3 +489,60 @@ class TestVectorizers:
         a = (TfidfVectorizer.Builder().build()).fitTransform(self.DOCS)
         v = TfidfVectorizer.Builder().iterate(self.DOCS).build().fit()
         np.testing.assert_array_equal(a, v.transformAll(self.DOCS))
+
+
+class TestHierarchicalSoftmax:
+    """Round-5 (≡ Word2Vec.Builder.useHierarchicSoftmax /
+    HierarchicSoftmax): Huffman-tree output layer as the batched
+    (B, L, D)-gather form."""
+
+    def test_huffman_codes_prefix_free_and_frequency_ordered(self):
+        from deeplearning4j_tpu.nlp.word2vec import _build_huffman
+        counts = [100, 50, 20, 10, 5, 2, 1]
+        points, codes, mask = _build_huffman(counts)
+        lens = mask.sum(-1).astype(int)
+        # most frequent word gets the (joint-)shortest code
+        assert lens[0] == lens.min()
+        assert lens[-1] == lens.max()
+        # prefix-free: no word's code is a prefix of another's
+        sigs = []
+        for w in range(len(counts)):
+            sigs.append(tuple(codes[w, :lens[w]].astype(int)))
+        for a in sigs:
+            for b in sigs:
+                if a is not b:
+                    assert a[:len(b)] != b or a == b
+        # inner-node ids within range (V-1 nodes)
+        assert points.max() < len(counts) - 1
+        # expected total: sum(len*count) is the Huffman-optimal cost
+        assert int((lens * np.asarray(counts)).sum()) == \
+            sum(c * l for c, l in zip(counts, lens))
+
+    def test_hs_word2vec_learns_topics(self):
+        model = (Word2Vec.Builder()
+                 .minWordFrequency(1).layerSize(32).seed(7).windowSize(3)
+                 .epochs(4).useHierarchicSoftmax(True).sampling(0)
+                 .learningRate(0.08).batchSize(512)
+                 .iterate(CollectionSentenceIterator(synthetic_corpus()))
+                 .tokenizerFactory(DefaultTokenizerFactory())
+                 .build().fit())
+        assert model.params["syn1"].shape[0] == model.vocabSize() - 1
+        assert model.similarity("cat", "dog") > model.similarity("cat",
+                                                                 "gpu")
+        assert model.similarity("cpu", "ram") > model.similarity("cpu",
+                                                                 "cow")
+
+    def test_hs_single_word_vocab_safe(self):
+        from deeplearning4j_tpu.nlp.word2vec import _build_huffman
+        points, codes, mask = _build_huffman([5])
+        assert mask.sum() == 0   # no inner nodes, empty path
+
+
+def test_hs_rejected_on_ns_only_models():
+    from deeplearning4j_tpu.nlp import FastText, ParagraphVectors
+    with pytest.raises(ValueError, match="useHierarchicSoftmax"):
+        (ParagraphVectors.Builder().useHierarchicSoftmax(True)
+         .iterate([("d0", "a b c")]).build())
+    with pytest.raises(ValueError, match="useHierarchicSoftmax"):
+        (FastText.Builder().useHierarchicSoftmax(True)
+         .iterate(CollectionSentenceIterator(["a b c"])).build())
