@@ -18,9 +18,13 @@ registerSerializableOp(opname, builder) — the same contract the
 reference applies to custom-op import (builder must be registered in the
 loading process too).
 
-Control-flow nodes (if/while/scan/for) capture USER callables — the
-reference serializes those as nested sub-graphs; here they are documented
-non-serializable and save() raises an actionable error naming them.
+Control flow serializes through the *Graph forms (SameDiff.ifCondGraph /
+whileLoopGraph / scanLoopGraph / forLoopGraph): branch/body logic is a
+SameDiff SUB-graph whose doc travels inline in the node's params — the
+same nested encoding the reference's FlatBuffers uses. The plain-callable
+forms (ifCond/whileLoop/...) capture arbitrary USER Python and stay
+documented non-serializable; save() raises an actionable error naming
+them and pointing at the *Graph forms.
 """
 from __future__ import annotations
 
@@ -55,6 +59,15 @@ def registerSerializableOp(opname, builder):
 
 def build_fn(opname, params):
     b = OP_BUILDERS.get(opname)
+    if b is None and opname.split(".")[0] in ("onnx", "tf"):
+        # importer builders register at module import; pull the provider
+        # in on demand (covers nested control-flow sub-graphs too)
+        import importlib
+        importlib.import_module(
+            "deeplearning4j_tpu.autodiff."
+            + {"onnx": "onnx_import", "tf": "tf_import"}[
+                opname.split(".")[0]])
+        b = OP_BUILDERS.get(opname)
     if b is None:
         raise KeyError(
             f"no builder registered for op {opname!r} — "
@@ -243,6 +256,144 @@ def _b_random_bernoulli(seed, shape, p=0.5):
         jax.random.PRNGKey(seed), p, _t(shape)).astype(jnp.float32)
 
 
+# -- nested graph docs (serializable control flow rides on these) ---------
+def graph_doc(sd, inline_values=False):
+    """The JSON node table for a graph. inline_values=True embeds every
+    VARIABLE/CONSTANT array as base64 (for SUB-graphs nested inside a
+    control-flow node's params — the top-level artifact keeps values in
+    the npz leg instead)."""
+    import base64
+
+    nodes = []
+    for name, v in sd._nodes.items():
+        nodes.append({
+            "name": name,
+            "vtype": v.vtype,
+            "shape": list(v.shape) if v.shape is not None else None,
+            "opname": getattr(v, "opname", None),
+            "params": getattr(v, "params", None),
+            "inputs": list(v.inputs),
+        })
+    doc = {"counter": sd._counter, "loss_names": list(sd._loss_names),
+           "nodes": nodes}
+    if inline_values:
+        vals = {}
+        for k, arr in sd._values.items():
+            a = np.asarray(arr)
+            # dtype.str keeps byte order ('<f4'): the inline leg must
+            # stay endian-safe like the npz leg
+            vals[k] = {"dtype": a.dtype.str, "shape": list(a.shape),
+                       "b64": base64.b64encode(a.tobytes()).decode()}
+        doc["values"] = vals
+    return doc
+
+
+def graph_from_doc(doc):
+    """Rebuild a SameDiff from a graph_doc (values from the inline base64
+    leg when present)."""
+    import base64
+
+    from deeplearning4j_tpu.autodiff.samediff import (SameDiff, SDVariable,
+                                                      VariableType)
+
+    sd = SameDiff()
+    sd._counter = int(doc.get("counter", 0))
+    sd._loss_names = list(doc.get("loss_names", []))
+    for nd in doc["nodes"]:
+        name, vtype = nd["name"], nd["vtype"]
+        shape = tuple(nd["shape"]) if nd["shape"] is not None else None
+        if vtype == VariableType.ARRAY:
+            fn = build_fn(nd["opname"], nd.get("params"))
+            v = SDVariable(sd, name, vtype, shape, fn, nd["inputs"])
+            v.opname = nd["opname"]
+            v.params = nd.get("params")
+            v.serializable = True
+        else:
+            v = SDVariable(sd, name, vtype, shape)
+        sd._nodes[name] = v
+    for k, spec in (doc.get("values") or {}).items():
+        arr = np.frombuffer(base64.b64decode(spec["b64"]),
+                            np.dtype(spec["dtype"])).reshape(spec["shape"])
+        sd._values[k] = jnp.asarray(arr)
+    return sd
+
+
+def _subgraph_runner(doc, out_names):
+    """Compile a nested graph doc to fn(input_dict) -> {out: array}."""
+    sub = graph_from_doc(doc)
+    run = sub._make_exec(tuple(out_names))
+    values = sub._values
+
+    def call(placeholders):
+        return run(values, placeholders)
+    return call
+
+
+# -- serializable control flow (≡ the reference's FlatBuffers form, where
+# If/While bodies persist as nested sub-graphs). The *Graph control-flow
+# API on SameDiff passes its branch/body GRAPHS here as inline docs.
+@op_builder("samediff.if")
+def _b_if(true_graph, false_graph, input_names, output):
+    t = _subgraph_runner(true_graph, [output])
+    f = _subgraph_runner(false_graph, [output])
+
+    def fn(pred, *arrs):
+        env = dict(zip(input_names, arrs))
+        return jax.lax.cond(jnp.reshape(pred, ()).astype(bool),
+                            lambda e: t(e)[output],
+                            lambda e: f(e)[output], env)
+    return fn
+
+
+@op_builder("samediff.while")
+def _b_while(cond_graph, body_graph, state_names, cond_out, body_outs):
+    c = _subgraph_runner(cond_graph, [cond_out])
+    b = _subgraph_runner(body_graph, list(body_outs))
+
+    def fn(*arrs):
+        def cond(vs):
+            env = dict(zip(state_names, vs))
+            return jnp.reshape(c(env)[cond_out], ()).astype(bool)
+
+        def body(vs):
+            env = dict(zip(state_names, vs))
+            outs = b(env)
+            return tuple(outs[o] for o in body_outs)
+        return jax.lax.while_loop(cond, body, tuple(arrs))
+    return fn
+
+
+@op_builder("samediff.scan")
+def _b_scan(body_graph, carry_name, x_name, carry_out, y_out):
+    b = _subgraph_runner(body_graph, [carry_out, y_out])
+
+    def fn(c0, xs):
+        def body(c, x):
+            outs = b({carry_name: c, x_name: x})
+            return outs[carry_out], outs[y_out]
+        return jax.lax.scan(body, c0, xs)
+    return fn
+
+
+@op_builder("samediff.for")
+def _b_for(body_graph, n_iters, index_name, state_names, body_outs):
+    b = _subgraph_runner(body_graph, list(body_outs))
+
+    def fn(*arrs):
+        def body(i, vs):
+            env = dict(zip(state_names, vs))
+            env[index_name] = jnp.asarray(i, jnp.int32)
+            outs = b(env)
+            return tuple(outs[o] for o in body_outs)
+        return jax.lax.fori_loop(0, int(n_iters), body, tuple(arrs))
+    return fn
+
+
+@op_builder("tuple_get")
+def _b_tuple_get(i):
+    return lambda t: t[i]
+
+
 # -- persistence ----------------------------------------------------------
 def save_samediff(sd, path, values_only=False):
     """Write the zip artifact. Raises on non-serializable nodes (control
@@ -266,28 +417,19 @@ def save_samediff(sd, path, values_only=False):
         raise ValueError(
             "SameDiff.save: graph contains ops with no registered "
             f"builder: {bad[:8]}{'...' if len(bad) > 8 else ''} — "
-            "control-flow nodes (if/while/scan/for) and ad-hoc callables "
-            "are not serializable; for custom ops call "
-            "autodiff.graph_serde.registerSerializableOp(opname, builder) "
-            "in both the saving and loading process, or checkpoint the "
-            "weights alone with save(path, values_only=True)")
+            "ad-hoc callables (including the plain ifCond/whileLoop/"
+            "scanLoop/forLoop forms) are not serializable. Options: "
+            "rebuild control flow with the *Graph forms (ifCondGraph/"
+            "whileLoopGraph/scanLoopGraph/forLoopGraph — sub-graphs "
+            "serialize inline), registerSerializableOp(opname, builder) "
+            "for custom ops (in both the saving and loading process), or "
+            "checkpoint the weights alone with "
+            "save(path, values_only=True)")
 
-    nodes = []
-    for name, v in sd._nodes.items():
-        nodes.append({
-            "name": name,
-            "vtype": v.vtype,
-            "shape": list(v.shape) if v.shape is not None else None,
-            "opname": getattr(v, "opname", None),
-            "params": getattr(v, "params", None),
-            "inputs": list(v.inputs),
-        })
     tc = sd._training_config
     doc = {
         "format": FORMAT_VERSION,
-        "counter": sd._counter,
-        "loss_names": list(sd._loss_names),
-        "nodes": nodes,
+        **graph_doc(sd),
         "training_config": None if tc is None else {
             "updater": encode(tc.updater) if tc.updater is not None else None,
             "l1": tc.l1, "l2": tc.l2,
@@ -308,9 +450,7 @@ def save_samediff(sd, path, values_only=False):
 def load_samediff(path):
     """Rebuild a SameDiff from the zip artifact in a fresh process: nodes
     from the table (op fns from OP_BUILDERS), values from the npz."""
-    from deeplearning4j_tpu.autodiff.samediff import (SameDiff, SDVariable,
-                                                      TrainingConfig,
-                                                      VariableType)
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
     from deeplearning4j_tpu.util.serde import decode
 
     with zipfile.ZipFile(path) as zf:
@@ -320,30 +460,8 @@ def load_samediff(path):
     if doc.get("format", 0) > FORMAT_VERSION:
         raise ValueError(f"samediff artifact format {doc['format']} is "
                          f"newer than this build ({FORMAT_VERSION})")
-    # builders from the importer modules register at module import —
-    # pull them in on demand so a fresh process can load without knowing
-    # where the graph came from
-    prefixes = {str(nd.get("opname", "")).split(".")[0]
-                for nd in doc["nodes"] if nd.get("opname")}
-    if "onnx" in prefixes:
-        import deeplearning4j_tpu.autodiff.onnx_import  # noqa: F401
-    if "tf" in prefixes:
-        import deeplearning4j_tpu.autodiff.tf_import  # noqa: F401
-    sd = SameDiff()
-    sd._counter = int(doc.get("counter", 0))
-    sd._loss_names = list(doc.get("loss_names", []))
-    for nd in doc["nodes"]:
-        name, vtype = nd["name"], nd["vtype"]
-        shape = tuple(nd["shape"]) if nd["shape"] is not None else None
-        if vtype == VariableType.ARRAY:
-            fn = build_fn(nd["opname"], nd.get("params"))
-            v = SDVariable(sd, name, vtype, shape, fn, nd["inputs"])
-            v.opname = nd["opname"]
-            v.params = nd.get("params")
-            v.serializable = True
-        else:
-            v = SDVariable(sd, name, vtype, shape)
-        sd._nodes[name] = v
+    sd = graph_from_doc(doc)
+    for name in sd._nodes:
         if name in values:
             sd._values[name] = jnp.asarray(values[name])
     tc = doc.get("training_config")

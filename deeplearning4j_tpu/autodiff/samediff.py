@@ -763,6 +763,91 @@ class SameDiff:
                                (lambda i_: lambda t: t[i_])(i), tup)
                 for i in range(n)]
 
+    # -- SERIALIZABLE control flow (round-5, ≡ the reference FlatBuffers
+    # form: If/While bodies persist as nested sub-graphs). Branch/body
+    # logic is expressed as SameDiff GRAPHS whose placeholders are fed by
+    # this graph's tensors — the whole thing saves/loads like any other
+    # op because the sub-graphs travel inline in the node's params. The
+    # plain-callable forms above stay for ad-hoc use (documented
+    # non-serializable). ---------------------------------------------
+    def _graph_params(self, sub):
+        from deeplearning4j_tpu.autodiff.graph_serde import graph_doc
+        bad = [n for n, v in sub._nodes.items()
+               if v.vtype == VariableType.ARRAY
+               and not getattr(v, "serializable", False)]
+        if bad:
+            raise ValueError(
+                f"control-flow sub-graph contains non-serializable ops "
+                f"{bad[:5]} — sub-graphs must use registry ops only "
+                "(the point of the *Graph control-flow forms)")
+        return graph_doc(sub, inline_values=True)
+
+    def ifCondGraph(self, name, pred, inputs, input_names, true_sd,
+                    false_sd, output):
+        """lax.cond with SameDiff sub-graph branches: `inputs` (this
+        graph's SDVariables) feed both branches' placeholders
+        `input_names`; each branch computes node `output`."""
+        inputs = [self._lift(v) for v in inputs]
+        return self._op_named(name, "samediff.if", None, self._lift(pred),
+                              *inputs, params={
+                                  "true_graph": self._graph_params(true_sd),
+                                  "false_graph":
+                                      self._graph_params(false_sd),
+                                  "input_names": list(input_names),
+                                  "output": output})
+
+    def whileLoopGraph(self, name, loop_vars, state_names, cond_sd,
+                       cond_out, body_sd, body_outs):
+        """lax.while_loop with sub-graph condition/body: state slots
+        `state_names` feed both graphs' placeholders; cond computes the
+        scalar `cond_out`, body computes one node per slot (`body_outs`).
+        Returns one SDVariable per final state slot."""
+        loop_vars = [self._lift(v) for v in loop_vars]
+        tup = self._op_named(f"{name}/state", "samediff.while", None,
+                             *loop_vars, params={
+                                 "cond_graph": self._graph_params(cond_sd),
+                                 "body_graph": self._graph_params(body_sd),
+                                 "state_names": list(state_names),
+                                 "cond_out": cond_out,
+                                 "body_outs": list(body_outs)})
+        return [self._op_named(f"{name}/out{i}", "tuple_get", None, tup,
+                               params={"i": i})
+                for i in range(len(loop_vars))]
+
+    def scanLoopGraph(self, name, init, xs, body_sd, carry_name, x_name,
+                      carry_out, y_out):
+        """lax.scan with a sub-graph body mapping placeholders
+        (carry_name, x_name) to nodes (carry_out, y_out). Returns
+        (final_carry, stacked_ys)."""
+        init, xs = self._lift(init), self._lift(xs)
+        tup = self._op_named(f"{name}/state", "samediff.scan", None, init,
+                             xs, params={
+                                 "body_graph": self._graph_params(body_sd),
+                                 "carry_name": carry_name,
+                                 "x_name": x_name,
+                                 "carry_out": carry_out, "y_out": y_out})
+        carry = self._op_named(f"{name}/carry", "tuple_get", None, tup,
+                               params={"i": 0})
+        ys = self._op_named(f"{name}/ys", "tuple_get", None, tup,
+                            params={"i": 1})
+        return carry, ys
+
+    def forLoopGraph(self, name, n_iters, loop_vars, state_names, body_sd,
+                     body_outs, index_name="i"):
+        """lax.fori_loop with a sub-graph body; the iteration index rides
+        in as placeholder `index_name` (int32 scalar)."""
+        loop_vars = [self._lift(v) for v in loop_vars]
+        tup = self._op_named(f"{name}/state", "samediff.for", None,
+                             *loop_vars, params={
+                                 "body_graph": self._graph_params(body_sd),
+                                 "n_iters": int(n_iters),
+                                 "index_name": index_name,
+                                 "state_names": list(state_names),
+                                 "body_outs": list(body_outs)})
+        return [self._op_named(f"{name}/out{i}", "tuple_get", None, tup,
+                               params={"i": i})
+                for i in range(len(loop_vars))]
+
 
     def _total_loss(self, values, placeholders):
         runner = self._make_exec(tuple(self._loss_names))

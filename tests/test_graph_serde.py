@@ -271,3 +271,102 @@ def test_model_guesser_loads_samediff_artifact(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(loaded.outputSingle({"x": xs}, "y").jax()),
         np.asarray(sd.outputSingle({"x": xs}, "y").jax()))
+
+
+class TestSerializableControlFlow:
+    """Round-5: the *Graph control-flow forms persist their sub-graphs
+    inline (≡ the reference FlatBuffers If/While nested-graph encoding)."""
+
+    def test_if_graph_roundtrip(self, tmp_path):
+        t = SameDiff.create()
+        ta = t.placeHolder("a", 3)
+        t.math.exp(ta).rename("out")
+        f = SameDiff.create()
+        fa = f.placeHolder("a", 3)
+        fa.mul(-1.0).rename("out")
+
+        sd = SameDiff.create()
+        v = sd.var("v", np.array([0.5, 1.0, 1.5], np.float32))
+        sd.ifCondGraph("branch", sd.constant("p", np.float32(1.0)), [v],
+                       ["a"], t, f, "out").rename("y")
+        want = np.asarray(sd.outputSingle({}, "y").jax())
+        np.testing.assert_allclose(want, np.exp([0.5, 1.0, 1.5]),
+                                   rtol=1e-6)
+        art = tmp_path / "if.sdz"
+        sd.save(art)   # would previously raise for any control flow
+        got = np.asarray(SameDiff.load(art).outputSingle({}, "y").jax())
+        np.testing.assert_array_equal(got, want)
+
+    def test_while_graph_roundtrip_in_fresh_process(self, tmp_path):
+        cond = SameDiff.create()
+        cn = cond.placeHolder("n", 1)
+        cond.placeHolder("acc", 1)
+        cn.sub(5.0).mul(-1.0).rename("keep")   # keep while n < 5 (n>0...)
+
+        body = SameDiff.create()
+        bn = body.placeHolder("n", 1)
+        bacc = body.placeHolder("acc", 1)
+        bn.add(1.0).rename("n2")
+        bacc.mul(2.0).rename("acc2")
+
+        sd = SameDiff.create()
+        n0 = sd.constant("n0", np.zeros(1, np.float32))
+        a0 = sd.constant("a0", np.ones(1, np.float32))
+        outs = sd.whileLoopGraph("loop", [n0, a0], ["n", "acc"], cond,
+                                 "keep", body, ["n2", "acc2"])
+        outs[1].rename("final")
+        # 5 doublings: acc = 32
+        assert float(np.asarray(sd.outputSingle({}, "final").jax())) == 32.0
+        art = tmp_path / "while.sdz"
+        sd.save(art)
+        got = _subprocess_output(art, np.zeros((1, 1), np.float32),
+                                 "final", tmp_path)
+        assert float(got.ravel()[0]) == 32.0
+
+    def test_scan_graph_roundtrip(self, tmp_path):
+        body = SameDiff.create()
+        c = body.placeHolder("c", 2)
+        x = body.placeHolder("x", 2)
+        c.add(x).rename("c2")
+        c.mul(0.0).add(x).rename("y")   # emit the input
+
+        sd = SameDiff.create()
+        init = sd.constant("init", np.zeros(2, np.float32))
+        xs = sd.var("xs", np.arange(8, dtype=np.float32).reshape(4, 2))
+        carry, ys = sd.scanLoopGraph("s", init, xs, body, "c", "x",
+                                     "c2", "y")
+        carry.rename("carry")
+        want = np.asarray(sd.outputSingle({}, "carry").jax())
+        np.testing.assert_allclose(want, [0 + 2 + 4 + 6, 1 + 3 + 5 + 7])
+        art = tmp_path / "scan.sdz"
+        sd.save(art)
+        sd2 = SameDiff.load(art)
+        np.testing.assert_array_equal(
+            np.asarray(sd2.outputSingle({}, "carry").jax()), want)
+
+    def test_for_graph_roundtrip(self, tmp_path):
+        body = SameDiff.create()
+        s = body.placeHolder("s", 1)
+        i = body.placeHolder("i")
+        s.add(i.add(1.0)).rename("s2")   # accumulate i+1
+
+        sd = SameDiff.create()
+        s0 = sd.constant("s0", np.zeros(1, np.float32))
+        outs = sd.forLoopGraph("f", 4, [s0], ["s"], body, ["s2"])
+        outs[0].rename("total")
+        assert float(np.asarray(
+            sd.outputSingle({}, "total").jax())) == 1 + 2 + 3 + 4
+        art = tmp_path / "for.sdz"
+        sd.save(art)
+        assert float(np.asarray(SameDiff.load(art).outputSingle(
+            {}, "total").jax())) == 10.0
+
+    def test_subgraph_with_adhoc_ops_rejected(self):
+        import jax.numpy as jnp
+        body = SameDiff.create()
+        a = body.placeHolder("a", 1)
+        body._op_named("bad", "custom", lambda t: t * 2, a)
+        sd = SameDiff.create()
+        with pytest.raises(ValueError, match="registry ops"):
+            sd.forLoopGraph("f", 2, [sd.constant("z", np.zeros(1,
+                            np.float32))], ["a"], body, ["bad"])
