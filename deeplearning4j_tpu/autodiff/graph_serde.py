@@ -395,17 +395,32 @@ def _b_tuple_get(i):
 
 
 # -- persistence ----------------------------------------------------------
-def save_samediff(sd, path, values_only=False):
+def _opt_leaves(sd):
+    """Optimizer-state leaves in tree_flatten order — live state if the
+    optimizer ran, else the still-pending leaves a load() carried (so a
+    load -> re-save repack keeps the momenta)."""
+    if sd._opt_state is not None:
+        return jax.tree_util.tree_leaves(sd._opt_state)
+    return getattr(sd, "_pending_opt_leaves", None)
+
+
+def save_samediff(sd, path, values_only=False, save_updater=False):
     """Write the zip artifact. Raises on non-serializable nodes (control
     flow, unregistered custom fns) with the node list in the message;
     values_only=True skips the graph leg entirely (checkpointing for
-    graphs with such nodes — re-build in code, then load_values)."""
+    graphs with such nodes — re-build in code, then load_values);
+    save_updater=True (≡ the reference's saveUpdaterState flag) also
+    persists the optimizer-state leaves so fit() resumes mid-momentum."""
     from deeplearning4j_tpu.autodiff.samediff import VariableType
     from deeplearning4j_tpu.util.serde import encode
 
     if values_only:
+        arrays = {k: np.asarray(v) for k, v in sd._values.items()}
+        if save_updater:
+            for i, leaf in enumerate(_opt_leaves(sd) or []):
+                arrays[f"__updater__{i}"] = np.asarray(leaf)
         buf = io.BytesIO()
-        np.savez(buf, **{k: np.asarray(v) for k, v in sd._values.items()})
+        np.savez(buf, **arrays)
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr(VALUES_NPZ, buf.getvalue())
         return
@@ -437,8 +452,15 @@ def save_samediff(sd, path, values_only=False):
             "dataSetLabelMapping": list(tc.dataSetLabelMapping),
         },
     }
+    arrays = {k: np.asarray(v) for k, v in sd._values.items()}
+    if save_updater:
+        leaves = _opt_leaves(sd)
+        if leaves is not None:
+            doc["updater_state_leaves"] = len(leaves)
+            for i, leaf in enumerate(leaves):
+                arrays[f"__updater__{i}"] = np.asarray(leaf)
     buf = io.BytesIO()
-    np.savez(buf, **{k: np.asarray(v) for k, v in sd._values.items()})
+    np.savez(buf, **arrays)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
         # allow_nan=False: the artifact must stay strict RFC-8259 JSON
         # (readable by jq / other languages) — open bounds etc. must be
@@ -464,6 +486,11 @@ def load_samediff(path):
     for name in sd._nodes:
         if name in values:
             sd._values[name] = jnp.asarray(values[name])
+    n_opt = doc.get("updater_state_leaves")
+    if n_opt:
+        # consumed by _ensure_optimizer once the optax structure exists
+        sd._pending_opt_leaves = [
+            jnp.asarray(values[f"__updater__{i}"]) for i in range(n_opt)]
     tc = doc.get("training_config")
     if tc is not None:
         sd._training_config = TrainingConfig(

@@ -875,6 +875,20 @@ class SameDiff:
             var_names = [v.name for v in self.variables()]
             self._opt_state = self._tx.init(
                 {n: self._values[n] for n in var_names})
+            pending = getattr(self, "_pending_opt_leaves", None)
+            if pending is not None:
+                # save(save_updater=True) artifact: splice the persisted
+                # optimizer-state leaves into the freshly built structure
+                treedef = jax.tree_util.tree_structure(self._opt_state)
+                if treedef.num_leaves != len(pending):
+                    raise ValueError(
+                        f"updater state in artifact has {len(pending)} "
+                        f"leaves but this optimizer has "
+                        f"{treedef.num_leaves} — was the training config "
+                        "changed after load?")
+                self._opt_state = jax.tree_util.tree_unflatten(
+                    treedef, pending)
+                self._pending_opt_leaves = None
 
     @functools.cached_property
     def _fit_step(self):
@@ -956,15 +970,16 @@ class SameDiff:
     # values — restores with no defining source; see graph_serde) --------
     def save(self, path, save_updater=False, values_only=False):
         """Write the self-contained zip artifact (samediff.json +
-        values.npz). save_updater is accepted for reference-API parity;
-        optimizer state is re-initialized after load (set the training
-        config's updater and fit resumes from the saved values).
+        values.npz). save_updater=True (≡ SameDiff.save's
+        saveUpdaterState) also persists the optimizer-state leaves, so a
+        loaded graph's fit() resumes mid-momentum bit-exactly.
 
         values_only=True writes just the values.npz leg — the persistence
-        path for graphs containing non-serializable nodes (control flow,
-        ad-hoc callables): re-build the graph in code and load_values()."""
+        path for graphs containing non-serializable nodes (ad-hoc
+        callables): re-build the graph in code and load_values()."""
         from deeplearning4j_tpu.autodiff.graph_serde import save_samediff
-        save_samediff(self, path, values_only=values_only)
+        save_samediff(self, path, values_only=values_only,
+                      save_updater=save_updater)
 
     @staticmethod
     def load(path):

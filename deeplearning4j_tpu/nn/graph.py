@@ -430,6 +430,8 @@ class ComputationGraph:
             lmasks, sub)
         self._score = float(loss)
         self._iteration += 1
+        self._last_features = ins     # for StatsListener histograms
+        self._params_version = getattr(self, "_params_version", 0) + 1
         for listener in self._listeners:
             listener.iterationDone(self, self._iteration, self._epoch)
 
@@ -478,6 +480,8 @@ class ComputationGraph:
          losses) = self._train_scan(self._params, self._opt_state,
                                     self._state, ins, labels, fmasks,
                                     lmasks, jnp.stack(subs))
+        self._last_features = jax.tree_util.tree_map(lambda a: a[-1], ins)
+        self._params_version = getattr(self, "_params_version", 0) + 1
         for loss in jax.device_get(losses):
             self._score = float(loss)
             self._iteration += 1

@@ -114,14 +114,18 @@ class StatsListener(TrainingListener):
         out = {}
         try:
             acts = ff(x)
-            for i, a in enumerate(acts):
+            if isinstance(acts, dict):   # ComputationGraph: node -> act
+                items = list(acts.items())
+            else:                        # MultiLayerNetwork: per-layer list
+                items = [(f"layer{i}", a) for i, a in enumerate(acts)]
+            for key, a in items:
                 arr = np.asarray(a.jax() if hasattr(a, "jax") else a,
                                  np.float32).ravel()
                 finite = arr[np.isfinite(arr)]
                 if finite.size == 0:   # diverged layer: record, don't die
-                    out[f"layer{i}"] = {"min": 0.0, "max": 0.0,
-                                        "counts": [0] * self.histogramBins,
-                                        "nonFinite": int(arr.size)}
+                    out[key] = {"min": 0.0, "max": 0.0,
+                                "counts": [0] * self.histogramBins,
+                                "nonFinite": int(arr.size)}
                     continue
                 lo, hi = float(finite.min()), float(finite.max())
                 counts, _ = np.histogram(
@@ -130,7 +134,7 @@ class StatsListener(TrainingListener):
                 h = {"min": lo, "max": hi, "counts": counts.tolist()}
                 if finite.size != arr.size:
                     h["nonFinite"] = int(arr.size - finite.size)
-                out[f"layer{i}"] = h
+                out[key] = h
         except Exception:   # noqa: BLE001 — stats must never kill training
             return out
         return out

@@ -370,3 +370,76 @@ class TestSerializableControlFlow:
         with pytest.raises(ValueError, match="registry ops"):
             sd.forLoopGraph("f", 2, [sd.constant("z", np.zeros(1,
                             np.float32))], ["a"], body, ["bad"])
+
+
+def test_save_updater_resumes_bit_exact(tmp_path):
+    """save_updater=True (≡ saveUpdaterState): a loaded graph's fit()
+    continues with the SAME Adam moments — identical trajectory to the
+    uninterrupted run."""
+    def build_and_train(steps):
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", None, 4)
+        w = sd.var("w", np.random.RandomState(0).randn(4, 2).astype(
+            np.float32))
+        y = sd.placeHolder("y", None, 2)
+        sd.loss.meanSquaredError("loss", y, x.mmul(w).rename("pred"))
+        sd.setLossVariables("loss")
+        sd.setTrainingConfig(TrainingConfig(
+            updater=Adam(1e-2), dataSetFeatureMapping=["x"],
+            dataSetLabelMapping=["y"]))
+        rng = np.random.RandomState(1)
+        xs = rng.randn(8, 4).astype(np.float32)
+        ys = rng.randn(8, 2).astype(np.float32)
+        for _ in range(steps):
+            sd.fit(xs, ys)
+        return sd, xs, ys
+
+    # 6 uninterrupted steps = the oracle
+    oracle, xs, ys = build_and_train(6)
+    # 3 steps -> save WITH updater -> load -> 3 more
+    half, _, _ = build_and_train(3)
+    art = tmp_path / "resume.sdz"
+    half.save(art, save_updater=True)
+    resumed = SameDiff.load(art)
+    for _ in range(3):
+        resumed.fit(xs, ys)
+    np.testing.assert_array_equal(np.asarray(resumed._values["w"]),
+                                  np.asarray(oracle._values["w"]))
+    # WITHOUT the updater the moments restart -> trajectory differs
+    half2, _, _ = build_and_train(3)
+    art2 = tmp_path / "noresume.sdz"
+    half2.save(art2)
+    cold = SameDiff.load(art2)
+    for _ in range(3):
+        cold.fit(xs, ys)
+    assert not np.array_equal(np.asarray(cold._values["w"]),
+                              np.asarray(oracle._values["w"]))
+
+
+def test_repack_without_fit_keeps_updater_state(tmp_path):
+    """load -> save (no fit between) must not drop the carried momenta."""
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", None, 3)
+    sd.var("w", np.ones((3, 2), np.float32))
+    y = sd.placeHolder("y", None, 2)
+    sd.loss.meanSquaredError("loss", y,
+                             x.mmul(sd.getVariable("w")).rename("p"))
+    sd.setLossVariables("loss")
+    sd.setTrainingConfig(TrainingConfig(updater=Adam(1e-2),
+                                        dataSetFeatureMapping=["x"],
+                                        dataSetLabelMapping=["y"]))
+    xs = np.ones((4, 3), np.float32)
+    ys = np.zeros((4, 2), np.float32)
+    sd.fit(xs, ys)
+    a1 = tmp_path / "a1.sdz"
+    sd.save(a1, save_updater=True)
+    # repack without training in between
+    mid = SameDiff.load(a1)
+    a2 = tmp_path / "a2.sdz"
+    mid.save(a2, save_updater=True)
+    final = SameDiff.load(a2)
+    assert len(final._pending_opt_leaves) > 0
+    # the momenta survive the double hop bit-exactly
+    for a, b in zip(SameDiff.load(a1)._pending_opt_leaves,
+                    final._pending_opt_leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -175,3 +175,31 @@ def test_histograms_survive_nonfinite_activations():
     h = storage.all()[0]["activationHistograms"]
     assert h["layer0"]["nonFinite"] == 4
     assert h["layer1"]["nonFinite"] == 1 and sum(h["layer1"]["counts"]) == 3
+
+
+def test_graph_activation_histograms():
+    """ComputationGraph records node-keyed histograms too (round-5)."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = (NeuralNetConfiguration.Builder()
+            .updater(Sgd(0.1)).seed(0)
+            .graphBuilder()
+            .addInputs("in")
+            .addLayer("d", DenseLayer.Builder().nOut(6)
+                      .activation("relu").build(), "in")
+            .addLayer("out", OutputLayer.Builder("mcxent").nOut(2)
+                      .activation("softmax").build(), "d")
+            .setOutputs("out")
+            .setInputTypes(InputType.feedForward(4)).build())
+    net = ComputationGraph(conf).init()
+    storage = InMemoryStatsStorage()
+    net.setListeners(StatsListener(storage))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    net.fit(DataSet(x, y))
+    recs = storage.all()
+    h = next(r["activationHistograms"] for r in recs
+             if r.get("activationHistograms"))
+    assert "d" in h and "out" in h    # node-name keys
+    assert sum(h["d"]["counts"]) > 0
