@@ -89,7 +89,8 @@ _SLOW_MODULES = {"test_multihost.py", "test_zoo.py", "test_kernels.py",
 _SMOKE_MODULES = {"test_ops.py", "test_multilayer.py", "test_eval.py",
                   "test_losses_tail.py", "test_datasets.py",
                   "test_serialization.py", "test_clustering.py",
-                  "test_graph_embeddings.py"}
+                  "test_graph_embeddings.py", "test_envguard.py",
+                  "test_image_transforms.py"}
 
 
 def pytest_collection_modifyitems(config, items):
