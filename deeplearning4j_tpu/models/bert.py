@@ -147,7 +147,20 @@ def _attention(cfg, layer, x, attn_mask, train, rng, attn_impl):
         # path (per-example key/query validity in VMEM)
         attn_impl = "flash" if jax.default_backend() == "tpu" else "dense"
     if callable(attn_impl):
-        ctx = attn_impl(q, k, v)
+        if attn_mask is None:
+            ctx = attn_impl(q, k, v)
+        else:
+            # a padded batch must never silently attend to padding: the
+            # custom impl either takes the mask (ulysses does) or the
+            # call fails loudly here
+            try:
+                ctx = attn_impl(q, k, v, attn_mask)
+            except TypeError as e:
+                raise ValueError(
+                    "attn_impl callable does not accept a mask argument "
+                    "but the batch carries attention_mask — use a "
+                    "masked impl (flash/dense) or an "
+                    "attn_impl(q, k, v, mask)") from e
     elif attn_impl in ("blockwise", "flash"):
         if attn_impl == "flash":
             from deeplearning4j_tpu.kernels import flash_attention

@@ -2,6 +2,8 @@ from deeplearning4j_tpu.parallel.mesh import DeviceMesh, initialize_distributed
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.sharded_trainer import (ParameterAveragingTrainer,
                                                          ShardedTrainer)
+from deeplearning4j_tpu.parallel.ulysses import (make_ulysses_attention,
+                                                 ulysses_attention_sharded)
 from deeplearning4j_tpu.parallel.ring_attention import (blockwise_attention,
                                                         dense_attention,
                                                         make_ring_attention,

@@ -1,0 +1,100 @@
+"""All-to-all (Ulysses-style) sequence parallelism over the `sp` axis.
+
+The complement to ring attention (parallel/ring_attention.py) for long
+sequences: instead of rotating K/V blocks around the ICI ring (n-1 hops,
+O(T/n) memory per device), TWO all-to-all collectives re-shard
+
+    (B, H, T/n, D)  --all_to_all-->  (B, H/n, T, D)
+
+so each device holds the FULL sequence for its head group, runs ordinary
+attention locally (causal works unchanged, padding masks ride one
+all_gather — no cross-device softmax bookkeeping), and a final
+all-to-all restores sequence sharding. Trade-offs, per the scaling-book
+recipe:
+
+- Ulysses: four all-to-alls per call (q/k/v gathers + output scatter),
+  full-T attention per device — wins when heads >= sp and T fits one
+  device's HBM after the head split.
+- Ring: n-1 ppermute hops overlapped with compute, O(T/n) activation
+  memory — wins when even T x D per head group is too big, or H < sp.
+
+Both return shard_map-ready fns with the same signature, so models swap
+strategies with one argument (models/bert.py attn_impl).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.parallel.ring_attention import (blockwise_attention,
+                                                        dense_attention)
+
+__all__ = ["make_ulysses_attention", "ulysses_attention_sharded"]
+
+
+def make_ulysses_attention(mesh, axis_name="sp", causal=False,
+                           attn_fn=None, block_size=512):
+    """Build f(q_local, k_local, v_local, mask_local=None) for use INSIDE
+    shard_map over `mesh`: q/k/v locals are (B, H, T/n, D) sharded on
+    time, the optional padding mask (B, T/n); output is sharded like q.
+    Requires H % n == 0 (heads split across the axis while attention
+    runs). attn_fn overrides the unmasked local attention (defaults to
+    the flash-style blockwise scan; signature f(q, k, v, causal=...));
+    masked batches run the dense local path (the full (B, T) mask is
+    all_gathered once)."""
+    if attn_fn is None:
+        def attn_fn(q, k, v, causal=False):
+            return blockwise_attention(q, k, v, block_size=block_size,
+                                       causal=causal)
+
+    def ulysses(q, k, v, mask=None):
+        n = lax.psum(1, axis_name)
+        h = q.shape[1]
+        if h % n:
+            raise ValueError(
+                f"ulysses attention needs heads ({h}) divisible by the "
+                f"{axis_name!r} axis size ({n}) — use ring attention for "
+                "head counts below the mesh axis")
+
+        def gather_seq(x):   # (B, H, T/n, D) -> (B, H/n, T, D)
+            return lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+        def scatter_seq(x):  # (B, H/n, T, D) -> (B, H, T/n, D)
+            return lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+        qg, kg, vg = gather_seq(q), gather_seq(k), gather_seq(v)
+        if mask is not None:
+            full = lax.all_gather(mask, axis_name, axis=1, tiled=True)
+            out = dense_attention(qg, kg, vg, causal=causal,
+                                  mask=full[:, None, None, :] > 0)
+        else:
+            out = attn_fn(qg, kg, vg, causal=causal)
+        return scatter_seq(out.astype(q.dtype))
+
+    return ulysses
+
+
+def ulysses_attention_sharded(mesh, q, k, v, mask=None, axis_name="sp",
+                              causal=False, attn_fn=None):
+    """Convenience wrapper: q/k/v are GLOBAL (B, H, T, D) arrays (mask
+    (B, T)); shards the time axis over `axis_name`, runs the all-to-all
+    attention, and returns the global result. (Models embed
+    make_ulysses_attention in their own shard_map instead — this is the
+    standalone surface.)"""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, axis_name, None)
+    fn = make_ulysses_attention(mesh, axis_name, causal=causal,
+                                attn_fn=attn_fn)
+    if mask is None:
+        sharded = jax.shard_map(
+            lambda a, b, c: fn(a, b, c), mesh=mesh,
+            in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+        return sharded(q, k, v)
+    sharded = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec, P(None, axis_name)),
+        out_specs=spec, check_vma=False)
+    return sharded(q, k, v, mask)

@@ -385,3 +385,115 @@ def test_zero1_sharded_optimizer_matches_replicated(devices8):
     assert shard0.shape != leaf.shape  # a real 1/dp slice, not a replica
     # and the per-process footprint is smaller than full replication
     assert state_memory_bytes(zero_net._opt_state) < replicated_bytes
+
+
+def test_ulysses_attention_matches_dense(devices8):
+    """All-to-all (Ulysses) sequence parallelism == dense attention —
+    the 2-collective complement to the ring (round-5)."""
+    from deeplearning4j_tpu.parallel.ulysses import \
+        ulysses_attention_sharded
+    mesh = DeviceMesh(devices8, sp=8).mesh
+    rng = np.random.default_rng(11)
+    B, H, T, D = 2, 8, 64, 8     # H divisible by sp
+    q = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    k = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    v = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    want = np.asarray(dense_attention(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v)))
+    got = np.asarray(ulysses_attention_sharded(
+        mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_attention_causal_and_head_check(devices8):
+    from deeplearning4j_tpu.parallel.ulysses import \
+        ulysses_attention_sharded
+    mesh = DeviceMesh(devices8, sp=8).mesh
+    rng = np.random.default_rng(12)
+    B, H, T, D = 1, 8, 32, 4
+    q = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    k = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    v = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    want = np.asarray(dense_attention(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), causal=True))
+    got = np.asarray(ulysses_attention_sharded(
+        mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+    # H=4 < sp=8: loud error, not silent wrong math
+    bad = rng.standard_normal((1, 4, 32, 4)).astype(np.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention_sharded(mesh, jnp.asarray(bad), jnp.asarray(bad),
+                                  jnp.asarray(bad))
+
+
+def test_bert_with_ulysses_attention_matches_dense(devices8):
+    """Model-level sp swap: BERT-tiny loss under all-to-all attention ==
+    the dense single-device path (same one-arg swap as ring)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.models.bert import (bert_tiny,
+                                                classification_loss,
+                                                init_bert_params)
+    from deeplearning4j_tpu.parallel.ulysses import make_ulysses_attention
+
+    mesh = DeviceMesh(devices8[:4], sp=4).mesh    # num_heads=4 == sp
+    cfg = bert_tiny(max_position_embeddings=32)
+    params = init_bert_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(13)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (2, 32)),
+             "labels": rng.integers(0, cfg.num_labels, (2,))}
+    want = float(classification_loss(cfg, params, batch, train=False))
+    spec = P(None, None, "sp", None)
+    uly = jax.shard_map(make_ulysses_attention(mesh, "sp"), mesh=mesh,
+                        in_specs=(spec, spec, spec), out_specs=spec,
+                        check_vma=False)
+    got = float(classification_loss(cfg, params, batch, train=False,
+                                    attn_impl=uly))
+    assert abs(got - want) < 5e-4, (got, want)
+
+
+def test_ulysses_masked_matches_dense(devices8):
+    """Padded batches: the mask rides one all_gather into the dense
+    local path; == masked dense attention."""
+    from deeplearning4j_tpu.parallel.ulysses import \
+        ulysses_attention_sharded
+    mesh = DeviceMesh(devices8, sp=8).mesh
+    rng = np.random.default_rng(14)
+    B, H, T, D = 2, 8, 64, 8
+    q = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    k = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    v = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    lengths = np.array([40, 64])
+    mask = (np.arange(T)[None, :] < lengths[:, None]).astype(np.float32)
+    want = np.asarray(dense_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        mask=jnp.asarray(mask)[:, None, None, :] > 0))
+    got = np.asarray(ulysses_attention_sharded(
+        mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        mask=jnp.asarray(mask)))
+    # padded-query rows attend over garbage; compare valid region
+    for i, L in enumerate(lengths):
+        np.testing.assert_allclose(got[i, :, :L], want[i, :, :L],
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_bert_callable_attn_impl_rejects_dropped_mask(devices8):
+    """A padded batch + mask-blind custom attn_impl must fail loudly,
+    never silently attend to padding (round-5 review fix)."""
+    import jax
+
+    from deeplearning4j_tpu.models.bert import (bert_tiny,
+                                                classification_loss,
+                                                init_bert_params)
+    cfg = bert_tiny(max_position_embeddings=16)
+    params = init_bert_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(15)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (2, 16)),
+             "labels": rng.integers(0, cfg.num_labels, (2,)),
+             "attention_mask": (np.arange(16)[None, :] < 10
+                                ).astype(np.float32).repeat(2, 0)}
+    with pytest.raises(ValueError, match="mask"):
+        classification_loss(cfg, params, batch, train=False,
+                            attn_impl=lambda q, k, v: dense_attention(
+                                q, k, v))
