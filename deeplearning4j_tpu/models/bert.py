@@ -152,15 +152,21 @@ def _attention(cfg, layer, x, attn_mask, train, rng, attn_impl):
         else:
             # a padded batch must never silently attend to padding: the
             # custom impl either takes the mask (ulysses does) or the
-            # call fails loudly here
+            # call fails loudly here. Arity is checked via bind() so a
+            # TypeError from INSIDE a mask-accepting impl is never
+            # misdiagnosed as a signature problem.
+            import inspect
             try:
-                ctx = attn_impl(q, k, v, attn_mask)
+                inspect.signature(attn_impl).bind(q, k, v, attn_mask)
             except TypeError as e:
                 raise ValueError(
                     "attn_impl callable does not accept a mask argument "
                     "but the batch carries attention_mask — use a "
                     "masked impl (flash/dense) or an "
                     "attn_impl(q, k, v, mask)") from e
+            except ValueError:
+                pass   # signature not introspectable: attempt the call
+            ctx = attn_impl(q, k, v, attn_mask)
     elif attn_impl in ("blockwise", "flash"):
         if attn_impl == "flash":
             from deeplearning4j_tpu.kernels import flash_attention

@@ -123,6 +123,40 @@ class SameDiffLambdaLayer(SameDiffLayer):
         return fn(x)
 
 
+class SameDiffOutputLayer(SameDiffLayer):
+    """User-defined OUTPUT layer (≡ samediff.SameDiffOutputLayer): the
+    custom-layer escape hatch for the loss head. Subclasses implement
+
+      - defineParameters() / defineLayer(params, x)  (as SameDiffLayer)
+      - defineLoss(labels, output, mask=None) -> scalar loss
+
+    defineLayer's result is both the network's output() and what
+    defineLoss scores (activation defaults to identity — apply any
+    nonlinearity inside defineLayer). Trains through the same jitted
+    whole-network step as built-in output layers."""
+
+    def __init__(self, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+
+    def defineLoss(self, labels, output, mask=None):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement "
+            "defineLoss(labels, output, mask=None) -> scalar")
+
+    # -- output-layer protocol (nn.multilayer/graph loss path) -----------
+    #: the network classes pass the current feature mask into
+    #: pre_activation when this is set, so defineLayer keeps its
+    #: mask=... contract even as the loss head
+    pre_activation_takes_mask = True
+
+    def pre_activation(self, params, x, mask=None):
+        return self.defineLayer(params, x, mask=mask)
+
+    def compute_loss(self, labels, preact, mask=None):
+        return self.defineLoss(labels, preact, mask=mask)
+
+
 class SameDiffVertex(GraphVertex):
     """Multi-input user-defined vertex for ComputationGraph (≡
     samediff.SameDiffVertex). Carries parameters via the graph's

@@ -213,7 +213,11 @@ class ComputationGraph:
                 node_masks[name] = pmask
                 continue
             if name in self.conf.output_names and hasattr(layer, "compute_loss"):
-                pre = layer.pre_activation(p, layer._dropout_in(x, ltrain, lrng))
+                xd = layer._dropout_in(x, ltrain, lrng)
+                if getattr(layer, "pre_activation_takes_mask", False):
+                    pre = layer.pre_activation(p, xd, mask=pmask)
+                else:
+                    pre = layer.pre_activation(p, xd)
                 preacts[name] = pre
                 from deeplearning4j_tpu.nn.activations import get_activation
                 acts[name] = get_activation(layer.activation)(pre)

@@ -203,7 +203,13 @@ class MultiLayerNetwork:
             s = state.get(str(i), {})
             if i == len(self.layers) - 1 and hasattr(layer, "compute_loss") \
                     and hasattr(layer, "pre_activation"):
-                preact = layer.pre_activation(p, layer._dropout_in(x, ltrain, lrng))
+                xd = layer._dropout_in(x, ltrain, lrng)
+                if getattr(layer, "pre_activation_takes_mask", False):
+                    # custom loss heads (SameDiffOutputLayer) keep the
+                    # defineLayer(params, x, mask) contract
+                    preact = layer.pre_activation(p, xd, mask=mask)
+                else:
+                    preact = layer.pre_activation(p, xd)
                 from deeplearning4j_tpu.nn.activations import get_activation
                 x = get_activation(layer.activation)(preact)
             elif carries is not None and getattr(layer, "is_recurrent", False):

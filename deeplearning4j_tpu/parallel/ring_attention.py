@@ -49,9 +49,11 @@ def _block_accumulate(carry, q, k, v, logits_mask, scale):
     return o_new, l_new, m_new
 
 
-def blockwise_attention(q, k, v, block_size=512, causal=False):
+def blockwise_attention(q, k, v, block_size=512, causal=False,
+                        kv_mask=None):
     """Single-device flash-style attention: lax.scan over K/V blocks with
-    online softmax — O(T) memory."""
+    online softmax — O(T) memory. kv_mask (B, T): padding-key validity
+    (invalid keys never receive probability), still O(T) memory."""
     b, h, t, d = q.shape
     scale = 1.0 / jnp.sqrt(d)
     nblk = -(-t // block_size)
@@ -62,6 +64,8 @@ def blockwise_attention(q, k, v, block_size=512, causal=False):
     kb = k.reshape(b, h, nblk, -1, d).transpose(2, 0, 1, 3, 4)
     vb = v.reshape(b, h, nblk, -1, d).transpose(2, 0, 1, 3, 4)
     q_pos = jnp.arange(t)
+    if kv_mask is not None and pad:
+        kv_mask = jnp.pad(kv_mask, ((0, 0), (0, pad)))
 
     def step(carry, inp):
         kv_idx, kblk, vblk = inp
@@ -70,6 +74,10 @@ def blockwise_attention(q, k, v, block_size=512, causal=False):
         if causal:
             lm = lm & (q_pos[:, None] >= k_pos[None, :])
         lm = lm[None, None]
+        if kv_mask is not None:
+            blk = lax.dynamic_slice_in_dim(kv_mask, kv_idx * block_size,
+                                           block_size, 1)
+            lm = lm & (blk > 0)[:, None, None, :]
         return _block_accumulate(carry, q, kblk, vblk, lm, scale), None
 
     o0 = jnp.zeros((b, h, t, d), jnp.float32)

@@ -39,14 +39,17 @@ def make_ulysses_attention(mesh, axis_name="sp", causal=False,
     shard_map over `mesh`: q/k/v locals are (B, H, T/n, D) sharded on
     time, the optional padding mask (B, T/n); output is sharded like q.
     Requires H % n == 0 (heads split across the axis while attention
-    runs). attn_fn overrides the unmasked local attention (defaults to
-    the flash-style blockwise scan; signature f(q, k, v, causal=...));
-    masked batches run the dense local path (the full (B, T) mask is
-    all_gathered once)."""
+    runs). attn_fn overrides the local attention (defaults to the
+    flash-style blockwise scan, which stays O(T) memory for masked
+    batches too; signature f(q, k, v, causal=..., kv_mask=None) — a
+    custom attn_fn without a kv_mask parameter fails loudly on masked
+    batches rather than silently attending to padding). The full (B, T)
+    mask is all_gathered once."""
+    custom_attn = attn_fn is not None
     if attn_fn is None:
-        def attn_fn(q, k, v, causal=False):
+        def attn_fn(q, k, v, causal=False, kv_mask=None):
             return blockwise_attention(q, k, v, block_size=block_size,
-                                       causal=causal)
+                                       causal=causal, kv_mask=kv_mask)
 
     def ulysses(q, k, v, mask=None):
         n = lax.psum(1, axis_name)
@@ -68,8 +71,19 @@ def make_ulysses_attention(mesh, axis_name="sp", causal=False,
         qg, kg, vg = gather_seq(q), gather_seq(k), gather_seq(v)
         if mask is not None:
             full = lax.all_gather(mask, axis_name, axis=1, tiled=True)
-            out = dense_attention(qg, kg, vg, causal=causal,
-                                  mask=full[:, None, None, :] > 0)
+            if custom_attn:
+                import inspect
+                try:
+                    inspect.signature(attn_fn).bind(qg, kg, vg,
+                                                    causal=causal,
+                                                    kv_mask=full)
+                except TypeError:
+                    raise ValueError(
+                        "masked batch but the custom attn_fn has no "
+                        "kv_mask parameter — silent padding attention "
+                        "is not an option; accept "
+                        "attn_fn(q, k, v, causal=..., kv_mask=None)")
+            out = attn_fn(qg, kg, vg, causal=causal, kv_mask=full)
         else:
             out = attn_fn(qg, kg, vg, causal=causal)
         return scatter_seq(out.astype(q.dtype))

@@ -497,3 +497,30 @@ def test_bert_callable_attn_impl_rejects_dropped_mask(devices8):
         classification_loss(cfg, params, batch, train=False,
                             attn_impl=lambda q, k, v: dense_attention(
                                 q, k, v))
+
+
+def test_ulysses_masked_stays_blockwise_and_custom_fn_guard(devices8):
+    """Masked batches ride the O(T) blockwise path (no dense logits);
+    a mask-blind custom attn_fn fails loudly."""
+    from deeplearning4j_tpu.parallel.ulysses import \
+        ulysses_attention_sharded
+    mesh = DeviceMesh(devices8, sp=8).mesh
+    rng = np.random.default_rng(16)
+    B, H, T, D = 1, 8, 64, 4
+    q = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    k = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    v = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    mask = (np.arange(T)[None, :] < 48).astype(np.float32)
+    want = np.asarray(dense_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        mask=jnp.asarray(mask)[:, None, None, :] > 0))
+    got = np.asarray(ulysses_attention_sharded(
+        mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        mask=jnp.asarray(mask)))
+    np.testing.assert_allclose(got[:, :, :48], want[:, :, :48],
+                               rtol=2e-4, atol=2e-5)
+    with pytest.raises(ValueError, match="kv_mask"):
+        ulysses_attention_sharded(
+            mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            mask=jnp.asarray(mask),
+            attn_fn=lambda a, b, c, causal=False: dense_attention(a, b, c))
