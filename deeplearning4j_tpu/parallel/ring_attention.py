@@ -228,7 +228,15 @@ def _make_ring_flash(axis_name, block_q=128, block_k=128, interpret=None,
         return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
     ring_flash.defvjp(fwd, bwd)
-    return ring_flash
+    def ring_flash_entry(q, k, v, kv_mask=None):
+        if kv_mask is not None:
+            raise NotImplementedError(
+                "the flash ring path has no kv_mask support yet — build "
+                "with make_ring_attention(use_flash=False) (the lax ring "
+                "rotates the mask with its K/V block) for padded batches")
+        return ring_flash(q, k, v)
+
+    return ring_flash_entry
 
 
 def make_ring_attention(mesh, axis_name="sp", causal=False, use_flash=None,
@@ -245,14 +253,24 @@ def make_ring_attention(mesh, axis_name="sp", causal=False, use_flash=None,
     step → causal kernel, past steps → full kernel, future steps skipped)
     but stays OPT-IN (use_flash=True) until it has an on-chip smoke run —
     interpret-mode tests don't validate Mosaic lowering (BENCH.md
-    round-3 lesson)."""
+    round-3 lesson).
+
+    Padded batches: the lax path takes kv_mask (local (B, T/n) slice
+    that rotates with its K/V block); the flash path raises
+    NotImplementedError for kv_mask — masked batches currently trade
+    the fused kernels for the lax accumulator (ring_attention() does
+    this automatically)."""
     if use_flash is None:
         use_flash = jax.default_backend() == "tpu" and not causal
     if use_flash:
         return _make_ring_flash(axis_name, block_q, block_k, interpret,
                                 causal=causal)
 
-    def ring_attn(q, k, v):
+    def ring_attn(q, k, v, kv_mask=None):
+        """kv_mask (round-5): local (B, T/n) key-validity slice — it
+        rotates around the ring WITH its K/V block, so padded keys never
+        receive probability from any device's queries (O(T/n) memory,
+        no full-mask gather)."""
         n = lax.psum(1, axis_name)
         my = lax.axis_index(axis_name)
         b, h, t_local, d = q.shape
@@ -260,35 +278,50 @@ def make_ring_attention(mesh, axis_name="sp", causal=False, use_flash=None,
         q_pos = my * t_local + jnp.arange(t_local)
 
         def step(carry, i):
-            o, l, m, kblk, vblk = carry
+            o, l, m, kblk, vblk, mblk = carry
             src_idx = (my - i) % n  # whose K/V block we currently hold
+            lm = None
             if causal:
                 k_pos = src_idx * t_local + jnp.arange(t_local)
                 lm = (q_pos[:, None] >= k_pos[None, :])[None, None]
-            else:
-                lm = None
+            if mblk is not None:
+                km = (mblk > 0)[:, None, None, :]   # (B,1,1,T/n)
+                lm = km if lm is None else (lm & km)
             o, l, m = _block_accumulate((o, l, m), q, kblk, vblk, lm, scale)
-            # rotate K/V one hop around the ring (overlaps with next block
-            # on TPU: XLA schedules the collective-permute async)
+            # rotate K/V (+ their mask slice) one hop around the ring
+            # (overlaps with next block on TPU: XLA schedules the
+            # collective-permute async)
             perm = [(j, (j + 1) % n) for j in range(n)]
             kblk = lax.ppermute(kblk, axis_name, perm)
             vblk = lax.ppermute(vblk, axis_name, perm)
-            return (o, l, m, kblk, vblk), None
+            if mblk is not None:
+                mblk = lax.ppermute(mblk, axis_name, perm)
+            return (o, l, m, kblk, vblk, mblk), None
 
         o0 = jnp.zeros((b, h, t_local, d), jnp.float32)
         l0 = jnp.zeros((b, h, t_local), jnp.float32)
         m0 = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)
-        (o, l, m, _, _), _ = lax.scan(step, (o0, l0, m0, k, v),
-                                      jnp.arange(n))
+        (o, l, m, _, _, _), _ = lax.scan(step, (o0, l0, m0, k, v, kv_mask),
+                                         jnp.arange(n))
         return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
     return ring_attn
 
 
-def ring_attention(q, k, v, mesh, axis_name="sp", causal=False):
-    """Convenience wrapper: shard (B,H,T,D) over T, run the ring, gather."""
-    fn = make_ring_attention(mesh, axis_name, causal)
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
+                   kv_mask=None):
+    """Convenience wrapper: shard (B,H,T,D) over T, run the ring, gather.
+    kv_mask: global (B, T) key-validity mask for padded batches — NOTE
+    masked batches run the lax ring (the Pallas flash ring has no mask
+    path yet), trading the fused-kernel HBM profile for correctness."""
+    fn = make_ring_attention(mesh, axis_name, causal,
+                             use_flash=False if kv_mask is not None
+                             else None)
     spec = P(None, None, axis_name, None)
-    shmapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    args, specs = [q, k, v], [spec, spec, spec]
+    if kv_mask is not None:
+        args.append(kv_mask)
+        specs.append(P(None, axis_name))
+    shmapped = jax.shard_map(fn, mesh=mesh, in_specs=tuple(specs),
                              out_specs=spec, check_vma=False)
-    return shmapped(q, k, v)
+    return shmapped(*args)

@@ -524,3 +524,45 @@ def test_ulysses_masked_stays_blockwise_and_custom_fn_guard(devices8):
             mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
             mask=jnp.asarray(mask),
             attn_fn=lambda a, b, c, causal=False: dense_attention(a, b, c))
+
+
+def test_ring_attention_masked_matches_dense(devices8):
+    """Round-5: the key-validity mask ROTATES with its K/V block around
+    the ring — padded keys get zero probability from every device."""
+    mesh = DeviceMesh(devices8, sp=8).mesh
+    rng = np.random.default_rng(18)
+    B, H, T, D = 2, 4, 64, 8
+    q = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    k = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    v = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    lengths = np.array([40, 64])
+    mask = (np.arange(T)[None, :] < lengths[:, None]).astype(np.float32)
+    want = np.asarray(dense_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        mask=jnp.asarray(mask)[:, None, None, :] > 0))
+    got = np.asarray(ring_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh,
+        kv_mask=jnp.asarray(mask)))
+    for i, L in enumerate(lengths):
+        np.testing.assert_allclose(got[i, :, :L], want[i, :, :L],
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_masked_causal(devices8):
+    mesh = DeviceMesh(devices8, sp=8).mesh
+    rng = np.random.default_rng(19)
+    B, H, T, D = 1, 2, 32, 4
+    q = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    k = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    v = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    mask = (np.arange(T)[None, :] < 24).astype(np.float32)
+    cm = np.tril(np.ones((T, T), bool))[None, None] & (
+        mask[:, None, None, :] > 0)
+    want = np.asarray(dense_attention(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v),
+                                      mask=jnp.asarray(cm)))
+    got = np.asarray(ring_attention(jnp.asarray(q), jnp.asarray(k),
+                                    jnp.asarray(v), mesh, causal=True,
+                                    kv_mask=jnp.asarray(mask)))
+    np.testing.assert_allclose(got[:, :, :24], want[:, :, :24],
+                               rtol=2e-4, atol=2e-5)
