@@ -104,29 +104,26 @@ def _make_ring_flash(axis_name, block_q=128, block_k=128, interpret=None,
     i > my → strictly-future block (skipped: lse = −inf in the merge,
     zero grads in backward). lax.cond picks the kernel per step, so each
     step still runs exactly one Pallas program."""
-    from deeplearning4j_tpu.kernels.flash_attention import (_flash_backward,
-                                                            _flash_forward)
+    from deeplearning4j_tpu.kernels.flash_attention import (
+        _flash_backward, _flash_forward, _zero_mask_cotangent)
 
-    @jax.custom_vjp
-    def ring_flash(q, k, v):
-        o, _ = _ring_flash_fwd_pass(q, k, v)
-        return o.astype(q.dtype)
-
-    def _block_fwd(q, kblk, vblk, i, my):
-        """One local flash block, causal-aware; lse (B*H, tq_padded)."""
+    def _block_fwd(q, kblk, vblk, mblk, i, my):
+        """One local flash block, causal- and mask-aware; lse is
+        (B*H, tq_padded). mblk is None (static) or the held K/V block's
+        key-validity slice."""
         if not causal:
-            return _flash_forward(q, kblk, vblk, None, None, False,
+            return _flash_forward(q, kblk, vblk, None, mblk, False,
                                   block_q, block_k, interpret)
 
-        def diag(q, kb, vb):
-            return _flash_forward(q, kb, vb, None, None, True,
+        def diag(q, kb, vb, mb):
+            return _flash_forward(q, kb, vb, None, mb, True,
                                   block_q, block_k, interpret)
 
-        def past(q, kb, vb):
-            return _flash_forward(q, kb, vb, None, None, False,
+        def past(q, kb, vb, mb):
+            return _flash_forward(q, kb, vb, None, mb, False,
                                   block_q, block_k, interpret)
 
-        def future(q, kb, vb):
+        def future(q, kb, vb, mb):
             # strictly-future block: SKIP the kernel — -inf lse zeroes
             # its weight in the associative merge. Shapes must mirror
             # _flash_forward's returns: out (B,H,T,D), lse (B*H, tq_pad).
@@ -136,66 +133,86 @@ def _make_ring_flash(axis_name, block_q=128, block_k=128, interpret=None,
             return (jnp.zeros((b, h, t_local, d), q.dtype),
                     jnp.full((b * h, tq_pad), -jnp.inf, jnp.float32))
 
+        if mblk is None:
+            return lax.cond(
+                i == 0, lambda q, kb, vb: diag(q, kb, vb, None),
+                lambda q, kb, vb: lax.cond(
+                    i <= my, lambda q2, kb2, vb2: past(q2, kb2, vb2, None),
+                    lambda q2, kb2, vb2: future(q2, kb2, vb2, None),
+                    q, kb, vb),
+                q, kblk, vblk)
         return lax.cond(
             i == 0, diag,
-            lambda q, kb, vb: lax.cond(i <= my, past, future, q, kb, vb),
-            q, kblk, vblk)
+            lambda q, kb, vb, mb: lax.cond(i <= my, past, future,
+                                           q, kb, vb, mb),
+            q, kblk, vblk, mblk)
 
-    def _ring_flash_fwd_pass(q, k, v):
+    def _fwd_pass(q, k, v, kv_mask):
+        """Shared forward ring (kv_mask None or the local mask slice):
+        per-block (o, lse) partials merged -inf-safely — a block whose
+        kernel saw NO valid key returns the +1e30 invalid-row sentinel,
+        which means "contributes nothing" (-inf) in the merge."""
         n = lax.psum(1, axis_name)
         my = lax.axis_index(axis_name)
         b, h, t_local, d = q.shape
         perm = [(j, (j + 1) % n) for j in range(n)]
 
         def step(carry, i):
-            o, lse, kblk, vblk = carry
-            ob, lse_b = _block_fwd(q, kblk, vblk, i, my)
+            o, lse, kblk, vblk, mblk = carry
+            ob, lse_b = _block_fwd(q, kblk, vblk, mblk, i, my)
             lse_b = lse_b[:, :t_local].reshape(b, h, t_local)
+            # +1e30 = kernel sentinel (no valid key for the row);
+            # <= -1e29 = causal+masked starvation (l ~ 0 at m = -1e30).
+            # Both mean "no contribution from this block".
+            lse_b = jnp.where((lse_b >= 1e29) | (lse_b <= -1e29),
+                              -jnp.inf, lse_b)
             m = jnp.maximum(lse, lse_b)
-            w1 = jnp.exp(lse - m)
-            w2 = jnp.exp(lse_b - m)
+            m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+            w1 = jnp.where(jnp.isfinite(lse), jnp.exp(lse - m_safe), 0.0)
+            w2 = jnp.where(jnp.isfinite(lse_b),
+                           jnp.exp(lse_b - m_safe), 0.0)
             s = jnp.maximum(w1 + w2, 1e-30)
             o = (o * w1[..., None]
                  + ob.astype(jnp.float32) * w2[..., None]) / s[..., None]
             lse = m + jnp.log(s)
             kblk = lax.ppermute(kblk, axis_name, perm)
             vblk = lax.ppermute(vblk, axis_name, perm)
-            return (o, lse, kblk, vblk), None
+            if mblk is not None:
+                mblk = lax.ppermute(mblk, axis_name, perm)
+            return (o, lse, kblk, vblk, mblk), None
 
         o0 = jnp.zeros(q.shape, jnp.float32)
         lse0 = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)
-        (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v),
-                                     jnp.arange(n))
+        (o, lse, _, _, _), _ = lax.scan(step, (o0, lse0, k, v, kv_mask),
+                                        jnp.arange(n))
         return o, lse
 
-    def fwd(q, k, v):
-        o, lse = _ring_flash_fwd_pass(q, k, v)
-        out = o.astype(q.dtype)
-        return out, (q, k, v, out, lse)
-
-    def bwd(res, g):
-        q, k, v, o, lse = res
+    def _bwd_pass(q, k, v, kv_mask, o, lse, g):
         n = lax.psum(1, axis_name)
         my = lax.axis_index(axis_name)
         b, h, t_local, d = q.shape
+        # rows that saw NO valid key anywhere merged to lse = -inf; the
+        # backward recompute needs the kernels' +1e30 sentinel form so
+        # p = exp(finite - 1e30) == 0 (never exp(+inf))
+        lse = jnp.where(jnp.isfinite(lse), lse, 1e30)
         lse2 = lse.reshape(b * h, t_local)
         perm = [(j, (j + 1) % n) for j in range(n)]
 
-        def _block_bwd(i, kblk, vblk):
+        def _block_bwd(i, kblk, vblk, mblk):
             if not causal:
-                return _flash_backward(q, kblk, vblk, None, None, o, lse2,
+                return _flash_backward(q, kblk, vblk, None, mblk, o, lse2,
                                        g, False, block_q, block_k,
                                        interpret)
 
-            def diag(kb, vb):
-                return _flash_backward(q, kb, vb, None, None, o, lse2, g,
+            def diag(kb, vb, mb):
+                return _flash_backward(q, kb, vb, None, mb, o, lse2, g,
                                        True, block_q, block_k, interpret)
 
-            def past(kb, vb):
-                return _flash_backward(q, kb, vb, None, None, o, lse2, g,
+            def past(kb, vb, mb):
+                return _flash_backward(q, kb, vb, None, mb, o, lse2, g,
                                        False, block_q, block_k, interpret)
 
-            def future(kb, vb):
+            def future(kb, vb, mb):
                 # the global-lse recompute would give NONZERO p for
                 # future blocks (they never entered the softmax) — their
                 # gradients are identically zero and must be skipped
@@ -203,14 +220,23 @@ def _make_ring_flash(axis_name, block_q=128, block_k=128, interpret=None,
                         jnp.zeros(kb.shape, kb.dtype),
                         jnp.zeros(vb.shape, vb.dtype))
 
+            if mblk is None:
+                return lax.cond(
+                    i == 0, lambda kb, vb: diag(kb, vb, None),
+                    lambda kb, vb: lax.cond(
+                        i <= my, lambda kb2, vb2: past(kb2, vb2, None),
+                        lambda kb2, vb2: future(kb2, vb2, None),
+                        kb, vb),
+                    kblk, vblk)
             return lax.cond(
                 i == 0, diag,
-                lambda kb, vb: lax.cond(i <= my, past, future, kb, vb),
-                kblk, vblk)
+                lambda kb, vb, mb: lax.cond(i <= my, past, future,
+                                            kb, vb, mb),
+                kblk, vblk, mblk)
 
         def step(carry, i):
-            dq, kblk, vblk, dkblk, dvblk = carry
-            dq_i, dk_i, dv_i = _block_bwd(i, kblk, vblk)
+            dq, kblk, vblk, mblk, dkblk, dvblk = carry
+            dq_i, dk_i, dv_i = _block_bwd(i, kblk, vblk, mblk)
             dq = dq + dq_i.astype(jnp.float32)
             dkblk = dkblk + dk_i.astype(jnp.float32)
             dvblk = dvblk + dv_i.astype(jnp.float32)
@@ -218,23 +244,54 @@ def _make_ring_flash(axis_name, block_q=128, block_k=128, interpret=None,
             # cycle every block (and its gradient sum) is home again
             kblk = lax.ppermute(kblk, axis_name, perm)
             vblk = lax.ppermute(vblk, axis_name, perm)
+            if mblk is not None:
+                mblk = lax.ppermute(mblk, axis_name, perm)
             dkblk = lax.ppermute(dkblk, axis_name, perm)
             dvblk = lax.ppermute(dvblk, axis_name, perm)
-            return (dq, kblk, vblk, dkblk, dvblk), None
+            return (dq, kblk, vblk, mblk, dkblk, dvblk), None
 
         z = jnp.zeros(q.shape, jnp.float32)
-        (dq, _, _, dk, dv), _ = lax.scan(
-            step, (z, k, v, z, z), jnp.arange(n))
+        (dq, _, _, _, dk, dv), _ = lax.scan(
+            step, (z, k, v, kv_mask, z, z), jnp.arange(n))
         return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
+    @jax.custom_vjp
+    def ring_flash(q, k, v):
+        o, _ = _fwd_pass(q, k, v, None)
+        return o.astype(q.dtype)
+
+    def fwd(q, k, v):
+        o, lse = _fwd_pass(q, k, v, None)
+        out = o.astype(q.dtype)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, g):
+        q, k, v, o, lse = res
+        return _bwd_pass(q, k, v, None, o, lse, g)
+
     ring_flash.defvjp(fwd, bwd)
+
+    @jax.custom_vjp
+    def ring_flash_masked(q, k, v, kv_mask):
+        o, _ = _fwd_pass(q, k, v, kv_mask)
+        return o.astype(q.dtype)
+
+    def fwd_m(q, k, v, kv_mask):
+        o, lse = _fwd_pass(q, k, v, kv_mask)
+        out = o.astype(q.dtype)
+        return out, (q, k, v, kv_mask, out, lse)
+
+    def bwd_m(res, g):
+        q, k, v, kv_mask, o, lse = res
+        dq, dk, dv = _bwd_pass(q, k, v, kv_mask, o, lse, g)
+        return dq, dk, dv, _zero_mask_cotangent(kv_mask)
+
+    ring_flash_masked.defvjp(fwd_m, bwd_m)
+
     def ring_flash_entry(q, k, v, kv_mask=None):
-        if kv_mask is not None:
-            raise NotImplementedError(
-                "the flash ring path has no kv_mask support yet — build "
-                "with make_ring_attention(use_flash=False) (the lax ring "
-                "rotates the mask with its K/V block) for padded batches")
-        return ring_flash(q, k, v)
+        if kv_mask is None:
+            return ring_flash(q, k, v)
+        return ring_flash_masked(q, k, v, kv_mask)
 
     return ring_flash_entry
 
@@ -255,11 +312,12 @@ def make_ring_attention(mesh, axis_name="sp", causal=False, use_flash=None,
     interpret-mode tests don't validate Mosaic lowering (BENCH.md
     round-3 lesson).
 
-    Padded batches: the lax path takes kv_mask (local (B, T/n) slice
-    that rotates with its K/V block); the flash path raises
-    NotImplementedError for kv_mask — masked batches currently trade
-    the fused kernels for the lax accumulator (ring_attention() does
-    this automatically)."""
+    Padded batches: BOTH paths take kv_mask (a local (B, T/n) slice
+    that rotates with its K/V block). The masked FLASH ring (round-5)
+    feeds each held block's slice into the kernels' own kv_mask path
+    (fwd + bwd) with -inf-safe partial merging; like causal, it stays
+    OPT-IN (use_flash=True) until an on-chip smoke —
+    ring_attention() auto-selects the lax ring for masked batches."""
     if use_flash is None:
         use_flash = jax.default_backend() == "tpu" and not causal
     if use_flash:
@@ -312,8 +370,9 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
                    kv_mask=None):
     """Convenience wrapper: shard (B,H,T,D) over T, run the ring, gather.
     kv_mask: global (B, T) key-validity mask for padded batches — NOTE
-    masked batches run the lax ring (the Pallas flash ring has no mask
-    path yet), trading the fused-kernel HBM profile for correctness."""
+    masked batches auto-select the lax ring (the masked flash ring
+    exists but is opt-in via make_ring_attention(use_flash=True) until
+    it has an on-chip smoke run)."""
     fn = make_ring_attention(mesh, axis_name, causal,
                              use_flash=False if kv_mask is not None
                              else None)

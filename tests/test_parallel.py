@@ -566,3 +566,151 @@ def test_ring_attention_masked_causal(devices8):
                                     kv_mask=jnp.asarray(mask)))
     np.testing.assert_allclose(got[:, :, :24], want[:, :, :24],
                                rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_masked_flash_path(devices8):
+    """Round-5: the masked FLASH ring (kernels' kv_mask + -inf-safe
+    merge) == dense, including fully-masked tail blocks, fwd AND grads."""
+    from deeplearning4j_tpu.parallel.ring_attention import \
+        make_ring_attention
+    mesh = DeviceMesh(devices8, sp=8).mesh
+    rng = np.random.default_rng(20)
+    B, H, T, D = 2, 4, 64, 8
+    q = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    k = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    v = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    # example 0: blocks 5-7 (T/n=8 each) fully masked
+    lengths = np.array([40, 64])
+    mask = (np.arange(T)[None, :] < lengths[:, None]).astype(np.float32)
+    fn = make_ring_attention(mesh, "sp", use_flash=True, block_q=16,
+                             block_k=16, interpret=True)
+    spec = P(None, None, "sp", None)
+    sharded = jax.shard_map(fn, mesh=mesh,
+                            in_specs=(spec, spec, spec, P(None, "sp")),
+                            out_specs=spec, check_vma=False)
+
+    def loss_dense(q_, k_, v_):
+        out = dense_attention(q_, k_, v_,
+                              mask=jnp.asarray(mask)[:, None, None, :] > 0)
+        # compare gradients through the VALID region only
+        vmask = jnp.asarray(mask)[:, None, :, None]
+        return jnp.sum(jnp.square(out * vmask))
+
+    def loss_flash_valid(q_, k_, v_):
+        out = sharded(q_, k_, v_, jnp.asarray(mask))
+        vmask = jnp.asarray(mask)[:, None, :, None]
+        return jnp.sum(jnp.square(out * vmask))
+
+    got = np.asarray(sharded(jnp.asarray(q), jnp.asarray(k),
+                             jnp.asarray(v), jnp.asarray(mask)))
+    want = np.asarray(dense_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        mask=jnp.asarray(mask)[:, None, None, :] > 0))
+    assert np.isfinite(got).all()
+    for i, L in enumerate(lengths):
+        np.testing.assert_allclose(got[i, :, :L], want[i, :, :L],
+                                   rtol=2e-4, atol=2e-5)
+    gf = jax.grad(loss_flash_valid, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-4)
+
+
+def test_ring_attention_masked_flash_zero_length_and_bool_mask(devices8):
+    """Review r5: a zero-length example must yield finite grads (the -inf
+    merged lse maps back to the kernels' +1e30 sentinel in backward),
+    and bool masks must differentiate (float0 cotangent)."""
+    from deeplearning4j_tpu.parallel.ring_attention import \
+        make_ring_attention
+    mesh = DeviceMesh(devices8, sp=8).mesh
+    rng = np.random.default_rng(21)
+    B, H, T, D = 2, 2, 32, 4
+    q = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    k = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    v = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    mask = np.zeros((B, T), np.float32)
+    mask[1, :20] = 1.0          # example 0: ZERO valid keys
+    fn = make_ring_attention(mesh, "sp", use_flash=True, block_q=16,
+                             block_k=16, interpret=True)
+    spec = P(None, None, "sp", None)
+    sharded = jax.shard_map(fn, mesh=mesh,
+                            in_specs=(spec, spec, spec, P(None, "sp")),
+                            out_specs=spec, check_vma=False)
+
+    def loss(q_, k_, v_):
+        out = sharded(q_, k_, v_, jnp.asarray(mask))
+        return jnp.sum(jnp.square(out * jnp.asarray(mask)[:, None, :,
+                                                          None]))
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for g_ in grads:
+        assert np.isfinite(np.asarray(g_)).all()
+        assert np.abs(np.asarray(g_)[0]).max() == 0   # ex 0 fully padded
+        assert np.abs(np.asarray(g_)[1]).max() > 0
+    # bool mask: same call must differentiate without dtype errors
+    bmask = jnp.asarray(mask) > 0
+    sharded_b = jax.shard_map(fn, mesh=mesh,
+                              in_specs=(spec, spec, spec, P(None, "sp")),
+                              out_specs=spec, check_vma=False)
+    gb = jax.grad(lambda q_: jnp.sum(jnp.square(
+        sharded_b(q_, jnp.asarray(k), jnp.asarray(v), bmask))))(
+            jnp.asarray(q))
+    assert np.isfinite(np.asarray(gb)[1]).all()
+
+
+def test_ring_attention_masked_flash_causal_left_padding(devices8):
+    """Review r5: causal + LEFT padding — valid query rows that causally
+    see no valid key must not leak garbage gradients."""
+    from deeplearning4j_tpu.parallel.ring_attention import \
+        make_ring_attention
+    mesh = DeviceMesh(devices8, sp=8).mesh
+    rng = np.random.default_rng(22)
+    B, H, T, D = 1, 2, 32, 4
+    q = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    k = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    v = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    mask = (np.arange(T)[None, :] >= 12).astype(np.float32)   # left pad
+    fn = make_ring_attention(mesh, "sp", causal=True, use_flash=True,
+                             block_q=16, block_k=16, interpret=True)
+    spec = P(None, None, "sp", None)
+    sharded = jax.shard_map(fn, mesh=mesh,
+                            in_specs=(spec, spec, spec, P(None, "sp")),
+                            out_specs=spec, check_vma=False)
+    got = np.asarray(sharded(jnp.asarray(q), jnp.asarray(k),
+                             jnp.asarray(v), jnp.asarray(mask)))
+    cm = np.tril(np.ones((T, T), bool))[None, None] & (
+        mask[:, None, None, :] > 0)
+    want = np.asarray(dense_attention(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v),
+                                      mask=jnp.asarray(cm)))
+    np.testing.assert_allclose(got[:, :, 12:], want[:, :, 12:],
+                               rtol=2e-4, atol=2e-5)
+
+    def loss(q_, k_, v_):
+        out = sharded(q_, k_, v_, jnp.asarray(mask))
+        vm = jnp.asarray(mask)[:, None, :, None]
+        return jnp.sum(jnp.square(out * vm))
+
+    def loss_dense(q_, k_, v_):
+        # -1e30 (finite) masking: the -inf dense oracle emits NaN probs
+        # for starved rows, which poison dv for EVERY key in backward —
+        # the flash path is the numerically correct one here
+        d_ = q_.shape[-1]
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q_, k_) / np.sqrt(d_)
+        logits = jnp.where(jnp.asarray(cm), logits, -1e30)
+        out = jax.nn.softmax(logits, axis=-1) @ v_
+        vm = jnp.asarray(mask)[:, None, :, None]
+        return jnp.sum(jnp.square(out * vm))
+
+    gf = jax.grad(loss, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for a, b in zip(gf, gd):
+        a, b = np.asarray(a), np.asarray(b)
+        assert np.isfinite(a).all() and np.isfinite(b).all()
+        np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-4)
