@@ -13,6 +13,9 @@ Modes:
              inference form) — the traffic hypothesis test.
   tsne     — t-SNE N>=20k on-chip smoke (VERDICT r4 weak #4 done
              criterion): row-blocked passes at N=20k and N=30k.
+  flashring — on-chip smoke of the round-5 MASKED flash ring (sp=1
+             degenerate ring: masked kernels + merge under Mosaic),
+             causal and noncausal.
 
 Prints '##'-prefixed JSON lines.
 """
@@ -26,7 +29,7 @@ import time
 
 os.environ.setdefault("DL4J_TPU_WANT_TPU", "1")  # explicit chip opt-in
 
-DEADLINES = {"resblock": 900, "tsne": 900}
+DEADLINES = {"resblock": 900, "tsne": 900, "flashring": 900}
 
 
 def _emit(obj):
@@ -125,13 +128,65 @@ def mode_tsne():
             _emit({"tsne_n": n, "error": str(e)[:300]})
 
 
+def mode_flashring():
+    """On-chip smoke for the round-5 masked flash ring (sp=1 mesh: the
+    ring degenerates to the local masked kernels + the merge logic, which
+    is what needs Mosaic validation on one chip)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel.ring_attention import (
+        dense_attention, make_ring_attention)
+    from deeplearning4j_tpu.util.hostkey import enable_compile_cache
+    enable_compile_cache(os.path.dirname(os.path.abspath(__file__)))
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    rng = np.random.default_rng(0)
+    lengths = (700, 1024)
+    for causal in (False, True):
+        B, H, T, D = 2, 8, 1024, 64
+        q, k, v = (jnp.asarray(rng.standard_normal((B, H, T, D)),
+                               jnp.bfloat16) for _ in range(3))
+        mask = jnp.asarray((np.arange(T)[None, :]
+                            < np.array(lengths)[:, None])
+                           .astype(np.float32))
+        fn = make_ring_attention(mesh, "sp", causal=causal,
+                                 use_flash=True, interpret=False)
+        spec = P(None, None, "sp", None)
+        sharded = jax.shard_map(fn, mesh=mesh,
+                                in_specs=(spec, spec, spec,
+                                          P(None, "sp")),
+                                out_specs=spec, check_vma=False)
+        row = {"causal": causal, "shape": [B, H, T, D]}
+        try:
+            t0 = time.perf_counter()
+            got = np.asarray(sharded(q, k, v, mask), np.float32)
+            row["wall_s"] = round(time.perf_counter() - t0, 1)
+            want = np.asarray(dense_attention(
+                q, k, v, causal=causal,
+                mask=mask[:, None, None, :] > 0), np.float32)
+            err = 0.0
+            for i, L in enumerate(lengths):
+                w = want[i, :, :L]
+                err = max(err, float(np.abs(got[i, :, :L] - w).max()
+                                     / (np.abs(w).max() or 1.0)))
+            row["max_rel_err_valid"] = err
+            row["finite"] = bool(np.isfinite(got).all())
+        except Exception as e:  # noqa: BLE001
+            row["error"] = str(e)[:300]
+        _emit(row)
+
+
 def main():
     mode = sys.argv[1] if len(sys.argv) > 1 else "resblock"
     _install_deadline(DEADLINES.get(mode, 900))
     import jax
     dev = jax.devices()[0]
     _emit({"mode": mode, "device": str(dev), "platform": dev.platform})
-    {"resblock": mode_resblock, "tsne": mode_tsne}[mode]()
+    {"resblock": mode_resblock, "tsne": mode_tsne,
+     "flashring": mode_flashring}[mode]()
     _emit({"mode": mode, "done": True})
 
 
