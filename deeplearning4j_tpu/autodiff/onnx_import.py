@@ -17,6 +17,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from deeplearning4j_tpu.autodiff.samediff import SameDiff
 from deeplearning4j_tpu.autodiff.tfproto import (_read_varint, _signed,
@@ -204,7 +205,8 @@ for _opn, _fn in _ONNX_ELEMENTWISE.items():
 op_builder("onnx.matmul")(lambda: jnp.matmul)
 op_builder("onnx.softplus")(lambda: jax.nn.softplus)
 op_builder("onnx.gap")(
-    lambda: lambda x: jnp.mean(x, axis=(2, 3), keepdims=True))
+    lambda: lambda x: jnp.mean(x, axis=tuple(range(2, x.ndim)),
+                               keepdims=True))
 
 
 @op_builder("onnx.gemm")
@@ -275,10 +277,36 @@ def _b_unsqueeze(axes=()):
     return unsq
 
 
-@op_builder("onnx.reduce_mean")
-def _b_reduce_mean(axes=(), keep=1):
-    ax = tuple(axes)
-    return lambda x, *_r: jnp.mean(x, axis=ax or None, keepdims=bool(keep))
+def _onnx_reduce_builder(fn):
+    def build(axes=(), keep=1):
+        ax = tuple(axes)
+        return lambda x, *_r: fn(x, axis=ax or None, keepdims=bool(keep))
+    return build
+
+
+for _rop, _rfn in [("reduce_mean", jnp.mean), ("reduce_sum", jnp.sum),
+                   ("reduce_max", jnp.max), ("reduce_min", jnp.min)]:
+    op_builder("onnx." + _rop)(_onnx_reduce_builder(_rfn))
+# global pools reduce every spatial dim (ONNX defines them for rank >= 3)
+op_builder("onnx.gmp")(
+    lambda: lambda x: jnp.max(x, axis=tuple(range(2, x.ndim)),
+                              keepdims=True))
+
+
+@op_builder("onnx.slice")
+def _b_slice(axes, starts, ends, steps):
+    def f(x, *_r):
+        sl = [slice(None)] * x.ndim
+        for a, st, en, sp in zip(axes, starts, ends, steps):
+            sl[a if a >= 0 else x.ndim + a] = slice(st, en, sp)
+        return x[tuple(sl)]
+    return f
+
+
+@op_builder("onnx.slice_axis")
+def _b_slice_axis(axis, start, size):
+    return lambda x, *_r: lax.slice_in_dim(x, start, start + size,
+                                           axis=axis)
 
 
 @op_builder("onnx.conv")
@@ -516,17 +544,76 @@ class OnnxGraphMapper:
             axes = [int(a) for a in (axes or [])]
             sd._op_named(out, "onnx." + op.lower(), None, *ins,
                          params={"axes": axes})
-        elif op == "ReduceMean":
+        elif op in ("ReduceMean", "ReduceSum", "ReduceMax", "ReduceMin"):
             axes = node.attrs.get("axes")
-            if axes is None and len(node.inputs) > 1:   # opset-18: input
-                av = const_val(1)
+            if axes is None and len(node.inputs) > 1 and node.inputs[1]:
+                av = const_val(1)   # opset-13/18+: axes as input
                 if av is None:
                     raise UnsupportedOnnxOpError(
-                        f"{out}: dynamic ReduceMean axes unsupported")
+                        f"{out}: dynamic {op} axes unsupported")
                 axes = np.asarray(av).reshape(-1).tolist()
-            sd._op_named(out, "onnx.reduce_mean", None, *ins, params={
-                "axes": [int(a) for a in (axes or [])],
-                "keep": int(_attr(node, "keepdims", 1))})
+            if not axes and int(_attr(node, "noop_with_empty_axes", 0)):
+                # spec: empty axes + the flag == identity, NOT reduce-all
+                sd._op_named(out, "onnx.identity", None, ins[0], params={})
+            else:
+                sd._op_named(out, "onnx.reduce_" + op[6:].lower(), None,
+                             *ins, params={
+                                 "axes": [int(a) for a in (axes or [])],
+                                 "keep": int(_attr(node, "keepdims", 1))})
+        elif op == "GlobalMaxPool":
+            sd._op_named(out, "onnx.gmp", None, *ins, params={})
+        elif op == "Slice":
+            starts = node.attrs.get("starts")
+            ends = node.attrs.get("ends")
+            axes = node.attrs.get("axes")
+            steps = None
+            if starts is None:        # opset-10+: inputs 1..4
+                def _slice_cv(i):
+                    if len(node.inputs) > i and node.inputs[i]:
+                        av = const_val(i)
+                        if av is None:
+                            raise UnsupportedOnnxOpError(
+                                f"{out}: dynamic Slice unsupported")
+                        return np.asarray(av).reshape(-1).tolist()
+                    return None
+                starts, ends = _slice_cv(1), _slice_cv(2)
+                axes, steps = _slice_cv(3), _slice_cv(4)
+            if starts is None or ends is None:
+                raise UnsupportedOnnxOpError(
+                    f"{out}: Slice needs constant starts/ends")
+            n_ = len(starts)
+            axes = (list(range(n_)) if axes is None
+                    else [int(a) for a in axes])
+            steps = ([1] * n_ if steps is None
+                     else [int(x_) for x_ in steps])
+            # clamp ONNX's INT64_MAX "to the end" sentinels into python
+            # slice range
+            big = 2 ** 31
+            sd._op_named(out, "onnx.slice", None, *ins, params={
+                "axes": axes,
+                "starts": [int(max(-big, min(big, v))) for v in starts],
+                "ends": [int(max(-big, min(big, v))) for v in ends],
+                "steps": steps})
+        elif op == "Split":
+            axis = int(_attr(node, "axis", 0))
+            sizes = node.attrs.get("split")
+            if sizes is None and len(node.inputs) > 1 and node.inputs[1]:
+                av = const_val(1)   # opset-13+: split sizes as input
+                if av is None:
+                    raise UnsupportedOnnxOpError(
+                        f"{out}: dynamic Split sizes unsupported")
+                sizes = np.asarray(av).reshape(-1).tolist()
+            if sizes is None:
+                raise UnsupportedOnnxOpError(
+                    f"{out}: Split without explicit sizes unsupported "
+                    "(equal split needs a static dim — export with the "
+                    "'split' attribute/input)")
+            off = 0
+            for i, o_name in enumerate(node.outputs):
+                sd._op_named(o_name, "onnx.slice_axis", None, *ins,
+                             params={"axis": axis, "start": off,
+                                     "size": int(sizes[i])})
+                off += int(sizes[i])
         elif op == "Conv":
             auto, pads = _pads_params(node)
             sd._op_named(out, "onnx.conv", None, *ins, params={

@@ -472,3 +472,128 @@ class TestRound4Session4Ops:
         got = np.asarray(importOnnx(model).outputSingle(
             {"x": x}, "y").jax())
         np.testing.assert_array_equal(got, x.repeat(2, 2).repeat(2, 3))
+
+
+class TestSplitSliceReduce:
+    """Round-5 importer tail: Split / Slice / ReduceSum-Max / GlobalMaxPool."""
+
+    def test_split_with_sizes(self):
+        model = onnx_model(
+            [onnx_node("Split", ["x"], ["a", "b", "c"], axis=1,
+                       split=[1, 2, 3])],
+            {}, {"x": [2, 6]}, ["a", "b", "c"])
+        sd = importOnnx(model)
+        x = np.arange(12, dtype=np.float32).reshape(2, 6)
+        outs = sd.output({"x": x}, ["a", "b", "c"])
+        np.testing.assert_array_equal(np.asarray(outs["a"].jax()),
+                                      x[:, :1])
+        np.testing.assert_array_equal(np.asarray(outs["b"].jax()),
+                                      x[:, 1:3])
+        np.testing.assert_array_equal(np.asarray(outs["c"].jax()),
+                                      x[:, 3:])
+
+    def test_split_without_sizes_rejected(self):
+        model = onnx_model(
+            [onnx_node("Split", ["x"], ["a", "b"], axis=1)],
+            {}, {"x": [2, 6]}, ["a", "b"])
+        with pytest.raises(UnsupportedOnnxOpError, match="Split"):
+            importOnnx(model)
+
+    def test_slice_opset10_inputs(self):
+        starts = np.asarray([1, 0], np.int64)
+        ends = np.asarray([3, 2 ** 40], np.int64)   # INT-huge "to end"
+        axes = np.asarray([0, 2], np.int64)
+        steps = np.asarray([1, 2], np.int64)
+        model = onnx_model(
+            [onnx_node("Slice", ["x", "s", "e", "a", "st"], ["y"])],
+            {"s": starts, "e": ends, "a": axes, "st": steps},
+            {"x": [4, 3, 6]}, ["y"])
+        sd = importOnnx(model)
+        x = np.random.default_rng(0).normal(size=(4, 3, 6)).astype(
+            np.float32)
+        got = np.asarray(sd.outputSingle({"x": x}, "y").jax())
+        np.testing.assert_array_equal(got, x[1:3, :, 0::2])
+
+    def test_slice_negative_start(self):
+        model = onnx_model(
+            [onnx_node("Slice", ["x"], ["y"], starts=[-2], ends=[2 ** 30],
+                       axes=[1])],
+            {}, {"x": [2, 5]}, ["y"])
+        sd = importOnnx(model)
+        x = np.arange(10, dtype=np.float32).reshape(2, 5)
+        got = np.asarray(sd.outputSingle({"x": x}, "y").jax())
+        np.testing.assert_array_equal(got, x[:, -2:])
+
+    def test_reduce_sum_and_max(self):
+        model = onnx_model(
+            [onnx_node("ReduceSum", ["x"], ["s"], axes=[1], keepdims=0),
+             onnx_node("ReduceMax", ["x"], ["m"], keepdims=1)],
+            {}, {"x": [2, 3, 4]}, ["s", "m"])
+        sd = importOnnx(model)
+        x = np.random.default_rng(1).normal(size=(2, 3, 4)).astype(
+            np.float32)
+        outs = sd.output({"x": x}, ["s", "m"])
+        np.testing.assert_allclose(np.asarray(outs["s"].jax()),
+                                   x.sum(1), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(outs["m"].jax()),
+                                   x.max(keepdims=True), rtol=1e-6)
+
+    def test_reduce_sum_opset13_axes_input(self):
+        model = onnx_model(
+            [onnx_node("ReduceSum", ["x", "ax"], ["y"], keepdims=0)],
+            {"ax": np.asarray([0], np.int64)}, {"x": [3, 2]}, ["y"])
+        sd = importOnnx(model)
+        x = np.ones((3, 2), np.float32)
+        np.testing.assert_allclose(
+            np.asarray(sd.outputSingle({"x": x}, "y").jax()),
+            np.full(2, 3.0), rtol=1e-6)
+
+    def test_global_max_pool(self):
+        model = onnx_model(
+            [onnx_node("GlobalMaxPool", ["x"], ["y"])],
+            {}, {"x": [1, 2, 4, 4]}, ["y"])
+        sd = importOnnx(model)
+        x = np.random.default_rng(2).normal(size=(1, 2, 4, 4)).astype(
+            np.float32)
+        got = np.asarray(sd.outputSingle({"x": x}, "y").jax())
+        np.testing.assert_allclose(got, x.max((2, 3), keepdims=True))
+
+    def test_split_roundtrips_through_serde(self, tmp_path):
+        model = onnx_model(
+            [onnx_node("Split", ["x"], ["a", "b"], axis=0, split=[1, 1]),
+             onnx_node("Add", ["a", "b"], ["y"])],
+            {}, {"x": [2, 3]}, ["y"])
+        sd = importOnnx(model)
+        x = np.random.default_rng(3).normal(size=(2, 3)).astype(np.float32)
+        want = np.asarray(sd.outputSingle({"x": x}, "y").jax())
+        art = tmp_path / "split.sdz"
+        sd.save(art)
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        got = np.asarray(SameDiff.load(art).outputSingle({"x": x},
+                                                         "y").jax())
+        np.testing.assert_array_equal(got, want)
+
+
+def test_reduce_noop_with_empty_axes():
+    model = onnx_model(
+        [onnx_node("ReduceSum", ["x"], ["y"], keepdims=1,
+                   noop_with_empty_axes=1)],
+        {}, {"x": [2, 3]}, ["y"])
+    sd = importOnnx(model)
+    x = np.random.default_rng(4).normal(size=(2, 3)).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(sd.outputSingle({"x": x}, "y").jax()), x)
+
+
+def test_global_pools_rank3():
+    model = onnx_model(
+        [onnx_node("GlobalMaxPool", ["x"], ["m"]),
+         onnx_node("GlobalAveragePool", ["x"], ["a"])],
+        {}, {"x": [2, 3, 5]}, ["m", "a"])
+    sd = importOnnx(model)
+    x = np.random.default_rng(5).normal(size=(2, 3, 5)).astype(np.float32)
+    outs = sd.output({"x": x}, ["m", "a"])
+    np.testing.assert_allclose(np.asarray(outs["m"].jax()),
+                               x.max(2, keepdims=True))
+    np.testing.assert_allclose(np.asarray(outs["a"].jax()),
+                               x.mean(2, keepdims=True), rtol=1e-6)
