@@ -394,6 +394,14 @@ def _b_tuple_get(i):
     return lambda t: t[i]
 
 
+@op_builder("slice_axis")
+def _b_slice_axis(axis, start, size):
+    """Shared slice-by-axis (ONNX Split / TF SplitV lower onto this);
+    lax.slice_in_dim canonicalizes negative axes itself."""
+    return lambda x, *_r: jax.lax.slice_in_dim(x, start, start + size,
+                                               axis=axis)
+
+
 # -- persistence ----------------------------------------------------------
 def _opt_leaves(sd):
     """Optimizer-state leaves in tree_flatten order — live state if the

@@ -303,10 +303,7 @@ def _b_slice(axes, starts, ends, steps):
     return f
 
 
-@op_builder("onnx.slice_axis")
-def _b_slice_axis(axis, start, size):
-    return lambda x, *_r: lax.slice_in_dim(x, start, start + size,
-                                           axis=axis)
+
 
 
 @op_builder("onnx.conv")
@@ -610,7 +607,7 @@ class OnnxGraphMapper:
                     "'split' attribute/input)")
             off = 0
             for i, o_name in enumerate(node.outputs):
-                sd._op_named(o_name, "onnx.slice_axis", None, *ins,
+                sd._op_named(o_name, "slice_axis", None, *ins,
                              params={"axis": axis, "start": off,
                                      "size": int(sizes[i])})
                 off += int(sizes[i])

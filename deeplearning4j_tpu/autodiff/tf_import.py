@@ -21,10 +21,13 @@ from deeplearning4j_tpu.autodiff import tfproto
 
 
 def _clean_ref(ref):
-    """strip ':0' output index; None for '^control' deps."""
+    """Strip a ':0' output index; KEEP ':N' for N > 0 (multi-output ops —
+    Split/SplitV/Unpack register one node per output under 'name:N');
+    None for '^control' deps."""
     if ref.startswith("^"):
         return None
-    return ref.split(":")[0]
+    base, _, idx = ref.partition(":")
+    return base if idx in ("", "0") else ref
 
 
 class UnsupportedTFOpError(ValueError):
@@ -62,6 +65,41 @@ from deeplearning4j_tpu.autodiff.graph_serde import op_builder  # noqa: E402
 for _opn, _fn in _ELEMENTWISE.items():
     op_builder("tf." + _opn.lower())((lambda f: lambda: f)(_fn))
 op_builder("tf.softmax")(lambda: lambda x: jax.nn.softmax(x, axis=-1))
+op_builder("tf.softplus")(lambda: jax.nn.softplus)
+op_builder("tf.addn")(lambda: lambda *xs: sum(xs[1:], xs[0]))
+
+
+@op_builder("tf.leaky_relu")
+def _b_leaky_relu(alpha=0.2):
+    return lambda x: jnp.where(x > 0, x, alpha * x)
+
+
+@op_builder("tf.split_axis")
+def _b_split_axis(axis, index, num):
+    # equal split: the slice size resolves from the STATIC shape at
+    # trace time (TF Split carries only num_split)
+    def f(x, *_r):
+        ax = axis if axis >= 0 else x.ndim + axis
+        if x.shape[ax] % num:
+            raise ValueError(
+                f"Split: dim {ax} ({x.shape[ax]}) not divisible by "
+                f"num_split={num}")
+        size = x.shape[ax] // num
+        return jax.lax.slice_in_dim(x, index * size, (index + 1) * size,
+                                    axis=ax)
+    return f
+
+
+@op_builder("tf.unstack_idx")
+def _b_unstack_idx(axis, index, num):
+    def f(x):
+        ax = axis if axis >= 0 else x.ndim + axis
+        if x.shape[ax] != num:
+            raise ValueError(
+                f"Unpack: num={num} but dim {ax} is {x.shape[ax]}")
+        return jnp.squeeze(
+            jax.lax.slice_in_dim(x, index, index + 1, axis=ax), axis=ax)
+    return f
 op_builder("tf.shape")(lambda: lambda x: jnp.asarray(x.shape, jnp.int32))
 op_builder("tf.rsqrt")(lambda: jax.lax.rsqrt)
 
@@ -321,6 +359,59 @@ class TFGraphMapper:
             sd._op_named(name, "tf.shape", None, *ins, params={})
         elif op == "Rsqrt":
             sd._op_named(name, "tf.rsqrt", None, *ins, params={})
+        elif op == "Softplus":
+            sd._op_named(name, "tf.softplus", None, *ins, params={})
+        elif op == "LeakyRelu":
+            a = node.attrs.get("alpha")
+            sd._op_named(name, "tf.leaky_relu", None, *ins, params={
+                "alpha": 0.2 if a is None else float(a)})
+        elif op == "AddN":
+            sd._op_named(name, "tf.addn", None, *ins, params={})
+        elif op == "Split":
+            # TF v1 Split: inputs [split_dim, value], attr num_split;
+            # equal split — sizes resolve from the static shape at trace
+            av = const_val(0)
+            if av is None:
+                raise UnsupportedTFOpError(
+                    f"{name}: dynamic Split axis unsupported")
+            axis = int(np.asarray(av).reshape(()))
+            num = int(node.attrs.get("num_split", 0) or 0)
+            if num <= 0:
+                raise UnsupportedTFOpError(
+                    f"{name}: Split needs the num_split attribute")
+            for i in range(num):
+                out_name = name if i == 0 else f"{name}:{i}"
+                # the VALUE is input[1] (input[0] is the axis const)
+                sd._op_named(out_name, "tf.split_axis", None, ins[1],
+                             params={"axis": axis, "index": i,
+                                     "num": num})
+        elif op == "SplitV":
+            # inputs [value, size_splits, axis]
+            sizes = const_val(1)
+            ax_v = const_val(2)
+            if sizes is None or ax_v is None:
+                raise UnsupportedTFOpError(
+                    f"{name}: dynamic SplitV sizes/axis unsupported")
+            axis = int(np.asarray(ax_v).reshape(()))
+            sizes = [int(v) for v in np.asarray(sizes).reshape(-1)]
+            if any(v < 0 for v in sizes):
+                raise UnsupportedTFOpError(
+                    f"{name}: SplitV -1 (inferred) size unsupported")
+            off = 0
+            for i, sz in enumerate(sizes):
+                out_name = name if i == 0 else f"{name}:{i}"
+                sd._op_named(out_name, "slice_axis", None, ins[0],
+                             params={"axis": axis, "start": off,
+                                     "size": sz})
+                off += sz
+        elif op == "Unpack":
+            axis = int(node.attrs.get("axis", 0) or 0)
+            num = int(node.attrs.get("num", 1) or 1)
+            for i in range(num):
+                out_name = name if i == 0 else f"{name}:{i}"
+                sd._op_named(out_name, "tf.unstack_idx", None, *ins,
+                             params={"axis": axis, "index": i,
+                                     "num": num})
         elif op == "Tile":
             reps = const_val(1)
             sd._op_named(name, "tf.tile", None, *ins, params={

@@ -383,3 +383,88 @@ class TestFrozenCnnOps:
         ])
         with pytest.raises(UnsupportedTFOpError, match="batch/channel"):
             importFrozenTF(data)
+
+
+class TestSplitUnpackTail:
+    """Round-5 TF importer tail: Split/SplitV/Unpack (multi-output ':N'
+    refs), AddN, LeakyRelu, Softplus."""
+
+    def test_split_equal_and_output_refs(self):
+        data = tfproto.encode_graphdef([
+            ("x", "Placeholder", [], {}),
+            ("axis", "Const", [], {"value": np.asarray(1, np.int32)}),
+            ("split", "Split", ["axis", "x"], {"num_split": 3}),
+            ("y", "Sub", ["split:2", "split"], {}),   # out2 - out0
+        ])
+        sd = importFrozenTF(data)
+        x = np.arange(12, dtype=np.float32).reshape(2, 6)
+        got = np.asarray(sd.outputSingle({"x": x}, "y").jax())
+        np.testing.assert_array_equal(got, x[:, 4:6] - x[:, 0:2])
+
+    def test_splitv_sizes(self):
+        data = tfproto.encode_graphdef([
+            ("x", "Placeholder", [], {}),
+            ("sizes", "Const", [], {"value": np.asarray([1, 3],
+                                                        np.int32)}),
+            ("axis", "Const", [], {"value": np.asarray(1, np.int32)}),
+            ("sv", "SplitV", ["x", "sizes", "axis"], {}),
+        ])
+        sd = importFrozenTF(data)
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        outs = sd.output({"x": x}, ["sv", "sv:1"])
+        np.testing.assert_array_equal(np.asarray(outs["sv"].jax()),
+                                      x[:, :1])
+        np.testing.assert_array_equal(np.asarray(outs["sv:1"].jax()),
+                                      x[:, 1:])
+
+    def test_unpack_addn_leakyrelu_softplus(self):
+        data = tfproto.encode_graphdef([
+            ("x", "Placeholder", [], {}),
+            ("u", "Unpack", ["x"], {"axis": 0, "num": 2}),
+            ("s", "AddN", ["u", "u:1"], {}),
+            ("l", "LeakyRelu", ["s"], {"alpha": 0.1}),
+            ("p", "Softplus", ["l"], {}),
+        ])
+        sd = importFrozenTF(data)
+        x = np.asarray([[[1.0, -2.0], [3.0, -4.0]],
+                        [[5.0, -6.0], [7.0, -8.0]]], np.float32)
+        got = np.asarray(sd.outputSingle({"x": x}, "p").jax())
+        s = x[0] + x[1]
+        leaky = np.where(s > 0, s, 0.1 * s)
+        np.testing.assert_allclose(got, np.log1p(np.exp(-np.abs(leaky)))
+                                   + np.maximum(leaky, 0), rtol=1e-5)
+
+    def test_split_roundtrips_through_serde(self, tmp_path):
+        data = tfproto.encode_graphdef([
+            ("x", "Placeholder", [], {}),
+            ("axis", "Const", [], {"value": np.asarray(0, np.int32)}),
+            ("sp", "Split", ["axis", "x"], {"num_split": 2}),
+            ("y", "Add", ["sp", "sp:1"], {}),
+        ])
+        sd = importFrozenTF(data)
+        x = np.random.default_rng(6).normal(size=(4, 3)).astype(np.float32)
+        want = np.asarray(sd.outputSingle({"x": x}, "y").jax())
+        art = tmp_path / "tfsplit.sdz"
+        sd.save(art)
+        got = np.asarray(SameDiff.load(art).outputSingle({"x": x},
+                                                         "y").jax())
+        np.testing.assert_array_equal(got, want)
+
+
+def test_split_indivisible_and_leakyrelu_zero_alpha():
+    data = tfproto.encode_graphdef([
+        ("x", "Placeholder", [], {}),
+        ("axis", "Const", [], {"value": np.asarray(1, np.int32)}),
+        ("sp", "Split", ["axis", "x"], {"num_split": 2}),
+    ])
+    sd = importFrozenTF(data)
+    with pytest.raises(ValueError, match="divisible"):
+        sd.outputSingle({"x": np.zeros((2, 7), np.float32)}, "sp")
+    data2 = tfproto.encode_graphdef([
+        ("x", "Placeholder", [], {}),
+        ("y", "LeakyRelu", ["x"], {"alpha": 0.0}),   # == plain relu
+    ])
+    sd2 = importFrozenTF(data2)
+    got = np.asarray(sd2.outputSingle(
+        {"x": np.asarray([-1.0, 2.0], np.float32)}, "y").jax())
+    np.testing.assert_array_equal(got, [0.0, 2.0])
