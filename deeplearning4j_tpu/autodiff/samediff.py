@@ -907,10 +907,20 @@ class SameDiff:
 
         return step
 
-    def fit(self, dataset=None, labels=None, placeholders=None):
+    def fit(self, dataset=None, labels=None, placeholders=None, epochs=1):
         """fit(DataSet) using TrainingConfig mappings, fit(features,
-        labels) arrays through the same mappings, or
-        fit(placeholders=dict) feeding everything directly."""
+        labels) arrays through the same mappings, fit(placeholders=dict)
+        feeding everything directly, or — ≡ SameDiff.fit(DataSetIterator,
+        numEpochs) — fit(iterator, epochs=N): trains every batch of the
+        iterator per epoch and returns the per-batch loss history (a
+        plain list, ≡ the reference's History losscurve)."""
+        if hasattr(dataset, "hasNext") and hasattr(dataset, "next"):
+            history = []
+            for _ in range(int(epochs)):
+                dataset.reset()
+                while dataset.hasNext():
+                    history.append(self.fit(dataset.next()))
+            return history
         self._ensure_optimizer()
         tc = self._training_config
         if isinstance(labels, dict):

@@ -323,3 +323,33 @@ def test_evaluate_multi_output_graph():
     import pytest
     with pytest.raises(ValueError, match="label index"):
         sd.evaluate(it, {"p1": Evaluation()}, labelIndex={"p1": 5})
+
+
+def test_fit_iterator_epochs():
+    """≡ SameDiff.fit(DataSetIterator, numEpochs): per-batch loss history,
+    training actually progresses."""
+    import numpy as np
+
+    from deeplearning4j_tpu.autodiff.samediff import (SameDiff,
+                                                      TrainingConfig)
+    from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", None, 4)
+    w = sd.var("w", np.random.RandomState(0).randn(4, 2).astype(
+        np.float32))
+    y = sd.placeHolder("y", None, 2)
+    sd.loss.meanSquaredError("loss", y, x.mmul(w))
+    sd.setLossVariables("loss")
+    sd.setTrainingConfig(TrainingConfig(updater=Adam(5e-2),
+                                        dataSetFeatureMapping=["x"],
+                                        dataSetLabelMapping=["y"]))
+    rng = np.random.RandomState(1)
+    xs = rng.randn(64, 4).astype(np.float32)
+    w_true = rng.randn(4, 2).astype(np.float32)
+    it = ArrayDataSetIterator(xs, (xs @ w_true).astype(np.float32),
+                              batch_size=16)
+    history = sd.fit(it, epochs=40)
+    assert len(history) == 4 * 40          # batches x epochs
+    assert history[-1] < history[0] * 0.2  # converging
