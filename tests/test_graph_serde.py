@@ -316,7 +316,8 @@ class TestSerializableControlFlow:
                                  "keep", body, ["n2", "acc2"])
         outs[1].rename("final")
         # 5 doublings: acc = 32
-        assert float(np.asarray(sd.outputSingle({}, "final").jax())) == 32.0
+        assert float(np.asarray(
+            sd.outputSingle({}, "final").jax()).ravel()[0]) == 32.0
         art = tmp_path / "while.sdz"
         sd.save(art)
         got = _subprocess_output(art, np.zeros((1, 1), np.float32),
@@ -355,11 +356,11 @@ class TestSerializableControlFlow:
         outs = sd.forLoopGraph("f", 4, [s0], ["s"], body, ["s2"])
         outs[0].rename("total")
         assert float(np.asarray(
-            sd.outputSingle({}, "total").jax())) == 1 + 2 + 3 + 4
+            sd.outputSingle({}, "total").jax()).ravel()[0]) == 1 + 2 + 3 + 4
         art = tmp_path / "for.sdz"
         sd.save(art)
         assert float(np.asarray(SameDiff.load(art).outputSingle(
-            {}, "total").jax())) == 10.0
+            {}, "total").jax()).ravel()[0]) == 10.0
 
     def test_subgraph_with_adhoc_ops_rejected(self):
         import jax.numpy as jnp
