@@ -39,3 +39,28 @@ class TestLastPartial:
         first_brace = next(line for line in out.splitlines()
                            if line.strip().startswith("{"))
         assert json.loads(first_brace)["value"] == 5.0
+
+
+def test_median_of_windows_extends_on_spread():
+    import bench
+
+    # stable series: exactly k windows run
+    calls = []
+
+    def stable(i):
+        calls.append(i)
+        return 100.0 + (i % 2)   # spread 1% << 20%
+    med, vals, spread = bench._median_of_windows(stable, k=5)
+    assert len(vals) == 5 and calls == [0, 1, 2, 3, 4]
+    assert spread < 0.2 and 100.0 <= med <= 101.0
+
+    # noisy series: keeps adding windows to max_k
+    seq = iter([100.0, 200.0, 100.0, 200.0, 100.0, 200.0, 100.0, 200.0,
+                100.0])
+
+    def noisy(i):
+        return next(seq)
+    med2, vals2, spread2 = bench._median_of_windows(noisy, k=5, max_k=9)
+    assert len(vals2) == 9          # capped, never infinite
+    assert spread2 > 0.2            # honestly recorded even at the cap
+    assert med2 in (100.0, 150.0, 200.0)
