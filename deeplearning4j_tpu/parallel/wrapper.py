@@ -181,9 +181,60 @@ class ParallelWrapper:
             listener.iterationDone(m, m._iteration, m._epoch)
         return m._score
 
-    def fit(self, iterator, epochs=1):
+    # -- scanned dispatch (round-5): k same-shape batches in ONE sharded
+    # dispatch, reusing the model's _train_scan — the dp-path answer to
+    # the per-dispatch tunnel cost the r4 stepsPerDispatch A/B measured
+    # (bit-identical to the sequential loop, like the single-device form)
+    @staticmethod
+    def _scan_sig(ds):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        if isinstance(ds, MultiDataSet):
+            return None   # multi data routes through the single path
+        def sh(a):
+            return None if a is None else tuple(np.shape(a))
+        return (sh(ds.features), sh(ds.labels), sh(ds.featuresMask),
+                sh(ds.labelsMask))
+
+    def _fit_group_scanned(self, group):
+        m = self.model
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh2 = NamedSharding(self.mesh.mesh, P(None, "dp"))  # (k, B, ...)
+        subs = []
+        for _ in group:   # identical key stream to the sequential path
+            m._rng_key, sub = jax.random.split(m._rng_key)
+            subs.append(sub)
+
+        def stack_put(field):
+            arrs = [getattr(ds, field) for ds in group]
+            if arrs[0] is None:
+                return None
+            return jax.device_put(
+                np.stack([np.asarray(a) for a in arrs]), sh2)
+
+        xs, ys = stack_put("features"), stack_put("labels")
+        fms, lms = stack_put("featuresMask"), stack_put("labelsMask")
+        import jax.numpy as jnp
+        if self._graph_model():
+            ins, labels, fmasks, lmasks = m._pack_single(xs, ys, fms, lms)
+            (m._params, m._opt_state, m._state,
+             losses) = m._train_scan(m._params, m._opt_state, m._state,
+                                     ins, labels, fmasks, lmasks,
+                                     jnp.stack(subs))
+        else:
+            (m._params, m._opt_state, m._state,
+             losses) = m._train_scan(m._params, m._opt_state, m._state,
+                                     xs, ys, fms, lms, jnp.stack(subs))
+        for loss in jax.device_get(losses):
+            m._score = float(loss)
+            m._iteration += 1
+            for listener in m._listeners:
+                listener.iterationDone(m, m._iteration, m._epoch)
+
+    def fit(self, iterator, epochs=1, stepsPerDispatch=1):
         """Data-parallel fit: same jitted train step as the wrapped model —
-        input sharding makes it SPMD over the dp axis."""
+        input sharding makes it SPMD over the dp axis. stepsPerDispatch=k
+        scans k same-shape batches inside ONE dispatch (ragged/odd batches
+        fall back to the per-batch step; numerics identical either way)."""
         if self.model._params is None:
             self.model.init()
         self._shard_model()
@@ -191,11 +242,39 @@ class ParallelWrapper:
         if self.prefetch_buffer and hasattr(iterator, "asyncSupported") \
                 and iterator.asyncSupported():
             it = AsyncDataSetIterator(iterator, self.prefetch_buffer)
+        k = max(1, int(stepsPerDispatch))
         for _ in range(int(epochs)):
             if hasattr(it, "reset"):
                 it.reset()
-            for ds in it:
-                self._fit_dataset(ds)
+            if k == 1:
+                for ds in it:
+                    self._fit_dataset(ds)
+            else:
+                group, sig = [], None
+
+                def flush():
+                    nonlocal group
+                    for g in group:   # sub-k groups run singly
+                        self._fit_dataset(g)
+                    group = []
+
+                for ds in it:
+                    s = self._scan_sig(ds)
+                    scannable = (s is not None
+                                 and s[0][0] % self.mesh.size == 0)
+                    if not scannable:
+                        flush()
+                        sig = None
+                        self._fit_dataset(ds)
+                        continue
+                    if s != sig:
+                        flush()
+                        sig = s
+                    group.append(ds)
+                    if len(group) == k:
+                        self._fit_group_scanned(group)
+                        group = []
+                flush()
             self.model._epoch += 1
         return self.model
 
