@@ -183,8 +183,10 @@ class ParallelWrapper:
 
     # -- scanned dispatch (round-5): k same-shape batches in ONE sharded
     # dispatch, reusing the model's _train_scan — the dp-path answer to
-    # the per-dispatch tunnel cost the r4 stepsPerDispatch A/B measured
-    # (bit-identical to the sequential loop, like the single-device form)
+    # the per-dispatch tunnel cost the r4 stepsPerDispatch A/B measured.
+    # Same rng key stream and math as the sequential loop: dense models
+    # come out bit-identical; conv models can differ by fp-reassociation
+    # noise (~1e-6) because XLA fuses the scanned conv body differently
     @staticmethod
     def _scan_sig(ds):
         from deeplearning4j_tpu.datasets.dataset import MultiDataSet
@@ -234,7 +236,8 @@ class ParallelWrapper:
         """Data-parallel fit: same jitted train step as the wrapped model —
         input sharding makes it SPMD over the dp axis. stepsPerDispatch=k
         scans k same-shape batches inside ONE dispatch (ragged/odd batches
-        fall back to the per-batch step; numerics identical either way)."""
+        fall back to the per-batch step; same key stream and math — dense
+        models bit-identical, conv models within fp-reassociation noise)."""
         if self.model._params is None:
             self.model.init()
         self._shard_model()

@@ -795,3 +795,35 @@ def test_parallel_wrapper_scanned_graph_model(devices8):
     for a, b in zip(jax.tree_util.tree_leaves(g1._params),
                     jax.tree_util.tree_leaves(g2._params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_parallel_wrapper_scanned_conv_model_numerics(devices8):
+    """Conv models under the wrapper scan: XLA fuses the scanned body
+    differently, so the contract is fp-reassociation-level equality
+    (dense models stay bit-exact — test above)."""
+    from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                                   SubsamplingLayer)
+
+    def lenet():
+        conf = (NeuralNetConfiguration.Builder().seed(12).updater(
+            Adam(1e-2)).list()
+            .layer(ConvolutionLayer(nOut=8, kernelSize=(5, 5),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(nOut=16, activation="relu"))
+            .layer(OutputLayer.Builder("mcxent").nOut(10)
+                   .activation("softmax").build())
+            .setInputType(InputType.convolutionalFlat(28, 28, 1)).build())
+        return MultiLayerNetwork(conf).init()
+
+    from deeplearning4j_tpu.datasets.iterators import MnistDataSetIterator
+    a, b = lenet(), lenet()
+    ParallelWrapper.Builder(a).workers(8).build().fit(
+        MnistDataSetIterator(64, num_examples=256), epochs=2)
+    ParallelWrapper.Builder(b).workers(8).build().fit(
+        MnistDataSetIterator(64, num_examples=256), epochs=2,
+        stepsPerDispatch=2)
+    for la, lb in zip(jax.tree_util.tree_leaves(a._params),
+                      jax.tree_util.tree_leaves(b._params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   atol=1e-5)
