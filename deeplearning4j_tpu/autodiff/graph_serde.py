@@ -418,7 +418,10 @@ def save_samediff(sd, path, values_only=False, save_updater=False):
     values_only=True skips the graph leg entirely (checkpointing for
     graphs with such nodes — re-build in code, then load_values);
     save_updater=True (≡ the reference's saveUpdaterState flag) also
-    persists the optimizer-state leaves so fit() resumes mid-momentum."""
+    persists the optimizer-state leaves so fit() resumes mid-momentum —
+    in BOTH artifact forms: load_samediff restores them via
+    doc["updater_state_leaves"], and SameDiff.load_values restores the
+    `__updater__N` arrays from values-only checkpoints too."""
     from deeplearning4j_tpu.autodiff.samediff import VariableType
     from deeplearning4j_tpu.util.serde import encode
 

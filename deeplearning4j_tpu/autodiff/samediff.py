@@ -1003,7 +1003,14 @@ class SameDiff:
         """Load ONLY the values from a save() artifact into THIS graph
         (the old partial-restore surface, kept for API compatibility;
         also reads values_only=True artifacts and legacy pre-r5 pickle
-        checkpoints written by this module's old save())."""
+        checkpoints written by this module's old save()).
+
+        Artifacts written with save_updater=True carry `__updater__N`
+        optimizer-state leaves; those are restored too — spliced straight
+        into a live optimizer, or parked in `_pending_opt_leaves` for
+        `_ensure_optimizer` to consume on the first fit() — so a
+        values-only checkpoint resumes mid-momentum instead of silently
+        dropping the updater state (ADVICE r5, graph_serde.py:425)."""
         import io
         import zipfile
 
@@ -1023,8 +1030,29 @@ class SameDiff:
             import pickle
             with open(path, "rb") as f:
                 values = pickle.load(f)["values"]
+        upd_prefix = "__updater__"
+        upd_keys = sorted((k for k in values if k.startswith(upd_prefix)),
+                          key=lambda k: int(k[len(upd_prefix):]))
+        treedef = None
+        if upd_keys and self._opt_state is not None:
+            # validate BEFORE mutating anything: a mismatch must leave
+            # the graph exactly as it was (values, caches, optimizer)
+            treedef = jax.tree_util.tree_structure(self._opt_state)
+            if treedef.num_leaves != len(upd_keys):
+                raise ValueError(
+                    f"updater state in artifact has {len(upd_keys)} "
+                    f"leaves but this optimizer has "
+                    f"{treedef.num_leaves} — was the training config "
+                    "changed since the checkpoint?")
         for k, v in values.items():
             if k in self._values:
                 self._values[k] = jnp.asarray(v)
+        if upd_keys:
+            leaves = [jnp.asarray(values[k]) for k in upd_keys]
+            if treedef is not None:
+                self._opt_state = jax.tree_util.tree_unflatten(treedef,
+                                                               leaves)
+            else:
+                self._pending_opt_leaves = leaves
         self._invalidate()
         return self

@@ -151,22 +151,27 @@ def _attention(cfg, layer, x, attn_mask, train, rng, attn_impl):
             ctx = attn_impl(q, k, v)
         else:
             # a padded batch must never silently attend to padding: the
-            # custom impl either takes the mask (ulysses does) or the
-            # call fails loudly here. Arity is checked via bind() so a
-            # TypeError from INSIDE a mask-accepting impl is never
-            # misdiagnosed as a signature problem.
-            import inspect
-            try:
-                inspect.signature(attn_impl).bind(q, k, v, attn_mask)
-            except TypeError as e:
+            # custom impl has to DECLARE the mask — a 4th positional
+            # slot or an explicit 'mask'/'attn_mask'/'kv_mask' keyword
+            # parameter, passed by whichever convention the signature
+            # supports. Bare *args/**kwargs catch-alls are rejected: a
+            # kwargs-swallowing impl would pass an arity bind() and drop
+            # the mask silently (ADVICE r5). Non-introspectable
+            # signatures are refused too — wrap them to declare the mask.
+            from deeplearning4j_tpu.util.introspect import \
+                explicit_mask_param
+            conv = explicit_mask_param(attn_impl, positional_slot=4)
+            if conv is None:
                 raise ValueError(
-                    "attn_impl callable does not accept a mask argument "
-                    "but the batch carries attention_mask — use a "
-                    "masked impl (flash/dense) or an "
-                    "attn_impl(q, k, v, mask)") from e
-            except ValueError:
-                pass   # signature not introspectable: attempt the call
-            ctx = attn_impl(q, k, v, attn_mask)
+                    "attn_impl callable does not explicitly declare a "
+                    "mask parameter (bare *args/**kwargs or a "
+                    "non-introspectable signature does not count) but "
+                    "the batch carries attention_mask — use a masked "
+                    "impl (flash/dense) or an attn_impl(q, k, v, mask)")
+            if conv[0] == "positional":
+                ctx = attn_impl(q, k, v, attn_mask)
+            else:
+                ctx = attn_impl(q, k, v, **{conv[1]: attn_mask})
     elif attn_impl in ("blockwise", "flash"):
         if attn_impl == "flash":
             from deeplearning4j_tpu.kernels import flash_attention

@@ -1,0 +1,68 @@
+"""Unified host-side metrics + span tracing (the monitoring subsystem).
+
+Dependency-free, disabled by default, and wired through the trainers
+(`nn/multilayer.py`, `nn/graph.py`), the parallel stack
+(`parallel/wrapper.py`, `parallel/sharded_trainer.py`,
+`parallel/inference.py`), the executioner (`runtime/executioner.py`),
+and the dashboard (`ui/server.py` serves `GET /metrics` in Prometheus
+text format and a live metrics tab).
+
+Quick start (one line at each end):
+
+    net.setListeners(MetricsListener())          # optimize/listeners.py
+    UIServer.getInstance().start()               # GET /metrics
+
+or explicitly:
+
+    from deeplearning4j_tpu import monitoring
+    monitoring.enable()
+    ... fit / serve ...
+    monitoring.export_chrome_trace("/tmp/fit_trace.json")  # Perfetto
+    print(monitoring.get_registry().prometheus_text())
+
+Scope split across the repo's three observability layers:
+- monitoring (this package) — HOST-side: where did the step's wall time
+  go (data-iter / dispatch / listeners / eval / checkpoint spans), jit
+  compile events, transfer bytes, device memory gauges;
+- `optimize/listeners.ProfilerListener` + `optimize/xplane.py` —
+  DEVICE-side: the XLA per-op trace (xplane.pb);
+- `ui/stats.StatsListener` — LEARNING diagnostics: score curves, update
+  ratios, activation histograms.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.monitoring.state import STATE
+from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry,
+    JIT_CACHE_MISSES, JIT_COMPILE_SECONDS, OP_DISPATCHES,
+    TRANSFER_H2D_BYTES, DEVICE_MEMORY_BYTES, DEVICE_MEMORY_SUPPORTED,
+    HOST_RSS_BYTES,
+    bootstrap_core_metrics, collect_device_memory, get_registry,
+    record_transfer)
+from deeplearning4j_tpu.monitoring.tracing import (  # noqa: F401
+    NULL_SPAN, Span, Tracer, export_chrome_trace, get_tracer, span,
+    traced_iter)
+
+__all__ = [
+    "enable", "disable", "enabled", "span", "traced_iter",
+    "export_chrome_trace", "get_tracer", "get_registry",
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "Tracer",
+    "bootstrap_core_metrics", "collect_device_memory", "record_transfer",
+    "JIT_CACHE_MISSES", "JIT_COMPILE_SECONDS", "OP_DISPATCHES",
+    "TRANSFER_H2D_BYTES", "DEVICE_MEMORY_BYTES",
+    "DEVICE_MEMORY_SUPPORTED", "HOST_RSS_BYTES",
+]
+
+
+def enable():
+    """Turn on metrics collection and span recording globally."""
+    STATE.enabled = True
+
+
+def disable():
+    """Back to the zero-overhead default (one branch per call site)."""
+    STATE.enabled = False
+
+
+def enabled():
+    return STATE.enabled

@@ -1,0 +1,351 @@
+"""MetricsRegistry: dependency-free counters, gauges, and streaming
+histograms with Prometheus text exposition.
+
+Host-side observability for the XLA-fused world: device ops collapse into
+one step executable (SURVEY §1 inversion), so the actionable numbers are
+host-side — dispatch wall time, jit-cache compile events, host↔device
+transfer bytes, device memory watermarks. This registry is where all of
+them land; `ui/server.py` exposes it at `GET /metrics` and
+`optimize/listeners.MetricsListener` feeds it per iteration.
+
+Division of labour with the rest of the repo's observability:
+- `optimize/xplane.py` + `ProfilerListener` — DEVICE-side per-op traces
+  (jax.profiler / xplane.pb, viewable in TensorBoard/Perfetto);
+- `ui/stats.py` StatsListener — learning diagnostics (score, update
+  ratios, activation histograms) for the training dashboard;
+- this module — HOST-side operational metrics in Prometheus shape, plus
+  `monitoring.tracing` for span-level phase timing.
+
+Everything is JSON-native (`snapshot()`), same idiom as `ui/stats.py`.
+"""
+from __future__ import annotations
+
+import math
+import re
+import threading
+
+from deeplearning4j_tpu.monitoring.state import STATE
+
+# canonical metric names used by the built-in collectors (dots are
+# sanitized to underscores in the Prometheus exposition)
+JIT_CACHE_MISSES = "dl4j.jit.cache_misses"
+JIT_COMPILE_SECONDS = "dl4j.jit.compile_seconds"
+OP_DISPATCHES = "dl4j.op.dispatches"
+TRANSFER_H2D_BYTES = "dl4j.transfer.host_to_device_bytes"
+DEVICE_MEMORY_BYTES = "dl4j.device.memory_bytes"
+DEVICE_MEMORY_SUPPORTED = "dl4j.device.memory_stats_supported"
+HOST_RSS_BYTES = "dl4j.host.rss_bytes"
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name):
+    n = _NAME_RE.sub("_", str(name))
+    return "_" + n if n[:1].isdigit() else n
+
+
+def _prom_labels(labels, extra=()):
+    items = list(labels) + list(extra)
+    if not items:
+        return ""
+    def esc(v):
+        return str(v).replace("\\", "\\\\").replace("\n", "\\n") \
+                     .replace('"', '\\"')
+    body = ",".join(f'{_LABEL_RE.sub("_", str(k))}="{esc(v)}"'
+                    for k, v in items)
+    return "{" + body + "}"
+
+
+class Counter:
+    """Monotonic counter. inc() is lock-free on CPython (int += under the
+    GIL is not torn; a lost increment under extreme contention is an
+    acceptable metrics trade, same as statsd)."""
+
+    __slots__ = ("name", "labels", "_value")
+    kind = "counter"
+
+    def __init__(self, name, labels=()):
+        self.name = name
+        self.labels = labels
+        self._value = 0
+
+    def inc(self, amount=1):
+        self._value += amount
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge:
+    __slots__ = ("name", "labels", "_value")
+    kind = "gauge"
+
+    def __init__(self, name, labels=()):
+        self.name = name
+        self.labels = labels
+        self._value = 0.0
+
+    def set(self, value):
+        self._value = float(value)
+
+    def inc(self, amount=1.0):
+        self._value += amount
+
+    def dec(self, amount=1.0):
+        self._value -= amount
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Histogram:
+    """Streaming histogram: exact count/sum/min/max plus quantiles
+    (p50/p95/p99) over a bounded ring-buffer reservoir of the most recent
+    observations — O(reservoir) memory however long training runs."""
+
+    __slots__ = ("name", "labels", "_lock", "_count", "_sum", "_min",
+                 "_max", "_ring", "_ring_n", "_idx")
+    kind = "histogram"
+
+    def __init__(self, name, labels=(), reservoir=2048):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self._ring = [0.0] * int(reservoir)
+        self._ring_n = 0
+        self._idx = 0
+
+    def observe(self, value):
+        v = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            self._ring[self._idx] = v
+            self._idx = (self._idx + 1) % len(self._ring)
+            if self._ring_n < len(self._ring):
+                self._ring_n += 1
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def quantile(self, q):
+        """Quantile over the reservoir (recent window); None when empty."""
+        with self._lock:
+            window = sorted(self._ring[:self._ring_n])
+        if not window:
+            return None
+        pos = min(len(window) - 1,
+                  max(0, int(math.ceil(q * len(window)) - 1)))
+        return window[pos]
+
+    def snapshot(self):
+        with self._lock:
+            window = sorted(self._ring[:self._ring_n])
+            out = {"count": self._count, "sum": self._sum,
+                   "min": None if self._count == 0 else self._min,
+                   "max": None if self._count == 0 else self._max}
+        for label, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+            if window:
+                pos = min(len(window) - 1,
+                          max(0, int(math.ceil(q * len(window)) - 1)))
+                out[label] = window[pos]
+            else:
+                out[label] = None
+        return out
+
+
+class MetricsRegistry:
+    """Named metric families, each a set of label-keyed children.
+
+    counter/gauge/histogram are get-or-create: the same (name, labels)
+    always returns the same object, so call sites never cache handles
+    unless they want to skip the dict lookup."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}          # (name, labelitems) -> metric
+        self._help = {}             # name -> help string
+        #: bumped by clear() so hot paths that cache metric handles
+        #: (runtime/executioner.py) know to re-resolve them
+        self.generation = 0
+
+    def _get(self, cls, name, labels, help=None, **kw):
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, labels=key[1], **kw)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name!r} already registered as "
+                                f"{m.kind}, not {cls.kind}")
+            if help:
+                self._help.setdefault(name, help)
+        return m
+
+    def counter(self, name, labels=None, help=None):
+        return self._get(Counter, name, labels, help)
+
+    def gauge(self, name, labels=None, help=None):
+        return self._get(Gauge, name, labels, help)
+
+    def histogram(self, name, labels=None, help=None, reservoir=2048):
+        return self._get(Histogram, name, labels, help,
+                         reservoir=reservoir)
+
+    def get(self, name, labels=None):
+        """Existing metric or None (never creates)."""
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            return self._metrics.get(key)
+
+    def clear(self):
+        with self._lock:
+            self._metrics.clear()
+            self._help.clear()
+            self.generation += 1
+
+    # -- export ----------------------------------------------------------
+    def snapshot(self):
+        """JSON-native dump (same idiom as ui/stats records):
+        {name: [{labels: {...}, ...metric fields}]}."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out = {}
+        for (name, labelitems), m in items:
+            rec = {"labels": dict(labelitems), "kind": m.kind}
+            if isinstance(m, Histogram):
+                rec.update(m.snapshot())
+            else:
+                rec["value"] = m.value
+            out.setdefault(name, []).append(rec)
+        return out
+
+    def prometheus_text(self):
+        """Prometheus text exposition format 0.0.4. Histograms are emitted
+        as summaries (streaming quantiles, not cumulative buckets)."""
+        with self._lock:
+            items = sorted(self._metrics.items(), key=lambda kv: kv[0])
+            helps = dict(self._help)
+        lines = []
+        seen_header = set()
+        for (name, labelitems), m in items:
+            pname = _prom_name(name)
+            if pname not in seen_header:
+                seen_header.add(pname)
+                if name in helps:
+                    lines.append(f"# HELP {pname} {helps[name]}")
+                ptype = "summary" if isinstance(m, Histogram) else m.kind
+                lines.append(f"# TYPE {pname} {ptype}")
+            if isinstance(m, Histogram):
+                snap = m.snapshot()
+                for label, q in (("p50", "0.5"), ("p95", "0.95"),
+                                 ("p99", "0.99")):
+                    v = snap[label]
+                    if v is not None:
+                        lines.append(
+                            f"{pname}"
+                            f"{_prom_labels(labelitems, [('quantile', q)])}"
+                            f" {v:.9g}")
+                lines.append(f"{pname}_count{_prom_labels(labelitems)} "
+                             f"{snap['count']}")
+                lines.append(f"{pname}_sum{_prom_labels(labelitems)} "
+                             f"{snap['sum']:.9g}")
+            else:
+                v = m.value
+                vs = f"{v:.9g}" if isinstance(v, float) else str(v)
+                lines.append(f"{pname}{_prom_labels(labelitems)} {vs}")
+        return "\n".join(lines) + "\n"
+
+
+_global_registry = MetricsRegistry()
+
+
+def get_registry():
+    """THE process-global registry every built-in collector feeds."""
+    return _global_registry
+
+
+# -- built-in collectors ---------------------------------------------------
+def record_transfer(nbytes, registry=None):
+    """Count host→device bytes at explicit placement points
+    (jax.device_put call sites in the parallel stack). No-op when
+    monitoring is disabled — one branch, no allocation."""
+    if not STATE.enabled:
+        return
+    (registry or _global_registry).counter(
+        TRANSFER_H2D_BYTES,
+        help="bytes explicitly placed host-to-device").inc(int(nbytes))
+
+
+def collect_device_memory(registry=None):
+    """Per-device memory gauges from `device.memory_stats()` (TPU/GPU
+    backends; CPU returns None → the `supported 0` gauge says so instead
+    of inventing numbers), plus the host RSS from /proc."""
+    reg = registry or _global_registry
+    import jax
+    for d in jax.devices():
+        dev = str(d)
+        stats = None
+        try:
+            fn = getattr(d, "memory_stats", None)
+            stats = fn() if fn is not None else None
+        except Exception:   # noqa: BLE001 — metrics must never raise
+            stats = None
+        reg.gauge(DEVICE_MEMORY_SUPPORTED, labels={"device": dev},
+                  help="1 when the backend exposes memory_stats()") \
+           .set(0.0 if not stats else 1.0)
+        if stats:
+            for key in ("bytes_in_use", "peak_bytes_in_use",
+                        "bytes_limit", "largest_free_block_bytes"):
+                if key in stats:
+                    reg.gauge(DEVICE_MEMORY_BYTES,
+                              labels={"device": dev, "stat": key},
+                              help="device memory from memory_stats()") \
+                       .set(float(stats[key]))
+        else:
+            reg.gauge(DEVICE_MEMORY_BYTES,
+                      labels={"device": dev, "stat": "bytes_in_use"},
+                      help="device memory from memory_stats()").set(0.0)
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        import os
+        reg.gauge(HOST_RSS_BYTES, help="host process resident set size") \
+           .set(rss_pages * os.sysconf("SC_PAGE_SIZE"))
+    except Exception:   # noqa: BLE001 — non-Linux hosts
+        pass
+    return reg
+
+
+def bootstrap_core_metrics(registry=None):
+    """Make sure the core metric families exist (scrape targets must see
+    stable series even before the first compile/transfer happens) and
+    refresh the device-memory gauges. Called by the /metrics handler and
+    by MetricsListener on construction."""
+    reg = registry or _global_registry
+    reg.counter(JIT_CACHE_MISSES,
+                help="OpExecutioner.exec jit-cache misses")
+    reg.histogram(JIT_COMPILE_SECONDS,
+                  help="wall time of OpExecutioner.exec cache-miss "
+                       "dispatches (trace+compile+first run)")
+    reg.counter(OP_DISPATCHES, help="OpExecutioner.exec dispatches")
+    reg.counter(TRANSFER_H2D_BYTES,
+                help="bytes explicitly placed host-to-device")
+    return collect_device_memory(reg)

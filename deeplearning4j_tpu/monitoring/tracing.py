@@ -1,0 +1,172 @@
+"""Lightweight span tracing with Chrome trace-event JSON export.
+
+`span("name")` is a context manager; nesting is tracked per thread, and
+the recorded events are Chrome trace-event "X" (complete) events, so the
+export loads directly into Perfetto / `chrome://tracing` and shows the
+host-side phase structure of a `fit()` — data-iter / dispatch / listener
+/ eval / checkpoint — that the device-side xplane trace
+(`optimize/xplane.py`) cannot see.
+
+Disabled fast path: `span()` returns a shared no-op singleton after ONE
+flag check — no allocation, nothing recorded. Event storage is bounded
+(`max_events`), so a forgotten `enable()` cannot leak memory over a long
+training run.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from deeplearning4j_tpu.monitoring.state import STATE
+
+
+class _NullSpan:
+    """Shared do-nothing span for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Span:
+    __slots__ = ("name", "args", "_tracer", "_t0")
+
+    def __init__(self, tracer, name, args=None):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+        self._t0 = 0
+
+    def __enter__(self):
+        self._tracer._local.stack.append(self.name)
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter_ns()
+        local = self._tracer._local
+        stack = local.stack
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        self._tracer._record(self, self._t0, t1, len(stack),
+                             exc_type is not None)
+        return False
+
+
+class Tracer:
+    """Collects span events; thread-safe; bounded."""
+
+    def __init__(self, max_events=200_000):
+        self.max_events = int(max_events)
+        self._lock = threading.Lock()
+        self._events = []
+        self._dropped = 0
+        self._epoch_ns = time.perf_counter_ns()
+        self._local = threading.local()
+
+    def _ensure_local(self):
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+
+    def span(self, name, args=None):
+        self._ensure_local()
+        return Span(self, name, args)
+
+    def _record(self, span, t0_ns, t1_ns, depth, failed):
+        ev = {
+            "name": span.name,
+            "cat": "host",
+            "ph": "X",
+            "ts": (t0_ns - self._epoch_ns) / 1e3,      # microseconds
+            "dur": (t1_ns - t0_ns) / 1e3,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+        }
+        args = dict(span.args) if span.args else {}
+        args["depth"] = depth
+        if failed:
+            args["error"] = True
+        ev["args"] = args
+        with self._lock:
+            if len(self._events) < self.max_events:
+                self._events.append(ev)
+            else:
+                self._dropped += 1
+
+    # -- export ----------------------------------------------------------
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    def clear(self):
+        with self._lock:
+            self._events = []
+            self._dropped = 0
+        self._epoch_ns = time.perf_counter_ns()
+
+    def to_chrome_trace(self):
+        """Chrome trace-event JSON object (the {"traceEvents": [...]}
+        envelope both Perfetto and chrome://tracing load)."""
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if dropped:
+            doc["otherData"] = {"droppedEvents": dropped}
+        return doc
+
+    def export(self, path):
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+
+_global_tracer = Tracer()
+
+
+def get_tracer():
+    return _global_tracer
+
+
+def span(name, args=None):
+    """THE instrumentation point: a context manager timing one phase.
+
+    Disabled (the default): one flag check, returns the shared no-op
+    singleton — no allocation, no lock, nothing recorded."""
+    if not STATE.enabled:
+        return NULL_SPAN
+    return _global_tracer.span(name, args)
+
+
+def export_chrome_trace(path):
+    """Write everything recorded so far as Chrome trace-event JSON."""
+    return _global_tracer.export(path)
+
+
+def traced_iter(iterable, name="fit.data_next"):
+    """Wrap data iteration so time spent PULLING batches (host input
+    pipeline) shows as its own span per batch. Disabled → returns the
+    iterable untouched (zero cost)."""
+    if not STATE.enabled:
+        return iterable
+
+    def gen():
+        it = iter(iterable)
+        while True:
+            with span(name):
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+            yield item
+
+    return gen()

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.util.crash_reporting import \
     with_crash_dump
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
@@ -429,15 +430,18 @@ class ComputationGraph:
     def _fit_unpacked(self, unpacked):
         ins, labels, fmasks, lmasks = unpacked
         self._rng_key, sub = jax.random.split(self._rng_key)
-        self._params, self._opt_state, self._state, loss = self._train_step(
-            self._params, self._opt_state, self._state, ins, labels, fmasks,
-            lmasks, sub)
-        self._score = float(loss)
+        with _mon.span("train.dispatch"):
+            self._params, self._opt_state, self._state, loss = \
+                self._train_step(
+                    self._params, self._opt_state, self._state, ins,
+                    labels, fmasks, lmasks, sub)
+            self._score = float(loss)
         self._iteration += 1
         self._last_features = ins     # for StatsListener histograms
         self._params_version = getattr(self, "_params_version", 0) + 1
-        for listener in self._listeners:
-            listener.iterationDone(self, self._iteration, self._epoch)
+        with _mon.span("train.listeners"):
+            for listener in self._listeners:
+                listener.iterationDone(self, self._iteration, self._epoch)
 
     @functools.cached_property
     def _train_scan(self):
@@ -480,17 +484,20 @@ class ComputationGraph:
         stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
                                          *unpacked)
         ins, labels, fmasks, lmasks = stacked
-        (self._params, self._opt_state, self._state,
-         losses) = self._train_scan(self._params, self._opt_state,
-                                    self._state, ins, labels, fmasks,
-                                    lmasks, jnp.stack(subs))
+        with _mon.span("train.scan_dispatch"):
+            (self._params, self._opt_state, self._state,
+             losses) = self._train_scan(self._params, self._opt_state,
+                                        self._state, ins, labels, fmasks,
+                                        lmasks, jnp.stack(subs))
         self._last_features = jax.tree_util.tree_map(lambda a: a[-1], ins)
         self._params_version = getattr(self, "_params_version", 0) + 1
-        for loss in jax.device_get(losses):
-            self._score = float(loss)
-            self._iteration += 1
-            for listener in self._listeners:
-                listener.iterationDone(self, self._iteration, self._epoch)
+        with _mon.span("train.listeners"):
+            for loss in jax.device_get(losses):
+                self._score = float(loss)
+                self._iteration += 1
+                for listener in self._listeners:
+                    listener.iterationDone(self, self._iteration,
+                                           self._epoch)
 
     @staticmethod
     def _batch_sig(unpacked_or_ds):
@@ -506,10 +513,12 @@ class ComputationGraph:
         if self._params is None:
             self.init()
         if labels is not None:
-            self._fit_batch(DataSet(as_jax(data), as_jax(labels)))
+            with _mon.span("fit"):
+                self._fit_batch(DataSet(as_jax(data), as_jax(labels)))
             return self
         if isinstance(data, (DataSet, MultiDataSet)):
-            self._fit_batch(data)
+            with _mon.span("fit"):
+                self._fit_batch(data)
             return self
         k = max(1, int(stepsPerDispatch))
         n_epochs = int(epochs) if epochs is not None else 1
@@ -522,36 +531,39 @@ class ComputationGraph:
                     self._fit_unpacked(unpacked)
 
         for _ in range(n_epochs):
-            if hasattr(data, "reset"):
-                data.reset()
-            group, group_sig = [], None
-            for ds in data:
-                if k == 1:
-                    self._fit_batch(ds)
-                    continue
-                unpacked = self._unpack(ds)
-                sig = self._batch_sig(unpacked)
-                if group and (sig != group_sig or len(group) >= k):
+            with _mon.span("fit.epoch"):
+                if hasattr(data, "reset"):
+                    data.reset()
+                group, group_sig = [], None
+                for ds in _mon.traced_iter(data):
+                    if k == 1:
+                        self._fit_batch(ds)
+                        continue
+                    unpacked = self._unpack(ds)
+                    sig = self._batch_sig(unpacked)
+                    if group and (sig != group_sig or len(group) >= k):
+                        flush(group)
+                        group = []
+                    group_sig = sig
+                    group.append(unpacked)
+                if group:
                     flush(group)
-                    group = []
-                group_sig = sig
-                group.append(unpacked)
-            if group:
-                flush(group)
-            self._epoch += 1
-            for listener in self._listeners:
-                if hasattr(listener, "onEpochEnd"):
-                    listener.onEpochEnd(self)
+                self._epoch += 1
+                with _mon.span("fit.epoch_listeners"):
+                    for listener in self._listeners:
+                        if hasattr(listener, "onEpochEnd"):
+                            listener.onEpochEnd(self)
         return self
 
     # -- evaluation ------------------------------------------------------
     def _eval_loop(self, iterator, evaluator):
         if hasattr(iterator, "reset"):
             iterator.reset()
-        for ds in iterator:
-            out = self.output(ds.features)
-            out0 = out[0] if isinstance(out, list) else out
-            evaluator.eval(ds.labels, out0.numpy(), mask=ds.labelsMask)
+        for ds in _mon.traced_iter(iterator, "eval.data_next"):
+            with _mon.span("eval.batch"):
+                out = self.output(ds.features)
+                out0 = out[0] if isinstance(out, list) else out
+                evaluator.eval(ds.labels, out0.numpy(), mask=ds.labelsMask)
         return evaluator
 
     def evaluate(self, iterator):

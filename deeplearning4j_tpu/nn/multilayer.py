@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.util.crash_reporting import \
     with_crash_dump
 from deeplearning4j_tpu.datasets.dataset import DataSet
@@ -456,17 +457,20 @@ class MultiLayerNetwork:
                else jnp.stack([jnp.asarray(m) for _, _, m, _ in group]))
         fms = (None if group[0][3] is None
                else jnp.stack([jnp.asarray(m) for _, _, _, m in group]))
-        (self._params, self._opt_state, self._state,
-         losses) = self._train_scan(self._params, self._opt_state,
-                                    self._state, xs, ys, fms, lms,
-                                    jnp.stack(subs))
+        with _mon.span("train.scan_dispatch"):
+            (self._params, self._opt_state, self._state,
+             losses) = self._train_scan(self._params, self._opt_state,
+                                        self._state, xs, ys, fms, lms,
+                                        jnp.stack(subs))
         self._last_features = group[-1][0]
         self._params_version = getattr(self, "_params_version", 0) + 1
-        for loss in jax.device_get(losses):
-            self._score = float(loss)
-            self._iteration += 1
-            for listener in self._listeners:
-                listener.iterationDone(self, self._iteration, self._epoch)
+        with _mon.span("train.listeners"):
+            for loss in jax.device_get(losses):
+                self._score = float(loss)
+                self._iteration += 1
+                for listener in self._listeners:
+                    listener.iterationDone(self, self._iteration,
+                                           self._epoch)
 
     @staticmethod
     def _batch_sig(ds):
@@ -520,23 +524,26 @@ class MultiLayerNetwork:
             carries = self._zero_carries(x.shape[0])
             total = 0.0
             nseg = 0
-            for t0 in range(0, x.shape[1], tlen):
-                xs = x[:, t0:t0 + tlen]
-                ys = y[:, t0:t0 + tlen] if y.ndim == 3 else y
-                fs = None if fmask is None else fmask[:, t0:t0 + tlen]
-                ls = None if lmask is None else lmask[:, t0:t0 + tlen]
-                (self._params, self._opt_state, self._state, carries,
-                 loss) = self._train_step_tbptt(
-                    self._params, self._opt_state, self._state, carries,
-                    xs, ys, fs, ls, jax.random.fold_in(sub, t0))
-                total += float(loss)
-                nseg += 1
+            with _mon.span("train.dispatch"):
+                for t0 in range(0, x.shape[1], tlen):
+                    xs = x[:, t0:t0 + tlen]
+                    ys = y[:, t0:t0 + tlen] if y.ndim == 3 else y
+                    fs = None if fmask is None else fmask[:, t0:t0 + tlen]
+                    ls = None if lmask is None else lmask[:, t0:t0 + tlen]
+                    (self._params, self._opt_state, self._state, carries,
+                     loss) = self._train_step_tbptt(
+                        self._params, self._opt_state, self._state, carries,
+                        xs, ys, fs, ls, jax.random.fold_in(sub, t0))
+                    total += float(loss)
+                    nseg += 1
             self._score = total / max(1, nseg)
         else:
-            self._params, self._opt_state, self._state, loss = self._train_step(
-                self._params, self._opt_state, self._state, x, y, fmask,
-                lmask, sub)
-            self._score = float(loss)
+            with _mon.span("train.dispatch"):
+                self._params, self._opt_state, self._state, loss = \
+                    self._train_step(
+                        self._params, self._opt_state, self._state, x, y,
+                        fmask, lmask, sub)
+                self._score = float(loss)
         self._iteration += 1
         # most recent training batch, for listeners that inspect
         # activations (StatsListener histograms — ≡ the reference
@@ -545,8 +552,9 @@ class MultiLayerNetwork:
         # listener calls per single update)
         self._last_features = x
         self._params_version = getattr(self, "_params_version", 0) + 1
-        for listener in self._listeners:
-            listener.iterationDone(self, self._iteration, self._epoch)
+        with _mon.span("train.listeners"):
+            for listener in self._listeners:
+                listener.iterationDone(self, self._iteration, self._epoch)
 
     # -- layerwise unsupervised pretraining (≡ MultiLayerNetwork.pretrain
     # / pretrainLayer: VAE ELBO, historically RBM contrastive divergence) -
@@ -613,11 +621,13 @@ class MultiLayerNetwork:
         if self._params is None:
             self.init()
         if labels is not None:  # fit(features, labels)
-            self._fit_batch(as_jax(data), as_jax(labels))
+            with _mon.span("fit"):
+                self._fit_batch(as_jax(data), as_jax(labels))
             return self
         if isinstance(data, DataSet):
-            self._fit_batch(data.features, data.labels, data.labelsMask,
-                            data.featuresMask)
+            with _mon.span("fit"):
+                self._fit_batch(data.features, data.labels,
+                                data.labelsMask, data.featuresMask)
             return self
         # iterator
         from deeplearning4j_tpu.nn.conf.builders import BackpropType
@@ -634,27 +644,29 @@ class MultiLayerNetwork:
                     self._fit_batch(f, l, lm, fm)
 
         for _ in range(n_epochs):
-            if hasattr(data, "reset"):
-                data.reset()
-            group, group_sig = [], None
-            for ds in data:
-                if k == 1:
-                    self._fit_batch(ds.features, ds.labels, ds.labelsMask,
-                                    ds.featuresMask)
-                    continue
-                sig = self._batch_sig(ds)
-                if group and (sig != group_sig or len(group) >= k):
+            with _mon.span("fit.epoch"):
+                if hasattr(data, "reset"):
+                    data.reset()
+                group, group_sig = [], None
+                for ds in _mon.traced_iter(data):
+                    if k == 1:
+                        self._fit_batch(ds.features, ds.labels,
+                                        ds.labelsMask, ds.featuresMask)
+                        continue
+                    sig = self._batch_sig(ds)
+                    if group and (sig != group_sig or len(group) >= k):
+                        flush(group)
+                        group = []
+                    group_sig = sig
+                    group.append((ds.features, ds.labels, ds.labelsMask,
+                                  ds.featuresMask))
+                if group:
                     flush(group)
-                    group = []
-                group_sig = sig
-                group.append((ds.features, ds.labels, ds.labelsMask,
-                              ds.featuresMask))
-            if group:
-                flush(group)
-            self._epoch += 1
-            for listener in self._listeners:
-                if hasattr(listener, "onEpochEnd"):
-                    listener.onEpochEnd(self)
+                self._epoch += 1
+                with _mon.span("fit.epoch_listeners"):
+                    for listener in self._listeners:
+                        if hasattr(listener, "onEpochEnd"):
+                            listener.onEpochEnd(self)
         return self
 
     # -- evaluation -------------------------------------------------------
@@ -693,10 +705,11 @@ class MultiLayerNetwork:
     def _eval_loop(self, iterator, evaluator):
         if hasattr(iterator, "reset"):
             iterator.reset()
-        for ds in iterator:
-            out = self.output(ds.features, fmask=ds.featuresMask)
-            evaluator.eval(ds.labels, out.numpy(),
-                           mask=ds.labelsMask)
+        for ds in _mon.traced_iter(iterator, "eval.data_next"):
+            with _mon.span("eval.batch"):
+                out = self.output(ds.features, fmask=ds.featuresMask)
+                evaluator.eval(ds.labels, out.numpy(),
+                               mask=ds.labelsMask)
 
     # -- listeners --------------------------------------------------------
     def setListeners(self, *listeners):

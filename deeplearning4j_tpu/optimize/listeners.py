@@ -1,11 +1,23 @@
 """Training listeners (≡ deeplearning4j-nn :: optimize.listeners.*:
 ScoreIterationListener, PerformanceListener, TimeIterationListener,
 EvaluativeListener, CheckpointListener, and the BaseTrainingListener
-protocol)."""
+protocol).
+
+Observability cross-links (three complementary layers):
+- `MetricsListener` (here) — HOST-side operational metrics + span traces
+  via `deeplearning4j_tpu.monitoring` (Prometheus `/metrics` on the UI
+  server, Chrome-trace JSON for Perfetto);
+- `ProfilerListener` (here) + `optimize/xplane.py` — DEVICE-side XLA
+  per-op traces (jax.profiler / xplane.pb);
+- `ui.stats.StatsListener` — LEARNING diagnostics (score, update
+  ratios, activation histograms) for the training dashboard.
+"""
 from __future__ import annotations
 
 import os
 import time
+
+from deeplearning4j_tpu import monitoring as _mon
 
 
 class TrainingListener:
@@ -89,7 +101,8 @@ class EvaluativeListener(TrainingListener):
     def iterationDone(self, model, iteration, epoch):
         if iteration % self.every != 0:
             return
-        e = model.evaluate(self.iterator)
+        with _mon.span("listener.evaluate"):
+            e = model.evaluate(self.iterator)
         self.last_evaluation = e
         self.log(f"Evaluation at iteration {iteration}: "
                  f"accuracy={e.accuracy():.4f} f1={e.f1():.4f}")
@@ -112,7 +125,8 @@ class CheckpointListener(TrainingListener):
     def _save(self, model, tag):
         from deeplearning4j_tpu.util.model_serializer import ModelSerializer
         path = os.path.join(self.dir, f"checkpoint_{tag}.zip")
-        ModelSerializer.writeModel(model, path, self.saveUpdater)
+        with _mon.span("listener.checkpoint"):
+            ModelSerializer.writeModel(model, path, self.saveUpdater)
         self._saved.append(path)
         while len(self._saved) > self.keep:
             old = self._saved.pop(0)
@@ -201,3 +215,86 @@ class ProfilerListener(TrainingListener):
             jax.profiler.stop_trace()
             self._tracing = False
             self.trace_dir = None
+
+
+class MetricsListener(TrainingListener):
+    """One-line opt-in to the HOST-side monitoring subsystem:
+
+        net.setListeners(MetricsListener())
+
+    Constructing it calls `monitoring.enable()` (that IS the opt-in: every
+    instrumented span/metric point in the trainers, parallel stack, and
+    executioner goes live), bootstraps the core metric families (jit
+    compile histogram, transfer counter, device memory gauges), and then
+    per iteration records:
+
+    - `dl4j.train.iterations` (counter), `dl4j.train.score` (gauge),
+    - `dl4j.train.iteration_seconds` (histogram → p50/p95/p99),
+    - device memory gauges every `deviceMemoryFrequency` iterations
+      (`device.memory_stats()` where the backend has it).
+
+    `tracePath` (optional) exports the accumulated span trace as
+    Chrome trace-event JSON at every epoch end — load it in Perfetto /
+    chrome://tracing to see nested data-iter / dispatch / listener /
+    eval / checkpoint phases.
+
+    Scrape surface: `UIServer.getInstance().start()` then
+    `GET /metrics` (Prometheus text format).
+
+    Complements (does not replace) `ProfilerListener` (DEVICE-side
+    xplane trace — see optimize/xplane.py) and `ui.stats.StatsListener`
+    (learning diagnostics for the dashboard).
+    """
+
+    def __init__(self, registry=None, deviceMemoryFrequency=50,
+                 tracePath=None):
+        _mon.enable()
+        self.registry = registry if registry is not None \
+            else _mon.get_registry()
+        _mon.bootstrap_core_metrics(self.registry)
+        self.deviceMemoryFrequency = max(1, int(deviceMemoryFrequency))
+        self.trace_path = None if tracePath is None else str(tracePath)
+        self._last_time = None
+        self._params_version_seen = None
+
+    def iterationDone(self, model, iteration, epoch):
+        reg = self.registry
+        now = time.perf_counter()
+        reg.counter("dl4j.train.iterations",
+                    help="training iterations observed").inc()
+        score = model.score()
+        if score is not None:
+            reg.gauge("dl4j.train.score",
+                      help="most recent training loss").set(float(score))
+        # scanned fit (stepsPerDispatch=k) fires k iterationDone calls
+        # microseconds apart after ONE dispatch; time dispatch-to-dispatch
+        # via _params_version (same dedup contract as StatsListener) so
+        # the histogram isn't drowned in k-1 near-zero intervals
+        version = getattr(model, "_params_version", None)
+        params_fresh = version is None \
+            or version != self._params_version_seen
+        self._params_version_seen = version
+        if params_fresh:
+            if self._last_time is not None:
+                reg.histogram("dl4j.train.iteration_seconds",
+                              help="host wall time between real param "
+                                   "updates").observe(now - self._last_time)
+            self._last_time = now
+        if iteration % self.deviceMemoryFrequency == 0:
+            _mon.collect_device_memory(reg)
+
+    def onEpochEnd(self, model):
+        # inter-epoch work (eval/checkpoint listeners) must not count as
+        # an iteration interval
+        self._last_time = None
+        _mon.collect_device_memory(self.registry)
+        if self.trace_path:
+            tracer = _mon.get_tracer()
+            tracer.export(self.trace_path)
+            # near the event cap, start a fresh window rather than let
+            # every later span drop silently: the file just written
+            # preserves the old window; subsequent epoch exports rewrite
+            # the path with the newer one (late-training spans matter
+            # more than re-exporting early ones)
+            if len(tracer.events()) >= 0.8 * tracer.max_events:
+                tracer.clear()

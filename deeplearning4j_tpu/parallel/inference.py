@@ -25,6 +25,8 @@ import threading
 
 import numpy as np
 
+from deeplearning4j_tpu import monitoring as _mon
+
 
 class InferenceMode:
     SEQUENTIAL = "SEQUENTIAL"   # direct call, no queue
@@ -99,6 +101,10 @@ class ParallelInference:
         dim) or a batch; for multi-input ComputationGraphs a LIST/TUPLE
         with one array per model input (coalesced per-input). Returns the
         model output with matching leading dims."""
+        if _mon.enabled():
+            _mon.get_registry().counter(
+                "dl4j.inference.requests",
+                help="ParallelInference.output calls").inc()
         n_inputs = len(self._input_ranks())
         if isinstance(x, (list, tuple)) and n_inputs > 1:
             if len(x) != n_inputs:
@@ -242,8 +248,19 @@ class ParallelInference:
                     [xj, np.repeat(xj[-1:], nb - n, axis=0)], axis=0)
                     for xj in cols]
             self.model_calls += 1
-            out = self.model.output(cols if n_inputs > 1 else cols[0])
-            out = (out[0] if isinstance(out, list) else out).numpy()[:n]
+            if _mon.enabled():
+                reg = _mon.get_registry()
+                reg.counter("dl4j.inference.forwards",
+                            help="coalesced forward passes").inc()
+                reg.histogram(
+                    "dl4j.inference.batch_rows",
+                    help="rows per coalesced forward (pre-padding)"
+                ).observe(n)
+                _mon.record_transfer(sum(c.nbytes for c in cols))
+            with _mon.span("inference.forward"):
+                out = self.model.output(cols if n_inputs > 1 else cols[0])
+                out = (out[0] if isinstance(out, list)
+                       else out).numpy()[:n]
             i = 0
             for r in batch:
                 k = r.x[0].shape[0]

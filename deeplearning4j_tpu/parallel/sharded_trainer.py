@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.nn.updaters import Updater
 
 
@@ -61,7 +62,12 @@ class ShardedTrainer:
 
     def shard_batch(self, batch):
         sh = NamedSharding(self.mesh, P(self.batch_axis))
-        return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), batch)
+
+        def put(a):
+            _mon.record_transfer(getattr(a, "nbytes", 0))
+            return jax.device_put(a, sh)
+
+        return jax.tree_util.tree_map(put, batch)
 
     def init(self, params):
         params = self.shard_params(params)
@@ -88,7 +94,8 @@ class ShardedTrainer:
         return step
 
     def fit_batch(self, params, opt_state, batch, rng):
-        return self.make_step()(params, opt_state, batch, rng)
+        with _mon.span("sharded.dispatch"):
+            return self.make_step()(params, opt_state, batch, rng)
 
 
 class ParameterAveragingTrainer:
@@ -161,5 +168,6 @@ class ParameterAveragingTrainer:
         return self._step
 
     def fit_batch(self, params, opt_state, batch, rng, iteration):
-        return self.make_step()(params, opt_state, batch,
-                                rng, jnp.asarray(iteration))
+        with _mon.span("sharded.dispatch"):
+            return self.make_step()(params, opt_state, batch,
+                                    rng, jnp.asarray(iteration))

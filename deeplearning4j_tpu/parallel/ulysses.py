@@ -72,17 +72,35 @@ def make_ulysses_attention(mesh, axis_name="sp", causal=False,
         if mask is not None:
             full = lax.all_gather(mask, axis_name, axis=1, tiled=True)
             if custom_attn:
+                # kv_mask is passed BY KEYWORD, so the impl must name it
+                # as a keyword-reachable parameter — a bare **kwargs
+                # catch-all (or a positional-only param that happens to
+                # share the name) would swallow it silently (ADVICE r5;
+                # same guard as models/bert.py). The bind() then checks
+                # the REST of the call (e.g. a missing causal param), so
+                # a convention mismatch still surfaces as this curated
+                # error, not a bare TypeError from inside shard_map.
                 import inspect
-                try:
-                    inspect.signature(attn_fn).bind(qg, kg, vg,
-                                                    causal=causal,
-                                                    kv_mask=full)
-                except TypeError:
+
+                from deeplearning4j_tpu.util.introspect import \
+                    explicit_mask_param
+                ok = explicit_mask_param(attn_fn,
+                                         names=("kv_mask",)) is not None
+                if ok:
+                    try:
+                        inspect.signature(attn_fn).bind(
+                            qg, kg, vg, causal=causal, kv_mask=full)
+                    except TypeError:
+                        ok = False
+                if not ok:
                     raise ValueError(
-                        "masked batch but the custom attn_fn has no "
-                        "kv_mask parameter — silent padding attention "
-                        "is not an option; accept "
-                        "attn_fn(q, k, v, causal=..., kv_mask=None)")
+                        "masked batch but the custom attn_fn does not "
+                        "explicitly declare a kv_mask parameter (bare "
+                        "**kwargs does not count) or cannot be called "
+                        "with (q, k, v, causal=..., kv_mask=...) — "
+                        "silent padding attention is not an option; "
+                        "accept attn_fn(q, k, v, causal=..., "
+                        "kv_mask=None)")
             out = attn_fn(qg, kg, vg, causal=causal, kv_mask=full)
         else:
             out = attn_fn(qg, kg, vg, causal=causal)

@@ -19,6 +19,7 @@ import numpy as np
 
 import jax
 
+from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh
 
@@ -156,6 +157,10 @@ class ParallelWrapper:
             lm[b:] = 0.0
             if fm is not None:
                 fm = self._pad_rows(fm, pad)
+        if _mon.enabled():
+            _mon.record_transfer(feats.nbytes + labs.nbytes
+                                 + (0 if lm is None else lm.nbytes)
+                                 + (0 if fm is None else fm.nbytes))
         x = jax.device_put(feats, self.mesh.sharding("dp"))
         y = jax.device_put(labs, self.mesh.sharding("dp"))
         lmask = None if lm is None \
@@ -164,21 +169,31 @@ class ParallelWrapper:
             else jax.device_put(fm, self.mesh.sharding("dp"))
         m = self.model
         m._rng_key, sub = jax.random.split(m._rng_key)
-        if is_graph:
-            # the reference's ParallelWrapper wraps ComputationGraph too;
-            # packing convention lives in ComputationGraph._pack_single
-            ins, labels, fmasks, lmasks = m._pack_single(x, y, fmask,
-                                                         lmask)
-            m._params, m._opt_state, m._state, loss = m._train_step(
-                m._params, m._opt_state, m._state, ins, labels, fmasks,
-                lmasks, sub)
-        else:
-            m._params, m._opt_state, m._state, loss = m._train_step(
-                m._params, m._opt_state, m._state, x, y, fmask, lmask, sub)
-        m._score = float(loss)
+        with _mon.span("parallel.dispatch"):
+            if is_graph:
+                # the reference's ParallelWrapper wraps ComputationGraph
+                # too; packing convention lives in
+                # ComputationGraph._pack_single
+                ins, labels, fmasks, lmasks = m._pack_single(x, y, fmask,
+                                                             lmask)
+                m._params, m._opt_state, m._state, loss = m._train_step(
+                    m._params, m._opt_state, m._state, ins, labels, fmasks,
+                    lmasks, sub)
+            else:
+                ins = None
+                m._params, m._opt_state, m._state, loss = m._train_step(
+                    m._params, m._opt_state, m._state, x, y, fmask, lmask,
+                    sub)
+            m._score = float(loss)
         m._iteration += 1
-        for listener in m._listeners:
-            listener.iterationDone(m, m._iteration, m._epoch)
+        # StatsListener contract (ADVICE r5): the model-side fit paths set
+        # both of these per real update — the wrapper's step must too, or
+        # ratio/histogram collection freezes on a stale version
+        m._last_features = ins if is_graph else x
+        m._params_version = getattr(m, "_params_version", 0) + 1
+        with _mon.span("train.listeners"):
+            for listener in m._listeners:
+                listener.iterationDone(m, m._iteration, m._epoch)
         return m._score
 
     # -- scanned dispatch (round-5): k same-shape batches in ONE sharded
@@ -192,6 +207,8 @@ class ParallelWrapper:
         from deeplearning4j_tpu.datasets.dataset import MultiDataSet
         if isinstance(ds, MultiDataSet):
             return None   # multi data routes through the single path
+        if ds.features is None:
+            return None   # no features → non-scannable, not a TypeError
         def sh(a):
             return None if a is None else tuple(np.shape(a))
         return (sh(ds.features), sh(ds.labels), sh(ds.featuresMask),
@@ -210,27 +227,40 @@ class ParallelWrapper:
             arrs = [getattr(ds, field) for ds in group]
             if arrs[0] is None:
                 return None
-            return jax.device_put(
-                np.stack([np.asarray(a) for a in arrs]), sh2)
+            stacked = np.stack([np.asarray(a) for a in arrs])
+            _mon.record_transfer(stacked.nbytes)
+            return jax.device_put(stacked, sh2)
 
         xs, ys = stack_put("features"), stack_put("labels")
         fms, lms = stack_put("featuresMask"), stack_put("labelsMask")
         import jax.numpy as jnp
-        if self._graph_model():
-            ins, labels, fmasks, lmasks = m._pack_single(xs, ys, fms, lms)
-            (m._params, m._opt_state, m._state,
-             losses) = m._train_scan(m._params, m._opt_state, m._state,
-                                     ins, labels, fmasks, lmasks,
-                                     jnp.stack(subs))
-        else:
-            (m._params, m._opt_state, m._state,
-             losses) = m._train_scan(m._params, m._opt_state, m._state,
-                                     xs, ys, fms, lms, jnp.stack(subs))
-        for loss in jax.device_get(losses):
-            m._score = float(loss)
-            m._iteration += 1
-            for listener in m._listeners:
-                listener.iterationDone(m, m._iteration, m._epoch)
+        with _mon.span("parallel.scan_dispatch"):
+            if self._graph_model():
+                ins, labels, fmasks, lmasks = m._pack_single(xs, ys, fms,
+                                                             lms)
+                (m._params, m._opt_state, m._state,
+                 losses) = m._train_scan(m._params, m._opt_state, m._state,
+                                         ins, labels, fmasks, lmasks,
+                                         jnp.stack(subs))
+                # last batch of the scanned stack, unpacked like the
+                # model-side scanned path (graph.py:487)
+                m._last_features = jax.tree_util.tree_map(
+                    lambda a: a[-1], ins)
+            else:
+                (m._params, m._opt_state, m._state,
+                 losses) = m._train_scan(m._params, m._opt_state, m._state,
+                                         xs, ys, fms, lms, jnp.stack(subs))
+                m._last_features = xs[-1]
+        # ONE real param update for the whole scanned group: bump the
+        # version once so StatsListener's dedup treats the k-1 inner
+        # iterationDone calls as param-stale (ADVICE r5, wrapper.py:200)
+        m._params_version = getattr(m, "_params_version", 0) + 1
+        with _mon.span("train.listeners"):
+            for loss in jax.device_get(losses):
+                m._score = float(loss)
+                m._iteration += 1
+                for listener in m._listeners:
+                    listener.iterationDone(m, m._iteration, m._epoch)
 
     def fit(self, iterator, epochs=1, stepsPerDispatch=1):
         """Data-parallel fit: same jitted train step as the wrapped model —
@@ -247,38 +277,39 @@ class ParallelWrapper:
             it = AsyncDataSetIterator(iterator, self.prefetch_buffer)
         k = max(1, int(stepsPerDispatch))
         for _ in range(int(epochs)):
-            if hasattr(it, "reset"):
-                it.reset()
-            if k == 1:
-                for ds in it:
-                    self._fit_dataset(ds)
-            else:
-                group, sig = [], None
-
-                def flush():
-                    nonlocal group
-                    for g in group:   # sub-k groups run singly
-                        self._fit_dataset(g)
-                    group = []
-
-                for ds in it:
-                    s = self._scan_sig(ds)
-                    scannable = (s is not None
-                                 and s[0][0] % self.mesh.size == 0)
-                    if not scannable:
-                        flush()
-                        sig = None
+            with _mon.span("fit.epoch"):
+                if hasattr(it, "reset"):
+                    it.reset()
+                if k == 1:
+                    for ds in _mon.traced_iter(it):
                         self._fit_dataset(ds)
-                        continue
-                    if s != sig:
-                        flush()
-                        sig = s
-                    group.append(ds)
-                    if len(group) == k:
-                        self._fit_group_scanned(group)
+                else:
+                    group, sig = [], None
+
+                    def flush():
+                        nonlocal group
+                        for g in group:   # sub-k groups run singly
+                            self._fit_dataset(g)
                         group = []
-                flush()
-            self.model._epoch += 1
+
+                    for ds in _mon.traced_iter(it):
+                        s = self._scan_sig(ds)
+                        scannable = (s is not None and len(s[0]) > 0
+                                     and s[0][0] % self.mesh.size == 0)
+                        if not scannable:
+                            flush()
+                            sig = None
+                            self._fit_dataset(ds)
+                            continue
+                        if s != sig:
+                            flush()
+                            sig = s
+                        group.append(ds)
+                        if len(group) == k:
+                            self._fit_group_scanned(group)
+                            group = []
+                    flush()
+                self.model._epoch += 1
         return self.model
 
     def shutdown(self):

@@ -13,6 +13,8 @@ import time
 
 import jax
 
+from deeplearning4j_tpu import monitoring as _mon
+
 
 class OpExecutioner:
     _instance = None
@@ -22,6 +24,8 @@ class OpExecutioner:
         self.profiling = False
         self.op_counts = collections.Counter()
         self.op_times = collections.defaultdict(float)
+        # (registry, generation, dispatches, misses, compile_hist)
+        self._mon_handles = None
 
     @classmethod
     def getInstance(cls):
@@ -31,19 +35,50 @@ class OpExecutioner:
 
     # -- dispatch --------------------------------------------------------
     def exec(self, fn, *args, static_argnums=(), **kwargs):
-        """Execute fn under jit with executioner-level caching/profiling."""
+        """Execute fn under jit with executioner-level caching/profiling.
+
+        With monitoring enabled, cache misses also feed the global
+        MetricsRegistry: `dl4j.jit.cache_misses` (counter) and
+        `dl4j.jit.compile_seconds` (histogram over the wall time of the
+        miss dispatch — trace + XLA compile + first run, blocked to
+        completion so the number is honest). The disabled path is the
+        exact pre-monitoring fast path: dict hit, call, return."""
         key = (fn, static_argnums)
-        if key not in self._jit_cache:
-            self._jit_cache[key] = jax.jit(fn, static_argnums=static_argnums)
-        jitted = self._jit_cache[key]
-        if not self.profiling:
+        jitted = self._jit_cache.get(key)
+        miss = jitted is None
+        if miss:
+            jitted = jax.jit(fn, static_argnums=static_argnums)
+            self._jit_cache[key] = jitted
+        mon_on = _mon.enabled()
+        if not (self.profiling or mon_on):
             return jitted(*args, **kwargs)
         t0 = time.perf_counter()
         out = jitted(*args, **kwargs)
-        jax.block_until_ready(out)
-        name = getattr(fn, "__name__", str(fn))
-        self.op_counts[name] += 1
-        self.op_times[name] += time.perf_counter() - t0
+        if self.profiling or miss:
+            jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        if self.profiling:
+            name = getattr(fn, "__name__", str(fn))
+            self.op_counts[name] += 1
+            self.op_times[name] += dt
+        if mon_on:
+            # cache the registry handles (per-dispatch _get would pay a
+            # lock + key build on the hottest path), but re-resolve when
+            # the registry instance or its generation changed — after
+            # clear() the old Counter objects are orphans that would
+            # silently drop these series from /metrics
+            reg = _mon.get_registry()
+            h = self._mon_handles
+            if h is None or h[0] is not reg or h[1] != reg.generation:
+                h = self._mon_handles = (
+                    reg, reg.generation,
+                    reg.counter(_mon.OP_DISPATCHES),
+                    reg.counter(_mon.JIT_CACHE_MISSES),
+                    reg.histogram(_mon.JIT_COMPILE_SECONDS))
+            h[2].inc()
+            if miss:
+                h[3].inc()
+                h[4].observe(dt)
         return out
 
     def commit(self):

@@ -4,7 +4,11 @@ web dashboard).
 Two forms, both dependency-free:
 - `UIServer.getInstance().attach(storage)` then `start()` — a stdlib
   http.server on a background thread: `/` serves the dashboard page,
-  `/stats` the JSON records the page polls every second.
+  `/stats` the JSON records the page polls every second, and
+  `/metrics` the host-side monitoring registry in Prometheus text
+  exposition format (see deeplearning4j_tpu.monitoring — jit compile
+  histogram, device memory gauges, transfer/inference counters; the
+  dashboard's Metrics tab renders the same scrape).
 - `render_static_html(storage, path)` — a self-contained HTML snapshot
   (inline SVG charts) for environments without an open port.
 """
@@ -33,6 +37,12 @@ svg{width:100%;height:220px}
 <div id="hists"></div></div>
 <div class="chart"><h2>t-SNE</h2><svg id="tsne" style="height:320px">
 </svg><div class="meta" id="tsnemeta">no t-SNE data attached</div></div>
+<div class="chart"><h2>Metrics (host-side monitoring)</h2>
+<div class="meta">Prometheus exposition of the monitoring registry —
+enable with <code>net.setListeners(MetricsListener())</code>; scrape at
+<code>/metrics</code></div>
+<pre id="metrics" style="max-height:320px;overflow:auto;font-size:12px">
+monitoring disabled or no metrics yet</pre></div>
 <script>
 const COLORS = ['#0a6','#06a','#a06','#a60','#60a','#6a0','#066','#660'];
 function poly(svg, xs, ys, color){
@@ -102,6 +112,12 @@ async function tick(){
     document.getElementById('hists').innerHTML = Object.keys(ah)
       .map((k,i)=>histSvg(ah[k], k, COLORS[i % COLORS.length])).join("");
   }
+  try {
+    const mr = await fetch('/metrics'); const mt = await mr.text();
+    if (mt.trim()){
+      document.getElementById('metrics').textContent = mt;
+    }
+  } catch (e) {}
   const tr = await fetch('/tsne'); const td = await tr.json();
   if (td.points && td.points.length){
     const el = document.getElementById('tsne');
@@ -190,6 +206,23 @@ class UIServer:
                 elif self.path.startswith("/tsne"):
                     body = json.dumps(server._tsne).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/metrics"):
+                    # Prometheus scrape surface for the host-side
+                    # monitoring registry; with monitoring ENABLED the
+                    # core families + device memory gauges refresh per
+                    # scrape (pull-model collectors). Disabled → serve
+                    # whatever the registry holds WITHOUT touching jax:
+                    # a dashboard-only UIServer must not initialize a
+                    # backend (or poll memory_stats) from its 1 s tick.
+                    from deeplearning4j_tpu import monitoring as _mon
+                    reg = _mon.get_registry()
+                    if _mon.enabled():
+                        try:
+                            _mon.bootstrap_core_metrics(reg)
+                        except Exception:  # noqa: BLE001 — always serve
+                            pass
+                    body = reg.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
                 else:
                     body = _PAGE.encode()
                     ctype = "text/html"

@@ -6,6 +6,13 @@ Each iteration records score, timing, and per-layer parameter/update
 summaries (the mean-magnitude ratios the reference's dashboard charts for
 learning-rate tuning). Storage is JSON-native; FileStatsStorage appends
 JSONL so a dashboard — live server or static HTML — can tail it.
+
+Observability cross-links: StatsListener covers LEARNING diagnostics.
+For HOST-side operational metrics and span tracing (where did the step's
+wall time go; Prometheus `/metrics`; Chrome-trace export), opt in with
+`optimize.listeners.MetricsListener` / `deeplearning4j_tpu.monitoring`;
+for DEVICE-side per-op XLA traces use `optimize.listeners.
+ProfilerListener` + `optimize/xplane.py`. All three can run together.
 """
 from __future__ import annotations
 
