@@ -37,6 +37,12 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     JIT_CACHE_MISSES, JIT_COMPILE_SECONDS, OP_DISPATCHES,
     TRANSFER_H2D_BYTES, DEVICE_MEMORY_BYTES, DEVICE_MEMORY_SUPPORTED,
     HOST_RSS_BYTES,
+    RESILIENCE_RETRIES, RESILIENCE_BACKOFF_SECONDS,
+    RESILIENCE_BREAKER_TRIPS, RESILIENCE_FAULTS_INJECTED,
+    RESILIENCE_BATCHES_SKIPPED, RESILIENCE_CHECKPOINT_SAVES,
+    RESILIENCE_RESUMES, RESILIENCE_RESUME_STEP,
+    RESILIENCE_INFERENCE_SHED, RESILIENCE_INFERENCE_TIMEOUTS,
+    RESILIENCE_COLLECTOR_RESTARTS,
     bootstrap_core_metrics, collect_device_memory, get_registry,
     record_transfer)
 from deeplearning4j_tpu.monitoring.tracing import (  # noqa: F401
@@ -51,6 +57,12 @@ __all__ = [
     "JIT_CACHE_MISSES", "JIT_COMPILE_SECONDS", "OP_DISPATCHES",
     "TRANSFER_H2D_BYTES", "DEVICE_MEMORY_BYTES",
     "DEVICE_MEMORY_SUPPORTED", "HOST_RSS_BYTES",
+    "RESILIENCE_RETRIES", "RESILIENCE_BACKOFF_SECONDS",
+    "RESILIENCE_BREAKER_TRIPS", "RESILIENCE_FAULTS_INJECTED",
+    "RESILIENCE_BATCHES_SKIPPED", "RESILIENCE_CHECKPOINT_SAVES",
+    "RESILIENCE_RESUMES", "RESILIENCE_RESUME_STEP",
+    "RESILIENCE_INFERENCE_SHED", "RESILIENCE_INFERENCE_TIMEOUTS",
+    "RESILIENCE_COLLECTOR_RESTARTS",
 ]
 
 

@@ -36,6 +36,21 @@ DEVICE_MEMORY_BYTES = "dl4j.device.memory_bytes"
 DEVICE_MEMORY_SUPPORTED = "dl4j.device.memory_stats_supported"
 HOST_RSS_BYTES = "dl4j.host.rss_bytes"
 
+# resilience subsystem (resilience/ + the hardened serving/training
+# paths): every retry, breaker trip, shed request, skipped batch, and
+# checkpoint resume lands on one of these
+RESILIENCE_RETRIES = "dl4j.resilience.retries"
+RESILIENCE_BACKOFF_SECONDS = "dl4j.resilience.backoff_seconds"
+RESILIENCE_BREAKER_TRIPS = "dl4j.resilience.breaker_trips"
+RESILIENCE_FAULTS_INJECTED = "dl4j.resilience.faults_injected"
+RESILIENCE_BATCHES_SKIPPED = "dl4j.resilience.batches_skipped"
+RESILIENCE_CHECKPOINT_SAVES = "dl4j.resilience.checkpoint_saves"
+RESILIENCE_RESUMES = "dl4j.resilience.resumes"
+RESILIENCE_RESUME_STEP = "dl4j.resilience.resume_step"
+RESILIENCE_INFERENCE_SHED = "dl4j.resilience.inference_shed"
+RESILIENCE_INFERENCE_TIMEOUTS = "dl4j.resilience.inference_timeouts"
+RESILIENCE_COLLECTOR_RESTARTS = "dl4j.resilience.collector_restarts"
+
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _LABEL_RE = re.compile(r"[^a-zA-Z0-9_]")
 

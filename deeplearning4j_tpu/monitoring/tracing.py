@@ -102,6 +102,13 @@ class Tracer:
             else:
                 self._dropped += 1
 
+    def current_stack(self):
+        """The CALLING thread's open-span stack, outermost first (what
+        the process was doing right now — crash_reporting embeds this in
+        OOM dumps so post-mortems show the phase that died)."""
+        self._ensure_local()
+        return list(self._local.stack)
+
     # -- export ----------------------------------------------------------
     def events(self):
         with self._lock:

@@ -15,6 +15,7 @@ import numpy as np
 import optax
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.util.crash_reporting import \
     with_crash_dump
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
@@ -428,6 +429,8 @@ class ComputationGraph:
         self._fit_unpacked(self._unpack(ds))
 
     def _fit_unpacked(self, unpacked):
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
         ins, labels, fmasks, lmasks = unpacked
         self._rng_key, sub = jax.random.split(self._rng_key)
         with _mon.span("train.dispatch"):
@@ -477,6 +480,8 @@ class ComputationGraph:
         full groups go through the scan — sub-k remainders run singly so
         lax.scan is traced for exactly ONE length per batch shape (each
         distinct scan length is a fresh compile)."""
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
         subs = []
         for _ in unpacked:  # identical key stream to sequential _fit_batch
             self._rng_key, sub = jax.random.split(self._rng_key)
@@ -536,6 +541,8 @@ class ComputationGraph:
                     data.reset()
                 group, group_sig = [], None
                 for ds in _mon.traced_iter(data):
+                    if _faults.ACTIVE is not None:
+                        _faults.ACTIVE.fire(_faults.DATA_NEXT)
                     if k == 1:
                         self._fit_batch(ds)
                         continue

@@ -18,6 +18,7 @@ import numpy as np
 import optax
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.util.crash_reporting import \
     with_crash_dump
 from deeplearning4j_tpu.datasets.dataset import DataSet
@@ -447,6 +448,8 @@ class MultiLayerNetwork:
         """Flush a same-shape batch group through ONE scanned dispatch.
         Callers only send FULL groups here (sub-k remainders run singly)
         so lax.scan is traced for exactly one length per batch shape."""
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
         subs = []
         for _ in group:   # identical key stream to sequential _fit_batch
             self._rng_key, sub = jax.random.split(self._rng_key)
@@ -512,6 +515,8 @@ class MultiLayerNetwork:
 
     def _fit_batch(self, features, labels, labels_mask=None,
                    features_mask=None):
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
         x = jnp.asarray(features)
         y = jnp.asarray(labels)
         lmask = None if labels_mask is None else jnp.asarray(labels_mask)
@@ -649,6 +654,8 @@ class MultiLayerNetwork:
                     data.reset()
                 group, group_sig = [], None
                 for ds in _mon.traced_iter(data):
+                    if _faults.ACTIVE is not None:
+                        _faults.ACTIVE.fire(_faults.DATA_NEXT)
                     if k == 1:
                         self._fit_batch(ds.features, ds.labels,
                                         ds.labelsMask, ds.featuresMask)

@@ -17,49 +17,206 @@ import os
 import jax
 import numpy as np
 
+from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.resilience import faults as _faults
+
 
 class ElasticCheckpointer:
-    """Orbax-backed save/resume for (step, params, opt_state) pytrees."""
+    """Orbax-backed save/resume for (step, params, opt_state[, extra])
+    pytrees. `extra` carries whatever the trainer needs for step-accurate
+    resume (rng key, batch-norm state, iteration counters)."""
 
     def __init__(self, directory, max_to_keep=3, save_interval_steps=1):
         import orbax.checkpoint as ocp
         self._ocp = ocp
         self.directory = os.path.abspath(str(directory))
         os.makedirs(self.directory, exist_ok=True)
+        self._closed = False
         self.manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps))
 
-    def save(self, step, params, opt_state=None, wait=False):
+    def check_for_errors(self):
+        """Surface a deferred ASYNC-save failure now. Orbax records
+        exceptions from the background commit thread; without this check
+        they would be swallowed until (or past) close — a training run
+        could 'checkpoint' for hours while every save failed."""
+        check = getattr(self.manager, "check_for_errors", None)
+        if check is not None:
+            check()
+
+    def save(self, step, params, opt_state=None, extra=None, wait=False):
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.CHECKPOINT_SAVE)
+        self.check_for_errors()     # previous async save failed → raise
         state = {"params": params}
         if opt_state is not None:
             state["opt_state"] = opt_state
-        self.manager.save(int(step),
-                          args=self._ocp.args.StandardSave(state))
+        if extra:
+            state["extra"] = extra
+        if not wait:
+            # ASYNC save of buffers the caller's next train step will
+            # DONATE is a use-after-free: XLA reuses the memory while
+            # orbax's background thread still serializes it (on CPU the
+            # device buffer even aliases host memory — np.asarray would
+            # be a view, hence np.array's forced copy). Snapshot to
+            # host copies first; wait=True saves need no copy.
+            # Non-fully-addressable arrays (multi-host shards) CANNOT be
+            # gathered here — they pass through to orbax's per-shard
+            # writer exactly as before this fix.
+            def _snap(a):
+                if not hasattr(a, "shape") or isinstance(a, np.ndarray):
+                    return a
+                if getattr(a, "is_fully_addressable", True):
+                    return np.array(a)
+                return a
+
+            state = jax.tree_util.tree_map(_snap, state)
+        saved = self.manager.save(int(step),
+                                  args=self._ocp.args.StandardSave(state))
+        if saved and _mon.enabled():
+            _mon.get_registry().counter(
+                _mon.RESILIENCE_CHECKPOINT_SAVES,
+                help="checkpoint saves issued (async unless wait)").inc()
         if wait:
             self.manager.wait_until_finished()
+            self.check_for_errors()
         return self
 
     def latest_step(self):
         return self.manager.latest_step()
 
     def restore(self, step=None, like=None):
-        """Restore (step, state). `like` — a pytree of arrays with the
-        target sharding/layout (orbax restores device-put to match)."""
+        """Restore (step, state). `like` fixes the TREE STRUCTURE of the
+        result (optax NamedTuples survive). Leaves whose `like`
+        counterpart carries a NamedSharding come back device-put to that
+        sharding (mesh reshape across save/restore works, as before);
+        everything else comes back as HOST numpy arrays — callers
+        re-place on device themselves (`replace_on_mesh`, the trainers'
+        resume paths).
+
+        Deliberately restores WITHOUT a target and grafts the raw
+        leaves into `like`'s treedef: orbax's targeted-restore path
+        (StandardRestore(like)) hands back numpy arrays whose backing
+        memory is not soundly owned — reading them after the restore
+        call intermittently yields garbage or segfaults (observed
+        ~half of resume runs on this orbax/jax CPU combo; the untargeted
+        path has never misread). Shapes are validated leaf-by-leaf so a
+        structure mismatch fails loudly instead of silently
+        transposing state."""
         step = self.manager.latest_step() if step is None else int(step)
         if step is None:
             return None, None
-        if like is not None:
-            args = self._ocp.args.StandardRestore(like)
-        else:
-            args = self._ocp.args.StandardRestore()
-        return step, self.manager.restore(step, args=args)
+        if like is not None and any(
+                getattr(a, "is_fully_addressable", True) is False
+                for a in jax.tree_util.tree_leaves(like)):
+            # multi-host target: keep orbax's per-shard targeted restore
+            # (the untargeted path below reads every leaf fully on every
+            # host, and the graft's device_put cannot place shards this
+            # process does not own)
+            return step, self.manager.restore(
+                step, args=self._ocp.args.StandardRestore(like))
+        import logging
+
+        class _DropTargetWarning(logging.Filter):
+            def filter(self, record):
+                return "expects a target tree" not in record.getMessage()
+
+        # the untargeted restore is deliberate (see above) — drop orbax's
+        # per-restore warning about it, nothing else
+        absl_logger = logging.getLogger("absl")
+        f = _DropTargetWarning()
+        absl_logger.addFilter(f)
+        try:
+            raw = self.manager.restore(
+                step, args=self._ocp.args.StandardRestore())
+        finally:
+            absl_logger.removeFilter(f)
+        if like is None:
+            return step, raw
+        like_leaves, treedef = jax.tree_util.tree_flatten(like)
+        raw_leaves = jax.tree_util.tree_leaves(raw)
+        if len(raw_leaves) != len(like_leaves):
+            raise ValueError(
+                f"checkpoint step {step} has {len(raw_leaves)} leaves "
+                f"but the restore target has {len(like_leaves)} — "
+                "saved and target structures do not match")
+        from jax.sharding import NamedSharding
+
+        grafted = []
+        for want, got in zip(like_leaves, raw_leaves):
+            ws = tuple(getattr(want, "shape", ()) or ())
+            gs = tuple(getattr(got, "shape", ()) or ())
+            if ws != gs:
+                raise ValueError(
+                    f"checkpoint step {step}: leaf shape {gs} does not "
+                    f"match target shape {ws} — saved and target "
+                    "structures do not match")
+            dt = getattr(want, "dtype", None)
+            host = np.asarray(got) if dt is None \
+                else np.asarray(got, dtype=dt)
+            sh = getattr(want, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                grafted.append(jax.device_put(xla_owned_copy(host), sh))
+            else:
+                grafted.append(host)
+        return step, jax.tree_util.tree_unflatten(treedef, grafted)
 
     def close(self):
-        self.manager.wait_until_finished()
-        self.manager.close()
+        """Idempotent: wait for any in-flight async save (never tear
+        down a half-written checkpoint), surface deferred errors, then
+        close — the manager is closed even when the wait raises."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.manager.wait_until_finished()
+            self.check_for_errors()
+        finally:
+            self.manager.close()
+
+
+def xla_owned_copy(host):
+    """A jax array GUARANTEED to own its buffer (bit-exact copy of
+    `host`). On this jax CPU backend `jnp.asarray(numpy)` zero-copy
+    aliases any suitably-aligned numpy buffer (measured 20/20 on fresh
+    allocations); when a donating jitted step later consumes such an
+    array, XLA frees/reuses memory numpy owns — heap corruption that
+    surfaces as free(): corrupted chunks, NaN params, or segfaults a
+    step or two after resume. Staging through a deliberately MISALIGNED
+    view makes the zero-copy eligibility check fail, forcing a real
+    copy into XLA-allocated memory (verified 0/20 aliased)."""
+    import jax.numpy as jnp
+    host = np.asarray(host)
+    if host.nbytes == 0:
+        return jnp.asarray(host)
+    raw = np.empty(host.nbytes + 1, np.uint8)
+    view = raw[1:1 + host.nbytes].view(host.dtype).reshape(host.shape)
+    view[...] = host
+    return jnp.asarray(view)
+
+
+def replace_on_mesh(mesh, like, state):
+    """Re-place every restored leaf on a mesh-wide sharding taken from
+    its `like` counterpart. Orbax restores each leaf committed to its
+    `like` placement; a fresh optimizer's scalars (e.g. Adam count) sit
+    on one device, which would clash with mesh-committed params inside
+    jit — so leaves whose `like` has no NamedSharding get the replicated
+    mesh sharding instead."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def place(fresh, restored):
+        sh = fresh.sharding if isinstance(
+            getattr(fresh, "sharding", None), NamedSharding) \
+            else NamedSharding(mesh, PartitionSpec())
+        if not isinstance(restored, np.ndarray) \
+                and getattr(restored, "sharding", None) == sh:
+            return restored     # restore() already placed it (owned)
+        return jax.device_put(xla_owned_copy(restored), sh)
+
+    return jax.tree_util.tree_map(place, like, state)
 
 
 class ElasticTrainer:
@@ -83,19 +240,7 @@ class ElasticTrainer:
         like = {"params": params, "opt_state": opt_state}
         step, state = self.ckpt.restore(like=like)
         self.step_num = step
-        # orbax restores each leaf committed to its `like` placement; a
-        # fresh optimizer's scalars (e.g. Adam count) sit on one device,
-        # which would clash with mesh-committed params inside jit —
-        # re-place every restored leaf on a mesh-wide sharding
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        def place(fresh, restored):
-            sh = fresh.sharding if isinstance(
-                getattr(fresh, "sharding", None), NamedSharding) \
-                else NamedSharding(self.trainer.mesh, PartitionSpec())
-            return jax.device_put(restored, sh)
-
-        state = jax.tree_util.tree_map(place, like, state)
+        state = replace_on_mesh(self.trainer.mesh, like, state)
         return state["params"], state["opt_state"]
 
     def fit_batch(self, params, opt_state, batch, rng):

@@ -11,21 +11,40 @@ batch dim to a power-of-two bucket (static shapes → no fresh XLA
 compiles per request count), runs a single forward, and scatters the
 rows back to their futures.
 
+Graceful degradation (resilience/): callers NEVER block indefinitely.
+- `output(x, timeout_ms=...)` enforces a per-request deadline — expiry
+  cancels the request and raises `InferenceTimeoutError`;
+- enqueue is bounded: a queue that stays full for `enqueue_timeout_ms`
+  sheds the request with `InferenceOverloadedError` instead of blocking;
+- a dead collector thread is restarted behind a `CircuitBreaker` —
+  repeated deaths OPEN the breaker and requests are served directly
+  (degraded, uncoalesced) until the cooldown's half-open probe brings
+  the collector back;
+- `shutdown()` is idempotent and drains the queue clean.
+Sheds, timeouts, and restarts count through `monitoring/`
+(`dl4j.resilience.inference_*` / `collector_restarts`).
+
 Usage parity:
     pi = (ParallelInference.Builder(net)
           .inferenceMode(InferenceMode.BATCHED)
           .batchLimit(32).queueLimit(256).build())
-    out = pi.output(x)          # thread-safe, blocks for the result
+    out = pi.output(x)                    # thread-safe, blocks
+    out = pi.output(x, timeout_ms=50)     # bounded wait
     pi.shutdown()
 """
 from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience.errors import (InferenceOverloadedError,
+                                                  InferenceTimeoutError)
+from deeplearning4j_tpu.resilience.policy import CircuitBreaker
 
 
 class InferenceMode:
@@ -44,7 +63,8 @@ def _bucket(n):
 
 
 class _Request:
-    __slots__ = ("x", "event", "result", "error", "claimed", "server")
+    __slots__ = ("x", "event", "result", "error", "claimed", "cancelled",
+                 "server")
 
     def __init__(self, x):
         self.x = x
@@ -52,25 +72,47 @@ class _Request:
         self.result = None
         self.error = None
         self.claimed = False
+        self.cancelled = False  # deadline expired: discard, never serve
         self.server = None      # thread that claimed it (set under lock)
 
 
 class ParallelInference:
     def __init__(self, model, inference_mode=InferenceMode.BATCHED,
-                 batch_limit=32, queue_limit=256, collect_timeout_ms=2.0):
+                 batch_limit=32, queue_limit=256, collect_timeout_ms=2.0,
+                 enqueue_timeout_ms=100.0, breaker=None):
         self.model = model
         self.mode = inference_mode
         self.batch_limit = int(batch_limit)
         self.collect_timeout = collect_timeout_ms / 1e3
+        self.enqueue_timeout = enqueue_timeout_ms / 1e3
         self.model_calls = 0          # diagnostic: forwards actually run
+        self.collector_restarts = 0   # diagnostic: breaker-guarded revives
+        self.collector_error = None   # last error that killed a collector
+        self._restart_unconfirmed = False   # revive awaiting 1st success
         self._queue = queue.Queue(maxsize=int(queue_limit))
         self._claim_lock = threading.Lock()
+        self._lifecycle_lock = threading.Lock()   # restart + shutdown
+        self._breaker = breaker or CircuitBreaker(
+            failure_threshold=3, cooldown=5.0, name="inference.collector")
+        self._last_dead = None    # thread whose death was already recorded
         self._shutdown = False
         self._thread = None
         if self.mode != InferenceMode.SEQUENTIAL:
-            self._thread = threading.Thread(target=self._collector,
-                                            daemon=True)
-            self._thread.start()
+            self._thread = self._start_collector()
+
+    def _start_collector(self):
+        t = threading.Thread(target=self._collector_main, daemon=True)
+        t.start()
+        return t
+
+    def _collector_main(self):
+        try:
+            self._collector()
+        except BaseException as e:  # noqa: BLE001 — thread is dying anyway
+            # remember why (surfaced by the revive path / diagnostics)
+            # instead of spewing a default thread traceback; waiting
+            # clients detect the death and revive or degrade
+            self.collector_error = e
 
     class Builder:
         def __init__(self, model):
@@ -89,6 +131,17 @@ class ParallelInference:
             self._kw["queue_limit"] = int(n)
             return self
 
+        def enqueueTimeoutMs(self, ms):
+            """How long output() may wait for queue space before shedding
+            with InferenceOverloadedError."""
+            self._kw["enqueue_timeout_ms"] = float(ms)
+            return self
+
+        def breaker(self, breaker):
+            """Circuit breaker guarding collector-thread restarts."""
+            self._kw["breaker"] = breaker
+            return self
+
         def workers(self, *_):
             return self  # one jitted executable serves all threads
 
@@ -96,11 +149,20 @@ class ParallelInference:
             return ParallelInference(self._model, **self._kw)
 
     # -- client side -----------------------------------------------------
-    def output(self, x):
+    def output(self, x, timeout_ms=None):
         """Thread-safe inference. x: one example (features without batch
         dim) or a batch; for multi-input ComputationGraphs a LIST/TUPLE
         with one array per model input (coalesced per-input). Returns the
-        model output with matching leading dims."""
+        model output with matching leading dims.
+
+        timeout_ms bounds the WHOLE call (enqueue + wait): expiry cancels
+        the request and raises InferenceTimeoutError. A full queue that
+        stays full past the bounded enqueue wait sheds the request with
+        InferenceOverloadedError — callers never block indefinitely.
+        Direct (SEQUENTIAL / degraded / post-shutdown) forwards run
+        synchronously and cannot be interrupted mid-flight: the deadline
+        is enforced after the forward, so the worst-case latency of a
+        timed-out direct call is one model forward."""
         if _mon.enabled():
             _mon.get_registry().counter(
                 "dl4j.inference.requests",
@@ -120,22 +182,38 @@ class ParallelInference:
         single = self._needs_batch(xs)
         if single:
             xs = tuple(a[None] for a in xs)
+        deadline = None if timeout_ms is None \
+            else time.monotonic() + float(timeout_ms) / 1e3
         if self.mode == InferenceMode.SEQUENTIAL or self._shutdown:
-            self.model_calls += 1
-            out = self.model.output(list(xs) if multi else xs[0])
-            out = (out[0] if isinstance(out, list) else out).numpy()
-            return out[0] if single else out
+            return self._direct_deadline(xs, multi, single, deadline)
+        if self._thread is not None and not self._thread.is_alive():
+            # dead collector noticed up front: revive (breaker willing)
+            # or serve this request directly — no pointless queue wait
+            if not self._revive_collector():
+                return self._direct_deadline(xs, multi, single, deadline)
         req = _Request(xs)
-        self._queue.put(req)
-        # wait with a shutdown escape: a request enqueued as the collector
-        # exits would otherwise block forever — claim it and serve direct
-        while not req.event.wait(0.25):
+        self._enqueue(req, deadline)
+        degraded = False
+        while not req.event.is_set():
+            wait = 0.25
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._cancel(req)
+                    raise InferenceTimeoutError(
+                        f"inference request missed its "
+                        f"{float(timeout_ms):.6g} ms deadline")
+                wait = min(wait, remaining)
+            if req.event.wait(wait):
+                break
             dead = self._thread is not None and not self._thread.is_alive()
-            if dead:
-                # collector is gone for good: flip to direct-serve mode so
-                # later calls stop enqueueing into a queue nobody drains
-                self._shutdown = True
-            if self._shutdown or dead:
+            if dead and not self._shutdown:
+                # breaker-guarded revive; False → breaker OPEN, serve
+                # this request directly (degraded but live)
+                if self._revive_collector():
+                    continue
+                degraded = True
+            if self._shutdown or (dead and degraded):
                 with self._claim_lock:
                     # reclaim an unclaimed request, or one whose claiming
                     # THREAD died before delivering (a claim held by a live
@@ -153,7 +231,107 @@ class ParallelInference:
                 # else a live thread claimed it: keep waiting below
         if req.error is not None:
             raise req.error
+        if deadline is not None and time.monotonic() > deadline:
+            # result landed after the deadline (e.g. a degraded direct
+            # serve that outran the budget): honour the contract
+            self._count_timeout()
+            raise InferenceTimeoutError(
+                f"inference request missed its "
+                f"{float(timeout_ms):.6g} ms deadline (late result "
+                "discarded)")
+        if self._restart_unconfirmed and not degraded:
+            # the FIRST queued result after a restart proves the revived
+            # collector is healthy: close the breaker exactly once (a
+            # permanent every-request record_success would also zero the
+            # failure count between deaths, so a flapping collector
+            # could never trip to degraded mode)
+            self._restart_unconfirmed = False
+            self._breaker.record_success()
         return req.result[0] if single else req.result
+
+    def _direct(self, xs, multi, single):
+        self.model_calls += 1
+        out = self.model.output(list(xs) if multi else xs[0])
+        out = (out[0] if isinstance(out, list) else out).numpy()
+        return out[0] if single else out
+
+    def _direct_deadline(self, xs, multi, single, deadline):
+        """Direct serve with the deadline enforced AFTER the forward
+        (a synchronous jitted call cannot be interrupted mid-flight)."""
+        out = self._direct(xs, multi, single)
+        if deadline is not None and time.monotonic() > deadline:
+            self._count_timeout()
+            raise InferenceTimeoutError(
+                "inference request missed its deadline (direct forward "
+                "finished late; result discarded)")
+        return out
+
+    def _count_timeout(self):
+        if _mon.enabled():
+            _mon.get_registry().counter(
+                _mon.RESILIENCE_INFERENCE_TIMEOUTS,
+                help="requests cancelled at their deadline").inc()
+
+    def _enqueue(self, req, deadline):
+        wait = self.enqueue_timeout
+        if deadline is not None:
+            wait = min(wait, max(0.0, deadline - time.monotonic()))
+        try:
+            if wait > 0:
+                self._queue.put(req, timeout=wait)
+            else:
+                self._queue.put_nowait(req)
+        except queue.Full:
+            if deadline is not None and time.monotonic() >= deadline:
+                # the caller's deadline — not the enqueue budget —
+                # expired while waiting for space: that is a timeout,
+                # not a shed (callers retry on overloaded, not timeout)
+                self._count_timeout()
+                raise InferenceTimeoutError(
+                    "inference request deadline expired while waiting "
+                    "for queue space") from None
+            if _mon.enabled():
+                _mon.get_registry().counter(
+                    _mon.RESILIENCE_INFERENCE_SHED,
+                    help="requests shed because the queue stayed full "
+                         "for the whole bounded enqueue wait").inc()
+            raise InferenceOverloadedError(
+                f"inference queue full (limit {self._queue.maxsize}) "
+                f"after {wait * 1e3:.6g} ms — request shed") from None
+
+    def _cancel(self, req):
+        """Deadline expiry: mark the request so no thread serves it (or,
+        if already in flight, so its late result is discarded)."""
+        with self._claim_lock:
+            req.cancelled = True
+            req.claimed = True
+        self._count_timeout()
+
+    def _revive_collector(self):
+        """Restart a dead collector behind the circuit breaker. Each
+        distinct thread death records ONE breaker failure (not one per
+        waiting caller); when the breaker is OPEN the restart is shed
+        and the caller degrades to direct serving. Returns True when a
+        live collector exists after the call."""
+        with self._lifecycle_lock:
+            if self._shutdown:
+                return False
+            t = self._thread
+            if t is None or t.is_alive():
+                return True
+            if t is not self._last_dead:
+                self._last_dead = t
+                self._breaker.record_failure()
+            if not self._breaker.allow():
+                return False
+            self._thread = self._start_collector()
+            self.collector_restarts += 1
+            self._restart_unconfirmed = True
+        if _mon.enabled():
+            _mon.get_registry().counter(
+                _mon.RESILIENCE_COLLECTOR_RESTARTS,
+                help="collector threads restarted after death").inc()
+        return True
 
     def _input_ranks(self):
         want = getattr(self.model, "_input_ranks", None)
@@ -191,6 +369,10 @@ class ParallelInference:
     # -- collector thread ------------------------------------------------
     def _collector(self):
         while not self._shutdown:
+            # fault site OUTSIDE the per-batch try: a fault here kills
+            # the collector thread (the auto-restart path under test)
+            if _faults.ACTIVE is not None:
+                _faults.ACTIVE.fire(_faults.INFERENCE_COLLECTOR)
             try:
                 first = self._queue.get(timeout=0.1)
             except queue.Empty:
@@ -222,9 +404,10 @@ class ParallelInference:
 
     def _dispatch(self, batch):
         """Claim-then-run: a request the fallback path already claimed
-        (shutdown race) must not be served twice."""
+        (shutdown race) or that was cancelled at its deadline must not
+        be served (twice / at all)."""
         with self._claim_lock:
-            batch = [r for r in batch if not r.claimed]
+            batch = [r for r in batch if not r.claimed and not r.cancelled]
             me = threading.current_thread()
             for r in batch:
                 r.claimed = True
@@ -234,6 +417,8 @@ class ParallelInference:
 
     def _run(self, batch):
         try:
+            if _faults.ACTIVE is not None:
+                _faults.ACTIVE.fire(_faults.INFERENCE_FORWARD)
             n_inputs = len(batch[0].x)
             cols = []
             for j in range(n_inputs):
@@ -279,18 +464,25 @@ class ParallelInference:
                 raise
 
     def shutdown(self):
-        if self._thread is not None and not self._shutdown:
+        """Idempotent: the first call stops the collector and drains the
+        queue (serving every live request, discarding cancelled ones);
+        repeats are no-ops. Post-shutdown output() serves directly."""
+        with self._lifecycle_lock:
+            if self._shutdown:
+                return
             self._shutdown = True
+            t = self._thread
+        if t is not None:
             try:
                 self._queue.put_nowait(None)
             except queue.Full:
                 pass
-            self._thread.join(timeout=5)
-            # serve anything the collector left behind
-            while True:
-                try:
-                    r = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                if r is not None:
-                    self._dispatch([r])
+            t.join(timeout=5)
+        # serve anything the collector left behind
+        while True:
+            try:
+                r = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if r is not None:
+                self._dispatch([r])

@@ -27,6 +27,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.nn.updaters import Updater
+from deeplearning4j_tpu.resilience import faults as _faults
 
 
 def _as_tx(updater):
@@ -94,6 +95,8 @@ class ShardedTrainer:
         return step
 
     def fit_batch(self, params, opt_state, batch, rng):
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
         with _mon.span("sharded.dispatch"):
             return self.make_step()(params, opt_state, batch, rng)
 
@@ -168,6 +171,8 @@ class ParameterAveragingTrainer:
         return self._step
 
     def fit_batch(self, params, opt_state, batch, rng, iteration):
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
         with _mon.span("sharded.dispatch"):
             return self.make_step()(params, opt_state, batch,
                                     rng, jnp.asarray(iteration))

@@ -22,6 +22,7 @@ import jax
 from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+from deeplearning4j_tpu.resilience import faults as _faults
 
 
 class ParallelWrapper:
@@ -113,6 +114,8 @@ class ParallelWrapper:
     def _fit_dataset(self, ds):
         """One dp-sharded train step on a DataSet (the shared inner loop —
         also driven by EarlyStoppingParallelTrainer)."""
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
         is_graph = self._graph_model()
         from deeplearning4j_tpu.datasets.dataset import MultiDataSet
         if isinstance(ds, MultiDataSet):
@@ -215,6 +218,8 @@ class ParallelWrapper:
                 sh(ds.labelsMask))
 
     def _fit_group_scanned(self, group):
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
         m = self.model
         from jax.sharding import NamedSharding, PartitionSpec as P
         sh2 = NamedSharding(self.mesh.mesh, P(None, "dp"))  # (k, B, ...)

@@ -1,0 +1,55 @@
+"""Resilience subsystem: retry/backoff, circuit breaking, deterministic
+fault injection, and checkpoint-resume training (≡ the reference's
+SharedTrainingMaster fault tolerance, where a restarted host rejoins
+from shared state, generalized into first-class runtime policies).
+
+Pieces:
+- `policy` — `RetryPolicy` (exponential backoff + seeded jitter,
+  attempt/deadline budgets, OOM-never-retries classifier) and
+  `CircuitBreaker` (closed/open/half-open with cooldown);
+- `faults` — seeded `FaultPlan` injection at named sites
+  (data.next / train.dispatch / checkpoint.save / inference.forward),
+  zero-cost-when-disabled hooks in the production paths;
+- `trainer` — `FaultTolerantTrainer`: periodic async checkpoints,
+  step-accurate `resume_or_init`, retry around transient dispatch
+  failures, skip-and-count for corrupt batches;
+- `errors` — the typed degradation errors, including the
+  `InferenceTimeoutError` / `InferenceOverloadedError` raised by the
+  hardened `parallel/inference.py`.
+
+Everything is observable through `monitoring/` as `dl4j.resilience.*`
+with one-flag-check overhead when monitoring is off.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.resilience.errors import (  # noqa: F401
+    CircuitOpenError, FatalTrainingError, InferenceOverloadedError,
+    InferenceTimeoutError, InjectedFault, ResilienceError,
+    RetryExhaustedError, TransientError)
+from deeplearning4j_tpu.resilience.faults import (  # noqa: F401
+    CHECKPOINT_SAVE, DATA_NEXT, INFERENCE_COLLECTOR, INFERENCE_FORWARD,
+    TRAIN_DISPATCH, FaultPlan, clear_plan, install_plan)
+from deeplearning4j_tpu.resilience.policy import (  # noqa: F401
+    CircuitBreaker, RetryPolicy, default_classifier)
+
+__all__ = [
+    "ResilienceError", "TransientError", "RetryExhaustedError",
+    "CircuitOpenError", "InferenceTimeoutError",
+    "InferenceOverloadedError", "InjectedFault", "FatalTrainingError",
+    "RetryPolicy", "CircuitBreaker", "default_classifier",
+    "FaultPlan", "install_plan", "clear_plan",
+    "DATA_NEXT", "TRAIN_DISPATCH", "CHECKPOINT_SAVE",
+    "INFERENCE_FORWARD", "INFERENCE_COLLECTOR",
+    "FaultTolerantTrainer",
+]
+
+
+def __getattr__(name):
+    # FaultTolerantTrainer imports parallel/elastic.py, which imports
+    # this package back through parallel/inference.py — resolved lazily
+    # so `import deeplearning4j_tpu.resilience` never cycles
+    if name == "FaultTolerantTrainer":
+        from deeplearning4j_tpu.resilience.trainer import \
+            FaultTolerantTrainer
+        return FaultTolerantTrainer
+    raise AttributeError(name)

@@ -1,0 +1,65 @@
+"""Typed errors for the resilience subsystem.
+
+Kept dependency-free (no jax, no package imports) so any layer —
+`parallel/inference.py`, the trainers, user code — can import them
+without cycles. Each error names the degradation mode it represents,
+mirroring how the reference's SharedTrainingMaster surfaces distinct
+failure classes (worker loss vs. transport backpressure) instead of one
+opaque RuntimeError.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "ResilienceError", "TransientError", "RetryExhaustedError",
+    "CircuitOpenError", "InferenceTimeoutError",
+    "InferenceOverloadedError", "InjectedFault", "FatalTrainingError",
+]
+
+
+class ResilienceError(RuntimeError):
+    """Base class for every typed resilience error."""
+
+
+class TransientError(ResilienceError):
+    """An error the raiser asserts is safe to retry (device hiccup,
+    preempted dispatch, transport blip). `RetryPolicy` always classifies
+    this type as retryable."""
+
+
+class RetryExhaustedError(ResilienceError):
+    """RetryPolicy gave up: attempt budget or deadline exceeded. The
+    last underlying failure rides along as `__cause__` / `.last_error`."""
+
+    def __init__(self, message, last_error=None, attempts=0):
+        super().__init__(message)
+        self.last_error = last_error
+        self.attempts = attempts
+
+
+class CircuitOpenError(ResilienceError):
+    """The circuit breaker is OPEN: calls are shed without being tried
+    until the cooldown elapses (then one half-open probe is allowed)."""
+
+
+class InferenceTimeoutError(ResilienceError):
+    """A ParallelInference request missed its per-request deadline
+    (`output(x, timeout_ms=...)`). The request is cancelled: a late
+    result, if one arrives, is discarded."""
+
+
+class InferenceOverloadedError(ResilienceError):
+    """ParallelInference shed the request because the queue stayed full
+    for the whole bounded enqueue wait — graceful degradation instead of
+    blocking the caller indefinitely."""
+
+
+class InjectedFault(TransientError):
+    """Default exception raised by the fault-injection harness
+    (`resilience/faults.py`). Transient by definition, so retry paths
+    exercise their backoff logic under injection."""
+
+
+class FatalTrainingError(ResilienceError):
+    """A deliberately NON-retryable injected/classified failure — used by
+    fault plans to simulate a process kill (the trainer must crash and
+    later resume from its checkpoint, not retry through it)."""

@@ -1,0 +1,178 @@
+"""Deterministic, seeded fault injection (the harness the resilience
+tests drive; ≡ testing the reference's SharedTrainingMaster by killing
+Spark workers on schedule, but in-process and reproducible).
+
+Production code consults the harness through zero-cost-when-disabled
+hooks at four named sites:
+
+    DATA_NEXT          "data.next"          — batch pulled from iterator
+    TRAIN_DISPATCH     "train.dispatch"     — before the jitted step runs
+    CHECKPOINT_SAVE    "checkpoint.save"    — before an async ckpt save
+    INFERENCE_FORWARD  "inference.forward"  — before a coalesced forward
+
+The hook at every call site is literally
+
+    if _faults.ACTIVE is not None:
+        _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+
+— one module-attribute check, no allocation, nothing else on the
+disabled (production) path. `ACTIVE` is only ever set by an installed
+`FaultPlan`.
+
+A plan is a list of seeded rules per site: fail exactly at call N, every
+nth call, or with probability p (seeded `random.Random`, so the same
+plan replays the same fault schedule). Rules raise `InjectedFault`
+(classified transient → exercises retry) unless given another exception
+factory (e.g. `FatalTrainingError` to simulate a kill, or an OOM-shaped
+RuntimeError to prove retry refuses it).
+"""
+from __future__ import annotations
+
+import random
+import threading
+
+from deeplearning4j_tpu.resilience.errors import InjectedFault
+
+__all__ = ["FaultPlan", "install_plan", "clear_plan", "ACTIVE",
+           "DATA_NEXT", "TRAIN_DISPATCH", "CHECKPOINT_SAVE",
+           "INFERENCE_FORWARD", "INFERENCE_COLLECTOR"]
+
+DATA_NEXT = "data.next"
+TRAIN_DISPATCH = "train.dispatch"
+CHECKPOINT_SAVE = "checkpoint.save"
+INFERENCE_FORWARD = "inference.forward"
+#: fires in the collector LOOP (outside the per-batch try), so a fault
+#: here kills the collector thread itself — the scenario the breaker-
+#: guarded auto-restart exists for
+INFERENCE_COLLECTOR = "inference.collector"
+
+#: THE switch production hooks check. None → injection off (the
+#: permanent state outside resilience tests).
+ACTIVE = None
+
+
+class _Rule:
+    __slots__ = ("kind", "arg", "make", "max_fires", "fires")
+
+    def __init__(self, kind, arg, make, max_fires):
+        self.kind = kind          # "at" | "every" | "prob"
+        self.arg = arg
+        self.make = make
+        self.max_fires = max_fires
+        self.fires = 0
+
+    def matches(self, call_n, rng):
+        if self.max_fires is not None and self.fires >= self.max_fires:
+            return False
+        if self.kind == "at":
+            return call_n == self.arg
+        if self.kind == "every":
+            return call_n % self.arg == 0
+        return rng.random() < self.arg          # "prob"
+
+
+def _default_exc(site, call_n):
+    return InjectedFault(f"injected fault at {site} (call {call_n})")
+
+
+class FaultPlan:
+    """Seeded schedule of failures at named injection sites.
+
+    Usage:
+        plan = (FaultPlan(seed=7)
+                .fail_at(TRAIN_DISPATCH, 17, exc=FatalTrainingError("kill"))
+                .every(INFERENCE_FORWARD, 3)
+                .probability(DATA_NEXT, 0.05))
+        with plan:                  # installs/clears the global hook
+            ... run training ...
+        plan.fired[TRAIN_DISPATCH]  # how many faults actually fired
+    """
+
+    def __init__(self, seed=0):
+        self._rules = {}            # site -> [_Rule]
+        self._calls = {}            # site -> call count (1-based)
+        self.fired = {}             # site -> faults raised
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    # -- rule builders (chainable) --------------------------------------
+    def _add(self, site, kind, arg, exc, max_fires):
+        make = exc if callable(exc) else (
+            None if exc is None else (lambda *_: exc))
+        self._rules.setdefault(site, []).append(
+            _Rule(kind, arg, make, max_fires))
+        return self
+
+    def fail_at(self, site, call_n, exc=None):
+        """Raise on exactly the `call_n`-th (1-based) visit to `site`."""
+        return self._add(site, "at", int(call_n), exc, max_fires=1)
+
+    def every(self, site, nth, exc=None, max_fires=None):
+        """Raise on every `nth` visit to `site`."""
+        if int(nth) < 1:
+            raise ValueError("nth must be >= 1")
+        return self._add(site, "every", int(nth), exc, max_fires)
+
+    def probability(self, site, p, exc=None, max_fires=None):
+        """Raise with probability `p` per visit (seeded, replayable)."""
+        return self._add(site, "prob", float(p), exc, max_fires)
+
+    # -- the hot hook ----------------------------------------------------
+    def fire(self, site):
+        """Called by production hooks while this plan is installed:
+        count the visit and raise if a rule matches. Thread-safe (the
+        inference sites fire from collector threads)."""
+        with self._lock:
+            n = self._calls.get(site, 0) + 1
+            self._calls[site] = n
+            exc = None
+            for rule in self._rules.get(site, ()):
+                if rule.matches(n, self._rng):
+                    rule.fires += 1
+                    self.fired[site] = self.fired.get(site, 0) + 1
+                    make = rule.make or _default_exc
+                    exc = make(site, n)
+                    break
+        if exc is None:
+            return
+        from deeplearning4j_tpu import monitoring as _mon
+        if _mon.enabled():
+            _mon.get_registry().counter(
+                _mon.RESILIENCE_FAULTS_INJECTED, labels={"site": site},
+                help="faults raised by the injection harness").inc()
+        raise exc
+
+    def calls(self, site):
+        """How many times `site` has been visited under this plan."""
+        with self._lock:
+            return self._calls.get(site, 0)
+
+    def reset_counts(self):
+        """Clear visit/fire counts but keep the rules (a 'restarted
+        process' sees fresh call numbering; rule fire budgets persist so
+        a fail-once kill does not re-kill the resumed run)."""
+        with self._lock:
+            self._calls.clear()
+        return self
+
+    # -- install/clear ---------------------------------------------------
+    def install(self):
+        global ACTIVE
+        ACTIVE = self
+        return self
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        clear_plan()
+        return False
+
+
+def install_plan(plan):
+    return plan.install()
+
+
+def clear_plan():
+    global ACTIVE
+    ACTIVE = None

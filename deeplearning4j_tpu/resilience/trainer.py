@@ -1,0 +1,398 @@
+"""FaultTolerantTrainer — checkpoint-resume training with retry and
+skip-and-count (≡ the reference's SharedTrainingMaster fault tolerance:
+a restarted worker rejoins and resumes from the last shared state; here
+the shared state is an orbax checkpoint and "rejoin" is
+`resume_or_init`).
+
+Two wrapping modes, detected from the wrapped object:
+
+* **network mode** — wraps a `MultiLayerNetwork` / `ComputationGraph`.
+  `fit(iterator, epochs=)` drives the model's own per-batch step with:
+  periodic async `ElasticCheckpointer` saves of
+  (params, opt_state, rng key, bn state, counters); `resume_or_init()`
+  on entry, restoring the latest checkpoint and SKIPPING the iterator
+  batches that run already consumed — step-accurate, so a resumed run
+  reaches params bit-identical to an uninterrupted one (the rng key is
+  checkpointed, so the retry/resume replay uses the exact key stream);
+  retry-with-backoff around transient dispatch failures (model state is
+  snapshotted before each attempt and restored before a retry, so a
+  half-mutated attempt never leaks into the replay); and skip-and-count
+  for corrupt/non-finite batches instead of crashing.
+
+* **sharded mode** — wraps a `ShardedTrainer`-style functional trainer
+  (`init`/`fit_batch`). `resume_or_init(init_params)` returns restored
+  (params, opt_state) re-placed on the trainer's mesh;
+  `fit_batch(params, opt_state, batch, rng)` adds the same retry, skip,
+  and periodic-save behavior. Deterministic resume here requires the
+  caller to derive `rng` from `trainer.step` (e.g.
+  `jax.random.fold_in(root, step)`), since the rng lives with the
+  caller in the functional style.
+
+Every resume, retry, skipped batch, and save is observable through
+`monitoring/` (`dl4j.resilience.*`) at zero cost when monitoring is
+disabled.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience.errors import FatalTrainingError
+from deeplearning4j_tpu.resilience.policy import RetryPolicy
+
+__all__ = ["FaultTolerantTrainer"]
+
+
+def _finite(a):
+    if a is None:
+        return True
+    arr = np.asarray(a)
+    if not np.issubdtype(arr.dtype, np.floating):
+        return True            # int label ids etc. cannot be NaN
+    return bool(np.isfinite(arr).all())
+
+
+def _dataset_arrays(ds):
+    """Feature/label arrays of a DataSet or MultiDataSet (masks are
+    weights — a zero there is meaning, not corruption)."""
+    feats = getattr(ds, "features", None)
+    labs = getattr(ds, "labels", None)
+    out = []
+    for group in (feats, labs):
+        if isinstance(group, (list, tuple)):
+            out.extend(group)
+        elif group is not None:
+            out.append(group)
+    return out
+
+
+class FaultTolerantTrainer:
+    def __init__(self, model, directory, save_every=25, max_to_keep=3,
+                 retry_policy=None, skip_non_finite=True,
+                 max_skipped_batches=None):
+        from deeplearning4j_tpu.parallel.elastic import ElasticCheckpointer
+        self.model = model
+        # our `step` counter (batches consumed) drives save cadence, so
+        # the manager itself saves every step it is asked to
+        self.ckpt = ElasticCheckpointer(directory, max_to_keep=max_to_keep,
+                                        save_interval_steps=1)
+        self.save_every = int(save_every)
+        self.retry = retry_policy or RetryPolicy(max_attempts=3)
+        self.skip_non_finite = bool(skip_non_finite)
+        self.max_skipped_batches = max_skipped_batches
+        self.step = 0              # iterator batches consumed (inc. skipped)
+        self.skipped = 0
+        self.resumed_step = None   # step restored from, or None
+        self._is_network = hasattr(model, "_fit_batch")
+
+    # -- shared bookkeeping ---------------------------------------------
+    def _count_skip(self, reason):
+        self.skipped += 1
+        if _mon.enabled():
+            _mon.get_registry().counter(
+                _mon.RESILIENCE_BATCHES_SKIPPED,
+                labels={"reason": reason},
+                help="batches skipped instead of crashing the run").inc()
+        if self.max_skipped_batches is not None \
+                and self.skipped > self.max_skipped_batches:
+            raise FatalTrainingError(
+                f"skipped {self.skipped} batches "
+                f"(> max_skipped_batches={self.max_skipped_batches}) — "
+                "data pipeline looks broken, refusing to train on noise")
+
+    def _note_resume(self, step):
+        self.resumed_step = step
+        if _mon.enabled():
+            reg = _mon.get_registry()
+            reg.counter(_mon.RESILIENCE_RESUMES,
+                        help="checkpoint resumes after restart").inc()
+            reg.gauge(_mon.RESILIENCE_RESUME_STEP,
+                      help="step the latest resume restored").set(step)
+
+    # ===================== network mode =================================
+    def _net_extra(self):
+        m = self.model
+        # 0-d ndarrays: orbax StandardSave rejects bare numpy scalars
+        extra = {"rng_key": np.asarray(m._rng_key),
+                 "iteration": np.asarray(int(m._iteration), np.int64),
+                 "epoch": np.asarray(int(m._epoch), np.int64),
+                 "step": np.asarray(int(self.step), np.int64)}
+        if m._state:
+            extra["net_state"] = m._state
+        return extra
+
+    def _save_network(self, wait=False):
+        m = self.model
+        self.ckpt.save(self.step, m._params, m._opt_state,
+                       extra=self._net_extra(), wait=wait)
+
+    def resume_or_init(self):
+        """Network mode: restore the latest checkpoint INTO the wrapped
+        (already-initialized) model. Returns the restored step (batches
+        already consumed by the crashed run), 0 when starting fresh."""
+        import jax
+        m = self.model
+        if m._params is None:
+            m.init()
+        latest = self.ckpt.latest_step()
+        if latest is None:
+            return 0
+        like = {"params": m._params, "opt_state": m._opt_state,
+                "extra": self._net_extra()}
+        step, state = self.ckpt.restore(like=like)
+
+        # Rebuild restored leaves as XLA-OWNED device arrays before the
+        # donating train step ever sees them (see
+        # parallel/elastic.xla_owned_copy: jnp.asarray zero-copy
+        # aliases numpy memory, and donation then frees a buffer numpy
+        # owns — intermittent heap corruption after resume). Uncommitted
+        # like init()'s arrays; mesh-sharded leaves get the explicit
+        # NamedSharding device_put.
+        from jax.sharding import NamedSharding
+
+        from deeplearning4j_tpu.parallel.elastic import xla_owned_copy
+
+        def place(fresh, restored):
+            if not hasattr(restored, "shape"):
+                return restored
+            sh = getattr(fresh, "sharding", None)
+            if sh is None:
+                return np.array(restored)
+            owned = xla_owned_copy(restored)
+            return jax.device_put(owned, sh) \
+                if isinstance(sh, NamedSharding) else owned
+
+        state = jax.tree_util.tree_map(place, like, state)
+        m._params = state["params"]
+        m._opt_state = state["opt_state"]
+        extra = state["extra"]
+        if "net_state" in extra:
+            m._state = extra["net_state"]
+        m._rng_key = xla_owned_copy(
+            np.asarray(extra["rng_key"], np.uint32))
+        m._iteration = int(extra["iteration"])
+        # _epoch is deliberately NOT restored: fit() re-walks every epoch
+        # from 0 (skipping consumed batches) and increments per pass, so
+        # restoring the mid-run value would double-count the replayed
+        # epochs (final _epoch = restored + epochs instead of epochs).
+        # The checkpointed value stays available in the dump for
+        # post-mortems.
+        self.step = int(extra["step"])
+        self._note_resume(self.step)
+        return self.step
+
+    def _snapshot(self):
+        m = self.model
+        return (m._params, m._opt_state, m._state, m._rng_key,
+                m._iteration, m._epoch, m._score,
+                getattr(m, "_params_version", 0))
+
+    def _restore_snapshot(self, snap):
+        m = self.model
+        (m._params, m._opt_state, m._state, m._rng_key,
+         m._iteration, m._epoch, m._score, m._params_version) = snap
+
+    def _fit_one(self, ds):
+        """One batch through the model's own step, retrying transient
+        dispatch failures. The pre-attempt snapshot is restored before
+        every retry so the rng split and counters replay exactly —
+        a retried step is bit-identical to a never-failed one.
+
+        The snapshot holds REFERENCES (a per-batch host copy of every
+        param would double the step's memory traffic). A failure raised
+        BEFORE the jitted dispatch consumes its donated buffers — the
+        fault-injection point, enqueue/transfer errors — restores and
+        retries cleanly. A failure AFTER donation leaves the snapshot
+        pointing at deleted buffers; retrying would crash confusingly,
+        so that case re-raises the original error and the process-level
+        answer (restart + resume_or_init from the last checkpoint)
+        takes over."""
+        m = self.model
+        snap = self._snapshot()
+
+        def attempt():
+            if self._is_multilayer():
+                m._fit_batch(ds.features, ds.labels, ds.labelsMask,
+                             ds.featuresMask)
+            else:
+                m._fit_batch(ds)
+
+        def on_retry(attempt_n, exc):
+            import jax
+            for tree in (snap[0], snap[1], snap[2]):
+                for leaf in jax.tree_util.tree_leaves(tree):
+                    if getattr(leaf, "is_deleted", lambda: False)():
+                        raise exc   # donated mid-dispatch: not retryable
+            self._restore_snapshot(snap)
+
+        self.retry.call(attempt, label="train.dispatch",
+                        on_retry=on_retry)
+
+    def _is_multilayer(self):
+        # ComputationGraph._fit_batch takes the DataSet whole;
+        # MultiLayerNetwork's takes unpacked arrays
+        cached = getattr(self, "_multilayer_sig", None)
+        if cached is None:
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+            cached = not isinstance(self.model, ComputationGraph)
+            self._multilayer_sig = cached
+        return cached
+
+    def fit(self, data, epochs=1):
+        """Network mode: resume (if a checkpoint exists), then drive the
+        iterator. Batch positions the crashed run already consumed are
+        skipped — corrupt batches count as consumed, so replay alignment
+        holds. A fatal (non-retryable) error waits for in-flight async
+        saves before propagating, so the NEXT run's `resume_or_init`
+        sees every checkpoint this run completed."""
+        if not self._is_network:
+            raise TypeError("fit(iterator) is network mode; wrap a "
+                            "MultiLayerNetwork/ComputationGraph, or use "
+                            "resume_or_init(params)/fit_batch(...) for "
+                            "functional trainers")
+        already = self.resume_or_init()
+        consumed = 0
+        try:
+            for _ in range(int(epochs)):
+                with _mon.span("fit.epoch"):
+                    if hasattr(data, "reset"):
+                        data.reset()
+                    # the RAW iterator, spanned manually — traced_iter's
+                    # generator would be finalized by the first iterator
+                    # exception, silently truncating the epoch on the
+                    # very errors this loop exists to skip-and-count
+                    it = iter(data)
+                    while True:
+                        # the injection hook gets its OWN handler: it
+                        # fires BEFORE the pull, so the iterator has not
+                        # advanced and the real batch must be pulled-
+                        # and-dropped to keep `consumed` aligned with
+                        # true iterator position (resume replay depends
+                        # on it)
+                        if _faults.ACTIVE is not None:
+                            try:
+                                _faults.ACTIVE.fire(_faults.DATA_NEXT)
+                            except Exception as e:  # noqa: BLE001
+                                if not self.retry.classifier(e):
+                                    raise
+                                try:
+                                    next(it)
+                                except StopIteration:
+                                    break
+                                consumed += 1
+                                if consumed > already:
+                                    self.step = consumed
+                                    self._count_skip("data_fault")
+                                continue
+                        try:
+                            with _mon.span("fit.data_next"):
+                                ds = next(it)
+                        except StopIteration:
+                            break
+                        except Exception as e:  # noqa: BLE001
+                            if not self.retry.classifier(e):
+                                raise
+                            # the iterator ITSELF failed mid-pull: that
+                            # position is lost (best effort — a broken
+                            # pipeline cannot re-serve it, and a
+                            # generator-backed iterator may end the
+                            # epoch on the next pull); count it consumed
+                            # so replay stays aligned with the positions
+                            # the iterator actually yielded
+                            consumed += 1
+                            if consumed > already:
+                                self.step = consumed
+                                self._count_skip("data_error")
+                            continue
+                        consumed += 1
+                        if consumed <= already:
+                            continue       # trained before the crash
+                        if self.skip_non_finite and \
+                                not all(_finite(a)
+                                        for a in _dataset_arrays(ds)):
+                            self.step = consumed
+                            self._count_skip("non_finite")
+                            continue
+                        self._fit_one(ds)
+                        self.step = consumed
+                        if self.step % self.save_every == 0:
+                            self._save_network()
+                    self.model._epoch += 1
+            self._save_network(wait=True)
+        except Exception:
+            # simulate-kill paths land here: flush in-flight saves so the
+            # restart can restore the newest completed checkpoint
+            try:
+                self.ckpt.manager.wait_until_finished()
+            except Exception:  # noqa: BLE001 — the original error wins
+                pass
+            raise
+        return self.model
+
+    # ===================== sharded (functional) mode ====================
+    def resume_or_init_sharded(self, init_params):
+        """Sharded mode: init via the wrapped trainer, then overwrite
+        with the latest checkpoint re-placed on the trainer's mesh.
+        Returns (params, opt_state); `self.step` holds the restored
+        step for the caller's rng derivation."""
+        from deeplearning4j_tpu.parallel.elastic import replace_on_mesh
+        trainer = self.model
+        params, opt_state = trainer.init(init_params)
+        latest = self.ckpt.latest_step()
+        if latest is None:
+            return params, opt_state
+        like = {"params": params, "opt_state": opt_state}
+        step, state = self.ckpt.restore(like=like)
+        state = replace_on_mesh(trainer.mesh, like, state)
+        self.step = int(step)
+        self._note_resume(self.step)
+        return state["params"], state["opt_state"]
+
+    def fit_batch(self, params, opt_state, batch, rng):
+        """Sharded mode: one retried step + periodic save. Non-finite
+        batches return the inputs unchanged with loss None."""
+        trainer = self.model
+        if self.skip_non_finite:
+            import jax
+            # only HOST-resident leaves are checked: np.asarray on an
+            # already-sharded device batch would force a blocking D2H
+            # readback every step (and crash on multi-host shards) —
+            # callers wanting device-batch validation should check
+            # before shard_batch
+            leaves = [a for a in jax.tree_util.tree_leaves(batch)
+                      if isinstance(a, np.ndarray)]
+            if not all(_finite(a) for a in leaves):
+                self.step += 1
+                self._count_skip("non_finite")
+                return params, opt_state, None
+        def on_retry(attempt_n, exc):
+            # same donation guard as network mode's _fit_one: a failure
+            # AFTER the jitted step consumed its donated inputs leaves
+            # params/opt_state deleted — re-raise the ORIGINAL error
+            # instead of a confusing 'Array has been deleted' retry
+            import jax
+            for tree in (params, opt_state):
+                for leaf in jax.tree_util.tree_leaves(tree):
+                    if getattr(leaf, "is_deleted", lambda: False)():
+                        raise exc
+
+        params, opt_state, loss = self.retry.call(
+            trainer.fit_batch, params, opt_state, batch, rng,
+            label="train.dispatch", on_retry=on_retry)
+        self.step += 1
+        if self.step % self.save_every == 0:
+            self.ckpt.save(self.step, params, opt_state)
+        return params, opt_state, loss
+
+    def finalize(self, params=None, opt_state=None):
+        """Final synchronous save (sharded mode passes the live state;
+        network mode reads it off the model) and close."""
+        if params is not None:
+            self.ckpt.save(self.step, params, opt_state, wait=True)
+        elif self._is_network and self.model._params is not None:
+            self._save_network(wait=True)
+        self.close()
+
+    def close(self):
+        self.ckpt.close()

@@ -139,6 +139,33 @@ class CrashReportingUtil:
             lines.append(f"Configuration: {type(conf).__name__} "
                          f"(layers: {len(getattr(conf, 'layers', []) or [])})")
         lines.append("")
+
+        # monitoring snapshot: what was the process DOING at OOM time?
+        # (counters tell the story so far, the open span stack tells the
+        # phase that died). Only when monitoring is on — the dump must
+        # not wake the subsystem up.
+        try:
+            from deeplearning4j_tpu import monitoring as _mon
+            if _mon.enabled():
+                lines.append("Monitoring at crash time:")
+                stack = _mon.get_tracer().current_stack()
+                lines.append("  open spans: "
+                             + (" > ".join(stack) if stack else "(none)"))
+                snap = _mon.get_registry().snapshot()
+                for name in sorted(snap):
+                    for rec in snap[name]:
+                        lbl = "".join(f"[{k}={v}]"
+                                      for k, v in rec["labels"].items())
+                        if rec["kind"] == "histogram":
+                            lines.append(
+                                f"  {name}{lbl}: count={rec['count']} "
+                                f"sum={rec['sum']:.6g} p99={rec['p99']}")
+                        else:
+                            lines.append(f"  {name}{lbl}: {rec['value']}")
+                lines.append("")
+        except Exception as e:  # noqa: BLE001 — dumps must never raise
+            lines.append(f"(monitoring snapshot failed: {e})")
+            lines.append("")
         lines.append("Mitigations (TPU):")
         lines.append("  - reduce the batch size (HBM high-water scales ~"
                      "linearly with batch)")

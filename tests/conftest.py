@@ -90,14 +90,16 @@ _SMOKE_MODULES = {"test_ops.py", "test_multilayer.py", "test_eval.py",
                   "test_losses_tail.py", "test_datasets.py",
                   "test_serialization.py", "test_clustering.py",
                   "test_graph_embeddings.py", "test_envguard.py",
-                  "test_image_transforms.py"}
+                  "test_image_transforms.py", "test_resilience.py"}
 
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
-        # minutes-long scale checks never belong in the smoke signal
+        # minutes-long scale checks and slow soaks never belong in the
+        # smoke signal
         if item.fspath.basename in _SMOKE_MODULES \
-                and "memory_bounded" not in item.name:
+                and "memory_bounded" not in item.name \
+                and item.get_closest_marker("slow") is None:
             item.add_marker(pytest.mark.smoke)
     if os.environ.get("DL4J_TPU_TEST_TIER", "full").lower() != "smoke":
         return

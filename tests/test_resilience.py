@@ -1,0 +1,613 @@
+"""Resilience subsystem (resilience/ + hardened parallel stack):
+seeded fault plans prove (a) checkpoint-resume training is bit-identical
+to an uninterrupted run, (b) retry refuses OOM-classified errors,
+(c) the circuit breaker opens/half-opens on schedule, (d) overloaded /
+timed-out inference raises typed errors and the queue drains clean."""
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.datasets.iterators import (ArrayDataSetIterator,
+                                                   DataSetIterator)
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer, Sgd)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.inference import (InferenceMode,
+                                                   ParallelInference)
+from deeplearning4j_tpu.resilience import (CircuitBreaker, CircuitOpenError,
+                                           FatalTrainingError, FaultPlan,
+                                           InferenceOverloadedError,
+                                           InferenceTimeoutError,
+                                           InjectedFault, RetryExhaustedError,
+                                           RetryPolicy, TransientError,
+                                           default_classifier, faults)
+from deeplearning4j_tpu.resilience.trainer import FaultTolerantTrainer
+
+
+def _net():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(3).updater(Sgd(0.1)).activation("tanh")
+            .list()
+            .layer(DenseLayer.Builder().nOut(8).build())
+            .layer(OutputLayer.Builder("mcxent").nOut(3)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=120, nan_at=None):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((n, 5)).astype(np.float32)
+    Y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    if nan_at is not None:
+        X[nan_at] = np.nan
+    return X, Y
+
+
+def _params(net):
+    return jax.tree_util.tree_map(np.asarray, net._params)
+
+
+def _assert_trees_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert np.asarray(x).dtype == np.asarray(y).dtype
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    yield
+    faults.clear_plan()
+    monitoring.disable()
+
+
+# ===================== RetryPolicy ========================================
+def test_retry_recovers_from_transient():
+    slept = []
+    pol = RetryPolicy(max_attempts=4, initial_backoff=0.01, jitter=0.0,
+                      sleep=slept.append)
+    n = [0]
+
+    def flaky():
+        n[0] += 1
+        if n[0] < 3:
+            raise TransientError("blip")
+        return "ok"
+
+    assert pol.call(flaky) == "ok"
+    assert n[0] == 3
+    # exponential: 0.01, 0.02
+    np.testing.assert_allclose(slept, [0.01, 0.02])
+
+
+def test_retry_never_retries_oom():
+    pol = RetryPolicy(max_attempts=5, initial_backoff=0.0,
+                      sleep=lambda s: None)
+    n = [0]
+
+    def oom():
+        n[0] += 1
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating")
+
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        pol.call(oom)
+    assert n[0] == 1, "OOM must fail fast, not burn retry budget"
+    # classifier agrees even for transiently-phrased OOMs
+    assert not default_classifier(
+        RuntimeError("RESOURCE_EXHAUSTED: try again"))
+    assert default_classifier(RuntimeError("UNAVAILABLE: socket closed"))
+    # typed-fatal beats a transient-looking message (a simulated process
+    # kill saying "preempted" must NOT be retried through)
+    assert not default_classifier(FatalTrainingError("preempted"))
+
+
+def test_retry_budget_exhaustion_is_typed():
+    pol = RetryPolicy(max_attempts=3, initial_backoff=0.0,
+                      sleep=lambda s: None)
+
+    def always():
+        raise TransientError("down")
+
+    with pytest.raises(RetryExhaustedError) as ei:
+        pol.call(always)
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last_error, TransientError)
+
+
+def test_retry_deadline_budget():
+    t = [0.0]
+    pol = RetryPolicy(max_attempts=100, initial_backoff=1.0, jitter=0.0,
+                      deadline=2.5, sleep=lambda s: t.__setitem__(0, t[0] + s),
+                      clock=lambda: t[0])
+
+    def always():
+        raise TransientError("down")
+
+    with pytest.raises(RetryExhaustedError, match="deadline"):
+        pol.call(always)
+    assert t[0] <= 2.5
+
+
+def test_retry_jitter_deterministic():
+    a = RetryPolicy(seed=42, jitter=0.5)
+    b = RetryPolicy(seed=42, jitter=0.5)
+    assert [a.backoff(i) for i in range(1, 6)] == \
+        [b.backoff(i) for i in range(1, 6)]
+
+
+# ===================== CircuitBreaker =====================================
+def test_breaker_opens_and_half_opens_on_schedule():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=3, cooldown=10.0,
+                        clock=lambda: t[0])
+    assert br.state == CircuitBreaker.CLOSED
+    for _ in range(2):
+        br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED, "below threshold stays closed"
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+    t[0] = 9.99
+    assert not br.allow(), "cooldown not elapsed"
+    t[0] = 10.0
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert br.allow(), "half-open hands out one probe"
+    assert not br.allow(), "second caller sheds while probe is out"
+    br.record_failure()          # probe failed -> re-open for a new cooldown
+    assert br.state == CircuitBreaker.OPEN
+    t[0] = 19.9
+    assert not br.allow()
+    t[0] = 20.1
+    assert br.allow()
+    br.record_success()          # probe succeeded -> closed, counters reset
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow() and br.allow()
+
+
+def test_breaker_call_sheds_with_typed_error():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=1, cooldown=5.0,
+                        clock=lambda: t[0])
+    with pytest.raises(ValueError):
+        br.call(lambda: (_ for _ in ()).throw(ValueError("x")))
+    with pytest.raises(CircuitOpenError):
+        br.call(lambda: "never runs")
+    t[0] = 5.0
+    assert br.call(lambda: "probe ok") == "probe ok"
+    assert br.state == CircuitBreaker.CLOSED
+
+
+# ===================== FaultPlan ==========================================
+def test_fault_plan_schedules_are_deterministic():
+    plan = (FaultPlan(seed=5)
+            .fail_at("site.a", 3)
+            .every("site.b", 2, max_fires=2)
+            .probability("site.c", 0.5))
+
+    def run(p, site, n):
+        hits = []
+        for i in range(1, n + 1):
+            try:
+                p.fire(site)
+            except InjectedFault:
+                hits.append(i)
+        return hits
+
+    assert run(plan, "site.a", 6) == [3]
+    assert run(plan, "site.b", 8) == [2, 4]      # max_fires caps at 2
+    prob_hits = run(plan, "site.c", 20)
+    # same seed replays the identical probabilistic schedule
+    plan2 = FaultPlan(seed=5).probability("site.c", 0.5)
+    assert run(plan2, "site.c", 20) == prob_hits
+    assert plan.calls("site.a") == 6
+
+
+def test_fault_smoke_injection_reaches_train_dispatch():
+    """Tier-1 smoke: the production hook in the fit path actually consults
+    an installed plan, and an uninstalled plan costs nothing."""
+    net = _net()
+    X, Y = _data(16)
+    with FaultPlan().fail_at(faults.TRAIN_DISPATCH, 1):
+        with pytest.raises(InjectedFault):
+            net.fit(ArrayDataSetIterator(X, Y, 8))
+    # plan cleared on exit: training works again
+    assert faults.ACTIVE is None
+    net.fit(ArrayDataSetIterator(X, Y, 8))
+
+
+# ===================== FaultTolerantTrainer ===============================
+def test_kill_and_resume_bit_identical(tmp_path):
+    """Acceptance (a): a seeded kill-at-step-N run resumes from the
+    latest checkpoint and reaches final params identical to an
+    uninterrupted run."""
+    X, Y = _data(120)
+
+    def it():
+        return ArrayDataSetIterator(X, Y, 8)   # 15 batches/epoch
+
+    ref_tr = FaultTolerantTrainer(_net(), tmp_path / "ref", save_every=10)
+    ref = _params(ref_tr.fit(it(), epochs=2))
+    ref_tr.close()
+
+    plan = FaultPlan(seed=7).fail_at(
+        faults.TRAIN_DISPATCH, 17,
+        exc=lambda s, n: FatalTrainingError(f"kill at {s}#{n}"))
+    t1 = FaultTolerantTrainer(_net(), tmp_path / "ckpt", save_every=10)
+    with plan:
+        with pytest.raises(FatalTrainingError):
+            t1.fit(it(), epochs=2)
+    t1.close()
+
+    # "restarted process": fresh model + trainer on the same directory;
+    # the kill rule is exhausted (max_fires=1) so the resumed run lives
+    t2 = FaultTolerantTrainer(_net(), tmp_path / "ckpt", save_every=10)
+    with plan:
+        m2 = t2.fit(it(), epochs=2)
+    assert t2.resumed_step == 10, "must resume from the step-10 checkpoint"
+    _assert_trees_equal(ref, _params(m2))
+    # counters match an uninterrupted run too (epoch is re-walked from 0
+    # on resume, not double-counted)
+    assert m2._epoch == 2
+    assert m2._iteration == ref_tr.model._iteration
+    t2.close()
+
+
+def test_transient_dispatch_faults_are_retried_exactly(tmp_path):
+    """Retried steps replay the same rng stream: a run with injected
+    transient dispatch faults ends bit-identical to a clean run."""
+    X, Y = _data(80)
+
+    def it():
+        return ArrayDataSetIterator(X, Y, 8)   # 10 batches
+
+    ref_tr = FaultTolerantTrainer(_net(), tmp_path / "ref", save_every=100)
+    ref = _params(ref_tr.fit(it(), epochs=1))
+    ref_tr.close()
+
+    pol = RetryPolicy(max_attempts=3, initial_backoff=0.0,
+                      sleep=lambda s: None)
+    t = FaultTolerantTrainer(_net(), tmp_path / "faulty", save_every=100,
+                             retry_policy=pol)
+    plan = FaultPlan(seed=1).every(faults.TRAIN_DISPATCH, 4, max_fires=2)
+    with plan:
+        m = t.fit(it(), epochs=1)
+    assert plan.fired[faults.TRAIN_DISPATCH] == 2
+    _assert_trees_equal(ref, _params(m))
+    t.close()
+
+
+def test_retry_stops_on_oom_classified_dispatch(tmp_path):
+    """Acceptance (b): an OOM-shaped dispatch failure must NOT be
+    retried — it propagates on attempt one."""
+    X, Y = _data(40)
+    t = FaultTolerantTrainer(_net(), tmp_path / "oom", save_every=100,
+                             retry_policy=RetryPolicy(
+                                 max_attempts=5, initial_backoff=0.0,
+                                 sleep=lambda s: None))
+    plan = FaultPlan().fail_at(
+        faults.TRAIN_DISPATCH, 2,
+        exc=lambda s, n: RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+    with plan:
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            t.fit(ArrayDataSetIterator(X, Y, 8), epochs=1)
+    assert plan.fired[faults.TRAIN_DISPATCH] == 1
+    assert plan.calls(faults.TRAIN_DISPATCH) == 2, \
+        "no re-attempt after the OOM"
+    t.close()
+
+
+def test_non_finite_batches_skipped_and_counted(tmp_path):
+    X, Y = _data(40, nan_at=10)          # batch 2 of 5 is corrupt
+    monitoring.enable()
+    monitoring.get_registry().clear()
+    t = FaultTolerantTrainer(_net(), tmp_path / "nan", save_every=100)
+    t.fit(ArrayDataSetIterator(X, Y, 8), epochs=1)
+    assert t.skipped == 1
+    c = monitoring.get_registry().get(
+        monitoring.RESILIENCE_BATCHES_SKIPPED,
+        labels={"reason": "non_finite"})
+    assert c is not None and c.value == 1
+    # the trained params are finite — the NaN batch never hit the step
+    for leaf in jax.tree_util.tree_leaves(t.model._params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    t.close()
+
+
+def test_data_fault_skips_one_real_batch(tmp_path):
+    """A data.next fault drops exactly one REAL batch: the iterator
+    still advances, `step` stays aligned with iterator position, and
+    the run completes with the remaining batches."""
+    X, Y = _data(40)                     # 5 batches of 8
+    t = FaultTolerantTrainer(_net(), tmp_path / "df", save_every=100)
+    plan = FaultPlan().fail_at(faults.DATA_NEXT, 2)
+    with plan:
+        t.fit(ArrayDataSetIterator(X, Y, 8), epochs=1)
+    assert t.skipped == 1
+    assert t.step == 5, "all 5 iterator positions consumed"
+    assert t.model._iteration == 4, "4 batches actually trained"
+    t.close()
+
+
+def test_max_skipped_batches_aborts(tmp_path):
+    X, Y = _data(40)
+    X[:] = np.nan
+    t = FaultTolerantTrainer(_net(), tmp_path / "allnan", save_every=100,
+                             max_skipped_batches=2)
+    with pytest.raises(FatalTrainingError, match="max_skipped_batches"):
+        t.fit(ArrayDataSetIterator(X, Y, 8), epochs=1)
+    t.close()
+
+
+def test_sharded_trainer_resume(tmp_path, devices8):
+    """Sharded (functional) mode: retry + periodic save + mesh-placed
+    restore round-trips through a fresh trainer."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn import Adam
+    from deeplearning4j_tpu.parallel import DeviceMesh, ShardedTrainer
+
+    mesh = DeviceMesh(devices8, dp=8).mesh
+    rng = np.random.default_rng(1)
+    params = {"W": rng.standard_normal((8, 2)).astype(np.float32) * 0.1}
+
+    def loss_fn(p, batch, rng_):
+        x, y = batch
+        logp = jax.nn.log_softmax(x @ p["W"], -1)
+        return -jnp.mean(jnp.sum(y * logp, -1))
+
+    def make():
+        return ShardedTrainer(loss_fn, Adam(0.05), mesh)
+
+    x = rng.standard_normal((16, 8)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    key = jax.random.PRNGKey(0)
+
+    ft = FaultTolerantTrainer(make(), tmp_path / "sh", save_every=5)
+    p, s = ft.resume_or_init_sharded(params)
+    batch = ft.model.shard_batch((jnp.asarray(x), jnp.asarray(y)))
+    for i in range(7):
+        p, s, loss = ft.fit_batch(p, s, batch,
+                                  jax.random.fold_in(key, ft.step))
+    ft.close()     # checkpoint at step 5 is on disk
+
+    ft2 = FaultTolerantTrainer(make(), tmp_path / "sh", save_every=5)
+    p2, s2 = ft2.resume_or_init_sharded(params)
+    assert ft2.step == 5 and ft2.resumed_step == 5
+    # restored params equal the live run's state at step 5: replay 2 more
+    for i in range(5, 7):
+        p2, s2, _ = ft2.fit_batch(p2, s2, batch, jax.random.fold_in(key, i))
+    _assert_trees_equal(jax.tree_util.tree_map(np.asarray, p),
+                        jax.tree_util.tree_map(np.asarray, p2))
+    ft2.close()
+
+
+# ===================== ElasticCheckpointer hardening ======================
+def test_checkpointer_close_idempotent_and_error_surfacing(tmp_path):
+    from deeplearning4j_tpu.parallel.elastic import ElasticCheckpointer
+    ck = ElasticCheckpointer(tmp_path / "ck")
+    ck.save(1, {"w": np.ones((2,), np.float32)}, wait=True)
+
+    boom = [RuntimeError("async save failed on the background thread")]
+
+    def failing_check():
+        if boom:
+            raise boom.pop()
+
+    ck.manager.check_for_errors = failing_check
+    # the DEFERRED error surfaces on the next save, not silently dropped
+    with pytest.raises(RuntimeError, match="async save failed"):
+        ck.save(2, {"w": np.ones((2,), np.float32)})
+    ck.close()
+    ck.close()       # idempotent: second close is a no-op, no raise
+
+
+def test_xla_owned_copy_never_aliases_host_memory():
+    """Regression: jnp.asarray zero-copy aliases aligned numpy buffers
+    on the CPU backend, and a donating train step then frees memory
+    numpy owns (heap corruption ~40% of resume runs before the fix).
+    xla_owned_copy must always produce an owned, bit-exact copy."""
+    from deeplearning4j_tpu.parallel.elastic import xla_owned_copy
+    rng = np.random.default_rng(0)
+    for arr in (rng.standard_normal((64, 64)).astype(np.float32),
+                np.array([1, 2], np.uint32),          # rng key shape
+                np.asarray(7, np.int32),              # 0-d scalar
+                np.zeros((0, 4), np.float32)):        # empty
+        owned = xla_owned_copy(arr)
+        assert owned.dtype == arr.dtype and owned.shape == arr.shape
+        back = np.asarray(owned)
+        assert not np.shares_memory(back, arr)
+        np.testing.assert_array_equal(back, arr)
+
+
+# ===================== ParallelInference degradation ======================
+def _stall(net):
+    """Make net.output block until the returned event is set."""
+    gate = threading.Event()
+    real = net.output
+
+    def slow(x):
+        gate.wait(10)
+        return real(x)
+
+    net.output = slow
+    return gate, real
+
+
+def test_inference_overload_sheds_with_typed_error():
+    """Acceptance (d): full queue -> InferenceOverloadedError within the
+    bounded wait; the queue drains clean afterwards."""
+    net = _net()
+    x = np.zeros((2, 5), np.float32)
+    pi = (ParallelInference.Builder(net)
+          .inferenceMode(InferenceMode.BATCHED)
+          .batchLimit(1).queueLimit(1).enqueueTimeoutMs(30).build())
+    gate, real = _stall(net)
+    try:
+        t1 = threading.Thread(target=lambda: pi.output(x))  # in collector
+        t1.start()
+        time.sleep(0.15)
+        t2 = threading.Thread(target=lambda: pi.output(x))  # fills queue
+        t2.start()
+        time.sleep(0.15)
+        t0 = time.monotonic()
+        with pytest.raises(InferenceOverloadedError):
+            pi.output(x)
+        assert time.monotonic() - t0 < 2.0, "shed must be prompt"
+    finally:
+        gate.set()
+        net.output = real
+    t1.join(10)
+    t2.join(10)
+    pi.shutdown()
+    assert pi._queue.qsize() == 0, "queue drains clean"
+    # still serves (direct) after shutdown
+    assert pi.output(x).shape == (2, 3)
+
+
+def test_inference_timeout_typed_and_late_result_discarded():
+    net = _net()
+    x = np.zeros((2, 5), np.float32)
+    pi = (ParallelInference.Builder(net)
+          .inferenceMode(InferenceMode.BATCHED)
+          .batchLimit(4).queueLimit(16).build())
+    gate, real = _stall(net)
+    try:
+        t1 = threading.Thread(target=lambda: pi.output(x))  # stalls collector
+        t1.start()
+        time.sleep(0.15)
+        t0 = time.monotonic()
+        with pytest.raises(InferenceTimeoutError):
+            pi.output(x, timeout_ms=100)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 2.0, f"deadline not honoured ({elapsed:.2f}s)"
+    finally:
+        gate.set()
+        net.output = real
+    t1.join(10)
+    pi.shutdown()
+    assert pi._queue.qsize() == 0, "cancelled request discarded on drain"
+
+
+def test_inference_shutdown_idempotent_and_dead_collector_never_blocks():
+    net = _net()
+    x = np.zeros((2, 5), np.float32)
+    # collector dies on its FIRST loop pass; breaker allows one restart,
+    # which also dies; then it is OPEN -> direct-serve degradation
+    plan = FaultPlan().every(faults.INFERENCE_COLLECTOR, 1, max_fires=50)
+    with plan:
+        pi = ParallelInference(
+            net, batch_limit=4, queue_limit=4,
+            breaker=CircuitBreaker(failure_threshold=1, cooldown=60.0,
+                                   name="test.collector"))
+        time.sleep(0.1)
+        out = pi.output(x)          # must not block despite dead collector
+        assert out.shape == (2, 3)
+        assert isinstance(pi.collector_error, InjectedFault)
+        pi.shutdown()
+        pi.shutdown()               # idempotent
+    out = pi.output(x)              # post-shutdown: direct serve
+    assert out.shape == (2, 3)
+
+
+def test_inference_collector_restarts_behind_breaker():
+    net = _net()
+    x = np.zeros((2, 5), np.float32)
+    plan = FaultPlan().fail_at(faults.INFERENCE_COLLECTOR, 2)  # dies once
+    with plan:
+        pi = ParallelInference(net, batch_limit=4, queue_limit=8)
+        deadline = time.monotonic() + 10
+        while pi._thread.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.02)        # wait for the scheduled death
+        assert not pi._thread.is_alive()
+        out = pi.output(x)          # revives the collector and serves
+        assert out.shape == (2, 3)
+        assert pi.collector_restarts == 1
+        assert pi._breaker.state == CircuitBreaker.CLOSED
+        pi.shutdown()
+
+
+def test_resilience_metrics_observable():
+    """Acceptance: resilience events land on dl4j.resilience.* and the
+    registry exports them; disabled monitoring stays zero-cost (no
+    metric objects created)."""
+    monitoring.enable()
+    monitoring.get_registry().clear()
+    pol = RetryPolicy(max_attempts=2, initial_backoff=0.0,
+                      sleep=lambda s: None)
+    with pytest.raises(RetryExhaustedError):
+        pol.call(lambda: (_ for _ in ()).throw(TransientError("x")))
+    br = CircuitBreaker(failure_threshold=1, cooldown=1.0, name="m")
+    br.record_failure()
+    reg = monitoring.get_registry()
+    assert reg.get(monitoring.RESILIENCE_RETRIES).value >= 1
+    assert reg.get(monitoring.RESILIENCE_BREAKER_TRIPS,
+                   labels={"breaker": "m"}).value == 1
+    text = reg.prometheus_text()
+    assert "dl4j_resilience_retries" in text
+    monitoring.disable()
+    reg.clear()
+    pol2 = RetryPolicy(max_attempts=2, initial_backoff=0.0,
+                       sleep=lambda s: None)
+    with pytest.raises(RetryExhaustedError):
+        pol2.call(lambda: (_ for _ in ()).throw(TransientError("x")))
+    assert reg.get(monitoring.RESILIENCE_RETRIES) is None, \
+        "disabled monitoring must not allocate metrics"
+
+
+def test_crash_dump_embeds_monitoring_snapshot(tmp_path):
+    from deeplearning4j_tpu.util.crash_reporting import CrashReportingUtil
+    monitoring.enable()
+    monitoring.get_registry().clear()
+    monitoring.get_registry().counter(
+        monitoring.RESILIENCE_RETRIES, help="x").inc(3)
+    net = _net()
+    exc = RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+    path = CrashReportingUtil.writeMemoryCrashDump(
+        net, exc, path=str(tmp_path / "dump.txt"))
+    text = open(path).read()
+    assert "Monitoring at crash time" in text
+    assert "dl4j.resilience.retries" in text
+    assert "open spans" in text
+    monitoring.disable()
+
+
+# ===================== slow soak ==========================================
+@pytest.mark.slow
+def test_soak_random_faults_training_always_completes(tmp_path):
+    """Soak: probabilistic faults at every site; across restarts the run
+    always completes and matches the clean run bit-for-bit."""
+    X, Y = _data(160)
+
+    def it():
+        return ArrayDataSetIterator(X, Y, 8)   # 20 batches/epoch
+
+    ref_tr = FaultTolerantTrainer(_net(), tmp_path / "ref", save_every=7)
+    ref = _params(ref_tr.fit(it(), epochs=3))
+    ref_tr.close()
+
+    plan = (FaultPlan(seed=11)
+            .probability(faults.TRAIN_DISPATCH, 0.08, max_fires=12)
+            .probability(faults.CHECKPOINT_SAVE, 0.05, max_fires=3))
+    pol = RetryPolicy(max_attempts=2, initial_backoff=0.0,
+                      sleep=lambda s: None)
+    final = None
+    with plan:
+        for restart in range(40):
+            t = FaultTolerantTrainer(_net(), tmp_path / "soak",
+                                     save_every=7, retry_policy=pol)
+            try:
+                final = _params(t.fit(it(), epochs=3))
+                t.close()
+                break
+            except Exception:   # noqa: BLE001 — simulated process death
+                t.close()
+        else:
+            pytest.fail("soak never completed in 40 restarts")
+    assert final is not None
+    _assert_trees_equal(ref, final)
