@@ -16,6 +16,7 @@ import struct
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.runtime.pipeline import PrefetchIterator
 
 
 class DataSetIterator:
@@ -418,75 +419,20 @@ class SyntheticImageNetIterator(DataSetIterator):
         return self._maybe_preprocess(DataSet(x, y))
 
 
-class AsyncDataSetIterator(DataSetIterator):
+class AsyncDataSetIterator(PrefetchIterator):
     """≡ AsyncDataSetIterator — background-thread prefetch so host batch
     prep overlaps device compute (the reference uses a workspace-backed
-    prefetch thread; same shape here)."""
+    prefetch thread; same shape here).
 
-    _EMPTY = object()   # distinct "nothing peeked" sentinel (None = EOS)
+    Built on runtime/pipeline.PrefetchIterator, which fixes two failure
+    modes of the original hand-rolled worker: a raising `base.next()` is
+    re-raised in the consumer with its original traceback instead of
+    masquerading as clean end-of-stream (silently truncating the epoch),
+    and `hasNext` polls with a timeout + worker-liveness check so a dead
+    worker thread surfaces as an error instead of deadlocking forever."""
 
     def __init__(self, base, queue_size=4):
-        super().__init__(base.batch())
-        import queue as _q
-        import threading
-        self._base = base
-        self._qsize = queue_size
-        self._queue = _q.Queue(maxsize=queue_size)
-        self._thread = None
-        self._stop = threading.Event()
-        self._peek = self._EMPTY
-
-    def _worker(self):
-        try:
-            while self._base.hasNext() and not self._stop.is_set():
-                self._queue.put(self._base.next())
-        finally:
-            self._queue.put(None)
-
-    def _ensure_thread(self):
-        import threading
-        if self._thread is None:
-            self._thread = threading.Thread(target=self._worker, daemon=True)
-            self._thread.start()
-
-    def reset(self):
-        self._stop.set()
-        if self._thread is not None:
-            while self._thread.is_alive():
-                try:
-                    self._queue.get_nowait()
-                except Exception:
-                    pass
-            self._thread.join(timeout=5)
-        self._stop.clear()
-        self._thread = None
-        self._peek = self._EMPTY   # drop any batch prefetched pre-reset
-        import queue as _q
-        self._queue = _q.Queue(maxsize=self._qsize)
-        self._base.reset()
-
-    def hasNext(self):
-        if self._peek is None:      # already saw end-of-stream
-            return False
-        self._ensure_thread()
-        if self._peek is self._EMPTY:
-            self._peek = self._queue.get()
-        return self._peek is not None
-
-    def next(self, num=None):
-        if not self.hasNext():
-            raise StopIteration("DataSetIterator exhausted; call reset()")
-        item, self._peek = self._peek, self._EMPTY
-        return item
-
-    def numExamples(self):
-        return self._base.numExamples()
-
-    def totalOutcomes(self):
-        return self._base.totalOutcomes()
-
-    def inputColumns(self):
-        return self._base.inputColumns()
+        super().__init__(base, depth=queue_size)
 
 
 class ListDataSetIterator(DataSetIterator):

@@ -43,6 +43,8 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     RESILIENCE_RESUMES, RESILIENCE_RESUME_STEP,
     RESILIENCE_INFERENCE_SHED, RESILIENCE_INFERENCE_TIMEOUTS,
     RESILIENCE_COLLECTOR_RESTARTS,
+    PIPELINE_SYNCS, PIPELINE_HOST_BLOCKED_MS, PIPELINE_PREFETCH_DEPTH,
+    PIPELINE_STAGED_BATCHES,
     bootstrap_core_metrics, collect_device_memory, get_registry,
     record_transfer)
 from deeplearning4j_tpu.monitoring.tracing import (  # noqa: F401
@@ -63,6 +65,8 @@ __all__ = [
     "RESILIENCE_RESUMES", "RESILIENCE_RESUME_STEP",
     "RESILIENCE_INFERENCE_SHED", "RESILIENCE_INFERENCE_TIMEOUTS",
     "RESILIENCE_COLLECTOR_RESTARTS",
+    "PIPELINE_SYNCS", "PIPELINE_HOST_BLOCKED_MS", "PIPELINE_PREFETCH_DEPTH",
+    "PIPELINE_STAGED_BATCHES",
 ]
 
 

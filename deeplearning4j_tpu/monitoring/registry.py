@@ -51,6 +51,17 @@ RESILIENCE_INFERENCE_SHED = "dl4j.resilience.inference_shed"
 RESILIENCE_INFERENCE_TIMEOUTS = "dl4j.resilience.inference_timeouts"
 RESILIENCE_COLLECTOR_RESTARTS = "dl4j.resilience.collector_restarts"
 
+# host pipeline (runtime/pipeline.py): is the host running ahead of the
+# device, or blocking on it? `syncs` counts every host-blocking
+# materialization (a listener-free fit should record ZERO per-step syncs),
+# `host_blocked_ms` is how long each one stalled the host, and
+# `prefetch_depth` samples the staging queue occupancy (0 = the device is
+# waiting on the loader; full = the loader is comfortably ahead)
+PIPELINE_SYNCS = "dl4j.pipeline.syncs"
+PIPELINE_HOST_BLOCKED_MS = "dl4j.pipeline.host_blocked_ms"
+PIPELINE_PREFETCH_DEPTH = "dl4j.pipeline.prefetch_depth"
+PIPELINE_STAGED_BATCHES = "dl4j.pipeline.staged_batches"
+
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _LABEL_RE = re.compile(r"[^a-zA-Z0-9_]")
 

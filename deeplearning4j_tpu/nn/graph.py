@@ -16,6 +16,7 @@ import optax
 
 from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.runtime import pipeline as _pipeline
 from deeplearning4j_tpu.util.crash_reporting import \
     with_crash_dump
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
@@ -359,7 +360,9 @@ class ComputationGraph:
 
     def score(self, dataset=None):
         if dataset is None:
-            return self._score
+            # lazy score: _score may hold the device loss scalar; this
+            # is the on-demand sync point (dl4j.pipeline.syncs)
+            return _pipeline.materialize_score(self)
         ins, labels, fmasks, lmasks = self._unpack(dataset)
         # inference-mode forward (≡ reference score(DataSet) semantics)
         loss, _ = self._loss(self._params, self._state, ins, labels, fmasks,
@@ -403,7 +406,7 @@ class ComputationGraph:
         return ins, labels, fmasks, lmasks
 
     def _unpack(self, ds):
-        if isinstance(ds, MultiDataSet):
+        if isinstance(ds, (MultiDataSet, _pipeline.StagedMultiBatch)):
             ins = {n: jnp.asarray(f) for n, f in
                    zip(self.conf.input_names, ds.features)}
             labels = [jnp.asarray(l) for l in ds.labels]
@@ -416,7 +419,7 @@ class ComputationGraph:
                 lmasks = [None if m is None else jnp.asarray(m)
                           for m in ds.labelsMasks]
             return ins, labels, fmasks, lmasks
-        if isinstance(ds, DataSet):
+        if isinstance(ds, (DataSet, _pipeline.StagedBatch)):
             return self._pack_single(
                 jnp.asarray(ds.features), jnp.asarray(ds.labels),
                 None if ds.featuresMask is None
@@ -438,7 +441,7 @@ class ComputationGraph:
                 self._train_step(
                     self._params, self._opt_state, self._state, ins,
                     labels, fmasks, lmasks, sub)
-            self._score = float(loss)
+            self._score = loss    # device scalar; score() floats it
         self._iteration += 1
         self._last_features = ins     # for StatsListener histograms
         self._params_version = getattr(self, "_params_version", 0) + 1
@@ -497,12 +500,18 @@ class ComputationGraph:
         self._last_features = jax.tree_util.tree_map(lambda a: a[-1], ins)
         self._params_version = getattr(self, "_params_version", 0) + 1
         with _mon.span("train.listeners"):
-            for loss in jax.device_get(losses):
-                self._score = float(loss)
-                self._iteration += 1
-                for listener in self._listeners:
-                    listener.iterationDone(self, self._iteration,
-                                           self._epoch)
+            if self._listeners:
+                # device slices, not device_get: score() syncs only for
+                # listeners that actually read it
+                for i in range(len(unpacked)):
+                    self._score = losses[i]
+                    self._iteration += 1
+                    for listener in self._listeners:
+                        listener.iterationDone(self, self._iteration,
+                                               self._epoch)
+            else:
+                self._score = losses[len(unpacked) - 1]
+                self._iteration += len(unpacked)
 
     @staticmethod
     def _batch_sig(unpacked_or_ds):
@@ -510,11 +519,17 @@ class ComputationGraph:
         return (str(treedef), tuple(jnp.shape(x) for x in leaves))
 
     @with_crash_dump
-    def fit(self, data, labels=None, epochs=None, stepsPerDispatch=1):
+    def fit(self, data, labels=None, epochs=None, stepsPerDispatch=1,
+            prefetch=None):
         """stepsPerDispatch > 1 (iterator form): group consecutive
         same-structure batches into one scanned dispatch — numerically
         identical to the sequential loop (tested); ragged/odd batches
-        flush the group early and run singly."""
+        flush the group early and run singly.
+
+        prefetch: staging queue depth for the background device-staging
+        prefetcher (async-supporting iterators; default
+        runtime.pipeline.DEFAULT_PREFETCH, 0 disables) — batch N+1 is
+        staged to XLA-owned device buffers while step N computes."""
         if self._params is None:
             self.init()
         if labels is not None:
@@ -535,62 +550,81 @@ class ComputationGraph:
                 for unpacked in group:
                     self._fit_unpacked(unpacked)
 
-        for _ in range(n_epochs):
-            with _mon.span("fit.epoch"):
-                if hasattr(data, "reset"):
-                    data.reset()
-                group, group_sig = [], None
-                for ds in _mon.traced_iter(data):
-                    if _faults.ACTIVE is not None:
-                        _faults.ACTIVE.fire(_faults.DATA_NEXT)
-                    if k == 1:
-                        self._fit_batch(ds)
-                        continue
-                    unpacked = self._unpack(ds)
-                    sig = self._batch_sig(unpacked)
-                    if group and (sig != group_sig or len(group) >= k):
+        it, _pf = _pipeline.maybe_prefetch(data, prefetch)
+        try:
+            for _ in range(n_epochs):
+                with _mon.span("fit.epoch"):
+                    if hasattr(it, "reset"):
+                        it.reset()
+                    group, group_sig = [], None
+                    for ds in _mon.traced_iter(it):
+                        if _faults.ACTIVE is not None:
+                            _faults.ACTIVE.fire(_faults.DATA_NEXT)
+                        if k == 1:
+                            self._fit_batch(ds)
+                            continue
+                        unpacked = self._unpack(ds)
+                        sig = self._batch_sig(unpacked)
+                        if group and (sig != group_sig or len(group) >= k):
+                            flush(group)
+                            group = []
+                        group_sig = sig
+                        group.append(unpacked)
+                    if group:
                         flush(group)
-                        group = []
-                    group_sig = sig
-                    group.append(unpacked)
-                if group:
-                    flush(group)
-                self._epoch += 1
-                with _mon.span("fit.epoch_listeners"):
-                    for listener in self._listeners:
-                        if hasattr(listener, "onEpochEnd"):
-                            listener.onEpochEnd(self)
+                    self._epoch += 1
+                    with _mon.span("fit.epoch_listeners"):
+                        for listener in self._listeners:
+                            if hasattr(listener, "onEpochEnd"):
+                                listener.onEpochEnd(self)
+        finally:
+            if _pf is not None:
+                _pf.close()
         return self
 
     # -- evaluation ------------------------------------------------------
-    def _eval_loop(self, iterator, evaluator):
-        if hasattr(iterator, "reset"):
-            iterator.reset()
-        for ds in _mon.traced_iter(iterator, "eval.data_next"):
-            with _mon.span("eval.batch"):
-                out = self.output(ds.features)
-                out0 = out[0] if isinstance(out, list) else out
-                evaluator.eval(ds.labels, out0.numpy(), mask=ds.labelsMask)
+    def _eval_loop(self, iterator, evaluator, prefetch=None):
+        # overlap host batch prep with the device forward pass: features
+        # stage to device in the background, labels stay host-side;
+        # prefetch=0 forces fully synchronous eval (mirrors fit())
+        it, _pf = _pipeline.maybe_prefetch(
+            iterator, prefetch, stage=_pipeline.stage_for_eval)
+        try:
+            if hasattr(it, "reset"):
+                it.reset()
+            for ds in _mon.traced_iter(it, "eval.data_next"):
+                with _mon.span("eval.batch"):
+                    out = self.output(ds.features)
+                    out0 = out[0] if isinstance(out, list) else out
+                    evaluator.eval(ds.labels, out0.numpy(),
+                                   mask=ds.labelsMask)
+        finally:
+            if _pf is not None:
+                _pf.close()
         return evaluator
 
-    def evaluate(self, iterator):
+    def evaluate(self, iterator, prefetch=None):
         from deeplearning4j_tpu.eval.evaluation import Evaluation
-        return self._eval_loop(iterator, Evaluation())
+        return self._eval_loop(iterator, Evaluation(), prefetch=prefetch)
 
-    def evaluateROC(self, iterator, threshold_steps=0):
+    def evaluateROC(self, iterator, threshold_steps=0, prefetch=None):
         from deeplearning4j_tpu.eval.evaluation import ROC
-        return self._eval_loop(iterator, ROC(threshold_steps))
+        return self._eval_loop(iterator, ROC(threshold_steps),
+                               prefetch=prefetch)
 
-    def evaluateROCMultiClass(self, iterator, threshold_steps=0):
+    def evaluateROCMultiClass(self, iterator, threshold_steps=0,
+                              prefetch=None):
         from deeplearning4j_tpu.eval.evaluation import ROCMultiClass
-        return self._eval_loop(iterator, ROCMultiClass(threshold_steps))
+        return self._eval_loop(iterator, ROCMultiClass(threshold_steps),
+                               prefetch=prefetch)
 
     def evaluateCalibration(self, iterator, reliabilityDiagNumBins=10,
-                            histogramNumBins=10):
+                            histogramNumBins=10, prefetch=None):
         from deeplearning4j_tpu.eval.evaluation import EvaluationCalibration
         return self._eval_loop(
             iterator, EvaluationCalibration(reliabilityDiagNumBins,
-                                            histogramNumBins))
+                                            histogramNumBins),
+            prefetch=prefetch)
 
     # -- listeners / misc ------------------------------------------------
     def setListeners(self, *listeners):

@@ -19,6 +19,7 @@ import optax
 
 from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.runtime import pipeline as _pipeline
 from deeplearning4j_tpu.util.crash_reporting import \
     with_crash_dump
 from deeplearning4j_tpu.datasets.dataset import DataSet
@@ -372,7 +373,10 @@ class MultiLayerNetwork:
             loss, _ = self._loss(self._params, self._state, x, y, fmask,
                                  lmask, None, train=False)
             return float(loss)
-        return self._score
+        # lazy score: fit() leaves the DEVICE loss scalar in _score so a
+        # listener-free loop never blocks; reading it here is the
+        # on-demand sync point (counted via dl4j.pipeline.syncs)
+        return _pipeline.materialize_score(self)
 
     def computeGradients(self, x, y, fmask=None, lmask=None):
         """Gradients of the full regularized loss — used by gradient-check
@@ -468,12 +472,19 @@ class MultiLayerNetwork:
         self._last_features = group[-1][0]
         self._params_version = getattr(self, "_params_version", 0) + 1
         with _mon.span("train.listeners"):
-            for loss in jax.device_get(losses):
-                self._score = float(loss)
-                self._iteration += 1
-                for listener in self._listeners:
-                    listener.iterationDone(self, self._iteration,
-                                           self._epoch)
+            if self._listeners:
+                # device slices, not device_get: listeners that never
+                # read score() cost zero syncs; ones that do pay only
+                # for the iterations they actually look at
+                for i in range(len(group)):
+                    self._score = losses[i]
+                    self._iteration += 1
+                    for listener in self._listeners:
+                        listener.iterationDone(self, self._iteration,
+                                               self._epoch)
+            else:
+                self._score = losses[len(group) - 1]
+                self._iteration += len(group)
 
     @staticmethod
     def _batch_sig(ds):
@@ -527,8 +538,8 @@ class MultiLayerNetwork:
                 and x.ndim == 3 and x.shape[1] > self.conf.tbptt_fwd_length):
             tlen = int(self.conf.tbptt_fwd_length)
             carries = self._zero_carries(x.shape[0])
-            total = 0.0
-            nseg = 0
+            total = None    # loss accumulates ON DEVICE: the old
+            nseg = 0        # per-segment float() blocked every segment
             with _mon.span("train.dispatch"):
                 for t0 in range(0, x.shape[1], tlen):
                     xs = x[:, t0:t0 + tlen]
@@ -539,16 +550,16 @@ class MultiLayerNetwork:
                      loss) = self._train_step_tbptt(
                         self._params, self._opt_state, self._state, carries,
                         xs, ys, fs, ls, jax.random.fold_in(sub, t0))
-                    total += float(loss)
+                    total = loss if total is None else total + loss
                     nseg += 1
-            self._score = total / max(1, nseg)
+            self._score = None if total is None else total / nseg
         else:
             with _mon.span("train.dispatch"):
                 self._params, self._opt_state, self._state, loss = \
                     self._train_step(
                         self._params, self._opt_state, self._state, x, y,
                         fmask, lmask, sub)
-                self._score = float(loss)
+                self._score = loss    # device scalar; score() floats it
         self._iteration += 1
         # most recent training batch, for listeners that inspect
         # activations (StatsListener histograms — ≡ the reference
@@ -603,7 +614,7 @@ class MultiLayerNetwork:
                     feats = pp.preProcess(feats)
                 self._rng_key, sub = jax.random.split(self._rng_key)
                 p, opt_state, loss = step(p, opt_state, feats, sub)
-                self._score = float(loss)
+                self._score = loss    # lazy; score() floats on demand
         self._params[key] = p
         self._build_optimizer()  # opt state shapes unchanged but refresh
         return self
@@ -616,13 +627,23 @@ class MultiLayerNetwork:
         return self
 
     @with_crash_dump
-    def fit(self, data, labels=None, epochs=None, stepsPerDispatch=1):
+    def fit(self, data, labels=None, epochs=None, stepsPerDispatch=1,
+            prefetch=None):
         """stepsPerDispatch > 1 (iterator form only): group consecutive
         same-shape batches and run each group as ONE lax.scan dispatch —
         numerically identical to the sequential loop (tested), but pays
         the host→device round-trip once per group instead of per batch.
         Groups flush early on a shape change, so ragged tails stay exact.
-        TBPTT configs ignore it (the segment loop owns the dispatch)."""
+        TBPTT configs ignore it (the segment loop owns the dispatch).
+
+        prefetch (iterator form, async-supporting iterators): staging
+        queue depth for the background device-staging prefetcher — batch
+        N+1 is pulled, preprocessed, and copied into XLA-owned device
+        buffers while step N computes. Default
+        runtime.pipeline.DEFAULT_PREFETCH (2); 0 disables. Combined with
+        the lazy score (no per-step float(loss)) a listener-free fit
+        performs ZERO host-blocking syncs — see README 'Host pipeline &
+        async dispatch'."""
         if self._params is None:
             self.init()
         if labels is not None:  # fit(features, labels)
@@ -648,75 +669,91 @@ class MultiLayerNetwork:
                 for f, l, lm, fm in group:
                     self._fit_batch(f, l, lm, fm)
 
-        for _ in range(n_epochs):
-            with _mon.span("fit.epoch"):
-                if hasattr(data, "reset"):
-                    data.reset()
-                group, group_sig = [], None
-                for ds in _mon.traced_iter(data):
-                    if _faults.ACTIVE is not None:
-                        _faults.ACTIVE.fire(_faults.DATA_NEXT)
-                    if k == 1:
-                        self._fit_batch(ds.features, ds.labels,
-                                        ds.labelsMask, ds.featuresMask)
-                        continue
-                    sig = self._batch_sig(ds)
-                    if group and (sig != group_sig or len(group) >= k):
+        it, _pf = _pipeline.maybe_prefetch(data, prefetch)
+        try:
+            for _ in range(n_epochs):
+                with _mon.span("fit.epoch"):
+                    if hasattr(it, "reset"):
+                        it.reset()
+                    group, group_sig = [], None
+                    for ds in _mon.traced_iter(it):
+                        if _faults.ACTIVE is not None:
+                            _faults.ACTIVE.fire(_faults.DATA_NEXT)
+                        if k == 1:
+                            self._fit_batch(ds.features, ds.labels,
+                                            ds.labelsMask, ds.featuresMask)
+                            continue
+                        sig = self._batch_sig(ds)
+                        if group and (sig != group_sig or len(group) >= k):
+                            flush(group)
+                            group = []
+                        group_sig = sig
+                        group.append((ds.features, ds.labels,
+                                      ds.labelsMask, ds.featuresMask))
+                    if group:
                         flush(group)
-                        group = []
-                    group_sig = sig
-                    group.append((ds.features, ds.labels, ds.labelsMask,
-                                  ds.featuresMask))
-                if group:
-                    flush(group)
-                self._epoch += 1
-                with _mon.span("fit.epoch_listeners"):
-                    for listener in self._listeners:
-                        if hasattr(listener, "onEpochEnd"):
-                            listener.onEpochEnd(self)
+                    self._epoch += 1
+                    with _mon.span("fit.epoch_listeners"):
+                        for listener in self._listeners:
+                            if hasattr(listener, "onEpochEnd"):
+                                listener.onEpochEnd(self)
+        finally:
+            if _pf is not None:
+                _pf.close()
         return self
 
     # -- evaluation -------------------------------------------------------
-    def evaluate(self, iterator):
+    def evaluate(self, iterator, prefetch=None):
         from deeplearning4j_tpu.eval.evaluation import Evaluation
         e = Evaluation()
-        self._eval_loop(iterator, e)
+        self._eval_loop(iterator, e, prefetch=prefetch)
         return e
 
-    def evaluateROC(self, iterator, threshold_steps=0):
+    def evaluateROC(self, iterator, threshold_steps=0, prefetch=None):
         from deeplearning4j_tpu.eval.evaluation import ROC
         roc = ROC(threshold_steps)
-        self._eval_loop(iterator, roc)
+        self._eval_loop(iterator, roc, prefetch=prefetch)
         return roc
 
-    def evaluateRegression(self, iterator):
+    def evaluateRegression(self, iterator, prefetch=None):
         from deeplearning4j_tpu.eval.evaluation import RegressionEvaluation
         e = RegressionEvaluation()
-        self._eval_loop(iterator, e)
+        self._eval_loop(iterator, e, prefetch=prefetch)
         return e
 
-    def evaluateROCMultiClass(self, iterator, threshold_steps=0):
+    def evaluateROCMultiClass(self, iterator, threshold_steps=0,
+                              prefetch=None):
         from deeplearning4j_tpu.eval.evaluation import ROCMultiClass
         roc = ROCMultiClass(threshold_steps)
-        self._eval_loop(iterator, roc)
+        self._eval_loop(iterator, roc, prefetch=prefetch)
         return roc
 
     def evaluateCalibration(self, iterator, reliabilityDiagNumBins=10,
-                            histogramNumBins=10):
+                            histogramNumBins=10, prefetch=None):
         """≡ MultiLayerNetwork.evaluateCalibration → EvaluationCalibration."""
         from deeplearning4j_tpu.eval.evaluation import EvaluationCalibration
         e = EvaluationCalibration(reliabilityDiagNumBins, histogramNumBins)
-        self._eval_loop(iterator, e)
+        self._eval_loop(iterator, e, prefetch=prefetch)
         return e
 
-    def _eval_loop(self, iterator, evaluator):
-        if hasattr(iterator, "reset"):
-            iterator.reset()
-        for ds in _mon.traced_iter(iterator, "eval.data_next"):
-            with _mon.span("eval.batch"):
-                out = self.output(ds.features, fmask=ds.featuresMask)
-                evaluator.eval(ds.labels, out.numpy(),
-                               mask=ds.labelsMask)
+    def _eval_loop(self, iterator, evaluator, prefetch=None):
+        # eval overlaps too: a background stage pulls + device-stages
+        # batch N+1's features while batch N's forward pass runs
+        # (labels stay host-side — the evaluator reads them there);
+        # prefetch=0 forces fully synchronous eval (mirrors fit())
+        it, _pf = _pipeline.maybe_prefetch(
+            iterator, prefetch, stage=_pipeline.stage_for_eval)
+        try:
+            if hasattr(it, "reset"):
+                it.reset()
+            for ds in _mon.traced_iter(it, "eval.data_next"):
+                with _mon.span("eval.batch"):
+                    out = self.output(ds.features, fmask=ds.featuresMask)
+                    evaluator.eval(ds.labels, out.numpy(),
+                                   mask=ds.labelsMask)
+        finally:
+            if _pf is not None:
+                _pf.close()
 
     # -- listeners --------------------------------------------------------
     def setListeners(self, *listeners):

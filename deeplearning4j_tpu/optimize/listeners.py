@@ -247,12 +247,18 @@ class MetricsListener(TrainingListener):
     """
 
     def __init__(self, registry=None, deviceMemoryFrequency=50,
-                 tracePath=None):
+                 tracePath=None, scoreFrequency=1):
         _mon.enable()
         self.registry = registry if registry is not None \
             else _mon.get_registry()
         _mon.bootstrap_core_metrics(self.registry)
         self.deviceMemoryFrequency = max(1, int(deviceMemoryFrequency))
+        #: reading score() materializes the device loss — a host-blocking
+        #: sync (counted on dl4j.pipeline.syncs). scoreFrequency=N reads
+        #: it every N iterations so metrics collection doesn't serialize
+        #: the async pipeline it is observing (≡ ScoreIterationListener's
+        #: printIterations cadence)
+        self.scoreFrequency = max(1, int(scoreFrequency))
         self.trace_path = None if tracePath is None else str(tracePath)
         self._last_time = None
         self._params_version_seen = None
@@ -262,10 +268,12 @@ class MetricsListener(TrainingListener):
         now = time.perf_counter()
         reg.counter("dl4j.train.iterations",
                     help="training iterations observed").inc()
-        score = model.score()
-        if score is not None:
-            reg.gauge("dl4j.train.score",
-                      help="most recent training loss").set(float(score))
+        if iteration % self.scoreFrequency == 0:
+            score = model.score()
+            if score is not None:
+                reg.gauge("dl4j.train.score",
+                          help="most recent training loss") \
+                   .set(float(score))
         # scanned fit (stepsPerDispatch=k) fires k iterationDone calls
         # microseconds apart after ONE dispatch; time dispatch-to-dispatch
         # via _params_version (same dedup contract as StatsListener) so

@@ -159,7 +159,7 @@ class ElasticCheckpointer:
                 else np.asarray(got, dtype=dt)
             sh = getattr(want, "sharding", None)
             if isinstance(sh, NamedSharding):
-                grafted.append(jax.device_put(xla_owned_copy(host), sh))
+                grafted.append(xla_owned_copy(host, sh))
             else:
                 grafted.append(host)
         return step, jax.tree_util.tree_unflatten(treedef, grafted)
@@ -178,24 +178,10 @@ class ElasticCheckpointer:
             self.manager.close()
 
 
-def xla_owned_copy(host):
-    """A jax array GUARANTEED to own its buffer (bit-exact copy of
-    `host`). On this jax CPU backend `jnp.asarray(numpy)` zero-copy
-    aliases any suitably-aligned numpy buffer (measured 20/20 on fresh
-    allocations); when a donating jitted step later consumes such an
-    array, XLA frees/reuses memory numpy owns — heap corruption that
-    surfaces as free(): corrupted chunks, NaN params, or segfaults a
-    step or two after resume. Staging through a deliberately MISALIGNED
-    view makes the zero-copy eligibility check fail, forcing a real
-    copy into XLA-allocated memory (verified 0/20 aliased)."""
-    import jax.numpy as jnp
-    host = np.asarray(host)
-    if host.nbytes == 0:
-        return jnp.asarray(host)
-    raw = np.empty(host.nbytes + 1, np.uint8)
-    view = raw[1:1 + host.nbytes].view(host.dtype).reshape(host.shape)
-    view[...] = host
-    return jnp.asarray(view)
+# canonical implementation moved to runtime/pipeline.py (the host
+# pipeline stages EVERY batch through it, not just checkpoint restores);
+# re-exported here so existing call/import sites keep working
+from deeplearning4j_tpu.runtime.pipeline import xla_owned_copy  # noqa: E402,F401
 
 
 def replace_on_mesh(mesh, like, state):
@@ -214,7 +200,7 @@ def replace_on_mesh(mesh, like, state):
         if not isinstance(restored, np.ndarray) \
                 and getattr(restored, "sharding", None) == sh:
             return restored     # restore() already placed it (owned)
-        return jax.device_put(xla_owned_copy(restored), sh)
+        return xla_owned_copy(restored, sh)
 
     return jax.tree_util.tree_map(place, like, state)
 

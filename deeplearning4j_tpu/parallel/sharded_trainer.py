@@ -61,14 +61,40 @@ class ShardedTrainer:
                                         else NamedSharding(self.mesh, s)),
             params, self.param_specs)
 
-    def shard_batch(self, batch):
+    def shard_batch(self, batch, owned=False):
+        """dp-shard one batch pytree. owned=True stages host leaves
+        through XLA-owned copies (runtime/pipeline.xla_owned_copy) — the
+        background prefetch path uses it so staged buffers can never
+        alias loader-owned numpy memory."""
         sh = NamedSharding(self.mesh, P(self.batch_axis))
 
         def put(a):
             _mon.record_transfer(getattr(a, "nbytes", 0))
+            if owned and not isinstance(a, jax.Array):
+                from deeplearning4j_tpu.runtime.pipeline import \
+                    xla_owned_copy
+                return xla_owned_copy(a, sh)
             return jax.device_put(a, sh)
 
         return jax.tree_util.tree_map(put, batch)
+
+    def prefetch_batches(self, batches, depth=2):
+        """The host-pipeline wiring for this functional trainer: returns
+        an iterator whose background worker pulls `batches` (any
+        iterable or DataSetIterator-protocol source of batch pytrees)
+        and dp-shards batch N+1 onto the mesh while the caller's step N
+        computes.
+
+            it = trainer.prefetch_batches(loader, depth=2)
+            for staged in it:
+                params, opt_state, loss = trainer.fit_batch(
+                    params, opt_state, staged, rng)
+
+        Call .close() (or exhaust it) to stop the worker."""
+        from deeplearning4j_tpu.runtime.pipeline import PrefetchIterator
+        return PrefetchIterator(
+            batches, depth=depth,
+            stage=lambda b: self.shard_batch(b, owned=True))
 
     def init(self, params):
         params = self.shard_params(params)

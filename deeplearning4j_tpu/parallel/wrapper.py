@@ -20,9 +20,22 @@ import numpy as np
 import jax
 
 from deeplearning4j_tpu import monitoring as _mon
-from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh
 from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.runtime import pipeline as _pipeline
+
+
+class _StagedShards:
+    """One batch already padded + dp-sharded onto the mesh by the
+    prefetch worker — _fit_dataset consumes it without any host work."""
+
+    __slots__ = ("x", "y", "fmask", "lmask")
+
+    def __init__(self, x, y, fmask, lmask):
+        self.x = x
+        self.y = y
+        self.fmask = fmask
+        self.lmask = lmask
 
 
 class ParallelWrapper:
@@ -111,12 +124,11 @@ class ParallelWrapper:
                 "ShardedTrainer for general graphs")
         return self._is_graph
 
-    def _fit_dataset(self, ds):
-        """One dp-sharded train step on a DataSet (the shared inner loop —
-        also driven by EarlyStoppingParallelTrainer)."""
-        if _faults.ACTIVE is not None:
-            _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
-        is_graph = self._graph_model()
+    def _host_prep(self, ds):
+        """Host side of one batch: unwrap (Multi)DataSet, pad a ragged
+        final batch to a dp multiple with zero-weighted rows. Returns
+        numpy (feats, labs, fmask, lmask). Runs on the caller's thread
+        in the synchronous path, on the prefetch worker when staging."""
         from deeplearning4j_tpu.datasets.dataset import MultiDataSet
         if isinstance(ds, MultiDataSet):
             # single-array MultiDataSet (the usual graph pairing) maps
@@ -164,12 +176,37 @@ class ParallelWrapper:
             _mon.record_transfer(feats.nbytes + labs.nbytes
                                  + (0 if lm is None else lm.nbytes)
                                  + (0 if fm is None else fm.nbytes))
-        x = jax.device_put(feats, self.mesh.sharding("dp"))
-        y = jax.device_put(labs, self.mesh.sharding("dp"))
-        lmask = None if lm is None \
-            else jax.device_put(lm, self.mesh.sharding("dp"))
-        fmask = None if fm is None \
-            else jax.device_put(fm, self.mesh.sharding("dp"))
+        return feats, labs, fm, lm
+
+    def _stage(self, ds):
+        """Prefetch-worker staging: host prep + dp-sharded device_put
+        through XLA-owned copies (donation-safe; overlaps the NEXT
+        batch's H2D transfer with the current step's compute)."""
+        feats, labs, fm, lm = self._host_prep(ds)
+        sh = self.mesh.sharding("dp")
+        own = _pipeline.xla_owned_copy
+        return _StagedShards(
+            own(feats, sh), own(labs, sh),
+            None if fm is None else own(fm, sh),
+            None if lm is None else own(lm, sh))
+
+    def _fit_dataset(self, ds):
+        """One dp-sharded train step on a DataSet (the shared inner loop —
+        also driven by EarlyStoppingParallelTrainer). Accepts either a
+        raw (Multi)DataSet or a _StagedShards from the prefetcher."""
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        is_graph = self._graph_model()
+        if isinstance(ds, _StagedShards):
+            x, y, fmask, lmask = ds.x, ds.y, ds.fmask, ds.lmask
+        else:
+            feats, labs, fm, lm = self._host_prep(ds)
+            x = jax.device_put(feats, self.mesh.sharding("dp"))
+            y = jax.device_put(labs, self.mesh.sharding("dp"))
+            lmask = None if lm is None \
+                else jax.device_put(lm, self.mesh.sharding("dp"))
+            fmask = None if fm is None \
+                else jax.device_put(fm, self.mesh.sharding("dp"))
         m = self.model
         m._rng_key, sub = jax.random.split(m._rng_key)
         with _mon.span("parallel.dispatch"):
@@ -187,7 +224,7 @@ class ParallelWrapper:
                 m._params, m._opt_state, m._state, loss = m._train_step(
                     m._params, m._opt_state, m._state, x, y, fmask, lmask,
                     sub)
-            m._score = float(loss)
+            m._score = loss    # device scalar; score() floats on demand
         m._iteration += 1
         # StatsListener contract (ADVICE r5): the model-side fit paths set
         # both of these per real update — the wrapper's step must too, or
@@ -261,11 +298,15 @@ class ParallelWrapper:
         # iterationDone calls as param-stale (ADVICE r5, wrapper.py:200)
         m._params_version = getattr(m, "_params_version", 0) + 1
         with _mon.span("train.listeners"):
-            for loss in jax.device_get(losses):
-                m._score = float(loss)
-                m._iteration += 1
-                for listener in m._listeners:
-                    listener.iterationDone(m, m._iteration, m._epoch)
+            if m._listeners:
+                for i in range(len(group)):
+                    m._score = losses[i]   # device slice; lazy float
+                    m._iteration += 1
+                    for listener in m._listeners:
+                        listener.iterationDone(m, m._iteration, m._epoch)
+            else:
+                m._score = losses[len(group) - 1]
+                m._iteration += len(group)
 
     def fit(self, iterator, epochs=1, stepsPerDispatch=1):
         """Data-parallel fit: same jitted train step as the wrapped model —
@@ -276,45 +317,55 @@ class ParallelWrapper:
         if self.model._params is None:
             self.model.init()
         self._shard_model()
-        it = iterator
+        it, pf = iterator, None
+        k = max(1, int(stepsPerDispatch))
         if self.prefetch_buffer and hasattr(iterator, "asyncSupported") \
                 and iterator.asyncSupported():
-            it = AsyncDataSetIterator(iterator, self.prefetch_buffer)
-        k = max(1, int(stepsPerDispatch))
-        for _ in range(int(epochs)):
-            with _mon.span("fit.epoch"):
-                if hasattr(it, "reset"):
-                    it.reset()
-                if k == 1:
-                    for ds in _mon.traced_iter(it):
-                        self._fit_dataset(ds)
-                else:
-                    group, sig = [], None
-
-                    def flush():
-                        nonlocal group
-                        for g in group:   # sub-k groups run singly
-                            self._fit_dataset(g)
-                        group = []
-
-                    for ds in _mon.traced_iter(it):
-                        s = self._scan_sig(ds)
-                        scannable = (s is not None and len(s[0]) > 0
-                                     and s[0][0] % self.mesh.size == 0)
-                        if not scannable:
-                            flush()
-                            sig = None
+            # k == 1: stage all the way onto the mesh (pad + dp-sharded
+            # device_put) in the background. k > 1: the scanned path
+            # stacks host arrays per group itself, so prefetch only the
+            # host pull (stage=None) and leave staging to the group.
+            it = pf = _pipeline.PrefetchIterator(
+                iterator, depth=self.prefetch_buffer,
+                stage=self._stage if k == 1 else None)
+        try:
+            for _ in range(int(epochs)):
+                with _mon.span("fit.epoch"):
+                    if hasattr(it, "reset"):
+                        it.reset()
+                    if k == 1:
+                        for ds in _mon.traced_iter(it):
                             self._fit_dataset(ds)
-                            continue
-                        if s != sig:
-                            flush()
-                            sig = s
-                        group.append(ds)
-                        if len(group) == k:
-                            self._fit_group_scanned(group)
+                    else:
+                        group, sig = [], None
+
+                        def flush():
+                            nonlocal group
+                            for g in group:   # sub-k groups run singly
+                                self._fit_dataset(g)
                             group = []
-                    flush()
-                self.model._epoch += 1
+
+                        for ds in _mon.traced_iter(it):
+                            s = self._scan_sig(ds)
+                            scannable = (s is not None and len(s[0]) > 0
+                                         and s[0][0] % self.mesh.size == 0)
+                            if not scannable:
+                                flush()
+                                sig = None
+                                self._fit_dataset(ds)
+                                continue
+                            if s != sig:
+                                flush()
+                                sig = s
+                            group.append(ds)
+                            if len(group) == k:
+                                self._fit_group_scanned(group)
+                                group = []
+                        flush()
+                    self.model._epoch += 1
+        finally:
+            if pf is not None:
+                pf.close()
         return self.model
 
     def shutdown(self):

@@ -70,9 +70,16 @@ def _dataset_arrays(ds):
 class FaultTolerantTrainer:
     def __init__(self, model, directory, save_every=25, max_to_keep=3,
                  retry_policy=None, skip_non_finite=True,
-                 max_skipped_batches=None):
+                 max_skipped_batches=None, prefetch=2):
+        """prefetch: staging-queue depth for the host pipeline in
+        network-mode fit() (0 disables). Batch consumption is counted on
+        the CONSUMER side of the prefetch queue — i.e. at the training
+        loop, in source order — so `step`/resume replay see exactly the
+        batches that trained, never ones merely sitting staged in the
+        queue: kill/resume stays bit-identical with prefetch on."""
         from deeplearning4j_tpu.parallel.elastic import ElasticCheckpointer
         self.model = model
+        self.prefetch = int(prefetch)
         # our `step` counter (batches consumed) drives save cadence, so
         # the manager itself saves every step it is asked to
         self.ckpt = ElasticCheckpointer(directory, max_to_keep=max_to_keep,
@@ -159,9 +166,8 @@ class FaultTolerantTrainer:
             sh = getattr(fresh, "sharding", None)
             if sh is None:
                 return np.array(restored)
-            owned = xla_owned_copy(restored)
-            return jax.device_put(owned, sh) \
-                if isinstance(sh, NamedSharding) else owned
+            return xla_owned_copy(
+                restored, sh if isinstance(sh, NamedSharding) else None)
 
         state = jax.tree_util.tree_map(place, like, state)
         m._params = state["params"]
@@ -253,16 +259,39 @@ class FaultTolerantTrainer:
                             "functional trainers")
         already = self.resume_or_init()
         consumed = 0
+        # host pipeline: batches stage to XLA-owned device buffers in
+        # the background; the finite check happens on the HOST arrays
+        # inside the worker (pre-staging), so the consumer loop reads a
+        # precomputed verdict instead of forcing a device readback.
+        # Resume replay pulls-and-drops the first `already` batches —
+        # staging those would waste a host copy + H2D transfer each, so
+        # the stage passes them through untouched (worker pull order ==
+        # consumer delivery order, so the countdown aligns; each worker
+        # error shifts it by one, leaving at most that many trainable
+        # batches unstaged — still correct, the fit paths accept raw
+        # DataSets).
+        from deeplearning4j_tpu.runtime import pipeline as _pipeline
+        replay = {"left": already}
+
+        def _stage(ds):
+            if replay["left"] > 0:
+                replay["left"] -= 1
+                return ds
+            return _pipeline.stage_dataset(
+                ds, check_finite=self.skip_non_finite)
+
+        src, pf = _pipeline.maybe_prefetch(data, self.prefetch,
+                                           stage=_stage)
         try:
             for _ in range(int(epochs)):
                 with _mon.span("fit.epoch"):
-                    if hasattr(data, "reset"):
-                        data.reset()
+                    if hasattr(src, "reset"):
+                        src.reset()
                     # the RAW iterator, spanned manually — traced_iter's
                     # generator would be finalized by the first iterator
                     # exception, silently truncating the epoch on the
                     # very errors this loop exists to skip-and-count
-                    it = iter(data)
+                    it = iter(src)
                     while True:
                         # the injection hook gets its OWN handler: it
                         # fires BEFORE the pull, so the iterator has not
@@ -304,16 +333,33 @@ class FaultTolerantTrainer:
                             if consumed > already:
                                 self.step = consumed
                                 self._count_skip("data_error")
+                            if isinstance(src, _pipeline.PrefetchIterator):
+                                # the error killed the prefetch worker
+                                # and would re-raise forever; restart it
+                                # from the base's current position so
+                                # skip-and-count proceeds exactly like
+                                # the unprefetched path (a permanently
+                                # broken loader is still bounded by
+                                # max_skipped_batches, as before). `src`,
+                                # not `pf`: the user may have handed us an
+                                # already-wrapped Async/PrefetchIterator
+                                # (pf is None then)
+                                src.resume_after_error()
                             continue
                         consumed += 1
                         if consumed <= already:
                             continue       # trained before the crash
-                        if self.skip_non_finite and \
-                                not all(_finite(a)
-                                        for a in _dataset_arrays(ds)):
-                            self.step = consumed
-                            self._count_skip("non_finite")
-                            continue
+                        if self.skip_non_finite:
+                            # staged batches carry the worker's host-side
+                            # verdict; checking the device arrays here
+                            # would block on a D2H readback every step
+                            pre = getattr(ds, "_host_finite", None)
+                            finite = pre if pre is not None else all(
+                                _finite(a) for a in _dataset_arrays(ds))
+                            if not finite:
+                                self.step = consumed
+                                self._count_skip("non_finite")
+                                continue
                         self._fit_one(ds)
                         self.step = consumed
                         if self.step % self.save_every == 0:
@@ -328,6 +374,9 @@ class FaultTolerantTrainer:
             except Exception:  # noqa: BLE001 — the original error wins
                 pass
             raise
+        finally:
+            if pf is not None:
+                pf.close()
         return self.model
 
     # ===================== sharded (functional) mode ====================

@@ -1,0 +1,479 @@
+"""Host pipeline: async dispatch + device staging prefetch.
+
+BENCH.md's profile puts single-chip XLA fusions within ~1.5x of the HBM
+bound, so the remaining throughput lever is the HOST side. Two host
+pathologies starved the device in the pre-pipeline fit loops (the same
+per-step host round-trips PAPERS.md's PyGraph analysis shows killing
+CUDA-graph throughput):
+
+1. **per-step blocking sync** — every fit loop did `float(loss)` each
+   step, parking the host until the device finished. JAX's async
+   dispatch lets the host run ahead, queueing step N+1 (and N+2, ...)
+   while step N computes; one `float()` per step forfeits that. The fix
+   is the *lazy score*: `_score` holds the device scalar and only
+   `score()` (listeners, early stopping, user code) materializes it —
+   numerics are bit-identical, only WHEN the host blocks changes. Sync
+   cadence is therefore the consumer's cadence: a
+   `ScoreIterationListener(10)` syncs every 10 steps, a listener-free
+   `fit()` never syncs.
+
+2. **synchronous input staging** — batch N+1's host→device conversion
+   waited for step N's dispatch loop. `PrefetchIterator` moves
+   pull + preprocess + device staging to a background thread with a
+   bounded queue (double-buffered by default), so input prep overlaps
+   device compute (the upstream DL4J `AsyncDataSetIterator` /
+   `prefetchBuffer` idea, extended to stage all the way onto the
+   device).
+
+Staging is donation-safe by construction: every host array is copied
+through `xla_owned_copy`, because on this backend `jnp.asarray(numpy)`
+zero-copy ALIASES suitably-aligned numpy buffers and a donating jitted
+step then frees memory numpy owns — free(): corrupted chunks / NaN
+params / segfaults (root-caused in the resilience PR, 20/20 aliased on
+fresh allocations, 0/20 through the misaligned-view copy).
+
+Observability (`dl4j.pipeline.*`, zero-cost when monitoring is
+disabled): `syncs` counts host-blocking materializations (the
+regression guard: a listener-free fit must record 0 per-step syncs),
+`host_blocked_ms` how long each blocked, `prefetch_depth` the staging
+queue occupancy, `staged_batches` throughput of the staging thread.
+
+`bench_pipeline.py` (repo root, CPU-runnable) measures the overlap win
+against an IO-bound synthetic loader.
+"""
+from __future__ import annotations
+
+import os
+import queue as _queue
+import threading
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.monitoring.state import STATE
+
+__all__ = [
+    "DEFAULT_PREFETCH", "PrefetchIterator", "StagedBatch",
+    "StagedMultiBatch", "blocking_float", "materialize_score",
+    "maybe_prefetch", "stage_dataset", "stage_for_eval", "xla_owned_copy",
+]
+
+#: default staging queue depth (double buffer): batch N+1 stages while
+#: step N computes. 0 disables prefetch globally.
+DEFAULT_PREFETCH = int(os.environ.get("DL4J_PIPELINE_PREFETCH", "2"))
+
+
+def xla_owned_copy(host, sharding=None):
+    """A jax array GUARANTEED to own its buffer (bit-exact copy of
+    `host`). On this jax CPU backend `jnp.asarray(numpy)` zero-copy
+    aliases any suitably-aligned numpy buffer (measured 20/20 on fresh
+    allocations); when a donating jitted step later consumes such an
+    array, XLA frees/reuses memory numpy owns — heap corruption that
+    surfaces as free(): corrupted chunks, NaN params, or segfaults a
+    step or two after resume. Staging through a deliberately MISALIGNED
+    view makes the zero-copy eligibility check fail, forcing a real
+    copy into XLA-allocated memory (verified 0/20 aliased). Pass
+    `sharding` to land the copy directly on an explicit placement."""
+    host = np.asarray(host)
+    if host.nbytes == 0:
+        out = jnp.asarray(host)
+        return out if sharding is None else jax.device_put(out, sharding)
+    raw = np.empty(host.nbytes + 1, np.uint8)
+    view = raw[1:1 + host.nbytes].view(host.dtype).reshape(host.shape)
+    view[...] = host
+    if sharding is None:
+        return jnp.asarray(view)
+    return jax.device_put(view, sharding)
+
+
+# -- lazy score ------------------------------------------------------------
+def blocking_float(value, site="score"):
+    """float(device scalar), COUNTED: every call that actually blocks on
+    the device lands on `dl4j.pipeline.syncs` (+ a host_blocked_ms
+    observation), so a re-introduced per-step sync shows up in metrics
+    and trips the tier-1 regression test."""
+    if value is None:
+        return None
+    if isinstance(value, (float, int)):
+        return float(value)
+    if not STATE.enabled:
+        return float(value)
+    t0 = time.perf_counter()
+    v = float(value)
+    blocked_ms = (time.perf_counter() - t0) * 1e3
+    reg = _mon.get_registry()
+    reg.counter(_mon.PIPELINE_SYNCS, labels={"site": site},
+                help="host-blocking device syncs (0/step when the "
+                     "pipeline is healthy)").inc()
+    reg.histogram(_mon.PIPELINE_HOST_BLOCKED_MS, labels={"site": site},
+                  help="wall time the host spent blocked per sync") \
+       .observe(blocked_ms)
+    return v
+
+
+def materialize_score(model, site="score"):
+    """The one place `_score` turns host-side: floats a device-resident
+    loss on demand and caches the float back, so N listeners reading the
+    same iteration's score cost ONE sync."""
+    s = model._score
+    if s is None or isinstance(s, float):
+        return s
+    v = blocking_float(s, site=site)
+    model._score = v
+    return v
+
+
+# -- staged batch containers ----------------------------------------------
+class StagedBatch:
+    """Device-resident DataSet stand-in: same read surface
+    (features/labels/masks, numExamples) but every array is already an
+    XLA-owned device buffer, so the fit paths' `jnp.asarray` is a no-op
+    and the host never touches the bytes again. Deliberately NOT a
+    DataSet subclass — DataSet.__init__ coerces to numpy, which would
+    drag the arrays straight back to the host."""
+
+    __slots__ = ("features", "labels", "featuresMask", "labelsMask",
+                 "_host_finite")
+
+    def __init__(self, features, labels, featuresMask=None,
+                 labelsMask=None, host_finite=None):
+        self.features = features
+        self.labels = labels
+        self.featuresMask = featuresMask
+        self.labelsMask = labelsMask
+        self._host_finite = host_finite
+
+    def numExamples(self):
+        return 0 if self.features is None else int(self.features.shape[0])
+
+
+class StagedMultiBatch:
+    """MultiDataSet counterpart of StagedBatch (list-of-arrays fields)."""
+
+    __slots__ = ("features", "labels", "featuresMasks", "labelsMasks",
+                 "_host_finite")
+
+    def __init__(self, features, labels, featuresMasks=None,
+                 labelsMasks=None, host_finite=None):
+        self.features = features
+        self.labels = labels
+        self.featuresMasks = featuresMasks
+        self.labelsMasks = labelsMasks
+        self._host_finite = host_finite
+
+
+class _EvalStaged:
+    """Eval staging: features (what the forward pass consumes) go to the
+    device; labels/masks stay HOST-side numpy — the evaluator reads them
+    on the host, so staging them would just bounce the bytes
+    host→device→host. Everything not staged proxies to the original."""
+
+    __slots__ = ("_ds", "features", "featuresMask")
+
+    def __init__(self, ds, features, featuresMask):
+        self._ds = ds
+        self.features = features
+        self.featuresMask = featuresMask
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_ds"), name)
+
+
+def _owned(a):
+    if a is None:
+        return None
+    if isinstance(a, jax.Array):
+        return a
+    return xla_owned_copy(np.asarray(a))
+
+
+def _host_floats_finite(arrays):
+    """Finite check on HOST arrays (pre-staging). After staging the check
+    would force a blocking device readback per batch — exactly the sync
+    this pipeline removes — so FaultTolerantTrainer consumes this
+    precomputed verdict instead."""
+    for a in arrays:
+        if a is None:
+            continue
+        arr = np.asarray(a)
+        if np.issubdtype(arr.dtype, np.floating) \
+                and not np.isfinite(arr).all():
+            return False
+    return True
+
+
+def stage_dataset(ds, check_finite=False):
+    """Stage one DataSet/MultiDataSet onto the device through XLA-owned
+    copies. Runs on the prefetch worker thread, overlapping the NEXT
+    step's H2D conversion with the current step's compute."""
+    multi = isinstance(getattr(ds, "features", None), (list, tuple))
+    if multi:
+        arrays = list(ds.features) + list(ds.labels or [])
+        finite = _host_floats_finite(arrays) if check_finite else None
+        staged = StagedMultiBatch(
+            [_owned(f) for f in ds.features],
+            None if ds.labels is None else [_owned(l) for l in ds.labels],
+            None if ds.featuresMasks is None
+            else [_owned(m) for m in ds.featuresMasks],
+            None if ds.labelsMasks is None
+            else [_owned(m) for m in ds.labelsMasks],
+            host_finite=finite)
+    else:
+        finite = (_host_floats_finite([ds.features, ds.labels])
+                  if check_finite else None)
+        staged = StagedBatch(_owned(ds.features), _owned(ds.labels),
+                             _owned(getattr(ds, "featuresMask", None)),
+                             _owned(getattr(ds, "labelsMask", None)),
+                             host_finite=finite)
+    if STATE.enabled:
+        _mon.get_registry().counter(
+            _mon.PIPELINE_STAGED_BATCHES,
+            help="batches staged to device by the prefetch worker").inc()
+    return staged
+
+
+def stage_for_eval(ds):
+    """Eval-loop staging: device-stage features (+features mask) only."""
+    feats = getattr(ds, "features", None)
+    if isinstance(feats, (list, tuple)):
+        staged = [_owned(f) for f in feats]
+    else:
+        staged = _owned(feats)
+    fm = getattr(ds, "featuresMask", None)
+    return _EvalStaged(ds, staged, _owned(fm))
+
+
+# -- the prefetcher --------------------------------------------------------
+class PrefetchIterator:
+    """Background-thread prefetch with optional device staging.
+
+    Wraps either a DataSetIterator (hasNext/next protocol) or any plain
+    iterable. The worker pulls `base`, applies `stage` (e.g.
+    `stage_dataset` → XLA-owned device arrays), and feeds a bounded
+    queue of depth `depth`; the consumer side exposes the standard
+    hasNext/next/reset surface plus python iteration.
+
+    Failure semantics (the two classic async-iterator bugs, fixed by
+    construction):
+    - an exception in the worker — `base.next()` raising, staging
+      failing — is CAPTURED and re-raised in the consumer with the
+      original traceback; it can never masquerade as a clean
+      end-of-stream and silently truncate the epoch;
+    - the consumer polls the queue with a timeout and checks worker
+      liveness, so a worker that dies without posting a result surfaces
+      as an error instead of deadlocking `hasNext` forever.
+    """
+
+    _EMPTY = object()    # nothing peeked yet
+    _EOS = object()      # worker saw clean end-of-stream
+    _FAILED = object()   # worker captured an exception (see self._error)
+    _POLL_S = 0.25       # consumer liveness-poll interval
+
+    def __init__(self, base, depth=2, stage=None):
+        self._base = base
+        self._depth = max(1, int(depth))
+        self._stage = stage
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._peek = self._EMPTY
+        self._error = None
+
+    # -- worker side -----------------------------------------------------
+    def _offer(self, q, stop, item):
+        """put() that a reset()/close() can always interrupt — a plain
+        blocking put on a full queue with a gone consumer would leak the
+        worker thread forever."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _worker(self, q, stop):
+        # q/stop are THIS generation's objects, bound at thread start: a
+        # straggler worker from before a reset() can never touch the
+        # fresh queue or see the fresh (cleared) stop event
+        try:
+            base = self._base
+            if hasattr(base, "hasNext") and hasattr(base, "next"):
+                while not stop.is_set() and base.hasNext():
+                    item = base.next()
+                    if self._stage is not None:
+                        item = self._stage(item)
+                    if not self._offer(q, stop, item):
+                        return
+            else:
+                for item in iter(base):
+                    if stop.is_set():
+                        return
+                    if self._stage is not None:
+                        item = self._stage(item)
+                    if not self._offer(q, stop, item):
+                        return
+        except BaseException as e:  # noqa: BLE001 — delivered to consumer
+            self._error = e
+            self._offer(q, stop, self._FAILED)
+            return
+        self._offer(q, stop, self._EOS)
+
+    # -- consumer side ---------------------------------------------------
+    def _ensure_thread(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._worker, args=(self._queue, self._stop),
+                daemon=True, name="dl4j-pipeline-prefetch")
+            self._thread.start()
+
+    def _get_item(self):
+        self._ensure_thread()
+        while True:
+            try:
+                item = self._queue.get(timeout=self._POLL_S)
+            except _queue.Empty:
+                t = self._thread
+                if t is not None and t.is_alive():
+                    continue
+                # worker is gone: drain once more to close the race
+                # where it posted between our get timing out and the
+                # liveness check
+                try:
+                    item = self._queue.get_nowait()
+                except _queue.Empty:
+                    if self._error is not None:
+                        raise self._error
+                    raise RuntimeError(
+                        "prefetch worker died without delivering a batch, "
+                        "an error, or end-of-stream")
+            if STATE.enabled:
+                _mon.get_registry().gauge(
+                    _mon.PIPELINE_PREFETCH_DEPTH,
+                    help="staged batches waiting in the prefetch queue "
+                         "(0 = device waiting on the loader)") \
+                    .set(self._queue.qsize())
+            return item
+
+    def hasNext(self):
+        if self._peek is self._EMPTY:
+            self._peek = self._get_item()
+        if self._peek is self._FAILED:
+            # _peek stays FAILED: every subsequent hasNext/next re-raises
+            # instead of pretending the stream ended cleanly
+            raise self._error
+        return self._peek is not self._EOS
+
+    def next(self, num=None):
+        if not self.hasNext():
+            raise StopIteration("DataSetIterator exhausted; call reset()")
+        item, self._peek = self._peek, self._EMPTY
+        return item
+
+    def failed(self):
+        """True once the worker has died on an error: hasNext/next
+        re-raise until reset() or resume_after_error() revives the
+        stream."""
+        return self._peek is self._FAILED
+
+    def resume_after_error(self):
+        """Clear a sticky worker failure and prefetch on from the base's
+        CURRENT position (the failed pull's batch is lost, exactly as
+        with a raw iterator whose next() raised mid-pull) — this is how
+        skip-and-count consumers (FaultTolerantTrainer) keep their
+        count-one-error-and-continue semantics with prefetch on. No-op
+        unless in the failed state."""
+        if self._peek is not self._FAILED:
+            return
+        self._shutdown_worker()
+        self._stop = threading.Event()
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._peek = self._EMPTY
+        self._error = None
+
+    # -- lifecycle -------------------------------------------------------
+    def _shutdown_worker(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            deadline = time.monotonic() + 10.0
+            while t.is_alive() and time.monotonic() < deadline:
+                try:     # unblock a worker stuck in _offer on a full queue
+                    self._queue.get_nowait()
+                except _queue.Empty:
+                    time.sleep(0.002)
+            t.join(timeout=5)
+        self._thread = None
+
+    def reset(self):
+        self._shutdown_worker()
+        # fresh generation: new stop event + queue, so the (joined) old
+        # worker's objects are dead ends even if it somehow lingered
+        self._stop = threading.Event()
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._peek = self._EMPTY
+        self._error = None
+        if hasattr(self._base, "reset"):
+            self._base.reset()
+
+    def close(self):
+        """Stop the worker without resetting the base (for finally:
+        blocks around a fit/eval that may exit early)."""
+        self._shutdown_worker()
+
+    # -- protocol parity -------------------------------------------------
+    def resetSupported(self):
+        sup = getattr(self._base, "resetSupported", None)
+        return hasattr(self._base, "reset") if sup is None else sup()
+
+    def asyncSupported(self):
+        return False    # already async; double-wrapping buys nothing
+
+    def batch(self):
+        return self._base.batch()
+
+    def numExamples(self):
+        return self._base.numExamples()
+
+    def totalOutcomes(self):
+        return self._base.totalOutcomes()
+
+    def inputColumns(self):
+        return self._base.inputColumns()
+
+    def setPreProcessor(self, pp):
+        self._base.setPreProcessor(pp)
+
+    def getPreProcessor(self):
+        getpp = getattr(self._base, "getPreProcessor", None)
+        return None if getpp is None else getpp()
+
+    def __iter__(self):
+        if self.resetSupported():
+            self.reset()
+        return self
+
+    def __next__(self):
+        if not self.hasNext():
+            raise StopIteration
+        return self.next()
+
+
+def maybe_prefetch(data, depth=None, stage=None):
+    """(iterator, prefetcher-or-None): wrap `data` in a staging
+    prefetcher when it opts in (`asyncSupported()`) and `depth` > 0.
+    The second element is the caller's close() handle (None when no
+    wrapping happened). Already-wrapped iterators pass through."""
+    depth = DEFAULT_PREFETCH if depth is None else int(depth)
+    if depth <= 0 or isinstance(data, PrefetchIterator):
+        return data, None
+    sup = getattr(data, "asyncSupported", None)
+    if sup is None or not sup():
+        return data, None
+    pf = PrefetchIterator(data, depth=depth,
+                          stage=stage_dataset if stage is None else stage)
+    return pf, pf

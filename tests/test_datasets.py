@@ -117,6 +117,66 @@ def test_async_iterator_equivalent():
         np.testing.assert_array_equal(a, d)
 
 
+class _FailingIterator(ArrayDataSetIterator):
+    """Raises from next() at a given batch index — a broken loader."""
+
+    def __init__(self, fail_at=3, **kw):
+        x = np.arange(40, dtype=np.float32).reshape(20, 2)
+        y = np.zeros((20, 1), np.float32)
+        super().__init__(x, y, 4, **kw)
+        self._fail_at = fail_at
+        self._served = 0
+
+    def next(self, num=None):
+        if self._served == self._fail_at:
+            raise ValueError("loader exploded mid-epoch")
+        self._served += 1
+        return super().next(num)
+
+
+def test_async_iterator_reraises_worker_error_not_truncates():
+    """A raising base.next() must surface in the consumer (with the
+    original traceback), NOT masquerade as a clean end-of-stream that
+    silently truncates the epoch to 3 of 5 batches."""
+    import traceback
+    it = AsyncDataSetIterator(_FailingIterator(fail_at=3), queue_size=2)
+    got = []
+    with pytest.raises(ValueError, match="loader exploded") as exc_info:
+        while it.hasNext():
+            got.append(it.next())
+    assert len(got) == 3           # the good batches still arrive, in order
+    tb = "".join(traceback.format_tb(exc_info.value.__traceback__))
+    assert "_FailingIterator" in tb or "next" in tb
+    # the error is sticky: repeated polls keep raising, never silent EOS
+    with pytest.raises(ValueError):
+        it.hasNext()
+
+
+def test_async_iterator_dead_worker_does_not_deadlock(monkeypatch):
+    """A worker thread that dies without posting a batch, an error, or
+    end-of-stream must surface as an error — the old untimed
+    queue.get() blocked hasNext forever."""
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+    y = np.zeros((4, 1), np.float32)
+    it = AsyncDataSetIterator(ArrayDataSetIterator(x, y, 2), queue_size=2)
+    monkeypatch.setattr(type(it), "_worker", lambda self, q, stop: None)
+    monkeypatch.setattr(type(it), "_POLL_S", 0.05)
+    with pytest.raises(RuntimeError, match="worker died"):
+        it.hasNext()
+
+
+def test_async_iterator_reset_midstream():
+    x = np.arange(40, dtype=np.float32).reshape(20, 2)
+    y = np.zeros((20, 1), np.float32)
+    it = AsyncDataSetIterator(ArrayDataSetIterator(x, y, 5), queue_size=2)
+    assert it.hasNext()
+    it.next()
+    it.next()
+    it.reset()
+    full = [b.features for b in it]
+    np.testing.assert_array_equal(np.concatenate(full), x)
+
+
 class TestListDataSetIterator:
     def test_rebatches_across_list_entries(self):
         from deeplearning4j_tpu.datasets import ListDataSetIterator

@@ -1,0 +1,435 @@
+"""Host pipeline (runtime/pipeline.py): lazy score + device-staging
+prefetch. The headline regression guard: a listener-free fit() performs
+ZERO per-step host-blocking syncs (`dl4j.pipeline.syncs`) — anyone
+re-adding a `float(loss)` to a fit loop trips it."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.nn import (Adam, DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer, Sgd)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import (MetricsListener,
+                                                   ScoreIterationListener)
+from deeplearning4j_tpu.runtime import pipeline
+
+
+@pytest.fixture(autouse=True)
+def _clean_monitoring():
+    yield
+    monitoring.get_registry().clear()
+    monitoring.disable()
+
+
+def _net(seed=3):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Sgd(0.1)).activation("tanh")
+            .list()
+            .layer(DenseLayer.Builder().nOut(8).build())
+            .layer(OutputLayer.Builder("mcxent").nOut(3)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, 5)).astype(np.float32)
+    Y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return X, Y
+
+
+def _syncs(reg=None):
+    snap = (reg or monitoring.get_registry()).snapshot()
+    return sum(r["value"] for r in snap.get(monitoring.PIPELINE_SYNCS, []))
+
+
+def _params(net):
+    return jax.tree_util.tree_map(np.asarray, net._params)
+
+
+def _assert_trees_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# -- the regression guard ---------------------------------------------------
+def test_listener_free_fit_records_zero_per_step_syncs():
+    """Acceptance: 50 training steps, no listeners → 0 host-blocking
+    syncs; the first score() read afterwards is exactly 1."""
+    X, Y = _data(400)
+    monitoring.enable()
+    reg = monitoring.get_registry()
+    reg.clear()
+    net = _net()
+    net.fit(ArrayDataSetIterator(X, Y, 8), epochs=1)   # 50 batches
+    assert net.getIterationCount() == 50
+    assert _syncs(reg) == 0, \
+        "a fit loop re-introduced a per-step blocking sync"
+    # every batch went through the background staging stage
+    snap = reg.snapshot()
+    staged = sum(r["value"]
+                 for r in snap.get(monitoring.PIPELINE_STAGED_BATCHES, []))
+    assert staged == 50
+    s = net.score()
+    assert isinstance(s, float) and np.isfinite(s)
+    assert _syncs(reg) == 1
+    # cached: a second read does not sync again
+    assert net.score() == s
+    assert _syncs(reg) == 1
+
+
+def test_score_listener_syncs_at_its_own_cadence():
+    X, Y = _data(400)
+    monitoring.enable()
+    reg = monitoring.get_registry()
+    reg.clear()
+    net = _net()
+    net.setListeners(ScoreIterationListener(10, log_fn=lambda *_: None))
+    net.fit(ArrayDataSetIterator(X, Y, 8), epochs=1)   # iterations 1..50
+    assert _syncs(reg) == 5    # iterations 10, 20, 30, 40, 50
+
+
+def test_metrics_listener_score_frequency_bounds_syncs():
+    X, Y = _data(400)
+    net = _net()
+    reg = monitoring.get_registry()
+    reg.clear()
+    net.setListeners(MetricsListener(scoreFrequency=25))
+    net.fit(ArrayDataSetIterator(X, Y, 8), epochs=1)
+    assert _syncs(reg) == 2    # iterations 25, 50
+    assert reg.get("dl4j.train.score") is not None
+
+
+# -- numerics: only WHEN we block changes, never the math -------------------
+def test_prefetched_fit_bit_identical_to_synchronous():
+    X, Y = _data(240)
+    a, b = _net(), _net()
+    a.fit(ArrayDataSetIterator(X, Y, 8), epochs=2)               # pipeline
+    b.setListeners(ScoreIterationListener(1, log_fn=lambda *_: None))
+    b.fit(ArrayDataSetIterator(X, Y, 8), epochs=2, prefetch=0)   # old style
+    _assert_trees_equal(_params(a), _params(b))
+    assert a.score() == b.score()
+
+
+def test_prefetch_composes_with_scanned_dispatch():
+    X, Y = _data(240)
+    a, b = _net(), _net()
+    a.fit(ArrayDataSetIterator(X, Y, 8), epochs=1, stepsPerDispatch=5)
+    b.fit(ArrayDataSetIterator(X, Y, 8), epochs=1, stepsPerDispatch=5,
+          prefetch=0)
+    _assert_trees_equal(_params(a), _params(b))
+
+
+def test_tbptt_fit_zero_syncs_device_accumulated_score():
+    """Satellite: the TBPTT segment loop must not float() per segment —
+    loss accumulates on device, score() is one sync at the end."""
+    from deeplearning4j_tpu.nn.conf.builders import BackpropType
+    from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(12).updater(Adam(5e-3))
+            .list()
+            .layer(LSTM.Builder().nOut(6).build())
+            .layer(RnnOutputLayer.Builder("mcxent").nOut(4)
+                   .activation("softmax").build())
+            .setInputType(InputType.recurrent(5))
+            .backpropType(BackpropType.TruncatedBPTT)
+            .tBPTTLength(4)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((2, 12, 5)).astype(np.float32)   # 3 segments
+    y = np.zeros((2, 12, 4), np.float32)
+    y[..., 0] = 1.0
+    monitoring.enable()
+    reg = monitoring.get_registry()
+    reg.clear()
+    net.fit(DataSet(x, y))
+    assert _syncs(reg) == 0
+    s = net.score()
+    assert isinstance(s, float) and np.isfinite(s)
+    assert _syncs(reg) == 1
+
+
+# -- staging / donation safety ---------------------------------------------
+def test_staged_batch_never_aliases_host_memory():
+    """Mutating the loader's buffers after staging must not change the
+    staged arrays (xla_owned_copy staging; aliasing + a donating step
+    corrupts the host heap — resilience PR root cause)."""
+    feats = np.arange(12, dtype=np.float32).reshape(3, 4)
+    labs = np.eye(3, dtype=np.float32)
+    staged = pipeline.stage_dataset(DataSet(feats, labs))
+    want_f, want_l = feats.copy(), labs.copy()
+    feats[...] = -1.0
+    labs[...] = -1.0
+    np.testing.assert_array_equal(np.asarray(staged.features), want_f)
+    np.testing.assert_array_equal(np.asarray(staged.labels), want_l)
+    assert isinstance(staged.features, jax.Array)
+
+
+def test_stage_dataset_host_finite_flag():
+    feats = np.ones((4, 3), np.float32)
+    labs = np.eye(4, dtype=np.float32)
+    ok = pipeline.stage_dataset(DataSet(feats, labs), check_finite=True)
+    assert ok._host_finite is True
+    feats[1, 2] = np.nan
+    bad = pipeline.stage_dataset(DataSet(feats, labs), check_finite=True)
+    assert bad._host_finite is False
+
+
+# -- prefetcher unit behavior ----------------------------------------------
+def test_prefetcher_preserves_order_and_resets():
+    X, Y = _data(60, seed=4)
+    base = ArrayDataSetIterator(X, Y, 10)
+    pf = pipeline.PrefetchIterator(base, depth=2,
+                                   stage=pipeline.stage_dataset)
+    first = [np.asarray(b.features) for b in pf]
+    assert len(first) == 6
+    np.testing.assert_array_equal(np.concatenate(first), X)
+    # reset mid-stream: consume 2, reset, full pass again
+    pf.reset()
+    assert pf.hasNext()
+    pf.next()
+    pf.next()
+    pf.reset()
+    again = [np.asarray(b.features) for b in pf]
+    np.testing.assert_array_equal(np.concatenate(again), X)
+    pf.close()
+
+
+def test_prefetcher_wraps_plain_iterables():
+    batches = [{"x": np.full((2, 2), i, np.float32)} for i in range(5)]
+    pf = pipeline.PrefetchIterator(batches, depth=2)
+    got = [b["x"][0, 0] for b in pf]
+    assert got == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+
+def test_prefetcher_close_interrupts_blocked_worker():
+    """A consumer abandoning mid-stream (error in the fit body) must not
+    leak a worker blocked on a full queue."""
+    X, Y = _data(200, seed=5)
+    pf = pipeline.PrefetchIterator(ArrayDataSetIterator(X, Y, 4), depth=1)
+    assert pf.hasNext()    # spins the worker up; queue fills
+    pf.close()
+    assert pf._thread is None
+
+
+def test_maybe_prefetch_gates():
+    X, Y = _data(40)
+    it = ArrayDataSetIterator(X, Y, 8)
+    same, pf = pipeline.maybe_prefetch(it, 0)
+    assert same is it and pf is None
+    wrapped, pf = pipeline.maybe_prefetch(it)
+    assert isinstance(wrapped, pipeline.PrefetchIterator)
+    pf.close()
+    # never double-wrap
+    again, pf2 = pipeline.maybe_prefetch(wrapped)
+    assert again is wrapped and pf2 is None
+
+    class NoAsync(ArrayDataSetIterator):
+        def asyncSupported(self):
+            return False
+
+    na = NoAsync(X, Y, 8)
+    same, pf3 = pipeline.maybe_prefetch(na)
+    assert same is na and pf3 is None
+
+
+# -- evaluation overlap -----------------------------------------------------
+def test_eval_prefetch_matches_synchronous_eval():
+    X, Y = _data(160, seed=7)
+    net = _net()
+    net.fit(ArrayDataSetIterator(X, Y, 16), epochs=1)
+    e1 = net.evaluate(ArrayDataSetIterator(X, Y, 16))              # prefetched
+    e2 = net.evaluate(ArrayDataSetIterator(X, Y, 16), prefetch=0)  # sync
+    assert e1.accuracy() == e2.accuracy()
+    assert e1.f1() == e2.f1()
+
+
+# -- parallel stack ---------------------------------------------------------
+def _mlp(seed=3):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Sgd(0.05)).activation("relu")
+            .list()
+            .layer(DenseLayer.Builder().nOut(16).build())
+            .layer(OutputLayer.Builder("mcxent").nOut(3)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_wrapper_staged_prefetch_bit_identical(devices8):
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+    X, Y = _data(320, seed=9)
+
+    def run(prefetch_buffer):
+        net = _mlp(seed=11)
+        pw = (ParallelWrapper.Builder(net).workers(8)
+              .prefetchBuffer(prefetch_buffer).build())
+        pw.fit(ArrayDataSetIterator(X, Y, 32), epochs=2)
+        return net
+
+    staged = run(2)      # background mesh staging (_StagedShards path)
+    plain = run(0)       # synchronous host prep + device_put
+    _assert_trees_equal(_params(staged), _params(plain))
+    assert isinstance(staged.score(), float)
+
+
+def test_sharded_trainer_prefetch_batches(devices8):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deeplearning4j_tpu.parallel import DeviceMesh, ShardedTrainer
+    mesh = DeviceMesh(devices8, dp=8).mesh
+    rng = np.random.default_rng(1)
+    params = {"W": rng.standard_normal((8, 2)).astype(np.float32) * 0.1}
+    specs = {"W": NamedSharding(mesh, P())}
+
+    def loss_fn(p, batch, rng_):
+        x, y = batch
+        logp = jax.nn.log_softmax(x @ p["W"], -1)
+        return -jnp.mean(jnp.sum(y * logp, -1))
+
+    def batches():
+        r = np.random.default_rng(3)
+        return [(r.standard_normal((16, 8)).astype(np.float32),
+                 np.eye(2, dtype=np.float32)[r.integers(0, 2, 16)])
+                for _ in range(10)]
+
+    def run(prefetched):
+        tr = ShardedTrainer(loss_fn, Adam(0.05), mesh, specs, donate=False)
+        p, s = tr.init(dict(params))
+        key = jax.random.PRNGKey(0)
+        src = (tr.prefetch_batches(batches(), depth=2) if prefetched
+               else [tr.shard_batch(b) for b in batches()])
+        losses = []
+        for i, b in enumerate(src):
+            p, s, l = tr.fit_batch(p, s, b, jax.random.fold_in(key, i))
+            losses.append(float(l))
+        return p, losses
+
+    p1, l1 = run(True)
+    p2, l2 = run(False)
+    _assert_trees_equal(_params_tree(p1), _params_tree(p2))
+    np.testing.assert_array_equal(l1, l2)
+    assert l1[-1] < l1[0]
+
+
+def _params_tree(p):
+    return jax.tree_util.tree_map(np.asarray, p)
+
+
+# -- fault-tolerant trainer interplay ---------------------------------------
+def test_ftt_kill_resume_bit_identical_with_prefetch(tmp_path):
+    """Acceptance: kill/resume stays bit-identical with the staging
+    prefetcher enabled (consumption counted at the source, before the
+    prefetch queue)."""
+    from deeplearning4j_tpu.resilience import FatalTrainingError, FaultPlan
+    from deeplearning4j_tpu.resilience import faults
+    from deeplearning4j_tpu.resilience.trainer import FaultTolerantTrainer
+    X, Y = _data(120, seed=0)
+
+    def it():
+        return ArrayDataSetIterator(X, Y, 8)   # 15 batches/epoch
+
+    # uninterrupted reference WITHOUT prefetch
+    ref_tr = FaultTolerantTrainer(_net(), tmp_path / "ref", save_every=10,
+                                  prefetch=0)
+    ref = _params(ref_tr.fit(it(), epochs=2))
+    ref_tr.close()
+
+    plan = FaultPlan(seed=7).fail_at(
+        faults.TRAIN_DISPATCH, 17,
+        exc=lambda s, n: FatalTrainingError(f"kill at {s}#{n}"))
+    t1 = FaultTolerantTrainer(_net(), tmp_path / "ckpt", save_every=10,
+                              prefetch=2)
+    with plan:
+        with pytest.raises(FatalTrainingError):
+            t1.fit(it(), epochs=2)
+    t1.close()
+
+    t2 = FaultTolerantTrainer(_net(), tmp_path / "ckpt", save_every=10,
+                              prefetch=2)
+    with plan:
+        m2 = t2.fit(it(), epochs=2)
+    assert t2.resumed_step == 10
+    _assert_trees_equal(ref, _params(m2))
+    t2.close()
+
+
+def test_ftt_loader_error_skip_counts_and_continues_with_prefetch(tmp_path):
+    """A transient loader error kills the prefetch worker mid-epoch; FTT
+    must count ONE data_error skip and train the REST of the epoch —
+    same skip-and-count semantics as the unprefetched path, not an
+    epoch abort (and not an infinite re-raise loop)."""
+    from deeplearning4j_tpu.resilience import TransientError
+    from deeplearning4j_tpu.resilience.trainer import FaultTolerantTrainer
+    X, Y = _data(80, seed=3)
+
+    class Failing(ArrayDataSetIterator):
+        def next(self, num=None):
+            if self._cursor == 40:     # batch 5 is lost mid-pull
+                self._cursor += 8
+                raise TransientError("loader hiccup")
+            return super().next(num)
+
+    t = FaultTolerantTrainer(_net(), tmp_path / "hiccup", save_every=100,
+                             prefetch=2)
+    m = t.fit(Failing(X, Y, 8), epochs=1)
+    assert t.skipped == 1              # counted once, not forever
+    assert m.getIterationCount() == 9  # ALL other batches trained
+    t.close()
+
+    # an ALREADY-wrapped async iterator (pf is None inside FTT) must get
+    # the same one-skip-and-continue treatment, not re-raise forever
+    from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
+    t3 = FaultTolerantTrainer(_net(), tmp_path / "prewrapped",
+                              save_every=100, prefetch=2)
+    m3 = t3.fit(AsyncDataSetIterator(Failing(X, Y, 8)), epochs=1)
+    assert t3.skipped == 1
+    assert m3.getIterationCount() == 9
+    t3.close()
+
+    # a permanently broken loader is still bounded, exactly as before
+    from deeplearning4j_tpu.resilience import FatalTrainingError
+
+    class AlwaysFailing(ArrayDataSetIterator):
+        def next(self, num=None):
+            if self._cursor >= 16:
+                raise TransientError("loader dead")
+            return super().next(num)
+
+    t2 = FaultTolerantTrainer(_net(), tmp_path / "dead", save_every=100,
+                              prefetch=2, max_skipped_batches=3)
+    with pytest.raises(FatalTrainingError, match="skipped"):
+        t2.fit(AlwaysFailing(X, Y, 8), epochs=1)
+    t2.close()
+
+
+def test_ftt_skips_non_finite_via_host_verdict(tmp_path):
+    """The staged-batch finite check happens on the host, pre-staging —
+    the skip still fires and counts with prefetch enabled."""
+    from deeplearning4j_tpu.resilience.trainer import FaultTolerantTrainer
+    X, Y = _data(80, seed=2)
+    X[24] = np.nan    # batch 3 (batch size 8)
+    t = FaultTolerantTrainer(_net(), tmp_path / "nf", save_every=100,
+                             prefetch=2)
+    t.fit(ArrayDataSetIterator(X, Y, 8), epochs=1)
+    assert t.skipped == 1
+    t.close()
+
+
+# -- overlap microbench (committed check; excluded from tier-1 timing) ------
+@pytest.mark.slow
+def test_pipeline_overlap_speedup():
+    import bench_pipeline
+    # io_ms auto-calibrates to this host's step time (ideal win ~2x)
+    result = bench_pipeline.run(steps=30, warmup=4)
+    assert result["speedup"] >= 1.2, result
