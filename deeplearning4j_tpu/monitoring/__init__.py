@@ -22,16 +22,29 @@ or explicitly:
 
 Scope split across the repo's three observability layers:
 - monitoring (this package) — HOST-side: where did the step's wall time
-  go (data-iter / dispatch / listeners / eval / checkpoint spans), jit
-  compile events, transfer bytes, device memory gauges;
-- `optimize/listeners.ProfilerListener` + `optimize/xplane.py` —
-  DEVICE-side: the XLA per-op trace (xplane.pb);
+  go (data-iter / stage / dispatch / listeners / eval / checkpoint
+  spans), jit compile events, transfer bytes, the step-time attribution
+  flight recorder (`steps.py`, `GET /steps`), and device memory
+  telemetry + OOM forensics (`memory.py`);
+- `profiler.ProfileSession` + `optimize/xplane.py` — DEVICE-side: an
+  on-demand jax.profiler window over the next k steps decoded to a
+  per-op self-time/FLOPs/bytes table (`profile_next_steps(k)` /
+  `POST /profile?steps=k`; subsumes the old ProfilerListener window);
 - `ui/stats.StatsListener` — LEARNING diagnostics: score curves, update
   ratios, activation histograms.
 """
 from __future__ import annotations
 
 from deeplearning4j_tpu.monitoring.state import STATE
+from deeplearning4j_tpu.monitoring import memory  # noqa: F401
+from deeplearning4j_tpu.monitoring import profiler  # noqa: F401
+from deeplearning4j_tpu.monitoring import steps  # noqa: F401
+from deeplearning4j_tpu.monitoring.memory import (  # noqa: F401
+    MemoryMonitor)
+from deeplearning4j_tpu.monitoring.profiler import (  # noqa: F401
+    ProfileSession, last_report, profile_next_steps)
+from deeplearning4j_tpu.monitoring.steps import (  # noqa: F401
+    StepRecorder, recorder as step_recorder)
 from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry,
     JIT_CACHE_MISSES, JIT_COMPILE_SECONDS, OP_DISPATCHES,
@@ -45,6 +58,10 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     RESILIENCE_COLLECTOR_RESTARTS,
     PIPELINE_SYNCS, PIPELINE_HOST_BLOCKED_MS, PIPELINE_PREFETCH_DEPTH,
     PIPELINE_STAGED_BATCHES,
+    PROFILE_SESSIONS, PROFILE_CAPTURED_STEPS, PROFILE_DEVICE_MS,
+    PROFILE_OP_MS, PROFILE_OP_COUNT,
+    STEP_WALL_MS, STEP_PHASE_MS,
+    MODEL_PARAMS_BYTES, MODEL_OPT_STATE_BYTES, MODEL_LAYER_STATE_BYTES,
     bootstrap_core_metrics, collect_device_memory, get_registry,
     record_transfer)
 from deeplearning4j_tpu.monitoring.tracing import (  # noqa: F401
@@ -56,6 +73,14 @@ __all__ = [
     "export_chrome_trace", "get_tracer", "get_registry",
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "Tracer",
     "bootstrap_core_metrics", "collect_device_memory", "record_transfer",
+    "memory", "profiler", "steps",
+    "MemoryMonitor", "ProfileSession", "StepRecorder",
+    "last_report", "profile_next_steps", "step_recorder",
+    "PROFILE_SESSIONS", "PROFILE_CAPTURED_STEPS", "PROFILE_DEVICE_MS",
+    "PROFILE_OP_MS", "PROFILE_OP_COUNT",
+    "STEP_WALL_MS", "STEP_PHASE_MS",
+    "MODEL_PARAMS_BYTES", "MODEL_OPT_STATE_BYTES",
+    "MODEL_LAYER_STATE_BYTES",
     "JIT_CACHE_MISSES", "JIT_COMPILE_SECONDS", "OP_DISPATCHES",
     "TRANSFER_H2D_BYTES", "DEVICE_MEMORY_BYTES",
     "DEVICE_MEMORY_SUPPORTED", "HOST_RSS_BYTES",

@@ -1,0 +1,147 @@
+"""Device memory telemetry: HBM gauges, live-tree footprint estimates,
+and the OOM forensics snapshot.
+
+Three consumers:
+- **periodic gauges** — `sample()` refreshes the per-device
+  `dl4j.device.memory_bytes` gauges (bytes-in-use / peak / limit, from
+  `device.memory_stats()`; TPU/GPU backends — CPU says "unsupported"
+  instead of inventing numbers) plus `dl4j.model.*_bytes` footprint
+  estimates from a live model's param/optimizer/state trees.
+  `MetricsListener(deviceMemoryFrequency=N)` calls it every N
+  iterations; `MemoryMonitor` runs it on a background thread for
+  serving processes that have no training loop to piggyback on.
+- **OOM forensics** — every `sample()` keeps its reading in
+  `last_sample()`; when an XLA RESOURCE_EXHAUSTED escapes,
+  `util/crash_reporting.py` embeds that LAST-KNOWN-GOOD reading in the
+  dump, which is forensically more useful than the post-mortem query
+  (after the OOM the allocator has often already unwound, so "bytes in
+  use at death" under-reports the spike that killed the run).
+- **capacity planning** — `footprint(model)` alone answers "how much
+  HBM do the params + optimizer state pin" before a run is launched.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from deeplearning4j_tpu.monitoring import registry as _registry
+from deeplearning4j_tpu.monitoring.state import STATE
+
+__all__ = ["MemoryMonitor", "device_memory_stats", "footprint",
+           "last_sample", "sample"]
+
+_lock = threading.Lock()
+_last_sample = None
+
+
+def device_memory_stats():
+    """{device_str: stats_dict_or_None} from `device.memory_stats()` over
+    the local devices. Never raises — backends without the API (CPU)
+    report None."""
+    out = {}
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — no backend yet / init failure
+        return out
+    for d in devices:
+        try:
+            fn = getattr(d, "memory_stats", None)
+            out[str(d)] = fn() if fn is not None else None
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            out[str(d)] = None
+    return out
+
+
+def _tree_bytes(tree):
+    import numpy as np
+
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            total += int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+    return total
+
+
+def footprint(model):
+    """Byte estimates from the LIVE trees of a network / trainer-shaped
+    object: {"params_bytes", "opt_state_bytes", "layer_state_bytes"}.
+    Missing trees report 0 (e.g. an un-init()ed net)."""
+    return {
+        "params_bytes": _tree_bytes(getattr(model, "_params", None)),
+        "opt_state_bytes": _tree_bytes(getattr(model, "_opt_state", None)),
+        "layer_state_bytes": _tree_bytes(getattr(model, "_state", None)),
+    }
+
+
+def sample(registry=None, model=None):
+    """One telemetry reading: refresh the device-memory + host-RSS gauges
+    (via `registry.collect_device_memory`), add model footprint gauges
+    when a model is given, and retain the reading for OOM forensics.
+    Returns the snapshot dict."""
+    reg = registry if registry is not None else _registry.get_registry()
+    snap = {"ts": time.time(), "devices": device_memory_stats()}
+    _registry.collect_device_memory(reg, device_stats=snap["devices"])
+    if model is not None:
+        fp = footprint(model)
+        snap["model"] = fp
+        reg.gauge(_registry.MODEL_PARAMS_BYTES,
+                  help="bytes pinned by the live parameter tree") \
+           .set(fp["params_bytes"])
+        reg.gauge(_registry.MODEL_OPT_STATE_BYTES,
+                  help="bytes pinned by the live optimizer state") \
+           .set(fp["opt_state_bytes"])
+        reg.gauge(_registry.MODEL_LAYER_STATE_BYTES,
+                  help="bytes pinned by layer state (BN stats, ...)") \
+           .set(fp["layer_state_bytes"])
+    global _last_sample
+    with _lock:
+        _last_sample = snap
+    return snap
+
+
+def last_sample():
+    """The most recent `sample()` reading (None before the first) — the
+    OOM forensics hook crash_reporting embeds in memory crash dumps."""
+    with _lock:
+        return _last_sample
+
+
+class MemoryMonitor:
+    """Background periodic `sample()` for processes without a training
+    loop (serving, notebooks): `MemoryMonitor(interval_s=10).start()`.
+    Samples only while monitoring is enabled — a running monitor on a
+    disabled registry costs one flag check per interval."""
+
+    def __init__(self, interval_s=10.0, registry=None, model=None):
+        self.interval_s = float(interval_s)
+        self.registry = registry
+        self.model = model
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dl4j-memory-monitor")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            if not STATE.enabled:
+                continue
+            try:
+                sample(self.registry, self.model)
+            except Exception:  # noqa: BLE001 — telemetry must never die
+                pass
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+        return self

@@ -1,0 +1,334 @@
+"""On-demand XLA profiling sessions (ProfileSession).
+
+The device-side complement of the host metrics/span layer: arm a
+session, run training, and the next k steps are captured with
+`jax.profiler.trace`; the resulting xplane.pb is decoded by
+`optimize/xplane.py` (no TensorBoard dependency) into a per-op cost
+table — self-time, category/FLOPs rollups, memory movers — published
+three ways:
+
+- programmatic: `session = profile_next_steps(3)` ... `session.report`
+  (dict) / `session.render()` (text) / `last_report()`;
+- HTTP: `POST /profile?steps=k` on the UI server arms one,
+  `GET /profile` returns the latest report JSON (the dashboard's
+  "Device profile" tab renders it);
+- metrics: `dl4j.profile.*` (sessions, captured steps, device ms, and
+  per-op gauges for the top ops).
+
+Cost model: ZERO when disarmed — every trainer hook is one module-level
+`ACTIVE is not None` branch (the `resilience/faults.py` pattern), so an
+uninstrumented `fit()` pays a single pointer compare per step. While a
+session IS armed, `jax.profiler` tracing costs whatever XLA charges for
+the window (that's the point: profiling is a scoped decision, not an
+always-on tax).
+
+This subsumes the old `optimize.listeners.ProfilerListener` trace-window
+duty: the listener remains as a thin compatibility shim that arms a
+ProfileSession from its `iterationDone` cadence.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+
+from deeplearning4j_tpu.monitoring import registry as _registry
+
+__all__ = ["ProfileSession", "active_session", "last_report",
+           "last_session", "profile_next_steps"]
+
+#: the armed session, or None (the one-branch trainer fast path:
+#: `if _prof.ACTIVE is not None: _prof.ACTIVE.step_start()`)
+ACTIVE = None
+
+_lock = threading.Lock()
+_last_session = None
+
+
+class ProfileSession:
+    """One profiling window over k training steps.
+
+    Lifecycle: armed → tracing → done (or failed). Trainers drive it
+    through two hooks at each step boundary: `step_start()` (starts the
+    jax.profiler trace on the first step after arming, so the window
+    always covers WHOLE steps) and `step_end()` (counts captured steps;
+    on the k-th, stops the trace, decodes it, and publishes the report).
+    `finish()` force-closes a window the loop abandoned early (fit
+    raised / iterator exhausted); re-arming via `profile_next_steps()`
+    calls it on a still-tracing predecessor so `jax.profiler` is never
+    double-started. A window that outlives one `fit()` simply keeps
+    capturing the next trainer's steps — that is the contract ("the
+    next k steps of whatever runs next")."""
+
+    def __init__(self, steps=None, trace_dir=None, device_substr=None,
+                 top=25, registry=None, keep_trace=None):
+        if steps is None:   # DL4J_PROFILE_STEPS sets the default window
+            try:
+                steps = int(os.environ.get("DL4J_PROFILE_STEPS", "3"))
+            except ValueError:
+                steps = 3
+        self.steps = max(1, int(steps))
+        # the temp dir is created LAZILY in _begin(): an armed-but-
+        # replaced (or never-run) session must not leak an empty
+        # dl4j-profile-* directory per POST /profile
+        self._own_trace_dir = trace_dir is None
+        self.trace_dir = str(trace_dir) if trace_dir is not None else None
+        # None → auto: prefer the TPU/GPU device plane, fall back to the
+        # host-thread planes CPU traces use
+        self.device_substr = device_substr
+        self.top = int(top)
+        self.registry = registry
+        self.keep_trace = (not self._own_trace_dir if keep_trace is None
+                           else bool(keep_trace))
+        self.state = "armed"
+        self.captured_steps = 0
+        self.report = None
+        self.error = None
+        self._t_begin = None
+        # serializes the armed→tracing→done/failed transitions: the
+        # trainer thread (k-th step_end) and an HTTP re-arm thread
+        # (profile_next_steps → finish) can both reach _end(); only one
+        # may stop the trace and publish
+        self._window_lock = threading.Lock()
+
+    # -- trainer hooks (hot path only while armed) -----------------------
+    def step_start(self):
+        if self.state == "armed":
+            self._begin()
+
+    def step_end(self):
+        if self.state != "tracing":
+            return
+        self.captured_steps += 1
+        if self.captured_steps >= self.steps:
+            self._end()
+
+    # -- window control ---------------------------------------------------
+    def begin(self):
+        """Manually open the trace window (listener-driven mode —
+        optimize.listeners.ProfilerListener; the armed/global mode uses
+        step_start instead)."""
+        if self.state == "armed":
+            self._begin()
+        return self
+
+    def end(self):
+        """Manually close the window: stop the trace, decode the xplane,
+        publish the report/metrics."""
+        if self.state == "tracing":
+            self._end()
+        return self
+
+    def _begin(self):
+        import jax
+        with self._window_lock:
+            if self.state != "armed":   # lost the race to another opener
+                return
+            try:
+                if self.trace_dir is None:
+                    self.trace_dir = tempfile.mkdtemp(
+                        prefix="dl4j-profile-")
+                jax.profiler.start_trace(self.trace_dir)
+            except Exception as e:  # noqa: BLE001 — must not kill fit
+                self.state, self.error = "failed", f"start_trace: {e}"
+            else:
+                self._t_begin = time.perf_counter()
+                self.state = "tracing"
+                return
+        _deactivate(self)
+        if self._own_trace_dir and not self.keep_trace:
+            self._cleanup_trace()
+
+    def _end(self):
+        import jax
+        with self._window_lock:
+            if self.state != "tracing":   # another thread closed it first
+                return
+            wall_ms = (time.perf_counter() - self._t_begin) * 1e3 \
+                if self._t_begin else None
+            try:
+                # flush queued device work so the trace contains the
+                # whole k-th step, then stop
+                from deeplearning4j_tpu.runtime.executioner import \
+                    OpExecutioner
+                OpExecutioner.getInstance().commit()
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001
+                self.state, self.error = "failed", f"stop_trace: {e}"
+            else:
+                try:
+                    self.report = self._build_report(wall_ms)
+                    self.state = "done"
+                    self._publish_metrics()
+                except Exception as e:  # noqa: BLE001 — a decode bug
+                    self.state = "failed"        # must not kill fit
+                    self.error = f"decode: {e}"
+        _deactivate(self)
+        if self._own_trace_dir and not self.keep_trace:
+            self._cleanup_trace()
+
+    def finish(self):
+        """Force-close: stop a still-open trace window and build the
+        report from however many steps were captured. No-op unless
+        armed or tracing."""
+        with self._window_lock:
+            never_ran = self.state == "armed"
+            if never_ran:
+                # never saw a step: nothing to decode
+                self.state = "failed"
+                self.error = "no steps ran while armed"
+        if never_ran:
+            _deactivate(self)
+            return
+        self._end()   # no-op unless tracing (checked under the lock)
+
+    # -- decoding ---------------------------------------------------------
+    def _build_report(self, wall_ms):
+        from deeplearning4j_tpu.optimize import xplane
+        if self.device_substr is not None:
+            candidates = [self.device_substr]
+        else:
+            candidates = ["TPU", "GPU", ""]
+        rows, used, lines = [], "", []
+        for sub in candidates:
+            # one decode per candidate plane; both tables derive from it
+            lines = xplane.collect_lines(self.trace_dir,
+                                         device_substr=sub)
+            rows = xplane.op_table(self.trace_dir, lines=lines)
+            if rows:
+                used = sub
+                break
+        memory = xplane.memory_breakdown(self.trace_dir, lines=lines)
+        report = {
+            "steps": self.captured_steps,
+            "wall_ms": wall_ms,
+            "trace_dir": self.trace_dir if self.keep_trace else None,
+            "device_substr": used,
+            "device_self_ms": sum(r["self_ms"] for r in rows),
+            "op_count": len(rows),
+            "ops": rows[:self.top],
+            "categories": xplane.category_rollup(rows),
+            "memory": [{"name": n, "total_ms": ms, "bytes_accessed": b,
+                        "gb_per_s": gbps}
+                       for n, ms, b, gbps in memory[:self.top]],
+            "ts": time.time(),
+        }
+        return report
+
+    def _publish_metrics(self):
+        reg = self.registry if self.registry is not None \
+            else _registry.get_registry()
+        reg.counter(_registry.PROFILE_SESSIONS,
+                    help="completed ProfileSession windows").inc()
+        reg.gauge(_registry.PROFILE_CAPTURED_STEPS,
+                  help="steps captured by the last profile window") \
+           .set(self.captured_steps)
+        reg.gauge(_registry.PROFILE_DEVICE_MS,
+                  help="device self time decoded from the last profile "
+                       "window").set(self.report["device_self_ms"])
+        for r in self.report["ops"][:10]:
+            labels = {"op": r["name"][:80]}
+            reg.gauge(_registry.PROFILE_OP_MS, labels=labels,
+                      help="per-op self ms from the last profile window") \
+               .set(r["self_ms"])
+            reg.gauge(_registry.PROFILE_OP_COUNT, labels=labels,
+                      help="per-op occurrences in the last profile "
+                           "window").set(r["count"])
+
+    def _cleanup_trace(self):
+        if self.trace_dir is None:
+            return
+        import shutil
+        try:
+            shutil.rmtree(self.trace_dir, ignore_errors=True)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- presentation -----------------------------------------------------
+    def render(self, top=None):
+        """Text report (top-K ops + category rollup + memory movers)."""
+        if self.report is None:
+            return f"<ProfileSession {self.state}" + \
+                (f": {self.error}>" if self.error else ">")
+        from deeplearning4j_tpu.optimize import xplane
+        mem = [(m["name"], m["total_ms"], m["bytes_accessed"],
+                m["gb_per_s"]) for m in self.report["memory"]]
+        head = (f"ProfileSession: {self.report['steps']} steps, "
+                f"{self.report['wall_ms']:.1f} ms wall\n"
+                if self.report.get("wall_ms") else "")
+        return head + xplane.render_report(self.report["ops"], mem,
+                                           top=top or self.top)
+
+    def to_json(self):
+        return json.dumps({"state": self.state, "error": self.error,
+                           "report": self.report})
+
+
+def _deactivate(session):
+    global ACTIVE, _last_session
+    with _lock:
+        if ACTIVE is session:
+            ACTIVE = None
+        # a session that failed before its window ever OPENED carries no
+        # report — don't let it clobber a real one in last_report() /
+        # GET /profile (e.g. a ProfilerListener whose start_trace lost to
+        # an already-open global window)
+        if (session._t_begin is None and session.report is None
+                and _last_session is not None
+                and _last_session.report is not None):
+            return
+        _last_session = session
+
+
+def profile_next_steps(steps=None, **kwargs):
+    """Arm a ProfileSession over the next `steps` training steps of
+    WHATEVER trainer runs next (MultiLayerNetwork/ComputationGraph fit,
+    ParallelWrapper, ShardedTrainer). Returns the session; its `.report`
+    appears once the window closes. Re-arming replaces a still-armed
+    session (an in-flight tracing window is finished first so
+    jax.profiler isn't double-started)."""
+    global ACTIVE
+    with _lock:
+        prev = ACTIVE
+    if prev is not None:
+        # unconditionally: a still-"armed" predecessor may be racing a
+        # trainer thread through step_start — finish() marks it failed
+        # under its window lock, so that in-flight _begin becomes a
+        # no-op instead of opening a trace nothing will ever close
+        prev.finish()
+    session = ProfileSession(steps=steps, **kwargs)
+    with _lock:
+        ACTIVE = session
+    return session
+
+
+def active_session():
+    return ACTIVE
+
+
+def last_session():
+    """The most recently completed (or failed) session."""
+    with _lock:
+        return _last_session
+
+
+def last_report():
+    s = last_session()
+    return None if s is None else s.report
+
+
+def status():
+    """JSON-able status for GET /profile: the armed session (if any) and
+    the last completed report."""
+    with _lock:
+        active, last = ACTIVE, _last_session
+    out = {"active": None, "last": None}
+    if active is not None:
+        out["active"] = {"state": active.state, "steps": active.steps,
+                         "captured_steps": active.captured_steps}
+    if last is not None:
+        out["last"] = {"state": last.state, "error": last.error,
+                       "report": last.report}
+    return out

@@ -62,6 +62,24 @@ PIPELINE_HOST_BLOCKED_MS = "dl4j.pipeline.host_blocked_ms"
 PIPELINE_PREFETCH_DEPTH = "dl4j.pipeline.prefetch_depth"
 PIPELINE_STAGED_BATCHES = "dl4j.pipeline.staged_batches"
 
+# device profiling (monitoring/profiler.py ProfileSession): one on-demand
+# jax.profiler window around k training steps, rolled up to a per-op table
+PROFILE_SESSIONS = "dl4j.profile.sessions"
+PROFILE_CAPTURED_STEPS = "dl4j.profile.captured_steps"
+PROFILE_DEVICE_MS = "dl4j.profile.device_ms"
+PROFILE_OP_MS = "dl4j.profile.op_ms"
+PROFILE_OP_COUNT = "dl4j.profile.op_count"
+
+# step-time attribution flight recorder (monitoring/steps.py)
+STEP_WALL_MS = "dl4j.step.wall_ms"
+STEP_PHASE_MS = "dl4j.step.phase_ms"
+
+# model memory footprint estimates from the live trees
+# (monitoring/memory.py)
+MODEL_PARAMS_BYTES = "dl4j.model.params_bytes"
+MODEL_OPT_STATE_BYTES = "dl4j.model.opt_state_bytes"
+MODEL_LAYER_STATE_BYTES = "dl4j.model.layer_state_bytes"
+
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _LABEL_RE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -320,20 +338,21 @@ def record_transfer(nbytes, registry=None):
         help="bytes explicitly placed host-to-device").inc(int(nbytes))
 
 
-def collect_device_memory(registry=None):
+def collect_device_memory(registry=None, device_stats=None):
     """Per-device memory gauges from `device.memory_stats()` (TPU/GPU
     backends; CPU returns None → the `supported 0` gauge says so instead
-    of inventing numbers), plus the host RSS from /proc."""
+    of inventing numbers), plus the host RSS from /proc.
+
+    `device_stats` lets a caller that already holds a
+    `{device_str: stats_or_None}` snapshot (monitoring.memory.sample)
+    feed the gauges without a second memory_stats sweep; when omitted,
+    the LOCAL devices are queried — this process can only meaningfully
+    gauge the chips it owns."""
     reg = registry or _global_registry
-    import jax
-    for d in jax.devices():
-        dev = str(d)
-        stats = None
-        try:
-            fn = getattr(d, "memory_stats", None)
-            stats = fn() if fn is not None else None
-        except Exception:   # noqa: BLE001 — metrics must never raise
-            stats = None
+    if device_stats is None:
+        from deeplearning4j_tpu.monitoring.memory import device_memory_stats
+        device_stats = device_memory_stats()
+    for dev, stats in device_stats.items():
         reg.gauge(DEVICE_MEMORY_SUPPORTED, labels={"device": dev},
                   help="1 when the backend exposes memory_stats()") \
            .set(0.0 if not stats else 1.0)

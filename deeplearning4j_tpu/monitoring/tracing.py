@@ -20,6 +20,7 @@ import threading
 import time
 
 from deeplearning4j_tpu.monitoring.state import STATE
+from deeplearning4j_tpu.monitoring import steps as _steps
 
 
 class _NullSpan:
@@ -72,6 +73,7 @@ class Tracer:
         self._dropped = 0
         self._epoch_ns = time.perf_counter_ns()
         self._local = threading.local()
+        self._pid = os.getpid()   # constant; skip the syscall per record
 
     def _ensure_local(self):
         if not hasattr(self._local, "stack"):
@@ -88,7 +90,7 @@ class Tracer:
             "ph": "X",
             "ts": (t0_ns - self._epoch_ns) / 1e3,      # microseconds
             "dur": (t1_ns - t0_ns) / 1e3,
-            "pid": os.getpid(),
+            "pid": self._pid,
             "tid": threading.get_ident(),
         }
         args = dict(span.args) if span.args else {}
@@ -101,6 +103,11 @@ class Tracer:
                 self._events.append(ev)
             else:
                 self._dropped += 1
+        # feed the step-attribution flight recorder (monitoring/steps.py):
+        # reached only when monitoring is enabled (disabled spans are the
+        # shared NULL_SPAN and never get here), and on_span is one dict
+        # lookup for spans it doesn't track
+        _steps.recorder().on_span(span.name, (t1_ns - t0_ns) / 1e6)
 
     def current_stack(self):
         """The CALLING thread's open-span stack, outermost first (what

@@ -15,6 +15,7 @@ import numpy as np
 import optax
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.monitoring import profiler as _prof
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.runtime import pipeline as _pipeline
 from deeplearning4j_tpu.util.crash_reporting import \
@@ -429,13 +430,19 @@ class ComputationGraph:
         raise TypeError(f"Cannot fit on {type(ds)}")
 
     def _fit_batch(self, ds):
-        self._fit_unpacked(self._unpack(ds))
+        with _mon.span("train.stage"):
+            unpacked = self._unpack(ds)
+        self._fit_unpacked(unpacked)
 
     def _fit_unpacked(self, unpacked):
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_start()
         ins, labels, fmasks, lmasks = unpacked
-        self._rng_key, sub = jax.random.split(self._rng_key)
+        with _mon.span("train.stage"):
+            self._rng_key, sub = jax.random.split(self._rng_key)
         with _mon.span("train.dispatch"):
             self._params, self._opt_state, self._state, loss = \
                 self._train_step(
@@ -448,6 +455,9 @@ class ComputationGraph:
         with _mon.span("train.listeners"):
             for listener in self._listeners:
                 listener.iterationDone(self, self._iteration, self._epoch)
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_end()
 
     @functools.cached_property
     def _train_scan(self):
@@ -485,13 +495,17 @@ class ComputationGraph:
         distinct scan length is a fresh compile)."""
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
-        subs = []
-        for _ in unpacked:  # identical key stream to sequential _fit_batch
-            self._rng_key, sub = jax.random.split(self._rng_key)
-            subs.append(sub)
-        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
-                                         *unpacked)
-        ins, labels, fmasks, lmasks = stacked
+        _ps = _prof.ACTIVE             # armed ProfileSession: the whole
+        if _ps is not None:            # scanned dispatch is one "step"
+            _ps.step_start()
+        with _mon.span("train.stage"):
+            subs = []
+            for _ in unpacked:  # identical key stream to _fit_batch
+                self._rng_key, sub = jax.random.split(self._rng_key)
+                subs.append(sub)
+            stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                             *unpacked)
+            ins, labels, fmasks, lmasks = stacked
         with _mon.span("train.scan_dispatch"):
             (self._params, self._opt_state, self._state,
              losses) = self._train_scan(self._params, self._opt_state,
@@ -512,6 +526,9 @@ class ComputationGraph:
             else:
                 self._score = losses[len(unpacked) - 1]
                 self._iteration += len(unpacked)
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_end()
 
     @staticmethod
     def _batch_sig(unpacked_or_ds):

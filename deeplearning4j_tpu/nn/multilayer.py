@@ -18,6 +18,7 @@ import numpy as np
 import optax
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.monitoring import profiler as _prof
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.runtime import pipeline as _pipeline
 from deeplearning4j_tpu.util.crash_reporting import \
@@ -454,16 +455,22 @@ class MultiLayerNetwork:
         so lax.scan is traced for exactly one length per batch shape."""
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
-        subs = []
-        for _ in group:   # identical key stream to sequential _fit_batch
-            self._rng_key, sub = jax.random.split(self._rng_key)
-            subs.append(sub)
-        xs = jnp.stack([jnp.asarray(f) for f, _, _, _ in group])
-        ys = jnp.stack([jnp.asarray(l) for _, l, _, _ in group])
-        lms = (None if group[0][2] is None
-               else jnp.stack([jnp.asarray(m) for _, _, m, _ in group]))
-        fms = (None if group[0][3] is None
-               else jnp.stack([jnp.asarray(m) for _, _, _, m in group]))
+        _ps = _prof.ACTIVE             # armed ProfileSession: the whole
+        if _ps is not None:            # scanned dispatch is one "step"
+            _ps.step_start()
+        with _mon.span("train.stage"):
+            subs = []
+            for _ in group:   # identical key stream to seq _fit_batch
+                self._rng_key, sub = jax.random.split(self._rng_key)
+                subs.append(sub)
+            xs = jnp.stack([jnp.asarray(f) for f, _, _, _ in group])
+            ys = jnp.stack([jnp.asarray(l) for _, l, _, _ in group])
+            lms = (None if group[0][2] is None
+                   else jnp.stack([jnp.asarray(m)
+                                   for _, _, m, _ in group]))
+            fms = (None if group[0][3] is None
+                   else jnp.stack([jnp.asarray(m)
+                                   for _, _, _, m in group]))
         with _mon.span("train.scan_dispatch"):
             (self._params, self._opt_state, self._state,
              losses) = self._train_scan(self._params, self._opt_state,
@@ -485,6 +492,9 @@ class MultiLayerNetwork:
             else:
                 self._score = losses[len(group) - 1]
                 self._iteration += len(group)
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_end()
 
     @staticmethod
     def _batch_sig(ds):
@@ -528,11 +538,20 @@ class MultiLayerNetwork:
                    features_mask=None):
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
-        x = jnp.asarray(features)
-        y = jnp.asarray(labels)
-        lmask = None if labels_mask is None else jnp.asarray(labels_mask)
-        fmask = None if features_mask is None else jnp.asarray(features_mask)
-        self._rng_key, sub = jax.random.split(self._rng_key)
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_start()
+        # "train.stage": host-side step prep (device placement of the
+        # batch + rng split) — its own attribution phase so the flight
+        # recorder's per-step sum tracks wall time (steps.SUM_PHASES)
+        with _mon.span("train.stage"):
+            x = jnp.asarray(features)
+            y = jnp.asarray(labels)
+            lmask = None if labels_mask is None \
+                else jnp.asarray(labels_mask)
+            fmask = None if features_mask is None \
+                else jnp.asarray(features_mask)
+            self._rng_key, sub = jax.random.split(self._rng_key)
         from deeplearning4j_tpu.nn.conf.builders import BackpropType
         if (self.conf.backprop_type == BackpropType.TruncatedBPTT
                 and x.ndim == 3 and x.shape[1] > self.conf.tbptt_fwd_length):
@@ -571,6 +590,9 @@ class MultiLayerNetwork:
         with _mon.span("train.listeners"):
             for listener in self._listeners:
                 listener.iterationDone(self, self._iteration, self._epoch)
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_end()
 
     # -- layerwise unsupervised pretraining (≡ MultiLayerNetwork.pretrain
     # / pretrainLayer: VAE ELBO, historically RBM contrastive divergence) -

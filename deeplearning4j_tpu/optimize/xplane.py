@@ -131,20 +131,33 @@ def parse_xspace(path, with_stats=False, plane_substr=None):
     return planes
 
 
-def memory_breakdown(trace_dir, device_substr="TPU", line_substr=None):
+def _bytes_accessed(stats):
+    """Largest 'bytes'-ish cost-analysis stat on an event — XLA variously
+    spells it "bytes accessed" / "bytes_accessed" / per-memory-space
+    variants; the op and memory tables must agree on the heuristic."""
+    b = 0
+    for k, v in stats.items():
+        if "bytes" in k.lower() and isinstance(v, int):
+            b = max(b, v)
+    return b
+
+
+def memory_breakdown(trace_dir, device_substr="TPU", line_substr=None,
+                     lines=None):
     """Per-op bytes-accessed table from the XStat cost-analysis metrics:
     [(op_name, total_ms, bytes_accessed, GB_per_s)] sorted by bytes
     descending. Rides the same plane/line selection as op_breakdown; ops
-    with no bytes stat report 0 (fusion roots carry the stat on TPU)."""
+    with no bytes stat report 0 (fusion roots carry the stat on TPU).
+    `lines` (from collect_lines) skips the parse and reuses an
+    already-decoded selection."""
     totals, nbytes = {}, {}
-    for line in _selected_lines(trace_dir, device_substr, line_substr,
-                                with_stats=True):
+    if lines is None:
+        lines = _selected_lines(trace_dir, device_substr, line_substr,
+                                with_stats=True)
+    for line in lines:
         for ev in line["events"]:
             name, dur, stats = ev[0], ev[1], ev[3]
-            b = 0
-            for k, v in stats.items():
-                if "bytes" in k.lower() and isinstance(v, int):
-                    b = max(b, v)
+            b = _bytes_accessed(stats)
             totals[name] = totals.get(name, 0) + dur
             nbytes[name] = nbytes.get(name, 0) + b
     rows = []
@@ -177,6 +190,15 @@ def _selected_lines(trace_dir, device_substr, line_substr, with_stats):
             yield from lines
 
 
+def collect_lines(trace_dir, device_substr="TPU", line_substr=None):
+    """Materialize one stats-bearing plane/line selection so several
+    tables (op_table + memory_breakdown) can be derived from a single
+    decode of the trace — closing a ProfileSession window parses each
+    candidate device plane once instead of once per table."""
+    return list(_selected_lines(trace_dir, device_substr, line_substr,
+                                with_stats=True))
+
+
 def op_breakdown(trace_dir, device_substr="TPU", line_substr=None):
     """Aggregate device-plane op durations across a trace directory.
 
@@ -191,6 +213,153 @@ def op_breakdown(trace_dir, device_substr="TPU", line_substr=None):
     rows = [(n, t / 1e9, counts[n]) for n, t in totals.items()]
     rows.sort(key=lambda r: -r[1])
     return rows
+
+
+def _self_times(events):
+    """Per-event SELF time (duration minus nested children) for one
+    line's [(name, dur_ps, off_ps), ...] events.
+
+    XLA lines nest: a fusion event spans its constituent sub-events, and
+    "total time" double-counts every level. Sweep events in start order
+    (ties: longer first, so parents precede their children) with a
+    containment stack; each event's duration is charged against its
+    nearest enclosing ancestor. Returns self-times aligned with
+    `events`' order."""
+    idx = sorted(range(len(events)),
+                 key=lambda i: (events[i][2], -events[i][1]))
+    selfs = [0] * len(events)
+    stack = []   # (end_ps, original_index) of open ancestors
+    child_total = {}
+    for i in idx:
+        _name, dur, off = events[i][0], events[i][1], events[i][2]
+        while stack and stack[-1][0] <= off:
+            stack.pop()
+        if stack:
+            parent = stack[-1][1]
+            child_total[parent] = child_total.get(parent, 0) + dur
+        stack.append((off + dur, i))
+    for i in range(len(events)):
+        selfs[i] = max(0, events[i][1] - child_total.get(i, 0))
+    return selfs
+
+
+#: substring -> category for ops whose stats carry no explicit category
+_NAME_CATEGORIES = (
+    ("fusion", "fusion"), ("convolution", "convolution"),
+    ("conv", "convolution"), ("dot", "matmul"), ("gemm", "matmul"),
+    ("matmul", "matmul"), ("all-reduce", "collective"),
+    ("all-gather", "collective"), ("reduce-scatter", "collective"),
+    ("collective", "collective"), ("copy", "copy"),
+    ("transpose", "copy"), ("reshape", "copy"), ("broadcast", "copy"),
+    ("reduce", "reduce"), ("scatter", "scatter"), ("gather", "gather"),
+    ("sort", "sort"), ("rng", "rng"), ("infeed", "infeed"),
+    ("outfeed", "outfeed"), ("custom-call", "custom-call"),
+)
+
+
+def _categorize(name, stats):
+    cat = stats.get("category") or stats.get("equation_category")
+    if isinstance(cat, str) and cat:
+        return cat
+    low = name.lower()
+    for sub, cat in _NAME_CATEGORIES:
+        if sub in low:
+            return cat
+    return "other"
+
+
+def op_table(trace_dir, device_substr="TPU", line_substr=None,
+             lines=None):
+    """The full per-op cost table ProfileSession publishes: one row per
+    distinct op name with
+
+        {"name", "total_ms", "self_ms", "count", "category",
+         "flops", "bytes_accessed", "pct"}
+
+    sorted by self_ms descending (`pct` is self_ms share of the summed
+    self time, which — unlike total time — adds to ~100% even with
+    nested fusion events). Plane/line selection matches op_breakdown;
+    `lines` (from collect_lines) skips the parse and reuses an
+    already-decoded selection."""
+    rows = {}
+    total_self = 0
+    if lines is None:
+        lines = _selected_lines(trace_dir, device_substr, line_substr,
+                                with_stats=True)
+    for line in lines:
+        events = line["events"]
+        selfs = _self_times([(e[0], e[1], e[2]) for e in events])
+        for ev, self_ps in zip(events, selfs):
+            name, dur, stats = ev[0], ev[1], ev[3]
+            r = rows.get(name)
+            if r is None:
+                r = rows[name] = {
+                    "name": name, "total_ms": 0.0, "self_ms": 0.0,
+                    "count": 0, "category": _categorize(name, stats),
+                    "flops": 0, "bytes_accessed": 0}
+            r["total_ms"] += dur / 1e9
+            r["self_ms"] += self_ps / 1e9
+            r["count"] += 1
+            total_self += self_ps
+            fl = stats.get("flops")
+            if isinstance(fl, int) and fl > 0:
+                r["flops"] += fl
+            r["bytes_accessed"] += _bytes_accessed(stats)
+    out = sorted(rows.values(), key=lambda r: -r["self_ms"])
+    denom = total_self / 1e9
+    for r in out:
+        r["pct"] = 100.0 * r["self_ms"] / denom if denom > 0 else 0.0
+    return out
+
+
+def category_rollup(rows):
+    """Aggregate an op_table by category:
+    [{"category", "self_ms", "count", "flops", "pct"}], self-time
+    descending."""
+    cats = {}
+    for r in rows:
+        c = cats.setdefault(r["category"],
+                            {"category": r["category"], "self_ms": 0.0,
+                             "count": 0, "flops": 0})
+        c["self_ms"] += r["self_ms"]
+        c["count"] += r["count"]
+        c["flops"] += r["flops"]
+    out = sorted(cats.values(), key=lambda c: -c["self_ms"])
+    total = sum(c["self_ms"] for c in out)
+    for c in out:
+        c["pct"] = 100.0 * c["self_ms"] / total if total > 0 else 0.0
+    return out
+
+
+def render_report(rows, memory_rows=None, top=25):
+    """Text report over an op_table (+ optional memory_breakdown rows):
+    top-K ops by self time, the category rollup, and the top memory
+    movers — the `repr` surface of a ProfileSession and the payload of
+    `print_profile()`."""
+    lines = []
+    total_self = sum(r["self_ms"] for r in rows)
+    lines.append(f"device self time: {total_self:.3f} ms across "
+                 f"{len(rows)} distinct ops")
+    lines.append(f"{'self ms':>10}  {'total ms':>10}  {'%':>5}  "
+                 f"{'count':>6}  {'category':<12} op")
+    for r in rows[:top]:
+        lines.append(f"{r['self_ms']:10.3f}  {r['total_ms']:10.3f}  "
+                     f"{r['pct']:5.1f}  {r['count']:6d}  "
+                     f"{r['category']:<12} {r['name'][:70]}")
+    lines.append("")
+    lines.append("by category:")
+    for c in category_rollup(rows):
+        gflops = c["flops"] / 1e9
+        lines.append(f"{c['self_ms']:10.3f} ms  {c['pct']:5.1f}%  "
+                     f"x{c['count']:<7d} {c['category']:<12}"
+                     + (f"  {gflops:.2f} GFLOP" if gflops else ""))
+    if memory_rows:
+        lines.append("")
+        lines.append("top memory movers (bytes accessed):")
+        for name, ms, b, gbps in memory_rows[:top]:
+            lines.append(f"{b:14,d} B  {ms:9.3f} ms  {gbps:8.1f} GB/s  "
+                         f"{name[:60]}")
+    return "\n".join(lines)
 
 
 def print_breakdown(trace_dir, top=25, device_substr="TPU",

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.parallel.mesh import shard_map
+
 
 def stack_stage_params(per_stage_params):
     """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim."""
@@ -74,7 +76,7 @@ def make_pipeline_fn(stage_fn, mesh, n_microbatches, axis_name="pp"):
     def wrapped(stacked_params, x):
         in_specs = (jax.tree_util.tree_map(lambda _: P(axis_name),
                                            stacked_params), P())
-        return jax.shard_map(pipeline, mesh=mesh,
+        return shard_map(pipeline, mesh=mesh,
                              in_specs=in_specs, out_specs=P(),
                              check_vma=False)(stacked_params, x)
 

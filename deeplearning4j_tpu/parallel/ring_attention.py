@@ -18,6 +18,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from deeplearning4j_tpu.parallel.mesh import shard_map
+
 
 def dense_attention(q, k, v, causal=False, mask=None, scale=None):
     """Reference O(T²) attention (numerics oracle for the sharded paths)."""
@@ -381,6 +383,6 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
     if kv_mask is not None:
         args.append(kv_mask)
         specs.append(P(None, axis_name))
-    shmapped = jax.shard_map(fn, mesh=mesh, in_specs=tuple(specs),
+    shmapped = shard_map(fn, mesh=mesh, in_specs=tuple(specs),
                              out_specs=spec, check_vma=False)
     return shmapped(*args)

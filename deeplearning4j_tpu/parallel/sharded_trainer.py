@@ -26,7 +26,9 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.monitoring import profiler as _prof
 from deeplearning4j_tpu.nn.updaters import Updater
+from deeplearning4j_tpu.parallel.mesh import shard_map
 from deeplearning4j_tpu.resilience import faults as _faults
 
 
@@ -53,13 +55,25 @@ class ShardedTrainer:
 
     # -- placement -------------------------------------------------------
     def shard_params(self, params):
+        """Host leaves go through xla_owned_copy, NOT a bare device_put:
+        the step donates params, and device_put of a suitably-aligned
+        numpy array can zero-copy ALIAS it on this backend — the donating
+        step would then free memory numpy owns (heap corruption that
+        surfaced as nondeterministic garbage losses; same root cause as
+        the runtime/pipeline.py staging hazard)."""
+        from deeplearning4j_tpu.runtime.pipeline import xla_owned_copy
+
+        def put(a, s):
+            sh = s if isinstance(s, NamedSharding) \
+                else NamedSharding(self.mesh, s)
+            if isinstance(a, jax.Array):
+                return jax.device_put(a, sh)
+            return xla_owned_copy(a, sh)
+
         if self.param_specs is None:
-            sh = NamedSharding(self.mesh, P())
-            return jax.device_put(params, sh)
-        return jax.tree_util.tree_map(
-            lambda a, s: jax.device_put(a, s if isinstance(s, NamedSharding)
-                                        else NamedSharding(self.mesh, s)),
-            params, self.param_specs)
+            rep = P()
+            return jax.tree_util.tree_map(lambda a: put(a, rep), params)
+        return jax.tree_util.tree_map(put, params, self.param_specs)
 
     def shard_batch(self, batch, owned=False):
         """dp-shard one batch pytree. owned=True stages host leaves
@@ -123,8 +137,15 @@ class ShardedTrainer:
     def fit_batch(self, params, opt_state, batch, rng):
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_start()
         with _mon.span("sharded.dispatch"):
-            return self.make_step()(params, opt_state, batch, rng)
+            out = self.make_step()(params, opt_state, batch, rng)
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_end()
+        return out
 
 
 class ParameterAveragingTrainer:
@@ -189,7 +210,7 @@ class ParameterAveragingTrainer:
             restack = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
             return restack(p), restack(s), jax.lax.pmean(loss, axis)
 
-        shmapped = jax.shard_map(
+        shmapped = shard_map(
             local_steps, mesh=mesh,
             in_specs=(wspec, wspec, bspec, P(), P()),
             out_specs=(wspec, wspec, P()), check_vma=False)
@@ -199,6 +220,13 @@ class ParameterAveragingTrainer:
     def fit_batch(self, params, opt_state, batch, rng, iteration):
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_start()
         with _mon.span("sharded.dispatch"):
-            return self.make_step()(params, opt_state, batch,
-                                    rng, jnp.asarray(iteration))
+            out = self.make_step()(params, opt_state, batch,
+                                   rng, jnp.asarray(iteration))
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_end()
+        return out

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from deeplearning4j_tpu.parallel.mesh import shard_map
 from deeplearning4j_tpu.parallel.ring_attention import (blockwise_attention,
                                                         dense_attention)
 
@@ -122,11 +123,11 @@ def ulysses_attention_sharded(mesh, q, k, v, mask=None, axis_name="sp",
     fn = make_ulysses_attention(mesh, axis_name, causal=causal,
                                 attn_fn=attn_fn)
     if mask is None:
-        sharded = jax.shard_map(
+        sharded = shard_map(
             lambda a, b, c: fn(a, b, c), mesh=mesh,
             in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
         return sharded(q, k, v)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec, P(None, axis_name)),
         out_specs=spec, check_vma=False)
     return sharded(q, k, v, mask)

@@ -20,6 +20,7 @@ import numpy as np
 import jax
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.monitoring import profiler as _prof
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.runtime import pipeline as _pipeline
@@ -196,19 +197,23 @@ class ParallelWrapper:
         raw (Multi)DataSet or a _StagedShards from the prefetcher."""
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_start()
         is_graph = self._graph_model()
-        if isinstance(ds, _StagedShards):
-            x, y, fmask, lmask = ds.x, ds.y, ds.fmask, ds.lmask
-        else:
-            feats, labs, fm, lm = self._host_prep(ds)
-            x = jax.device_put(feats, self.mesh.sharding("dp"))
-            y = jax.device_put(labs, self.mesh.sharding("dp"))
-            lmask = None if lm is None \
-                else jax.device_put(lm, self.mesh.sharding("dp"))
-            fmask = None if fm is None \
-                else jax.device_put(fm, self.mesh.sharding("dp"))
-        m = self.model
-        m._rng_key, sub = jax.random.split(m._rng_key)
+        with _mon.span("train.stage"):
+            if isinstance(ds, _StagedShards):
+                x, y, fmask, lmask = ds.x, ds.y, ds.fmask, ds.lmask
+            else:
+                feats, labs, fm, lm = self._host_prep(ds)
+                x = jax.device_put(feats, self.mesh.sharding("dp"))
+                y = jax.device_put(labs, self.mesh.sharding("dp"))
+                lmask = None if lm is None \
+                    else jax.device_put(lm, self.mesh.sharding("dp"))
+                fmask = None if fm is None \
+                    else jax.device_put(fm, self.mesh.sharding("dp"))
+            m = self.model
+            m._rng_key, sub = jax.random.split(m._rng_key)
         with _mon.span("parallel.dispatch"):
             if is_graph:
                 # the reference's ParallelWrapper wraps ComputationGraph
@@ -234,6 +239,9 @@ class ParallelWrapper:
         with _mon.span("train.listeners"):
             for listener in m._listeners:
                 listener.iterationDone(m, m._iteration, m._epoch)
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_end()
         return m._score
 
     # -- scanned dispatch (round-5): k same-shape batches in ONE sharded
@@ -257,14 +265,12 @@ class ParallelWrapper:
     def _fit_group_scanned(self, group):
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_start()
         m = self.model
         from jax.sharding import NamedSharding, PartitionSpec as P
         sh2 = NamedSharding(self.mesh.mesh, P(None, "dp"))  # (k, B, ...)
-        subs = []
-        for _ in group:   # identical key stream to the sequential path
-            m._rng_key, sub = jax.random.split(m._rng_key)
-            subs.append(sub)
-
         def stack_put(field):
             arrs = [getattr(ds, field) for ds in group]
             if arrs[0] is None:
@@ -273,8 +279,13 @@ class ParallelWrapper:
             _mon.record_transfer(stacked.nbytes)
             return jax.device_put(stacked, sh2)
 
-        xs, ys = stack_put("features"), stack_put("labels")
-        fms, lms = stack_put("featuresMask"), stack_put("labelsMask")
+        with _mon.span("train.stage"):
+            subs = []
+            for _ in group:   # identical key stream to the seq path
+                m._rng_key, sub = jax.random.split(m._rng_key)
+                subs.append(sub)
+            xs, ys = stack_put("features"), stack_put("labels")
+            fms, lms = stack_put("featuresMask"), stack_put("labelsMask")
         import jax.numpy as jnp
         with _mon.span("parallel.scan_dispatch"):
             if self._graph_model():
@@ -307,6 +318,9 @@ class ParallelWrapper:
             else:
                 m._score = losses[len(group) - 1]
                 m._iteration += len(group)
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_end()
 
     def fit(self, iterator, epochs=1, stepsPerDispatch=1):
         """Data-parallel fit: same jitted train step as the wrapped model —

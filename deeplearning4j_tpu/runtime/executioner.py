@@ -79,6 +79,9 @@ class OpExecutioner:
             if miss:
                 h[3].inc()
                 h[4].observe(dt)
+                # the flight recorder attributes compile stalls to the
+                # step they landed in (monitoring/steps.py)
+                _mon.step_recorder().on_compile(dt)
         return out
 
     def commit(self):

@@ -112,6 +112,8 @@ def blocking_float(value, site="score"):
     reg.histogram(_mon.PIPELINE_HOST_BLOCKED_MS, labels={"site": site},
                   help="wall time the host spent blocked per sync") \
        .observe(blocked_ms)
+    # attribute the stall to the current step's flight-recorder record
+    _mon.step_recorder().on_host_blocked(blocked_ms)
     return v
 
 

@@ -9,6 +9,11 @@ Two forms, both dependency-free:
   exposition format (see deeplearning4j_tpu.monitoring — jit compile
   histogram, device memory gauges, transfer/inference counters; the
   dashboard's Metrics tab renders the same scrape).
+- device observability endpoints: `POST /profile?steps=k` arms a
+  `monitoring.profiler.ProfileSession` over the next k training steps,
+  `GET /profile` returns its status + the latest decoded per-op report,
+  and `GET /steps` serves the step-time attribution flight recorder
+  (records + percentile summary) — each with a dashboard tab.
 - `render_static_html(storage, path)` — a self-contained HTML snapshot
   (inline SVG charts) for environments without an open port.
 """
@@ -16,6 +21,7 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 _PAGE = """<!DOCTYPE html>
@@ -43,6 +49,21 @@ enable with <code>net.setListeners(MetricsListener())</code>; scrape at
 <code>/metrics</code></div>
 <pre id="metrics" style="max-height:320px;overflow:auto;font-size:12px">
 monitoring disabled or no metrics yet</pre></div>
+<div class="chart"><h2>Device profile (XLA per-op)</h2>
+<div class="meta">On-demand jax.profiler window decoded to a per-op
+table — arm with
+<button onclick="armProfile()">profile next 3 steps</button> or
+<code>POST /profile?steps=k</code>; also
+<code>monitoring.profile_next_steps(k)</code></div>
+<pre id="profile" style="max-height:360px;overflow:auto;font-size:12px">
+no profile captured yet</pre></div>
+<div class="chart"><h2>Step-time attribution (flight recorder)</h2>
+<div class="meta">Per-step host phase breakdown (data_next / dispatch /
+listeners + host-blocked and compile stalls) — <code>GET /steps</code>;
+records appear while monitoring is enabled
+(<code>MetricsListener()</code>)</div>
+<pre id="steps" style="max-height:320px;overflow:auto;font-size:12px">
+no step records yet</pre></div>
 <script>
 const COLORS = ['#0a6','#06a','#a06','#a60','#60a','#6a0','#066','#660'];
 function poly(svg, xs, ys, color){
@@ -118,6 +139,54 @@ async function tick(){
       document.getElementById('metrics').textContent = mt;
     }
   } catch (e) {}
+  try {
+    const pr = await fetch('/profile'); const pd = await pr.json();
+    const el = document.getElementById('profile');
+    if (pd.last && pd.last.report){
+      const rep = pd.last.report;
+      let txt = `captured ${rep.steps} steps · device self time ` +
+        `${rep.device_self_ms.toFixed(3)} ms · ${rep.op_count} ops\n\n` +
+        '   self ms   total ms      %  count  category     op\n';
+      for (const r of rep.ops){
+        txt += `${r.self_ms.toFixed(3).padStart(10)} ` +
+          `${r.total_ms.toFixed(3).padStart(10)} ` +
+          `${r.pct.toFixed(1).padStart(6)} ${String(r.count).padStart(6)}` +
+          `  ${r.category.padEnd(12)} ${r.name.slice(0,70)}\n`;
+      }
+      el.textContent = txt;
+    } else if (pd.active){
+      el.textContent = `profiling: ${pd.active.state} ` +
+        `(${pd.active.captured_steps}/${pd.active.steps} steps)`;
+    }
+  } catch (e) {}
+  try {
+    const sr = await fetch('/steps'); const sd = await sr.json();
+    const el = document.getElementById('steps');
+    if (sd.summary && sd.summary.count){
+      const s = sd.summary;
+      let txt = `${s.count} steps`;
+      if (s.wall_ms){ txt += ` · wall p50 ${s.wall_ms.p50.toFixed(2)} ms` +
+        ` p95 ${s.wall_ms.p95.toFixed(2)} ms`; }
+      if (s.coverage != null){
+        txt += ` · attribution coverage ${(100*s.coverage).toFixed(0)}%`; }
+      txt += '\n';
+      for (const k in s.phases){
+        const p = s.phases[k];
+        txt += `  ${k}: p50 ${p.p50.toFixed(2)} ms  ` +
+          `p95 ${p.p95.toFixed(2)} ms\n`;
+      }
+      txt += `  compiles: ${s.compile_count_total} ` +
+        `(${s.compile_ms_total.toFixed(1)} ms) · host blocked ` +
+        `${s.host_blocked_ms_total.toFixed(1)} ms\n\nlast steps:\n`;
+      for (const r of sd.records.slice(-12)){
+        const ph = Object.entries(r.phases)
+          .map(([k,v])=>`${k}=${v.toFixed(2)}`).join(' ');
+        txt += `  #${r.step} wall=` +
+          (r.wall_ms==null?'?':r.wall_ms.toFixed(2)) + ` ms  ${ph}\n`;
+      }
+      el.textContent = txt;
+    }
+  } catch (e) {}
   const tr = await fetch('/tsne'); const td = await tr.json();
   if (td.points && td.points.length){
     const el = document.getElementById('tsne');
@@ -137,6 +206,9 @@ async function tick(){
       `${td.points.length} points` + (lset.length>1 ?
       ` · classes: ${lset.join(", ")}` : "");
   }
+}
+async function armProfile(){
+  try { await fetch('/profile?steps=3', {method: 'POST'}); } catch (e) {}
 }
 setInterval(tick, 1000); tick();
 </script></body></html>"""
@@ -206,6 +278,33 @@ class UIServer:
                 elif self.path.startswith("/tsne"):
                     body = json.dumps(server._tsne).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/profile"):
+                    # latest ProfileSession status/report; arming is the
+                    # POST below. Import is local so a dashboard-only
+                    # UIServer doesn't pull the profiler at startup.
+                    from deeplearning4j_tpu.monitoring import \
+                        profiler as _prof
+                    body = json.dumps(_prof.status()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/steps"):
+                    # step-time attribution flight recorder: ring records
+                    # + percentile summary (monitoring/steps.py). The
+                    # summary covers the WHOLE ring; records are bounded
+                    # (?last=N, default 64) — the dashboard polls every
+                    # second and renders only a short tail, so shipping
+                    # all 512 ring entries per tick is waste
+                    from deeplearning4j_tpu.monitoring import \
+                        steps as _steps
+                    q = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query)
+                    try:
+                        last = int(q.get("last", ["64"])[0])
+                    except ValueError:
+                        last = 64
+                    rec = _steps.recorder()
+                    body = json.dumps({"records": rec.records(last=last),
+                                       "summary": rec.summary()}).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/metrics"):
                     # Prometheus scrape surface for the host-side
                     # monitoring registry; with monitoring ENABLED the
@@ -228,6 +327,31 @@ class UIServer:
                     ctype = "text/html"
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path.startswith("/profile"):
+                    # arm an on-demand profiling window over the next k
+                    # training steps of whatever trainer runs next
+                    from deeplearning4j_tpu.monitoring import \
+                        profiler as _prof
+                    q = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query)
+                    try:
+                        steps = int(q.get("steps", ["3"])[0])
+                    except ValueError:
+                        steps = 3
+                    session = _prof.profile_next_steps(steps=steps)
+                    body = json.dumps({"armed": True,
+                                       "steps": session.steps}).encode()
+                    code = 200
+                else:
+                    body = b'{"error": "unknown endpoint"}'
+                    code = 404
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

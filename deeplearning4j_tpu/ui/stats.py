@@ -87,9 +87,16 @@ class StatsListener(TrainingListener):
 
     def _flat_params(self, model):
         """ONE device->host transfer of the parameter set; summaries and
-        ratios both derive from this host copy."""
+        ratios both derive from this host copy.
+
+        np.array (NOT np.asarray): on the CPU backend np.asarray(jax_arr)
+        can return a zero-copy VIEW of the device buffer, and the donating
+        train step rewrites that buffer in place on the next update — the
+        "previous" snapshot would silently mutate to equal the current
+        params and every update ratio would read exactly 0 (the reverse
+        direction of the runtime/pipeline.py xla_owned_copy hazard)."""
         params = getattr(model, "_params", None) or {}
-        return {f"{ln}_{pn}": np.asarray(v)
+        return {f"{ln}_{pn}": np.array(v)
                 for ln, p in params.items() for pn, v in p.items()}
 
     @staticmethod

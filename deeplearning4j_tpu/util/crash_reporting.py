@@ -140,6 +140,48 @@ class CrashReportingUtil:
                          f"(layers: {len(getattr(conf, 'layers', []) or [])})")
         lines.append("")
 
+        # OOM forensics: the LAST telemetry reading taken BEFORE the
+        # crash (monitoring/memory.py sample()) — after an OOM the
+        # allocator has often unwound, so the live post-mortem numbers
+        # above under-report the spike; this is the last-known-good view
+        try:
+            from deeplearning4j_tpu.monitoring import memory as _mem
+            snap = _mem.last_sample()
+            if snap is not None:
+                age = datetime.datetime.now().timestamp() - snap["ts"]
+                lines.append(f"Device memory telemetry "
+                             f"(last reading, {age:.1f}s before dump):")
+                for dev, stats in snap["devices"].items():
+                    if stats:
+                        keep = {k: stats[k] for k in
+                                ("bytes_in_use", "peak_bytes_in_use",
+                                 "bytes_limit") if k in stats}
+                        lines.append(f"  {dev}: " + ", ".join(
+                            f"{k}={v:,}" for k, v in keep.items()))
+                    else:
+                        lines.append(f"  {dev}: (no memory_stats)")
+                if "model" in snap:
+                    lines.append("  model footprint: " + ", ".join(
+                        f"{k}={v:,}" for k, v in snap["model"].items()))
+                lines.append("")
+        except Exception as e:  # noqa: BLE001 — dumps must never raise
+            lines.append(f"(memory telemetry unavailable: {e})")
+            lines.append("")
+
+        # step-time flight recorder: percentile summary + the last few
+        # per-step attribution records (monitoring/steps.py) — "what was
+        # each step doing right before the OOM"
+        try:
+            from deeplearning4j_tpu.monitoring import steps as _steps
+            rec = _steps.recorder()
+            if rec.records(last=1):
+                lines.append("Step-time flight recorder:")
+                lines.extend(rec.crash_lines())
+                lines.append("")
+        except Exception as e:  # noqa: BLE001
+            lines.append(f"(flight recorder unavailable: {e})")
+            lines.append("")
+
         # monitoring snapshot: what was the process DOING at OOM time?
         # (counters tell the story so far, the open span stack tells the
         # phase that died). Only when monitoring is on — the dump must

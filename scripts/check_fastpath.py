@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Fast-path lint: instrumented hot-path modules must not call the
+metrics registry outside an enabled-guard.
+
+The monitoring contract since PR 1 is ONE branch on the disabled path:
+every `registry.counter(...)` / `.gauge(...)` / `.histogram(...)` /
+`get_registry()` reachable per-step must sit inside the
+`if _mon.enabled():` / `if STATE.enabled:` guard pattern (or behind an
+early `if not ...enabled...: return`). A bare registry call costs a
+lock + dict lookup + possible allocation per step even with monitoring
+off — exactly the always-on overhead the disabled-by-default design
+exists to prevent, and the kind of regression that creeps in silently
+with new instrumentation.
+
+This script AST-walks the hot-path modules and reports violations;
+`tests/test_fastpath_lint.py` runs it in tier-1 so a violating PR fails
+CI. Run manually:  python scripts/check_fastpath.py  (exit 1 on
+violations).
+
+Intentionally NOT linted: `monitoring/` internals (they ARE the guard),
+`_mon.span(...)` / `record_transfer(...)` / `step_recorder()` (each
+internally one flag check), and cold-path modules (listeners, ui,
+resilience policies) where a per-call registry lookup is irrelevant.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: per-step hot-path modules (relative to the repo root)
+HOT_MODULES = [
+    "deeplearning4j_tpu/nn/multilayer.py",
+    "deeplearning4j_tpu/nn/graph.py",
+    "deeplearning4j_tpu/runtime/executioner.py",
+    "deeplearning4j_tpu/runtime/pipeline.py",
+    "deeplearning4j_tpu/parallel/wrapper.py",
+    "deeplearning4j_tpu/parallel/sharded_trainer.py",
+    "deeplearning4j_tpu/parallel/inference.py",
+]
+
+#: attribute calls that hit the registry
+REGISTRY_ATTRS = {"counter", "gauge", "histogram"}
+#: bare/attribute function names that resolve the registry
+REGISTRY_FUNCS = {"get_registry"}
+
+#: substrings that mark an `if` test (or early-return guard test) as the
+#: enabled-guard: `_mon.enabled()`, `STATE.enabled`, a cached
+#: `mon_on = _mon.enabled()`, or an armed-session check
+GUARD_TOKENS = ("enabled", "STATE.", "mon_on", "ACTIVE")
+
+
+def _is_registry_call(node):
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in REGISTRY_ATTRS:
+        return f".{f.attr}(...)"
+    name = None
+    if isinstance(f, ast.Attribute):
+        name = f.attr
+    elif isinstance(f, ast.Name):
+        name = f.id
+    if name in REGISTRY_FUNCS:
+        return f"{name}()"
+    return None
+
+
+def _test_is_guard(test):
+    try:
+        src = ast.unparse(test)
+    except Exception:  # noqa: BLE001 — unparse of odd nodes
+        return False
+    return any(tok in src for tok in GUARD_TOKENS)
+
+
+def _guarded(node, ancestors):
+    """Inside an `if <enabled-ish>` block, or after an early-return
+    `if not <enabled-ish>: return` in the enclosing function."""
+    func = None
+    for anc in reversed(ancestors):
+        if isinstance(anc, ast.If) and _test_is_guard(anc.test):
+            return True
+        if func is None and isinstance(anc, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+            func = anc
+    if func is not None:
+        for stmt in ast.walk(func):
+            if isinstance(stmt, ast.If) and _test_is_guard(stmt.test) \
+                    and stmt.lineno < node.lineno \
+                    and any(isinstance(s, (ast.Return, ast.Raise))
+                            for s in stmt.body):
+                return True
+    return False
+
+
+def check_source(source, path="<string>"):
+    """[(path, lineno, description)] for unguarded registry calls."""
+    tree = ast.parse(source, filename=path)
+    violations = []
+
+    def walk(node, ancestors):
+        if isinstance(node, ast.Call):
+            what = _is_registry_call(node)
+            if what is not None and not _guarded(node, ancestors):
+                violations.append(
+                    (path, node.lineno,
+                     f"{what} outside the enabled-guard fast path"))
+        for child in ast.iter_child_nodes(node):
+            walk(child, ancestors + [node])
+
+    walk(tree, [])
+    return violations
+
+
+def check_file(path):
+    with open(path) as f:
+        return check_source(f.read(), path)
+
+
+def main(modules=None):
+    violations = []
+    for rel in modules or HOT_MODULES:
+        path = os.path.join(REPO_ROOT, rel)
+        if not os.path.exists(path):
+            continue
+        violations.extend(check_file(path))
+    for path, lineno, msg in violations:
+        print(f"{os.path.relpath(path, REPO_ROOT)}:{lineno}: {msg}")
+    if violations:
+        print(f"\n{len(violations)} fast-path violation(s): wrap the "
+              "call in `if _mon.enabled():` (or an early "
+              "`if not STATE.enabled: return`) so the disabled path "
+              "stays one branch.")
+    return violations
+
+
+if __name__ == "__main__":
+    sys.exit(1 if main(sys.argv[1:] or None) else 0)

@@ -6,6 +6,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.parallel.mesh import shard_map
 from deeplearning4j_tpu.models.bert import (BertConfig, bert_classify,
                                             bert_encode, bert_mlm_logits,
                                             bert_tiny, classification_loss,
@@ -141,7 +142,7 @@ def test_ring_attention_impl_matches_dense(tiny, devices8):
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, None, "sp", None)
-    ring_sharded = jax.shard_map(ring, mesh=mesh,
+    ring_sharded = shard_map(ring, mesh=mesh,
                                  in_specs=(spec, spec, spec),
                                  out_specs=spec, check_vma=False)
     got = np.asarray(bert_encode(cfg, params, ids, attn_impl=ring_sharded))

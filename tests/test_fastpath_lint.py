@@ -1,0 +1,71 @@
+"""scripts/check_fastpath.py in tier-1: instrumented hot-path modules
+must keep the disabled-monitoring path at one branch — no bare registry
+calls outside the enabled-guard pattern."""
+import os
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+import check_fastpath  # noqa: E402
+
+
+def test_repo_hot_paths_are_clean():
+    violations = check_fastpath.main()
+    assert violations == [], "\n".join(
+        f"{p}:{ln}: {msg}" for p, ln, msg in violations)
+
+
+def test_lint_flags_unguarded_registry_call():
+    bad = textwrap.dedent("""
+        from deeplearning4j_tpu import monitoring as _mon
+
+        def fit_batch(self, x):
+            _mon.get_registry().counter("dl4j.train.steps").inc()
+            return x
+    """)
+    v = check_fastpath.check_source(bad)
+    assert len(v) == 2   # get_registry() AND .counter(...)
+    assert all("outside the enabled-guard" in msg for _, _, msg in v)
+
+
+def test_lint_accepts_guarded_patterns():
+    good = textwrap.dedent("""
+        from deeplearning4j_tpu import monitoring as _mon
+        from deeplearning4j_tpu.monitoring.state import STATE
+
+        def wrapped_guard(self, x):
+            if _mon.enabled():
+                _mon.get_registry().counter("a").inc()
+            return x
+
+        def early_return_guard(self, x):
+            if not STATE.enabled:
+                return x
+            reg = _mon.get_registry()
+            reg.histogram("b").observe(1.0)
+            return x
+
+        def cached_flag(self):
+            mon_on = _mon.enabled()
+            if not mon_on:
+                return
+            _mon.get_registry().gauge("c").set(1)
+    """)
+    assert check_fastpath.check_source(good) == []
+
+
+def test_lint_rejects_guard_after_the_call():
+    # the guard must precede the call — a later early-return doesn't
+    # protect the hot path
+    bad = textwrap.dedent("""
+        from deeplearning4j_tpu import monitoring as _mon
+        from deeplearning4j_tpu.monitoring.state import STATE
+
+        def f(self):
+            _mon.get_registry().counter("a").inc()
+            if not STATE.enabled:
+                return
+    """)
+    assert len(check_fastpath.check_source(bad)) == 2

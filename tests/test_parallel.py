@@ -9,6 +9,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.parallel.mesh import shard_map
 from deeplearning4j_tpu.nn import (Adam, DenseLayer, InputType,
                                    MultiLayerNetwork, NeuralNetConfiguration,
                                    OutputLayer, Sgd)
@@ -292,7 +293,7 @@ def test_ring_attention_flash_kernel_path(devices8):
     ring = make_ring_attention(mesh, "sp", use_flash=True, block_q=16,
                                block_k=16, interpret=True)
     spec = P(None, None, "sp", None)
-    f = jax.shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
+    f = shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
                       out_specs=spec, check_vma=False)
     np.testing.assert_allclose(np.asarray(f(q, k, v)),
                                np.asarray(dense_attention(q, k, v)),
@@ -322,7 +323,7 @@ def test_ring_attention_flash_causal_matches_dense(devices8):
     ring = make_ring_attention(mesh, "sp", causal=True, use_flash=True,
                                block_q=16, block_k=16, interpret=True)
     spec = P(None, None, "sp", None)
-    f = jax.shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
+    f = shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
                       out_specs=spec, check_vma=False)
     want = dense_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(f(q, k, v)), np.asarray(want),
@@ -445,7 +446,7 @@ def test_bert_with_ulysses_attention_matches_dense(devices8):
              "labels": rng.integers(0, cfg.num_labels, (2,))}
     want = float(classification_loss(cfg, params, batch, train=False))
     spec = P(None, None, "sp", None)
-    uly = jax.shard_map(make_ulysses_attention(mesh, "sp"), mesh=mesh,
+    uly = shard_map(make_ulysses_attention(mesh, "sp"), mesh=mesh,
                         in_specs=(spec, spec, spec), out_specs=spec,
                         check_vma=False)
     got = float(classification_loss(cfg, params, batch, train=False,
@@ -585,7 +586,7 @@ def test_ring_attention_masked_flash_path(devices8):
     fn = make_ring_attention(mesh, "sp", use_flash=True, block_q=16,
                              block_k=16, interpret=True)
     spec = P(None, None, "sp", None)
-    sharded = jax.shard_map(fn, mesh=mesh,
+    sharded = shard_map(fn, mesh=mesh,
                             in_specs=(spec, spec, spec, P(None, "sp")),
                             out_specs=spec, check_vma=False)
 
@@ -636,7 +637,7 @@ def test_ring_attention_masked_flash_zero_length_and_bool_mask(devices8):
     fn = make_ring_attention(mesh, "sp", use_flash=True, block_q=16,
                              block_k=16, interpret=True)
     spec = P(None, None, "sp", None)
-    sharded = jax.shard_map(fn, mesh=mesh,
+    sharded = shard_map(fn, mesh=mesh,
                             in_specs=(spec, spec, spec, P(None, "sp")),
                             out_specs=spec, check_vma=False)
 
@@ -653,7 +654,7 @@ def test_ring_attention_masked_flash_zero_length_and_bool_mask(devices8):
         assert np.abs(np.asarray(g_)[1]).max() > 0
     # bool mask: same call must differentiate without dtype errors
     bmask = jnp.asarray(mask) > 0
-    sharded_b = jax.shard_map(fn, mesh=mesh,
+    sharded_b = shard_map(fn, mesh=mesh,
                               in_specs=(spec, spec, spec, P(None, "sp")),
                               out_specs=spec, check_vma=False)
     gb = jax.grad(lambda q_: jnp.sum(jnp.square(
@@ -677,7 +678,7 @@ def test_ring_attention_masked_flash_causal_left_padding(devices8):
     fn = make_ring_attention(mesh, "sp", causal=True, use_flash=True,
                              block_q=16, block_k=16, interpret=True)
     spec = P(None, None, "sp", None)
-    sharded = jax.shard_map(fn, mesh=mesh,
+    sharded = shard_map(fn, mesh=mesh,
                             in_specs=(spec, spec, spec, P(None, "sp")),
                             out_specs=spec, check_vma=False)
     got = np.asarray(sharded(jnp.asarray(q), jnp.asarray(k),
@@ -741,7 +742,7 @@ def test_bert_masked_ring_matches_dense(devices8):
                                      attn_impl="dense"))
     fn = make_ring_attention(mesh, "sp", use_flash=False)
     spec = P(None, None, "sp", None)
-    ring = jax.shard_map(fn, mesh=mesh,
+    ring = shard_map(fn, mesh=mesh,
                          in_specs=(spec, spec, spec, P(None, "sp")),
                          out_specs=spec, check_vma=False)
     got = float(classification_loss(cfg, params, batch, train=False,

@@ -97,7 +97,12 @@ def test_update_ratios_and_activation_histograms_recorded():
     for r in with_r:
         for k, v in r["updateRatios"].items():
             assert np.isfinite(v) and v >= 0, (k, v)
-    assert any(v > 0 for r in with_r for v in r["updateRatios"].values())
+    # every post-first record must show REAL movement: all-zero ratios
+    # were the aliased-snapshot regression (np.asarray view of a donated
+    # param buffer mutating in place — see StatsListener._flat_params)
+    for r in with_r:
+        assert all(v > 0 for v in r["updateRatios"].values()), \
+            r["updateRatios"]
     with_h = [r for r in recs if r.get("activationHistograms")]
     assert with_h, "no activation histograms recorded"
     h = with_h[-1]["activationHistograms"]

@@ -1,0 +1,206 @@
+"""optimize/xplane.py unit coverage: hand-encoded xplane.pb byte streams
+(wire format per the documented field numbers) through the full decode
+surface — op_breakdown, memory_breakdown, the op_table self-time /
+category / FLOPs rollups, and report rendering."""
+import struct
+
+import pytest
+
+from deeplearning4j_tpu.autodiff.tfproto import _write_varint
+from deeplearning4j_tpu.optimize import xplane
+
+
+# -- minimal protobuf writer (field numbers from the xplane.py header) -----
+def _tag(f, w):
+    out = bytearray()
+    _write_varint(out, (f << 3) | w)
+    return bytes(out)
+
+
+def _varint(v):
+    out = bytearray()
+    _write_varint(out, v)
+    return bytes(out)
+
+
+def _ld(f, payload):
+    return _tag(f, 2) + _varint(len(payload)) + payload
+
+
+def _vint(f, v):
+    return _tag(f, 0) + _varint(v)
+
+
+def _map_entry(field, key, value_msg):
+    return _ld(field, _vint(1, key) + _ld(2, value_msg))
+
+
+def _event(meta_id, off_ps, dur_ps, stats=b""):
+    return _ld(4, _vint(1, meta_id) + _vint(2, off_ps)
+               + _vint(3, dur_ps) + stats)
+
+
+def _stat(meta_id, payload):
+    return _ld(4, _vint(1, meta_id) + payload)
+
+
+def write_trace(tmp_path, plane_bytes, run="run1", host="host"):
+    d = tmp_path / "plugins" / "profile" / run
+    d.mkdir(parents=True, exist_ok=True)
+    (d / f"{host}.xplane.pb").write_bytes(plane_bytes)
+    return str(tmp_path)
+
+
+def _basic_plane(plane_name=b"/device:TPU:0", line_name=b"XLA Ops",
+                 events=(), event_metas=(), stat_metas=(), extra_lines=()):
+    body = b""
+    for sid, name in stat_metas:
+        body += _map_entry(5, sid, _vint(1, sid) + _ld(2, name))
+    for mid, name, meta_stats in event_metas:
+        body += _map_entry(4, mid, _vint(1, mid) + _ld(2, name)
+                           + meta_stats)
+    line = _ld(3, _ld(2, line_name) + _vint(3, 0)
+               + b"".join(events))
+    return _ld(1, _ld(2, plane_name) + body + line
+               + b"".join(extra_lines))
+
+
+class TestDecode:
+    def test_op_breakdown_aggregates_and_sorts(self, tmp_path):
+        # two ops: %mul 3 ms over two events, %add 1 ms over one
+        plane = _basic_plane(
+            event_metas=[(1, b"%mul", b""), (2, b"%add", b"")],
+            events=[_event(1, 0, 1_000_000_000),
+                    _event(1, 2_000_000_000, 2_000_000_000),
+                    _event(2, 5_000_000_000, 1_000_000_000)])
+        trace = write_trace(tmp_path, plane)
+        rows = xplane.op_breakdown(trace)
+        assert rows == [("%mul", 3.0, 2), ("%add", 1.0, 1)]
+
+    def test_display_name_preferred_and_plane_filter(self, tmp_path):
+        plane = _basic_plane(
+            plane_name=b"/host:CPU",
+            event_metas=[(1, b"%ugly.raw", b"")],
+            events=[_event(1, 0, 1_000_000_000)])
+        # display_name (field 3) wins over name when present
+        pretty = _ld(1, _ld(2, b"/device:TPU:0")
+                     + _map_entry(4, 1, _vint(1, 1) + _ld(2, b"%raw")
+                                  + _ld(3, b"nice_op"))
+                     + _ld(3, _ld(2, b"XLA Ops") + _vint(3, 0)
+                           + _event(1, 0, 2_000_000_000)))
+        trace = write_trace(tmp_path, plane + pretty)
+        rows = xplane.op_breakdown(trace, device_substr="TPU")
+        assert rows == [("nice_op", 2.0, 1)]   # host plane filtered out
+        rows_all = xplane.op_breakdown(trace, device_substr="")
+        assert {r[0] for r in rows_all} == {"%ugly.raw", "nice_op"}
+
+    def test_xla_ops_line_selected_over_others(self, tmp_path):
+        # "Steps" line spans the same wall time as "XLA Ops" — summing
+        # both would double-count; the reader must pick "XLA Ops"
+        steps_line = _ld(3, _ld(2, b"Steps") + _vint(3, 0)
+                         + _event(1, 0, 9_000_000_000))
+        plane = _basic_plane(
+            event_metas=[(1, b"%op", b"")],
+            events=[_event(1, 0, 4_000_000_000)],
+            extra_lines=[steps_line])
+        trace = write_trace(tmp_path, plane)
+        rows = xplane.op_breakdown(trace)
+        assert rows == [("%op", 4.0, 1)]
+
+    def test_memory_breakdown_from_stats(self, tmp_path):
+        # stat metadata 1 = "bytes accessed"; event-level uint64 stat
+        ev_stats = _stat(1, _vint(3, 4_000_000))
+        plane = _basic_plane(
+            stat_metas=[(1, b"bytes accessed")],
+            event_metas=[(1, b"%fusion.7", b"")],
+            events=[_event(1, 0, 2_000_000_000, ev_stats)])
+        trace = write_trace(tmp_path, plane)
+        rows = xplane.memory_breakdown(trace)
+        assert len(rows) == 1
+        name, ms, b, gbps = rows[0]
+        assert name == "%fusion.7" and ms == 2.0 and b == 4_000_000
+        assert gbps == pytest.approx((4e6 / 1e9) / (2.0 / 1e3))
+
+
+class TestOpTable:
+    def test_self_time_subtracts_nested_children(self, tmp_path):
+        # %fusion spans [0, 10 ms); %child [2 ms, 6 ms) nested inside:
+        # fusion self = 6 ms, child self = 4 ms
+        plane = _basic_plane(
+            event_metas=[(1, b"%fusion", b""), (2, b"%child", b"")],
+            events=[_event(1, 0, 10_000_000_000),
+                    _event(2, 2_000_000_000, 4_000_000_000)])
+        trace = write_trace(tmp_path, plane)
+        rows = {r["name"]: r for r in xplane.op_table(trace)}
+        assert rows["%fusion"]["total_ms"] == pytest.approx(10.0)
+        assert rows["%fusion"]["self_ms"] == pytest.approx(6.0)
+        assert rows["%child"]["self_ms"] == pytest.approx(4.0)
+        # pct is the self-time share: 60 / 40
+        assert rows["%fusion"]["pct"] == pytest.approx(60.0)
+        assert rows["%child"]["pct"] == pytest.approx(40.0)
+
+    def test_category_from_stat_and_name_heuristic(self, tmp_path):
+        # op 1 carries an explicit "category" ref-stat; op 2 falls back
+        # to the name heuristic (convolution); op 3 to "other"
+        ev1_stats = _stat(1, _vint(7, 2))   # ref -> stat_meta 2's name
+        plane = _basic_plane(
+            stat_metas=[(1, b"category"), (2, b"my-cat")],
+            event_metas=[(1, b"%op.a", b""), (2, b"%convolution.3", b""),
+                         (3, b"%mystery", b"")],
+            events=[_event(1, 0, 1_000_000_000, ev1_stats),
+                    _event(2, 1_000_000_000, 1_000_000_000),
+                    _event(3, 2_000_000_000, 1_000_000_000)])
+        trace = write_trace(tmp_path, plane)
+        cats = {r["name"]: r["category"] for r in xplane.op_table(trace)}
+        assert cats == {"%op.a": "my-cat",
+                        "%convolution.3": "convolution",
+                        "%mystery": "other"}
+
+    def test_flops_and_bytes_rollup(self, tmp_path):
+        stats = (_stat(1, _vint(3, 1_000)) +       # flops uint64
+                 _stat(2, _vint(3, 2_048)))        # bytes accessed
+        plane = _basic_plane(
+            stat_metas=[(1, b"flops"), (2, b"bytes accessed")],
+            event_metas=[(1, b"%dot.1", b"")],
+            events=[_event(1, 0, 1_000_000_000, stats),
+                    _event(1, 1_000_000_000, 1_000_000_000, stats)])
+        trace = write_trace(tmp_path, plane)
+        (row,) = xplane.op_table(trace)
+        assert row["flops"] == 2_000 and row["bytes_accessed"] == 4_096
+        assert row["category"] == "matmul" and row["count"] == 2
+
+    def test_category_rollup_and_render(self, tmp_path):
+        plane = _basic_plane(
+            event_metas=[(1, b"%dot.1", b""), (2, b"%copy.2", b"")],
+            events=[_event(1, 0, 3_000_000_000),
+                    _event(2, 3_000_000_000, 1_000_000_000)])
+        trace = write_trace(tmp_path, plane)
+        rows = xplane.op_table(trace)
+        roll = xplane.category_rollup(rows)
+        assert [c["category"] for c in roll] == ["matmul", "copy"]
+        assert roll[0]["pct"] == pytest.approx(75.0)
+        text = xplane.render_report(
+            rows, memory_rows=xplane.memory_breakdown(trace), top=10)
+        assert "%dot.1" in text and "matmul" in text
+        assert "by category:" in text
+
+    def test_empty_trace_dir(self, tmp_path):
+        assert xplane.op_table(str(tmp_path)) == []
+        assert xplane.op_breakdown(str(tmp_path)) == []
+        assert xplane.render_report([]).startswith("device self time")
+
+
+class TestSelfTimes:
+    def test_disjoint_siblings_keep_full_duration(self):
+        events = [("a", 100, 0), ("b", 100, 100)]
+        assert xplane._self_times(events) == [100, 100]
+
+    def test_deep_nesting(self):
+        # a [0,100) > b [10,90) > c [20,30): a self 20, b self 70, c 10
+        events = [("a", 100, 0), ("b", 80, 10), ("c", 10, 20)]
+        assert xplane._self_times(events) == [20, 70, 10]
+
+    def test_same_offset_parent_first(self):
+        # parent and child share a start: longer duration is the parent
+        events = [("child", 10, 0), ("parent", 100, 0)]
+        assert xplane._self_times(events) == [10, 90]
