@@ -55,7 +55,11 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     RESILIENCE_BATCHES_SKIPPED, RESILIENCE_CHECKPOINT_SAVES,
     RESILIENCE_RESUMES, RESILIENCE_RESUME_STEP,
     RESILIENCE_INFERENCE_SHED, RESILIENCE_INFERENCE_TIMEOUTS,
-    RESILIENCE_COLLECTOR_RESTARTS,
+    RESILIENCE_COLLECTOR_RESTARTS, RESILIENCE_CKPT_ORPHANS_REMOVED,
+    RESILIENCE_CKPT_FALLBACKS,
+    GUARDIAN_CHECKS, GUARDIAN_SKIPPED_UPDATES, GUARDIAN_LR_RETRIES,
+    GUARDIAN_ROLLBACKS, GUARDIAN_SAVES_GATED, GUARDIAN_LAST_GOOD_STEP,
+    WATCHDOG_STALLS, WATCHDOG_BEAT_AGE_SECONDS, WATCHDOG_DUMPS,
     PIPELINE_SYNCS, PIPELINE_HOST_BLOCKED_MS, PIPELINE_PREFETCH_DEPTH,
     PIPELINE_STAGED_BATCHES,
     PROFILE_SESSIONS, PROFILE_CAPTURED_STEPS, PROFILE_DEVICE_MS,
@@ -89,7 +93,11 @@ __all__ = [
     "RESILIENCE_BATCHES_SKIPPED", "RESILIENCE_CHECKPOINT_SAVES",
     "RESILIENCE_RESUMES", "RESILIENCE_RESUME_STEP",
     "RESILIENCE_INFERENCE_SHED", "RESILIENCE_INFERENCE_TIMEOUTS",
-    "RESILIENCE_COLLECTOR_RESTARTS",
+    "RESILIENCE_COLLECTOR_RESTARTS", "RESILIENCE_CKPT_ORPHANS_REMOVED",
+    "RESILIENCE_CKPT_FALLBACKS",
+    "GUARDIAN_CHECKS", "GUARDIAN_SKIPPED_UPDATES", "GUARDIAN_LR_RETRIES",
+    "GUARDIAN_ROLLBACKS", "GUARDIAN_SAVES_GATED", "GUARDIAN_LAST_GOOD_STEP",
+    "WATCHDOG_STALLS", "WATCHDOG_BEAT_AGE_SECONDS", "WATCHDOG_DUMPS",
     "PIPELINE_SYNCS", "PIPELINE_HOST_BLOCKED_MS", "PIPELINE_PREFETCH_DEPTH",
     "PIPELINE_STAGED_BATCHES",
 ]

@@ -50,6 +50,24 @@ RESILIENCE_RESUME_STEP = "dl4j.resilience.resume_step"
 RESILIENCE_INFERENCE_SHED = "dl4j.resilience.inference_shed"
 RESILIENCE_INFERENCE_TIMEOUTS = "dl4j.resilience.inference_timeouts"
 RESILIENCE_COLLECTOR_RESTARTS = "dl4j.resilience.collector_restarts"
+RESILIENCE_CKPT_ORPHANS_REMOVED = "dl4j.resilience.ckpt_orphans_removed"
+RESILIENCE_CKPT_FALLBACKS = "dl4j.resilience.ckpt_restore_fallbacks"
+
+# training guardian (resilience/guardian.py): model-state health —
+# device-side per-step verdicts, skipped (never-applied) updates, and
+# the escalation ladder's LR retries / checkpoint rollbacks
+GUARDIAN_CHECKS = "dl4j.guardian.checks"
+GUARDIAN_SKIPPED_UPDATES = "dl4j.guardian.skipped_updates"
+GUARDIAN_LR_RETRIES = "dl4j.guardian.lr_retries"
+GUARDIAN_ROLLBACKS = "dl4j.guardian.rollbacks"
+GUARDIAN_SAVES_GATED = "dl4j.guardian.saves_gated"
+GUARDIAN_LAST_GOOD_STEP = "dl4j.guardian.last_good_step"
+
+# stall watchdog (resilience/watchdog.py): per-trainer heartbeat age and
+# stall trips (a step exceeding DL4J_STALL_TIMEOUT)
+WATCHDOG_STALLS = "dl4j.watchdog.stalls"
+WATCHDOG_BEAT_AGE_SECONDS = "dl4j.watchdog.beat_age_seconds"
+WATCHDOG_DUMPS = "dl4j.watchdog.dumps"
 
 # host pipeline (runtime/pipeline.py): is the host running ahead of the
 # device, or blocking on it? `syncs` counts every host-blocking

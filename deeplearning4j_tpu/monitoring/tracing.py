@@ -74,10 +74,24 @@ class Tracer:
         self._epoch_ns = time.perf_counter_ns()
         self._local = threading.local()
         self._pid = os.getpid()   # constant; skip the syscall per record
+        # tid -> the SAME list object as that thread's _local.stack, so
+        # a monitor thread (resilience/watchdog.py) can snapshot what
+        # every thread is doing right now without cross-thread locals
+        self._stacks_by_tid = {}
 
     def _ensure_local(self):
         if not hasattr(self._local, "stack"):
+            # registering a new thread is rare — use it to evict tids of
+            # exited threads, so a watchdog-less process (where
+            # open_spans() never runs) doesn't pin one stack list per
+            # dead span-recording thread forever
+            if len(self._stacks_by_tid) > threading.active_count():
+                live = {t.ident for t in threading.enumerate()}
+                for tid in list(self._stacks_by_tid):
+                    if tid not in live:
+                        self._stacks_by_tid.pop(tid, None)
             self._local.stack = []
+            self._stacks_by_tid[threading.get_ident()] = self._local.stack
 
     def span(self, name, args=None):
         self._ensure_local()
@@ -115,6 +129,28 @@ class Tracer:
         OOM dumps so post-mortems show the phase that died)."""
         self._ensure_local()
         return list(self._local.stack)
+
+    def open_spans(self):
+        """{thread_id: open-span stack} across ALL LIVE threads that
+        have recorded a span — the cross-thread view a stall watchdog
+        needs (a wedged trainer thread cannot report on itself). Exited
+        threads are evicted here (cold path — their stale stacks would
+        otherwise read as phantom wedged threads in a stall report, and
+        pin their lists forever). Best effort: stacks mutate
+        concurrently; the copy is taken per list and never raises."""
+        live = {t.ident for t in threading.enumerate()}
+        out = {}
+        for tid, stack in list(self._stacks_by_tid.items()):
+            if tid not in live:
+                self._stacks_by_tid.pop(tid, None)
+                continue
+            try:
+                snap = list(stack)
+            except Exception:  # noqa: BLE001 — concurrent mutation
+                snap = []
+            if snap:
+                out[tid] = snap
+        return out
 
     # -- export ----------------------------------------------------------
     def events(self):

@@ -17,6 +17,8 @@ import optax
 from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.monitoring import profiler as _prof
 from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience import guardian as _guardian
+from deeplearning4j_tpu.resilience import watchdog as _watchdog
 from deeplearning4j_tpu.runtime import pipeline as _pipeline
 from deeplearning4j_tpu.util.crash_reporting import \
     with_crash_dump
@@ -387,6 +389,30 @@ class ComputationGraph:
 
         return step
 
+    @functools.cached_property
+    def _train_step_guarded(self):
+        """Guardian variant of `_train_step` (see
+        MultiLayerNetwork._train_step_guarded): same update + device
+        health verdict, update applied only when loss and global grad
+        norm are finite and the norm is under the guardian's threshold;
+        `lr_scale` implements the reduce-LR escalation rung."""
+        tx = self._tx
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step(params, opt_state, state, inputs, labels, fmasks,
+                 lmasks, rng, lr_scale, max_gnorm):
+            (loss, new_state), grads = jax.value_and_grad(
+                lambda p: self._loss(p, state, inputs, labels, fmasks,
+                                     lmasks, rng), has_aux=True)(params)
+            params, opt_state, (state,), gnorm, ok = \
+                _guardian.guarded_apply(
+                    tx, grads, loss, params, opt_state, lr_scale,
+                    max_gnorm, constraints=self._apply_constraints,
+                    extra=((new_state, state),))
+            return params, opt_state, state, loss, gnorm, ok
+
+        return step
+
     def _apply_constraints(self, params):
         """Post-update constraints per layer vertex (≡ BaseConstraint)."""
         pairs = [(n, self.nodes[n].ref) for n in self._layer_names]
@@ -437,18 +463,32 @@ class ComputationGraph:
     def _fit_unpacked(self, unpacked):
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        if _watchdog.ACTIVE is not None:
+            _watchdog.ACTIVE.beat(f"graph@{id(self):x}")
         _ps = _prof.ACTIVE
         if _ps is not None:
             _ps.step_start()
         ins, labels, fmasks, lmasks = unpacked
         with _mon.span("train.stage"):
             self._rng_key, sub = jax.random.split(self._rng_key)
+        _g = _guardian.ACTIVE
         with _mon.span("train.dispatch"):
-            self._params, self._opt_state, self._state, loss = \
-                self._train_step(
+            if _g is not None:
+                (self._params, self._opt_state, self._state, loss,
+                 gnorm, ok) = self._train_step_guarded(
                     self._params, self._opt_state, self._state, ins,
-                    labels, fmasks, lmasks, sub)
+                    labels, fmasks, lmasks, sub, _g.lr_scale,
+                    _g.max_gnorm)
+            else:
+                self._params, self._opt_state, self._state, loss = \
+                    self._train_step(
+                        self._params, self._opt_state, self._state, ins,
+                        labels, fmasks, lmasks, sub)
             self._score = loss    # device scalar; score() floats it
+        if _g is not None:
+            # device scalars only — materialized at the guardian's
+            # check cadence, never per step
+            _g.on_step(loss, gnorm, ok)
         self._iteration += 1
         self._last_features = ins     # for StatsListener histograms
         self._params_version = getattr(self, "_params_version", 0) + 1
@@ -495,6 +535,8 @@ class ComputationGraph:
         distinct scan length is a fresh compile)."""
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        if _watchdog.ACTIVE is not None:
+            _watchdog.ACTIVE.beat(f"graph@{id(self):x}")
         _ps = _prof.ACTIVE             # armed ProfileSession: the whole
         if _ps is not None:            # scanned dispatch is one "step"
             _ps.step_start()
@@ -550,14 +592,26 @@ class ComputationGraph:
         if self._params is None:
             self.init()
         if labels is not None:
-            with _mon.span("fit"):
-                self._fit_batch(DataSet(as_jax(data), as_jax(labels)))
+            try:
+                with _mon.span("fit"):
+                    self._fit_batch(DataSet(as_jax(data), as_jax(labels)))
+            finally:           # retire even on a raise: a FAILED fit is
+                #                not a wedged one (see iterator path)
+                if _watchdog.ACTIVE is not None:
+                    _watchdog.ACTIVE.retire(f"graph@{id(self):x}")
             return self
         if isinstance(data, (DataSet, MultiDataSet)):
-            with _mon.span("fit"):
-                self._fit_batch(data)
+            try:
+                with _mon.span("fit"):
+                    self._fit_batch(data)
+            finally:
+                if _watchdog.ACTIVE is not None:
+                    _watchdog.ACTIVE.retire(f"graph@{id(self):x}")
             return self
         k = max(1, int(stepsPerDispatch))
+        if _guardian.ACTIVE is not None:
+            k = 1    # guardian needs per-step health verdicts; a scan
+            #          group would hide k-1 of them inside one dispatch
         n_epochs = int(epochs) if epochs is not None else 1
 
         def flush(group):
@@ -595,6 +649,10 @@ class ComputationGraph:
                             if hasattr(listener, "onEpochEnd"):
                                 listener.onEpochEnd(self)
         finally:
+            # fit over: this trainer's heartbeat is no longer stall
+            # evidence (see multilayer.fit)
+            if _watchdog.ACTIVE is not None:
+                _watchdog.ACTIVE.retire(f"graph@{id(self):x}")
             if _pf is not None:
                 _pf.close()
         return self
@@ -610,6 +668,8 @@ class ComputationGraph:
             if hasattr(it, "reset"):
                 it.reset()
             for ds in _mon.traced_iter(it, "eval.data_next"):
+                if _faults.ACTIVE is not None:
+                    _faults.ACTIVE.fire(_faults.EVAL_FORWARD)
                 with _mon.span("eval.batch"):
                     out = self.output(ds.features)
                     out0 = out[0] if isinstance(out, list) else out

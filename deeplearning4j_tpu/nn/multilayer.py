@@ -20,6 +20,8 @@ import optax
 from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.monitoring import profiler as _prof
 from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience import guardian as _guardian
+from deeplearning4j_tpu.resilience import watchdog as _watchdog
 from deeplearning4j_tpu.runtime import pipeline as _pipeline
 from deeplearning4j_tpu.util.crash_reporting import \
     with_crash_dump
@@ -416,6 +418,35 @@ class MultiLayerNetwork:
         return step
 
     @functools.cached_property
+    def _train_step_guarded(self):
+        """The guardian's variant of `_train_step`: the SAME update plus
+        a device-side health verdict — global grad norm finite, loss
+        finite, grad norm under the guardian's EMA-derived threshold —
+        and the update is APPLIED ONLY WHEN HEALTHY (`jnp.where`
+        select inside the same donated program), so one overflowing
+        step can never write NaN into the live params. `lr_scale`
+        (traced scalar — no recompile when the guardian backs off the
+        LR) multiplies the updates for the reduce-LR escalation rung.
+        Compiled only when a guardian is installed; the unguarded path
+        is untouched."""
+        tx = self._tx
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step(params, opt_state, state, x, y, fmask, lmask, rng,
+                 lr_scale, max_gnorm):
+            (loss, (new_state, _)), grads = jax.value_and_grad(
+                lambda p: self._loss(p, state, x, y, fmask, lmask, rng),
+                has_aux=True)(params)
+            params, opt_state, (state,), gnorm, ok = \
+                _guardian.guarded_apply(
+                    tx, grads, loss, params, opt_state, lr_scale,
+                    max_gnorm, constraints=self._apply_constraints,
+                    extra=((new_state, state),))
+            return params, opt_state, state, loss, gnorm, ok
+
+        return step
+
+    @functools.cached_property
     def _train_scan(self):
         """K train steps in ONE dispatch: lax.scan over stacked batches.
 
@@ -455,6 +486,8 @@ class MultiLayerNetwork:
         so lax.scan is traced for exactly one length per batch shape."""
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        if _watchdog.ACTIVE is not None:
+            _watchdog.ACTIVE.beat(f"multilayer@{id(self):x}")
         _ps = _prof.ACTIVE             # armed ProfileSession: the whole
         if _ps is not None:            # scanned dispatch is one "step"
             _ps.step_start()
@@ -527,6 +560,38 @@ class MultiLayerNetwork:
 
         return step
 
+    @functools.cached_property
+    def _train_step_tbptt_guarded(self):
+        """Guardian variant of `_train_step_tbptt`: the same segment
+        update plus the device-side health verdict, applied only when
+        healthy — params, optimizer state, bn state AND the recurrent
+        carries (a NaN forward pass must not poison the hidden state
+        that threads into the next segment). Segments report
+        `on_step(retryable=False)`: earlier healthy segments of the same
+        batch already updated params, so the RETRY rung must never
+        re-run the whole batch."""
+        tx = self._tx
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step(params, opt_state, state, carries, x, y, fmask, lmask,
+                 rng, lr_scale, max_gnorm):
+            def lossf(p):
+                loss, (new_state, new_carries) = self._loss(
+                    p, state, x, y, fmask, lmask, rng, carries=carries)
+                return loss, (new_state, new_carries)
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                lossf, has_aux=True)(params)
+            # stop state flowing gradients across segments
+            new_carries = jax.lax.stop_gradient(new_carries)
+            params, opt_state, (state, carries), gnorm, ok = \
+                _guardian.guarded_apply(
+                    tx, grads, loss, params, opt_state, lr_scale,
+                    max_gnorm, constraints=self._apply_constraints,
+                    extra=((new_state, state), (new_carries, carries)))
+            return params, opt_state, state, carries, loss, gnorm, ok
+
+        return step
+
     def _zero_carries(self, batch):
         carries = {}
         for i, layer in enumerate(self.layers):
@@ -538,6 +603,8 @@ class MultiLayerNetwork:
                    features_mask=None):
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        if _watchdog.ACTIVE is not None:
+            _watchdog.ACTIVE.beat(f"multilayer@{id(self):x}")
         _ps = _prof.ACTIVE
         if _ps is not None:
             _ps.step_start()
@@ -559,26 +626,51 @@ class MultiLayerNetwork:
             carries = self._zero_carries(x.shape[0])
             total = None    # loss accumulates ON DEVICE: the old
             nseg = 0        # per-segment float() blocked every segment
+            _g = _guardian.ACTIVE
             with _mon.span("train.dispatch"):
                 for t0 in range(0, x.shape[1], tlen):
                     xs = x[:, t0:t0 + tlen]
                     ys = y[:, t0:t0 + tlen] if y.ndim == 3 else y
                     fs = None if fmask is None else fmask[:, t0:t0 + tlen]
                     ls = None if lmask is None else lmask[:, t0:t0 + tlen]
-                    (self._params, self._opt_state, self._state, carries,
-                     loss) = self._train_step_tbptt(
-                        self._params, self._opt_state, self._state, carries,
-                        xs, ys, fs, ls, jax.random.fold_in(sub, t0))
+                    if _g is not None:
+                        (self._params, self._opt_state, self._state,
+                         carries, loss, gnorm, ok) = \
+                            self._train_step_tbptt_guarded(
+                                self._params, self._opt_state, self._state,
+                                carries, xs, ys, fs, ls,
+                                jax.random.fold_in(sub, t0),
+                                _g.lr_scale, _g.max_gnorm)
+                        # retryable=False: the batch's earlier healthy
+                        # segments already updated params
+                        _g.on_step(loss, gnorm, ok, retryable=False)
+                    else:
+                        (self._params, self._opt_state, self._state,
+                         carries, loss) = self._train_step_tbptt(
+                            self._params, self._opt_state, self._state,
+                            carries, xs, ys, fs, ls,
+                            jax.random.fold_in(sub, t0))
                     total = loss if total is None else total + loss
                     nseg += 1
             self._score = None if total is None else total / nseg
         else:
+            _g = _guardian.ACTIVE
             with _mon.span("train.dispatch"):
-                self._params, self._opt_state, self._state, loss = \
-                    self._train_step(
+                if _g is not None:
+                    (self._params, self._opt_state, self._state, loss,
+                     gnorm, ok) = self._train_step_guarded(
                         self._params, self._opt_state, self._state, x, y,
-                        fmask, lmask, sub)
+                        fmask, lmask, sub, _g.lr_scale, _g.max_gnorm)
+                else:
+                    self._params, self._opt_state, self._state, loss = \
+                        self._train_step(
+                            self._params, self._opt_state, self._state,
+                            x, y, fmask, lmask, sub)
                 self._score = loss    # device scalar; score() floats it
+            if _g is not None:
+                # device scalars only — the guardian materializes them
+                # in one stacked read at its check cadence
+                _g.on_step(loss, gnorm, ok)
         self._iteration += 1
         # most recent training batch, for listeners that inspect
         # activations (StatsListener histograms — ≡ the reference
@@ -669,19 +761,31 @@ class MultiLayerNetwork:
         if self._params is None:
             self.init()
         if labels is not None:  # fit(features, labels)
-            with _mon.span("fit"):
-                self._fit_batch(as_jax(data), as_jax(labels))
+            try:
+                with _mon.span("fit"):
+                    self._fit_batch(as_jax(data), as_jax(labels))
+            finally:           # retire even on a raise: a FAILED fit is
+                #                not a wedged one (see iterator path)
+                if _watchdog.ACTIVE is not None:
+                    _watchdog.ACTIVE.retire(f"multilayer@{id(self):x}")
             return self
         if isinstance(data, DataSet):
-            with _mon.span("fit"):
-                self._fit_batch(data.features, data.labels,
-                                data.labelsMask, data.featuresMask)
+            try:
+                with _mon.span("fit"):
+                    self._fit_batch(data.features, data.labels,
+                                    data.labelsMask, data.featuresMask)
+            finally:
+                if _watchdog.ACTIVE is not None:
+                    _watchdog.ACTIVE.retire(f"multilayer@{id(self):x}")
             return self
         # iterator
         from deeplearning4j_tpu.nn.conf.builders import BackpropType
         k = max(1, int(stepsPerDispatch))
         if self.conf.backprop_type == BackpropType.TruncatedBPTT:
             k = 1
+        if _guardian.ACTIVE is not None:
+            k = 1    # guardian needs per-step health verdicts; a scan
+            #          group would hide k-1 of them inside one dispatch
         n_epochs = int(epochs) if epochs is not None else 1
 
         def flush(group):
@@ -720,6 +824,11 @@ class MultiLayerNetwork:
                             if hasattr(listener, "onEpochEnd"):
                                 listener.onEpochEnd(self)
         finally:
+            # the fit ended (or raised): this trainer's heartbeat is no
+            # longer stall evidence — an armed watchdog must not age it
+            # into a false trip while other trainers keep running
+            if _watchdog.ACTIVE is not None:
+                _watchdog.ACTIVE.retire(f"multilayer@{id(self):x}")
             if _pf is not None:
                 _pf.close()
         return self
@@ -769,6 +878,8 @@ class MultiLayerNetwork:
             if hasattr(it, "reset"):
                 it.reset()
             for ds in _mon.traced_iter(it, "eval.data_next"):
+                if _faults.ACTIVE is not None:
+                    _faults.ACTIVE.fire(_faults.EVAL_FORWARD)
                 with _mon.span("eval.batch"):
                     out = self.output(ds.features, fmask=ds.featuresMask)
                     evaluator.eval(ds.labels, out.numpy(),

@@ -19,6 +19,7 @@ import numpy as np
 
 from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience.errors import CheckpointIntegrityError
 
 
 class ElasticCheckpointer:
@@ -26,11 +27,25 @@ class ElasticCheckpointer:
     pytrees. `extra` carries whatever the trainer needs for step-accurate
     resume (rng key, batch-norm state, iteration counters)."""
 
-    def __init__(self, directory, max_to_keep=3, save_interval_steps=1):
+    def __init__(self, directory, max_to_keep=3, save_interval_steps=1,
+                 sweep_orphans=True):
+        """sweep_orphans=False skips the startup debris sweep — REQUIRED
+        when the directory is shared with another process that may have
+        an async save in flight (the sweep would rmtree its in-progress
+        orbax temp dir); the single-writer restart case keeps the
+        default."""
         import orbax.checkpoint as ocp
+
+        from deeplearning4j_tpu.resilience import integrity as _integrity
         self._ocp = ocp
         self.directory = os.path.abspath(str(directory))
         os.makedirs(self.directory, exist_ok=True)
+        # a kill mid-save leaves orbax tmp dirs / partial steps / stale
+        # manifests behind; sweep them BEFORE the manager scans the
+        # directory (startup only — no save from this process can be in
+        # flight yet). dl4j.resilience.ckpt_orphans_removed counts them.
+        self.orphans_removed = (_integrity.sweep_orphans(self.directory)
+                                if sweep_orphans else 0)
         self._closed = False
         self.manager = ocp.CheckpointManager(
             self.directory,
@@ -47,7 +62,11 @@ class ElasticCheckpointer:
         if check is not None:
             check()
 
-    def save(self, step, params, opt_state=None, extra=None, wait=False):
+    def save(self, step, params, opt_state=None, extra=None, wait=False,
+             verdict=None):
+        """`verdict` is the guardian health verdict recorded in the
+        integrity manifest ("verified" when the guardian vouched for
+        this state; defaults to "unguarded")."""
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.CHECKPOINT_SAVE)
         self.check_for_errors()     # previous async save failed → raise
@@ -76,6 +95,23 @@ class ElasticCheckpointer:
             state = jax.tree_util.tree_map(_snap, state)
         saved = self.manager.save(int(step),
                                   args=self._ocp.args.StandardSave(state))
+        if saved:
+            # integrity manifest from the SAME host snapshot orbax will
+            # serialize (no extra sync; cannot race donated buffers) —
+            # written atomically, so restore either sees a complete
+            # manifest or none
+            from deeplearning4j_tpu.resilience import \
+                integrity as _integrity
+            _integrity.write_manifest(self.directory, step, state,
+                                      verdict=verdict)
+            # reap sidecars whose generation max_to_keep GC just
+            # removed — without this a long run accumulates one orphan
+            # manifest per retired generation until the next restart.
+            # The just-saved step is kept explicitly: an async save may
+            # not appear in all_steps() yet
+            _integrity.prune_manifests(
+                self.directory,
+                keep=list(self.manager.all_steps()) + [int(step)])
         if saved and _mon.enabled():
             _mon.get_registry().counter(
                 _mon.RESILIENCE_CHECKPOINT_SAVES,
@@ -87,6 +123,10 @@ class ElasticCheckpointer:
 
     def latest_step(self):
         return self.manager.latest_step()
+
+    def all_steps(self):
+        """Every on-disk checkpoint generation, ascending."""
+        return sorted(int(s) for s in self.manager.all_steps())
 
     def restore(self, step=None, like=None):
         """Restore (step, state). `like` fixes the TREE STRUCTURE of the
@@ -106,6 +146,8 @@ class ElasticCheckpointer:
         path has never misread). Shapes are validated leaf-by-leaf so a
         structure mismatch fails loudly instead of silently
         transposing state."""
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.CHECKPOINT_RESTORE)
         step = self.manager.latest_step() if step is None else int(step)
         if step is None:
             return None, None
@@ -164,6 +206,44 @@ class ElasticCheckpointer:
                 grafted.append(host)
         return step, jax.tree_util.tree_unflatten(treedef, grafted)
 
+    def restore_verified(self, like=None, check_finite=True):
+        """Restore the newest checkpoint generation that passes
+        integrity verification (manifest checksums + finiteness — see
+        resilience/integrity.py), FALLING BACK a generation on any
+        restore or verification failure: a corrupted latest checkpoint
+        costs one generation of progress instead of the whole run.
+        Fallbacks land on `dl4j.resilience.ckpt_restore_fallbacks`.
+
+        Returns (step, state) like restore(); (None, None) when no
+        checkpoint exists at all; raises `CheckpointIntegrityError`
+        when generations exist but none could be restored."""
+        from deeplearning4j_tpu.resilience import integrity as _integrity
+        steps = self.all_steps()
+        if not steps:
+            return None, None
+        last_err = None
+        for step in reversed(steps):
+            try:
+                s, state = self.restore(step=step, like=like)
+                _integrity.verify_restored(self.directory, step, state,
+                                           check_finite=check_finite)
+                return s, state
+            except Exception as e:  # noqa: BLE001 — any failure here
+                # (orbax read error, injected restore fault, manifest
+                # mismatch, shape mismatch) means THIS generation is
+                # unusable; the one before it may not be
+                last_err = e
+                if _mon.enabled():
+                    _mon.get_registry().counter(
+                        _mon.RESILIENCE_CKPT_FALLBACKS,
+                        labels={"reason": type(e).__name__},
+                        help="checkpoint generations skipped on restore "
+                             "(corrupt/unreadable)").inc()
+        raise CheckpointIntegrityError(
+            f"no restorable checkpoint generation in {self.directory} "
+            f"({len(steps)} tried; newest failure: {last_err})") \
+            from last_err
+
     def close(self):
         """Idempotent: wait for any in-flight async save (never tear
         down a half-written checkpoint), surface deferred errors, then
@@ -209,22 +289,25 @@ class ElasticTrainer:
     """Wrap a ShardedTrainer-style step with periodic checkpoints and
     crash-resume (≡ fault-tolerant SharedTrainingMaster loop)."""
 
-    def __init__(self, trainer, directory, save_every=50, max_to_keep=3):
+    def __init__(self, trainer, directory, save_every=50, max_to_keep=3,
+                 sweep_orphans=True):
         self.trainer = trainer
         self.ckpt = ElasticCheckpointer(directory, max_to_keep=max_to_keep,
-                                        save_interval_steps=save_every)
+                                        save_interval_steps=save_every,
+                                        sweep_orphans=sweep_orphans)
         self.save_every = int(save_every)
         self.step_num = 0
 
     def resume_or_init(self, init_params):
-        """Restore the latest checkpoint if one exists, else shard the
-        given fresh params. Returns (params, opt_state)."""
+        """Restore the newest VERIFIED checkpoint if one exists (manifest
+        checksums + finiteness, falling back a generation on corruption —
+        the same integrity path FaultTolerantTrainer resumes through),
+        else shard the given fresh params. Returns (params, opt_state)."""
         params, opt_state = self.trainer.init(init_params)
-        latest = self.ckpt.latest_step()
-        if latest is None:
-            return params, opt_state
         like = {"params": params, "opt_state": opt_state}
-        step, state = self.ckpt.restore(like=like)
+        step, state = self.ckpt.restore_verified(like=like)
+        if step is None:
+            return params, opt_state
         self.step_num = step
         state = replace_on_mesh(self.trainer.mesh, like, state)
         return state["params"], state["opt_state"]

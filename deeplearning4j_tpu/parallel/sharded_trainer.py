@@ -30,6 +30,8 @@ from deeplearning4j_tpu.monitoring import profiler as _prof
 from deeplearning4j_tpu.nn.updaters import Updater
 from deeplearning4j_tpu.parallel.mesh import shard_map
 from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience import guardian as _guardian
+from deeplearning4j_tpu.resilience import watchdog as _watchdog
 
 
 def _as_tx(updater):
@@ -134,14 +136,50 @@ class ShardedTrainer:
         self._step = step
         return step
 
+    def make_guarded_step(self):
+        """Guardian variant of `make_step` (see
+        nn/multilayer._train_step_guarded): same update + device health
+        verdict (finite loss, finite global grad norm under the
+        guardian's threshold), applied only when healthy — a NaN
+        gradient never reaches the sharded params. The psum'd gnorm is
+        replicated, so every shard takes the same branch."""
+        cached = getattr(self, "_guarded_step", None)
+        if cached is not None:
+            return cached
+        tx = self.tx
+        loss_fn = self.loss_fn
+        donate = (0, 1) if self._donate else ()
+
+        @functools.partial(jax.jit, donate_argnums=donate)
+        def step(params, opt_state, batch, rng, lr_scale, max_gnorm):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+            params, opt_state, _, gnorm, ok = _guardian.guarded_apply(
+                tx, grads, loss, params, opt_state, lr_scale, max_gnorm)
+            return params, opt_state, loss, gnorm, ok
+
+        self._guarded_step = step
+        return step
+
     def fit_batch(self, params, opt_state, batch, rng):
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        if _watchdog.ACTIVE is not None:
+            _watchdog.ACTIVE.beat(f"sharded_trainer@{id(self):x}")
         _ps = _prof.ACTIVE
         if _ps is not None:
             _ps.step_start()
+        _g = _guardian.ACTIVE
         with _mon.span("sharded.dispatch"):
-            out = self.make_step()(params, opt_state, batch, rng)
+            if _g is not None:
+                params, opt_state, loss, gnorm, ok = \
+                    self.make_guarded_step()(params, opt_state, batch,
+                                             rng, _g.lr_scale,
+                                             _g.max_gnorm)
+                out = (params, opt_state, loss)
+            else:
+                out = self.make_step()(params, opt_state, batch, rng)
+        if _g is not None:
+            _g.on_step(loss, gnorm, ok)   # device scalars; no sync here
         _ps = _prof.ACTIVE
         if _ps is not None:
             _ps.step_end()
@@ -220,6 +258,8 @@ class ParameterAveragingTrainer:
     def fit_batch(self, params, opt_state, batch, rng, iteration):
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        if _watchdog.ACTIVE is not None:
+            _watchdog.ACTIVE.beat(f"param_averaging@{id(self):x}")
         _ps = _prof.ACTIVE
         if _ps is not None:
             _ps.step_start()

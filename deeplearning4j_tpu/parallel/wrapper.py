@@ -23,6 +23,8 @@ from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.monitoring import profiler as _prof
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh
 from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience import guardian as _guardian
+from deeplearning4j_tpu.resilience import watchdog as _watchdog
 from deeplearning4j_tpu.runtime import pipeline as _pipeline
 
 
@@ -197,6 +199,8 @@ class ParallelWrapper:
         raw (Multi)DataSet or a _StagedShards from the prefetcher."""
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        if _watchdog.ACTIVE is not None:
+            _watchdog.ACTIVE.beat(f"parallel_wrapper@{id(self):x}")
         _ps = _prof.ACTIVE
         if _ps is not None:
             _ps.step_start()
@@ -214,6 +218,7 @@ class ParallelWrapper:
                     else jax.device_put(fm, self.mesh.sharding("dp"))
             m = self.model
             m._rng_key, sub = jax.random.split(m._rng_key)
+        _g = _guardian.ACTIVE
         with _mon.span("parallel.dispatch"):
             if is_graph:
                 # the reference's ParallelWrapper wraps ComputationGraph
@@ -221,15 +226,29 @@ class ParallelWrapper:
                 # ComputationGraph._pack_single
                 ins, labels, fmasks, lmasks = m._pack_single(x, y, fmask,
                                                              lmask)
-                m._params, m._opt_state, m._state, loss = m._train_step(
-                    m._params, m._opt_state, m._state, ins, labels, fmasks,
-                    lmasks, sub)
+                if _g is not None:
+                    (m._params, m._opt_state, m._state, loss, gnorm,
+                     ok) = m._train_step_guarded(
+                        m._params, m._opt_state, m._state, ins, labels,
+                        fmasks, lmasks, sub, _g.lr_scale, _g.max_gnorm)
+                else:
+                    m._params, m._opt_state, m._state, loss = \
+                        m._train_step(m._params, m._opt_state, m._state,
+                                      ins, labels, fmasks, lmasks, sub)
             else:
                 ins = None
-                m._params, m._opt_state, m._state, loss = m._train_step(
-                    m._params, m._opt_state, m._state, x, y, fmask, lmask,
-                    sub)
+                if _g is not None:
+                    (m._params, m._opt_state, m._state, loss, gnorm,
+                     ok) = m._train_step_guarded(
+                        m._params, m._opt_state, m._state, x, y, fmask,
+                        lmask, sub, _g.lr_scale, _g.max_gnorm)
+                else:
+                    m._params, m._opt_state, m._state, loss = \
+                        m._train_step(m._params, m._opt_state, m._state,
+                                      x, y, fmask, lmask, sub)
             m._score = loss    # device scalar; score() floats on demand
+        if _g is not None:
+            _g.on_step(loss, gnorm, ok)   # device scalars; no sync here
         m._iteration += 1
         # StatsListener contract (ADVICE r5): the model-side fit paths set
         # both of these per real update — the wrapper's step must too, or
@@ -265,6 +284,8 @@ class ParallelWrapper:
     def _fit_group_scanned(self, group):
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        if _watchdog.ACTIVE is not None:
+            _watchdog.ACTIVE.beat(f"parallel_wrapper@{id(self):x}")
         _ps = _prof.ACTIVE
         if _ps is not None:
             _ps.step_start()
@@ -333,6 +354,8 @@ class ParallelWrapper:
         self._shard_model()
         it, pf = iterator, None
         k = max(1, int(stepsPerDispatch))
+        if _guardian.ACTIVE is not None:
+            k = 1    # per-step health verdicts (see model fit loops)
         if self.prefetch_buffer and hasattr(iterator, "asyncSupported") \
                 and iterator.asyncSupported():
             # k == 1: stage all the way onto the mesh (pad + dp-sharded
@@ -378,6 +401,10 @@ class ParallelWrapper:
                         flush()
                     self.model._epoch += 1
         finally:
+            # fit over: this trainer's heartbeat is no longer stall
+            # evidence (see multilayer.fit)
+            if _watchdog.ACTIVE is not None:
+                _watchdog.ACTIVE.retire(f"parallel_wrapper@{id(self):x}")
             if pf is not None:
                 pf.close()
         return self.model

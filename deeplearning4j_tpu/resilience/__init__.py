@@ -1,47 +1,90 @@
 """Resilience subsystem: retry/backoff, circuit breaking, deterministic
-fault injection, and checkpoint-resume training (≡ the reference's
+fault injection, checkpoint-resume training (≡ the reference's
 SharedTrainingMaster fault tolerance, where a restarted host rejoins
-from shared state, generalized into first-class runtime policies).
+from shared state, generalized into first-class runtime policies) — and
+the training GUARDIAN layer that protects the model state itself.
 
 Pieces:
 - `policy` — `RetryPolicy` (exponential backoff + seeded jitter,
   attempt/deadline budgets, OOM-never-retries classifier) and
   `CircuitBreaker` (closed/open/half-open with cooldown);
 - `faults` — seeded `FaultPlan` injection at named sites
-  (data.next / train.dispatch / checkpoint.save / inference.forward),
+  (data.next / train.dispatch / checkpoint.save / checkpoint.restore /
+  checkpoint.corrupt / eval.forward / inference.*),
   zero-cost-when-disabled hooks in the production paths;
 - `trainer` — `FaultTolerantTrainer`: periodic async checkpoints,
   step-accurate `resume_or_init`, retry around transient dispatch
-  failures, skip-and-count for corrupt batches;
-- `errors` — the typed degradation errors, including the
-  `InferenceTimeoutError` / `InferenceOverloadedError` raised by the
-  hardened `parallel/inference.py`.
+  failures, skip-and-count for corrupt batches, and the guardian/
+  watchdog driver (reduced-LR batch retry, checkpoint rollback,
+  health-gated saves);
+- `guardian` — `TrainingGuardian`: device-side divergence detection
+  (loss/grad-norm health folded into the jitted step, zero extra host
+  syncs) with the skip → reduce-LR → rollback → `DivergenceError`
+  escalation ladder;
+- `integrity` — checkpoint manifests (per-leaf checksums, atomic
+  rename) and verified restore with previous-generation fallback;
+- `watchdog` — `StallWatchdog`: per-trainer heartbeats + a monitor
+  thread that dumps a full crash report when a step exceeds
+  `DL4J_STALL_TIMEOUT`;
+- `errors` — the typed degradation errors.
 
-Everything is observable through `monitoring/` as `dl4j.resilience.*`
-with one-flag-check overhead when monitoring is off.
+Everything is observable through `monitoring/` (`dl4j.resilience.*`,
+`dl4j.guardian.*`, `dl4j.watchdog.*`) with one-flag-check overhead when
+monitoring is off, and summarized at `GET /health` on the UI server
+(`health_snapshot()`).
 """
 from __future__ import annotations
 
 from deeplearning4j_tpu.resilience.errors import (  # noqa: F401
-    CircuitOpenError, FatalTrainingError, InferenceOverloadedError,
-    InferenceTimeoutError, InjectedFault, ResilienceError,
-    RetryExhaustedError, TransientError)
+    CheckpointIntegrityError, CircuitOpenError, DivergenceError,
+    FatalTrainingError, InferenceOverloadedError, InferenceTimeoutError,
+    InjectedFault, ResilienceError, RetryExhaustedError, TransientError)
 from deeplearning4j_tpu.resilience.faults import (  # noqa: F401
-    CHECKPOINT_SAVE, DATA_NEXT, INFERENCE_COLLECTOR, INFERENCE_FORWARD,
-    TRAIN_DISPATCH, FaultPlan, clear_plan, install_plan)
+    CHECKPOINT_CORRUPT, CHECKPOINT_RESTORE, CHECKPOINT_SAVE, DATA_NEXT,
+    EVAL_FORWARD, INFERENCE_COLLECTOR, INFERENCE_FORWARD, TRAIN_DISPATCH,
+    FaultPlan, clear_plan, install_plan)
+from deeplearning4j_tpu.resilience.guardian import (  # noqa: F401
+    TrainingGuardian)
 from deeplearning4j_tpu.resilience.policy import (  # noqa: F401
     CircuitBreaker, RetryPolicy, default_classifier)
+from deeplearning4j_tpu.resilience.watchdog import (  # noqa: F401
+    StallWatchdog)
 
 __all__ = [
     "ResilienceError", "TransientError", "RetryExhaustedError",
     "CircuitOpenError", "InferenceTimeoutError",
     "InferenceOverloadedError", "InjectedFault", "FatalTrainingError",
+    "DivergenceError", "CheckpointIntegrityError",
     "RetryPolicy", "CircuitBreaker", "default_classifier",
     "FaultPlan", "install_plan", "clear_plan",
     "DATA_NEXT", "TRAIN_DISPATCH", "CHECKPOINT_SAVE",
+    "CHECKPOINT_RESTORE", "CHECKPOINT_CORRUPT", "EVAL_FORWARD",
     "INFERENCE_FORWARD", "INFERENCE_COLLECTOR",
+    "TrainingGuardian", "StallWatchdog", "health_snapshot",
     "FaultTolerantTrainer",
 ]
+
+
+def health_snapshot():
+    """The `GET /health` payload: overall status plus the installed
+    guardian's and watchdog's introspection snapshots (None when not
+    installed). Status ladder: a latched stall or an exhausted guardian
+    makes the process unhealthy; a guardian mid-escalation reports
+    degraded; otherwise ok."""
+    from deeplearning4j_tpu.resilience import guardian as _guardian
+    from deeplearning4j_tpu.resilience import watchdog as _watchdog
+    g = _guardian.ACTIVE
+    w = _watchdog.ACTIVE
+    gsnap = g.snapshot() if g is not None else None
+    wsnap = w.snapshot() if w is not None else None
+    status = "ok"
+    if gsnap is not None and gsnap["status"] == "degraded":
+        status = "degraded"
+    if wsnap is not None and wsnap["stalled"]:
+        status = "stalled"
+    if gsnap is not None and gsnap["status"] == "diverged":
+        status = "diverged"
+    return {"status": status, "guardian": gsnap, "watchdog": wsnap}
 
 
 def __getattr__(name):

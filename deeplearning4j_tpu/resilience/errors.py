@@ -13,6 +13,7 @@ __all__ = [
     "ResilienceError", "TransientError", "RetryExhaustedError",
     "CircuitOpenError", "InferenceTimeoutError",
     "InferenceOverloadedError", "InjectedFault", "FatalTrainingError",
+    "DivergenceError", "CheckpointIntegrityError",
 ]
 
 
@@ -63,3 +64,18 @@ class FatalTrainingError(ResilienceError):
     """A deliberately NON-retryable injected/classified failure — used by
     fault plans to simulate a process kill (the trainer must crash and
     later resume from its checkpoint, not retry through it)."""
+
+
+class DivergenceError(ResilienceError):
+    """The training guardian exhausted its escalation ladder
+    (skip-and-count → reduced-LR retry → checkpoint rollback) and the
+    run is still producing non-finite losses or grad-norm spikes.
+    Deliberately non-retryable: retrying a diverged run just re-diverges
+    — the fix is data/LR/config, and the model still holds the
+    last-known-good (rolled-back) parameters for a post-mortem."""
+
+
+class CheckpointIntegrityError(ResilienceError):
+    """A checkpoint failed manifest verification on restore (checksum /
+    structure mismatch, non-finite params, or a truncated write) and no
+    older generation could be restored either."""

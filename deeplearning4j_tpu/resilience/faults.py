@@ -3,11 +3,18 @@ tests drive; ≡ testing the reference's SharedTrainingMaster by killing
 Spark workers on schedule, but in-process and reproducible).
 
 Production code consults the harness through zero-cost-when-disabled
-hooks at four named sites:
+hooks at named sites:
 
     DATA_NEXT          "data.next"          — batch pulled from iterator
     TRAIN_DISPATCH     "train.dispatch"     — before the jitted step runs
     CHECKPOINT_SAVE    "checkpoint.save"    — before an async ckpt save
+    CHECKPOINT_RESTORE "checkpoint.restore" — before a ckpt restore read
+    CHECKPOINT_CORRUPT "checkpoint.corrupt" — inside manifest verification
+                                              (a fault here simulates a
+                                              corrupted checkpoint and
+                                              proves the previous-
+                                              generation fallback)
+    EVAL_FORWARD       "eval.forward"       — before an eval-loop forward
     INFERENCE_FORWARD  "inference.forward"  — before a coalesced forward
 
 The hook at every call site is literally
@@ -35,11 +42,18 @@ from deeplearning4j_tpu.resilience.errors import InjectedFault
 
 __all__ = ["FaultPlan", "install_plan", "clear_plan", "ACTIVE",
            "DATA_NEXT", "TRAIN_DISPATCH", "CHECKPOINT_SAVE",
+           "CHECKPOINT_RESTORE", "CHECKPOINT_CORRUPT", "EVAL_FORWARD",
            "INFERENCE_FORWARD", "INFERENCE_COLLECTOR"]
 
 DATA_NEXT = "data.next"
 TRAIN_DISPATCH = "train.dispatch"
 CHECKPOINT_SAVE = "checkpoint.save"
+CHECKPOINT_RESTORE = "checkpoint.restore"
+#: fires inside manifest verification (resilience/integrity.py) — a
+#: fault here is indistinguishable from a corrupted checkpoint, so the
+#: restore path must fall back to the previous generation
+CHECKPOINT_CORRUPT = "checkpoint.corrupt"
+EVAL_FORWARD = "eval.forward"
 INFERENCE_FORWARD = "inference.forward"
 #: fires in the collector LOOP (outside the per-batch try), so a fault
 #: here kills the collector thread itself — the scenario the breaker-

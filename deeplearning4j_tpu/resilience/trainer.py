@@ -38,19 +38,21 @@ import numpy as np
 
 from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.resilience import faults as _faults
-from deeplearning4j_tpu.resilience.errors import FatalTrainingError
+from deeplearning4j_tpu.resilience import guardian as _guardian
+from deeplearning4j_tpu.resilience import integrity as _integrity
+from deeplearning4j_tpu.resilience import watchdog as _watchdog
+from deeplearning4j_tpu.resilience.errors import (CheckpointIntegrityError,
+                                                  DivergenceError,
+                                                  FatalTrainingError)
 from deeplearning4j_tpu.resilience.policy import RetryPolicy
 
 __all__ = ["FaultTolerantTrainer"]
 
-
-def _finite(a):
-    if a is None:
-        return True
-    arr = np.asarray(a)
-    if not np.issubdtype(arr.dtype, np.floating):
-        return True            # int label ids etc. cannot be NaN
-    return bool(np.isfinite(arr).all())
+# canonical implementation in integrity.leaf_finite — it handles scalar
+# int/float leaves AND exotic float dtypes (bfloat16 registers with
+# numpy as kind 'V', so the old issubdtype(floating) gate silently
+# passed bfloat16 NaNs as finite)
+_finite = _integrity.leaf_finite
 
 
 def _dataset_arrays(ds):
@@ -70,20 +72,38 @@ def _dataset_arrays(ds):
 class FaultTolerantTrainer:
     def __init__(self, model, directory, save_every=25, max_to_keep=3,
                  retry_policy=None, skip_non_finite=True,
-                 max_skipped_batches=None, prefetch=2):
+                 max_skipped_batches=None, prefetch=2, guardian=None,
+                 watchdog=None, sweep_orphans=True):
         """prefetch: staging-queue depth for the host pipeline in
         network-mode fit() (0 disables). Batch consumption is counted on
         the CONSUMER side of the prefetch queue — i.e. at the training
         loop, in source order — so `step`/resume replay see exactly the
         batches that trained, never ones merely sitting staged in the
-        queue: kill/resume stays bit-identical with prefetch on."""
+        queue: kill/resume stays bit-identical with prefetch on.
+
+        guardian: a `TrainingGuardian` this trainer DRIVES — installed
+        around fit(), its reduced-LR escalations re-run the offending
+        batch, its rollback requests restore the last verified
+        checkpoint in place, and saves are gated on its health verdict
+        (a poisoned tree is never persisted; the manifest records the
+        verdict).
+
+        watchdog: a `StallWatchdog` armed/disarmed around fit() (the
+        caller owns start()/stop() of its monitor thread).
+
+        sweep_orphans: pass False when `directory` is SHARED with other
+        concurrently-saving processes (multi-host) — the startup debris
+        sweep would delete a peer's in-flight orbax temp dir."""
         from deeplearning4j_tpu.parallel.elastic import ElasticCheckpointer
         self.model = model
+        self.guardian = guardian
+        self.watchdog = watchdog
         self.prefetch = int(prefetch)
         # our `step` counter (batches consumed) drives save cadence, so
         # the manager itself saves every step it is asked to
         self.ckpt = ElasticCheckpointer(directory, max_to_keep=max_to_keep,
-                                        save_interval_steps=1)
+                                        save_interval_steps=1,
+                                        sweep_orphans=sweep_orphans)
         self.save_every = int(save_every)
         self.retry = retry_policy or RetryPolicy(max_attempts=3)
         self.skip_non_finite = bool(skip_non_finite)
@@ -129,36 +149,69 @@ class FaultTolerantTrainer:
             extra["net_state"] = m._state
         return extra
 
-    def _save_network(self, wait=False):
+    def _save_network(self, wait=False, verdict=None):
         m = self.model
         self.ckpt.save(self.step, m._params, m._opt_state,
-                       extra=self._net_extra(), wait=wait)
+                       extra=self._net_extra(), wait=wait,
+                       verdict=verdict)
 
-    def resume_or_init(self):
-        """Network mode: restore the latest checkpoint INTO the wrapped
-        (already-initialized) model. Returns the restored step (batches
-        already consumed by the crashed run), 0 when starting fresh."""
+    def _guardian_allows_save(self, g):
+        """THE save gate, shared by both modes: a tree the guardian
+        cannot vouch for (mid-escalation, unresolved bad streak) is
+        NEVER persisted — the whole point of rollback is that every
+        on-disk generation is a known-good target. Withheld saves count
+        on dl4j.guardian.saves_gated."""
+        if g is None or g.verify_now():
+            return True
+        if _mon.enabled():
+            _mon.get_registry().counter(
+                _mon.GUARDIAN_SAVES_GATED,
+                help="checkpoint saves withheld because the guardian "
+                     "could not vouch for the params").inc()
+        return False
+
+    def _maybe_save(self, g, wait=False):
+        """Gated checkpoint save; the verdict lands in the integrity
+        manifest."""
+        if not self._guardian_allows_save(g):
+            return False
+        self._save_network(wait=wait,
+                           verdict=None if g is None else "verified")
+        return True
+
+    def _drive_guardian(self, g, ds):
+        """Consume the guardian's escalation actions after a trained
+        batch: RETRY re-runs the SAME batch (the guarded step already
+        refused the bad update, so params are still pre-batch, and
+        `lr_scale` is now reduced); ROLLBACK restores the newest
+        verified checkpoint in place. Bounded by the ladder depth —
+        each pass through can escalate at most one rung."""
+        for _ in range(g.max_lr_retries + g.max_rollbacks + 1):
+            act = g.take_action()
+            if act is None:
+                return
+            if act == _guardian.RETRY:
+                self._fit_one(ds)
+                continue
+            if act == _guardian.ROLLBACK:
+                self._rollback_network(g)
+                return
+
+    def _load_network_state(self, like, state):
+        """Graft restored state into the live model, rebuilding every
+        leaf as an XLA-OWNED device array before the donating train
+        step ever sees it (see parallel/elastic.xla_owned_copy:
+        jnp.asarray zero-copy aliases numpy memory, and donation then
+        frees a buffer numpy owns — intermittent heap corruption after
+        resume). Uncommitted like init()'s arrays; mesh-sharded leaves
+        get the explicit NamedSharding device_put. Returns the restored
+        step counter (batches consumed when the checkpoint was
+        written)."""
         import jax
-        m = self.model
-        if m._params is None:
-            m.init()
-        latest = self.ckpt.latest_step()
-        if latest is None:
-            return 0
-        like = {"params": m._params, "opt_state": m._opt_state,
-                "extra": self._net_extra()}
-        step, state = self.ckpt.restore(like=like)
-
-        # Rebuild restored leaves as XLA-OWNED device arrays before the
-        # donating train step ever sees them (see
-        # parallel/elastic.xla_owned_copy: jnp.asarray zero-copy
-        # aliases numpy memory, and donation then frees a buffer numpy
-        # owns — intermittent heap corruption after resume). Uncommitted
-        # like init()'s arrays; mesh-sharded leaves get the explicit
-        # NamedSharding device_put.
         from jax.sharding import NamedSharding
 
         from deeplearning4j_tpu.parallel.elastic import xla_owned_copy
+        m = self.model
 
         def place(fresh, restored):
             if not hasattr(restored, "shape"):
@@ -184,9 +237,63 @@ class FaultTolerantTrainer:
         # epochs (final _epoch = restored + epochs instead of epochs).
         # The checkpointed value stays available in the dump for
         # post-mortems.
-        self.step = int(extra["step"])
+        return int(extra["step"])
+
+    def resume_or_init(self):
+        """Network mode: restore the newest VERIFIED checkpoint INTO the
+        wrapped (already-initialized) model — manifest-checksum and
+        finiteness verified, falling back a generation when the latest
+        is corrupt (resilience/integrity.py). Returns the restored step
+        (batches already consumed by the crashed run), 0 when starting
+        fresh."""
+        m = self.model
+        if m._params is None:
+            m.init()
+        latest = self.ckpt.latest_step()
+        if latest is None:
+            return 0
+        like = {"params": m._params, "opt_state": m._opt_state,
+                "extra": self._net_extra()}
+        step, state = self.ckpt.restore_verified(like=like)
+        if step is None:
+            return 0
+        self.step = self._load_network_state(like, state)
         self._note_resume(self.step)
         return self.step
+
+    def _restore_for_rollback(self, like):
+        """THE rollback restore both modes share: flush in-flight async
+        saves (the newest verified generation may still be writing —
+        reading it mid-write would needlessly burn a generation on the
+        fallback ladder), restore the newest VERIFIED generation, and
+        translate 'nothing restorable' into `DivergenceError`."""
+        try:
+            self.ckpt.manager.wait_until_finished()
+            step, state = self.ckpt.restore_verified(like=like)
+        except CheckpointIntegrityError as e:
+            raise DivergenceError(
+                "guardian requested rollback but no checkpoint "
+                "generation could be restored") from e
+        if step is None:
+            raise DivergenceError(
+                "guardian requested rollback but no verified checkpoint "
+                "exists yet (diverged before the first save)")
+        return step, state
+
+    def _rollback_network(self, g):
+        """Guardian-requested rollback: restore the newest verified
+        checkpoint IN PLACE (params, opt state, rng, counters — exactly
+        the resume path, minus the iterator bookkeeping: `self.step`
+        keeps counting real iterator positions so replay alignment and
+        save cadence are untouched). Raises `DivergenceError` when
+        there is nothing to roll back to."""
+        m = self.model
+        like = {"params": m._params, "opt_state": m._opt_state,
+                "extra": self._net_extra()}
+        step, state = self._restore_for_rollback(like)
+        restored = self._load_network_state(like, state)
+        g.note_rollback(restored)
+        return restored
 
     def _snapshot(self):
         m = self.model
@@ -259,6 +366,35 @@ class FaultTolerantTrainer:
                             "functional trainers")
         already = self.resume_or_init()
         consumed = 0
+        # guardian/watchdog scope: install the guardian for the duration
+        # of this fit (unless the caller already installed it) and arm
+        # the watchdog's stall detection
+        g = self.guardian
+        g_installed = False
+        if g is not None and _guardian.ACTIVE is not g:
+            g.install()
+            g_installed = True
+        elif g is None:
+            # a with-block guardian the caller installed (no guardian=
+            # kwarg): the guarded step already reports to it, so this
+            # fit must also DRIVE it — consume retry/rollback actions
+            # and gate saves on its verdict (mirrors sharded fit_batch,
+            # which reads ACTIVE too)
+            g = _guardian.ACTIVE
+        g_prev_driver = None
+        if g is not None:
+            # this fit DRIVES the guardian (take_action after each
+            # batch), so escalation actions must survive mid-batch
+            # flushes — a TBPTT segment loop flushes per segment, and a
+            # ROLLBACK raised on an early segment has to still be
+            # pending when _drive_guardian runs after the batch
+            g_prev_driver = g.driver_attached
+            g.driver_attached = True
+        # arm the watchdog for this fit; arm/disarm nest, so a caller's
+        # wider armed window (multi-phase script) or a concurrent fit
+        # sharing this watchdog keeps detection on after this one ends
+        if self.watchdog is not None:
+            self.watchdog.arm()
         # host pipeline: batches stage to XLA-owned device buffers in
         # the background; the finite check happens on the HOST arrays
         # inside the worker (pre-staging), so the consumer loop reads a
@@ -361,11 +497,13 @@ class FaultTolerantTrainer:
                                 self._count_skip("non_finite")
                                 continue
                         self._fit_one(ds)
+                        if g is not None:
+                            self._drive_guardian(g, ds)
                         self.step = consumed
                         if self.step % self.save_every == 0:
-                            self._save_network()
+                            self._maybe_save(g)
                     self.model._epoch += 1
-            self._save_network(wait=True)
+            self._maybe_save(g, wait=True)
         except Exception:
             # simulate-kill paths land here: flush in-flight saves so the
             # restart can restore the newest completed checkpoint
@@ -375,6 +513,19 @@ class FaultTolerantTrainer:
                 pass
             raise
         finally:
+            if g is not None:
+                g.driver_attached = g_prev_driver
+            if g_installed:
+                g.uninstall()    # restore any guardian this one shadowed
+            # _fit_one beats through model._fit_batch (never model.fit),
+            # so the model fit epilogues' retire never runs here — under
+            # a caller-armed wider window the stale beat would age into
+            # a false stall trip during the next phase
+            if _watchdog.ACTIVE is not None:
+                kind = "multilayer" if self._is_multilayer() else "graph"
+                _watchdog.ACTIVE.retire(f"{kind}@{id(self.model):x}")
+            if self.watchdog is not None:
+                self.watchdog.disarm()
             if pf is not None:
                 pf.close()
         return self.model
@@ -392,16 +543,42 @@ class FaultTolerantTrainer:
         if latest is None:
             return params, opt_state
         like = {"params": params, "opt_state": opt_state}
-        step, state = self.ckpt.restore(like=like)
+        step, state = self.ckpt.restore_verified(like=like)
+        if step is None:
+            return params, opt_state
         state = replace_on_mesh(trainer.mesh, like, state)
         self.step = int(step)
         self._note_resume(self.step)
+        return state["params"], state["opt_state"]
+
+    def _rollback_sharded(self, g, params, opt_state):
+        """Guardian rollback, functional style: returns the restored
+        (params, opt_state) re-placed on the trainer's mesh — the caller
+        simply carries on with them (`fit_batch` returns them
+        transparently)."""
+        from deeplearning4j_tpu.parallel.elastic import replace_on_mesh
+        like = {"params": params, "opt_state": opt_state}
+        step, state = self._restore_for_rollback(like)
+        state = replace_on_mesh(self.model.mesh, like, state)
+        g.note_rollback(int(step))
         return state["params"], state["opt_state"]
 
     def fit_batch(self, params, opt_state, batch, rng):
         """Sharded mode: one retried step + periodic save. Non-finite
         batches return the inputs unchanged with loss None."""
         trainer = self.model
+        # a guardian handed to the constructor is installed here (the
+        # functional style has no fit() scope to install it in) — the
+        # sharded step only computes its health verdict for the guardian
+        # that is ACTIVE at dispatch. Left installed across calls;
+        # close() clears it.
+        if self.guardian is not None \
+                and _guardian.ACTIVE is not self.guardian:
+            self.guardian.install()
+        if self.guardian is not None:
+            # driven every call (take_action below) — actions must not
+            # be dropped by an intervening flush; close() resets
+            self.guardian.driver_attached = True
         if self.skip_non_finite:
             import jax
             # only HOST-resident leaves are checked: np.asarray on an
@@ -430,18 +607,41 @@ class FaultTolerantTrainer:
             trainer.fit_batch, params, opt_state, batch, rng,
             label="train.dispatch", on_retry=on_retry)
         self.step += 1
-        if self.step % self.save_every == 0:
-            self.ckpt.save(self.step, params, opt_state)
+        # guardian escalations, functional flavor: the batch's inputs
+        # were donated, so the RETRY rung cannot literally re-run it —
+        # the reduced lr_scale applies from the next step instead (the
+        # guarded step already refused the bad update); ROLLBACK swaps
+        # in the restored state transparently
+        g = _guardian.ACTIVE
+        if g is not None:
+            act = g.take_action()
+            if act == _guardian.ROLLBACK:
+                params, opt_state = self._rollback_sharded(
+                    g, params, opt_state)
+        if self.step % self.save_every == 0 \
+                and self._guardian_allows_save(g):
+            self.ckpt.save(self.step, params, opt_state,
+                           verdict=None if g is None else "verified")
         return params, opt_state, loss
 
     def finalize(self, params=None, opt_state=None):
         """Final synchronous save (sharded mode passes the live state;
-        network mode reads it off the model) and close."""
+        network mode reads it off the model) and close. The save is
+        GATED like every other: a tree the guardian cannot vouch for is
+        not persisted on the way out either — the run ends with the
+        last verified generation as the newest on disk."""
+        g = self.guardian if self.guardian is not None \
+            else _guardian.ACTIVE
         if params is not None:
-            self.ckpt.save(self.step, params, opt_state, wait=True)
+            if self._guardian_allows_save(g):
+                self.ckpt.save(self.step, params, opt_state, wait=True,
+                               verdict=None if g is None else "verified")
         elif self._is_network and self.model._params is not None:
-            self._save_network(wait=True)
+            self._maybe_save(g, wait=True)
         self.close()
 
     def close(self):
+        if self.guardian is not None:
+            self.guardian.driver_attached = False
+            self.guardian.uninstall()
         self.ckpt.close()

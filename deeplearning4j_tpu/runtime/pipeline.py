@@ -91,6 +91,25 @@ def xla_owned_copy(host, sharding=None):
 
 
 # -- lazy score ------------------------------------------------------------
+def record_sync(site, blocked_ms):
+    """Account ONE host-blocking device sync: `dl4j.pipeline.syncs` +
+    `host_blocked_ms` + flight-recorder attribution (the stall lands on
+    the current step's record, so GET /steps phase coverage stays
+    honest). Shared by `blocking_float` and the guardian's stacked
+    verdict read — the zero-sync regression harness counts both through
+    the same metric."""
+    if not _mon.enabled():
+        return
+    reg = _mon.get_registry()
+    reg.counter(_mon.PIPELINE_SYNCS, labels={"site": site},
+                help="host-blocking device syncs (0/step when the "
+                     "pipeline is healthy)").inc()
+    reg.histogram(_mon.PIPELINE_HOST_BLOCKED_MS, labels={"site": site},
+                  help="wall time the host spent blocked per sync") \
+       .observe(blocked_ms)
+    _mon.step_recorder().on_host_blocked(blocked_ms)
+
+
 def blocking_float(value, site="score"):
     """float(device scalar), COUNTED: every call that actually blocks on
     the device lands on `dl4j.pipeline.syncs` (+ a host_blocked_ms
@@ -104,16 +123,7 @@ def blocking_float(value, site="score"):
         return float(value)
     t0 = time.perf_counter()
     v = float(value)
-    blocked_ms = (time.perf_counter() - t0) * 1e3
-    reg = _mon.get_registry()
-    reg.counter(_mon.PIPELINE_SYNCS, labels={"site": site},
-                help="host-blocking device syncs (0/step when the "
-                     "pipeline is healthy)").inc()
-    reg.histogram(_mon.PIPELINE_HOST_BLOCKED_MS, labels={"site": site},
-                  help="wall time the host spent blocked per sync") \
-       .observe(blocked_ms)
-    # attribute the stall to the current step's flight-recorder record
-    _mon.step_recorder().on_host_blocked(blocked_ms)
+    record_sync(site, (time.perf_counter() - t0) * 1e3)
     return v
 
 

@@ -305,6 +305,22 @@ class UIServer:
                     body = json.dumps({"records": rec.records(last=last),
                                        "summary": rec.summary()}).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/health"):
+                    # training-guardian + stall-watchdog state
+                    # (resilience.health_snapshot): 200 while healthy,
+                    # 503 when stalled or diverged — load balancers and
+                    # supervisors key off the status code alone
+                    from deeplearning4j_tpu import resilience as _res
+                    snap = _res.health_snapshot()
+                    body = json.dumps(snap).encode()
+                    code = 200 if snap["status"] in ("ok", "degraded") \
+                        else 503
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 elif self.path.startswith("/metrics"):
                     # Prometheus scrape surface for the host-side
                     # monitoring registry; with monitoring ENABLED the

@@ -30,7 +30,12 @@ import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-#: per-step hot-path modules (relative to the repo root)
+#: per-step hot-path modules (relative to the repo root). The
+#: resilience entries are the guardian/watchdog/fault hooks that sit
+#: INSIDE every train step — their registry calls must be behind the
+#: enabled-guard exactly like the trainers' own instrumentation
+#: (resilience/policy.py stays unlinted: breaker trips and retry
+#: backoffs are cold by definition).
 HOT_MODULES = [
     "deeplearning4j_tpu/nn/multilayer.py",
     "deeplearning4j_tpu/nn/graph.py",
@@ -39,6 +44,10 @@ HOT_MODULES = [
     "deeplearning4j_tpu/parallel/wrapper.py",
     "deeplearning4j_tpu/parallel/sharded_trainer.py",
     "deeplearning4j_tpu/parallel/inference.py",
+    "deeplearning4j_tpu/resilience/guardian.py",
+    "deeplearning4j_tpu/resilience/watchdog.py",
+    "deeplearning4j_tpu/resilience/faults.py",
+    "deeplearning4j_tpu/resilience/trainer.py",
 ]
 
 #: attribute calls that hit the registry

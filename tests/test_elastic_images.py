@@ -81,6 +81,34 @@ class TestElastic:
         assert np.isfinite(float(loss))
         et2.finalize(params2, opt2)
 
+    def test_resume_falls_back_past_corrupt_generation(self, tmp_path):
+        """ElasticTrainer resumes through the integrity-verified path:
+        a corrupted newest generation costs one generation of progress,
+        not a silent resume from poisoned bytes."""
+        from deeplearning4j_tpu.resilience import integrity
+        ckdir = tmp_path / "elastic"
+        trainer = _make_trainer()
+        et = ElasticTrainer(trainer, ckdir, save_every=2)
+        init = {"w": np.ones((4, 2), np.float32),
+                "b": np.zeros((2,), np.float32)}
+        params, opt = et.resume_or_init(init)
+        rng = jax.random.PRNGKey(0)
+        snapshots = {}
+        for i in range(10):
+            params, opt, _ = et.fit_batch(params, opt, _batch(i), rng)
+            snapshots[et.step_num] = np.asarray(params["w"]).copy()
+        et.ckpt.manager.wait_until_finished()
+
+        mpath = integrity.manifest_path(ckdir, 10)
+        doc = open(mpath).read().replace("crc32:", "crc32:dead", 1)
+        open(mpath, "w").write(doc)
+
+        trainer2 = _make_trainer()
+        et2 = ElasticTrainer(trainer2, ckdir, save_every=2)
+        params2, _ = et2.resume_or_init(init)
+        assert et2.step_num == 8, "corrupt newest must fall back one gen"
+        assert np.allclose(np.asarray(params2["w"]), snapshots[8])
+
     def test_multihost_noop_without_env(self, monkeypatch):
         monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
         assert initialize_multihost() is False

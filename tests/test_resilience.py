@@ -138,8 +138,10 @@ def test_retry_deadline_budget():
 def test_retry_jitter_deterministic():
     a = RetryPolicy(seed=42, jitter=0.5)
     b = RetryPolicy(seed=42, jitter=0.5)
-    assert [a.backoff(i) for i in range(1, 6)] == \
-        [b.backoff(i) for i in range(1, 6)]
+    schedule = [a.backoff(i) for i in range(1, 6)]
+    assert schedule == [b.backoff(i) for i in range(1, 6)]
+    c = RetryPolicy(seed=43, jitter=0.5)
+    assert schedule != [c.backoff(i) for i in range(1, 6)]
 
 
 # ===================== CircuitBreaker =====================================
