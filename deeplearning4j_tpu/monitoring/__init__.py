@@ -48,6 +48,13 @@ from deeplearning4j_tpu.monitoring.steps import (  # noqa: F401
 from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry,
     JIT_CACHE_MISSES, JIT_COMPILE_SECONDS, OP_DISPATCHES,
+    JIT_PERSISTENT_HITS, JIT_PERSISTENT_MISSES,
+    JIT_PERSISTENT_REQUESTS,
+    EXEC_COMPILES, EXEC_COMPILE_SECONDS, EXEC_DISK_HITS,
+    EXEC_DESERIALIZE_FAILURES, EXEC_SERIALIZE_FAILURES,
+    SERVING_ROWS, SERVING_PADDED_ROWS, SERVING_BUCKET_OCCUPANCY,
+    SERVING_SPLITS, SERVING_STAGED_BUFFERS, SERVING_STAGING_OCCUPANCY,
+    SERVING_AOT_FALLBACKS,
     TRANSFER_H2D_BYTES, DEVICE_MEMORY_BYTES, DEVICE_MEMORY_SUPPORTED,
     HOST_RSS_BYTES,
     RESILIENCE_RETRIES, RESILIENCE_BACKOFF_SECONDS,
@@ -86,6 +93,13 @@ __all__ = [
     "MODEL_PARAMS_BYTES", "MODEL_OPT_STATE_BYTES",
     "MODEL_LAYER_STATE_BYTES",
     "JIT_CACHE_MISSES", "JIT_COMPILE_SECONDS", "OP_DISPATCHES",
+    "JIT_PERSISTENT_HITS", "JIT_PERSISTENT_MISSES",
+    "JIT_PERSISTENT_REQUESTS",
+    "EXEC_COMPILES", "EXEC_COMPILE_SECONDS", "EXEC_DISK_HITS",
+    "EXEC_DESERIALIZE_FAILURES", "EXEC_SERIALIZE_FAILURES",
+    "SERVING_ROWS", "SERVING_PADDED_ROWS", "SERVING_BUCKET_OCCUPANCY",
+    "SERVING_SPLITS", "SERVING_STAGED_BUFFERS",
+    "SERVING_STAGING_OCCUPANCY", "SERVING_AOT_FALLBACKS",
     "TRANSFER_H2D_BYTES", "DEVICE_MEMORY_BYTES",
     "DEVICE_MEMORY_SUPPORTED", "HOST_RSS_BYTES",
     "RESILIENCE_RETRIES", "RESILIENCE_BACKOFF_SECONDS",

@@ -30,7 +30,39 @@ from deeplearning4j_tpu.monitoring.state import STATE
 # sanitized to underscores in the Prometheus exposition)
 JIT_CACHE_MISSES = "dl4j.jit.cache_misses"
 JIT_COMPILE_SECONDS = "dl4j.jit.compile_seconds"
+# persistent-compilation-cache tier split (runtime/executables.py wires
+# jax's cache events): a "hit" skipped the XLA compile (served from the
+# cross-process on-disk cache); "requests" counts every compile that
+# consulted the cache, so live compiles = requests - hits. NOTE jax's
+# "miss" event fires only when a NEW entry is WRITTEN — sub-threshold
+# compiles (jax_persistent_cache_min_compile_time_secs/_entry_size) are
+# not persisted and land in neither hits nor misses, only in requests.
+JIT_PERSISTENT_HITS = "dl4j.jit.persistent_hits"
+JIT_PERSISTENT_MISSES = "dl4j.jit.persistent_misses"
+JIT_PERSISTENT_REQUESTS = "dl4j.jit.persistent_requests"
 OP_DISPATCHES = "dl4j.op.dispatches"
+
+# AOT serving-executable store (runtime/executables.py): two-tier cache
+# of pre-compiled bucketed forwards. Steady-state serving must show ZERO
+# compiles — every forward resolves in the in-memory tier; a restarted
+# replica warms via disk_hits (deserialize, no XLA compile)
+EXEC_COMPILES = "dl4j.exec.compiles"
+EXEC_COMPILE_SECONDS = "dl4j.exec.compile_seconds"
+EXEC_DISK_HITS = "dl4j.exec.disk_hits"
+EXEC_DESERIALIZE_FAILURES = "dl4j.exec.deserialize_failures"
+EXEC_SERIALIZE_FAILURES = "dl4j.exec.serialize_failures"
+
+# shape-bucketed continuous batching (parallel/inference.py AOT path):
+# padding waste = padded_rows / (rows + padded_rows); occupancy is the
+# per-dispatch fill ratio rows/bucket; splits count oversized batches
+# served across several max-bucket dispatches instead of a novel shape
+SERVING_ROWS = "dl4j.serving.rows"
+SERVING_PADDED_ROWS = "dl4j.serving.padded_rows"
+SERVING_BUCKET_OCCUPANCY = "dl4j.serving.bucket_occupancy"
+SERVING_SPLITS = "dl4j.serving.splits"
+SERVING_STAGED_BUFFERS = "dl4j.serving.staged_buffers"
+SERVING_STAGING_OCCUPANCY = "dl4j.serving.staging_occupancy"
+SERVING_AOT_FALLBACKS = "dl4j.serving.aot_fallbacks"
 TRANSFER_H2D_BYTES = "dl4j.transfer.host_to_device_bytes"
 DEVICE_MEMORY_BYTES = "dl4j.device.memory_bytes"
 DEVICE_MEMORY_SUPPORTED = "dl4j.device.memory_stats_supported"

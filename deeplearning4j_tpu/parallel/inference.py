@@ -24,6 +24,21 @@ Graceful degradation (resilience/): callers NEVER block indefinitely.
 Sheds, timeouts, and restarts count through `monitoring/`
 (`dl4j.resilience.inference_*` / `collector_restarts`).
 
+Serving-grade AOT path (runtime/executables.py): configuring a bucket
+ladder switches dispatch from the live `model.output` trace to
+ahead-of-time compiled executables — one per bucketed input signature,
+warmed at startup (`warmup()`), persisted/restored through the
+versioned on-disk executable cache (`DL4J_EXEC_CACHE`). Steady state
+is then enqueue → pad-to-bucket → dispatch with ZERO jit traces, ZERO
+XLA compiles and zero host-owned aliasing (inputs enter the device
+through `StagingRing`/`xla_owned_copy` and are donated). Oversized
+batches split across max-bucket chunks (µ-cuDNN micro-batching)
+instead of compiling a novel shape; for sequence models a length
+ladder pads the time axis under a validity mask. Any AOT-path failure
+counts `dl4j.serving.aot_fallbacks` and PERMANENTLY reverts this
+instance to the legacy live path — serving never goes down over a
+cache problem.
+
 Usage parity:
     pi = (ParallelInference.Builder(net)
           .inferenceMode(InferenceMode.BATCHED)
@@ -31,6 +46,14 @@ Usage parity:
     out = pi.output(x)                    # thread-safe, blocks
     out = pi.output(x, timeout_ms=50)     # bounded wait
     pi.shutdown()
+
+Low-latency serving:
+    pi = (ParallelInference.Builder(net)
+          .bucketLadder([1, 2, 4, 8, 16])     # batch buckets
+          .executableCacheDir("/var/dl4j/exec")
+          .build())
+    pi.warmup()                           # ladder pre-compiled/loaded
+    out = pi.output(x)                    # zero-compile steady state
 """
 from __future__ import annotations
 
@@ -79,9 +102,23 @@ class _Request:
 class ParallelInference:
     def __init__(self, model, inference_mode=InferenceMode.BATCHED,
                  batch_limit=32, queue_limit=256, collect_timeout_ms=2.0,
-                 enqueue_timeout_ms=100.0, breaker=None):
+                 enqueue_timeout_ms=100.0, breaker=None,
+                 bucket_ladder=None, length_buckets=None,
+                 exec_cache_dir=None, staging_depth=2):
         self.model = model
         self.mode = inference_mode
+        # AOT serving: a configured ladder closes the shape vocabulary
+        # and switches dispatch to pre-compiled executables; admission
+        # then coalesces up to the ladder's top bucket by default
+        self._ladder = None
+        self._length_buckets = length_buckets  # warmup()'s default ladder
+        if bucket_ladder is not None:
+            from deeplearning4j_tpu.runtime.executables import BucketLadder
+            self._ladder = (bucket_ladder
+                            if isinstance(bucket_ladder, BucketLadder)
+                            else BucketLadder(batch=bucket_ladder,
+                                              length=length_buckets))
+            batch_limit = self._ladder.max_batch
         self.batch_limit = int(batch_limit)
         self.collect_timeout = collect_timeout_ms / 1e3
         self.enqueue_timeout = enqueue_timeout_ms / 1e3
@@ -89,6 +126,11 @@ class ParallelInference:
         self.collector_restarts = 0   # diagnostic: breaker-guarded revives
         self.collector_error = None   # last error that killed a collector
         self._restart_unconfirmed = False   # revive awaiting 1st success
+        self._exec_cache_dir = exec_cache_dir
+        self._staging_depth = int(staging_depth)
+        self._store = None            # ExecutableStore, built lazily
+        self._ring = None             # StagingRing, built with the store
+        self._aot_error = None        # first AOT failure (diagnostic)
         self._queue = queue.Queue(maxsize=int(queue_limit))
         self._claim_lock = threading.Lock()
         self._lifecycle_lock = threading.Lock()   # restart + shutdown
@@ -140,6 +182,32 @@ class ParallelInference:
         def breaker(self, breaker):
             """Circuit breaker guarding collector-thread restarts."""
             self._kw["breaker"] = breaker
+            return self
+
+        def bucketLadder(self, buckets):
+            """Batch-bucket ladder (list of ints or a BucketLadder):
+            switches dispatch to AOT pre-compiled executables, one per
+            bucketed signature. batchLimit defaults to the top rung."""
+            self._kw["bucket_ladder"] = buckets
+            return self
+
+        def lengthBuckets(self, buckets):
+            """Sequence-length ladder: recurrent inputs pad their time
+            axis to the smallest admitting rung under a validity mask."""
+            self._kw["length_buckets"] = buckets
+            return self
+
+        def executableCacheDir(self, path):
+            """On-disk AOT executable cache root (default
+            $DL4J_EXEC_CACHE): a restarted replica warmup()s by
+            deserializing, not compiling."""
+            self._kw["exec_cache_dir"] = path
+            return self
+
+        def stagingDepth(self, n):
+            """Device input staging-ring depth (how many dispatches of
+            inputs may be staged ahead, default 2)."""
+            self._kw["staging_depth"] = int(n)
             return self
 
         def workers(self, *_):
@@ -382,7 +450,10 @@ class ParallelInference:
             batch = [first]
             strays = []    # incompatible shapes: run AFTER the main batch
             total = first.x[0].shape[0]
-            # coalesce until batchLimit or a brief quiet period
+            # continuous batching: admit queued requests into the next
+            # dispatch up to the bucket boundary (with a ladder,
+            # batch_limit IS the top bucket) or a brief quiet period;
+            # whatever arrives during the dispatch queues for the next
             while total < self.batch_limit:
                 try:
                     nxt = self._queue.get(timeout=self.collect_timeout)
@@ -391,9 +462,7 @@ class ParallelInference:
                 if nxt is None:
                     self._shutdown = True
                     break
-                if (len(nxt.x) != len(first.x)
-                        or any(a.shape[1:] != b.shape[1:]
-                               for a, b in zip(nxt.x, first.x))):
+                if self._incompatible(nxt, first):
                     strays.append(nxt)
                     continue
                 batch.append(nxt)
@@ -401,6 +470,30 @@ class ParallelInference:
             self._dispatch(batch)
             for s in strays:
                 self._dispatch([s])
+
+    def _incompatible(self, nxt, first):
+        """Can nxt coalesce into first's dispatch? Exact feature-shape
+        match normally; under a length ladder, sequence inputs may
+        differ in their time axis (axis 1) — they pad to one length
+        bucket under a validity mask. The tolerance applies only when
+        the FIRST input is the sequence (mirroring _serve_aot, which
+        derives the mask and length bucket from input 0): a model
+        whose sequence input is elsewhere falls back to exact-shape
+        coalescing, so mismatched-T requests become strays and serve
+        individually at their native shapes instead of producing an
+        un-concatenatable batch."""
+        if len(nxt.x) != len(first.x):
+            return True
+        seq_ok = (self._ladder is not None
+                  and self._ladder.length is not None
+                  and first.x[0].ndim == 3 and nxt.x[0].ndim == 3)
+        for a, b in zip(nxt.x, first.x):
+            if a.shape[1:] == b.shape[1:]:
+                continue
+            if not (seq_ok and a.ndim == 3 and b.ndim == 3
+                    and a.shape[2:] == b.shape[2:]):
+                return True
+        return False
 
     def _dispatch(self, batch):
         """Claim-then-run: a request the fallback path already claimed
@@ -419,39 +512,13 @@ class ParallelInference:
         try:
             if _faults.ACTIVE is not None:
                 _faults.ACTIVE.fire(_faults.INFERENCE_FORWARD)
-            n_inputs = len(batch[0].x)
-            cols = []
-            for j in range(n_inputs):
-                xj = np.concatenate([r.x[j] for r in batch], axis=0)
-                cols.append(xj)
-            n = cols[0].shape[0]
-            nb = _bucket(n)
-            if nb != n:
-                # pad with copies of the last row: static bucket shapes
-                # keep XLA from compiling one executable per request count
-                cols = [np.concatenate(
-                    [xj, np.repeat(xj[-1:], nb - n, axis=0)], axis=0)
-                    for xj in cols]
-            self.model_calls += 1
-            if _mon.enabled():
-                reg = _mon.get_registry()
-                reg.counter("dl4j.inference.forwards",
-                            help="coalesced forward passes").inc()
-                reg.histogram(
-                    "dl4j.inference.batch_rows",
-                    help="rows per coalesced forward (pre-padding)"
-                ).observe(n)
-                _mon.record_transfer(sum(c.nbytes for c in cols))
-            with _mon.span("inference.forward"):
-                out = self.model.output(cols if n_inputs > 1 else cols[0])
-                out = (out[0] if isinstance(out, list)
-                       else out).numpy()[:n]
-            i = 0
-            for r in batch:
-                k = r.x[0].shape[0]
-                r.result = out[i:i + k]
-                i += k
-                r.event.set()
+            if self._ladder is not None:
+                try:
+                    self._serve_aot(batch)
+                    return
+                except Exception as e:  # noqa: BLE001 — degrade, stay up
+                    self._note_aot_fallback(e)
+            self._serve_legacy(batch)
         except BaseException as e:  # noqa: BLE001 — deliver to the waiter
             # even KeyboardInterrupt/SystemExit must release the waiters
             # before propagating, or output() blocks forever
@@ -462,6 +529,313 @@ class ParallelInference:
                 r.event.set()
             if not isinstance(e, Exception):
                 raise
+
+    def _serve_legacy(self, batch):
+        """Live-trace path (no ladder configured, or AOT disabled after
+        a failure): one eager `model.output` per coalesced batch, batch
+        dim padded to the next power of two."""
+        n_inputs = len(batch[0].x)
+        cols = []
+        for j in range(n_inputs):
+            xj = np.concatenate([r.x[j] for r in batch], axis=0)
+            cols.append(xj)
+        n = cols[0].shape[0]
+        nb = _bucket(n)
+        if nb != n:
+            # pad with copies of the last row: static bucket shapes
+            # keep XLA from compiling one executable per request count
+            cols = [np.concatenate(
+                [xj, np.repeat(xj[-1:], nb - n, axis=0)], axis=0)
+                for xj in cols]
+        self.model_calls += 1
+        if _mon.enabled():
+            reg = _mon.get_registry()
+            reg.counter("dl4j.inference.forwards",
+                        help="coalesced forward passes").inc()
+            reg.histogram(
+                "dl4j.inference.batch_rows",
+                help="rows per coalesced forward (pre-padding)"
+            ).observe(n)
+            _mon.record_transfer(sum(c.nbytes for c in cols))
+        with _mon.span("inference.forward"):
+            out = self.model.output(cols if n_inputs > 1 else cols[0])
+            out = (out[0] if isinstance(out, list)
+                   else out).numpy()[:n]
+        i = 0
+        for r in batch:
+            k = r.x[0].shape[0]
+            r.result = out[i:i + k]
+            i += k
+            r.event.set()
+
+    # -- AOT serving path (runtime/executables.py) ------------------------
+    def warmup(self, buckets=None, lengths=None, example=None):
+        """Pre-resolve the whole bucket ladder at startup, so steady
+        state never compiles: every ladder signature is deserialized
+        from the on-disk executable cache (warm replica: seconds) or
+        live-compiled once and persisted (cold cache: pays today what
+        the request path would have paid per shape).
+
+        `buckets`/`lengths` (re)configure the ladder; with neither
+        given nor a Builder ladder, a power-of-two ladder up to
+        batchLimit is installed. Per-input feature shapes come from
+        `example` (one example or a batch, like output()) or from the
+        model's InputType conf. Returns the warmup stats dict
+        {compiled, from_disk, seconds, signatures}."""
+        if self._aot_error is not None:
+            # the fallback is PERMANENT per instance: re-warming would
+            # aim the next dispatch straight back at the known-broken
+            # AOT path (and fail a request per re-warm)
+            raise RuntimeError(
+                "AOT serving is disabled for this instance after a "
+                "dispatch failure; build a fresh ParallelInference "
+                "once the cause is fixed") from self._aot_error
+        from deeplearning4j_tpu.runtime.executables import BucketLadder
+        if buckets is not None or self._ladder is None:
+            if buckets is None:
+                b, ladder = 1, []
+                while b < self.batch_limit:
+                    ladder.append(b)
+                    b *= 2
+                buckets = ladder + [self.batch_limit]
+            self._ladder = BucketLadder(
+                batch=buckets,
+                length=(lengths if lengths is not None
+                        else (self._ladder.length if self._ladder
+                              else self._length_buckets)))
+            self.batch_limit = self._ladder.max_batch
+        elif lengths is not None:
+            self._ladder = BucketLadder(batch=self._ladder.batch,
+                                        length=lengths)
+        store, _ = self._ensure_aot()
+        shapes = self._warmup_shapes(example)
+        sigs = []
+        for b in self._ladder.batch:
+            for feats in shapes:
+                sig = tuple(((b,) + tuple(shp), "float32")
+                            for shp in feats)
+                # mirror _serve_aot exactly: masked iff a length ladder
+                # is set and the FIRST input is a (B, T, F) sequence
+                with_mask = (self._ladder.length is not None
+                             and len(sig[0][0]) == 3)
+                sigs.append((sig, with_mask))
+        stats = store.warmup(sigs)
+        stats["signatures"] = len(sigs)
+        return stats
+
+    def _warmup_shapes(self, example):
+        """Per-input FEATURE shape lists to warm: [[shape_per_input]].
+        From an example request (preferred — exact), else from the
+        conf's InputTypes; sequence inputs expand across the length
+        ladder (their conf length is often None/variable)."""
+        if example is not None:
+            n_inputs = len(self._input_ranks())
+            if isinstance(example, (list, tuple)) and n_inputs > 1:
+                xs = tuple(np.asarray(a, np.float32) for a in example)
+            else:
+                xs = (np.asarray(example, np.float32),)
+            if self._needs_batch(xs):
+                feats = [tuple(a.shape) for a in xs]
+            else:
+                feats = [tuple(a.shape[1:]) for a in xs]
+        else:
+            feats = [tuple(t.shape())
+                     for t in self._input_types()]
+        if self._ladder.length is None:
+            if any(d is None for shp in feats for d in shp):
+                raise ValueError(
+                    f"cannot warm variable-length inputs {feats} "
+                    "without length buckets; pass lengths=[...] or an "
+                    "example")
+            return [feats]
+        out = []
+        for tb in self._ladder.length:
+            row = []
+            for shp in feats:
+                if len(shp) == 2:   # recurrent (time, features)
+                    row.append((tb, shp[1]))
+                else:
+                    row.append(shp)
+            out.append(row)
+        return out
+
+    def _input_types(self):
+        """InputType conf objects, one per model input."""
+        conf = getattr(self.model, "conf", None)
+        if conf is None:
+            raise ValueError("model has no conf: pass warmup(example=)")
+        node_types = getattr(conf, "node_output_types", None)
+        input_names = getattr(conf, "input_names", None)
+        if node_types and input_names:
+            return [node_types[n] for n in input_names]
+        it = getattr(conf, "input_type", None)
+        if it is None or not hasattr(it, "shape"):
+            raise ValueError(
+                "model conf has no sized InputType: pass "
+                "warmup(example=)")
+        return [it]
+
+    def _ensure_aot(self):
+        """Build the executable store + staging ring once (lazily, so a
+        Builder-configured instance pays nothing until first use).
+        Double-checked: the steady-state dispatch takes no lock."""
+        store = self._store
+        if store is not None:
+            return store, self._ring
+        with self._lifecycle_lock:
+            if self._store is None:
+                from deeplearning4j_tpu.runtime.executables import (
+                    ExecutableStore, StagingRing)
+                # ring BEFORE store: the unlocked fast path keys on
+                # _store, so _ring must already be visible then
+                self._ring = StagingRing(self._staging_depth)
+                self._store = ExecutableStore(
+                    self.model, directory=self._exec_cache_dir)
+        return self._store, self._ring
+
+    def _note_aot_fallback(self, e):
+        """First AOT failure flips this instance to the legacy path for
+        good: serving availability beats executable-cache purity."""
+        if self._aot_error is None:
+            self._aot_error = e
+        self._ladder = None
+        if _mon.enabled():
+            _mon.get_registry().counter(
+                _mon.SERVING_AOT_FALLBACKS,
+                help="AOT serving failures (instance reverted to the "
+                     "legacy live path)").inc()
+
+    def _serve_aot(self, batch):
+        """Steady-state serving: pad-to-bucket, stage XLA-owned input
+        buffers, dispatch pre-compiled executables. No jit, no trace,
+        no host-owned aliasing; oversized batches split across
+        max-bucket chunks. Results are delivered only after EVERY chunk
+        dispatched, so a mid-batch failure can still fall back to the
+        legacy path without double-serving."""
+        store, ring = self._ensure_aot()
+        ladder = self._ladder
+        n_inputs = len(batch[0].x)
+        with_mask = (ladder.length is not None
+                     and batch[0].x[0].ndim == 3)
+        if with_mask:
+            # one length bucket covers EVERY sequence input (a second
+            # rank-3 input longer than input 0 must not overflow tb)
+            tb = ladder.length_bucket(
+                max(r.x[j].shape[1] for r in batch
+                    for j in range(n_inputs) if r.x[j].ndim == 3))
+            cols, mask = self._pad_time(batch, n_inputs, tb)
+        else:
+            cols = [np.concatenate([r.x[j] for r in batch], axis=0)
+                    for j in range(n_inputs)]
+            mask = None
+        n = cols[0].shape[0]
+        chunks = ladder.chunks(n)
+        mon_on = _mon.enabled()
+        pending = []
+        i = 0
+        for c in chunks:
+            b = ladder.bucket(c)
+            pad = b - c
+            ccols = [col[i:i + c] for col in cols]
+            if pad:
+                # pad with copies of the last row (numerically inert:
+                # padded rows are sliced away before delivery)
+                ccols = [np.concatenate(
+                    [xj, np.repeat(xj[-1:], pad, axis=0)], axis=0)
+                    for xj in ccols]
+            sig = tuple((tuple(xj.shape), str(xj.dtype)) for xj in ccols)
+            entry = store.lookup(sig, with_mask)
+            if entry is None:
+                # miss path: deserialize from disk or live-compile —
+                # never reached once warmup() covered the ladder
+                entry = store.load_or_compile(sig, with_mask=with_mask)
+            arrays = ccols
+            if with_mask:
+                cmask = mask[i:i + c]
+                if pad:
+                    cmask = np.concatenate(
+                        [cmask, np.zeros((pad, cmask.shape[1]),
+                                         np.float32)], axis=0)
+                arrays = ccols + [cmask]
+            self.model_calls += 1
+            if mon_on:
+                reg = _mon.get_registry()
+                reg.counter("dl4j.inference.forwards",
+                            help="coalesced forward passes").inc()
+                reg.histogram(
+                    "dl4j.inference.batch_rows",
+                    help="rows per coalesced forward (pre-padding)"
+                ).observe(c)
+                reg.counter(_mon.SERVING_ROWS,
+                            help="real rows dispatched through the AOT "
+                                 "serving path").inc(c)
+                if pad:
+                    reg.counter(
+                        _mon.SERVING_PADDED_ROWS,
+                        help="bucket-padding rows (waste ratio = "
+                             "padded / (rows + padded))").inc(pad)
+                reg.histogram(_mon.SERVING_BUCKET_OCCUPANCY,
+                              help="per-dispatch fill ratio "
+                                   "rows/bucket").observe(c / b)
+                _mon.record_transfer(sum(a.nbytes for a in arrays))
+            # stage → donate: inputs enter the device as XLA-owned
+            # copies; the executable may reuse their allocations.
+            # stage() returns THIS chunk's buffers (concurrent
+            # dispatchers never serve each other's inputs)
+            bufs = ring.stage(arrays)
+            try:
+                with _mon.span("inference.forward"):
+                    out = entry.call(self.model._params,
+                                     self.model._state, *bufs)
+            finally:
+                # a leaked slot would strand later dispatchers in
+                # stage() forever once the ring fills
+                ring.release()
+            pending.append((c, out))
+            i += c
+        if mon_on and len(chunks) > 1:
+            _mon.get_registry().counter(
+                _mon.SERVING_SPLITS,
+                help="oversized batches split across bucket chunks "
+                     "instead of compiling a novel shape").inc()
+        # materialize (blocks on the device) AFTER all dispatches so
+        # chunk k+1's staging overlapped chunk k's compute
+        parts = [np.asarray(out[0])[:c] for c, out in pending]
+        full = parts[0] if len(parts) == 1 else np.concatenate(parts, 0)
+        i = 0
+        for r in batch:
+            k = r.x[0].shape[0]
+            res = full[i:i + k]
+            if with_mask and res.ndim == 3:
+                res = res[:, :r.x[0].shape[1]]   # drop padded timesteps
+            r.result = res
+            i += k
+        for r in batch:
+            r.event.set()
+
+    @staticmethod
+    def _pad_time(batch, n_inputs, tb):
+        """Pad sequence inputs (axis 1) to the length bucket; returns
+        per-input concatenated columns + an (N, tb) validity mask
+        (1 = real timestep) fed to the masked executable so padded
+        steps hold recurrent carries and emit zeros."""
+        cols = []
+        for j in range(n_inputs):
+            parts = []
+            for r in batch:
+                xj = r.x[j]
+                if xj.ndim == 3 and xj.shape[1] < tb:
+                    xj = np.pad(
+                        xj, [(0, 0), (0, tb - xj.shape[1]), (0, 0)])
+                parts.append(xj)
+            cols.append(np.concatenate(parts, axis=0))
+        mask = np.zeros((cols[0].shape[0], tb), np.float32)
+        i = 0
+        for r in batch:
+            k, t = r.x[0].shape[0], r.x[0].shape[1]
+            mask[i:i + k, :t] = 1.0
+            i += k
+        return cols, mask
 
     def shutdown(self):
         """Idempotent: the first call stops the collector and drains the

@@ -14,6 +14,10 @@ Two forms, both dependency-free:
   `GET /profile` returns its status + the latest decoded per-op report,
   and `GET /steps` serves the step-time attribution flight recorder
   (records + percentile summary) — each with a dashboard tab.
+- `GET /executables` — AOT serving-executable cache status
+  (runtime/executables.py `status()`): every live store's entries with
+  compile-vs-disk provenance, hit/miss tallies, and the persistent
+  compilation cache tier split.
 - `render_static_html(storage, path)` — a self-contained HTML snapshot
   (inline SVG charts) for environments without an open port.
 """
@@ -304,6 +308,15 @@ class UIServer:
                     rec = _steps.recorder()
                     body = json.dumps({"records": rec.records(last=last),
                                        "summary": rec.summary()}).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/executables"):
+                    # AOT serving-executable cache status: per-store
+                    # entries (signature + compile/disk provenance),
+                    # hit/miss tallies, and the persistent-compile-
+                    # cache tier split (runtime/executables.py)
+                    from deeplearning4j_tpu.runtime import \
+                        executables as _exe
+                    body = json.dumps(_exe.status()).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/health"):
                     # training-guardian + stall-watchdog state

@@ -41,6 +41,7 @@ HOT_MODULES = [
     "deeplearning4j_tpu/nn/graph.py",
     "deeplearning4j_tpu/runtime/executioner.py",
     "deeplearning4j_tpu/runtime/pipeline.py",
+    "deeplearning4j_tpu/runtime/executables.py",
     "deeplearning4j_tpu/parallel/wrapper.py",
     "deeplearning4j_tpu/parallel/sharded_trainer.py",
     "deeplearning4j_tpu/parallel/inference.py",
@@ -49,6 +50,25 @@ HOT_MODULES = [
     "deeplearning4j_tpu/resilience/faults.py",
     "deeplearning4j_tpu/resilience/trainer.py",
 ]
+
+# -- serving steady-state lint --------------------------------------------
+#: modules forming the AOT serving hot path: everything REACHABLE from
+#: the roots below (intra-repo call graph by function name) must never
+#: trace or compile — `jax.jit` / `.lower()` / `.compile()` belong to
+#: the declared miss-path boundary functions only
+SERVING_MODULES = [
+    "deeplearning4j_tpu/parallel/inference.py",
+    "deeplearning4j_tpu/runtime/executables.py",
+]
+#: steady-state entry points: the collector's dispatch path and the
+#: store/ring hot methods
+SERVING_ROOTS = {"_dispatch", "_run", "lookup", "stage", "release"}
+#: the documented miss-path boundary: steady state never crosses it
+#: (`load_or_compile` runs only when `lookup` missed — i.e. a shape
+#: outside the warmed ladder); the traversal does not descend into it
+SERVING_MISS_BOUNDARY = {"load_or_compile", "warmup"}
+#: calls that mean "a trace or an XLA compile happens here"
+TRACE_CALL_NAMES = {"jit", "lower", "compile", "eval_shape", "trace"}
 
 #: attribute calls that hit the registry
 REGISTRY_ATTRS = {"counter", "gauge", "histogram"}
@@ -127,6 +147,72 @@ def check_file(path):
         return check_source(f.read(), path)
 
 
+# -- serving steady-state lint (no trace/compile reachable from the
+#    dispatch path) ---------------------------------------------------------
+def _call_name(node):
+    """Best-effort callee name of a Call: `f(...)` → f, `a.b.f(...)` →
+    f. Good enough for an intra-repo method-name call graph."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _is_trace_call(node):
+    name = _call_name(node)
+    if name not in TRACE_CALL_NAMES:
+        return None
+    f = node.func
+    # `jax.jit(...)` / `jit(...)` / `<lowered>.compile()` /
+    # `jit(...).lower(...)` all count; plain `"x".lower()` string
+    # methods share the name — accept the (theoretical) false positive
+    # over missing a real trace on the serving path
+    return f".{name}(...)" if isinstance(f, ast.Attribute) \
+        else f"{name}(...)"
+
+
+def check_serving_steady_state(sources):
+    """sources: {path: source}. Walks the union call graph of every
+    function/method defined in the serving modules, starting from
+    SERVING_ROOTS and NOT descending into SERVING_MISS_BOUNDARY, and
+    flags any trace/compile call inside the reachable set. Steady-state
+    serving (post-`warmup()`) must resolve every dispatch from the
+    in-memory executable tier — a reachable `jax.jit`/`lower`/`compile`
+    means a novel shape could trace ON the request path."""
+    defs = {}        # name -> (path, FunctionDef)
+    for path, source in sources.items():
+        tree = ast.parse(source, filename=path)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, (path, node))
+    violations = []
+    seen = set()
+    frontier = [r for r in SERVING_ROOTS if r in defs]
+    while frontier:
+        name = frontier.pop()
+        if name in seen or name in SERVING_MISS_BOUNDARY:
+            continue
+        seen.add(name)
+        path, fn = defs[name]
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            what = _is_trace_call(node)
+            if what is not None:
+                violations.append(
+                    (path, node.lineno,
+                     f"{what} reachable from the serving dispatch "
+                     f"path (via {name}) — steady state must stay "
+                     "inside the AOT executable cache"))
+            callee = _call_name(node)
+            if callee in defs and callee not in seen \
+                    and callee not in SERVING_MISS_BOUNDARY:
+                frontier.append(callee)
+    return violations
+
+
 def main(modules=None):
     violations = []
     for rel in modules or HOT_MODULES:
@@ -134,13 +220,22 @@ def main(modules=None):
         if not os.path.exists(path):
             continue
         violations.extend(check_file(path))
+    if modules is None:
+        sources = {}
+        for rel in SERVING_MODULES:
+            path = os.path.join(REPO_ROOT, rel)
+            if os.path.exists(path):
+                with open(path) as f:
+                    sources[path] = f.read()
+        violations.extend(check_serving_steady_state(sources))
     for path, lineno, msg in violations:
         print(f"{os.path.relpath(path, REPO_ROOT)}:{lineno}: {msg}")
     if violations:
-        print(f"\n{len(violations)} fast-path violation(s): wrap the "
-              "call in `if _mon.enabled():` (or an early "
+        print(f"\n{len(violations)} fast-path violation(s): wrap "
+              "registry calls in `if _mon.enabled():` (or an early "
               "`if not STATE.enabled: return`) so the disabled path "
-              "stays one branch.")
+              "stays one branch, and keep traces/compiles behind the "
+              "executable-store miss boundary (load_or_compile).")
     return violations
 
 

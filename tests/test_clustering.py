@@ -220,6 +220,8 @@ class TestTsne:
         np.testing.assert_allclose(yA, yB[:45], atol=1e-4)
         assert np.abs(yB[45:]).max() == 0   # padded rows stay inert
 
+    @pytest.mark.slow   # ~30 s memory soak: the longest single test in
+    #                     tier-1 (round-7 suite diet); `-m slow` runs it
     def test_memory_bounded_large_n(self):
         # N=20k, d=4: the stored conditional P is 1.6 GB fp32; the
         # blocked passes keep everything else at O(block·N). Two descent

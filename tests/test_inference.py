@@ -4,6 +4,7 @@ coalesces requests into fewer forward passes."""
 import threading
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.nn import (DenseLayer, InputType,
                                    NeuralNetConfiguration, OutputLayer, Sgd)
@@ -12,7 +13,11 @@ from deeplearning4j_tpu.parallel.inference import (InferenceMode,
                                                    ParallelInference)
 
 
-def _net():
+@pytest.fixture(scope="module")
+def net():
+    """One shared net for the whole module (round-7 suite diet): every
+    test only READS it through output(), so the build + first-forward
+    compile is paid once instead of per test."""
     conf = (NeuralNetConfiguration.Builder()
             .seed(3).updater(Sgd(0.1)).activation("tanh")
             .list()
@@ -24,8 +29,7 @@ def _net():
     return MultiLayerNetwork(conf).init()
 
 
-def test_sequential_mode_matches_direct():
-    net = _net()
+def test_sequential_mode_matches_direct(net):
     pi = ParallelInference.Builder(net).inferenceMode(
         InferenceMode.SEQUENTIAL).build()
     x = np.random.default_rng(0).standard_normal((4, 5)).astype(np.float32)
@@ -36,8 +40,7 @@ def test_sequential_mode_matches_direct():
                                atol=1e-6)
 
 
-def test_batched_mode_concurrent_clients_exact():
-    net = _net()
+def test_batched_mode_concurrent_clients_exact(net):
     pi = (ParallelInference.Builder(net)
           .inferenceMode(InferenceMode.BATCHED)
           .batchLimit(16).build())
@@ -67,8 +70,7 @@ def test_batched_mode_concurrent_clients_exact():
     assert pi.model_calls < 40, pi.model_calls
 
 
-def test_batch_requests_and_padding_buckets():
-    net = _net()
+def test_batch_requests_and_padding_buckets(net):
     pi = (ParallelInference.Builder(net)
           .inferenceMode(InferenceMode.BATCHED).batchLimit(8).build())
     rng = np.random.default_rng(2)
@@ -80,8 +82,7 @@ def test_batch_requests_and_padding_buckets():
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
 
 
-def test_shutdown_falls_back_to_direct():
-    net = _net()
+def test_shutdown_falls_back_to_direct(net):
     pi = ParallelInference.Builder(net).build()
     pi.shutdown()
     x = np.zeros((2, 5), np.float32)

@@ -190,8 +190,13 @@ class TestMixtureDensity:
         them, the mixture should place mass near both."""
         k = 2
         loss = LossMixtureDensity(gaussians=k, labelWidth=1)
+        # Adam 1e-2: at 5e-3 the mixture is still mid-way out of the
+        # mode-collapsed basin at iteration 150 (score ~2.1, one mean
+        # stuck near 0.7) but fully split by ~300 — the loss and model
+        # are fine, the budget wasn't; the faster LR converges (score
+        # ~-0.7, means ±2) inside the same 150-iteration budget
         net = MultiLayerNetwork(
-            NeuralNetConfiguration.Builder().seed(0).updater(Adam(5e-3))
+            NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
             .weightInit("xavier").list()
             .layer(DenseLayer(nOut=32, activation="tanh"))
             .layer(OutputLayer(nOut=loss.nOut(), activation="identity",

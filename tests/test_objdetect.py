@@ -102,6 +102,8 @@ class TestYoloZoo:
 
 
 class TestFaceNet:
+    @pytest.mark.slow   # ~14 s compile soak (inception tower + triplet
+    #                     head grads); round-7 suite diet
     def test_builds_and_trains(self):
         m = FaceNetNN4Small2(numClasses=5, inputShape=(32, 32, 3))
         net = m.init()

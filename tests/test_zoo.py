@@ -113,6 +113,9 @@ class TestNASNet:
 
 
 class TestEfficientNet:
+    @pytest.mark.slow   # ~23 s compile soak (full B0 graph + grads on
+    #                     1 vCPU); TestInstantiation still covers the
+    #                     EfficientNet builder path in tier-1
     def test_b0_builds_forwards_and_trains(self):
         from deeplearning4j_tpu.models.zoo import EfficientNet
         net = EfficientNet("B0", numClasses=4,
