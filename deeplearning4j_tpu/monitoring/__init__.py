@@ -67,6 +67,8 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     GUARDIAN_CHECKS, GUARDIAN_SKIPPED_UPDATES, GUARDIAN_LR_RETRIES,
     GUARDIAN_ROLLBACKS, GUARDIAN_SAVES_GATED, GUARDIAN_LAST_GOOD_STEP,
     WATCHDOG_STALLS, WATCHDOG_BEAT_AGE_SECONDS, WATCHDOG_DUMPS,
+    DIST_PEERS, DIST_PEER_LOST, DIST_PREEMPTIONS,
+    DIST_BARRIER_TIMEOUTS, DIST_ENCODED_BYTES, DIST_RESIDUAL_NORM,
     PIPELINE_SYNCS, PIPELINE_HOST_BLOCKED_MS, PIPELINE_PREFETCH_DEPTH,
     PIPELINE_STAGED_BATCHES,
     PROFILE_SESSIONS, PROFILE_CAPTURED_STEPS, PROFILE_DEVICE_MS,
@@ -112,6 +114,8 @@ __all__ = [
     "GUARDIAN_CHECKS", "GUARDIAN_SKIPPED_UPDATES", "GUARDIAN_LR_RETRIES",
     "GUARDIAN_ROLLBACKS", "GUARDIAN_SAVES_GATED", "GUARDIAN_LAST_GOOD_STEP",
     "WATCHDOG_STALLS", "WATCHDOG_BEAT_AGE_SECONDS", "WATCHDOG_DUMPS",
+    "DIST_PEERS", "DIST_PEER_LOST", "DIST_PREEMPTIONS",
+    "DIST_BARRIER_TIMEOUTS", "DIST_ENCODED_BYTES", "DIST_RESIDUAL_NORM",
     "PIPELINE_SYNCS", "PIPELINE_HOST_BLOCKED_MS", "PIPELINE_PREFETCH_DEPTH",
     "PIPELINE_STAGED_BATCHES",
 ]

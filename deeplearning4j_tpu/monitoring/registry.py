@@ -101,6 +101,16 @@ WATCHDOG_STALLS = "dl4j.watchdog.stalls"
 WATCHDOG_BEAT_AGE_SECONDS = "dl4j.watchdog.beat_age_seconds"
 WATCHDOG_DUMPS = "dl4j.watchdog.dumps"
 
+# multi-host coordination (parallel/multihost.py): peer liveness, the
+# preemption drain, barrier health, and the compressed gradient
+# exchange's wire/residual telemetry
+DIST_PEERS = "dl4j.dist.peers"
+DIST_PEER_LOST = "dl4j.dist.peer_lost"
+DIST_PREEMPTIONS = "dl4j.dist.preemptions"
+DIST_BARRIER_TIMEOUTS = "dl4j.dist.barrier_timeouts"
+DIST_ENCODED_BYTES = "dl4j.dist.encoded_bytes"
+DIST_RESIDUAL_NORM = "dl4j.dist.residual_norm"
+
 # host pipeline (runtime/pipeline.py): is the host running ahead of the
 # device, or blocking on it? `syncs` counts every host-blocking
 # materialization (a listener-free fit should record ZERO per-step syncs),

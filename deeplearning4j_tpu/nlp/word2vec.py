@@ -86,8 +86,13 @@ def _hs_step(params, lr, center, context, points, codes, mask, weights):
         s = jnp.einsum("bd,bld->bl", v, u)
         sign = 1.0 - 2.0 * codes[context]
         ll = jax.nn.log_sigmoid(sign * s) * mask[context]
-        denom = jnp.maximum(weights.sum(), 1.0)
-        return -jnp.sum(ll.sum(-1) * weights) / denom
+        # SUM over pairs, not mean: the reference applies its learning
+        # rate PER training pair (online SGD); a batch-mean divides the
+        # per-pair step by B (=512 default), leaving the embeddings at
+        # ~their random init within any realistic epoch budget — the
+        # measured "similarity" was just init noise (root cause of the
+        # seed's two topic-clustering test failures)
+        return -jnp.sum(ll.sum(-1) * weights)
 
     loss, grads = jax.value_and_grad(loss_fn)(params)
     params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
@@ -108,8 +113,8 @@ def _sgns_step(params, lr, center, context, negatives, weights):
         u_neg = p["syn1"][negatives]                # (B, K, D)
         pos = jax.nn.log_sigmoid(jnp.sum(v * u_pos, -1))
         neg = jax.nn.log_sigmoid(-jnp.einsum("bd,bkd->bk", v, u_neg)).sum(-1)
-        denom = jnp.maximum(weights.sum(), 1.0)
-        return -jnp.sum((pos + neg) * weights) / denom
+        # sum, not mean — per-pair learning-rate semantics (see _hs_step)
+        return -jnp.sum((pos + neg) * weights)
 
     loss, grads = jax.value_and_grad(loss_fn)(params)
     params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)

@@ -20,6 +20,10 @@ from deeplearning4j_tpu.parallel.zero import (shard_optimizer_state,
                                               state_memory_bytes)
 from deeplearning4j_tpu.parallel.inference import (InferenceMode,
                                                    ParallelInference)
+from deeplearning4j_tpu.parallel.multihost import (CoordinatedGuardian,
+                                                   MultiHostRunner,
+                                                   MultiHostTrainer,
+                                                   PeerCoordinator)
 
 __all__ = ["DeviceMesh", "initialize_distributed", "ParallelWrapper",
            "ParameterAveragingTrainer", "ShardedTrainer",
@@ -28,4 +32,6 @@ __all__ = ["DeviceMesh", "initialize_distributed", "ParallelWrapper",
            "make_pipeline_fn", "make_pipelined_loss", "stack_stage_params",
            "ElasticCheckpointer", "ElasticTrainer", "initialize_multihost",
            "shard_optimizer_state", "state_memory_bytes",
-           "InferenceMode", "ParallelInference"]
+           "InferenceMode", "ParallelInference",
+           "CoordinatedGuardian", "MultiHostRunner", "MultiHostTrainer",
+           "PeerCoordinator"]

@@ -19,7 +19,13 @@ import jax.numpy as jnp
 import optax
 
 
-def threshold_encoding(initial_threshold=1e-3, min_threshold=1e-5,
+#: the encoder's starting threshold — shared with the multi-host
+#: trainer's stacked per-worker state init so the two can never drift
+DEFAULT_INITIAL_THRESHOLD = 1e-3
+
+
+def threshold_encoding(initial_threshold=DEFAULT_INITIAL_THRESHOLD,
+                       min_threshold=1e-5,
                        decay=0.95, boost=1.2, target_sparsity=1e-3):
     """optax transform: g -> quantized {−t,0,+t} with residual feedback.
 
@@ -31,7 +37,11 @@ def threshold_encoding(initial_threshold=1e-3, min_threshold=1e-5,
     def init_fn(params):
         residual = jax.tree_util.tree_map(jnp.zeros_like, params)
         return {"residual": residual,
-                "threshold": jnp.asarray(initial_threshold, jnp.float32)}
+                "threshold": jnp.asarray(initial_threshold, jnp.float32),
+                # elements shipped last step (the wire-cost telemetry the
+                # multi-host trainer surfaces as dl4j.dist.encoded_bytes);
+                # device scalar so the update stays sync-free
+                "nnz": jnp.asarray(0, jnp.int32)}
 
     def update_fn(updates, state, params=None):
         del params
@@ -56,9 +66,21 @@ def threshold_encoding(initial_threshold=1e-3, min_threshold=1e-5,
                             jnp.where(frac > 50 * target_sparsity,
                                       thr * boost, thr))
         new_thr = jnp.maximum(new_thr, min_threshold)
-        return sent, {"residual": residual, "threshold": new_thr}
+        return sent, {"residual": residual, "threshold": new_thr,
+                      "nnz": jnp.asarray(nonzero, jnp.int32)}
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+def encoder_stats(enc_state):
+    """Device-scalar wire telemetry for a (possibly per-worker-stacked)
+    threshold-encoding state: mean adaptive threshold, total elements
+    shipped last step, and the un-sent residual mass. Pure jax — the
+    multi-host sync point jits this once and materializes the three
+    scalars together at flush cadence (never per step)."""
+    return {"threshold": jnp.mean(enc_state["threshold"]),
+            "nnz": jnp.sum(enc_state["nnz"]),
+            "residual_norm": optax.global_norm(enc_state["residual"])}
 
 
 def encoded_updater(updater, **kw):

@@ -28,12 +28,23 @@ class ElasticCheckpointer:
     resume (rng key, batch-norm state, iteration counters)."""
 
     def __init__(self, directory, max_to_keep=3, save_interval_steps=1,
-                 sweep_orphans=True):
+                 sweep_orphans=True, primary_only=False, read_only=False):
         """sweep_orphans=False skips the startup debris sweep — REQUIRED
         when the directory is shared with another process that may have
         an async save in flight (the sweep would rmtree its in-progress
         orbax temp dir); the single-writer restart case keeps the
-        default."""
+        default.
+
+        Multi-host modes: with `jax.process_count() > 1`, orbax's save
+        path runs `sync_global_processes` — a GLOBAL barrier that hangs
+        forever if only one process saves (root-caused against the
+        two-process runner: process 0's save stalled inside
+        `create_temporary_path` waiting for peers that never call save).
+        `primary_only=True` scopes every orbax barrier to THIS process
+        (`MultiprocessingOptions(active_processes={me})` — the barrier
+        rides the coordination service restricted to one process id),
+        so the single-writer pattern works; `read_only=True` is the
+        peers' flavor: restore/inspect with no save machinery at all."""
         import orbax.checkpoint as ocp
 
         from deeplearning4j_tpu.resilience import integrity as _integrity
@@ -44,14 +55,33 @@ class ElasticCheckpointer:
         # manifests behind; sweep them BEFORE the manager scans the
         # directory (startup only — no save from this process can be in
         # flight yet). dl4j.resilience.ckpt_orphans_removed counts them.
-        self.orphans_removed = (_integrity.sweep_orphans(self.directory)
-                                if sweep_orphans else 0)
+        self.orphans_removed = (
+            _integrity.sweep_orphans(self.directory)
+            if sweep_orphans and not read_only else 0)
         self._closed = False
+        opts = {"max_to_keep": max_to_keep,
+                "save_interval_steps": save_interval_steps}
+        if read_only:
+            opts["read_only"] = True
+        if primary_only or read_only:
+            # scope EVERY orbax barrier to this process alone — both the
+            # save-side atomicity syncs and the one at the end of
+            # Checkpointer.restore (without this, a read-only peer's
+            # restore dispatches a global device sync the single writer
+            # never joins → a silent cross-host hang)
+            me = jax.process_index()
+            opts["multiprocessing_options"] = \
+                ocp.options.MultiprocessingOptions(
+                    primary_host=me, active_processes={me},
+                    # two processes' single-process barriers share one
+                    # coordination service: identical keys with
+                    # different task sets are rejected as conflicting
+                    barrier_sync_key_prefix=f"dl4j-p{me}")
+            # orbax refuses create=True with active_processes; the root
+            # directory already exists (makedirs above)
+            opts["create"] = False
         self.manager = ocp.CheckpointManager(
-            self.directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
-                save_interval_steps=save_interval_steps))
+            self.directory, options=ocp.CheckpointManagerOptions(**opts))
 
     def check_for_errors(self):
         """Surface a deferred ASYNC-save failure now. Orbax records
@@ -201,7 +231,7 @@ class ElasticCheckpointer:
                 else np.asarray(got, dtype=dt)
             sh = getattr(want, "sharding", None)
             if isinstance(sh, NamedSharding):
-                grafted.append(xla_owned_copy(host, sh))
+                grafted.append(place_global(host, sh))
             else:
                 grafted.append(host)
         return step, jax.tree_util.tree_unflatten(treedef, grafted)
@@ -261,7 +291,24 @@ class ElasticCheckpointer:
 # canonical implementation moved to runtime/pipeline.py (the host
 # pipeline stages EVERY batch through it, not just checkpoint restores);
 # re-exported here so existing call/import sites keep working
-from deeplearning4j_tpu.runtime.pipeline import xla_owned_copy  # noqa: E402,F401
+from deeplearning4j_tpu.runtime.pipeline import (  # noqa: E402,F401
+    as_unaliasable, xla_owned_copy)
+
+
+def place_global(host, sharding):
+    """Donation-safe placement of a host array onto ANY NamedSharding —
+    including cross-process shardings no single process could
+    `device_put` whole. Fully-addressable targets take the ordinary
+    `xla_owned_copy`; multi-host targets materialize shard-by-shard via
+    `make_array_from_callback`, each shard staged through the
+    misaligned-copy trick so XLA owns every buffer (the same aliasing
+    hazard class as whole-array staging — a donating step must never
+    free numpy-owned memory)."""
+    if getattr(sharding, "is_fully_addressable", True):
+        return xla_owned_copy(host, sharding)
+    host = np.asarray(host)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: as_unaliasable(host[idx]))
 
 
 def replace_on_mesh(mesh, like, state):
@@ -270,7 +317,9 @@ def replace_on_mesh(mesh, like, state):
     `like` placement; a fresh optimizer's scalars (e.g. Adam count) sit
     on one device, which would clash with mesh-committed params inside
     jit — so leaves whose `like` has no NamedSharding get the replicated
-    mesh sharding instead."""
+    mesh sharding instead. Cross-process shardings place shard-by-shard
+    (`place_global`), so a multi-host resume re-creates exactly the
+    shards this process owns."""
     from jax.sharding import NamedSharding, PartitionSpec
 
     def place(fresh, restored):
@@ -280,7 +329,7 @@ def replace_on_mesh(mesh, like, state):
         if not isinstance(restored, np.ndarray) \
                 and getattr(restored, "sharding", None) == sh:
             return restored     # restore() already placed it (owned)
-        return xla_owned_copy(restored, sh)
+        return place_global(restored, sh)
 
     return jax.tree_util.tree_map(place, like, state)
 
@@ -327,16 +376,9 @@ class ElasticTrainer:
 
 def initialize_multihost(coordinator_address=None, num_processes=None,
                          process_id=None):
-    """≡ the reference's cluster join for the elastic path; reads the
-    JAX_COORDINATOR_ADDRESS env when no address is given and delegates to
-    parallel.mesh.initialize_distributed (single implementation)."""
-    from deeplearning4j_tpu.parallel.mesh import initialize_distributed
-    if coordinator_address is None:
-        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if coordinator_address is None:
-        return False
-    return initialize_distributed(
-        coordinator_address,
-        num_processes or int(os.environ.get("JAX_NUM_PROCESSES", "1")),
-        process_id if process_id is not None
-        else int(os.environ.get("JAX_PROCESS_ID", "0")))
+    """≡ the reference's cluster join for the elastic path; delegates to
+    the hardened bootstrap (parallel/multihost.initialize — single
+    implementation), which resolves the `DL4J_*` / `JAX_*` env config
+    itself and returns False when no coordinator is configured."""
+    from deeplearning4j_tpu.parallel.multihost import initialize
+    return initialize(coordinator_address, num_processes, process_id)

@@ -121,13 +121,16 @@ class DeviceMesh:
 
 
 def initialize_distributed(coordinator_address=None, num_processes=None,
-                           process_id=None):
+                           process_id=None, **kw):
     """Multi-host bring-up (≡ SharedTrainingMaster's cluster bootstrap, but
     over jax.distributed instead of Aeron UDP). Gated: single-process
-    environments skip silently."""
-    if coordinator_address is None:
-        return False
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
-    return True
+    environments (no coordinator configured anywhere) skip silently.
+
+    Delegates to the HARDENED bootstrap in `parallel/multihost.py`:
+    env-driven config (`DL4J_COORDINATOR` / `DL4J_NUM_PROCESSES` /
+    `DL4J_PROCESS_ID`), connect retry/backoff under a deadline, CPU
+    gloo collectives, and a post-init cross-process sanity barrier —
+    failures raise typed `DistributedInitError`, never hang."""
+    from deeplearning4j_tpu.parallel.multihost import initialize
+    return initialize(coordinator_address, num_processes, process_id,
+                      **kw)

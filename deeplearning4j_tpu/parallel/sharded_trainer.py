@@ -28,6 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.monitoring import profiler as _prof
 from deeplearning4j_tpu.nn.updaters import Updater
+from deeplearning4j_tpu.parallel import coordination as _dist
 from deeplearning4j_tpu.parallel.mesh import shard_map
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience import guardian as _guardian
@@ -165,6 +166,12 @@ class ShardedTrainer:
             _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
         if _watchdog.ACTIVE is not None:
             _watchdog.ACTIVE.beat(f"sharded_trainer@{id(self):x}")
+        if _dist.ACTIVE is not None:
+            # multi-host sync point every sync_every steps: heartbeat +
+            # step agreement + preemption decision (one int increment
+            # and a modulo off the sync cadence); `self` lets a bound
+            # coordinator ignore host-local auxiliary trainers
+            _dist.ACTIVE.on_step(self)
         _ps = _prof.ACTIVE
         if _ps is not None:
             _ps.step_start()
@@ -179,7 +186,9 @@ class ShardedTrainer:
             else:
                 out = self.make_step()(params, opt_state, batch, rng)
         if _g is not None:
-            _g.on_step(loss, gnorm, ok)   # device scalars; no sync here
+            # device scalars; no sync here. `source` lets a bound
+            # (coordinated) guardian ignore auxiliary local trainers
+            _g.on_step(loss, gnorm, ok, source=self)
         _ps = _prof.ACTIVE
         if _ps is not None:
             _ps.step_end()

@@ -36,12 +36,15 @@ monitoring is off, and summarized at `GET /health` on the UI server
 from __future__ import annotations
 
 from deeplearning4j_tpu.resilience.errors import (  # noqa: F401
-    CheckpointIntegrityError, CircuitOpenError, DivergenceError,
-    FatalTrainingError, InferenceOverloadedError, InferenceTimeoutError,
-    InjectedFault, ResilienceError, RetryExhaustedError, TransientError)
+    CheckpointIntegrityError, CircuitOpenError, DistributedInitError,
+    DivergenceError, FatalTrainingError, InferenceOverloadedError,
+    InferenceTimeoutError, InjectedFault, PeerDesyncError, PeerLostError,
+    PreemptionSignal, ResilienceError, RetryExhaustedError,
+    TransientError)
 from deeplearning4j_tpu.resilience.faults import (  # noqa: F401
-    CHECKPOINT_CORRUPT, CHECKPOINT_RESTORE, CHECKPOINT_SAVE, DATA_NEXT,
-    EVAL_FORWARD, INFERENCE_COLLECTOR, INFERENCE_FORWARD, TRAIN_DISPATCH,
+    CHECKPOINT_CORRUPT, CHECKPOINT_RESTORE, CHECKPOINT_SAVE,
+    COMM_ALLREDUCE, COMM_BARRIER, DATA_NEXT, EVAL_FORWARD, HOST_PREEMPT,
+    INFERENCE_COLLECTOR, INFERENCE_FORWARD, TRAIN_DISPATCH,
     FaultPlan, clear_plan, install_plan)
 from deeplearning4j_tpu.resilience.guardian import (  # noqa: F401
     TrainingGuardian)
@@ -55,11 +58,14 @@ __all__ = [
     "CircuitOpenError", "InferenceTimeoutError",
     "InferenceOverloadedError", "InjectedFault", "FatalTrainingError",
     "DivergenceError", "CheckpointIntegrityError",
+    "DistributedInitError", "PeerLostError", "PeerDesyncError",
+    "PreemptionSignal",
     "RetryPolicy", "CircuitBreaker", "default_classifier",
     "FaultPlan", "install_plan", "clear_plan",
     "DATA_NEXT", "TRAIN_DISPATCH", "CHECKPOINT_SAVE",
     "CHECKPOINT_RESTORE", "CHECKPOINT_CORRUPT", "EVAL_FORWARD",
     "INFERENCE_FORWARD", "INFERENCE_COLLECTOR",
+    "COMM_ALLREDUCE", "COMM_BARRIER", "HOST_PREEMPT",
     "TrainingGuardian", "StallWatchdog", "health_snapshot",
     "FaultTolerantTrainer",
 ]
@@ -67,24 +73,38 @@ __all__ = [
 
 def health_snapshot():
     """The `GET /health` payload: overall status plus the installed
-    guardian's and watchdog's introspection snapshots (None when not
-    installed). Status ladder: a latched stall or an exhausted guardian
-    makes the process unhealthy; a guardian mid-escalation reports
-    degraded; otherwise ok."""
+    guardian's, watchdog's, and multi-host coordinator's introspection
+    snapshots (None when not installed). Status ladder: a latched stall,
+    a lost peer, or an exhausted guardian makes the process unhealthy; a
+    guardian mid-escalation or a pending preemption reports degraded;
+    otherwise ok. The coordinator snapshot carries the per-process PEER
+    TABLE (heartbeat step/age, preempt flags, lost verdicts)."""
     from deeplearning4j_tpu.resilience import guardian as _guardian
     from deeplearning4j_tpu.resilience import watchdog as _watchdog
     g = _guardian.ACTIVE
     w = _watchdog.ACTIVE
+    try:
+        from deeplearning4j_tpu.parallel import coordination as _coord
+        c = _coord.ACTIVE
+    except Exception:  # noqa: BLE001 — health must always answer
+        c = None
     gsnap = g.snapshot() if g is not None else None
     wsnap = w.snapshot() if w is not None else None
+    csnap = c.snapshot() if c is not None else None
     status = "ok"
     if gsnap is not None and gsnap["status"] == "degraded":
         status = "degraded"
+    if csnap is not None and (csnap["preempt_requested"]
+                              or csnap["preempted"]):
+        status = "degraded"
     if wsnap is not None and wsnap["stalled"]:
         status = "stalled"
+    if csnap is not None and csnap["lost"]:
+        status = "peer_lost"
     if gsnap is not None and gsnap["status"] == "diverged":
         status = "diverged"
-    return {"status": status, "guardian": gsnap, "watchdog": wsnap}
+    return {"status": status, "guardian": gsnap, "watchdog": wsnap,
+            "distributed": csnap}
 
 
 def __getattr__(name):

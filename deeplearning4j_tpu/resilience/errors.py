@@ -14,6 +14,8 @@ __all__ = [
     "CircuitOpenError", "InferenceTimeoutError",
     "InferenceOverloadedError", "InjectedFault", "FatalTrainingError",
     "DivergenceError", "CheckpointIntegrityError",
+    "DistributedInitError", "PeerLostError", "PeerDesyncError",
+    "PreemptionSignal",
 ]
 
 
@@ -79,3 +81,45 @@ class CheckpointIntegrityError(ResilienceError):
     """A checkpoint failed manifest verification on restore (checksum /
     structure mismatch, non-finite params, or a truncated write) and no
     older generation could be restored either."""
+
+
+class DistributedInitError(ResilienceError):
+    """Multi-host bootstrap failed LOUDLY: the coordinator never came up
+    within the connect deadline, the post-init sanity barrier timed out,
+    or the cluster shape (process count / device count) does not match
+    what every peer expected. Deliberately typed so supervisors can tell
+    'the cluster never formed' (re-schedule the whole job) from a
+    mid-run peer loss (`PeerLostError`, restart one worker)."""
+
+
+class PeerLostError(ResilienceError):
+    """A peer process stopped heartbeating / never reached a barrier
+    within the configured timeout — it was killed, wedged inside a
+    collective, or partitioned. Raised on the SURVIVING host within a
+    bounded time instead of hanging in the next collective forever; a
+    peer-table dump is written first (`.report_path` when available)."""
+
+    def __init__(self, message, peers=None, report_path=None):
+        super().__init__(message)
+        #: peer-table snapshot at detection time (pid -> info dict)
+        self.peers = peers or {}
+        self.report_path = report_path
+
+
+class PeerDesyncError(PeerLostError):
+    """Peers are alive but NOT on the same step / control decision — the
+    lockstep SPMD contract is broken (e.g. one worker skipped a batch
+    the others trained). Continuing would silently corrupt the model, so
+    the step-agreement check fails the run instead."""
+
+
+class PreemptionSignal(ResilienceError):
+    """A preemption notice (SIGTERM, or the `host.preempt` injection
+    site): the process must drain the in-flight step, write a final
+    coordinated checkpoint, and exit cleanly. Raised to UNWIND the fit
+    loop after the drain — it means 'shut down now', not 'something
+    broke'; `resume_or_init` on restart continues bit-identically."""
+
+    def __init__(self, message="preempted", step=None):
+        super().__init__(message)
+        self.step = step

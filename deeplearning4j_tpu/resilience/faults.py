@@ -35,6 +35,7 @@ RuntimeError to prove retry refuses it).
 """
 from __future__ import annotations
 
+import os
 import random
 import threading
 
@@ -43,7 +44,9 @@ from deeplearning4j_tpu.resilience.errors import InjectedFault
 __all__ = ["FaultPlan", "install_plan", "clear_plan", "ACTIVE",
            "DATA_NEXT", "TRAIN_DISPATCH", "CHECKPOINT_SAVE",
            "CHECKPOINT_RESTORE", "CHECKPOINT_CORRUPT", "EVAL_FORWARD",
-           "INFERENCE_FORWARD", "INFERENCE_COLLECTOR"]
+           "INFERENCE_FORWARD", "INFERENCE_COLLECTOR",
+           "COMM_ALLREDUCE", "COMM_BARRIER", "HOST_PREEMPT",
+           "PROCESS_ID", "resolve_process_id"]
 
 DATA_NEXT = "data.next"
 TRAIN_DISPATCH = "train.dispatch"
@@ -59,10 +62,47 @@ INFERENCE_FORWARD = "inference.forward"
 #: here kills the collector thread itself — the scenario the breaker-
 #: guarded auto-restart exists for
 INFERENCE_COLLECTOR = "inference.collector"
+#: fires before a multi-host train-step dispatch whose jitted body
+#: crosses processes (the compressed gradient all-reduce) — a fault
+#: here simulates a DCN transport blip mid-exchange
+COMM_ALLREDUCE = "comm.allreduce"
+#: fires before a cross-process coordination barrier / heartbeat
+#: exchange — the peer-containment paths must surface these as
+#: PeerLostError, never an indefinite hang
+COMM_BARRIER = "comm.barrier"
+#: fires at the multi-host sync point; inject a
+#: `PreemptionSignal` here to simulate SIGTERM delivery on schedule
+#: (the coordinated drain + checkpoint + clean exit path)
+HOST_PREEMPT = "host.preempt"
 
 #: THE switch production hooks check. None → injection off (the
 #: permanent state outside resilience tests).
 ACTIVE = None
+
+#: this process's id in a multi-host run — set by the distributed
+#: bootstrap (parallel/multihost.initialize) so FaultPlan seed
+#: derivation is process-aware without importing jax here. None until
+#: a bootstrap (or test) sets it; env vars are the fallback.
+PROCESS_ID = None
+
+
+def resolve_process_id(explicit=None):
+    """The process id used for per-worker seed derivation: an explicit
+    value wins, then the bootstrap-registered `PROCESS_ID`, then the
+    `DL4J_PROCESS_ID` / `JAX_PROCESS_ID` env vars, else 0 (single
+    process)."""
+    if explicit is not None:
+        return int(explicit)
+    if PROCESS_ID is not None:
+        return int(PROCESS_ID)
+    for env in ("DL4J_PROCESS_ID", "JAX_PROCESS_ID"):
+        v = os.environ.get(env)
+        if v:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return 0
 
 
 class _Rule:
@@ -102,11 +142,22 @@ class FaultPlan:
         plan.fired[TRAIN_DISPATCH]  # how many faults actually fired
     """
 
-    def __init__(self, seed=0):
+    def __init__(self, seed=0, process_id=None):
+        """Seed derivation is PROCESS-AWARE: the effective rng seed is
+        `seed ^ process_id` (explicit arg, else the bootstrap-registered
+        process id, else env — see `resolve_process_id`). Every worker
+        in a multi-process chaos run installs the same plan with the
+        same `seed`, yet probability rules fire on a schedule unique to
+        (and deterministic for) each worker — replaying the run replays
+        the exact same per-worker fault schedule. Deterministic rules
+        (`fail_at` / `every`) are unaffected: they count calls, not
+        random draws."""
         self._rules = {}            # site -> [_Rule]
         self._calls = {}            # site -> call count (1-based)
         self.fired = {}             # site -> faults raised
-        self._rng = random.Random(seed)
+        self.seed = int(seed)
+        self.process_id = resolve_process_id(process_id)
+        self._rng = random.Random(self.seed ^ self.process_id)
         self._lock = threading.Lock()
 
     # -- rule builders (chainable) --------------------------------------

@@ -181,6 +181,12 @@ class TrainingGuardian:
         self.driver_attached = False
         self._climbed_this_flush = False  # one rung max per flush
         self._prev_active = None   # guardian shadowed by install()
+        #: when bound to a specific trainer, on_step reports from OTHER
+        #: trainers are ignored — a host-local auxiliary guarded fit
+        #: must not advance a coordinated guardian's flush cadence (the
+        #: multi-host verdict windows must stay step-aligned across
+        #: hosts); None (default) accepts every report
+        self._bound = None
 
     # -- install / clear (the faults.py pattern, plus nesting) -----------
     def install(self):
@@ -220,8 +226,19 @@ class TrainingGuardian:
             self.uninstall()
         return False
 
+    def bind(self, trainer):
+        """Scope verdict collection to `trainer`: only reports whose
+        `source` IS that trainer feed this guardian's window — while
+        bound, source-less reports (call sites that don't plumb a
+        source, e.g. a host-local auxiliary MultiLayerNetwork.fit) are
+        dropped too, because ANY extra verdict desyncs a coordinated
+        window across hosts. None unbinds (every report counts, the
+        single-host default)."""
+        self._bound = trainer
+        return self
+
     # -- the hot hook ----------------------------------------------------
-    def on_step(self, loss, gnorm, ok, retryable=True):
+    def on_step(self, loss, gnorm, ok, retryable=True, source=None):
         """Record one guarded step's device scalars. No host sync here:
         the scalars materialize together at the `check_every` cadence.
         May raise `DivergenceError` from the flush when the ladder is
@@ -236,6 +253,8 @@ class TrainingGuardian:
         updates were applied, so re-running the batch would apply them
         twice) — escalation skips straight from the skip rung to
         rollback for those."""
+        if self._bound is not None and source is not self._bound:
+            return
         self.step += 1
         self._pending.append((gnorm, ok, retryable))
         if len(self._pending) >= self.check_every:

@@ -64,6 +64,15 @@ def leaf_finite(a):
         return True
     if isinstance(a, (bool, int)):
         return True
+    if getattr(a, "is_fully_addressable", True) is False:
+        # multi-host leaf: check the local shard (the full value when
+        # replicated); a genuinely remote-sharded leaf has nothing
+        # checkable here and passes — its owning process checks it
+        if getattr(a, "is_fully_replicated", False):
+            a = a.addressable_shards[0].data
+        else:
+            shards = getattr(a, "addressable_shards", ())
+            return all(leaf_finite(s.data) for s in shards)
     arr = np.asarray(a)
     kind = arr.dtype.kind
     if kind in "iub?SUO":          # ints/uints/bools/str/bytes/objects
@@ -87,11 +96,16 @@ def tree_finite(tree):
 # -- manifest write/read ----------------------------------------------------
 def _leaf_checksum(leaf):
     """crc32 over the leaf's raw bytes (host copy if device-resident),
-    prefixed so the algorithm can evolve without ambiguity. Multi-host
-    shards cannot be gathered here — they record (and verify) as
-    "skip"."""
+    prefixed so the algorithm can evolve without ambiguity. A REPLICATED
+    multi-host leaf checksums through its local shard (every process
+    holds the full value — this is what lets multi-host peers verify a
+    manifest against their own snapshot); genuinely sharded multi-host
+    leaves cannot be gathered here and record (and verify) as "skip"."""
     if getattr(leaf, "is_fully_addressable", True) is False:
-        return "skip"
+        if getattr(leaf, "is_fully_replicated", False):
+            leaf = leaf.addressable_shards[0].data
+        else:
+            return "skip"
     arr = np.ascontiguousarray(np.asarray(leaf))
     try:
         # zero-copy: crc straight over the array's memory — tobytes()

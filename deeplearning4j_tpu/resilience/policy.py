@@ -28,8 +28,11 @@ import time
 
 from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.resilience.errors import (CircuitOpenError,
+                                                  DistributedInitError,
                                                   FatalTrainingError,
                                                   InferenceTimeoutError,
+                                                  PeerLostError,
+                                                  PreemptionSignal,
                                                   RetryExhaustedError,
                                                   TransientError)
 from deeplearning4j_tpu.util.crash_reporting import CrashReportingUtil
@@ -59,10 +62,15 @@ def default_classifier(exc):
     if CrashReportingUtil.is_oom(exc):
         return False
     if isinstance(exc, (FatalTrainingError, RetryExhaustedError,
-                        InferenceTimeoutError)):
-        # typed non-retryables: a deadline that fully elapsed or an
-        # already-exhausted retry must not be retried just because the
-        # class NAME ("...TimeoutError") pattern-matches transient below
+                        InferenceTimeoutError, DistributedInitError,
+                        PeerLostError, PreemptionSignal)):
+        # typed non-retryables: a deadline that fully elapsed, an
+        # already-exhausted retry, a dead peer, or a preemption notice
+        # must not be retried just because the class name / message
+        # ("...TimeoutError", "preempted") pattern-matches transient
+        # below — the bootstrap retries connects itself; a lost peer
+        # needs a worker restart, not an in-process retry; a preemption
+        # means EXIT, retrying it defeats the drain
         return False
     if isinstance(exc, TransientError):
         return True

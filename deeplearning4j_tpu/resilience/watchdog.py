@@ -68,7 +68,8 @@ import traceback
 
 from deeplearning4j_tpu import monitoring as _mon
 
-__all__ = ["ACTIVE", "StallWatchdog", "clear_watchdog", "default_timeout"]
+__all__ = ["ACTIVE", "StallWatchdog", "clear_watchdog", "default_timeout",
+           "write_debug_report"]
 
 #: THE switch the trainer heartbeat hooks check (faults.py pattern).
 ACTIVE = None
@@ -79,6 +80,98 @@ def default_timeout():
         return float(os.environ.get("DL4J_STALL_TIMEOUT", "300"))
     except ValueError:
         return 300.0
+
+
+def _peer_table_lines():
+    """Peer-table section for crash/stall reports: the multi-host
+    coordinator's view of every process (step, heartbeat age, preempt
+    flag). Lazy + best-effort — single-process runs (no coordinator
+    installed) get one explanatory line, and a broken coordination
+    service must never stop a report from being written."""
+    lines = ["Peer table (multi-host):"]
+    try:
+        # read through sys.modules, never import: if coordination was
+        # never loaded, no coordinator can be installed — and a stall
+        # report must not pay (or deadlock on) a whole-package import
+        # inside a process that is by definition wedged
+        mod = sys.modules.get("deeplearning4j_tpu.parallel.coordination")
+        coord = getattr(mod, "ACTIVE", None) if mod is not None else None
+    except Exception:  # noqa: BLE001 — report must always be writable
+        coord = None
+    if coord is None:
+        lines.append("  (single process — no coordinator installed)")
+        return lines
+    try:
+        table = coord.peer_table()
+    except Exception as e:  # noqa: BLE001
+        lines.append(f"  (peer table unavailable: {e})")
+        return lines
+    if not table:
+        lines.append("  (no peer heartbeats observed yet)")
+    for pid, info in sorted(table.items()):
+        lines.append(f"  process {pid}: {info}")
+    return lines
+
+
+def write_debug_report(headline, dump_dir=None, prefix="dl4j-stall-report",
+                       extra_sections=None, count_dump=True):
+    """Write the full forensics report both the stall watchdog and the
+    multi-host peer monitor use: the headline, any caller sections
+    (heartbeat tables, peer autopsies), open monitoring spans, every
+    Python thread's stack, the flight-recorder tail, the last device
+    memory reading, and the multi-host peer table. Returns the report
+    path. `extra_sections` is a list of line-lists inserted after the
+    headline."""
+    ts = time.strftime("%Y%m%d-%H%M%S")
+    directory = dump_dir or os.getcwd()
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"{prefix}-{ts}-{os.getpid()}.txt")
+    lines = [f"deeplearning4j_tpu {prefix} ({ts})", "=" * 60, ""]
+    if isinstance(headline, str):
+        lines.append(headline)
+    else:
+        lines.extend(headline)
+    lines.append("")
+    for section in (extra_sections or ()):
+        lines.extend(section)
+        lines.append("")
+    lines.extend(_peer_table_lines())
+    lines.append("")
+    lines.append("Open monitoring spans by thread:")
+    spans = _mon.get_tracer().open_spans()
+    if spans:
+        for tid, stack in sorted(spans.items()):
+            lines.append(f"  thread {tid}: {' > '.join(stack)}")
+    else:
+        lines.append("  (none recorded — monitoring disabled or "
+                     "between spans)")
+    lines.append("")
+    lines.append("Python thread stacks:")
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for tid, frame in sys._current_frames().items():
+        if tid == threading.get_ident():
+            continue               # the reporting thread is not evidence
+        lines.append(f"  -- thread {tid} ({names.get(tid, '?')}) --")
+        for ln in traceback.format_stack(frame):
+            lines.extend("  " + s for s in ln.rstrip().splitlines())
+    lines.append("")
+    lines.append("Step-time flight recorder:")
+    lines.extend(_mon.step_recorder().crash_lines())
+    lines.append("")
+    mem = _mon.memory.last_sample()
+    lines.append("Last device memory reading:")
+    if mem:
+        for k, v in sorted(mem.items()):
+            lines.append(f"  {k}: {v}")
+    else:
+        lines.append("  (none — memory telemetry not sampling)")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    if count_dump and _mon.enabled():
+        _mon.get_registry().counter(
+            _mon.WATCHDOG_DUMPS,
+            help="stall crash-report files written").inc()
+    return path
 
 
 class StallWatchdog:
@@ -277,57 +370,17 @@ class StallWatchdog:
 
     # -- the report ------------------------------------------------------
     def _write_report(self, age):
-        ts = time.strftime("%Y%m%d-%H%M%S")
-        directory = self.dump_dir or os.getcwd()
-        os.makedirs(directory, exist_ok=True)
-        path = os.path.join(
-            directory, f"dl4j-stall-report-{ts}-{os.getpid()}.txt")
         now = self._clock()
-        lines = [f"deeplearning4j_tpu stall report ({ts})", "=" * 60, "",
-                 f"stall: no trainer heartbeat for {age:.1f} s "
-                 f"(timeout {self.stall_timeout:.1f} s)", ""]
-        lines.append("Heartbeats:")
+        beats = ["Heartbeats:"]
         if self._beats:
             for name, t in sorted(list(self._beats.items())):
-                lines.append(f"  {name}: {now - t:.1f} s ago")
+                beats.append(f"  {name}: {now - t:.1f} s ago")
         else:
-            lines.append("  (no step ever completed since arm())")
-        lines.append("")
-        lines.append("Open monitoring spans by thread:")
-        spans = _mon.get_tracer().open_spans()
-        if spans:
-            for tid, stack in sorted(spans.items()):
-                lines.append(f"  thread {tid}: {' > '.join(stack)}")
-        else:
-            lines.append("  (none recorded — monitoring disabled or "
-                         "between spans)")
-        lines.append("")
-        lines.append("Python thread stacks:")
-        names = {t.ident: t.name for t in threading.enumerate()}
-        for tid, frame in sys._current_frames().items():
-            if tid == threading.get_ident():
-                continue           # the watchdog itself is not evidence
-            lines.append(f"  -- thread {tid} ({names.get(tid, '?')}) --")
-            for ln in traceback.format_stack(frame):
-                lines.extend("  " + s for s in ln.rstrip().splitlines())
-        lines.append("")
-        lines.append("Step-time flight recorder:")
-        lines.extend(_mon.step_recorder().crash_lines())
-        lines.append("")
-        mem = _mon.memory.last_sample()
-        lines.append("Last device memory reading:")
-        if mem:
-            for k, v in sorted(mem.items()):
-                lines.append(f"  {k}: {v}")
-        else:
-            lines.append("  (none — memory telemetry not sampling)")
-        with open(path, "w") as f:
-            f.write("\n".join(lines) + "\n")
-        if _mon.enabled():
-            _mon.get_registry().counter(
-                _mon.WATCHDOG_DUMPS,
-                help="stall crash-report files written").inc()
-        return path
+            beats.append("  (no step ever completed since arm())")
+        return write_debug_report(
+            f"stall: no trainer heartbeat for {age:.1f} s "
+            f"(timeout {self.stall_timeout:.1f} s)",
+            dump_dir=self.dump_dir, extra_sections=[beats])
 
     # -- introspection (GET /health) -------------------------------------
     def snapshot(self):

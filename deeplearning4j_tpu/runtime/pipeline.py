@@ -58,13 +58,32 @@ from deeplearning4j_tpu.monitoring.state import STATE
 
 __all__ = [
     "DEFAULT_PREFETCH", "PrefetchIterator", "StagedBatch",
-    "StagedMultiBatch", "blocking_float", "materialize_score",
-    "maybe_prefetch", "stage_dataset", "stage_for_eval", "xla_owned_copy",
+    "StagedMultiBatch", "as_unaliasable", "blocking_float",
+    "materialize_score", "maybe_prefetch", "stage_dataset",
+    "stage_for_eval", "xla_owned_copy",
 ]
 
 #: default staging queue depth (double buffer): batch N+1 stages while
 #: step N computes. 0 disables prefetch globally.
 DEFAULT_PREFETCH = int(os.environ.get("DL4J_PIPELINE_PREFETCH", "2"))
+
+
+def as_unaliasable(host):
+    """A bit-exact but deliberately MISALIGNED copy of `host` that
+    jax's zero-copy eligibility check refuses — `device_put` /
+    `jnp.asarray` / `make_array_from_callback` of this view always
+    performs a REAL copy into XLA-allocated memory. The building block
+    of `xla_owned_copy`; exported for the per-shard staging paths
+    (multi-host placements go shard-by-shard through
+    `make_array_from_callback`, which would otherwise alias each shard's
+    numpy view exactly like a whole-array put)."""
+    host = np.asarray(host)
+    if host.nbytes == 0:
+        return host
+    raw = np.empty(host.nbytes + 1, np.uint8)
+    view = raw[1:1 + host.nbytes].view(host.dtype).reshape(host.shape)
+    view[...] = host
+    return view
 
 
 def xla_owned_copy(host, sharding=None):
@@ -75,16 +94,14 @@ def xla_owned_copy(host, sharding=None):
     array, XLA frees/reuses memory numpy owns — heap corruption that
     surfaces as free(): corrupted chunks, NaN params, or segfaults a
     step or two after resume. Staging through a deliberately MISALIGNED
-    view makes the zero-copy eligibility check fail, forcing a real
-    copy into XLA-allocated memory (verified 0/20 aliased). Pass
-    `sharding` to land the copy directly on an explicit placement."""
-    host = np.asarray(host)
-    if host.nbytes == 0:
-        out = jnp.asarray(host)
+    view (`as_unaliasable`) makes the zero-copy eligibility check fail,
+    forcing a real copy into XLA-allocated memory (verified 0/20
+    aliased). Pass `sharding` to land the copy directly on an explicit
+    placement."""
+    view = as_unaliasable(host)
+    if view.nbytes == 0:
+        out = jnp.asarray(view)
         return out if sharding is None else jax.device_put(out, sharding)
-    raw = np.empty(host.nbytes + 1, np.uint8)
-    view = raw[1:1 + host.nbytes].view(host.dtype).reshape(host.shape)
-    view[...] = host
     if sharding is None:
         return jnp.asarray(view)
     return jax.device_put(view, sharding)

@@ -45,6 +45,11 @@ HOT_MODULES = [
     "deeplearning4j_tpu/parallel/wrapper.py",
     "deeplearning4j_tpu/parallel/sharded_trainer.py",
     "deeplearning4j_tpu/parallel/inference.py",
+    # multi-host hot hooks: the per-step coordination/heartbeat/verdict
+    # paths must stay one pointer compare when disabled, and their
+    # sync-point registry calls guarded like everything else
+    "deeplearning4j_tpu/parallel/coordination.py",
+    "deeplearning4j_tpu/parallel/multihost.py",
     "deeplearning4j_tpu/resilience/guardian.py",
     "deeplearning4j_tpu/resilience/watchdog.py",
     "deeplearning4j_tpu/resilience/faults.py",

@@ -108,7 +108,7 @@ class TestDeepWalk:
     def test_communities_embed_closer(self):
         g = _barbell()
         dw = (DeepWalk.Builder().vectorSize(16).windowSize(3)
-              .learningRate(0.5).epochs(50).batchSize(256).seed(11).build())
+              .learningRate(0.01).epochs(50).batchSize(256).seed(11).build())
         dw.fit(g, walk_length=12)
         assert dw.numVertices() == 12 and dw.getVectorSize() == 16
         # mean intra-community similarity should beat inter-community
@@ -122,7 +122,7 @@ class TestDeepWalk:
     def test_vertices_nearest_stays_in_community(self):
         g = _barbell()
         dw = (DeepWalk.Builder().vectorSize(16).windowSize(3)
-              .learningRate(0.5).epochs(50).batchSize(256).seed(4).build())
+              .learningRate(0.01).epochs(50).batchSize(256).seed(4).build())
         dw.fit(g, walk_length=12)
         near = dw.verticesNearest(0, top=3)
         assert all(v < 6 for v in near)
@@ -138,7 +138,7 @@ class TestGraphVectorsSerializer:
     def test_roundtrip_exact(self, tmp_path):
         from deeplearning4j_tpu.graph.deepwalk import GraphVectorsSerializer
         g = _barbell()
-        dw = (DeepWalk.Builder().vectorSize(8).learningRate(0.5).epochs(10)
+        dw = (DeepWalk.Builder().vectorSize(8).learningRate(0.01).epochs(10)
               .batchSize(128).seed(5).build())
         dw.fit(g, walk_length=8)
         p = str(tmp_path / "gv.txt")

@@ -69,7 +69,7 @@ class TestWord2Vec:
         return (Word2Vec.Builder()
                 .minWordFrequency(1).layerSize(32).seed(7).windowSize(3)
                 .epochs(3).negativeSample(5).sampling(0)
-                .learningRate(0.05).batchSize(512)
+                .learningRate(0.01).batchSize(512)
                 .iterate(CollectionSentenceIterator(synthetic_corpus()))
                 .tokenizerFactory(DefaultTokenizerFactory())
                 .build().fit())
@@ -338,8 +338,8 @@ class TestSequenceVectors:
             g = group_a if rng.random() < 0.5 else group_b
             seqs.append(list(rng.choice(g, size=6)))
         sv = (SequenceVectors.Builder()
-              .layerSize(32).windowSize(3).epochs(30).seed(7)
-              .learningRate(0.3).batchSize(512).sampling(0)
+              .layerSize(32).windowSize(3).epochs(10).seed(7)
+              .learningRate(0.01).batchSize(512).sampling(0)
               .iterate(AbstractSequenceIterator(seqs))
               .build().fit())
         assert sv.vocabSize() == 12
@@ -522,7 +522,7 @@ class TestHierarchicalSoftmax:
         model = (Word2Vec.Builder()
                  .minWordFrequency(1).layerSize(32).seed(7).windowSize(3)
                  .epochs(4).useHierarchicSoftmax(True).sampling(0)
-                 .learningRate(0.08).batchSize(512)
+                 .learningRate(0.01).batchSize(512)
                  .iterate(CollectionSentenceIterator(synthetic_corpus()))
                  .tokenizerFactory(DefaultTokenizerFactory())
                  .build().fit())
