@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""CPU microbench: KV/carry-cache decode vs per-token full-sequence
+re-forward (generation/ — ROADMAP item 2), one JSON line.
+
+Three measurements over a char-RNN-sized TextGenerationLSTM-style
+model at sequence length 256:
+
+- **cached decode** — GenerationServer steady state: prefill once, then
+  one fixed-shape step executable per token (O(1) work/token). Reports
+  tokens/s and per-token ms; asserts the store never compiled past
+  warmup.
+- **full re-forward** — the no-decode-path baseline this PR removes:
+  every new token re-runs the whole fixed-shape (1, 256, F) masked
+  forward (one jit compile up front, O(T) work/token — the honest
+  "no incremental decode" serving strategy with static shapes).
+  Acceptance target: cached decode >= 5x tokens/s.
+- **admission mid-flight** — continuous batching under churn: two long
+  requests decode while two more are admitted into the in-flight
+  batch; reports aggregate tokens/s and asserts zero compiles and
+  zero extra traces during the whole run.
+
+Run:  JAX_PLATFORMS=cpu python bench_generation.py
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+
+SEQ_LEN = 256
+VOCAB = 32
+
+
+def _build_net(hidden=192, seed=7):
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+            .weightInit("xavier").list()
+            .layer(LSTM(nOut=hidden, activation="tanh"))
+            .layer(LSTM(nOut=hidden, activation="tanh"))
+            .layer(RnnOutputLayer(lossFunction="mcxent", nOut=VOCAB,
+                                  activation="softmax"))
+            .setInputType(InputType.recurrent(VOCAB)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _bench_cached_decode(net, prompt, new_tokens):
+    from deeplearning4j_tpu.generation import GenerationServer
+    srv = GenerationServer(net, slots=1, cache_lengths=[SEQ_LEN],
+                           prompt_buckets=[8], method="greedy", seed=0)
+    warm = srv.warmup()
+    try:
+        compiles0 = srv._store.stats["compiles"]
+        traces0 = srv._store.trace_calls
+        t0 = time.perf_counter()
+        toks = srv.generate(prompt, max_new_tokens=new_tokens,
+                            timeout=600)
+        wall = time.perf_counter() - t0
+        assert len(toks) == new_tokens
+        assert srv._store.stats["compiles"] == compiles0, \
+            "steady-state decode must not compile"
+        assert srv._store.trace_calls == traces0
+        return {"tokens": new_tokens,
+                "seconds": round(wall, 3),
+                "tokens_per_s": round(new_tokens / wall, 1),
+                "per_token_ms": round(wall * 1e3 / new_tokens, 3),
+                "warmup_s": round(warm["seconds"], 3)}, toks
+    finally:
+        srv.shutdown()
+
+
+def _bench_full_reforward(net, prompt, new_tokens):
+    """Per-token FULL fixed-shape re-forward: the pre-decode-path
+    serving strategy — static (1, SEQ_LEN, F) masked forward, logits
+    read at the last real position, one whole-sequence scan per
+    token."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fwd(params, state, x, mask):
+        _, preact, _, _ = net._forward(params, state, x, False, None,
+                                       mask=mask)
+        return preact
+
+    seq = list(prompt)
+    x = np.zeros((1, SEQ_LEN, VOCAB), np.float32)
+    for i, t in enumerate(seq):
+        x[0, i, t] = 1.0
+    mask = np.zeros((1, SEQ_LEN), np.float32)
+    # compile once outside the timed loop (shapes never change)
+    mask[0, :len(seq)] = 1.0
+    fwd(net._params, net._state, jnp.asarray(x),
+        jnp.asarray(mask)).block_until_ready()
+    toks = []
+    t0 = time.perf_counter()
+    for _ in range(new_tokens):
+        n = len(seq)
+        mask[0, :n] = 1.0
+        logits = fwd(net._params, net._state, jnp.asarray(x),
+                     jnp.asarray(mask))
+        tok = int(np.argmax(np.asarray(logits[0, n - 1])))
+        toks.append(tok)
+        if n < SEQ_LEN:
+            x[0, n, tok] = 1.0
+            seq.append(tok)
+    wall = time.perf_counter() - t0
+    return {"tokens": new_tokens,
+            "seconds": round(wall, 3),
+            "tokens_per_s": round(new_tokens / wall, 1),
+            "per_token_ms": round(wall * 1e3 / new_tokens, 3)}, toks
+
+
+def _bench_admission_mid_flight(net):
+    """Continuous batching under churn: start two long decodes, admit
+    two more mid-flight; aggregate throughput, zero compiles."""
+    from deeplearning4j_tpu.generation import GenerationServer
+    srv = GenerationServer(net, slots=4, cache_lengths=[SEQ_LEN],
+                           prompt_buckets=[8], method="greedy", seed=0)
+    srv.warmup()
+    try:
+        compiles0 = srv._store.stats["compiles"]
+        t0 = time.perf_counter()
+        first = [srv.submit([1, 2, 3], max_new_tokens=120)
+                 for _ in range(2)]
+        while srv.stats["tokens"] < 60:     # mid-flight...
+            time.sleep(0.01)
+        late = [srv.submit([4, 5], max_new_tokens=80)
+                for _ in range(2)]
+        total = sum(len(r.result(timeout=600)) for r in first + late)
+        wall = time.perf_counter() - t0
+        assert srv._store.stats["compiles"] == compiles0, \
+            "mid-flight admission must not compile"
+        return {"requests": 4,
+                "tokens": total,
+                "seconds": round(wall, 3),
+                "tokens_per_s": round(total / wall, 1),
+                "admissions": srv.stats["admissions"]}
+    finally:
+        srv.shutdown()
+
+
+def run(new_tokens=None):
+    prompt = [1, 5, 3, 7, 2, 6, 4, 8]
+    new_tokens = new_tokens or (SEQ_LEN - len(prompt))
+    net = _build_net()
+    cached, toks_c = _bench_cached_decode(net, prompt, new_tokens)
+    full, toks_f = _bench_full_reforward(net, prompt, new_tokens)
+    admission = _bench_admission_mid_flight(net)
+    return {
+        "seq_len": SEQ_LEN,
+        "vocab": VOCAB,
+        "greedy_tokens_agree": toks_c == toks_f,
+        "cached_decode": cached,
+        "full_reforward": full,
+        "speedup_tokens_per_s": round(
+            cached["tokens_per_s"] / full["tokens_per_s"], 2),
+        "admission_mid_flight": admission,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tokens", type=int, default=None)
+    args = ap.parse_args()
+    result = run(new_tokens=args.tokens)
+    print(json.dumps(result))
+    if result["speedup_tokens_per_s"] < 5.0:
+        raise SystemExit(
+            f"cached decode speedup {result['speedup_tokens_per_s']}x "
+            "below the 5x target")
+
+
+if __name__ == "__main__":
+    main()

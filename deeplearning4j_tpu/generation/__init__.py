@@ -1,0 +1,36 @@
+"""Autoregressive generation: KV-cache decode with continuous-batching
+admission (ROADMAP item 2 — the chat-style serving scenario class).
+
+- `decode`   — incremental decode-mode forwards: BertDecoder (per-layer
+  K/V caches + flash-attention decode kernel) and RecurrentDecoder
+  (LSTM/GRU carry state, bit-identical to the full-sequence scan).
+- `sampling` — fused batched greedy / temperature / top-k sampling over
+  explicit per-slot rng keys (all knobs traced: no recompiles).
+- `server`   — GenerationServer: fixed-shape decode batches, AOT
+  executables per (slot bucket, cache rung, prompt bucket), per-slot
+  admission/retirement, streaming token callbacks.
+
+Quick start:
+
+    from deeplearning4j_tpu.generation import GenerationServer
+    srv = GenerationServer(net, slots=8, cache_lengths=[256],
+                           method="top_k", top_k=40, temperature=0.8)
+    srv.warmup()                       # closed executable set, AOT
+    req = srv.submit(prompt_ids, max_new_tokens=100,
+                     on_token=lambda t: print(t))
+    tokens = req.result()
+"""
+from deeplearning4j_tpu.generation.decode import (BertDecoder,
+                                                  RecurrentDecoder)
+from deeplearning4j_tpu.generation.sampling import (GREEDY, SAMPLE,
+                                                    method_id,
+                                                    sample_step)
+from deeplearning4j_tpu.generation.server import (GenerationRequest,
+                                                  GenerationServer,
+                                                  status)
+
+__all__ = [
+    "BertDecoder", "RecurrentDecoder",
+    "GREEDY", "SAMPLE", "method_id", "sample_step",
+    "GenerationRequest", "GenerationServer", "status",
+]

@@ -1,0 +1,316 @@
+"""Decode-mode forwards: incremental single-token model evaluation over
+donated device state.
+
+Two adapters expose one contract to the GenerationServer:
+
+- **BertDecoder** — transformer stacks built on `models/bert.py` params:
+  per-layer K/V caches `(L, S, H, C, Dh)` (C = cache-length rung) with a
+  rolling per-slot position index. `step` embeds the current token at
+  its slot position, writes its K/V row, and attends the single query
+  against the cached keys via `flash_attention_decode` (Pallas kernel on
+  TPU, einsum elsewhere) — O(C) work per token instead of the O(T²)
+  full-sequence re-forward. `prefill` runs the causal full forward over
+  a length-bucketed prompt and writes the whole K/V block into the
+  slot's cache rows in one shot.
+
+- **RecurrentDecoder** — LSTM/GRU-style `MultiLayerNetwork`s
+  (TextGenerationLSTM and friends): the decode state is the per-layer
+  recurrent carry (h, c) rows, threaded through the network's own
+  `_forward(carries=...)` path, so decode-step numerics are
+  BIT-IDENTICAL to the full-sequence scan (tier-1 asserted).
+
+The contract (all pure functions, traced into AOT executables by the
+server — nothing here may touch the host):
+
+    model_args()                  -> tuple of non-donated leading args
+    init_cache(slots, cache_len)  -> donated cache pytree
+    step(margs, cache, tokens, pos)            -> (logits (S,V), cache')
+    prefill(margs, cache, slot, prompt, plen)  -> (cache', logits (V,))
+    grow(cache, new_len)          -> cache padded to a longer rung
+"""
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.kernels.flash_attention import (
+    flash_attention, flash_attention_decode)
+from deeplearning4j_tpu.models.bert import (_ffn, _layer_norm,
+                                            bert_mlm_logits)
+from deeplearning4j_tpu.parallel.ring_attention import dense_attention
+
+__all__ = ["BertDecoder", "RecurrentDecoder"]
+
+
+def _shape_tree_repr(tree):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return repr((str(treedef),
+                 tuple((tuple(l.shape), str(jnp.result_type(l)))
+                       for l in leaves)))
+
+
+class BertDecoder:
+    """KV-cache decode over a `models/bert.py` parameter tree.
+
+    The full-sequence reference this must match (≤ 1e-5) is
+    `bert_encode(..., causal=True)` + `bert_mlm_logits` over the same
+    prompt+generated prefix."""
+
+    uses_cache_rungs = True
+    n_model_args = 1
+
+    def __init__(self, cfg, params, attn_impl="auto"):
+        if cfg.moe_layers:
+            raise ValueError(
+                "BertDecoder does not support MoE layers (dense-dispatch "
+                "expert FFNs have no single-token decode path yet)")
+        if attn_impl not in ("auto", "dense", "pallas"):
+            raise ValueError(
+                f"attn_impl must be 'auto', 'dense' or 'pallas', "
+                f"got {attn_impl!r}")
+        self.cfg = cfg
+        self.params = params
+        self.attn_impl = attn_impl
+        self.vocab_size = int(cfg.vocab_size)
+        self.max_cache_len = int(cfg.max_position_embeddings)
+
+    def fingerprint(self):
+        parts = ("bert-decode", repr(self.cfg), self.attn_impl,
+                 _shape_tree_repr(self.params))
+        return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
+
+    def model_args(self):
+        return (self.params,)
+
+    def init_cache(self, slots, cache_len):
+        cfg = self.cfg
+        shape = (cfg.num_layers, slots, cfg.num_heads, cache_len,
+                 cfg.head_dim)
+        dt = cfg.compute_dtype
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def grow(self, cache, new_len):
+        pad = [(0, 0)] * 5
+        pad[3] = (0, int(new_len) - cache["k"].shape[3])
+        return {"k": jnp.pad(cache["k"], pad),
+                "v": jnp.pad(cache["v"], pad)}
+
+    def _embed(self, params, tokens, pos):
+        """Token + position embedding at per-slot positions (mirrors
+        bert_encode's embedding block; token_type unused in LM mode)."""
+        emb = params["embeddings"]
+        x = jnp.take(emb["word"], tokens, axis=0) \
+            + jnp.take(emb["position"], pos, axis=0)
+        return _layer_norm(x.astype(self.cfg.compute_dtype),
+                           emb["ln_scale"], emb["ln_bias"],
+                           self.cfg.layer_norm_eps)
+
+    def _decode_attn(self, q, kc, vc, cmask):
+        impl = self.attn_impl
+        if impl == "auto":
+            impl = ("pallas" if jax.default_backend() == "tpu"
+                    else "dense")
+        return flash_attention_decode(q, kc, vc, cmask, impl=impl)
+
+    def _prefill_attn(self, q, k, v):
+        if self.attn_impl == "pallas" or (
+                self.attn_impl == "auto"
+                and jax.default_backend() == "tpu"):
+            return flash_attention(q, k, v, causal=True)
+        return dense_attention(q, k, v, causal=True)
+
+    def step(self, margs, cache, tokens, pos):
+        """One decode step for the whole batch: embed `tokens` at their
+        slot positions, write each slot's K/V row at `pos`, attend the
+        single query over rows 0..pos, and return next-token logits.
+        `pos[s]` = number of already-cached tokens in slot s (the
+        position the current token occupies)."""
+        (params,) = margs
+        cfg = self.cfg
+        x = self._embed(params, tokens, pos)            # (S, H)
+        kc, vc = cache["k"], cache["v"]
+        s = tokens.shape[0]
+        ar = jnp.arange(s)
+        c = kc.shape[3]
+        nh, hd = cfg.num_heads, cfg.head_dim
+        # rows 0..pos are valid (the current write included)
+        cmask = jnp.arange(c)[None, :] <= pos[:, None]  # (S, C)
+        dt = x.dtype
+        for li, layer in enumerate(params["layers"]):
+            qkv = x @ layer["qkv_W"].astype(dt) \
+                + layer["qkv_b"].astype(dt)             # (S, 3H)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(s, nh, hd)
+            kc = kc.at[li, ar, :, pos].set(k.reshape(s, nh, hd))
+            vc = vc.at[li, ar, :, pos].set(v.reshape(s, nh, hd))
+            ctx = self._decode_attn(q, kc[li], vc[li], cmask)
+            a = ctx.reshape(s, cfg.hidden_size) \
+                @ layer["proj_W"].astype(dt) + layer["proj_b"].astype(dt)
+            x = _layer_norm(x + a, layer["ln1_scale"], layer["ln1_bias"],
+                            cfg.layer_norm_eps)
+            f = _ffn(cfg, layer, x, False, None)
+            x = _layer_norm(x + f, layer["ln2_scale"], layer["ln2_bias"],
+                            cfg.layer_norm_eps)
+        logits = bert_mlm_logits(cfg, params, x[:, None, :])[:, 0]
+        return logits, {"k": kc, "v": vc}
+
+    def prefill(self, margs, cache, slot, prompt, plen):
+        """Causal full forward over one length-bucketed prompt (1, P);
+        writes the slot's K/V block for rows 0..P-1 in one shot and
+        returns the logits at the last REAL position (plen - 1). Rows
+        beyond plen hold padding garbage — masked out by the decode
+        cache mask (pos starts at plen), so a bucketed prompt serves
+        bit-the-same as an exact-length one."""
+        (params,) = margs
+        cfg = self.cfg
+        p_len = prompt.shape[0]
+        emb = params["embeddings"]
+        x = jnp.take(emb["word"], prompt[None], axis=0) \
+            + emb["position"][None, :p_len]
+        x = _layer_norm(x.astype(cfg.compute_dtype), emb["ln_scale"],
+                        emb["ln_bias"], cfg.layer_norm_eps)
+        kc, vc = cache["k"], cache["v"]
+        nh, hd = cfg.num_heads, cfg.head_dim
+        dt = x.dtype
+        for li, layer in enumerate(params["layers"]):
+            qkv = x @ layer["qkv_W"].astype(dt) \
+                + layer["qkv_b"].astype(dt)             # (1, P, 3H)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+
+            def heads(t):
+                return t.reshape(1, p_len, nh, hd).transpose(0, 2, 1, 3)
+
+            q, k, v = heads(q), heads(k), heads(v)      # (1, nh, P, hd)
+            kc = lax.dynamic_update_slice(
+                kc, k[None].astype(kc.dtype), (li, slot, 0, 0, 0))
+            vc = lax.dynamic_update_slice(
+                vc, v[None].astype(vc.dtype), (li, slot, 0, 0, 0))
+            ctx = self._prefill_attn(q, k, v)
+            a = ctx.transpose(0, 2, 1, 3).reshape(1, p_len,
+                                                  cfg.hidden_size) \
+                @ layer["proj_W"].astype(dt) + layer["proj_b"].astype(dt)
+            x = _layer_norm(x + a, layer["ln1_scale"], layer["ln1_bias"],
+                            cfg.layer_norm_eps)
+            f = _ffn(cfg, layer, x, False, None)
+            x = _layer_norm(x + f, layer["ln2_scale"], layer["ln2_bias"],
+                            cfg.layer_norm_eps)
+        h_last = jnp.take(x[0], plen - 1, axis=0)       # (H,)
+        logits = bert_mlm_logits(cfg, params, h_last[None, None, :])[0, 0]
+        return {"k": kc, "v": vc}, logits
+
+
+class RecurrentDecoder:
+    """Carry-state decode over a recurrent `MultiLayerNetwork` (stacked
+    LSTM/GRU/SimpleRnn + an RnnOutputLayer-style dense head, e.g. the
+    zoo's TextGenerationLSTM).
+
+    Tokens enter as one-hot feature vectors (char-RNN convention:
+    head nOut == input feature width == vocab). The decode state is the
+    recurrent carries, threaded through the network's OWN
+    `_forward(carries=...)` path — a decode step is literally a T=1
+    scan, so carries and logits are bit-identical to the full-sequence
+    forward."""
+
+    uses_cache_rungs = False
+    n_model_args = 2
+
+    def __init__(self, net):
+        self.net = net
+        layers = net.layers
+        head = layers[-1]
+        if not hasattr(head, "pre_activation"):
+            raise ValueError(
+                f"RecurrentDecoder needs a dense (RnnOutputLayer-style) "
+                f"head with pre_activation; got {type(head).__name__}")
+        rec = [l for l in layers[:-1]
+               if getattr(l, "is_recurrent", False)]
+        if not rec:
+            raise ValueError(
+                "RecurrentDecoder needs at least one recurrent layer")
+        for l in rec:
+            if not hasattr(l, "scan_apply"):
+                raise ValueError(
+                    f"{type(l).__name__} cannot run step-by-step "
+                    "(no carried-state protocol)")
+        it = getattr(net.conf, "input_type", None)
+        if it is None or not hasattr(it, "size"):
+            raise ValueError(
+                "net conf has no sized recurrent InputType")
+        self.n_features = int(it.size)
+        self.vocab_size = int(head.nOut)
+        if self.vocab_size != self.n_features:
+            raise ValueError(
+                f"char-RNN generation feeds sampled tokens back as "
+                f"one-hot inputs: head nOut ({self.vocab_size}) must "
+                f"equal the input feature width ({self.n_features})")
+        # carry state is O(1) in sequence length: cache rungs are
+        # meaningless — the server collapses them to a single rung that
+        # only bounds prompt_len + max_new_tokens
+        self.max_cache_len = None
+
+    def fingerprint(self):
+        from deeplearning4j_tpu.runtime.executables import \
+            model_fingerprint
+        return hashlib.sha256(
+            ("recurrent-decode-" + model_fingerprint(self.net)).encode()
+        ).hexdigest()[:16]
+
+    def model_args(self):
+        return (self.net._params, self.net._state)
+
+    def init_cache(self, slots, cache_len):
+        carries = {}
+        for i, layer in enumerate(self.net.layers):
+            if getattr(layer, "is_recurrent", False):
+                carries[str(i)] = layer.zero_carry(int(slots))
+        return {"carries": carries}
+
+    def grow(self, cache, new_len):
+        return cache    # carry state is length-independent
+
+    def step(self, margs, cache, tokens, pos):
+        """One decode step: one-hot the current tokens, run a T=1 pass
+        through the network's carried-state forward, return the head's
+        pre-activation logits (softmax-free: sampling works on logits)
+        and the advanced carries.
+
+        The step runs under an all-ones validity mask so it compiles
+        into the SAME masked-scan graph family as the bucketed prefill
+        and the canonical masked full-sequence forward — XLA fuses the
+        gate math identically across that family (tested), which is
+        what makes decode carries/logits BIT-identical to the
+        full-sequence recompute rather than merely close."""
+        params, state = margs
+        s = tokens.shape[0]
+        x = jax.nn.one_hot(tokens, self.n_features,
+                           dtype=jnp.float32)[:, None, :]    # (S, 1, F)
+        _, preact, _, _, carries = self.net._forward(
+            params, state, x, False, None,
+            mask=jnp.ones((s, 1), jnp.float32),
+            carries=cache["carries"])
+        return preact[:, 0].astype(jnp.float32), {"carries": carries}
+
+    def prefill(self, margs, cache, slot, prompt, plen):
+        """Run the length-bucketed prompt through the full scan under a
+        validity mask (masked steps HOLD the carry — the recurrent
+        layers' own masking contract), then graft the resulting carry
+        rows into the slot. Returns the logits at the last real step."""
+        params, state = margs
+        p_len = prompt.shape[0]
+        x = jax.nn.one_hot(prompt, self.n_features,
+                           dtype=jnp.float32)[None]          # (1, P, F)
+        mask = (jnp.arange(p_len)[None, :] < plen).astype(jnp.float32)
+        _, preact, _, _, fresh = self.net._forward(
+            params, state, x, False, None, mask=mask, carries={})
+        carries = {}
+        for idx, rows in cache["carries"].items():
+            carries[idx] = tuple(
+                lax.dynamic_update_slice(
+                    full, one.astype(full.dtype),
+                    (slot,) + (0,) * (full.ndim - 1))
+                for full, one in zip(rows, fresh[idx]))
+        logits = jnp.take(preact[0], plen - 1, axis=0).astype(jnp.float32)
+        return {"carries": carries}, logits

@@ -1,0 +1,642 @@
+"""GenerationServer — continuous-batching autoregressive serving over
+the AOT executable stack.
+
+The chat-style scenario: long-lived stateful requests share one
+fixed-shape decode batch. A background decode thread runs ONE
+pre-compiled executable per token for the WHOLE batch; new requests are
+admitted into free slots of the in-flight batch between steps
+(prefill + cache graft, one dispatch) and finished ones retire without
+ever changing a shape — the executable set is closed over
+(slot bucket, cache-length rung, prompt bucket) exactly like
+`ParallelInference`'s bucket ladder is closed over batch shapes.
+
+Steady-state contract (linted by scripts/check_fastpath.py and
+regression-tested): past `warmup()`, the decode loop performs ZERO jit
+traces and ZERO XLA compiles — step, admit, retire, and grow all
+resolve from the in-memory executable tier — and the ONLY per-token
+host sync is the sampled-token fetch (`_fetch_tokens`); the whole
+decode state (KV caches / recurrent carries, positions, active mask,
+per-slot sampling knobs, rng keys) lives on device and is DONATED
+through every step, so steady state is one fixed-shape dispatch per
+token.
+
+Executables (per `FunctionStore`, two-tier: in-memory + on-disk
+serialized — a restarted replica warms from disk):
+
+- ``("step", C)`` — decode one token for all S slots at cache rung C:
+  embed → write K/V row (or advance carries) → single-query attention →
+  logits → fused per-slot sampling (greedy / temperature / top-k, all
+  TRACED per-slot values: mixed sampling configs share one executable).
+- ``("admit", C, P)`` — prefill one prompt at prompt bucket P, graft
+  its cache/carry rows into a slot, arm the slot's sampling config and
+  rng key, sample the first token.
+- ``("retire",)`` — clear a slot's position/active/token columns
+  (cache rows need no clearing: the cache-validity mask hides them).
+- ``("grow_to_<C'>", C)`` — pad the KV cache from rung C to C' when an
+  admission needs more room than the current rung (never shrinks
+  mid-flight; recurrent carry state is rung-independent).
+
+Resilience: admission rides the same bounded-enqueue/shed semantics as
+`ParallelInference` (`InferenceOverloadedError`, enqueue timeout); a
+decode-loop failure fails the affected requests, resets the device
+state, and keeps serving.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import weakref
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.generation.sampling import method_id, sample_step
+
+__all__ = ["GenerationRequest", "GenerationServer", "status"]
+
+_SERVERS = weakref.WeakSet()
+
+#: decode-state tuple layout (everything donated through each step)
+_CACHE, _POS, _ACTIVE, _TOKENS, _RNG, _METHOD, _TEMP, _TOPK = range(8)
+
+
+class GenerationRequest:
+    """Handle for one submitted prompt: collects generated tokens,
+    streams them (`stream()` / `on_token`), resolves via `result()`."""
+
+    def __init__(self, prompt, max_new_tokens, eos_id, method,
+                 temperature, top_k, on_token=None):
+        self.prompt = prompt                  # np.int32 (plen,)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.method = method                  # sampling.GREEDY/SAMPLE
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.on_token = on_token
+        self.tokens = []                      # generated token ids
+        self.error = None
+        self.finish_reason = None             # "eos" | "length" | "error"
+        self._done = threading.Event()
+        self._stream = queue.Queue()
+
+    # -- server side ------------------------------------------------------
+    def _push(self, tok):
+        self.tokens.append(tok)
+        self._stream.put(tok)
+        if self.on_token is not None:
+            try:
+                self.on_token(tok)
+            except Exception:  # noqa: BLE001 — a bad callback must not
+                pass           # kill the shared decode loop
+
+    def _finish(self, reason):
+        self.finish_reason = reason
+        self._done.set()
+        self._stream.put(None)
+
+    def _fail(self, exc):
+        self.error = exc
+        self._finish("error")
+
+    # -- client side ------------------------------------------------------
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        """Block until the request finished; returns the generated
+        token ids — when generation stopped on `eos_id`, the EOS token
+        is the last element (finish_reason tells which case hit)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation request still in flight")
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+    def stream(self, timeout=None):
+        """Yield tokens as they are generated (ends at EOS/length).
+        `timeout` bounds the wait per token (TimeoutError on expiry,
+        matching result())."""
+        while True:
+            try:
+                tok = self._stream.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    "generation stream produced no token within the "
+                    "timeout") from None
+            if tok is None:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield tok
+
+
+class GenerationServer:
+    """Continuous-batching KV-cache decode server over one model.
+
+    `decoder`: a `generation.decode` adapter (BertDecoder /
+    RecurrentDecoder) or a recurrent `MultiLayerNetwork` (wrapped
+    automatically). `slots` is the decode batch bucket; `cache_lengths`
+    the cache rungs (prompt_len + max_new_tokens must fit the top
+    rung); `prompt_buckets` the prefill length ladder."""
+
+    def __init__(self, decoder, slots=4, cache_lengths=(128,),
+                 prompt_buckets=None, method="greedy", temperature=1.0,
+                 top_k=0, eos_id=None, max_new_tokens=64, seed=0,
+                 queue_limit=256, enqueue_timeout_ms=100.0,
+                 exec_cache_dir=None):
+        from deeplearning4j_tpu.generation.decode import RecurrentDecoder
+        if not hasattr(decoder, "init_cache"):
+            decoder = RecurrentDecoder(decoder)
+        self.decoder = decoder
+        self.slots = int(slots)
+        if self.slots < 1:
+            raise ValueError("slots must be >= 1")
+        rungs = tuple(sorted({int(c) for c in cache_lengths}))
+        if not rungs or rungs[0] < 2:
+            raise ValueError(f"cache_lengths must be >= 2: {cache_lengths}")
+        if not decoder.uses_cache_rungs:
+            # carry state is O(1) in sequence length: one rung, which
+            # only bounds prompt_len + max_new_tokens
+            rungs = (rungs[-1],)
+        if decoder.max_cache_len is not None \
+                and rungs[-1] > decoder.max_cache_len:
+            raise ValueError(
+                f"top cache rung {rungs[-1]} exceeds the model's "
+                f"maximum decodable length {decoder.max_cache_len}")
+        self.cache_lengths = rungs
+        if prompt_buckets is None:
+            prompt_buckets, b = [], 8
+            while b < rungs[-1]:
+                prompt_buckets.append(b)
+                b *= 2
+            prompt_buckets.append(rungs[-1])
+        self.prompt_buckets = tuple(sorted({int(p)
+                                            for p in prompt_buckets}))
+        if self.prompt_buckets[-1] > rungs[-1]:
+            raise ValueError("prompt buckets cannot exceed the top "
+                             "cache rung")
+        self.default_method = method_id(method)
+        self.default_temperature = float(temperature)
+        self.default_top_k = int(top_k)
+        self.default_eos_id = eos_id
+        self.default_max_new_tokens = int(max_new_tokens)
+        self.seed = int(seed)
+        self.enqueue_timeout = float(enqueue_timeout_ms) / 1e3
+        self.stats = {"tokens": 0, "steps": 0, "admissions": 0,
+                      "retirements": 0, "errors": 0}
+        self.token_fetches = 0       # host syncs: ONE per decode step
+        self._queue = queue.Queue(maxsize=int(queue_limit))
+        self._store = None           # FunctionStore, built at warmup
+        self._exec_cache_dir = exec_cache_dir
+        self._exes = {}              # (name, *) -> bare executable call
+        self._margs = None           # non-donated model args
+        self._state = None           # donated decode-state tuple
+        self._rung = None
+        self._slot_req = {}          # slot -> (GenerationRequest, admit#)
+        self._free = list(range(self.slots))
+        self._counter = 0            # admission counter (rng derivation)
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._shutdown = False
+        self._dead = None            # unrecoverable decode-loop error
+        self._warm = False
+        self._thread = None
+        _SERVERS.add(self)
+
+    # -- warmup (the declared trace/compile boundary) ---------------------
+    def warmup(self):
+        """Build the whole closed executable set — step/retire per
+        rung, admit per (rung, prompt bucket), grow per rung pair —
+        through the two-tier FunctionStore (warm replica: deserialize,
+        no XLA compile), initialize the device decode state at the
+        smallest rung, and start the decode loop. Idempotent (and safe
+        under concurrent first submits)."""
+        with self._lock:
+            return self._warmup_locked()
+
+    def _warmup_locked(self):
+        if self._warm:
+            return {"compiled": 0, "from_disk": 0, "seconds": 0.0,
+                    "executables": len(self._exes)}
+        from deeplearning4j_tpu.runtime.executables import FunctionStore
+        t0 = time.perf_counter()
+        # slots is part of every executable's SHAPE but not of the
+        # (name, rung, bucket) keys — it must be part of the store
+        # identity or two servers over the same model with different
+        # slot counts would share (wrong-shaped) disk entries
+        store = FunctionStore(
+            f"{self.decoder.fingerprint()}-s{self.slots}",
+            directory=self._exec_cache_dir)
+        store.register("step", self._traced_step,
+                       donate_argnums=self._donate_range())
+        store.register("admit", self._traced_admit,
+                       donate_argnums=self._donate_range())
+        store.register("retire", self._traced_retire,
+                       donate_argnums=(0, 1, 2))
+        self._margs = tuple(self.decoder.model_args())
+        sds = jax.ShapeDtypeStruct
+        scalar_i = sds((), jnp.int32)
+        scalar_f = sds((), jnp.float32)
+        for ci, rung in enumerate(self.cache_lengths):
+            spec = self._state_spec(rung)
+            margs_spec = jax.tree_util.tree_map(
+                lambda l: sds(jnp.shape(l), jnp.result_type(l)),
+                self._margs)
+            key = ("step", rung)
+            e = store.load_or_compile(key, (*margs_spec, *spec))
+            self._exes[key] = e.call
+            for p in self.prompt_buckets:
+                if p > rung:
+                    continue
+                key = ("admit", rung, p)
+                e = store.load_or_compile(
+                    key, (*margs_spec, *spec, scalar_i,
+                          sds((p,), jnp.int32), scalar_i,
+                          sds((2,), jnp.uint32), scalar_i, scalar_f,
+                          scalar_i))
+                self._exes[key] = e.call
+            for bigger in self.cache_lengths[ci + 1:]:
+                name = f"grow_to_{bigger}"
+                store.register(
+                    name,
+                    lambda cache, _to=bigger: self.decoder.grow(cache,
+                                                                _to),
+                    donate_argnums=(0,))
+                key = (name, rung)
+                e = store.load_or_compile(key, (spec[_CACHE],))
+                self._exes[key] = e.call
+        key = ("retire",)
+        e = store.load_or_compile(
+            key, (sds((self.slots,), jnp.int32),
+                  sds((self.slots,), jnp.bool_),
+                  sds((self.slots,), jnp.int32), scalar_i))
+        self._exes[key] = e.call
+        self._store = store
+        self._rung = self.cache_lengths[0]
+        self._state = self._init_state(self._rung)
+        self._warm = True
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True)
+            self._thread.start()
+        return {"compiled": store.stats["compiles"],
+                "from_disk": store.stats["disk_hits"],
+                "seconds": time.perf_counter() - t0,
+                "executables": len(self._exes)}
+
+    def _donate_range(self):
+        n = len(tuple(self.decoder.model_args()))
+        return tuple(range(n, n + 8))
+
+    def _state_spec(self, rung):
+        sds = jax.ShapeDtypeStruct
+        s = self.slots
+        cache = jax.eval_shape(
+            lambda: self.decoder.init_cache(s, rung))
+        return (cache, sds((s,), jnp.int32), sds((s,), jnp.bool_),
+                sds((s,), jnp.int32), sds((s, 2), jnp.uint32),
+                sds((s,), jnp.int32), sds((s,), jnp.float32),
+                sds((s,), jnp.int32))
+
+    def _init_state(self, rung):
+        s = self.slots
+        return (self.decoder.init_cache(s, rung),
+                jnp.zeros((s,), jnp.int32),
+                jnp.zeros((s,), jnp.bool_),
+                jnp.zeros((s,), jnp.int32),
+                jnp.zeros((s, 2), jnp.uint32),
+                jnp.zeros((s,), jnp.int32),
+                jnp.ones((s,), jnp.float32),
+                jnp.zeros((s,), jnp.int32))
+
+    # -- traced bodies (pure; lowered once per signature at warmup) -------
+    def _traced_step(self, *args):
+        n = self.decoder.n_model_args
+        margs = args[:n]
+        cache, pos, active, tokens, rng, method, temp, topk = args[n:]
+        logits, cache = self.decoder.step(margs, cache, tokens, pos)
+        sampled, rng = sample_step(logits, rng, method, temp, topk)
+        tokens = jnp.where(active, sampled, tokens)
+        pos = jnp.where(active, pos + 1, pos)
+        out = jnp.where(active, sampled, -1)
+        return (cache, pos, active, tokens, rng, method, temp, topk,
+                out)
+
+    def _traced_admit(self, *args):
+        n = self.decoder.n_model_args
+        margs = args[:n]
+        (cache, pos, active, tokens, rng, method, temp, topk,
+         slot, prompt, plen, key, m, t, k) = args[n:]
+        cache, logits = self.decoder.prefill(margs, cache, slot, prompt,
+                                             plen)
+        first, key2 = sample_step(logits[None], key[None], m[None],
+                                  t[None], k[None])
+        pos = pos.at[slot].set(plen)
+        active = active.at[slot].set(True)
+        tokens = tokens.at[slot].set(first[0])
+        rng = rng.at[slot].set(key2[0])
+        method = method.at[slot].set(m)
+        temp = temp.at[slot].set(t)
+        topk = topk.at[slot].set(k)
+        return (cache, pos, active, tokens, rng, method, temp, topk,
+                first[0])
+
+    @staticmethod
+    def _traced_retire(pos, active, tokens, slot):
+        return (pos.at[slot].set(0),
+                active.at[slot].set(False),
+                tokens.at[slot].set(0))
+
+    # -- client side ------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None, eos_id="default",
+               method=None, temperature=None, top_k=None, on_token=None,
+               timeout_ms=None):
+        """Queue one prompt for generation; returns a GenerationRequest
+        immediately (tokens stream in as the decode loop reaches it).
+        Admission is bounded: a full queue sheds with
+        InferenceOverloadedError after the enqueue timeout."""
+        from deeplearning4j_tpu.parallel.inference import bounded_enqueue
+        if not self._warm:
+            self.warmup()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if prompt.size > self.prompt_buckets[-1]:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds the top prompt "
+                f"bucket {self.prompt_buckets[-1]}")
+        max_new = (self.default_max_new_tokens if max_new_tokens is None
+                   else int(max_new_tokens))
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size + max_new > self.cache_lengths[-1]:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new}) "
+                f"exceeds the top cache rung {self.cache_lengths[-1]}")
+        req = GenerationRequest(
+            prompt, max_new,
+            self.default_eos_id if eos_id == "default" else eos_id,
+            self.default_method if method is None else method_id(method),
+            (self.default_temperature if temperature is None
+             else temperature),
+            self.default_top_k if top_k is None else top_k,
+            on_token=on_token)
+        deadline = (None if timeout_ms is None
+                    else time.monotonic() + float(timeout_ms) / 1e3)
+        # liveness check + enqueue are ONE locked step: a request must
+        # never land in the queue after shutdown()/_die() drained it
+        # (nothing would ever fail or serve it — result() would hang)
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("GenerationServer is shut down")
+            if self._dead is not None:
+                raise self._dead
+            bounded_enqueue(self._queue, req, deadline,
+                            self.enqueue_timeout, what="generation")
+        self._work.set()
+        return req
+
+    def generate(self, prompt, timeout=None, **kw):
+        """Blocking convenience: submit + result."""
+        return self.submit(prompt, **kw).result(timeout=timeout)
+
+    # -- decode loop ------------------------------------------------------
+    def _loop(self):
+        while not self._shutdown:
+            try:
+                self._admit_pending()
+                if not self._slot_req:
+                    if not self._work.wait(timeout=0.05):
+                        continue
+                    self._work.clear()
+                    continue
+                self._step_once()
+            except Exception as e:  # noqa: BLE001 — fail reqs, stay up
+                try:
+                    self._recover(e)
+                except Exception as e2:  # noqa: BLE001 — recovery
+                    # itself failed (e.g. the state re-allocation hit
+                    # the same OOM): a silent thread death would hang
+                    # every future result() — mark the server dead so
+                    # submit() refuses and queued requests fail
+                    self._die(e2)
+                    return
+
+    def _admit_pending(self):
+        """Admit queued requests into free slots of the in-flight batch
+        — one prefill dispatch each, no shape changes (a longer request
+        may first GROW the cache to a pre-compiled bigger rung).
+
+        A failing admission cannot be contained to its own request:
+        the grow/admit dispatch DONATES the whole decode state, so a
+        post-donation failure leaves `self._state` pointing at freed
+        buffers (real on TPU; CPU ignores donation) — the exception
+        fails the triggering request here, then propagates so
+        `_recover` fails the in-flight batch and rebuilds the state
+        instead of letting the next step dispatch invalid buffers.
+        (Size/shape validation already happened at submit().)"""
+        while self._free:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                self._admit_one(req)
+            except Exception as e:  # noqa: BLE001 — see docstring
+                req._fail(e)
+                raise
+
+    def _admit_one(self, req):
+        plen = int(req.prompt.size)
+        pbucket = next(p for p in self.prompt_buckets if p >= plen)
+        needed = plen + req.max_new_tokens
+        rung = self._rung
+        if needed > rung or pbucket > rung:
+            rung = next(c for c in self.cache_lengths
+                        if c >= needed and c >= pbucket)
+            call = self._exes[(f"grow_to_{rung}", self._rung)]
+            cache = call(self._state[_CACHE])
+            self._state = (cache,) + self._state[1:]
+            self._rung = rung
+        slot = self._free.pop()
+        self._counter += 1
+        admit_id = self._counter
+        padded = np.zeros((pbucket,), np.int32)
+        padded[:plen] = req.prompt
+        key = np.random.default_rng(
+            (self.seed, admit_id)).integers(0, 2 ** 32, size=2,
+                                            dtype=np.uint32)
+        t0 = time.perf_counter()
+        call = self._exes[("admit", rung, pbucket)]
+        out = call(*self._margs, *self._state, np.int32(slot), padded,
+                   np.int32(plen), key, np.int32(req.method),
+                   np.float32(req.temperature), np.int32(req.top_k))
+        self._state = tuple(out[:8])
+        first = int(self._fetch_tokens(out[8]))
+        prefill_ms = (time.perf_counter() - t0) * 1e3
+        self._slot_req[slot] = req
+        self.stats["admissions"] += 1
+        self.stats["tokens"] += 1     # the prefill's first sampled token
+        if _mon.enabled():
+            reg = _mon.get_registry()
+            reg.counter(_mon.GEN_ADMISSIONS,
+                        help="sequences admitted into the decode "
+                             "batch").inc()
+            reg.counter(_mon.GEN_TOKENS,
+                        help="tokens generated (all slots)").inc()
+            reg.histogram(_mon.GEN_PREFILL_MS,
+                          help="prompt prefill + cache-graft wall "
+                               "time").observe(prefill_ms)
+            reg.gauge(_mon.GEN_ACTIVE_SLOTS,
+                      help="occupied decode slots").set(
+                len(self._slot_req))
+        self._deliver(slot, req, first)
+
+    def _step_once(self):
+        """ONE token for the whole batch: a single pre-compiled
+        fixed-shape dispatch; the sampled-token fetch is the only host
+        sync."""
+        t0 = time.perf_counter()
+        call = self._exes[("step", self._rung)]
+        out = call(*self._margs, *self._state)
+        self._state = tuple(out[:8])
+        toks = self._fetch_tokens(out[8])
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        served = list(self._slot_req.items())
+        self.stats["steps"] += 1
+        self.stats["tokens"] += len(served)
+        if _mon.enabled():
+            reg = _mon.get_registry()
+            reg.counter(_mon.GEN_TOKENS,
+                        help="tokens generated (all slots)").inc(
+                len(served))
+            reg.histogram(_mon.GEN_PER_TOKEN_MS,
+                          help="decode-step wall time (whole "
+                               "batch)").observe(dt_ms)
+        for slot, req in served:
+            self._deliver(slot, req, int(toks[slot]))
+
+    def _fetch_tokens(self, arr):
+        """THE per-step host sync: materialize the sampled tokens.
+        Everything else stays device-resident (and donated onward)."""
+        self.token_fetches += 1
+        return np.asarray(arr)
+
+    def _deliver(self, slot, req, tok):
+        req._push(tok)
+        if (req.eos_id is not None and tok == req.eos_id) \
+                or len(req.tokens) >= req.max_new_tokens:
+            self._retire_slot(
+                slot, "eos" if (req.eos_id is not None
+                                and tok == req.eos_id) else "length")
+
+    def _retire_slot(self, slot, reason):
+        """Per-sequence retirement: clear the slot's device columns
+        (one tiny pre-compiled dispatch) and free it for admission."""
+        call = self._exes[("retire",)]
+        pos, active, tokens = call(self._state[_POS],
+                                   self._state[_ACTIVE],
+                                   self._state[_TOKENS], np.int32(slot))
+        self._state = (self._state[_CACHE], pos, active, tokens,
+                       *self._state[_RNG:])
+        req = self._slot_req.pop(slot)
+        self._free.append(slot)
+        self.stats["retirements"] += 1
+        if _mon.enabled():
+            reg = _mon.get_registry()
+            reg.counter(_mon.GEN_RETIREMENTS,
+                        help="sequences retired (EOS or length)").inc()
+            reg.gauge(_mon.GEN_ACTIVE_SLOTS,
+                      help="occupied decode slots").set(
+                len(self._slot_req))
+        req._finish(reason)
+
+    def _recover(self, exc):
+        """A decode-loop failure fails the in-flight requests and
+        resets the device state (the donated buffers may be gone
+        mid-dispatch) — the server keeps serving new submissions."""
+        self.stats["errors"] += 1
+        with self._lock:
+            for slot, req in list(self._slot_req.items()):
+                req._fail(exc)
+            self._slot_req.clear()
+            self._free = list(range(self.slots))
+            self._rung = self.cache_lengths[0]
+            self._state = self._init_state(self._rung)
+
+    def _die(self, exc):
+        """Unrecoverable: record the cause, refuse future submits, and
+        fail everything queued or in flight so no caller hangs on a
+        server whose decode thread is gone."""
+        err = RuntimeError(
+            f"GenerationServer decode loop died: {exc!r}")
+        err.__cause__ = exc
+        with self._lock:
+            self._dead = err
+            for _, req in list(self._slot_req.items()):
+                req._fail(err)
+            self._slot_req.clear()
+        while True:
+            try:
+                self._queue.get_nowait()._fail(err)
+            except queue.Empty:
+                return
+
+    # -- lifecycle / status ----------------------------------------------
+    def shutdown(self):
+        """Idempotent: stops the decode loop; in-flight and queued
+        requests fail with a RuntimeError."""
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        err = RuntimeError("GenerationServer shut down")
+        # any submit racing this drain either saw _shutdown under the
+        # lock (raised) or enqueued before we took it above — so after
+        # this drain the queue stays empty forever
+        with self._lock:
+            for _, req in list(self._slot_req.items()):
+                req._fail(err)
+            self._slot_req.clear()
+            while True:
+                try:
+                    self._queue.get_nowait()._fail(err)
+                except queue.Empty:
+                    break
+
+    def __enter__(self):
+        self.warmup()
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    def status(self):
+        return {
+            "decoder": type(self.decoder).__name__,
+            "slots": self.slots,
+            "cache_lengths": list(self.cache_lengths),
+            "rung": self._rung,
+            "prompt_buckets": list(self.prompt_buckets),
+            "active_slots": len(self._slot_req),
+            "queued": self._queue.qsize(),
+            "warm": self._warm,
+            "executables": len(self._exes),
+            "token_fetches": self.token_fetches,
+            **self.stats,
+            "store": (None if self._store is None
+                      else self._store.status()),
+        }
+
+
+def status():
+    """Aggregate generation status for every live server
+    (`GET /generation` on the UIServer)."""
+    return {"servers": [s.status() for s in list(_SERVERS)]}

@@ -1,6 +1,8 @@
 """Pallas TPU kernels — the hand-fused hot ops (≡ the reference's cuDNN
 helper layer, rebuilt as TPU VMEM-tiled kernels; interpret-mode on CPU)."""
-from deeplearning4j_tpu.kernels.flash_attention import flash_attention
+from deeplearning4j_tpu.kernels.flash_attention import (flash_attention,
+                                                        flash_attention_decode)
 from deeplearning4j_tpu.kernels.layernorm import fused_layernorm
 
-__all__ = ["flash_attention", "fused_layernorm"]
+__all__ = ["flash_attention", "flash_attention_decode",
+           "fused_layernorm"]

@@ -477,3 +477,71 @@ def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
             f"kv_mask length {kv_mask.shape[1]} != Tk {tk}")
     return _flash_attention_vjp(q, k, v, mask, kv_mask, causal, block_q,
                                 block_k, interpret)
+
+
+# ---------------------------------------------------------------------------
+# decode kernel: one query token against a cached K/V
+# ---------------------------------------------------------------------------
+def _decode_reference(q, k_cache, v_cache, cache_mask):
+    """Einsum oracle for the decode path — softmax(q·Kᵀ/√d)·V over the
+    VALID cache rows only. Fully-invalid rows (no cached keys) come back
+    zeroed, matching the Pallas kernel's empty-softmax convention."""
+    d = q.shape[-1]
+    scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("bhqd,bhcd->bhqc", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    valid = cache_mask.astype(bool)
+    s = jnp.where(valid[:, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqc,bhcd->bhqd", p,
+                     v_cache.astype(jnp.float32)).astype(q.dtype)
+    any_valid = valid.any(axis=-1)
+    return jnp.where(any_valid[:, None, None, None], out, 0)
+
+
+def flash_attention_decode(q1, k_cache, v_cache, cache_mask, impl="auto",
+                           block_k=128, interpret=None):
+    """Incremental-decode attention: a SINGLE query block per sequence
+    attends over that sequence's cached K/V under a cache-validity mask.
+
+    The KV-cache serving hot path (generation/): at decode step t the
+    cache holds keys/values for positions 0..t (the current token's K/V
+    already written), `cache_mask` marks which cache rows are real
+    (ragged per sequence — slots in a continuous batch sit at different
+    positions), and the query is the current token only. O(C·D) HBM
+    per step instead of the O(T²) full-sequence re-forward.
+
+    - q1: (B, H, D) or (B, H, 1, D) — current-token query
+    - k_cache / v_cache: (B, H, C, D) — rolling caches (C = cache rung)
+    - cache_mask: (B, C) truthy — valid cache rows (ragged lengths)
+    - impl: 'auto' (Pallas kernel on TPU, einsum elsewhere), 'pallas'
+      (force kernel; interpret-mode off-TPU), or 'dense'
+    Forward-only (decode never backprops). Rows whose mask has NO valid
+    cache entry return zeros. Returns the same rank as q1.
+    """
+    squeeze = q1.ndim == 3
+    q = q1[:, :, None, :] if squeeze else q1
+    if q.ndim != 4 or q.shape[2] != 1:
+        raise ValueError(
+            f"q1 must be (B, H, D) or (B, H, 1, D), got {q1.shape}")
+    if k_cache.shape != v_cache.shape or k_cache.ndim != 4:
+        raise ValueError(
+            f"k_cache/v_cache must match as (B, H, C, D): "
+            f"{k_cache.shape} vs {v_cache.shape}")
+    if cache_mask.shape != (q.shape[0], k_cache.shape[2]):
+        raise ValueError(
+            f"cache_mask must be (B, C) = "
+            f"{(q.shape[0], k_cache.shape[2])}, got {cache_mask.shape}")
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "dense"
+    if impl == "pallas":
+        out, _ = _flash_forward(q, k_cache, v_cache, None, cache_mask,
+                                causal=False, block_q=128, block_k=block_k,
+                                interpret=interpret)
+    elif impl == "dense":
+        out = _decode_reference(q, k_cache, v_cache, cache_mask)
+    else:
+        raise ValueError(
+            f"unknown decode impl {impl!r}; expected 'auto', 'pallas' "
+            "or 'dense'")
+    return out[:, :, 0, :] if squeeze else out

@@ -130,7 +130,8 @@ def _dropout(x, rate, train, rng):
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 
-def _attention(cfg, layer, x, attn_mask, train, rng, attn_impl):
+def _attention(cfg, layer, x, attn_mask, train, rng, attn_impl,
+               causal=False):
     B, T, H = x.shape
     nh, hd = cfg.num_heads, cfg.head_dim
     dt = x.dtype
@@ -146,6 +147,14 @@ def _attention(cfg, layer, x, attn_mask, train, rng, attn_impl):
         # padded batches route the (B, T) mask into the kernel's masked
         # path (per-example key/query validity in VMEM)
         attn_impl = "flash" if jax.default_backend() == "tpu" else "dense"
+    if causal and callable(attn_impl):
+        raise ValueError(
+            "causal attention is only wired through the built-in "
+            "'dense'/'flash' impls; custom attn_impl callables do not "
+            "declare a causal parameter")
+    if causal and attn_impl == "blockwise":
+        raise ValueError("'blockwise' attn_impl has no causal path; "
+                         "use flash or dense for causal encoding")
     if callable(attn_impl):
         if attn_mask is None:
             ctx = attn_impl(q, k, v)
@@ -175,7 +184,7 @@ def _attention(cfg, layer, x, attn_mask, train, rng, attn_impl):
     elif attn_impl in ("blockwise", "flash"):
         if attn_impl == "flash":
             from deeplearning4j_tpu.kernels import flash_attention
-            ctx = flash_attention(q, k, v, mask=attn_mask)
+            ctx = flash_attention(q, k, v, causal=causal, mask=attn_mask)
         else:
             if attn_mask is not None:
                 raise ValueError("'blockwise' attn_impl has no padding-mask "
@@ -185,7 +194,7 @@ def _attention(cfg, layer, x, attn_mask, train, rng, attn_impl):
         mask = None
         if attn_mask is not None:
             mask = attn_mask[:, None, None, :] > 0
-        ctx = dense_attention(q, k, v, mask=mask)
+        ctx = dense_attention(q, k, v, causal=causal, mask=mask)
     else:
         raise ValueError(f"unknown attn_impl {attn_impl!r}; expected "
                          "'dense', 'blockwise', 'flash', or a callable")
@@ -224,11 +233,17 @@ def _moe_ffn(cfg, layer, x, train, rng):
     return _dropout(out, cfg.dropout, train, rng)
 
 
-def _encoder_layer(cfg, layer, x, attn_mask, train, rng, attn_impl):
+def _encoder_layer(cfg, layer, x, attn_mask, train, rng, attn_impl,
+                   causal=False):
+    # the incremental-decode path (generation/decode.py BertDecoder)
+    # mirrors this block's exact arithmetic against its K/V cache —
+    # changing norm placement / bias handling here must keep
+    # tests/test_generation.py::test_bert_kv_decode_matches_full_forward
+    # green (it pins decode == this forward to <= 1e-5)
     r1 = r2 = None
     if rng is not None:
         rng, r1, r2 = jax.random.split(rng, 3)
-    a = _attention(cfg, layer, x, attn_mask, train, r1, attn_impl)
+    a = _attention(cfg, layer, x, attn_mask, train, r1, attn_impl, causal)
     x = _layer_norm(x + a, layer["ln1_scale"], layer["ln1_bias"],
                     cfg.layer_norm_eps)
     if "moe" in layer:
@@ -240,8 +255,12 @@ def _encoder_layer(cfg, layer, x, attn_mask, train, rng, attn_impl):
 
 
 def bert_encode(cfg, params, input_ids, token_type_ids=None, attn_mask=None,
-                train=False, rng=None, attn_impl="auto"):
-    """(B, T) int ids -> (B, T, H) hidden states."""
+                train=False, rng=None, attn_impl="auto", causal=False):
+    """(B, T) int ids -> (B, T, H) hidden states.
+
+    `causal=True` masks attention to past-and-present positions only —
+    the full-sequence reference for the autoregressive decode path
+    (generation/): KV-cache decode logits must match this forward."""
     dt = cfg.compute_dtype
     B, T = input_ids.shape
     emb = params["embeddings"]
@@ -258,12 +277,12 @@ def bert_encode(cfg, params, input_ids, token_type_ids=None, attn_mask=None,
     block = _encoder_layer
     if cfg.remat:
         block = jax.checkpoint(_encoder_layer,
-                               static_argnums=(0, 4, 6))
+                               static_argnums=(0, 4, 6, 7))
     for li, layer in enumerate(params["layers"]):
         lr = None
         if rng is not None:
             lr = jax.random.fold_in(rng, li)
-        x = block(cfg, layer, x, attn_mask, train, lr, attn_impl)
+        x = block(cfg, layer, x, attn_mask, train, lr, attn_impl, causal)
     return x
 
 

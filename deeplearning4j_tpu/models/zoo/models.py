@@ -443,3 +443,21 @@ class TextGenerationLSTM(ZooModel):
                                       activation="softmax"))
                 .setInputType(InputType.recurrent(self.numClasses))
                 .build())
+
+    def generationServer(self, net=None, **kw):
+        """Serve this char-RNN autoregressively through the
+        KV/carry-cache decode stack (generation/GenerationServer):
+        incremental per-token decode with continuous-batching
+        admission instead of a full re-forward per character.
+
+            srv = TextGenerationLSTM(numClasses=77).generationServer(
+                slots=8, cache_lengths=[512], method="top_k", top_k=5)
+            srv.warmup()
+            chars = srv.generate(seed_ids, max_new_tokens=200)
+
+        Pass a trained `net` (from `.init()` + fit) to serve real
+        weights; omitting it serves a fresh init (useful for shape
+        warmup). Remaining kwargs go to GenerationServer."""
+        from deeplearning4j_tpu.generation import GenerationServer
+        return GenerationServer(net if net is not None else self.init(),
+                                **kw)

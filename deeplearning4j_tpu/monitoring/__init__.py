@@ -75,6 +75,8 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     PROFILE_OP_MS, PROFILE_OP_COUNT,
     STEP_WALL_MS, STEP_PHASE_MS,
     MODEL_PARAMS_BYTES, MODEL_OPT_STATE_BYTES, MODEL_LAYER_STATE_BYTES,
+    GEN_TOKENS, GEN_ACTIVE_SLOTS, GEN_ADMISSIONS, GEN_RETIREMENTS,
+    GEN_PREFILL_MS, GEN_PER_TOKEN_MS,
     bootstrap_core_metrics, collect_device_memory, get_registry,
     record_transfer)
 from deeplearning4j_tpu.monitoring.tracing import (  # noqa: F401
@@ -118,6 +120,8 @@ __all__ = [
     "DIST_BARRIER_TIMEOUTS", "DIST_ENCODED_BYTES", "DIST_RESIDUAL_NORM",
     "PIPELINE_SYNCS", "PIPELINE_HOST_BLOCKED_MS", "PIPELINE_PREFETCH_DEPTH",
     "PIPELINE_STAGED_BATCHES",
+    "GEN_TOKENS", "GEN_ACTIVE_SLOTS", "GEN_ADMISSIONS",
+    "GEN_RETIREMENTS", "GEN_PREFILL_MS", "GEN_PER_TOKEN_MS",
 ]
 
 

@@ -140,6 +140,15 @@ MODEL_PARAMS_BYTES = "dl4j.model.params_bytes"
 MODEL_OPT_STATE_BYTES = "dl4j.model.opt_state_bytes"
 MODEL_LAYER_STATE_BYTES = "dl4j.model.layer_state_bytes"
 
+# autoregressive generation (generation/server.py): KV-cache decode loop
+# with continuous-batching admission
+GEN_TOKENS = "dl4j.gen.tokens"
+GEN_ACTIVE_SLOTS = "dl4j.gen.active_slots"
+GEN_ADMISSIONS = "dl4j.gen.admissions"
+GEN_RETIREMENTS = "dl4j.gen.retirements"
+GEN_PREFILL_MS = "dl4j.gen.prefill_ms"
+GEN_PER_TOKEN_MS = "dl4j.gen.per_token_ms"
+
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _LABEL_RE = re.compile(r"[^a-zA-Z0-9_]")
 

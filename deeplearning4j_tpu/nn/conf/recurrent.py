@@ -114,10 +114,15 @@ class LSTM(BaseRecurrentLayer):
             z = zxw + h_prev @ u
             h, c = self._gates(z, c_prev, params, dtype)
             if m is not None:
-                mm = m[:, None]
-                h = mm * h + (1 - mm) * h_prev
-                c = mm * c + (1 - mm) * c_prev
-                y = mm * h
+                # exact SELECT, not arithmetic blending: a valid step is
+                # bit-identical to the unmasked step (the KV-less decode
+                # path's prefill-at-a-bucket == exact-length guarantee)
+                # and a garbage padded input can never NaN-poison a held
+                # carry (0 * nan would)
+                mm = m[:, None] > 0
+                h = jnp.where(mm, h, h_prev)
+                c = jnp.where(mm, c, c_prev)
+                y = jnp.where(mm, h, 0)
             else:
                 y = h
             return (h, c), y
@@ -190,9 +195,10 @@ class SimpleRnn(BaseRecurrentLayer):
                 zxw, m = inp
             h = act(zxw + h_prev @ u)
             if m is not None:
-                mm = m[:, None]
-                h = mm * h + (1 - mm) * h_prev
-                y = mm * h
+                # exact select — see LSTM.step
+                mm = m[:, None] > 0
+                h = jnp.where(mm, h, h_prev)
+                y = jnp.where(mm, h, 0)
             else:
                 y = h
             return (h,), y

@@ -85,6 +85,41 @@ def _bucket(n):
     return b
 
 
+def bounded_enqueue(q, item, deadline, enqueue_timeout, count_timeout=None,
+                    what="inference"):
+    """Bounded admission shared by ParallelInference and the generation
+    subsystem's GenerationServer: wait at most `enqueue_timeout` seconds
+    (clipped to the caller's deadline) for queue space, then SHED with
+    `InferenceOverloadedError` — callers never block indefinitely. A
+    caller deadline that expires while waiting raises
+    `InferenceTimeoutError` instead (callers retry on overloaded, not
+    on timeout); `count_timeout` lets the owner count that case on its
+    own metric."""
+    wait = enqueue_timeout
+    if deadline is not None:
+        wait = min(wait, max(0.0, deadline - time.monotonic()))
+    try:
+        if wait > 0:
+            q.put(item, timeout=wait)
+        else:
+            q.put_nowait(item)
+    except queue.Full:
+        if deadline is not None and time.monotonic() >= deadline:
+            if count_timeout is not None:
+                count_timeout()
+            raise InferenceTimeoutError(
+                f"{what} request deadline expired while waiting "
+                "for queue space") from None
+        if _mon.enabled():
+            _mon.get_registry().counter(
+                _mon.RESILIENCE_INFERENCE_SHED,
+                help="requests shed because the queue stayed full "
+                     "for the whole bounded enqueue wait").inc()
+        raise InferenceOverloadedError(
+            f"{what} queue full (limit {q.maxsize}) "
+            f"after {wait * 1e3:.6g} ms — request shed") from None
+
+
 class _Request:
     __slots__ = ("x", "event", "result", "error", "claimed", "cancelled",
                  "server")
@@ -341,31 +376,11 @@ class ParallelInference:
                 help="requests cancelled at their deadline").inc()
 
     def _enqueue(self, req, deadline):
-        wait = self.enqueue_timeout
-        if deadline is not None:
-            wait = min(wait, max(0.0, deadline - time.monotonic()))
-        try:
-            if wait > 0:
-                self._queue.put(req, timeout=wait)
-            else:
-                self._queue.put_nowait(req)
-        except queue.Full:
-            if deadline is not None and time.monotonic() >= deadline:
-                # the caller's deadline — not the enqueue budget —
-                # expired while waiting for space: that is a timeout,
-                # not a shed (callers retry on overloaded, not timeout)
-                self._count_timeout()
-                raise InferenceTimeoutError(
-                    "inference request deadline expired while waiting "
-                    "for queue space") from None
-            if _mon.enabled():
-                _mon.get_registry().counter(
-                    _mon.RESILIENCE_INFERENCE_SHED,
-                    help="requests shed because the queue stayed full "
-                         "for the whole bounded enqueue wait").inc()
-            raise InferenceOverloadedError(
-                f"inference queue full (limit {self._queue.maxsize}) "
-                f"after {wait * 1e3:.6g} ms — request shed") from None
+        # the caller's deadline — not the enqueue budget — expiring
+        # while waiting for space is a timeout, not a shed (callers
+        # retry on overloaded, not timeout)
+        bounded_enqueue(self._queue, req, deadline, self.enqueue_timeout,
+                        count_timeout=self._count_timeout)
 
     def _cancel(self, req):
         """Deadline expiry: mark the request so no thread serves it (or,

@@ -73,7 +73,7 @@ from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.runtime.pipeline import xla_owned_copy
 
 __all__ = [
-    "BucketLadder", "ExecutableStore", "StagingRing",
+    "BucketLadder", "ExecutableStore", "FunctionStore", "StagingRing",
     "configure_persistent_cache", "forward_fn", "model_fingerprint",
     "persistent_cache_stats", "status",
 ]
@@ -284,7 +284,7 @@ class BucketLadder:
         return f"BucketLadder(batch={self.batch}, length={self.length})"
 
 
-# -- the store -------------------------------------------------------------
+# -- the stores ------------------------------------------------------------
 class _Entry:
     __slots__ = ("call", "source")
 
@@ -293,47 +293,41 @@ class _Entry:
         self.source = source        # "compile" | "disk"
 
 
-class ExecutableStore:
-    """Two-tier AOT executable cache for ONE model's serving forward.
+class _AotStoreBase:
+    """Shared two-tier AOT executable machinery: tier 0 in-process dict
+    (the hot path: one dict get, no locks), tier 1 versioned on-disk
+    serialized executables under the shared cache layout. Subclasses
+    supply WHAT gets lowered (a model forward, a named decode
+    function); this base owns identity, the memory→disk→compile
+    resolution flow, persistence, and stats."""
 
-    Hot path: `lookup(sig)` — a dict get. Miss path (the ONLY place a
-    trace or compile may happen; scripts/check_fastpath.py enforces
-    that the serving hot path never reaches past `lookup`):
-    `load_or_compile(sig)` under a lock — disk tier first, live
-    `jit().lower().compile()` last, serialized back to disk."""
+    kind = "aot"
 
-    def __init__(self, model, directory=None, donate_inputs=True):
-        self.model = model
-        self.donate_inputs = bool(donate_inputs)
+    def __init__(self, fingerprint, directory=None):
         self.directory = (os.environ.get(ENV_CACHE_DIR) or None
                           if directory is None else (directory or None))
-        self.fingerprint = model_fingerprint(model)
+        self.fingerprint = fingerprint
         self.flavour = device_flavour()
-        self.trace_calls = 0        # times a python fwd was traced
+        self.trace_calls = 0        # times a python fn was traced
         self.stats = {"memory_hits": 0, "disk_hits": 0, "compiles": 0,
                       "deserialize_failures": 0, "serialize_failures": 0}
         self._mem = {}
         self._lock = threading.Lock()
-
-        def counted(fwd):
-            def run(*args):
-                self.trace_calls += 1   # once per TRACE, never per call
-                return fwd(*args)
-            return run
-
-        # masked variant: (B, T) validity mask appended after the
-        # inputs (length-bucketed sequence serving pads the time axis)
-        self._fwds = {False: counted(forward_fn(model, with_mask=False)),
-                      True: counted(forward_fn(model, with_mask=True))}
         # third tier: live compiles (cache-layout misses) still warm
         # the cross-process persistent compilation cache
         configure_persistent_cache()
         _STORES.add(self)
 
+    def _counted(self, fwd):
+        def run(*args):
+            self.trace_calls += 1   # once per TRACE, never per call
+            return fwd(*args)
+        return run
+
     # -- hot path ---------------------------------------------------------
-    def lookup(self, sig, with_mask=False):
+    def lookup(self, key):
         """Steady state: one dict get, no locks, no jax."""
-        e = self._mem.get((sig, with_mask))
+        e = self._mem.get(key)
         if e is None:
             return None
         self.stats["memory_hits"] += 1
@@ -350,40 +344,15 @@ class ExecutableStore:
                 "backend": jax.default_backend(), "flavour": self.flavour,
                 "fingerprint": self.fingerprint}
 
-    def _abstract_args(self, sig, with_mask):
-        sds = jax.ShapeDtypeStruct
-        as_sds = lambda t: jax.tree_util.tree_map(  # noqa: E731
-            lambda l: sds(jnp.shape(l), jnp.result_type(l)), t)
-        xs = [sds(shape, jnp.dtype(dt)) for shape, dt in sig]
-        if with_mask:
-            # (B, T) validity mask over the first (sequence) input
-            xs.append(sds(tuple(sig[0][0][:2]), jnp.dtype("float32")))
-        return (as_sds(self.model._params), as_sds(self.model._state),
-                *xs)
-
-    def _lower(self, sig, with_mask):
-        """Trace + lower (no XLA compile). Inputs (incl. the mask) are
-        donated so dispatch reuses the staged XLA-owned buffers."""
-        args = self._abstract_args(sig, with_mask)
-        donate = (tuple(range(2, len(args))) if self.donate_inputs
-                  else ())
-        with warnings.catch_warnings():
-            # XLA:CPU ignores donation ("donated buffers were not
-            # usable") — harmless here, load-bearing on TPU
-            warnings.simplefilter("ignore", UserWarning)
-            return jax.jit(self._fwds[with_mask],
-                           donate_argnums=donate).lower(*args)
-
     def _count(self, name, help_):
         if _mon.enabled():
             _mon.get_registry().counter(name, help=help_).inc()
 
-    def load_or_compile(self, sig, with_mask=False):
-        """Resolve one bucketed signature: memory → disk (deserialize,
-        no XLA compile) → live compile (persisted back). Corrupt or
-        mismatched disk entries count `deserialize_failures` and fall
-        through to the live compile — never crash, never go stale."""
-        key = (sig, with_mask)
+    def _resolve(self, key, lower_fn):
+        """Memory → disk (deserialize, no XLA compile) → live compile
+        (persisted back), under the store lock. Corrupt or mismatched
+        disk entries count `deserialize_failures` and fall through to
+        the live compile — never crash, never go stale."""
         with self._lock:
             e = self._mem.get(key)
             if e is not None:
@@ -395,7 +364,7 @@ class ExecutableStore:
                 if e is not None:
                     self._mem[key] = e
                     return e
-            e = self._compile_live(sig, with_mask, path)
+            e = self._compile_live(key, lower_fn, path)
             self._mem[key] = e
             return e
 
@@ -424,9 +393,9 @@ class ExecutableStore:
                 pass
             return None
 
-    def _compile_live(self, sig, with_mask, path):
+    def _compile_live(self, key, lower_fn, path):
         t0 = time.perf_counter()
-        compiled = self._lower(sig, with_mask).compile()
+        compiled = lower_fn().compile()
         dt = time.perf_counter() - t0
         self.stats["compiles"] += 1
         if _mon.enabled():
@@ -439,7 +408,7 @@ class ExecutableStore:
                .observe(dt)
         e = _Entry(compiled, "compile")
         if path is not None:
-            self._persist((sig, with_mask), path, compiled)
+            self._persist(key, path, compiled)
         return e
 
     def _persist(self, key, path, compiled):
@@ -457,6 +426,74 @@ class ExecutableStore:
             self._count(_mon.EXEC_SERIALIZE_FAILURES,
                         "serving executables that could not be "
                         "serialized to disk (in-process cache only)")
+
+    def status(self):
+        return {"kind": self.kind,
+                "fingerprint": self.fingerprint,
+                "flavour": self.flavour,
+                "directory": self.directory,
+                "entries": [{"signature": repr(k), "source": e.source}
+                            for k, e in sorted(self._mem.items(),
+                                               key=lambda kv: repr(kv[0]))],
+                "trace_calls": self.trace_calls,
+                **self.stats}
+
+
+class ExecutableStore(_AotStoreBase):
+    """Two-tier AOT executable cache for ONE model's serving forward.
+
+    Hot path: `lookup(sig)` — a dict get. Miss path (the ONLY place a
+    trace or compile may happen; scripts/check_fastpath.py enforces
+    that the serving hot path never reaches past `lookup`):
+    `load_or_compile(sig)` under a lock — disk tier first, live
+    `jit().lower().compile()` last, serialized back to disk."""
+
+    kind = "model-forward"
+
+    def __init__(self, model, directory=None, donate_inputs=True):
+        self.model = model
+        self.donate_inputs = bool(donate_inputs)
+        super().__init__(model_fingerprint(model), directory=directory)
+        # masked variant: (B, T) validity mask appended after the
+        # inputs (length-bucketed sequence serving pads the time axis)
+        self._fwds = {
+            False: self._counted(forward_fn(model, with_mask=False)),
+            True: self._counted(forward_fn(model, with_mask=True))}
+
+    # -- hot path ---------------------------------------------------------
+    def lookup(self, sig, with_mask=False):
+        """Steady state: one dict get, no locks, no jax."""
+        return super().lookup((sig, with_mask))
+
+    # -- miss path (boundary: the lint stops descending here) -------------
+    def _abstract_args(self, sig, with_mask):
+        sds = jax.ShapeDtypeStruct
+        as_sds = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda l: sds(jnp.shape(l), jnp.result_type(l)), t)
+        xs = [sds(shape, jnp.dtype(dt)) for shape, dt in sig]
+        if with_mask:
+            # (B, T) validity mask over the first (sequence) input
+            xs.append(sds(tuple(sig[0][0][:2]), jnp.dtype("float32")))
+        return (as_sds(self.model._params), as_sds(self.model._state),
+                *xs)
+
+    def _lower(self, sig, with_mask):
+        """Trace + lower (no XLA compile). Inputs (incl. the mask) are
+        donated so dispatch reuses the staged XLA-owned buffers."""
+        args = self._abstract_args(sig, with_mask)
+        donate = (tuple(range(2, len(args))) if self.donate_inputs
+                  else ())
+        with warnings.catch_warnings():
+            # XLA:CPU ignores donation ("donated buffers were not
+            # usable") — harmless here, load-bearing on TPU
+            warnings.simplefilter("ignore", UserWarning)
+            return jax.jit(self._fwds[with_mask],
+                           donate_argnums=donate).lower(*args)
+
+    def load_or_compile(self, sig, with_mask=False):
+        """Resolve one bucketed signature through the base tiers."""
+        return self._resolve((sig, with_mask),
+                             lambda: self._lower(sig, with_mask))
 
     # -- warmup / status --------------------------------------------------
     def warmup(self, sigs):
@@ -478,16 +515,59 @@ class ExecutableStore:
                 "seconds": time.perf_counter() - t0}
 
     def status(self):
-        return {"model": type(self.model).__name__,
-                "fingerprint": self.fingerprint,
-                "flavour": self.flavour,
-                "directory": self.directory,
-                "entries": [{"signature": repr(k[0]), "masked": k[1],
-                             "source": e.source}
-                            for k, e in sorted(self._mem.items(),
-                                               key=lambda kv: repr(kv[0]))],
-                "trace_calls": self.trace_calls,
-                **self.stats}
+        base = super().status()
+        base["model"] = type(self.model).__name__
+        base["entries"] = [{"signature": repr(k[0]), "masked": k[1],
+                            "source": e.source}
+                           for k, e in sorted(self._mem.items(),
+                                              key=lambda kv: repr(kv[0]))]
+        return base
+
+
+class FunctionStore(_AotStoreBase):
+    """Two-tier AOT cache of NAMED functions (the generation decode
+    path: step / admit / retire / grow executables, one per cache-rung
+    or prompt-bucket signature).
+
+    `register(name, fn, donate_argnums=...)` declares the traceable;
+    `load_or_compile((name, ...), example_args)` lowers it against the
+    example's shapes/dtypes with the declared donation and runs it
+    through the same memory → disk → live-compile tiers as
+    ExecutableStore (so a restarted generation replica warms from disk
+    in deserialize time). The hot path is `lookup(key)` — one dict get;
+    the serving/decode-loop lints hold the trace boundary here too."""
+
+    kind = "function"
+
+    def __init__(self, fingerprint, directory=None):
+        super().__init__(fingerprint, directory=directory)
+        self._fns = {}
+
+    def register(self, name, fn, donate_argnums=()):
+        self._fns[name] = (self._counted(fn), tuple(donate_argnums))
+        return self
+
+    # -- miss path (boundary: the lint stops descending here) -------------
+    def _lower_named(self, name, example_args):
+        fn, donate = self._fns[name]
+        sds = jax.ShapeDtypeStruct
+        abstract = jax.tree_util.tree_map(
+            lambda l: sds(jnp.shape(l), jnp.result_type(l)), example_args)
+        with warnings.catch_warnings():
+            # XLA:CPU ignores donation — harmless there, load-bearing
+            # on TPU (the decode state is donated through every step)
+            warnings.simplefilter("ignore", UserWarning)
+            return jax.jit(fn, donate_argnums=donate).lower(*abstract)
+
+    def load_or_compile(self, key, example_args):
+        """key: (name, *static identity); example_args: concrete or
+        ShapeDtypeStruct positional args the executable will be called
+        with. Resolves through memory → disk → live compile."""
+        name = key[0]
+        if name not in self._fns:
+            raise KeyError(f"no function registered under {name!r}")
+        return self._resolve(
+            key, lambda: self._lower_named(name, tuple(example_args)))
 
 
 def status():

@@ -18,6 +18,9 @@ Two forms, both dependency-free:
   (runtime/executables.py `status()`): every live store's entries with
   compile-vs-disk provenance, hit/miss tallies, and the persistent
   compilation cache tier split.
+- `GET /generation` — autoregressive generation status
+  (generation/server.py `status()`): per-server slot occupancy, cache
+  rung, admission/retirement/token tallies, executable provenance.
 - `render_static_html(storage, path)` — a self-contained HTML snapshot
   (inline SVG charts) for environments without an open port.
 """
@@ -61,6 +64,11 @@ table — arm with
 <code>monitoring.profile_next_steps(k)</code></div>
 <pre id="profile" style="max-height:360px;overflow:auto;font-size:12px">
 no profile captured yet</pre></div>
+<div class="chart"><h2>Generation (KV-cache decode)</h2>
+<div class="meta">Continuous-batching autoregressive serving —
+<code>GET /generation</code>; live while a GenerationServer runs</div>
+<pre id="generation" style="max-height:240px;overflow:auto;font-size:12px">
+no generation servers live</pre></div>
 <div class="chart"><h2>Step-time attribution (flight recorder)</h2>
 <div class="meta">Per-step host phase breakdown (data_next / dispatch /
 listeners + host-blocked and compile stalls) — <code>GET /steps</code>;
@@ -161,6 +169,17 @@ async function tick(){
     } else if (pd.active){
       el.textContent = `profiling: ${pd.active.state} ` +
         `(${pd.active.captured_steps}/${pd.active.steps} steps)`;
+    }
+  } catch (e) {}
+  try {
+    const gr = await fetch('/generation'); const gd = await gr.json();
+    if (gd.servers && gd.servers.length){
+      document.getElementById('generation').textContent =
+        gd.servers.map(s =>
+          `${s.decoder}: slots ${s.active_slots}/${s.slots} · rung ` +
+          `${s.rung} · queued ${s.queued} · tokens ${s.tokens} · ` +
+          `admissions ${s.admissions} · retirements ${s.retirements} ` +
+          `· errors ${s.errors}`).join("\n");
     }
   } catch (e) {}
   try {
@@ -317,6 +336,15 @@ class UIServer:
                     from deeplearning4j_tpu.runtime import \
                         executables as _exe
                     body = json.dumps(_exe.status()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/generation"):
+                    # autoregressive generation status: every live
+                    # GenerationServer's slot occupancy, cache rung,
+                    # admission/retirement/token tallies and its
+                    # executable-store provenance (generation/server.py)
+                    from deeplearning4j_tpu.generation import \
+                        server as _gen
+                    body = json.dumps(_gen.status()).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/health"):
                     # training-guardian + stall-watchdog state

@@ -54,6 +54,11 @@ HOT_MODULES = [
     "deeplearning4j_tpu/resilience/watchdog.py",
     "deeplearning4j_tpu/resilience/faults.py",
     "deeplearning4j_tpu/resilience/trainer.py",
+    # generation decode loop: per-token metric calls must stay behind
+    # the enabled-guard (one dict-get + dispatch per token otherwise)
+    "deeplearning4j_tpu/generation/server.py",
+    "deeplearning4j_tpu/generation/decode.py",
+    "deeplearning4j_tpu/generation/sampling.py",
 ]
 
 # -- serving steady-state lint --------------------------------------------
@@ -74,6 +79,31 @@ SERVING_ROOTS = {"_dispatch", "_run", "lookup", "stage", "release"}
 SERVING_MISS_BOUNDARY = {"load_or_compile", "warmup"}
 #: calls that mean "a trace or an XLA compile happens here"
 TRACE_CALL_NAMES = {"jit", "lower", "compile", "eval_shape", "trace"}
+
+# -- generation decode-loop lint -------------------------------------------
+#: modules forming the generation hot path: the decode loop's
+#: step/admit/retire must resolve every dispatch from pre-compiled
+#: executables (trace rule) and the ONLY per-token host sync is the
+#: sampled-token fetch (sync rule)
+GENERATION_MODULES = [
+    "deeplearning4j_tpu/generation/server.py",
+    "deeplearning4j_tpu/generation/decode.py",
+    "deeplearning4j_tpu/generation/sampling.py",
+    "deeplearning4j_tpu/runtime/executables.py",
+]
+#: decode-loop entry points (GenerationServer hot methods)
+GENERATION_ROOTS = {"_step_once", "_admit_pending", "_admit_one",
+                    "_retire_slot", "_deliver"}
+#: the declared warmup boundary — steady state never crosses it
+GENERATION_MISS_BOUNDARY = {"load_or_compile", "warmup",
+                            "_warmup_locked"}
+#: per-token sync rule: only `_step_once`'s declared fetch point may
+#: materialize device values
+GENERATION_SYNC_ROOTS = {"_step_once"}
+GENERATION_SYNC_BOUNDARY = {"_fetch_tokens"}
+#: calls that mean "the host blocks on (or copies back) device data"
+SYNC_CALL_NAMES = {"asarray", "device_get", "block_until_ready",
+                   "item", "tolist", "copy_to_host_async"}
 
 #: attribute calls that hit the registry
 REGISTRY_ATTRS = {"counter", "gauge", "histogram"}
@@ -165,27 +195,14 @@ def _call_name(node):
     return None
 
 
-def _is_trace_call(node):
-    name = _call_name(node)
-    if name not in TRACE_CALL_NAMES:
-        return None
-    f = node.func
-    # `jax.jit(...)` / `jit(...)` / `<lowered>.compile()` /
-    # `jit(...).lower(...)` all count; plain `"x".lower()` string
-    # methods share the name — accept the (theoretical) false positive
-    # over missing a real trace on the serving path
-    return f".{name}(...)" if isinstance(f, ast.Attribute) \
-        else f"{name}(...)"
-
-
-def check_serving_steady_state(sources):
-    """sources: {path: source}. Walks the union call graph of every
-    function/method defined in the serving modules, starting from
-    SERVING_ROOTS and NOT descending into SERVING_MISS_BOUNDARY, and
-    flags any trace/compile call inside the reachable set. Steady-state
-    serving (post-`warmup()`) must resolve every dispatch from the
-    in-memory executable tier — a reachable `jax.jit`/`lower`/`compile`
-    means a novel shape could trace ON the request path."""
+def _check_reachable(sources, roots, boundary, flag_names, describe):
+    """Walk the union call graph (intra-repo, by function name) of
+    every function/method defined in `sources`, starting from `roots`
+    and NOT descending into `boundary`, and flag any call whose callee
+    name is in `flag_names`. `describe(what, via)` renders the
+    violation message. Matching is by bare callee name — a theoretical
+    false positive (e.g. `"x".lower()`) is accepted over ever missing
+    a real trace/sync on a hot path."""
     defs = {}        # name -> (path, FunctionDef)
     for path, source in sources.items():
         tree = ast.parse(source, filename=path)
@@ -194,28 +211,68 @@ def check_serving_steady_state(sources):
                 defs.setdefault(node.name, (path, node))
     violations = []
     seen = set()
-    frontier = [r for r in SERVING_ROOTS if r in defs]
+    frontier = [r for r in roots if r in defs]
     while frontier:
         name = frontier.pop()
-        if name in seen or name in SERVING_MISS_BOUNDARY:
+        if name in seen or name in boundary:
             continue
         seen.add(name)
         path, fn = defs[name]
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call):
                 continue
-            what = _is_trace_call(node)
-            if what is not None:
-                violations.append(
-                    (path, node.lineno,
-                     f"{what} reachable from the serving dispatch "
-                     f"path (via {name}) — steady state must stay "
-                     "inside the AOT executable cache"))
             callee = _call_name(node)
+            if callee in flag_names:
+                f = node.func
+                what = (f".{callee}(...)" if isinstance(f, ast.Attribute)
+                        else f"{callee}(...)")
+                violations.append(
+                    (path, node.lineno, describe(what, name)))
             if callee in defs and callee not in seen \
-                    and callee not in SERVING_MISS_BOUNDARY:
+                    and callee not in boundary:
                 frontier.append(callee)
     return violations
+
+
+def check_serving_steady_state(sources):
+    """sources: {path: source}. Steady-state serving (post-`warmup()`)
+    must resolve every dispatch from the in-memory executable tier — a
+    `jax.jit`/`lower`/`compile` reachable from the dispatch path means
+    a novel shape could trace ON the request path."""
+    return _check_reachable(
+        sources, SERVING_ROOTS, SERVING_MISS_BOUNDARY, TRACE_CALL_NAMES,
+        lambda what, via: (
+            f"{what} reachable from the serving dispatch path (via "
+            f"{via}) — steady state must stay inside the AOT "
+            "executable cache"))
+
+
+def check_generation_steady_state(sources):
+    """The generation decode loop (step / admit / retire) must reach no
+    jit/lower/trace call past the declared warmup boundary: admitting a
+    new sequence into an in-flight batch, stepping it, and retiring a
+    finished slot are all pre-compiled fixed-shape dispatches."""
+    return _check_reachable(
+        sources, GENERATION_ROOTS, GENERATION_MISS_BOUNDARY,
+        TRACE_CALL_NAMES,
+        lambda what, via: (
+            f"{what} reachable from the generation decode loop (via "
+            f"{via}) — step/admit/retire must stay inside the warmed "
+            "executable set"))
+
+
+def check_generation_host_sync(sources):
+    """Zero per-token host syncs beyond the sampled-token fetch: the
+    decode step's only device materialization is the declared
+    `_fetch_tokens` boundary — everything else (caches, carries,
+    positions, rng) stays device-resident and donated."""
+    return _check_reachable(
+        sources, GENERATION_SYNC_ROOTS, GENERATION_SYNC_BOUNDARY,
+        SYNC_CALL_NAMES,
+        lambda what, via: (
+            f"{what} reachable from the decode step (via {via}) — the "
+            "sampled-token fetch (_fetch_tokens) is the only allowed "
+            "per-token host sync"))
 
 
 def main(modules=None):
@@ -233,6 +290,14 @@ def main(modules=None):
                 with open(path) as f:
                     sources[path] = f.read()
         violations.extend(check_serving_steady_state(sources))
+        gen_sources = {}
+        for rel in GENERATION_MODULES:
+            path = os.path.join(REPO_ROOT, rel)
+            if os.path.exists(path):
+                with open(path) as f:
+                    gen_sources[path] = f.read()
+        violations.extend(check_generation_steady_state(gen_sources))
+        violations.extend(check_generation_host_sync(gen_sources))
     for path, lineno, msg in violations:
         print(f"{os.path.relpath(path, REPO_ROOT)}:{lineno}: {msg}")
     if violations:
