@@ -457,6 +457,74 @@ def child_main():
     print(json.dumps(result))
 
 
+def _preflight_child():
+    """BENCH_PREFLIGHT=1 child body: initialize the device and print
+    the '# device:' marker — nothing else. A tunnel-wedge hang dies
+    here in seconds of timeout instead of a full 560 s attempt."""
+    _want_tpu()
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"# device: {dev} platform={dev.platform}", flush=True)
+
+
+def _preflight(timeout_s: float):
+    """Probe device init in a kill-able child BEFORE burning full
+    measurement attempts. Returns (ok, diagnostic, is_outage):
+    is_outage is True ONLY for the known axon-tunnel signature (init
+    HANG with no device line — what BENCH_r05 spent 2×560 s timing out
+    on); a child that CRASHES is a code problem and must not be
+    reported as infrastructure."""
+    env = dict(os.environ)
+    env["BENCH_PREFLIGHT"] = "1"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, start_new_session=True, env=env)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        out, _ = proc.communicate()
+        if "# device:" in (out or ""):
+            # device came up but the child was slow to exit — not the
+            # outage signature; let the real attempts proceed
+            return True, "preflight slow but device initialized", False
+        return (False, f"device init hung for {timeout_s:.0f}s with no "
+                       f"device line (tunnel outage signature)", True)
+    if proc.returncode == 0 and "# device:" in (out or ""):
+        return True, "", False
+    return (False, f"preflight rc={proc.returncode}; "
+                   f"output tail: {(out or '')[-300:]}", False)
+
+
+def _outage_artifact(errors):
+    """The zero-value artifact with the outage note pointing at the
+    freshest code-side local measurement."""
+    out = {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "img/s",
+        "vs_baseline": 0.0,
+        "error": " | ".join(errors)[-900:],
+    }
+    import glob
+    locals_ = glob.glob(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_r*_local.json"))
+    note = ("axon TPU tunnel outage signature (init hang, no device "
+            "line) — see BENCH.md outage log")
+    if locals_:
+        newest = os.path.basename(max(locals_, key=os.path.getmtime))
+        note += (f"; freshest code-side measurements: {newest} "
+                 "(green full-extras run on a healthy tunnel)")
+    out["note"] = note
+    return out
+
+
 def _run_attempt(timeout_s: float):
     """Run one child attempt.
 
@@ -536,9 +604,34 @@ def _last_partial(out: str):
 
 def main():
     _want_tpu()
+    if os.environ.get("BENCH_PREFLIGHT") == "1":
+        _preflight_child()
+        return
     if os.environ.get("BENCH_CHILD") == "1":
         child_main()
         return
+
+    # fast-fail device preflight: a ~90 s kill-able init probe before
+    # any full attempt — the known tunnel-outage signature (init hang,
+    # no device line) records its verdict immediately instead of
+    # burning 2×560 s timing out (BENCH_PREFLIGHT_TIMEOUT=0 disables)
+    pf_timeout = float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "90"))
+    if pf_timeout > 0:
+        ok, diag, is_outage = _preflight(pf_timeout)
+        if not ok:
+            print(f"# preflight failed: {diag}", file=sys.stderr,
+                  flush=True)
+            if is_outage:
+                out = _outage_artifact([f"preflight: {diag}"])
+            else:
+                # child CRASHED (code problem, not infrastructure):
+                # plain error artifact, no outage note
+                out = {"metric": METRIC, "value": 0.0, "unit": "img/s",
+                       "vs_baseline": 0.0,
+                       "error": f"preflight: {diag}"[-900:]}
+            print(json.dumps(out))
+            return
+        print("# preflight: device ok", file=sys.stderr, flush=True)
 
     attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
     # must exceed the remote compile service's own ~500 s timeout: a
@@ -590,31 +683,21 @@ def main():
         print(json.dumps(partial))
         return
 
-    out = {
-        "metric": METRIC,
-        "value": 0.0,
-        "unit": "img/s",
-        "vs_baseline": 0.0,
-        "error": " | ".join(errors)[-900:],
-    }
     ran = [e for e in errors if e.startswith("attempt")]
     if ran and all("timeout" in e and "device_line=yes" not in e
                    for e in ran):
         # every attempt hung with no "# device:" line — the known axon
         # tunnel-wedge signature, not a framework failure (BENCH.md
-        # outage log; last driver-verified run BENCH_r02.json). Point at
-        # the FRESHEST local artifact that exists on this checkout.
-        import glob
-        locals_ = glob.glob(os.path.join(
-            os.path.dirname(os.path.abspath(__file__)),
-            "BENCH_r*_local.json"))
-        note = ("axon TPU tunnel outage signature (init hang, no device "
-                "line) — see BENCH.md outage log")
-        if locals_:
-            newest = os.path.basename(max(locals_, key=os.path.getmtime))
-            note += (f"; freshest code-side measurements: {newest} "
-                     "(green full-extras run on a healthy tunnel)")
-        out["note"] = note
+        # outage log; last driver-verified run BENCH_r02.json)
+        out = _outage_artifact(errors)
+    else:
+        out = {
+            "metric": METRIC,
+            "value": 0.0,
+            "unit": "img/s",
+            "vs_baseline": 0.0,
+            "error": " | ".join(errors)[-900:],
+        }
     print(json.dumps(out))
 
 

@@ -62,7 +62,7 @@ class BertDecoder:
     uses_cache_rungs = True
     n_model_args = 1
 
-    def __init__(self, cfg, params, attn_impl="auto"):
+    def __init__(self, cfg, params, attn_impl="auto", kv_dtype="fp"):
         if cfg.moe_layers:
             raise ValueError(
                 "BertDecoder does not support MoE layers (dense-dispatch "
@@ -71,15 +71,29 @@ class BertDecoder:
             raise ValueError(
                 f"attn_impl must be 'auto', 'dense' or 'pallas', "
                 f"got {attn_impl!r}")
+        if kv_dtype not in ("fp", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'fp' or 'int8', got {kv_dtype!r}")
+        if kv_dtype == "int8" and attn_impl == "pallas":
+            raise ValueError(
+                "attn_impl='pallas' has no int8-cache variant — the "
+                "quantized decode contraction runs the scale-folding "
+                "einsum path; use attn_impl='auto' or 'dense' with "
+                "kv_dtype='int8'")
         self.cfg = cfg
         self.params = params
         self.attn_impl = attn_impl
+        # "int8": K/V rows stored int8 with per-(head, position) f32
+        # scales (quantize/kvcache.py) and dequantized INSIDE
+        # flash_attention_decode — the steady-state cache read (the
+        # decode step's dominant traffic) drops to ~¼ width
+        self.kv_dtype = kv_dtype
         self.vocab_size = int(cfg.vocab_size)
         self.max_cache_len = int(cfg.max_position_embeddings)
 
     def fingerprint(self):
         parts = ("bert-decode", repr(self.cfg), self.attn_impl,
-                 _shape_tree_repr(self.params))
+                 self.kv_dtype, _shape_tree_repr(self.params))
         return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
 
     def model_args(self):
@@ -89,14 +103,23 @@ class BertDecoder:
         cfg = self.cfg
         shape = (cfg.num_layers, slots, cfg.num_heads, cache_len,
                  cfg.head_dim)
+        if self.kv_dtype == "int8":
+            return {"k": jnp.zeros(shape, jnp.int8),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "ks": jnp.ones(shape[:4], jnp.float32),
+                    "vs": jnp.ones(shape[:4], jnp.float32)}
         dt = cfg.compute_dtype
         return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
     def grow(self, cache, new_len):
         pad = [(0, 0)] * 5
         pad[3] = (0, int(new_len) - cache["k"].shape[3])
-        return {"k": jnp.pad(cache["k"], pad),
-                "v": jnp.pad(cache["v"], pad)}
+        out = {"k": jnp.pad(cache["k"], pad),
+               "v": jnp.pad(cache["v"], pad)}
+        if "ks" in cache:   # scale rows pad at 1 (zero rows round-trip)
+            out["ks"] = jnp.pad(cache["ks"], pad[:4], constant_values=1.0)
+            out["vs"] = jnp.pad(cache["vs"], pad[:4], constant_values=1.0)
+        return out
 
     def _embed(self, params, tokens, pos):
         """Token + position embedding at per-slot positions (mirrors
@@ -108,12 +131,17 @@ class BertDecoder:
                            emb["ln_scale"], emb["ln_bias"],
                            self.cfg.layer_norm_eps)
 
-    def _decode_attn(self, q, kc, vc, cmask):
+    def _decode_attn(self, q, kc, vc, cmask, ks=None, vs=None):
         impl = self.attn_impl
         if impl == "auto":
+            # int8 cache: the quantized decode GEMV reads the cache at
+            # int8 width through the scale-folding einsum on every
+            # backend (no Pallas int8-cache kernel yet; explicit
+            # 'pallas' + int8 is rejected at construction)
             impl = ("pallas" if jax.default_backend() == "tpu"
-                    else "dense")
-        return flash_attention_decode(q, kc, vc, cmask, impl=impl)
+                    and ks is None else "dense")
+        return flash_attention_decode(q, kc, vc, cmask, impl=impl,
+                                      k_scale=ks, v_scale=vs)
 
     def _prefill_attn(self, q, k, v):
         if self.attn_impl == "pallas" or (
@@ -132,6 +160,9 @@ class BertDecoder:
         cfg = self.cfg
         x = self._embed(params, tokens, pos)            # (S, H)
         kc, vc = cache["k"], cache["v"]
+        int8_kv = self.kv_dtype == "int8"
+        ks = cache.get("ks")
+        vs = cache.get("vs")
         s = tokens.shape[0]
         ar = jnp.arange(s)
         c = kc.shape[3]
@@ -144,9 +175,21 @@ class BertDecoder:
                 + layer["qkv_b"].astype(dt)             # (S, 3H)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(s, nh, hd)
-            kc = kc.at[li, ar, :, pos].set(k.reshape(s, nh, hd))
-            vc = vc.at[li, ar, :, pos].set(v.reshape(s, nh, hd))
-            ctx = self._decode_attn(q, kc[li], vc[li], cmask)
+            k = k.reshape(s, nh, hd)
+            v = v.reshape(s, nh, hd)
+            if int8_kv:
+                from deeplearning4j_tpu.quantize.kvcache import \
+                    quantize_rows
+                k, k_sc = quantize_rows(k)
+                v, v_sc = quantize_rows(v)
+                ks = ks.at[li, ar, :, pos].set(k_sc)
+                vs = vs.at[li, ar, :, pos].set(v_sc)
+            kc = kc.at[li, ar, :, pos].set(k.astype(kc.dtype))
+            vc = vc.at[li, ar, :, pos].set(v.astype(vc.dtype))
+            ctx = self._decode_attn(
+                q, kc[li], vc[li], cmask,
+                ks[li] if int8_kv else None,
+                vs[li] if int8_kv else None).astype(dt)
             a = ctx.reshape(s, cfg.hidden_size) \
                 @ layer["proj_W"].astype(dt) + layer["proj_b"].astype(dt)
             x = _layer_norm(x + a, layer["ln1_scale"], layer["ln1_bias"],
@@ -155,7 +198,11 @@ class BertDecoder:
             x = _layer_norm(x + f, layer["ln2_scale"], layer["ln2_bias"],
                             cfg.layer_norm_eps)
         logits = bert_mlm_logits(cfg, params, x[:, None, :])[:, 0]
-        return logits, {"k": kc, "v": vc}
+        out = {"k": kc, "v": vc}
+        if int8_kv:
+            out["ks"] = ks
+            out["vs"] = vs
+        return logits, out
 
     def prefill(self, margs, cache, slot, prompt, plen):
         """Causal full forward over one length-bucketed prompt (1, P);
@@ -173,6 +220,9 @@ class BertDecoder:
         x = _layer_norm(x.astype(cfg.compute_dtype), emb["ln_scale"],
                         emb["ln_bias"], cfg.layer_norm_eps)
         kc, vc = cache["k"], cache["v"]
+        int8_kv = self.kv_dtype == "int8"
+        ks = cache.get("ks")
+        vs = cache.get("vs")
         nh, hd = cfg.num_heads, cfg.head_dim
         dt = x.dtype
         for li, layer in enumerate(params["layers"]):
@@ -184,10 +234,24 @@ class BertDecoder:
                 return t.reshape(1, p_len, nh, hd).transpose(0, 2, 1, 3)
 
             q, k, v = heads(q), heads(k), heads(v)      # (1, nh, P, hd)
-            kc = lax.dynamic_update_slice(
-                kc, k[None].astype(kc.dtype), (li, slot, 0, 0, 0))
-            vc = lax.dynamic_update_slice(
-                vc, v[None].astype(vc.dtype), (li, slot, 0, 0, 0))
+            if int8_kv:
+                from deeplearning4j_tpu.quantize.kvcache import \
+                    quantize_rows
+                kq, k_sc = quantize_rows(k)             # (1, nh, P)
+                vq, v_sc = quantize_rows(v)
+                kc = lax.dynamic_update_slice(
+                    kc, kq[None], (li, slot, 0, 0, 0))
+                vc = lax.dynamic_update_slice(
+                    vc, vq[None], (li, slot, 0, 0, 0))
+                ks = lax.dynamic_update_slice(
+                    ks, k_sc[None], (li, slot, 0, 0))
+                vs = lax.dynamic_update_slice(
+                    vs, v_sc[None], (li, slot, 0, 0))
+            else:
+                kc = lax.dynamic_update_slice(
+                    kc, k[None].astype(kc.dtype), (li, slot, 0, 0, 0))
+                vc = lax.dynamic_update_slice(
+                    vc, v[None].astype(vc.dtype), (li, slot, 0, 0, 0))
             ctx = self._prefill_attn(q, k, v)
             a = ctx.transpose(0, 2, 1, 3).reshape(1, p_len,
                                                   cfg.hidden_size) \
@@ -199,7 +263,11 @@ class BertDecoder:
                             cfg.layer_norm_eps)
         h_last = jnp.take(x[0], plen - 1, axis=0)       # (H,)
         logits = bert_mlm_logits(cfg, params, h_last[None, None, :])[0, 0]
-        return {"k": kc, "v": vc}, logits
+        out = {"k": kc, "v": vc}
+        if int8_kv:
+            out["ks"] = ks
+            out["vs"] = vs
+        return out, logits
 
 
 class RecurrentDecoder:

@@ -499,8 +499,32 @@ def _decode_reference(q, k_cache, v_cache, cache_mask):
     return jnp.where(any_valid[:, None, None, None], out, 0)
 
 
+def _decode_reference_quantized(q, k_cache, v_cache, cache_mask,
+                                k_scale, v_scale):
+    """Decode attention over an int8-quantized cache with the dequant
+    FUSED into the contractions: the per-row key scale multiplies the
+    score logits (s·(k_row·ks) = (s·k_row)·ks), the per-row value scale
+    folds onto the softmax weights before the value pass — no
+    dequantized fp cache copy ever materializes; the cache reads stay
+    int8 (quantize/kvcache.py's traffic argument)."""
+    d = q.shape[-1]
+    scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("bhqd,bhcd->bhqc", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    s = s * k_scale[:, :, None, :].astype(jnp.float32)
+    valid = cache_mask.astype(bool)
+    s = jnp.where(valid[:, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    pv = p * v_scale[:, :, None, :].astype(jnp.float32)
+    out = jnp.einsum("bhqc,bhcd->bhqd", pv,
+                     v_cache.astype(jnp.float32)).astype(q.dtype)
+    any_valid = valid.any(axis=-1)
+    return jnp.where(any_valid[:, None, None, None], out, 0)
+
+
 def flash_attention_decode(q1, k_cache, v_cache, cache_mask, impl="auto",
-                           block_k=128, interpret=None):
+                           block_k=128, interpret=None, k_scale=None,
+                           v_scale=None):
     """Incremental-decode attention: a SINGLE query block per sequence
     attends over that sequence's cached K/V under a cache-validity mask.
 
@@ -516,6 +540,15 @@ def flash_attention_decode(q1, k_cache, v_cache, cache_mask, impl="auto",
     - cache_mask: (B, C) truthy — valid cache rows (ragged lengths)
     - impl: 'auto' (Pallas kernel on TPU, einsum elsewhere), 'pallas'
       (force kernel; interpret-mode off-TPU), or 'dense'
+    - k_scale / v_scale: (B, H, C) float32 per-head row scales of an
+      int8-quantized cache (quantize/kvcache.py). When given, the
+      dequant happens INSIDE the attention contractions — the single-
+      query decode pass is a bandwidth-bound GEMV, so reading the
+      cache at int8 width is the point; a materializing dequant would
+      give the traffic straight back. (The quantized path is einsum-
+      based on every backend: the scales fold onto logits/softmax
+      weights, which the Pallas fp kernel's streaming-softmax layout
+      has no slot for yet.)
     Forward-only (decode never backprops). Rows whose mask has NO valid
     cache entry return zeros. Returns the same rank as q1.
     """
@@ -532,6 +565,28 @@ def flash_attention_decode(q1, k_cache, v_cache, cache_mask, impl="auto",
         raise ValueError(
             f"cache_mask must be (B, C) = "
             f"{(q.shape[0], k_cache.shape[2])}, got {cache_mask.shape}")
+    if impl not in ("auto", "pallas", "dense"):
+        raise ValueError(
+            f"unknown decode impl {impl!r}; expected 'auto', 'pallas' "
+            "or 'dense'")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if k_scale is not None:
+        if impl == "pallas":
+            raise ValueError(
+                "impl='pallas' has no int8-cache variant (the "
+                "streaming-softmax kernel has no slot for per-row "
+                "scales yet) — use 'auto' or 'dense' with a "
+                "quantized cache")
+        expect = (q.shape[0], q.shape[1], k_cache.shape[2])
+        if tuple(k_scale.shape) != expect \
+                or tuple(v_scale.shape) != expect:
+            raise ValueError(
+                f"k_scale/v_scale must be (B, H, C) = {expect}, got "
+                f"{k_scale.shape} / {v_scale.shape}")
+        out = _decode_reference_quantized(q, k_cache, v_cache,
+                                          cache_mask, k_scale, v_scale)
+        return out[:, :, 0, :] if squeeze else out
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "dense"
     if impl == "pallas":

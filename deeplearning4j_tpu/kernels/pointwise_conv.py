@@ -101,6 +101,106 @@ def matmul_stats(x, w, block_m=256, interpret=None):
 
 
 # ---------------------------------------------------------------------------
+# inference epilogue fusion: affine (+ residual) (+ act) INSIDE the GEMM
+# ---------------------------------------------------------------------------
+# BENCH.md round 3's post-mortem of the standalone fusion attempt: a
+# Pallas custom-call is a fusion BARRIER, so removing one pass by hand
+# while breaking XLA's own elementwise merges was a net loss. The shape
+# that does win is the epilogue — the affine/residual/activation tail
+# applied to each GEMM tile while it is still in VMEM, costing zero
+# extra reads and removing the separate BN-apply / residual-add passes'
+# writes. These kernels are that shape for the INFERENCE path (training
+# BN needs global batch stats, which only exist after the full grid —
+# its stats epilogue lives in matmul_stats above).
+
+def _make_epilogue_kernel(acc_dtype):
+    """One body for both precisions: `acc_dtype` is the contraction's
+    accumulator (f32 for the fp GEMM, int32 for int8×int8 on the MXU);
+    the scale/bias/residual/activation tail is IDENTICAL so the fp and
+    int8 inference paths can never drift apart."""
+    def builder(act, has_res):
+        def kernel(x_ref, w_ref, s_ref, b_ref, *rest):
+            res_ref, y_ref = (rest if has_res else (None, rest[0]))
+            acc = jnp.dot(x_ref[...], w_ref[...],
+                          preferred_element_type=acc_dtype)
+            y = acc.astype(jnp.float32) \
+                * s_ref[...].astype(jnp.float32) \
+                + b_ref[...].astype(jnp.float32)
+            if has_res:
+                y = y + res_ref[...].astype(jnp.float32)
+            if act == "relu":
+                y = jnp.maximum(y, 0.0)
+            y_ref[...] = y.astype(y_ref.dtype)
+        return kernel
+    return builder
+
+
+_epilogue_kernel = _make_epilogue_kernel(jnp.float32)
+_int8_epilogue_kernel = _make_epilogue_kernel(jnp.int32)
+
+
+def _matmul_epilogue_call(kernel_builder, x, w, scale, shift, residual,
+                          act, out_dtype, block_m, interpret):
+    if interpret is None:
+        interpret = _default_interpret()
+    if act not in ("identity", "relu"):
+        raise ValueError(f"epilogue act must be identity|relu: {act!r}")
+    m, k = x.shape
+    n = w.shape[1]
+    bm = min(block_m, m)
+    pad = (-m) % bm
+    has_res = residual is not None
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        if has_res:
+            residual = jnp.pad(residual, ((0, pad), (0, 0)))
+    grid = (x.shape[0] // bm,)
+    in_specs = [
+        pl.BlockSpec((bm, k), lambda i: (i, 0)),
+        pl.BlockSpec((k, n), lambda i: (0, 0)),
+        pl.BlockSpec((1, n), lambda i: (0, 0)),
+        pl.BlockSpec((1, n), lambda i: (0, 0)),
+    ]
+    args = [x, w, scale.reshape(1, n), shift.reshape(1, n)]
+    if has_res:
+        in_specs.append(pl.BlockSpec((bm, n), lambda i: (i, 0)))
+        args.append(residual)
+    y = pl.pallas_call(
+        kernel_builder(act, has_res),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bm, n), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((x.shape[0], n), out_dtype),
+        interpret=interpret,
+    )(*args)
+    return y[:m]
+
+
+def matmul_epilogue(x, w, scale, shift, residual=None, act="identity",
+                    out_dtype=None, block_m=256, interpret=None):
+    """y = act((x @ w)·scale + shift [+ residual]) in ONE kernel: the
+    affine is the folded inference-BN (scale = γ·rsqrt(var+eps),
+    shift = β − γ·μ·rsqrt(var+eps)), applied per tile in VMEM — the
+    separate BN-apply and residual-add passes disappear. x: (M, K),
+    w: (K, N), scale/shift: (N,), residual: (M, N) or None."""
+    return _matmul_epilogue_call(
+        _epilogue_kernel, x, w, scale, shift, residual, act,
+        out_dtype or x.dtype, block_m, interpret)
+
+
+def int8_matmul_epilogue(xq, wq, scale, shift, residual=None,
+                         act="identity", out_dtype=jnp.float32,
+                         block_m=256, interpret=None):
+    """The int8 variant: xq (M, K) int8 × wq (K, N) int8 → int32 on the
+    MXU, with the dequant (scale = x_scale·w_scale[·γr]) + bias
+    (+ residual) (+ act) epilogue fused into the same kernel — the
+    int32 accumulator never leaves VMEM."""
+    return _matmul_epilogue_call(
+        _int8_epilogue_kernel, xq, wq, scale, shift, residual, act,
+        out_dtype, block_m, interpret)
+
+
+# ---------------------------------------------------------------------------
 # backward phase 1: dgamma / dbeta reduction (reads y, dz once)
 # ---------------------------------------------------------------------------
 def _bwd_stats_kernel(y_ref, dz_ref, mu_ref, r_ref, dg_ref, db_ref):

@@ -77,6 +77,8 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     MODEL_PARAMS_BYTES, MODEL_OPT_STATE_BYTES, MODEL_LAYER_STATE_BYTES,
     GEN_TOKENS, GEN_ACTIVE_SLOTS, GEN_ADMISSIONS, GEN_RETIREMENTS,
     GEN_PREFILL_MS, GEN_PER_TOKEN_MS,
+    QUANT_INT8_LAYERS, QUANT_CALIBRATIONS, QUANT_DEQUANT_FALLBACKS,
+    QUANT_ACTIVATION_BYTES,
     bootstrap_core_metrics, collect_device_memory, get_registry,
     record_transfer)
 from deeplearning4j_tpu.monitoring.tracing import (  # noqa: F401
@@ -122,6 +124,8 @@ __all__ = [
     "PIPELINE_STAGED_BATCHES",
     "GEN_TOKENS", "GEN_ACTIVE_SLOTS", "GEN_ADMISSIONS",
     "GEN_RETIREMENTS", "GEN_PREFILL_MS", "GEN_PER_TOKEN_MS",
+    "QUANT_INT8_LAYERS", "QUANT_CALIBRATIONS",
+    "QUANT_DEQUANT_FALLBACKS", "QUANT_ACTIVATION_BYTES",
 ]
 
 

@@ -140,6 +140,16 @@ MODEL_PARAMS_BYTES = "dl4j.model.params_bytes"
 MODEL_OPT_STATE_BYTES = "dl4j.model.opt_state_bytes"
 MODEL_LAYER_STATE_BYTES = "dl4j.model.layer_state_bytes"
 
+# quantization (quantize/): the memory-traffic diet's observability —
+# how many layers actually serve int8, how their activation scales were
+# obtained, which weight-bearing layers fell back to fp (dequant
+# fallbacks), and the per-model activation-traffic estimate by
+# precision policy (quantize/traffic.py gauge; labels: model, policy)
+QUANT_INT8_LAYERS = "dl4j.quant.int8_layers"
+QUANT_CALIBRATIONS = "dl4j.quant.calibrations"
+QUANT_DEQUANT_FALLBACKS = "dl4j.quant.dequant_fallbacks"
+QUANT_ACTIVATION_BYTES = "dl4j.quant.activation_traffic_bytes"
+
 # autoregressive generation (generation/server.py): KV-cache decode loop
 # with continuous-batching admission
 GEN_TOKENS = "dl4j.gen.tokens"

@@ -28,6 +28,15 @@ class BackpropType:
     TruncatedBPTT = "truncated_bptt"
 
 
+def _check_remat_policy(policy, allowed):
+    p = "none" if policy in (None, False) else \
+        ("layers" if policy is True else str(policy))
+    if p not in allowed:
+        raise ValueError(
+            f"rematPolicy must be one of {allowed}, got {policy!r}")
+    return p
+
+
 class WorkspaceMode:
     """Accepted for API parity; buffer reuse is XLA's job (donated buffers)."""
     ENABLED = "enabled"
@@ -40,7 +49,7 @@ class MultiLayerConfiguration:
     def __init__(self, defaults, layer_confs, input_type=None,
                  preprocessors=None, backprop_type=BackpropType.Standard,
                  tbptt_fwd_length=20, tbptt_back_length=20, data_type="float32",
-                 seed=0):
+                 seed=0, remat_policy="none"):
         self.defaults = defaults
         self.layers = layer_confs
         self.input_type = input_type
@@ -50,10 +59,19 @@ class MultiLayerConfiguration:
         self.tbptt_back_length = tbptt_back_length
         self.data_type = data_type
         self.seed = seed
+        self.remat_policy = remat_policy
         for i, l in enumerate(self.layers):
             if getattr(l, "name", None) is None:
                 l.name = f"layer{i}"  # addressable default (h5 import etc.)
         self._infer_shapes()
+        if remat_policy == "layers":
+            # every hidden layer recomputes its internals in backward
+            # unless it explicitly opted out with .remat(False); the
+            # loss head keeps its activations (it is the backward's
+            # starting point anyway)
+            for l in self.layers[:-1]:
+                if getattr(l, "remat", None) is None:
+                    l.remat = True
 
     def _infer_shapes(self):
         """nIn inference + automatic preprocessor insertion (≡ the
@@ -125,6 +143,7 @@ class ListBuilder:
         self._backprop_type = BackpropType.Standard
         self._tbptt_fwd = 20
         self._tbptt_back = 20
+        self._remat_policy = "none"
 
     def layer(self, *args):
         """layer(conf) or layer(index, conf) — accepts a built config or a
@@ -153,6 +172,17 @@ class ListBuilder:
         self._backprop_type = bp_type
         return self
 
+    def rematPolicy(self, policy):
+        """Selective activation recompute for the backward pass.
+        "layers" wraps every hidden layer's train-mode apply in
+        jax.checkpoint — only layer INPUTS are saved for backward,
+        everything inside a layer is recomputed (trades the conv/BN
+        FLOPs for the eliminated activation reads; ROADMAP item 3).
+        "none" (default) stores every intermediate as usual. Individual
+        layers may still opt in/out via .remat(True/False)."""
+        self._remat_policy = _check_remat_policy(policy, ("none", "layers"))
+        return self
+
     def tBPTTForwardLength(self, n):
         self._tbptt_fwd = int(n)
         return self
@@ -171,7 +201,8 @@ class ListBuilder:
         return MultiLayerConfiguration(
             dict(self._defaults), list(self._layers), self._input_type,
             self._preprocessors, self._backprop_type, self._tbptt_fwd,
-            self._tbptt_back, self._data_type, self._seed)
+            self._tbptt_back, self._data_type, self._seed,
+            self._remat_policy)
 
 
 class NeuralNetConfiguration:
@@ -256,6 +287,14 @@ class NeuralNetConfiguration:
                 cs.append(c)
             self._defaults["constraints"] = (
                 self._defaults.get("constraints", []) + cs)
+            return self
+
+        def precisionPolicy(self, policy):
+            """Quantization precision policy inherited by every layer
+            (quantize.PrecisionPolicy): training-time QAT fake-quant +
+            the eligibility map for the real int8 inference rewrite
+            (`quantize.quantize_network`). None = full precision."""
+            self._defaults["precisionPolicy"] = policy
             return self
 
         def gradientNormalization(self, gn):

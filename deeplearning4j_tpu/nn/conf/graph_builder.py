@@ -31,7 +31,7 @@ class ComputationGraphConfiguration:
     def __init__(self, defaults, nodes, input_names, output_names,
                  input_types=None, backprop_type=BackpropType.Standard,
                  tbptt_fwd_length=20, tbptt_back_length=20,
-                 data_type="float32", seed=0):
+                 data_type="float32", seed=0, remat_policy="none"):
         self.defaults = defaults
         self.nodes = nodes                    # dict name -> GraphNode
         self.input_names = list(input_names)
@@ -42,10 +42,75 @@ class ComputationGraphConfiguration:
         self.tbptt_back_length = tbptt_back_length
         self.data_type = data_type
         self.seed = seed
+        self.remat_policy = remat_policy
         self.topo_order = self._topo_sort()
         self.node_output_types = {}
         if self.input_types:
             self._infer_shapes()
+
+    def consumers(self):
+        """{node name: [consumer node names]} over the whole DAG — THE
+        consumer map every graph analysis shares (remat segmentation,
+        the traffic ledger via remat_plan, conv+BN fusion pairing, the
+        quantized chain planner)."""
+        consumers = {}
+        for name in self.topo_order:
+            for p in self.nodes[name].inputs:
+                consumers.setdefault(p, []).append(name)
+        return consumers
+
+    def remat_segments(self):
+        """Per-residual-block recompute segmentation (rematPolicy
+        "blocks"): split the topo order at BLOCK BOUNDARIES — nodes
+        whose activation is consumed by more than one downstream node
+        (in a residual graph that is exactly the block entry/exit: the
+        tensor feeding both the main path and the shortcut), plus
+        output nodes. Each segment between boundaries re-runs under
+        jax.checkpoint in backward, so only boundary activations are
+        stored — the cheap conv/BN internals of a block are recomputed
+        instead of read back from HBM. Returns a list of [node names],
+        one per segment (boundary node last in its segment)."""
+        consumers = self.consumers()
+        # parents of output nodes stay boundaries too: feature-dependent
+        # losses (needs_features heads) read the head's input activation
+        # directly from the acts dict
+        out_parents = {p for o in self.output_names
+                       for p in self.nodes[o].inputs}
+        segments, cur = [], []
+        for name in self.topo_order:
+            if self.nodes[name].kind == "input":
+                continue
+            cur.append(name)
+            boundary = (len(consumers.get(name, ())) != 1
+                        or name in self.output_names
+                        or name in out_parents)
+            if boundary:
+                segments.append(cur)
+                cur = []
+        if cur:
+            segments.append(cur)
+        return segments
+
+    def remat_plan(self):
+        """[(segment, saved_outputs)] — the authoritative statement of
+        what block-remat KEEPS: each segment's saved outputs are the
+        nodes a later segment, an output head, or the loss reads (on a
+        residual chain exactly the block boundary; on interleaved
+        branches possibly more). The graph executor saves exactly
+        these, and the traffic ledger (quantize/traffic.py) prices
+        exactly these — one rule, two consumers, no drift."""
+        consumers = self.consumers()
+        plan = []
+        for seg in self.remat_segments():
+            seg_set = set(seg)
+            outs = [n for n in seg
+                    if n in self.output_names
+                    or any(c not in seg_set
+                           for c in consumers.get(n, ()))]
+            if seg[-1] not in outs:
+                outs.append(seg[-1])
+            plan.append((seg, outs))
+        return plan
 
     def _topo_sort(self):
         order, seen, visiting = [], set(), set()
@@ -166,6 +231,7 @@ class GraphBuilder:
         self._input_types = []
         self._backprop_type = BackpropType.Standard
         self._tbptt_fwd = self._tbptt_back = 20
+        self._remat_policy = "none"
 
     def addInputs(self, *names):
         if len(names) == 1 and isinstance(names[0], (list, tuple)):
@@ -213,6 +279,19 @@ class GraphBuilder:
         self._backprop_type = t
         return self
 
+    def rematPolicy(self, policy):
+        """Selective activation recompute. "blocks": save only
+        residual-block boundary activations (nodes with >1 consumer —
+        the tensors feeding both a block's main path and its shortcut)
+        and recompute each block's conv/BN internals in backward via
+        jax.checkpoint; the DSL-level byte diet for ResNet-class graphs
+        (ROADMAP item 3). "layers" falls back to per-layer remat flags;
+        "none" (default) stores everything."""
+        from deeplearning4j_tpu.nn.conf.builders import _check_remat_policy
+        self._remat_policy = _check_remat_policy(
+            policy, ("none", "layers", "blocks"))
+        return self
+
     def tBPTTForwardLength(self, n):
         self._tbptt_fwd = int(n)
         return self
@@ -229,7 +308,16 @@ class GraphBuilder:
         for name, pp in getattr(self, "_pending_pp", {}).items():
             if name in self._nodes:
                 self._nodes[name].preprocessor = pp
-        return ComputationGraphConfiguration(
+        conf = ComputationGraphConfiguration(
             dict(self._defaults), self._nodes, self._inputs, self._outputs,
             self._input_types, self._backprop_type, self._tbptt_fwd,
-            self._tbptt_back, self._data_type, self._seed)
+            self._tbptt_back, self._data_type, self._seed,
+            self._remat_policy)
+        if self._remat_policy == "layers":
+            for name in conf.topo_order:
+                node = conf.nodes[name]
+                if (node.kind == "layer"
+                        and name not in conf.output_names
+                        and getattr(node.ref, "remat", None) is None):
+                    node.ref.remat = True
+        return conf

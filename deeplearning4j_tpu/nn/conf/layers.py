@@ -79,7 +79,8 @@ class Layer:
     INHERITED = ("activation", "weightInit", "biasInit", "l1", "l2",
                  "dropOut", "updater", "gradientNormalization",
                  "gradientNormalizationThreshold", "weightDecay",
-                 "constraints", "weightNoise")
+                 "constraints", "weightNoise", "precisionPolicy",
+                 "remat")
 
     @classmethod
     def _builder_positional(cls, args):
@@ -106,6 +107,13 @@ class Layer:
         self.weightDecay = weightDecay
         self.constraints = constraints
         self.weightNoise = kw.pop("weightNoise", None)
+        if "precisionPolicy" in kw and kw["precisionPolicy"] is None:
+            # EXPLICIT per-layer opt-out: a literal None would read as
+            # "unset" and inherit the global policy right back (None is
+            # the INHERITED sentinel) — resolve it to a disabled policy
+            # that shadows the inherited one
+            from deeplearning4j_tpu.quantize.policy import PrecisionPolicy
+            kw["precisionPolicy"] = PrecisionPolicy.off()
         cw = kw.pop("constrainWeights", None)  # builder-method spelling
         if cw is not None:
             self.constraints = (list(cw) if isinstance(cw, (list, tuple))
@@ -207,7 +215,19 @@ class DenseLayer(Layer):
         return params, {}, self.output_type(input_type)
 
     def pre_activation(self, params, x):
-        y = x @ params["W"].astype(x.dtype)
+        w = params["W"]
+        qp = getattr(self, "precisionPolicy", None)
+        if qp is not None and qp.applies_to(self):
+            # QAT fake-quant (STE): weights per-out-channel, input
+            # per-tensor — the fp forward simulates the deployed int8
+            # lattice so post-training quantization loses ~nothing
+            from deeplearning4j_tpu.quantize.core import (fake_quant_act,
+                                                          fake_quant_weight)
+            if qp.weights:
+                w = fake_quant_weight(w, channel_axis=-1)
+            if qp.activations:
+                x = fake_quant_act(x).astype(x.dtype)
+        y = x @ w.astype(x.dtype)
         if self.hasBias:
             y = y + params["b"].astype(x.dtype)
         return y
@@ -394,7 +414,18 @@ class ConvolutionLayer(Layer):
         return params, {}, self.output_type(input_type)
 
     def pre_activation(self, params, x):
-        w = params["W"].astype(x.dtype)
+        w = params["W"]
+        qp = getattr(self, "precisionPolicy", None)
+        if qp is not None and qp.applies_to(self):
+            # QAT fake-quant (STE) — see DenseLayer.pre_activation;
+            # per-out-channel weight scales over the HWIO kernel
+            from deeplearning4j_tpu.quantize.core import (fake_quant_act,
+                                                          fake_quant_weight)
+            if qp.weights:
+                w = fake_quant_weight(w, channel_axis=-1)
+            if qp.activations:
+                x = fake_quant_act(x).astype(x.dtype)
+        w = w.astype(x.dtype)
         b = getattr(self, "spaceToDepth", 1)
         y = None
         if (b > 1 and self.dilation == (1, 1)

@@ -25,10 +25,47 @@ tested, and available for graphs where XLA's fusion does worse.
 """
 from __future__ import annotations
 
+import functools
 import os
 
 import jax
 import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _eval_epilogue(xf, w, a, b, act, interpret):
+    """act((xf @ w)·a + b) through the epilogue-fused Pallas kernel
+    (kernels/pointwise_conv.matmul_epilogue), with a closed-form VJP:
+    the kernel itself has no differentiation rule, but eval-mode
+    forwards still get differentiated (input saliency, adversarial
+    probes), so the backward recomputes the pre-affine GEMM and emits
+    the standard affine/relu chain — grads to gamma/beta flow through
+    the fold arithmetic outside this function."""
+    from deeplearning4j_tpu.kernels.pointwise_conv import matmul_epilogue
+    return matmul_epilogue(xf, w, a, b, act=act, interpret=interpret)
+
+
+def _eval_epilogue_fwd(xf, w, a, b, act, interpret):
+    z = _eval_epilogue(xf, w, a, b, act, interpret)
+    return z, (xf, w, a, z)
+
+
+def _eval_epilogue_bwd(act, interpret, res, dz):
+    xf, w, a, z = res
+    dzf = dz.astype(jnp.float32)
+    if act == "relu":
+        dzf = jnp.where(z > 0, dzf, 0.0)
+    dy = dzf * a                                   # z = y·a + b
+    wf = w.astype(jnp.float32)
+    dx = (dy @ wf.T).astype(xf.dtype)
+    y = jnp.dot(xf.astype(jnp.float32), wf)        # recompute, not stored
+    dw = (xf.astype(jnp.float32).T @ dy).astype(w.dtype)
+    da = jnp.sum(dzf * y, axis=0).astype(a.dtype)
+    db = jnp.sum(dzf, axis=0).astype(a.dtype)
+    return dx, dw, da, db
+
+
+_eval_epilogue.defvjp(_eval_epilogue_fwd, _eval_epilogue_bwd)
 
 
 def fusion_enabled():
@@ -79,11 +116,7 @@ def find_conv1x1_bn_fusions(conf):
     never on the shared conf, so two nets built from one conf can run
     fused and unfused independently."""
     nodes = conf.nodes
-    consumers = {}
-    for name in conf.topo_order:
-        node = nodes[name]
-        for p in getattr(node, "inputs", ()):
-            consumers.setdefault(p, []).append(name)
+    consumers = conf.consumers()
     pairs = {}
     for name in conf.topo_order:
         conv = nodes[name]
@@ -136,7 +169,33 @@ def fused_apply(conv_layer, bn_layer, p_conv, p_bn, s_bn, x, train,
         # XLA DCEs this whole branch unless someone actually reads it
         y = jnp.dot(xf, w, preferred_element_type=jnp.float32).astype(
             x.dtype)
+    elif isinstance(xf, jax.core.Tracer):
+        # jitted inference (serving/eval executables): BN is a
+        # per-channel affine of the RUNNING stats — fold it (plus the
+        # relu) into the GEMM's epilogue so the conv output tile is
+        # normalized while still in VMEM instead of in a standalone
+        # BN-apply pass (the shape BENCH.md round 3 concluded is the
+        # only fusion that wins). _eval_epilogue carries a custom VJP
+        # (recompute-based closed form), so autodiff THROUGH an eval
+        # forward (input saliency etc.) keeps working. The
+        # reporting-only y below is DCE'd by XLA unless something
+        # actually reads it.
+        gamma = p_bn.get("gamma", jnp.ones_like(s_bn["mean"]))
+        beta = p_bn.get("beta", jnp.zeros_like(s_bn["mean"]))
+        inv = jax.lax.rsqrt(s_bn["var"] + bn_layer.eps)
+        act = str(bn_layer.activation).lower()
+        act = "identity" if act in ("identity", "linear") else act
+        z = _eval_epilogue(xf, w, gamma * inv,
+                           beta - gamma * s_bn["mean"] * inv,
+                           act, interpret)
+        new_state = s_bn
+        y = jnp.dot(xf, w, preferred_element_type=jnp.float32).astype(
+            x.dtype)
     else:
+        # eager inference: nothing DCEs an unread tensor here, so a
+        # separate epilogue kernel would make the conv GEMM run twice
+        # (once for z, once for the reported y) — one GEMM + the
+        # standalone BN apply is strictly cheaper op-by-op
         y = jnp.dot(xf, w, preferred_element_type=jnp.float32).astype(
             x.dtype)
         z, new_state = bn_layer.apply(p_bn, s_bn, y, train=False)

@@ -1,0 +1,991 @@
+"""Real int8 inference: post-training network rewrite + fused execution.
+
+`quantize_network(net)` takes a TRAINED MultiLayerNetwork or
+ComputationGraph and returns an INFERENCE-ONLY twin whose eligible
+layers (dense, pad-free 1×1 convolutions — the policy's
+`int8_servable` set) carry int8 weights with per-output-channel scales
+and execute through an int8 contraction with a fused
+dequant+bias+activation epilogue. A 1×1 conv feeding only a
+BatchNormalization absorbs the BN's inference affine INTO that epilogue
+(the BN node degrades to a pass-through), so conv+BN+act is one GEMM +
+one fused elementwise tail — no standalone BN pass. Ineligible
+weight-bearing layers stay fp and are counted on
+`dl4j.quant.dequant_fallbacks`.
+
+Execution strategies (`impl=`, default "auto"):
+
+- **"dot"** — the canonical int8×int8→int32 `lax.dot_general` per layer
+  (`quantize/core.int8_dot`), MXU-native on TPU. Activations quantize at
+  every layer boundary.
+- **"chain"** — the CPU-tuned shape (auto default off-TPU, where XLA
+  lowers int8 contractions to a scalar loop): maximal runs of quantized
+  pointwise layers — including residual adds and relu/identity
+  activations — execute as ONE cache-resident tiled pipeline:
+  `lax.scan` over row tiles, each tile dequantized once, pushed through
+  the whole run's GEMMs/epilogues/residuals while resident in cache,
+  and requantized to int8 on the single write back out. RAM sees int8
+  at run boundaries and nothing in between — the measured-write-
+  bandwidth-bound regime this box's BENCH profile lives in. Chain
+  entry/exit are the only activation-quantization points (strictly
+  less rounding error than per-layer "dot").
+
+The rewritten net keeps the original layer/node names and indices
+(folded BN nodes become `QuantPassthrough`), so `ExecutableStore` /
+`ParallelInference` serve it exactly like any model — the
+model fingerprint changes with the int8 param trees, so quantized
+executables cache separately from their fp twins.
+"""
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.quantize import calibrate as _cal
+from deeplearning4j_tpu.quantize.core import (INT8_MAX, dequant_epilogue,
+                                              int8_dot,
+                                              per_channel_scales, quantize)
+from deeplearning4j_tpu.quantize.policy import PrecisionPolicy
+
+__all__ = ["QuantizedConv1x1", "QuantizedDense", "QuantPassthrough",
+           "quantize_network"]
+
+#: rows per cache-resident tile of the chain executor — 1568×C f32
+#: stays comfortably inside L2 for the channel widths the policy admits
+CHAIN_TILE_ROWS = 1568
+
+
+def _default_impl():
+    return "dot" if jax.default_backend() == "tpu" else "chain"
+
+
+# -- quantized layer confs --------------------------------------------------
+class _QuantLayerBase:
+    """Conf-object contract shared with nn.conf.layers.Layer — enough
+    surface for the network classes, serde, and summary()."""
+
+    updater = None
+    constraints = None
+    dropOut = None
+    frozen = False
+
+    def apply_defaults(self, defaults):
+        return self
+
+    def regularization_terms(self):
+        return 0.0, 0.0
+
+    def feed_forward_mask(self, mask):
+        return mask
+
+    def initialize(self, key, input_type):
+        raise RuntimeError(
+            f"{type(self).__name__} is produced by quantize_network() "
+            "from a trained layer — it cannot initialize fresh params")
+
+
+class QuantizedDense(_QuantLayerBase):
+    """Dense layer served int8: y = act(int8dot(q(x), Wq)·scale + bias).
+
+    params: Wq int8 (nIn, nOut); scale f32 (nOut,) = x_scale·w_scale;
+    bias f32 (nOut,) or absent; x_scale f32 scalar (traced — a
+    recalibration changes an argument, never the executable)."""
+
+    def __init__(self, name, nIn, nOut, activation, hasBias, impl="auto"):
+        self.name = name
+        self.nIn, self.nOut = int(nIn), int(nOut)
+        self.activation = activation
+        self.hasBias = bool(hasBias)
+        self.impl = impl
+
+    def output_type(self, input_type):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        return InputType.feedForward(self.nOut)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        xq = quantize(x, params["x_scale"])
+        impl = _default_impl() if self.impl == "auto" else self.impl
+        if impl == "dot":
+            acc = int8_dot(xq, params["Wq"])
+        else:
+            # exact f32 twin of the int32 accumulation (see core);
+            # batched inputs (B, T, F) contract the trailing axis
+            acc = xq.astype(jnp.float32) @ params["Wq"].astype(
+                jnp.float32)
+        y = dequant_epilogue(acc, params["scale"], params.get("bias"),
+                             act=self.activation)
+        return y.astype(x.dtype), state
+
+
+class QuantizedConv1x1(_QuantLayerBase):
+    """Pad-free 1×1 conv served int8 as a GEMM over the flattened
+    spatial axis, with any following BatchNormalization folded into the
+    dequant epilogue (scale ← x_scale·w_scale·γr, bias ← conv-bias·γr +
+    (β − γμr)) and the BN's activation fused behind it."""
+
+    is_pointwise = True
+
+    def __init__(self, name, nIn, nOut, activation, stride=1,
+                 impl="auto"):
+        self.name = name
+        self.nIn, self.nOut = int(nIn), int(nOut)
+        self.activation = activation
+        self.stride = int(stride)
+        self.impl = impl
+
+    def output_type(self, input_type):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        s = self.stride
+        return InputType.convolutional(
+            -(-input_type.height // s), -(-input_type.width // s),
+            self.nOut)
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        if self.stride > 1:
+            x = x[:, ::self.stride, ::self.stride, :]
+        b, h, w, c = x.shape
+        xf = x.reshape(b * h * w, c)
+        xq = quantize(xf, params["x_scale"])
+        impl = _default_impl() if self.impl == "auto" else self.impl
+        if impl == "dot":
+            acc = int8_dot(xq, params["Wq"])
+        else:
+            acc = lax.dot_general(
+                xq.astype(jnp.float32), params["Wq"].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        y = dequant_epilogue(acc, params["scale"], params.get("bias"),
+                             act=self.activation)
+        return y.astype(x.dtype).reshape(b, h, w, self.nOut), state
+
+
+class QuantPassthrough(_QuantLayerBase):
+    """Stand-in for a layer whose work was folded into the quantized
+    layer before it (a BN absorbed into a conv epilogue). Keeps the
+    layer list / node graph shape-stable: names, indices, preprocessor
+    slots, and serialization all survive the rewrite."""
+
+    def __init__(self, name, folded_into):
+        self.name = name
+        self.folded_into = folded_into
+        self.activation = "identity"
+
+    def output_type(self, input_type):
+        return input_type
+
+    def apply(self, params, state, x, train=False, rng=None, mask=None):
+        return x, state
+
+
+# -- weight/BN folding ------------------------------------------------------
+def _fold_dense(layer, p):
+    w = np.asarray(p["W"], np.float32)
+    w_scale = np.asarray(per_channel_scales(w, -1))
+    wq = np.asarray(quantize(jnp.asarray(w), jnp.asarray(w_scale), 1))
+    out = {"Wq": jnp.asarray(wq), "w_scale": w_scale}
+    if layer.hasBias and "b" in p:
+        out["bias"] = jnp.asarray(np.asarray(p["b"], np.float32))
+    return out
+
+
+def _fold_conv_bn(conv, p_conv, bn, p_bn, s_bn):
+    """int8 weights + the conv-bias/BN affine folded to ONE epilogue
+    scale/bias pair (missing BN → plain conv epilogue)."""
+    w = np.asarray(p_conv["W"], np.float32)
+    cin, cout = w.shape[2], w.shape[3]
+    w2 = w.reshape(cin, cout)
+    w_scale = np.asarray(per_channel_scales(jnp.asarray(w2), -1))
+    wq = np.asarray(quantize(jnp.asarray(w2), jnp.asarray(w_scale), 1))
+    bias = (np.asarray(p_conv["b"], np.float32)
+            if getattr(conv, "hasBias", False) and "b" in p_conv
+            else np.zeros(cout, np.float32))
+    a = np.ones(cout, np.float32)
+    b = np.zeros(cout, np.float32)
+    if bn is not None:
+        mean = np.asarray(s_bn["mean"], np.float32)
+        var = np.asarray(s_bn["var"], np.float32)
+        gamma = np.asarray(p_bn.get("gamma", np.ones(cout)), np.float32)
+        beta = np.asarray(p_bn.get("beta", np.zeros(cout)), np.float32)
+        inv = 1.0 / np.sqrt(var + bn.eps)
+        a = gamma * inv
+        b = beta - gamma * mean * inv
+    return {"Wq": jnp.asarray(wq), "w_scale": w_scale,
+            "affine_a": a, "affine_b": np.asarray(a * bias + b,
+                                                  np.float32)}
+
+
+def _finish_params(folded, x_scale):
+    """Bake the calibrated activation scale into the epilogue: scale =
+    x_scale·w_scale[·γr], bias already affine-folded. x_scale rides as
+    a traced scalar param so recalibration never recompiles."""
+    w_scale = folded.pop("w_scale")
+    a = folded.pop("affine_a", None)
+    if a is not None:
+        folded["scale"] = jnp.asarray(x_scale * w_scale * a, jnp.float32)
+        folded["bias"] = jnp.asarray(folded.pop("affine_b"), jnp.float32)
+    else:
+        folded["scale"] = jnp.asarray(x_scale * w_scale, jnp.float32)
+    folded["x_scale"] = jnp.asarray(x_scale, jnp.float32)
+    return folded
+
+
+# -- the chain executor -----------------------------------------------------
+class _ChainPlan:
+    """One maximal run of quantized pointwise work executed as a
+    cache-resident tiled pipeline. steps: ("gemm", key, act) |
+    ("add", tap_step, src_is_entry) | ("relu",). `taps`: step indices
+    whose (dequantized, in-cache) outputs later adds read."""
+
+    def __init__(self, entry, exit_, steps, keys, taps, in_key,
+                 out_names):
+        self.entry = entry          # upstream act feeding the run
+        self.exit = exit_           # node/layer whose act the run yields
+        self.steps = steps
+        self.keys = keys            # param keys of the gemm steps
+        self.taps = frozenset(taps)
+        self.in_key = in_key        # param key supplying the entry scale
+        self.out_names = out_names  # names covered (for bookkeeping)
+
+    def run(self, params, x):
+        """x: (B, H, W, C) fp activation. One int8 quantize at entry;
+        after that, `lax.scan` over row tiles keeps every intermediate
+        in cache — GEMM epilogues, residual adds and relus never
+        round-trip RAM. Inside a tile the flow is the DEQUANTIZED f32
+        value (int8 quantization error is incurred at the run entry and
+        in the int8 weights; strictly less rounding than the per-layer
+        "dot" impl)."""
+        b, h, w, c = x.shape
+        m = b * h * w
+        x_scale = params[self.in_key]["x_scale"]
+        xq = quantize(x.reshape(m, c), x_scale)
+        bm = min(CHAIN_TILE_ROWS, m)
+        pad = (-m) % bm
+        if pad:
+            xq = jnp.pad(xq, ((0, pad), (0, 0)))
+        # int-valued f32 weights + epilogue scales, hoisted out of the
+        # scan (loop-invariant); `scale` params carry x_scale·w_scale[·a]
+        # for the per-layer impl — the in-cache value is already
+        # dequantized, so divide the entry scale back out
+        wf = [params[k]["Wq"].astype(jnp.float32) for k in self.keys]
+        sc = [params[k]["scale"] / params[k]["x_scale"]
+              for k in self.keys]
+        bi = [params[k].get("bias") for k in self.keys]
+        out_c = wf[-1].shape[1]
+
+        def tile_body(carry, tile):
+            cur = tile.astype(jnp.float32) * x_scale
+            entry = cur
+            saved = {}
+            gi = 0
+            for si, step in enumerate(self.steps):
+                if step[0] == "gemm":
+                    acc = lax.dot_general(
+                        cur, wf[gi], (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    cur = acc * sc[gi]
+                    if bi[gi] is not None:
+                        cur = cur + bi[gi]
+                    if step[2] == "relu":
+                        cur = jnp.maximum(cur, 0.0)
+                    gi += 1
+                elif step[0] == "add":
+                    src = entry if step[2] else saved[step[1]]
+                    cur = cur + src
+                elif step[0] == "relu":
+                    cur = jnp.maximum(cur, 0.0)
+                if si in self.taps:
+                    saved[si] = cur
+            return carry, cur
+
+        tiles = xq.reshape(-1, bm, c)
+        _, out = lax.scan(tile_body, 0, tiles)
+        out = out.reshape(-1, out_c)[:m]
+        return out.reshape(b, h, w, out_c).astype(x.dtype)
+
+
+def _count_quant_metrics(n_int8, n_fallback):
+    if _mon.enabled():
+        reg = _mon.get_registry()
+        if n_int8:
+            reg.counter(_mon.QUANT_INT8_LAYERS,
+                        help="layers rewritten to the int8 serving "
+                             "path").inc(n_int8)
+        if n_fallback:
+            reg.counter(_mon.QUANT_DEQUANT_FALLBACKS,
+                        help="weight-bearing layers the int8 rewrite "
+                             "left at full precision").inc(n_fallback)
+
+
+def _is_relu_or_identity(act):
+    return str(act).lower() in ("relu", "identity", "linear")
+
+
+def _effective_policy(layer, default):
+    """A layer-level precisionPolicy (including the `.off()` opt-out
+    sentinel `.precisionPolicy(None)` resolves to) shadows the
+    network-level/passed one for BOTH QAT and this rewrite."""
+    lp = getattr(layer, "precisionPolicy", None)
+    return lp if lp is not None else default
+
+
+# -- MultiLayerNetwork rewrite ----------------------------------------------
+def _quantize_multilayer(net, data, policy, impl, fuse):
+    from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                                   BatchNormalization,
+                                                   ConvolutionLayer,
+                                                   DenseLayer)
+    conf = copy.deepcopy(net.conf)
+    layers = conf.layers
+    n = len(layers)
+
+    # plan: which indices quantize, which BN folds into which conv
+    to_quant, folds = [], {}
+    i = 0
+    while i < n:
+        layer = net.conf.layers[i]
+        if _effective_policy(layer, policy).int8_servable(layer):
+            if (type(layer) is ConvolutionLayer
+                    and _is_relu_or_identity(layer.activation)
+                    and str(layer.activation).lower() != "relu"
+                    and i + 1 < n
+                    and type(net.conf.layers[i + 1]) is BatchNormalization
+                    and (i + 1) not in net.conf.preprocessors):
+                folds[i] = i + 1
+                to_quant.append(i)
+                i += 2
+                continue
+            to_quant.append(i)
+        i += 1
+
+    if not to_quant:
+        raise ValueError(
+            "quantize_network: no int8-servable layer found (policy "
+            f"{policy!r}); nothing to quantize")
+
+    # activation-scale calibration: observed (data) > upstream-BN > default
+    observed = None
+    if data is not None:
+        def collect(x):
+            xs = jnp.asarray(x)
+            _, _, _, acts = net._forward(net._params, net._state, xs,
+                                         False, None, collect=True)
+            ins = {}
+            for idx in to_quant:
+                a = xs.astype(net._compute_dtype) if idx == 0 \
+                    else acts[idx - 1]
+                pp = net.conf.preprocessors.get(idx)
+                ins[str(idx)] = pp.preProcess(a) if pp is not None else a
+            return ins
+        observed = _cal.observe(collect, data)
+    bn_scales = {}
+    for idx in to_quant:
+        prev = net.conf.layers[idx - 1] if idx > 0 else None
+        if type(prev) is BatchNormalization:
+            bn_scales[str(idx)] = _cal.bn_param_scale(
+                net._params.get(str(idx - 1), {}))
+    scales = _cal.resolve_scales([str(i) for i in to_quant], observed,
+                                 bn_scales)
+
+    new_params = {}
+    new_state = {}
+    fallbacks = 0
+    for idx in range(n):
+        key = str(idx)
+        layer = net.conf.layers[idx]
+        if idx in to_quant:
+            p = net._params.get(key, {})
+            x_scale, _src = scales[key]
+            if type(layer) is ConvolutionLayer:
+                bn_idx = folds.get(idx)
+                bn = net.conf.layers[bn_idx] if bn_idx is not None else None
+                folded = _fold_conv_bn(
+                    layer, p, bn,
+                    net._params.get(str(bn_idx), {}) if bn else None,
+                    net._state.get(str(bn_idx), {}) if bn else None)
+                act = bn.activation if bn is not None else layer.activation
+                layers[idx] = QuantizedConv1x1(
+                    layer.name, layer.nIn, layer.nOut, act,
+                    stride=layer.stride[0], impl=impl)
+                if bn_idx is not None:
+                    layers[bn_idx] = QuantPassthrough(
+                        net.conf.layers[bn_idx].name, layer.name)
+            else:
+                folded = _fold_dense(layer, p)
+                layers[idx] = QuantizedDense(
+                    layer.name, layer.nIn, layer.nOut, layer.activation,
+                    layer.hasBias, impl=impl)
+            new_params[key] = _finish_params(folded, x_scale)
+        elif idx in folds.values():
+            pass          # folded BN: no params, no state
+        else:
+            if net._params.get(key):
+                new_params[key] = jax.tree_util.tree_map(
+                    jnp.copy, net._params[key])
+            if net._state.get(key):
+                new_state[key] = jax.tree_util.tree_map(
+                    jnp.copy, net._state[key])
+            if isinstance(layer, (DenseLayer, ConvolutionLayer)) \
+                    and not hasattr(layer, "compute_loss"):
+                fallbacks += 1
+
+    _count_quant_metrics(len(to_quant), fallbacks)
+
+    # chain plans: maximal runs of stride-1 quantized convs /
+    # passthroughs / relu-identity activation layers (sequential nets
+    # have no residual taps)
+    plans = []
+    eff_impl = _default_impl() if impl == "auto" else impl
+    if fuse and eff_impl == "chain":
+        plans = _plan_multilayer_chains(conf, layers)
+
+    q = QuantizedMultiLayerNetwork(conf)
+    q._params = new_params
+    q._state = new_state
+    q._chain_plans = {p.entry: p for p in plans}
+    q._quant_stats = {"int8_layers": len(to_quant),
+                      "fallbacks": fallbacks,
+                      "folded_bns": len(folds),
+                      "chains": len(plans),
+                      "scales": {k: v for k, v in scales.items()}}
+    return q
+
+
+def _plan_multilayer_chains(conf, layers):
+    """Runs of consecutive [QuantizedConv1x1 stride-1 | QuantPassthrough
+    | ActivationLayer(relu/identity)] with >= 2 GEMMs become one
+    cache-resident chain. A preprocessor on a layer breaks the run
+    before it; the loss head never joins."""
+    from deeplearning4j_tpu.nn.conf.layers import ActivationLayer
+    plans, i, n = [], 0, len(layers)
+    while i < n:
+        layer = layers[i]
+        if not (isinstance(layer, QuantizedConv1x1)
+                and layer.stride == 1):
+            i += 1
+            continue
+        steps, keys, run = [], [], []
+        j = i
+        while j < n:
+            lj = layers[j]
+            if conf.preprocessors.get(j) is not None and j > i:
+                break
+            if isinstance(lj, QuantizedConv1x1) and lj.stride == 1 \
+                    and _is_relu_or_identity(lj.activation):
+                steps.append(("gemm", str(j),
+                              "relu" if str(lj.activation).lower()
+                              == "relu" else "identity"))
+                keys.append(str(j))
+            elif isinstance(lj, QuantPassthrough):
+                pass
+            elif isinstance(lj, ActivationLayer) \
+                    and _is_relu_or_identity(lj.activation):
+                if str(lj.activation).lower() == "relu":
+                    steps.append(("relu",))
+            else:
+                break
+            run.append(j)
+            j += 1
+        if len(keys) >= 2:
+            plans.append(_ChainPlan(
+                entry=i, exit_=run[-1], steps=steps, keys=keys,
+                taps=(), in_key=keys[0], out_names=tuple(run)))
+        i = max(j, i + 1)
+    return plans
+
+
+class QuantizedMultiLayerNetwork:
+    """Inference-only MultiLayerNetwork twin produced by
+    quantize_network(). Duck-compatible with the serving stack
+    (output / _forward / _params / _state / conf), refuses to train."""
+
+    def __init__(self, conf):
+        from deeplearning4j_tpu.ops.ndarray import resolve_dtype
+        self.conf = conf
+        self.layers = conf.layers
+        self._params = None
+        self._state = None
+        self._chain_plans = {}
+        self._compute_dtype = resolve_dtype(conf.data_type) or jnp.float32
+
+    # -- training surface: refused ----------------------------------------
+    def fit(self, *a, **kw):
+        raise RuntimeError(
+            "quantized networks are inference-only — train the fp "
+            "model (optionally with a QAT precisionPolicy) and "
+            "re-run quantize_network()")
+
+    computeGradients = fit
+    pretrain = fit
+
+    # -- forward -----------------------------------------------------------
+    def _forward(self, params, state, x, train, rng, mask=None,
+                 collect=False, stop_at=None, carries=None):
+        from deeplearning4j_tpu.nn.multilayer import (MultiLayerNetwork,
+                                                      _apply_layer,
+                                                      _hook_params)
+        if train:
+            raise RuntimeError("quantized networks are inference-only")
+        if collect or stop_at is not None or carries is not None \
+                or mask is not None or not self._chain_plans:
+            return MultiLayerNetwork._forward(
+                self, params, state, x, False, None, mask=mask,
+                collect=collect, stop_at=stop_at, carries=carries)
+        x = x.astype(self._compute_dtype)
+        new_state = dict(state)
+        preact = None
+        n = len(self.layers)
+        i = 0
+        while i < n:
+            plan = self._chain_plans.get(i)
+            if plan is not None:
+                pp = self.conf.preprocessors.get(i)
+                if pp is not None:
+                    x = pp.preProcess(x)
+                x = plan.run(params, x)
+                i = plan.exit + 1
+                continue
+            layer = self.layers[i]
+            pp = self.conf.preprocessors.get(i)
+            if pp is not None:
+                x = pp.preProcess(x)
+            p = _hook_params(layer, params.get(str(i), {}), False, None)
+            s = state.get(str(i), {})
+            if i == n - 1 and hasattr(layer, "compute_loss") \
+                    and hasattr(layer, "pre_activation"):
+                preact = layer.pre_activation(p, x)
+                from deeplearning4j_tpu.nn.activations import \
+                    get_activation
+                x = get_activation(layer.activation)(preact)
+            else:
+                x, ns = _apply_layer(layer, p, s, x, False, None, None)
+                if ns:
+                    new_state[str(i)] = ns
+            i += 1
+        return x, preact, new_state, []
+
+    def output(self, x, train=False, fmask=None):
+        from deeplearning4j_tpu.ops.ndarray import NDArray, as_jax
+        x = as_jax(x)
+        fmask = None if fmask is None else as_jax(fmask)
+        y, _, _, _ = self._forward(self._params, self._state, x, False,
+                                   None, mask=fmask)
+        return NDArray(y)
+
+    def predict(self, x):
+        out = self.output(x).numpy()
+        return np.argmax(out, axis=-1)
+
+    def summary(self):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork.summary(self)
+
+    def getnLayers(self):
+        return len(self.layers)
+
+    def getLayer(self, idx):
+        return self.layers[idx]
+
+
+# -- ComputationGraph rewrite -----------------------------------------------
+def _quantize_graph(net, data, policy, impl, fuse):
+    from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                                   ConvolutionLayer,
+                                                   DenseLayer)
+    conf = copy.deepcopy(net.conf)
+    nodes = conf.nodes
+    consumers = net.conf.consumers()
+
+    to_quant, folds = [], {}
+    skip = set()
+    for name in net.conf.topo_order:
+        node = net.conf.nodes[name]
+        if node.kind != "layer" or name in skip:
+            continue
+        layer = node.ref
+        if not _effective_policy(layer, policy).int8_servable(layer):
+            continue
+        if (type(layer) is ConvolutionLayer
+                and _is_relu_or_identity(layer.activation)
+                and str(layer.activation).lower() != "relu"):
+            outs = consumers.get(name, [])
+            if (len(outs) == 1 and name not in net.conf.output_names):
+                cand = net.conf.nodes[outs[0]]
+                if (cand.kind == "layer"
+                        and type(cand.ref) is BatchNormalization
+                        and cand.preprocessor is None
+                        and outs[0] not in net.conf.output_names):
+                    folds[name] = outs[0]
+                    skip.add(outs[0])
+        to_quant.append(name)
+
+    if not to_quant:
+        raise ValueError(
+            "quantize_network: no int8-servable layer node found "
+            f"(policy {policy!r}); nothing to quantize")
+
+    observed = None
+    if data is not None:
+        input_names = list(net.conf.input_names)
+
+        def collect(x):
+            ins = ({n: jnp.asarray(v) for n, v in x.items()}
+                   if isinstance(x, dict)
+                   else {input_names[0]: jnp.asarray(x)})
+            acts, _, _ = net._forward(net._params, net._state, ins,
+                                      False, None)
+            out = {}
+            for name in to_quant:
+                node = net.conf.nodes[name]
+                a = acts[node.inputs[0]]
+                if node.preprocessor is not None:
+                    a = node.preprocessor.preProcess(a)
+                out[name] = a
+            return out
+        observed = _cal.observe(collect, data)
+    bn_scales = {}
+    for name in to_quant:
+        parent = net.conf.nodes[net.conf.nodes[name].inputs[0]]
+        if parent.kind == "layer" \
+                and type(parent.ref) is BatchNormalization:
+            bn_scales[name] = _cal.bn_param_scale(
+                net._params.get(parent.name, {}))
+    scales = _cal.resolve_scales(to_quant, observed, bn_scales)
+
+    new_params, new_state = {}, {}
+    fallbacks = 0
+    folded_bns = set(folds.values())
+    for name in net.conf.topo_order:
+        node = net.conf.nodes[name]
+        if node.kind != "layer":
+            continue
+        layer = node.ref
+        if name in to_quant:
+            p = net._params.get(name, {})
+            x_scale, _src = scales[name]
+            if type(layer) is ConvolutionLayer:
+                bn_name = folds.get(name)
+                bn = (net.conf.nodes[bn_name].ref
+                      if bn_name is not None else None)
+                folded = _fold_conv_bn(
+                    layer, p, bn,
+                    net._params.get(bn_name, {}) if bn else None,
+                    net._state.get(bn_name, {}) if bn else None)
+                act = bn.activation if bn is not None else layer.activation
+                nodes[name].ref = QuantizedConv1x1(
+                    name, layer.nIn, layer.nOut, act,
+                    stride=layer.stride[0], impl=impl)
+                if bn_name is not None:
+                    nodes[bn_name].ref = QuantPassthrough(bn_name, name)
+            else:
+                folded = _fold_dense(layer, p)
+                nodes[name].ref = QuantizedDense(
+                    name, layer.nIn, layer.nOut, layer.activation,
+                    layer.hasBias, impl=impl)
+            new_params[name] = _finish_params(folded, x_scale)
+        elif name in folded_bns:
+            pass
+        else:
+            if net._params.get(name):
+                new_params[name] = jax.tree_util.tree_map(
+                    jnp.copy, net._params[name])
+            if net._state.get(name):
+                new_state[name] = jax.tree_util.tree_map(
+                    jnp.copy, net._state[name])
+            if isinstance(layer, (DenseLayer, ConvolutionLayer)) \
+                    and not hasattr(layer, "compute_loss"):
+                fallbacks += 1
+    # parameterized vertices keep their params too
+    for name in net.conf.topo_order:
+        node = net.conf.nodes[name]
+        if node.kind == "vertex" and net._params.get(name):
+            new_params[name] = jax.tree_util.tree_map(
+                jnp.copy, net._params[name])
+
+    _count_quant_metrics(len(to_quant), fallbacks)
+
+    plans = []
+    eff_impl = _default_impl() if impl == "auto" else impl
+    if fuse and eff_impl == "chain":
+        plans = _plan_graph_chains(conf)
+
+    q = QuantizedComputationGraph(conf)
+    q._params = new_params
+    q._state = new_state
+    q._chain_plans = {p.exit: p for p in plans}
+    q._chain_covered = {n for p in plans for n in p.out_names}
+    q._quant_stats = {"int8_layers": len(to_quant),
+                      "fallbacks": fallbacks,
+                      "folded_bns": len(folds),
+                      "chains": len(plans),
+                      "scales": dict(scales)}
+    return q
+
+
+def _plan_graph_chains(conf):
+    """Maximal single-entry/single-exit regions of chainable nodes —
+    stride-1 QuantizedConv1x1, folded-BN passthroughs, relu/identity
+    ActivationLayers, and ElementWiseVertex("add") whose residual
+    source is the region entry or an in-region value. Each region with
+    >= 2 GEMMs becomes one cache-resident tiled pipeline."""
+    from deeplearning4j_tpu.nn.conf.graph_vertices import ElementWiseVertex
+    from deeplearning4j_tpu.nn.conf.layers import ActivationLayer
+    consumers = conf.consumers()
+    nodes = conf.nodes
+
+    def chainable(name):
+        node = nodes[name]
+        if node.kind == "layer":
+            if getattr(node, "preprocessor", None) is not None:
+                return False
+            ref = node.ref
+            if isinstance(ref, QuantizedConv1x1):
+                return ref.stride == 1 and _is_relu_or_identity(
+                    ref.activation)
+            if isinstance(ref, QuantPassthrough):
+                return True
+            return (isinstance(ref, ActivationLayer)
+                    and _is_relu_or_identity(ref.activation))
+        if node.kind == "vertex":
+            return (isinstance(node.ref, ElementWiseVertex)
+                    and getattr(node.ref, "op", None) == "add"
+                    and len(node.inputs) == 2)
+        return False
+
+    assigned = set()
+    plans = []
+    topo = [n for n in conf.topo_order if nodes[n].kind != "input"]
+    for start_i, start in enumerate(topo):
+        if start in assigned or not chainable(start):
+            continue
+        if not isinstance(nodes[start].ref, QuantizedConv1x1):
+            continue
+        entry = nodes[start].inputs[0]
+        region = []
+        avail = {entry}
+        for name in topo[start_i:]:
+            if name in assigned:
+                break
+            if not chainable(name):
+                break
+            if any(p not in avail for p in nodes[name].inputs):
+                break
+            region.append(name)
+            avail.add(name)
+        # trim: every non-final node must be consumed inside the region
+        while len(region) > 1:
+            rset = set(region)
+            bad = None
+            for n in region[:-1]:
+                if any(c not in rset for c in consumers.get(n, ())) \
+                        or n in conf.output_names:
+                    bad = n
+                    break
+            if bad is None and region[-1] not in conf.output_names:
+                break
+            region = region[:region.index(bad) + 1] if bad is not None \
+                else region[:-1]
+        plan = _steps_for_region(conf, region, entry)
+        if plan is not None:
+            plans.append(plan)
+            assigned.update(plan.out_names)
+    return plans
+
+
+def _steps_for_region(conf, region, entry):
+    """Compile a region's nodes into executor steps; None when the
+    region is too small (< 2 GEMMs) or an add's source cannot be
+    expressed as an in-region tap."""
+    from deeplearning4j_tpu.nn.conf.layers import ActivationLayer
+    nodes = conf.nodes
+    steps, keys, taps = [], [], set()
+    # node_step: node name -> the executor step index producing its
+    # value ("entry" = the run's input). cur_step tracks the value the
+    # executor's running `cur` holds — only steps advance it, aliases
+    # (passthroughs, identity activations) don't.
+    node_step = {entry: "entry"}
+    cur_step = "entry"
+    for name in region:
+        ref = nodes[name].ref if nodes[name].kind == "layer" else None
+        if isinstance(ref, QuantizedConv1x1):
+            if node_step.get(nodes[name].inputs[0]) != cur_step:
+                return None   # chain must consume the running value
+            steps.append(("gemm", name,
+                          "relu" if str(ref.activation).lower() == "relu"
+                          else "identity"))
+            keys.append(name)
+            cur_step = node_step[name] = len(steps) - 1
+        elif isinstance(ref, QuantPassthrough):
+            node_step[name] = node_step[nodes[name].inputs[0]]
+        elif isinstance(ref, ActivationLayer):
+            if str(ref.activation).lower() == "relu":
+                if node_step.get(nodes[name].inputs[0]) != cur_step:
+                    return None
+                steps.append(("relu",))
+                cur_step = node_step[name] = len(steps) - 1
+            else:
+                node_step[name] = node_step[nodes[name].inputs[0]]
+        else:   # ElementWiseVertex add
+            p1, p2 = nodes[name].inputs
+            s1, s2 = node_step.get(p1), node_step.get(p2)
+            if s1 == cur_step:
+                src = s2
+            elif s2 == cur_step:
+                src = s1
+            else:
+                return None
+            if src is None:
+                return None
+            if src == "entry":
+                steps.append(("add", None, True))
+            else:
+                steps.append(("add", src, False))
+                taps.add(src)
+            cur_step = node_step[name] = len(steps) - 1
+    if len(keys) < 2:
+        return None
+    if node_step.get(region[-1]) != cur_step:
+        return None   # exit must BE the running value
+    return _ChainPlan(entry=entry, exit_=region[-1], steps=steps,
+                      keys=keys, taps=taps, in_key=keys[0],
+                      out_names=tuple(region))
+
+
+class QuantizedComputationGraph:
+    """Inference-only ComputationGraph twin produced by
+    quantize_network(). Duck-compatible with the serving stack
+    (output / outputSingle / _forward / conf), refuses to train."""
+
+    def __init__(self, conf):
+        from deeplearning4j_tpu.ops.ndarray import resolve_dtype
+        self.conf = conf
+        self.nodes = conf.nodes
+        self._params = None
+        self._state = None
+        self._chain_plans = {}
+        self._chain_covered = set()
+        self._fused_pairs = {}
+        self._fused_convs = set()
+        self._compute_dtype = resolve_dtype(conf.data_type) or jnp.float32
+
+    def fit(self, *a, **kw):
+        raise RuntimeError(
+            "quantized networks are inference-only — train the fp "
+            "model (optionally with a QAT precisionPolicy) and "
+            "re-run quantize_network()")
+
+    computeGradients = fit
+
+    # -- forward -----------------------------------------------------------
+    def _forward(self, params, state, inputs, train, rng, fmasks=None,
+                 want=None, carries=None):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        if train:
+            raise RuntimeError("quantized networks are inference-only")
+        masked = fmasks and any(m is not None for m in fmasks.values())
+        if carries is not None or masked or want == "all" \
+                or not self._chain_plans:
+            return ComputationGraph._forward(
+                self, params, state, inputs, False, None, fmasks, want,
+                carries)
+        acts = {name: x.astype(self._compute_dtype)
+                for name, x in inputs.items()}
+        preacts = {}
+        new_state = dict(state)
+        rng_index = self._rng_index
+        for name in self.conf.topo_order:
+            if self.nodes[name].kind == "input" \
+                    or name in self._chain_covered:
+                plan = self._chain_plans.get(name)
+                if plan is not None:
+                    acts[name] = plan.run(params, acts[plan.entry])
+                continue
+            ComputationGraph._run_node_plain(
+                self, name, params, state, acts, new_state, preacts,
+                None, rng_index, train=False)
+        return acts, preacts, new_state
+
+    @property
+    def _rng_index(self):
+        idx, li = {}, 0
+        for name in self.conf.topo_order:
+            if self.nodes[name].kind == "layer":
+                idx[name] = li
+                li += 1
+        return idx
+
+    def _as_input_dict(self, inputs):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        return ComputationGraph._as_input_dict(self, inputs)
+
+    def output(self, *inputs, train=False, fmasks=None):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        return ComputationGraph.output(self, *inputs, train=False,
+                                       fmasks=fmasks)
+
+    def outputSingle(self, *inputs):
+        out = self.output(*inputs)
+        return out[0] if isinstance(out, list) else out
+
+    def feedForward(self, inputs, train=False):
+        from deeplearning4j_tpu.ops.ndarray import NDArray
+        ins = self._as_input_dict(inputs)
+        acts, _, _ = self._forward(self._params, self._state, ins,
+                                   False, None, want="all")
+        return {k: NDArray(v) for k, v in acts.items()}
+
+    def summary(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        return ComputationGraph.summary(self)
+
+    def getLayer(self, name):
+        return self.nodes[name].ref
+
+
+# -- entry point ------------------------------------------------------------
+def quantize_network(net, data=None, policy=None, impl="auto",
+                     fuse=True):
+    """Rewrite a trained network for int8 serving.
+
+    net: MultiLayerNetwork or ComputationGraph (init()ed / trained).
+    data: optional iterable of feature batches (arrays, or input dicts
+        for multi-input graphs) for observed-absmax calibration of
+        activation scales; without it, scales come from upstream BN
+        statistics where available, else a conservative default.
+    policy: PrecisionPolicy (defaults to the net conf's inherited
+        precisionPolicy, else PrecisionPolicy.int8()).
+    impl: "auto" | "dot" | "chain" — see module docstring.
+    fuse: allow the cache-resident chain executor over runs of
+        quantized pointwise layers (chain impl only).
+
+    Returns the inference-only quantized twin; the original net is
+    untouched (params are copied, never aliased — the source net's
+    donated train buffers stay its own)."""
+    if policy is None:
+        policy = (getattr(net.conf, "defaults", {}) or {}).get(
+            "precisionPolicy") or PrecisionPolicy.int8()
+    if impl not in ("auto", "dot", "chain"):
+        raise ValueError(f"impl must be auto|dot|chain, got {impl!r}")
+    if net._params is None:
+        raise ValueError("quantize_network needs an init()ed network")
+    if hasattr(net, "outputSingle"):
+        q = _quantize_graph(net, data, policy, impl, fuse)
+    else:
+        q = _quantize_multilayer(net, data, policy, impl, fuse)
+    if _mon.enabled():
+        # the diet is observable: publish the per-model activation-
+        # traffic estimate under the new precision widths. The label
+        # needs a MODEL identity, not a class name — two quantized
+        # nets of the same class must not overwrite each other's
+        # gauge — so it carries the trace fingerprint.
+        from deeplearning4j_tpu.quantize import traffic as _traffic
+        from deeplearning4j_tpu.runtime.executables import \
+            model_fingerprint
+        _traffic.publish(
+            q, model_name=(f"{type(net).__name__}:"
+                           f"{model_fingerprint(q)[:8]}"))
+    return q

@@ -1,0 +1,45 @@
+"""int8 KV-cache codec for the generation decode path.
+
+Steady-state decode traffic is dominated by reading the whole K/V cache
+once per token (the single-query attention is a GEMV — pure bandwidth).
+Storing the cache int8 with one float32 scale per (head, position) row
+cuts that read to ~¼ (bf16 caches: ~½) at a per-row quantization error
+attention's softmax largely absorbs — the PR 8 decode tests hold the
+int8 token stream to the fp stream within tolerance.
+
+Layout: alongside each `(..., C, D)` cache tensor rides a `(..., C)`
+float32 scale tensor — "per-head scales": every head quantizes each of
+its cached rows against that row's own absmax, so one outlier head (or
+one outlier position) cannot crush the resolution of the rest.
+
+Dequantization happens INSIDE `flash_attention_decode` (the scales ride
+into the attention contraction as epilogue multipliers — for the score
+pass the row scale folds onto the logits, for the value pass it folds
+onto the softmax weights), so no dequantized fp copy of the cache is
+ever materialized in HBM.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.quantize.core import INT8_MAX
+
+__all__ = ["quantize_rows", "dequantize_rows"]
+
+
+def quantize_rows(x):
+    """Per-row symmetric int8: x (..., D) → (q int8 (..., D), scale f32
+    (...,)) with scale = absmax(row)/127 (all-zero rows get scale 1 so
+    they round-trip to zeros)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / INT8_MAX, 1.0)
+    q = jnp.round(xf / scale[..., None])
+    q = jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_rows(q, scale, dtype=jnp.float32):
+    """Inverse of quantize_rows (materializing — prefer the fused
+    in-attention dequant on the hot path)."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)

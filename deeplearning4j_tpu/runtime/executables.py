@@ -407,25 +407,89 @@ class _AotStoreBase:
                           help="wall time of live serving compiles") \
                .observe(dt)
         e = _Entry(compiled, "compile")
-        if path is not None:
-            self._persist(key, path, compiled)
+        if path is not None and self._persist(key, path,
+                                              compiled) == "broken":
+            # A compile served from jax's persistent kernel cache
+            # serializes an INCOMPLETE payload on XLA:CPU (the object
+            # code is not re-embedded: "Symbols not found" at reload —
+            # the round-trip check in _persist catches it in-process).
+            # Force ONE fresh compile outside that cache and persist
+            # it, so a restarted replica really does warm from disk
+            # with zero compiles instead of silently degrading. Only
+            # the broken-payload signature retries: a backend that
+            # cannot serialize at all (or a failing write) keeps the
+            # old count-and-move-on behavior — recompiling would buy
+            # nothing there.
+            fresh = self._compile_uncached(lower_fn)
+            if fresh is not None \
+                    and self._persist(key, path, fresh) is True:
+                e = _Entry(fresh, "compile")
         return e
 
+    @staticmethod
+    def _compile_uncached(lower_fn):
+        """Really recompile, bypassing BOTH jax compile caches.
+        Two latches have to be broken: the in-memory compilation LRU
+        would hand back the very same symbol-less executable without
+        compiling at all (jax.clear_caches()), and jax latches its
+        is-persistent-cache-used verdict process-globally, so the
+        enable_compilation_cache(False) scope only takes effect after
+        a reset_cache(); reset again afterwards so the next unrelated
+        compile re-evaluates back to enabled. Cost: a process-wide
+        jit-cache flush — acceptable on this path, which only runs at
+        store warmup when a broken payload was already detected (later
+        retraces recompile against the still-warm kernel cache)."""
+        try:
+            from jax._src import compilation_cache as _cc
+            from jax._src.config import enable_compilation_cache
+            try:
+                with enable_compilation_cache(False):
+                    _cc.reset_cache()
+                    jax.clear_caches()
+                    return lower_fn().compile()
+            finally:
+                _cc.reset_cache()
+        except Exception:  # noqa: BLE001 — keep the cached compile
+            return None
+
     def _persist(self, key, path, compiled):
+        """Serialize + verify + write one entry. Returns True when the
+        entry was written, "broken" when serialization produced an
+        UNLOADABLE payload (the deserialize_and_load round-trip failed
+        — the kernel-cache incomplete-payload signature, worth a fresh
+        recompile), or False when the backend cannot serialize / the
+        write failed (nothing a recompile would change). An unloadable
+        payload is never written to disk."""
         try:
             from jax.experimental import serialize_executable as _se
             blob = _se.serialize(compiled)
+        except Exception:  # noqa: BLE001 — backend may not serialize
+            self._count_serialize_failure()
+            return False
+        try:
+            # round-trip check: deserialization failures surface HERE,
+            # at persist time, not as a mystery on the next replica
+            _se.deserialize_and_load(*blob)
+        except Exception:  # noqa: BLE001 — incomplete payload
+            self._count_serialize_failure()
+            return "broken"
+        try:
             rec = {"meta": self._meta(), "key": key, "blob": blob}
             os.makedirs(os.path.dirname(path), exist_ok=True)
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "wb") as f:
                 pickle.dump(rec, f)
             os.replace(tmp, path)   # atomic: readers see whole files
-        except Exception:  # noqa: BLE001 — backend may not serialize
-            self.stats["serialize_failures"] += 1
-            self._count(_mon.EXEC_SERIALIZE_FAILURES,
-                        "serving executables that could not be "
-                        "serialized to disk (in-process cache only)")
+            return True
+        except Exception:  # noqa: BLE001 — unwritable cache dir
+            self._count_serialize_failure()
+            return False
+
+    def _count_serialize_failure(self):
+        self.stats["serialize_failures"] += 1
+        self._count(_mon.EXEC_SERIALIZE_FAILURES,
+                    "serving executables that could not be "
+                    "serialized to disk (in-process cache only)")
 
     def status(self):
         return {"kind": self.kind,

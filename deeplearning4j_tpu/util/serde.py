@@ -28,6 +28,10 @@ _CONFIG_MODULES = [
     "deeplearning4j_tpu.nn.conf.graph_vertices",
     "deeplearning4j_tpu.nn.updaters",
     "deeplearning4j_tpu.nn.schedules",
+    # precision policies ride on layer confs (QAT), and quantized
+    # layer confs replace trained layers after quantize_network()
+    "deeplearning4j_tpu.quantize.policy",
+    "deeplearning4j_tpu.quantize.infer",
 ]
 
 

@@ -59,6 +59,12 @@ HOT_MODULES = [
     "deeplearning4j_tpu/generation/server.py",
     "deeplearning4j_tpu/generation/decode.py",
     "deeplearning4j_tpu/generation/sampling.py",
+    # quantized inference: the rewritten layers' apply() and the chain
+    # executor run inside every served forward — registry calls belong
+    # to the rewrite/calibration cold path only
+    "deeplearning4j_tpu/quantize/core.py",
+    "deeplearning4j_tpu/quantize/infer.py",
+    "deeplearning4j_tpu/quantize/kvcache.py",
 ]
 
 # -- serving steady-state lint --------------------------------------------
@@ -90,6 +96,11 @@ GENERATION_MODULES = [
     "deeplearning4j_tpu/generation/decode.py",
     "deeplearning4j_tpu/generation/sampling.py",
     "deeplearning4j_tpu/runtime/executables.py",
+    # the int8 KV-cache codec runs INSIDE the decode step (quantize the
+    # new K/V row, dequant-in-attention) — it must obey the same
+    # no-trace / no-host-sync rules as the rest of the loop
+    "deeplearning4j_tpu/quantize/kvcache.py",
+    "deeplearning4j_tpu/quantize/core.py",
 ]
 #: decode-loop entry points (GenerationServer hot methods)
 GENERATION_ROOTS = {"_step_once", "_admit_pending", "_admit_one",
