@@ -35,11 +35,44 @@ serialized — a restarted replica warms from disk):
 - ``("grow_to_<C'>", C)`` — pad the KV cache from rung C to C' when an
   admission needs more room than the current rung (never shrinks
   mid-flight; recurrent carry state is rung-independent).
+- ``("advance_key_n",)`` — advance one rng key past n consumed
+  sampling splits in a single dispatch (the crash-replay
+  continuation-key derivation).
 
-Resilience: admission rides the same bounded-enqueue/shed semantics as
-`ParallelInference` (`InferenceOverloadedError`, enqueue timeout); a
-decode-loop failure fails the affected requests, resets the device
-state, and keeps serving.
+Survivability (the serving twin of the PR 5/7 training guardian):
+
+- **Crash-replay.** Every admitted request carries a host-side journal
+  (`_SlotJournal`: admission id → rng key derivation; the prompt,
+  sampling config, and delivered tokens already live on the request —
+  the per-step journal append IS the existing sampled-token fetch, so
+  it costs nothing extra). A decode-loop failure no longer fails the
+  in-flight batch: the state is rebuilt from the warm executable set
+  and every surviving request is RE-ADMITTED — by re-prefilling
+  prompt+generated-prefix with the admission key advanced past the
+  consumed splits when the prefix fits a prompt bucket, else by
+  re-generating the prefix from the original admission state with
+  delivery suppressed. Either way the continuation stream is
+  bit-identical to an uninterrupted run, because per-slot keys make
+  every stream a pure function of its admission state (chaos-tested).
+- **Supervised restart.** A failed recovery no longer latches the
+  server dead: a supervisor retries the rebuild+replay from the warm
+  `FunctionStore` (zero live compiles) under a bounded `RetryPolicy`;
+  only an exhausted budget — or sustained zero forward progress —
+  latches the typed `ServerDeadError`, which is pushed to every open
+  stream immediately so no consumer waits out its timeout.
+- **Memory-pressure degradation ladder.** An OOM-classified failure
+  (or a `monitoring/memory.py` high-water reading) degrades stepwise
+  instead of killing serving: (1) refuse further cache growth, (2)
+  also shed queued admissions, (3) shrink to a smaller pre-compiled
+  rung — in-flight requests replay into it, requests that no longer
+  fit fail with the typed `MemoryPressureError`. Pressure decays after
+  a clean stretch of steps. Events count `dl4j.gen.degradations`;
+  replays and restarts count `dl4j.gen.{replays,restarts}`.
+
+Admission rides the same bounded-enqueue/shed semantics as
+`ParallelInference` (`InferenceOverloadedError`, enqueue timeout).
+Chaos fault sites: `generation.step`, `generation.admit`, `cache.grow`
+(resilience/faults.py) fire inside the loop at zero disabled-path cost.
 """
 from __future__ import annotations
 
@@ -52,9 +85,18 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from deeplearning4j_tpu import monitoring as _mon
-from deeplearning4j_tpu.generation.sampling import method_id, sample_step
+from deeplearning4j_tpu.generation.sampling import (method_id,
+                                                    sample_step,
+                                                    split_keys)
+from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience.errors import (MemoryPressureError,
+                                                  ReplayDivergedError,
+                                                  ServerDeadError)
+from deeplearning4j_tpu.resilience.policy import RetryPolicy
+from deeplearning4j_tpu.util.crash_reporting import CrashReportingUtil
 
 __all__ = ["GenerationRequest", "GenerationServer", "status"]
 
@@ -119,7 +161,9 @@ class GenerationRequest:
     def stream(self, timeout=None):
         """Yield tokens as they are generated (ends at EOS/length).
         `timeout` bounds the wait per token (TimeoutError on expiry,
-        matching result())."""
+        matching result()). A server death pushes the terminal error
+        sentinel immediately — consumers raise promptly, they never
+        wait out the timeout on a dead decode loop."""
         while True:
             try:
                 tok = self._stream.get(timeout=timeout)
@@ -134,6 +178,26 @@ class GenerationRequest:
             yield tok
 
 
+class _SlotJournal:
+    """Host-side crash-replay journal for one admitted request.
+
+    `admit_id` (the admission counter value) derives the slot's rng
+    key; the prompt, sampling config, and delivered tokens live on the
+    request itself — together they make the token stream a pure
+    function of this record, which is exactly what `_replay_one` needs
+    to continue an interrupted request bit-identically. While a
+    re-generation replay is in flight, `expect` holds the
+    already-delivered prefix and `replay_idx` the suppression cursor."""
+
+    __slots__ = ("req", "admit_id", "expect", "replay_idx")
+
+    def __init__(self, req, admit_id):
+        self.req = req
+        self.admit_id = admit_id
+        self.expect = None
+        self.replay_idx = 0
+
+
 class GenerationServer:
     """Continuous-batching KV-cache decode server over one model.
 
@@ -141,13 +205,25 @@ class GenerationServer:
     RecurrentDecoder) or a recurrent `MultiLayerNetwork` (wrapped
     automatically). `slots` is the decode batch bucket; `cache_lengths`
     the cache rungs (prompt_len + max_new_tokens must fit the top
-    rung); `prompt_buckets` the prefill length ladder."""
+    rung); `prompt_buckets` the prefill length ladder.
+
+    Survivability knobs: `restart_policy` bounds supervised restarts
+    after a failed recovery (default 3 attempts, short backoff);
+    `max_consecutive_failures` bounds crash-recover churn with zero
+    forward progress; `pressure_relief_steps` clean decode steps — or
+    `pressure_relief_secs` of wall-clock quiet, whichever first —
+    decay one memory-pressure level; `memory_high_water` (fraction of
+    device memory, None disables) proactively refuses cache growth
+    from the `monitoring/memory.py` telemetry (reported 'degraded'
+    while it lasts)."""
 
     def __init__(self, decoder, slots=4, cache_lengths=(128,),
                  prompt_buckets=None, method="greedy", temperature=1.0,
                  top_k=0, eos_id=None, max_new_tokens=64, seed=0,
                  queue_limit=256, enqueue_timeout_ms=100.0,
-                 exec_cache_dir=None):
+                 exec_cache_dir=None, restart_policy=None,
+                 max_consecutive_failures=8, pressure_relief_steps=256,
+                 pressure_relief_secs=60.0, memory_high_water=0.92):
         from deeplearning4j_tpu.generation.decode import RecurrentDecoder
         if not hasattr(decoder, "init_cache"):
             decoder = RecurrentDecoder(decoder)
@@ -186,8 +262,34 @@ class GenerationServer:
         self.default_max_new_tokens = int(max_new_tokens)
         self.seed = int(seed)
         self.enqueue_timeout = float(enqueue_timeout_ms) / 1e3
+        # a caller-supplied policy sets the budget/backoff knobs but is
+        # NEVER mutated (it may be shared with other servers/trainers):
+        # the supervisor runs a private clone whose classifier is the
+        # server's own _restartable — restart classification (retry
+        # transients AND shrinkable OOMs, refuse a dead latch) is the
+        # server's call, not the policy's
+        rp = restart_policy or RetryPolicy(
+            max_attempts=3, initial_backoff=0.02, max_backoff=0.5)
+        self.restart_policy = RetryPolicy(
+            max_attempts=rp.max_attempts,
+            initial_backoff=rp.initial_backoff,
+            max_backoff=rp.max_backoff, multiplier=rp.multiplier,
+            jitter=rp.jitter, deadline=rp.deadline, seed=self.seed,
+            sleep=rp._sleep, clock=rp._clock,
+            classifier=self._restartable)
+        self.max_consecutive_failures = int(max_consecutive_failures)
+        self.pressure_relief_steps = int(pressure_relief_steps)
+        # wall-clock decay: a server whose remaining traffic is all
+        # refused (or that idles) takes no decode steps, so step-count
+        # relief alone would leave it degraded forever after one
+        # transient OOM — elapsed quiet time relieves too
+        self.pressure_relief_secs = (None if pressure_relief_secs is None
+                                     else float(pressure_relief_secs))
+        self.memory_high_water = (None if memory_high_water is None
+                                  else float(memory_high_water))
         self.stats = {"tokens": 0, "steps": 0, "admissions": 0,
-                      "retirements": 0, "errors": 0}
+                      "retirements": 0, "errors": 0, "replays": 0,
+                      "restarts": 0, "degradations": 0}
         self.token_fetches = 0       # host syncs: ONE per decode step
         self._queue = queue.Queue(maxsize=int(queue_limit))
         self._store = None           # FunctionStore, built at warmup
@@ -196,13 +298,21 @@ class GenerationServer:
         self._margs = None           # non-donated model args
         self._state = None           # donated decode-state tuple
         self._rung = None
-        self._slot_req = {}          # slot -> (GenerationRequest, admit#)
+        self._slot_req = {}          # slot -> _SlotJournal
+        self._replaying = []         # journals awaiting re-admission
         self._free = list(range(self.slots))
         self._counter = 0            # admission counter (rng derivation)
-        self._lock = threading.Lock()
+        # RLock: recovery replays deliveries (user on_token callbacks)
+        # under the lock; a callback calling submit() must not deadlock
+        self._lock = threading.RLock()
         self._work = threading.Event()
         self._shutdown = False
-        self._dead = None            # unrecoverable decode-loop error
+        self._dead = None            # typed ServerDeadError once latched
+        self._pressure = 0           # degradation-ladder level (0..3)
+        self._rung_cap = None        # growth cap while under pressure
+        self._clean_steps = 0        # steps since the last OOM event
+        self._pressure_ts = 0.0      # monotonic time of last escalation
+        self._consecutive_failures = 0   # incidents without a delivery
         self._warm = False
         self._thread = None
         _SERVERS.add(self)
@@ -210,11 +320,11 @@ class GenerationServer:
     # -- warmup (the declared trace/compile boundary) ---------------------
     def warmup(self):
         """Build the whole closed executable set — step/retire per
-        rung, admit per (rung, prompt bucket), grow per rung pair —
-        through the two-tier FunctionStore (warm replica: deserialize,
-        no XLA compile), initialize the device decode state at the
-        smallest rung, and start the decode loop. Idempotent (and safe
-        under concurrent first submits)."""
+        rung, admit per (rung, prompt bucket), grow per rung pair, the
+        replay key-advance — through the two-tier FunctionStore (warm
+        replica: deserialize, no XLA compile), initialize the device
+        decode state at the smallest rung, and start the decode loop.
+        Idempotent (and safe under concurrent first submits)."""
         with self._lock:
             return self._warmup_locked()
 
@@ -237,6 +347,10 @@ class GenerationServer:
                        donate_argnums=self._donate_range())
         store.register("retire", self._traced_retire,
                        donate_argnums=(0, 1, 2))
+        store.register(
+            "advance_key_n",
+            lambda k, n: lax.fori_loop(
+                0, n, lambda _, kk: split_keys(kk[None])[0][0], k))
         self._margs = tuple(self.decoder.model_args())
         sds = jax.ShapeDtypeStruct
         scalar_i = sds((), jnp.int32)
@@ -274,6 +388,10 @@ class GenerationServer:
             key, (sds((self.slots,), jnp.int32),
                   sds((self.slots,), jnp.bool_),
                   sds((self.slots,), jnp.int32), scalar_i))
+        self._exes[key] = e.call
+        key = ("advance_key_n",)
+        e = store.load_or_compile(key, (sds((2,), jnp.uint32),
+                                        scalar_i))
         self._exes[key] = e.call
         self._store = store
         self._rung = self.cache_lengths[0]
@@ -358,7 +476,8 @@ class GenerationServer:
         """Queue one prompt for generation; returns a GenerationRequest
         immediately (tokens stream in as the decode loop reaches it).
         Admission is bounded: a full queue sheds with
-        InferenceOverloadedError after the enqueue timeout."""
+        InferenceOverloadedError after the enqueue timeout; a dead
+        server refuses with the latched ServerDeadError."""
         from deeplearning4j_tpu.parallel.inference import bounded_enqueue
         if not self._warm:
             self.warmup()
@@ -410,20 +529,18 @@ class GenerationServer:
             try:
                 self._admit_pending()
                 if not self._slot_req:
+                    if self._pressure:
+                        # an idle server takes no steps and may see no
+                        # growth attempts: wall-clock relief must fire
+                        # from here or /health stays degraded forever
+                        self._maybe_relieve_by_time()
                     if not self._work.wait(timeout=0.05):
                         continue
                     self._work.clear()
                     continue
                 self._step_once()
-            except Exception as e:  # noqa: BLE001 — fail reqs, stay up
-                try:
-                    self._recover(e)
-                except Exception as e2:  # noqa: BLE001 — recovery
-                    # itself failed (e.g. the state re-allocation hit
-                    # the same OOM): a silent thread death would hang
-                    # every future result() — mark the server dead so
-                    # submit() refuses and queued requests fail
-                    self._die(e2)
+            except Exception as e:  # noqa: BLE001 — replay, stay up
+                if not self._survive(e):
                     return
 
     def _admit_pending(self):
@@ -431,14 +548,15 @@ class GenerationServer:
         — one prefill dispatch each, no shape changes (a longer request
         may first GROW the cache to a pre-compiled bigger rung).
 
-        A failing admission cannot be contained to its own request:
-        the grow/admit dispatch DONATES the whole decode state, so a
-        post-donation failure leaves `self._state` pointing at freed
-        buffers (real on TPU; CPU ignores donation) — the exception
-        fails the triggering request here, then propagates so
-        `_recover` fails the in-flight batch and rebuilds the state
-        instead of letting the next step dispatch invalid buffers.
-        (Size/shape validation already happened at submit().)"""
+        Failure containment: a degradation-ladder refusal
+        (`MemoryPressureError`) is raised BEFORE any dispatch, so it
+        fails only the triggering request and admission continues. Any
+        later failure happens after the request was journaled and after
+        a donating dispatch may have poisoned `self._state` (real on
+        TPU; CPU ignores donation) — it propagates so `_survive`
+        rebuilds the state and REPLAYS every journaled request,
+        including the one whose admission crashed. (Size/shape
+        validation already happened at submit().)"""
         while self._free:
             try:
                 req = self._queue.get_nowait()
@@ -446,39 +564,25 @@ class GenerationServer:
                 return
             try:
                 self._admit_one(req)
+            except MemoryPressureError as e:
+                req._fail(e)      # pre-dispatch refusal: state intact
+                continue
             except Exception as e:  # noqa: BLE001 — see docstring
-                req._fail(e)
+                if not any(rec.req is req
+                           for rec in self._slot_req.values()):
+                    # failed before the journal was registered: nothing
+                    # will replay it — fail it so no caller hangs
+                    req._fail(e)
                 raise
 
     def _admit_one(self, req):
-        plen = int(req.prompt.size)
-        pbucket = next(p for p in self.prompt_buckets if p >= plen)
-        needed = plen + req.max_new_tokens
-        rung = self._rung
-        if needed > rung or pbucket > rung:
-            rung = next(c for c in self.cache_lengths
-                        if c >= needed and c >= pbucket)
-            call = self._exes[(f"grow_to_{rung}", self._rung)]
-            cache = call(self._state[_CACHE])
-            self._state = (cache,) + self._state[1:]
-            self._rung = rung
-        slot = self._free.pop()
+        """Fresh admission: assign the next admission id (the rng-key
+        derivation the journal replays) and dispatch."""
         self._counter += 1
-        admit_id = self._counter
-        padded = np.zeros((pbucket,), np.int32)
-        padded[:plen] = req.prompt
-        key = np.random.default_rng(
-            (self.seed, admit_id)).integers(0, 2 ** 32, size=2,
-                                            dtype=np.uint32)
+        rec = _SlotJournal(req, self._counter)
         t0 = time.perf_counter()
-        call = self._exes[("admit", rung, pbucket)]
-        out = call(*self._margs, *self._state, np.int32(slot), padded,
-                   np.int32(plen), key, np.int32(req.method),
-                   np.float32(req.temperature), np.int32(req.top_k))
-        self._state = tuple(out[:8])
-        first = int(self._fetch_tokens(out[8]))
+        self._admit_rec(rec, req.prompt, self._admit_key(rec.admit_id))
         prefill_ms = (time.perf_counter() - t0) * 1e3
-        self._slot_req[slot] = req
         self.stats["admissions"] += 1
         self.stats["tokens"] += 1     # the prefill's first sampled token
         if _mon.enabled():
@@ -494,45 +598,121 @@ class GenerationServer:
             reg.gauge(_mon.GEN_ACTIVE_SLOTS,
                       help="occupied decode slots").set(
                 len(self._slot_req))
-        self._deliver(slot, req, first)
+
+    def _admit_rec(self, rec, prompt, key):
+        """Admission dispatch shared by fresh admissions and
+        crash-replay re-admissions: gate growth through the degradation
+        ladder, JOURNAL the record before the first donating dispatch
+        (a post-donation crash re-admits it from the journal), grow if
+        needed, prefill, and deliver the first sampled token (delivery
+        is suppressed while the record replays an already-delivered
+        prefix)."""
+        req = rec.req
+        plen = int(prompt.size)
+        pbucket = next(p for p in self.prompt_buckets if p >= plen)
+        needed = int(req.prompt.size) + req.max_new_tokens
+        rung = self._rung
+        if needed > rung or pbucket > rung:
+            rung = self._rung_for(needed, pbucket)
+            self._check_growth(rung)    # raises MemoryPressureError
+        slot = self._free.pop()
+        self._slot_req[slot] = rec
+        if rung != self._rung:
+            if _faults.ACTIVE is not None:
+                _faults.ACTIVE.fire(_faults.CACHE_GROW)
+            call = self._exes[(f"grow_to_{rung}", self._rung)]
+            cache = call(self._state[_CACHE])
+            self._state = (cache,) + self._state[1:]
+            self._rung = rung
+        padded = np.zeros((pbucket,), np.int32)
+        padded[:plen] = prompt
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.GENERATION_ADMIT)
+        call = self._exes[("admit", rung, pbucket)]
+        out = call(*self._margs, *self._state, np.int32(slot), padded,
+                   np.int32(plen), key, np.int32(req.method),
+                   np.float32(req.temperature), np.int32(req.top_k))
+        self._state = tuple(out[:8])
+        first = int(self._fetch_tokens(out[8]))
+        self._deliver(slot, rec, first)
+
+    def _admit_key(self, admit_id):
+        """Per-request admission rng key: a pure function of
+        (server seed, admission id) — the identity crash-replay re-derives."""
+        return np.random.default_rng(
+            (self.seed, admit_id)).integers(0, 2 ** 32, size=2,
+                                            dtype=np.uint32)
+
+    def _rung_for(self, needed, pbucket):
+        """Smallest pre-compiled cache rung admitting a request that
+        needs `needed` rows and prefills at prompt bucket `pbucket`."""
+        return next(c for c in self.cache_lengths
+                    if c >= needed and c >= pbucket)
 
     def _step_once(self):
         """ONE token for the whole batch: a single pre-compiled
         fixed-shape dispatch; the sampled-token fetch is the only host
         sync."""
         t0 = time.perf_counter()
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.GENERATION_STEP)
         call = self._exes[("step", self._rung)]
         out = call(*self._margs, *self._state)
         self._state = tuple(out[:8])
         toks = self._fetch_tokens(out[8])
         dt_ms = (time.perf_counter() - t0) * 1e3
         served = list(self._slot_req.items())
+        # replay-suppressed slots re-generate already-delivered tokens;
+        # only live deliveries count as generated
+        live = sum(1 for _, rec in served if rec.expect is None)
         self.stats["steps"] += 1
-        self.stats["tokens"] += len(served)
+        self.stats["tokens"] += live
+        if self._pressure:
+            self._clean_steps += 1
+            if self._clean_steps >= self.pressure_relief_steps:
+                self._relieve_pressure()
         if _mon.enabled():
             reg = _mon.get_registry()
             reg.counter(_mon.GEN_TOKENS,
-                        help="tokens generated (all slots)").inc(
-                len(served))
+                        help="tokens generated (all slots)").inc(live)
             reg.histogram(_mon.GEN_PER_TOKEN_MS,
                           help="decode-step wall time (whole "
                                "batch)").observe(dt_ms)
-        for slot, req in served:
-            self._deliver(slot, req, int(toks[slot]))
+        for slot, rec in served:
+            self._deliver(slot, rec, int(toks[slot]))
 
     def _fetch_tokens(self, arr):
         """THE per-step host sync: materialize the sampled tokens.
-        Everything else stays device-resident (and donated onward)."""
+        The journal append rides this same boundary — `_deliver` stores
+        the fetched token on the request's host-side list, so
+        crash-replay costs zero extra syncs."""
         self.token_fetches += 1
         return np.asarray(arr)
 
-    def _deliver(self, slot, req, tok):
+    def _deliver(self, slot, rec, tok):
+        req = rec.req
+        if rec.expect is not None:
+            # crash-replay suppression: this token was delivered before
+            # the crash — verify the re-generated stream matches the
+            # journal and hand delivery back to the live path once the
+            # prefix is exhausted
+            if tok != rec.expect[rec.replay_idx]:
+                req.error = ReplayDivergedError(
+                    f"replayed token {tok} != journaled "
+                    f"{rec.expect[rec.replay_idx]} at position "
+                    f"{rec.replay_idx}")
+                rec.expect = None
+                self._retire_slot(slot, "error")
+                return
+            rec.replay_idx += 1
+            if rec.replay_idx >= len(rec.expect):
+                rec.expect = None
+            return
+        self._consecutive_failures = 0      # forward progress
         req._push(tok)
-        if (req.eos_id is not None and tok == req.eos_id) \
-                or len(req.tokens) >= req.max_new_tokens:
-            self._retire_slot(
-                slot, "eos" if (req.eos_id is not None
-                                and tok == req.eos_id) else "length")
+        reason = self._finished_reason(req)
+        if reason is not None:
+            self._retire_slot(slot, reason)
 
     def _retire_slot(self, slot, reason):
         """Per-sequence retirement: clear the slot's device columns
@@ -543,53 +723,357 @@ class GenerationServer:
                                    self._state[_TOKENS], np.int32(slot))
         self._state = (self._state[_CACHE], pos, active, tokens,
                        *self._state[_RNG:])
-        req = self._slot_req.pop(slot)
+        rec = self._slot_req.pop(slot)
         self._free.append(slot)
         self.stats["retirements"] += 1
+        try:
+            if _mon.enabled():
+                reg = _mon.get_registry()
+                reg.counter(_mon.GEN_RETIREMENTS,
+                            help="sequences retired (EOS or "
+                                 "length)").inc()
+                reg.gauge(_mon.GEN_ACTIVE_SLOTS,
+                          help="occupied decode slots").set(
+                    len(self._slot_req))
+        finally:
+            # once popped from the journal, the request MUST finish —
+            # a failure above would otherwise leave it unreplayable
+            # and its consumer hung forever
+            rec.req._finish(reason)
+
+    # -- survivability: crash-replay, supervision, degradation -----------
+    def _survive(self, exc):
+        """Decode-loop failure: crash-replay recovery first (journal →
+        rebuild → re-admit), then supervised restarts under the
+        RetryPolicy budget. OOM-classified failures escalate the
+        memory-pressure ladder before the rebuild. Returns False when
+        the server latched dead (the loop must exit)."""
+        self.stats["errors"] += 1
+        self._consecutive_failures += 1
+        if self._consecutive_failures > self.max_consecutive_failures:
+            self._die(exc, reason=(
+                f"no forward progress after "
+                f"{self._consecutive_failures} consecutive "
+                f"decode-loop failures"))
+            return False
+        if CrashReportingUtil.is_oom(exc):
+            self._note_memory_pressure(exc)
+        try:
+            self._recover(exc)
+            return True
+        except Exception as e2:  # noqa: BLE001 — supervisor takes over
+            return self._supervised_restart(e2)
+
+    def _recover(self, exc=None):
+        """Crash-replay recovery: every in-flight journal moves to the
+        replay-pending set (the donated device state is presumed
+        poisoned mid-dispatch), the decode state is rebuilt at the
+        smallest rung from the warm executable set, and each surviving
+        request is re-admitted with its continuation bit-identical to
+        an uninterrupted run. Raises when the rebuild/replay itself
+        fails — the supervisor retries; pending journals survive the
+        retry because re-admission is idempotent from the journal."""
+        with self._lock:
+            if self._shutdown or self._dead is not None:
+                return
+            for rec in self._slot_req.values():
+                if rec not in self._replaying:
+                    self._replaying.append(rec)
+            self._slot_req.clear()
+            self._free = list(range(self.slots))
+            self._replaying.sort(key=lambda r: r.admit_id)
+            self._rung = self.cache_lengths[0]
+            self._state = self._init_state(self._rung)
+            while self._replaying:
+                rec = self._replaying[0]
+                if rec.req.done():
+                    self._replaying.pop(0)
+                    continue
+                reason = self._finished_reason(rec.req)
+                if reason is not None:
+                    # the final token was already delivered and only
+                    # the RETIREMENT was lost to the crash: finish the
+                    # request instead of replaying it — a replay would
+                    # generate past EOS / max_new_tokens
+                    rec.req._finish(reason)
+                    self._replaying.pop(0)
+                    continue
+                try:
+                    self._replay_one(rec)
+                except MemoryPressureError as e:
+                    # pre-dispatch refusal (no longer fits the capped
+                    # rung): fail this request, keep replaying the rest
+                    rec.req._fail(e)
+                    self._replaying.pop(0)
+                    continue
+                self._replaying.pop(0)
+
+    def _replay_one(self, rec):
+        """Re-admit one journaled request. Preferred path: re-prefill
+        prompt+generated-prefix in ONE dispatch, with the admission key
+        advanced past the consumed sampling splits — the next sampled
+        token continues the stream exactly (decode-exactness makes the
+        prefill logits equal the uninterrupted step's). When the prefix
+        outgrows the prompt-bucket ladder, fall back to re-generating
+        it from the original admission state with delivery suppressed —
+        per-slot keys make both paths bit-identical continuations."""
+        req = rec.req
+        g = len(req.tokens)
+        plen = int(req.prompt.size)
+        needed = plen + req.max_new_tokens
+        use_prefix = g and plen + g <= self.prompt_buckets[-1]
+        if use_prefix:
+            # the longer prefix bucket must not force a bigger cache
+            # rung than the request itself needs — a crash must never
+            # inflate memory (or trip the pressure cap) versus the
+            # uninterrupted run; otherwise re-generate instead
+            pb_prefix = next(p for p in self.prompt_buckets
+                             if p >= plen + g)
+            pb_orig = next(p for p in self.prompt_buckets
+                           if p >= plen)
+            use_prefix = (self._rung_for(needed, pb_prefix)
+                          == self._rung_for(needed, pb_orig))
+        if use_prefix:
+            prefix = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)])
+            key = self._advance_key(self._admit_key(rec.admit_id), g)
+            rec.expect = None
+            rec.replay_idx = 0
+            self._admit_rec(rec, prefix, key)
+            live_first = True       # the prefill sampled a NEW token
+        else:
+            rec.expect = list(req.tokens) or None
+            live_first = rec.expect is None   # g == 0: first-ever token
+            rec.replay_idx = 0
+            self._admit_rec(rec, req.prompt,
+                            self._admit_key(rec.admit_id))
+        self.stats["replays"] += 1
+        if live_first:
+            self.stats["tokens"] += 1
         if _mon.enabled():
             reg = _mon.get_registry()
-            reg.counter(_mon.GEN_RETIREMENTS,
-                        help="sequences retired (EOS or length)").inc()
+            reg.counter(_mon.GEN_REPLAYS,
+                        help="in-flight requests re-admitted by "
+                             "crash-replay").inc()
+            if live_first:
+                reg.counter(_mon.GEN_TOKENS,
+                            help="tokens generated (all slots)").inc()
             reg.gauge(_mon.GEN_ACTIVE_SLOTS,
                       help="occupied decode slots").set(
                 len(self._slot_req))
-        req._finish(reason)
 
-    def _recover(self, exc):
-        """A decode-loop failure fails the in-flight requests and
-        resets the device state (the donated buffers may be gone
-        mid-dispatch) — the server keeps serving new submissions."""
-        self.stats["errors"] += 1
-        with self._lock:
-            for slot, req in list(self._slot_req.items()):
-                req._fail(exc)
-            self._slot_req.clear()
-            self._free = list(range(self.slots))
-            self._rung = self.cache_lengths[0]
-            self._state = self._init_state(self._rung)
+    @staticmethod
+    def _finished_reason(req):
+        """The finish reason a delivered-but-unretired request should
+        get ("eos" / "length"), or None while it still needs tokens —
+        the guard that keeps crash-replay from continuing a stream
+        whose terminal token already reached the consumer."""
+        if req.tokens and req.eos_id is not None \
+                and req.tokens[-1] == req.eos_id:
+            return "eos"
+        if len(req.tokens) >= req.max_new_tokens:
+            return "length"
+        return None
 
-    def _die(self, exc):
-        """Unrecoverable: record the cause, refuse future submits, and
-        fail everything queued or in flight so no caller hangs on a
-        server whose decode thread is gone."""
-        err = RuntimeError(
-            f"GenerationServer decode loop died: {exc!r}")
-        err.__cause__ = exc
-        with self._lock:
-            self._dead = err
-            for _, req in list(self._slot_req.items()):
-                req._fail(err)
-            self._slot_req.clear()
+    def _advance_key(self, key, n):
+        """Advance an admission key past `n` consumed sampling splits —
+        the replay-prefill continuation key. ONE dispatch of the
+        pre-compiled `("advance_key_n",)` executable (n is a traced
+        scalar), so replay performs zero live compiles and O(1)
+        dispatches however long the delivered prefix."""
+        return self._exes[("advance_key_n",)](key, np.int32(n))
+
+    def _supervised_restart(self, exc):
+        """Recovery failed: retry the rebuild+replay from the warm
+        FunctionStore under the bounded RetryPolicy. The typed
+        ServerDeadError latch only engages once the budget is
+        exhausted (or the failure is classified unrestartable)."""
+
+        def on_retry(attempt, e):
+            self._count_restart()
+            if CrashReportingUtil.is_oom(e):
+                self._note_memory_pressure(e)
+
+        self._count_restart()
+        try:
+            self.restart_policy.call(self._recover, on_retry=on_retry,
+                                     label="generation-server restart")
+            return True
+        except Exception as final:  # noqa: BLE001 — budget exhausted
+            self._die(final, reason="supervised restart budget "
+                                    "exhausted")
+            return False
+
+    def _restartable(self, exc):
+        """Restart classifier: anything is worth a bounded restart
+        except a latched death, or an OOM once the degradation ladder
+        has no smaller rung left to shrink into (another allocation
+        attempt at the same size cannot help)."""
+        if isinstance(exc, ServerDeadError):
+            return False
+        if CrashReportingUtil.is_oom(exc):
+            if self._pressure < 3:
+                return True
+            cap = self._rung_cap or self.cache_lengths[-1]
+            return any(c < cap for c in self.cache_lengths)
+        return True
+
+    def _count_restart(self):
+        self.stats["restarts"] += 1
+        if _mon.enabled():
+            _mon.get_registry().counter(
+                _mon.GEN_RESTARTS,
+                help="supervised decode-loop restarts from the warm "
+                     "FunctionStore").inc()
+
+    # -- memory-pressure degradation ladder -------------------------------
+    def _note_memory_pressure(self, exc):
+        """Escalate the ladder one level: 1 = refuse cache growth past
+        the current rung, 2 = also shed every queued admission, 3 =
+        shrink the cap one pre-compiled rung (in-flight requests replay
+        into it; ones that no longer fit fail typed). Keeps a
+        `monitoring/memory.py` telemetry reading for OOM forensics."""
+        self._clean_steps = 0
+        self._pressure_ts = time.monotonic()
+        if self._pressure == 0 or self._rung_cap is None:
+            self._rung_cap = self._rung
+        self._pressure = min(3, self._pressure + 1)
+        action = ("refuse_growth", "shed_queue",
+                  "shrink")[self._pressure - 1]
+        if self._pressure >= 2:
+            self._shed_queue(exc)
+        if self._pressure >= 3:
+            smaller = [c for c in self.cache_lengths
+                       if c < self._rung_cap]
+            if smaller:
+                self._rung_cap = smaller[-1]
+            else:
+                # no smaller pre-compiled rung: the ladder is out of
+                # moves — say so instead of reporting a phantom shrink
+                action = "at_floor"
+        self._count_degradation(action)
+        if _mon.enabled():
+            try:
+                from deeplearning4j_tpu.monitoring import memory as _mem
+                _mem.sample()
+            except Exception:  # noqa: BLE001 — telemetry best-effort
+                pass
+
+    def _relieve_pressure(self):
+        """A clean stretch of decode steps — or of wall-clock quiet —
+        decays one pressure level; back at level 0 the growth cap
+        lifts entirely."""
+        self._clean_steps = 0
+        self._pressure_ts = time.monotonic()
+        self._pressure = max(0, self._pressure - 1)
+        if self._pressure == 0:
+            self._rung_cap = None
+
+    def _maybe_relieve_by_time(self):
+        """Wall-clock decay: re-evaluated on every growth attempt, so
+        pressure lifts even when the remaining traffic is all refused
+        (no decode steps run, the step-count relief never fires)."""
+        if self._pressure and self.pressure_relief_secs is not None \
+                and (time.monotonic() - self._pressure_ts
+                     >= self.pressure_relief_secs):
+            self._relieve_pressure()
+
+    def _check_growth(self, target):
+        """Degradation-ladder gate on cache growth — PRE-dispatch, so a
+        refusal is contained to the triggering request. Refuses past
+        the pressure cap, and proactively when the live device-memory
+        telemetry is already past the high-water mark (which also
+        reports the server 'degraded' on /health while it lasts)."""
+        self._maybe_relieve_by_time()
+        if self._rung_cap is not None and target > self._rung_cap:
+            self._count_degradation("refuse_growth")
+            raise MemoryPressureError(
+                f"cache growth to rung {target} refused: the "
+                f"memory-pressure ladder caps the cache at rung "
+                f"{self._rung_cap} (pressure level {self._pressure})")
+        if self.memory_high_water is not None:
+            from deeplearning4j_tpu.monitoring import memory as _mem
+            for stats in _mem.device_memory_stats().values():
+                if not stats:
+                    continue
+                used = stats.get("bytes_in_use")
+                limit = stats.get("bytes_limit")
+                if used and limit \
+                        and used / limit > self.memory_high_water:
+                    # telemetry-driven refusals are a degradation too:
+                    # /health must say 'degraded' while the replica is
+                    # systematically refusing growth, not 'ok'. No cap
+                    # is set — growth resumes the moment the telemetry
+                    # clears, and the pressure level decays on its own
+                    self._pressure = max(self._pressure, 1)
+                    self._pressure_ts = time.monotonic()
+                    self._clean_steps = 0   # fresh pressure evidence
+                    self._count_degradation("refuse_growth")
+                    raise MemoryPressureError(
+                        f"cache growth to rung {target} refused: "
+                        f"device memory at {used / limit:.0%} of limit "
+                        f"exceeds the {self.memory_high_water:.0%} "
+                        f"high-water mark")
+
+    def _shed_queue(self, cause):
+        """Ladder level 2: fail every queued (not-yet-admitted) request
+        typed instead of admitting into a memory-starved batch."""
+        shed = 0
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            err = MemoryPressureError(
+                "queued admission shed under memory pressure")
+            err.__cause__ = cause
+            req._fail(err)
+            shed += 1
+        return shed
+
+    def _count_degradation(self, action):
+        self.stats["degradations"] += 1
+        if _mon.enabled():
+            _mon.get_registry().counter(
+                _mon.GEN_DEGRADATIONS, labels={"action": action},
+                help="memory-pressure degradation-ladder events").inc()
+
+    def _fail_open_requests(self, err):
+        """Push the terminal error sentinel to every in-flight and
+        replay-pending request (caller holds the lock; already-finished
+        requests keep their results) and clear both collections."""
+        for rec in list(self._slot_req.values()):
+            if not rec.req.done():
+                rec.req._fail(err)
+        self._slot_req.clear()
+        for rec in self._replaying:
+            if not rec.req.done():
+                rec.req._fail(err)
+        self._replaying.clear()
+
+    def _drain_queue(self, err):
         while True:
             try:
                 self._queue.get_nowait()._fail(err)
             except queue.Empty:
                 return
 
+    def _die(self, cause, reason="decode loop died"):
+        """Terminal: latch the typed ServerDeadError, refuse future
+        submits, and push the error sentinel to EVERY open request —
+        in-flight, replay-pending, and queued — immediately, so no
+        stream consumer waits out its timeout on a dead server."""
+        err = ServerDeadError(f"GenerationServer {reason}: {cause!r}")
+        err.__cause__ = cause
+        with self._lock:
+            self._dead = err
+            self._fail_open_requests(err)
+        self._drain_queue(err)
+
     # -- lifecycle / status ----------------------------------------------
     def shutdown(self):
-        """Idempotent: stops the decode loop; in-flight and queued
-        requests fail with a RuntimeError."""
+        """Idempotent: stops the decode loop; in-flight, replay-pending,
+        and queued requests fail with a RuntimeError."""
         with self._lock:
             if self._shutdown:
                 return
@@ -602,14 +1086,8 @@ class GenerationServer:
         # lock (raised) or enqueued before we took it above — so after
         # this drain the queue stays empty forever
         with self._lock:
-            for _, req in list(self._slot_req.items()):
-                req._fail(err)
-            self._slot_req.clear()
-            while True:
-                try:
-                    self._queue.get_nowait()._fail(err)
-                except queue.Empty:
-                    break
+            self._fail_open_requests(err)
+            self._drain_queue(err)
 
     def __enter__(self):
         self.warmup()
@@ -617,6 +1095,28 @@ class GenerationServer:
 
     def __exit__(self, *exc):
         self.shutdown()
+
+    def serving_state(self):
+        """Compact survivability view for `GET /health`
+        (resilience.health_snapshot): dead → the replica must be
+        replaced; degraded → serving under the memory-pressure ladder;
+        serving/cold otherwise."""
+        if self._shutdown:
+            # deliberate shutdown wins over an earlier death: the
+            # operator already acted, /health must not keep paging
+            state = "shutdown"
+        elif self._dead is not None:
+            state = "dead"
+        elif self._pressure:
+            state = "degraded"
+        else:
+            state = "serving" if self._warm else "cold"
+        return {"state": state, "pressure": self._pressure,
+                "rung_cap": self._rung_cap,
+                "active_slots": len(self._slot_req),
+                "replays": self.stats["replays"],
+                "restarts": self.stats["restarts"],
+                "degradations": self.stats["degradations"]}
 
     def status(self):
         return {
@@ -630,6 +1130,7 @@ class GenerationServer:
             "warm": self._warm,
             "executables": len(self._exes),
             "token_fetches": self.token_fetches,
+            **self.serving_state(),
             **self.stats,
             "store": (None if self._store is None
                       else self._store.status()),

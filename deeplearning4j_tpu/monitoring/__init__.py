@@ -76,7 +76,8 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     STEP_WALL_MS, STEP_PHASE_MS,
     MODEL_PARAMS_BYTES, MODEL_OPT_STATE_BYTES, MODEL_LAYER_STATE_BYTES,
     GEN_TOKENS, GEN_ACTIVE_SLOTS, GEN_ADMISSIONS, GEN_RETIREMENTS,
-    GEN_PREFILL_MS, GEN_PER_TOKEN_MS,
+    GEN_PREFILL_MS, GEN_PER_TOKEN_MS, GEN_REPLAYS, GEN_RESTARTS,
+    GEN_DEGRADATIONS,
     QUANT_INT8_LAYERS, QUANT_CALIBRATIONS, QUANT_DEQUANT_FALLBACKS,
     QUANT_ACTIVATION_BYTES,
     bootstrap_core_metrics, collect_device_memory, get_registry,
@@ -124,6 +125,7 @@ __all__ = [
     "PIPELINE_STAGED_BATCHES",
     "GEN_TOKENS", "GEN_ACTIVE_SLOTS", "GEN_ADMISSIONS",
     "GEN_RETIREMENTS", "GEN_PREFILL_MS", "GEN_PER_TOKEN_MS",
+    "GEN_REPLAYS", "GEN_RESTARTS", "GEN_DEGRADATIONS",
     "QUANT_INT8_LAYERS", "QUANT_CALIBRATIONS",
     "QUANT_DEQUANT_FALLBACKS", "QUANT_ACTIVATION_BYTES",
 ]

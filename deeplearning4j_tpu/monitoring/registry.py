@@ -158,6 +158,11 @@ GEN_ADMISSIONS = "dl4j.gen.admissions"
 GEN_RETIREMENTS = "dl4j.gen.retirements"
 GEN_PREFILL_MS = "dl4j.gen.prefill_ms"
 GEN_PER_TOKEN_MS = "dl4j.gen.per_token_ms"
+# serving survivability: crash-replay re-admissions, supervised decode
+# restarts, and memory-pressure degradation-ladder events
+GEN_REPLAYS = "dl4j.gen.replays"
+GEN_RESTARTS = "dl4j.gen.restarts"
+GEN_DEGRADATIONS = "dl4j.gen.degradations"
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _LABEL_RE = re.compile(r"[^a-zA-Z0-9_]")

@@ -35,9 +35,12 @@ through `StagingRing`/`xla_owned_copy` and are donated). Oversized
 batches split across max-bucket chunks (µ-cuDNN micro-batching)
 instead of compiling a novel shape; for sequence models a length
 ladder pads the time axis under a validity mask. Any AOT-path failure
-counts `dl4j.serving.aot_fallbacks` and PERMANENTLY reverts this
-instance to the legacy live path — serving never goes down over a
-cache problem.
+counts `dl4j.serving.aot_fallbacks` and OPENS a half-open circuit
+breaker: dispatch degrades to the legacy live path for the cooldown,
+then ONE probe re-tries the AOT path — success restores zero-trace
+steady state, failure re-opens for another cooldown. Serving never
+goes down over a cache problem, and a transient cache problem never
+permanently costs the AOT fast path.
 
 Usage parity:
     pi = (ParallelInference.Builder(net)
@@ -139,7 +142,8 @@ class ParallelInference:
                  batch_limit=32, queue_limit=256, collect_timeout_ms=2.0,
                  enqueue_timeout_ms=100.0, breaker=None,
                  bucket_ladder=None, length_buckets=None,
-                 exec_cache_dir=None, staging_depth=2):
+                 exec_cache_dir=None, staging_depth=2,
+                 aot_breaker=None):
         self.model = model
         self.mode = inference_mode
         # AOT serving: a configured ladder closes the shape vocabulary
@@ -165,7 +169,13 @@ class ParallelInference:
         self._staging_depth = int(staging_depth)
         self._store = None            # ExecutableStore, built lazily
         self._ring = None             # StagingRing, built with the store
-        self._aot_error = None        # first AOT failure (diagnostic)
+        self._aot_error = None        # last AOT failure (diagnostic)
+        # AOT-path breaker: ONE dispatch failure opens it (serve legacy
+        # during the cooldown), the half-open probe re-tries the AOT
+        # path — a transient cache problem never permanently costs the
+        # zero-compile fast path
+        self._aot_breaker = aot_breaker or CircuitBreaker(
+            failure_threshold=1, cooldown=30.0, name="inference.aot")
         self._queue = queue.Queue(maxsize=int(queue_limit))
         self._claim_lock = threading.Lock()
         self._lifecycle_lock = threading.Lock()   # restart + shutdown
@@ -217,6 +227,13 @@ class ParallelInference:
         def breaker(self, breaker):
             """Circuit breaker guarding collector-thread restarts."""
             self._kw["breaker"] = breaker
+            return self
+
+        def aotBreaker(self, breaker):
+            """Circuit breaker guarding the AOT dispatch path: a
+            failure opens it (legacy serving during cooldown), the
+            half-open probe re-tries AOT."""
+            self._kw["aot_breaker"] = breaker
             return self
 
         def bucketLadder(self, buckets):
@@ -527,9 +544,10 @@ class ParallelInference:
         try:
             if _faults.ACTIVE is not None:
                 _faults.ACTIVE.fire(_faults.INFERENCE_FORWARD)
-            if self._ladder is not None:
+            if self._ladder is not None and self._aot_breaker.allow():
                 try:
                     self._serve_aot(batch)
+                    self._aot_breaker.record_success()
                     return
                 except Exception as e:  # noqa: BLE001 — degrade, stay up
                     self._note_aot_fallback(e)
@@ -596,15 +614,10 @@ class ParallelInference:
         batchLimit is installed. Per-input feature shapes come from
         `example` (one example or a batch, like output()) or from the
         model's InputType conf. Returns the warmup stats dict
-        {compiled, from_disk, seconds, signatures}."""
-        if self._aot_error is not None:
-            # the fallback is PERMANENT per instance: re-warming would
-            # aim the next dispatch straight back at the known-broken
-            # AOT path (and fail a request per re-warm)
-            raise RuntimeError(
-                "AOT serving is disabled for this instance after a "
-                "dispatch failure; build a fresh ParallelInference "
-                "once the cause is fixed") from self._aot_error
+        {compiled, from_disk, seconds, signatures}. A successful
+        warmup closes the AOT breaker: the operator just proved the
+        executable layer works, so dispatch goes straight back to the
+        zero-compile path without waiting out a cooldown."""
         from deeplearning4j_tpu.runtime.executables import BucketLadder
         if buckets is not None or self._ladder is None:
             if buckets is None:
@@ -636,6 +649,7 @@ class ParallelInference:
                 sigs.append((sig, with_mask))
         stats = store.warmup(sigs)
         stats["signatures"] = len(sigs)
+        self._aot_breaker.record_success()
         return stats
 
     def _warmup_shapes(self, example):
@@ -709,16 +723,18 @@ class ParallelInference:
         return self._store, self._ring
 
     def _note_aot_fallback(self, e):
-        """First AOT failure flips this instance to the legacy path for
-        good: serving availability beats executable-cache purity."""
-        if self._aot_error is None:
-            self._aot_error = e
-        self._ladder = None
+        """An AOT dispatch failure opens the breaker: serving degrades
+        to the legacy live path for the cooldown (availability beats
+        executable-cache purity), then the half-open probe re-tries the
+        AOT path — zero-trace steady state comes back on its own once
+        the cause clears."""
+        self._aot_error = e
+        self._aot_breaker.record_failure()
         if _mon.enabled():
             _mon.get_registry().counter(
                 _mon.SERVING_AOT_FALLBACKS,
-                help="AOT serving failures (instance reverted to the "
-                     "legacy live path)").inc()
+                help="AOT serving failures (breaker-guarded fallback "
+                     "to the legacy live path)").inc()
 
     def _serve_aot(self, batch):
         """Steady-state serving: pad-to-bucket, stage XLA-owned input
@@ -727,6 +743,8 @@ class ParallelInference:
         max-bucket chunks. Results are delivered only after EVERY chunk
         dispatched, so a mid-batch failure can still fall back to the
         legacy path without double-serving."""
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.SERVING_DISPATCH)
         store, ring = self._ensure_aot()
         ladder = self._ladder
         n_inputs = len(batch[0].x)

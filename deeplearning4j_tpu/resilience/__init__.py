@@ -38,14 +38,16 @@ from __future__ import annotations
 from deeplearning4j_tpu.resilience.errors import (  # noqa: F401
     CheckpointIntegrityError, CircuitOpenError, DistributedInitError,
     DivergenceError, FatalTrainingError, InferenceOverloadedError,
-    InferenceTimeoutError, InjectedFault, PeerDesyncError, PeerLostError,
-    PreemptionSignal, ResilienceError, RetryExhaustedError,
-    TransientError)
+    InferenceTimeoutError, InjectedFault, MemoryPressureError,
+    PeerDesyncError, PeerLostError, PreemptionSignal,
+    ReplayDivergedError, ResilienceError, RetryExhaustedError,
+    ServerDeadError, TransientError)
 from deeplearning4j_tpu.resilience.faults import (  # noqa: F401
-    CHECKPOINT_CORRUPT, CHECKPOINT_RESTORE, CHECKPOINT_SAVE,
-    COMM_ALLREDUCE, COMM_BARRIER, DATA_NEXT, EVAL_FORWARD, HOST_PREEMPT,
-    INFERENCE_COLLECTOR, INFERENCE_FORWARD, TRAIN_DISPATCH,
-    FaultPlan, clear_plan, install_plan)
+    CACHE_GROW, CHECKPOINT_CORRUPT, CHECKPOINT_RESTORE, CHECKPOINT_SAVE,
+    COMM_ALLREDUCE, COMM_BARRIER, DATA_NEXT, EVAL_FORWARD,
+    EXECUTABLES_LOAD, GENERATION_ADMIT, GENERATION_STEP, HOST_PREEMPT,
+    INFERENCE_COLLECTOR, INFERENCE_FORWARD, SERVING_DISPATCH,
+    TRAIN_DISPATCH, FaultPlan, clear_plan, install_plan)
 from deeplearning4j_tpu.resilience.guardian import (  # noqa: F401
     TrainingGuardian)
 from deeplearning4j_tpu.resilience.policy import (  # noqa: F401
@@ -59,13 +61,16 @@ __all__ = [
     "InferenceOverloadedError", "InjectedFault", "FatalTrainingError",
     "DivergenceError", "CheckpointIntegrityError",
     "DistributedInitError", "PeerLostError", "PeerDesyncError",
-    "PreemptionSignal",
+    "PreemptionSignal", "ServerDeadError", "MemoryPressureError",
+    "ReplayDivergedError",
     "RetryPolicy", "CircuitBreaker", "default_classifier",
     "FaultPlan", "install_plan", "clear_plan",
     "DATA_NEXT", "TRAIN_DISPATCH", "CHECKPOINT_SAVE",
     "CHECKPOINT_RESTORE", "CHECKPOINT_CORRUPT", "EVAL_FORWARD",
     "INFERENCE_FORWARD", "INFERENCE_COLLECTOR",
     "COMM_ALLREDUCE", "COMM_BARRIER", "HOST_PREEMPT",
+    "GENERATION_STEP", "GENERATION_ADMIT", "CACHE_GROW",
+    "EXECUTABLES_LOAD", "SERVING_DISPATCH",
     "TrainingGuardian", "StallWatchdog", "health_snapshot",
     "FaultTolerantTrainer",
 ]
@@ -73,12 +78,15 @@ __all__ = [
 
 def health_snapshot():
     """The `GET /health` payload: overall status plus the installed
-    guardian's, watchdog's, and multi-host coordinator's introspection
-    snapshots (None when not installed). Status ladder: a latched stall,
-    a lost peer, or an exhausted guardian makes the process unhealthy; a
-    guardian mid-escalation or a pending preemption reports degraded;
-    otherwise ok. The coordinator snapshot carries the per-process PEER
-    TABLE (heartbeat step/age, preempt flags, lost verdicts)."""
+    guardian's, watchdog's, multi-host coordinator's, and serving
+    (GenerationServer) introspection snapshots (None when not
+    installed). Status ladder: a latched stall, a lost peer, a dead
+    serving loop, or an exhausted guardian makes the process unhealthy;
+    a guardian mid-escalation, a pending preemption, or a serving
+    memory-pressure degradation reports degraded; otherwise ok. The
+    coordinator snapshot carries the per-process PEER TABLE (heartbeat
+    step/age, preempt flags, lost verdicts)."""
+    import sys
     from deeplearning4j_tpu.resilience import guardian as _guardian
     from deeplearning4j_tpu.resilience import watchdog as _watchdog
     g = _guardian.ACTIVE
@@ -91,8 +99,19 @@ def health_snapshot():
     gsnap = g.snapshot() if g is not None else None
     wsnap = w.snapshot() if w is not None else None
     csnap = c.snapshot() if c is not None else None
+    # serving states come from sys.modules, never a fresh import: a
+    # dashboard-only process must not pull jax in from its health tick
+    ssnap = None
+    _gen = sys.modules.get("deeplearning4j_tpu.generation.server")
+    if _gen is not None:
+        try:
+            ssnap = [s.serving_state() for s in list(_gen._SERVERS)]
+        except Exception:  # noqa: BLE001 — health must always answer
+            ssnap = None
     status = "ok"
     if gsnap is not None and gsnap["status"] == "degraded":
+        status = "degraded"
+    if ssnap and any(s["state"] == "degraded" for s in ssnap):
         status = "degraded"
     if csnap is not None and (csnap["preempt_requested"]
                               or csnap["preempted"]):
@@ -103,8 +122,10 @@ def health_snapshot():
         status = "peer_lost"
     if gsnap is not None and gsnap["status"] == "diverged":
         status = "diverged"
+    if ssnap and any(s["state"] == "dead" for s in ssnap):
+        status = "serving_dead"
     return {"status": status, "guardian": gsnap, "watchdog": wsnap,
-            "distributed": csnap}
+            "distributed": csnap, "serving": ssnap}
 
 
 def __getattr__(name):

@@ -15,7 +15,8 @@ __all__ = [
     "InferenceOverloadedError", "InjectedFault", "FatalTrainingError",
     "DivergenceError", "CheckpointIntegrityError",
     "DistributedInitError", "PeerLostError", "PeerDesyncError",
-    "PreemptionSignal",
+    "PreemptionSignal", "ServerDeadError", "MemoryPressureError",
+    "ReplayDivergedError",
 ]
 
 
@@ -111,6 +112,32 @@ class PeerDesyncError(PeerLostError):
     lockstep SPMD contract is broken (e.g. one worker skipped a batch
     the others trained). Continuing would silently corrupt the model, so
     the step-agreement check fails the run instead."""
+
+
+class ServerDeadError(ResilienceError):
+    """A serving loop (the GenerationServer decode thread) exhausted
+    its supervised-restart budget and is permanently down: every
+    in-flight, replay-pending, and queued request was failed with this
+    error and future submits refuse immediately. Deliberately typed so
+    a fleet supervisor can tell 'replace this replica' from a transient
+    per-request failure; `GET /health` reports `serving_dead`."""
+
+
+class MemoryPressureError(ResilienceError):
+    """The serving memory-pressure degradation ladder refused work
+    instead of risking (or after observing) a device OOM: a cache
+    growth past the capped rung, a queued admission shed while under
+    pressure, or an in-flight request that no longer fits the shrunken
+    cache rung. The server itself stays up — only the refused request
+    fails."""
+
+
+class ReplayDivergedError(ResilienceError):
+    """Crash-replay re-generated a token that does not match the
+    journaled (already-delivered) stream — the per-slot-key purity
+    contract was violated (should never happen; a bug or nondeterminism
+    in the decode path). The affected request fails typed rather than
+    silently delivering a forked continuation."""
 
 
 class PreemptionSignal(ResilienceError):

@@ -16,6 +16,11 @@ hooks at named sites:
                                               generation fallback)
     EVAL_FORWARD       "eval.forward"       — before an eval-loop forward
     INFERENCE_FORWARD  "inference.forward"  — before a coalesced forward
+    GENERATION_STEP    "generation.step"    — before a decode-step dispatch
+    GENERATION_ADMIT   "generation.admit"   — before a prefill admission
+    CACHE_GROW         "cache.grow"         — before a KV-cache rung growth
+    EXECUTABLES_LOAD   "executables.load"   — on the AOT store miss path
+    SERVING_DISPATCH   "serving.dispatch"   — inside the AOT serving path
 
 The hook at every call site is literally
 
@@ -46,6 +51,8 @@ __all__ = ["FaultPlan", "install_plan", "clear_plan", "ACTIVE",
            "CHECKPOINT_RESTORE", "CHECKPOINT_CORRUPT", "EVAL_FORWARD",
            "INFERENCE_FORWARD", "INFERENCE_COLLECTOR",
            "COMM_ALLREDUCE", "COMM_BARRIER", "HOST_PREEMPT",
+           "GENERATION_STEP", "GENERATION_ADMIT", "CACHE_GROW",
+           "EXECUTABLES_LOAD", "SERVING_DISPATCH",
            "PROCESS_ID", "resolve_process_id"]
 
 DATA_NEXT = "data.next"
@@ -74,6 +81,23 @@ COMM_BARRIER = "comm.barrier"
 #: `PreemptionSignal` here to simulate SIGTERM delivery on schedule
 #: (the coordinated drain + checkpoint + clean exit path)
 HOST_PREEMPT = "host.preempt"
+#: fires before the GenerationServer's per-token decode dispatch — a
+#: fault here kills the step mid-flight (donated state presumed gone);
+#: crash-replay must re-admit every surviving request bit-identically
+GENERATION_STEP = "generation.step"
+#: fires before a prompt-prefill admission dispatch (fresh or replay);
+#: the request is journaled first, so a fault here replays it
+GENERATION_ADMIT = "generation.admit"
+#: fires before a KV-cache rung-growth dispatch; inject an OOM-shaped
+#: error here to drive the memory-pressure degradation ladder
+CACHE_GROW = "cache.grow"
+#: fires on the AOT executable-store miss path (disk load / live
+#: compile) — simulates a corrupt or unreachable executable cache
+EXECUTABLES_LOAD = "executables.load"
+#: fires inside the AOT serving dispatch (`_serve_aot`) — a fault here
+#: must open the AOT breaker and degrade to the legacy path, then
+#: recover through the half-open probe after cooldown
+SERVING_DISPATCH = "serving.dispatch"
 
 #: THE switch production hooks check. None → injection off (the
 #: permanent state outside resilience tests).

@@ -70,6 +70,7 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.runtime.pipeline import xla_owned_copy
 
 __all__ = [
@@ -358,6 +359,11 @@ class _AotStoreBase:
             if e is not None:
                 self.stats["memory_hits"] += 1
                 return e
+            # chaos site: a fault here simulates a corrupt/unreachable
+            # executable cache on the miss path (warmup or a novel
+            # signature) — never the in-memory steady state above
+            if _faults.ACTIVE is not None:
+                _faults.ACTIVE.fire(_faults.EXECUTABLES_LOAD)
             path = (self._entry_path(key) if self.directory else None)
             if path is not None and os.path.exists(path):
                 e = self._load_disk(key, path)

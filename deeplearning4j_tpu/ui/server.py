@@ -176,10 +176,12 @@ async function tick(){
     if (gd.servers && gd.servers.length){
       document.getElementById('generation').textContent =
         gd.servers.map(s =>
-          `${s.decoder}: slots ${s.active_slots}/${s.slots} · rung ` +
-          `${s.rung} · queued ${s.queued} · tokens ${s.tokens} · ` +
-          `admissions ${s.admissions} · retirements ${s.retirements} ` +
-          `· errors ${s.errors}`).join("\n");
+          `${s.decoder} [${s.state}]: slots ${s.active_slots}/` +
+          `${s.slots} · rung ${s.rung} · queued ${s.queued} · ` +
+          `tokens ${s.tokens} · admissions ${s.admissions} · ` +
+          `retirements ${s.retirements} · errors ${s.errors} · ` +
+          `replays ${s.replays} · restarts ${s.restarts} · ` +
+          `degradations ${s.degradations}`).join("\n");
     }
   } catch (e) {}
   try {

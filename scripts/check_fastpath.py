@@ -102,15 +102,22 @@ GENERATION_MODULES = [
     "deeplearning4j_tpu/quantize/kvcache.py",
     "deeplearning4j_tpu/quantize/core.py",
 ]
-#: decode-loop entry points (GenerationServer hot methods)
+#: decode-loop entry points (GenerationServer hot methods) PLUS the
+#: crash-replay/supervised-restart path: re-admission and the key
+#: advance must also resolve entirely from the warmed executable set
+#: (the supervisor promises restarts with ZERO live compiles)
 GENERATION_ROOTS = {"_step_once", "_admit_pending", "_admit_one",
-                    "_retire_slot", "_deliver"}
+                    "_admit_rec", "_retire_slot", "_deliver",
+                    "_survive", "_recover", "_replay_one",
+                    "_advance_key", "_supervised_restart"}
 #: the declared warmup boundary — steady state never crosses it
 GENERATION_MISS_BOUNDARY = {"load_or_compile", "warmup",
                             "_warmup_locked"}
 #: per-token sync rule: only `_step_once`'s declared fetch point may
-#: materialize device values
-GENERATION_SYNC_ROOTS = {"_step_once"}
+#: materialize device values. `_deliver`/`_push` are roots too: the
+#: crash-replay journal append (the delivered-token list) must stay on
+#: the existing `_fetch_tokens` host boundary — no extra syncs
+GENERATION_SYNC_ROOTS = {"_step_once", "_deliver", "_push"}
 GENERATION_SYNC_BOUNDARY = {"_fetch_tokens"}
 #: calls that mean "the host blocks on (or copies back) device data"
 SYNC_CALL_NAMES = {"asarray", "device_get", "block_until_ready",

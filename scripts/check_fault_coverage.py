@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Fault-site coverage lint: every injection site declared in
+`resilience/faults.py` must be exercised by at least one test.
+
+The fault harness only earns its keep if every site a production path
+can fire is actually driven by a chaos/regression test — an uncovered
+site is a failure mode nobody has ever watched happen. This script
+parses faults.py for the declared site constants (module-level
+``UPPER_NAME = "dotted.site"`` string assignments) and greps the test
+tree for either the constant name (``GENERATION_STEP``) or the literal
+site string (``"generation.step"``). A site referenced by neither
+fails the lint, so a new fault site cannot ship untested.
+
+Grep-based on purpose, exactly like `check_fastpath.py`: it runs in
+tier-1 (tests/test_fault_coverage.py) with zero imports of jax or the
+package, and a textual reference is the right bar — the referencing
+test, not this lint, is responsible for driving the site meaningfully.
+
+Run manually:  python scripts/check_fault_coverage.py
+(prints uncovered sites, exit 1 when any).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FAULTS_MODULE = os.path.join(REPO_ROOT, "deeplearning4j_tpu",
+                             "resilience", "faults.py")
+TESTS_DIR = os.path.join(REPO_ROOT, "tests")
+
+#: what a site value looks like: lowercase dotted words ("cache.grow").
+#: Filters out non-site module constants (ACTIVE/PROCESS_ID are None
+#: assignments and never match the string form anyway).
+_SITE_RE = re.compile(r"[a-z_]+(\.[a-z_]+)+")
+
+
+def declared_sites(source=None):
+    """{CONSTANT_NAME: "site.string"} for every module-level site
+    declaration in faults.py (or the given source override)."""
+    if source is None:
+        with open(FAULTS_MODULE) as f:
+            source = f.read()
+    sites = {}
+    for node in ast.parse(source).body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        value = node.value
+        if (name.isupper() and isinstance(value, ast.Constant)
+                and isinstance(value.value, str)
+                and _SITE_RE.fullmatch(value.value)):
+            sites[name] = value.value
+    return sites
+
+
+def test_sources(tests_dir=None):
+    """{path: source} for every python file under tests/."""
+    tests_dir = tests_dir or TESTS_DIR
+    out = {}
+    for base, _, names in os.walk(tests_dir):
+        for n in sorted(names):
+            if n.endswith(".py"):
+                path = os.path.join(base, n)
+                with open(path) as f:
+                    out[path] = f.read()
+    return out
+
+
+def uncovered_sites(sites=None, sources=None):
+    """[(constant, site)] declared sites no test references by
+    constant name (word-bounded) or literal string."""
+    sites = declared_sites() if sites is None else sites
+    sources = test_sources() if sources is None else sources
+    blob = "\n".join(sources.values())
+    missing = []
+    for name, site in sorted(sites.items()):
+        if re.search(rf"\b{re.escape(name)}\b", blob):
+            continue
+        if site in blob:
+            continue
+        missing.append((name, site))
+    return missing
+
+
+def main():
+    missing = uncovered_sites()
+    for name, site in missing:
+        print(f"{name} ({site!r}): no test references this fault "
+              "injection site")
+    if missing:
+        print(f"\n{len(missing)} uncovered fault site(s): every "
+              "faults.py injection site must be exercised by at least "
+              "one test (reference the constant or the site string "
+              "and drive the production hook).")
+    return missing
+
+
+if __name__ == "__main__":
+    sys.exit(1 if main() else 0)

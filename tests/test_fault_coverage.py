@@ -1,0 +1,43 @@
+"""Tier-1 gate for scripts/check_fault_coverage.py: every fault
+injection site declared in resilience/faults.py must be exercised by
+at least one test, so a new site cannot ship untested (the same
+run-the-lint-in-CI pattern as test_fastpath_lint.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+import check_fault_coverage as cfc  # noqa: E402
+
+from deeplearning4j_tpu.resilience import faults  # noqa: E402
+
+
+def test_every_declared_site_is_covered():
+    missing = cfc.uncovered_sites()
+    assert missing == [], (
+        "fault sites with no exercising test: "
+        + ", ".join(f"{n} ({s})" for n, s in missing))
+
+
+def test_declared_sites_match_the_harness():
+    """The AST scrape agrees with what the faults module actually
+    exports — a site constant the scrape misses would silently escape
+    the coverage gate."""
+    sites = cfc.declared_sites()
+    exported = {n: getattr(faults, n) for n in faults.__all__
+                if isinstance(getattr(faults, n), str)
+                and cfc._SITE_RE.fullmatch(getattr(faults, n))}
+    assert sites == exported
+    assert "GENERATION_STEP" in sites and "CACHE_GROW" in sites
+
+
+def test_detects_an_uncovered_site():
+    sites = {"FAKE_SITE": "totally.uncovered"}
+    sources = {"tests/test_x.py": "def test_nothing():\n    pass\n"}
+    missing = cfc.uncovered_sites(sites, sources)
+    assert missing == [("FAKE_SITE", "totally.uncovered")]
+    # covered by constant name OR by the literal site string
+    by_name = {"tests/test_x.py": "plan.fail_at(faults.FAKE_SITE, 1)"}
+    assert cfc.uncovered_sites(sites, by_name) == []
+    by_literal = {"tests/test_x.py": 'plan.fail_at("totally.uncovered")'}
+    assert cfc.uncovered_sites(sites, by_literal) == []

@@ -316,12 +316,17 @@ def test_donating_dispatch_stress(net):
 # ===================== resilience of the AOT path =====================
 def test_aot_failure_degrades_to_legacy_path(net):
     """A broken executable layer must never take serving down: the
-    instance reverts to the legacy live path and keeps answering."""
+    first failure opens the AOT breaker, requests keep answering on
+    the legacy live path, and an explicit re-warm (the operator fixed
+    the cause) closes the breaker and restores the AOT fast path —
+    the fallback is a cooldown, never a lifetime revert."""
+    from deeplearning4j_tpu.resilience.policy import CircuitBreaker
     pi = (ParallelInference.Builder(net)
           .inferenceMode(InferenceMode.BATCHED)
           .bucketLadder([2, 4]).build())
     try:
         pi.warmup()
+        good_lookup = pi._store.lookup
         pi._store.lookup = None     # poison: TypeError on next dispatch
         mon.enable()
         fb0 = _counter(mon.SERVING_AOT_FALLBACKS)
@@ -329,16 +334,24 @@ def test_aot_failure_degrades_to_legacy_path(net):
             np.float32)
         np.testing.assert_allclose(pi.output(x), net.output(x).numpy(),
                                    atol=1e-5, rtol=1e-5)
-        assert pi._ladder is None           # permanently degraded
+        assert pi._aot_breaker.state == CircuitBreaker.OPEN
+        assert pi._ladder is not None       # NOT permanently degraded
         assert pi._aot_error is not None
         assert _counter(mon.SERVING_AOT_FALLBACKS) - fb0 == 1
-        # and stays up on the legacy path
+        # and stays up on the legacy path during the cooldown (one
+        # fallback event — the open breaker sheds without re-trying)
         np.testing.assert_allclose(pi.output(x), net.output(x).numpy(),
                                    atol=1e-5, rtol=1e-5)
-        # the fallback is permanent: re-warming must refuse rather
-        # than aim the next dispatch back at the broken AOT path
-        with pytest.raises(RuntimeError, match="disabled"):
-            pi.warmup()
+        assert _counter(mon.SERVING_AOT_FALLBACKS) - fb0 == 1
+        # the operator fixes the cause and re-warms: the breaker
+        # closes and the next dispatch is back on the AOT path
+        pi._store.lookup = good_lookup
+        pi.warmup()
+        assert pi._aot_breaker.state == CircuitBreaker.CLOSED
+        traces = pi._store.trace_calls
+        np.testing.assert_allclose(pi.output(x), net.output(x).numpy(),
+                                   atol=1e-5, rtol=1e-5)
+        assert pi._store.trace_calls == traces    # zero-trace again
     finally:
         pi.shutdown()
 
