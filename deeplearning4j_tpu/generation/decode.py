@@ -37,7 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from deeplearning4j_tpu.kernels.flash_attention import (
-    flash_attention, flash_attention_decode)
+    flash_attention, flash_attention_decode, flash_attention_decode_mq)
 from deeplearning4j_tpu.models.bert import (_ffn, _layer_norm,
                                             bert_mlm_logits)
 from deeplearning4j_tpu.parallel.ring_attention import dense_attention
@@ -203,6 +203,66 @@ class BertDecoder:
             out["ks"] = ks
             out["vs"] = vs
         return logits, out
+
+    @property
+    def supports_draft(self):
+        """Greedy drafting needs the multi-token `verify` forward; the
+        int8 KV codec has no multi-row quantized write path yet, so
+        drafting is fp-cache only."""
+        return self.kv_dtype == "fp"
+
+    def verify(self, margs, cache, tokens, pos, draft):
+        """Draft-block decode: for each slot, run the q-block
+        ``[tokens[s], draft[s, 0], ..., draft[s, d-2]]`` at positions
+        ``pos[s] .. pos[s]+d-1`` through the stack in ONE dispatch —
+        write all d K/V rows, attend each query over cache rows
+        ``0 .. pos[s]+j`` (the intra-block causal offset), and return
+        logits at every query: ``logits[s, j]`` is the model's
+        next-token distribution after consuming j draft tokens.
+        Exactly equal (same arithmetic, same masks) to d sequential
+        `step` calls — the greedy-drafting acceptance rule's oracle.
+        Rows written past the accepted prefix hold draft garbage but
+        sit beyond the slot's advanced position, so the decode cache
+        mask hides them until they are overwritten (same convention as
+        prefill's padded rows). fp cache only (`supports_draft`)."""
+        (params,) = margs
+        cfg = self.cfg
+        s = tokens.shape[0]
+        d = 1 + draft.shape[1]
+        tok_block = jnp.concatenate([tokens[:, None], draft], axis=1)
+        pos_block = pos[:, None] + jnp.arange(d)[None, :]   # (S, d)
+        x = self._embed(params, tok_block, pos_block)       # (S, d, H)
+        kc, vc = cache["k"], cache["v"]
+        ar = jnp.arange(s)
+        c = kc.shape[3]
+        nh, hd = cfg.num_heads, cfg.head_dim
+        # query j sees rows 0..pos+j (its own write included)
+        qmask = jnp.arange(c)[None, None, :] <= pos_block[:, :, None]
+        dt = x.dtype
+        for li, layer in enumerate(params["layers"]):
+            qkv = x @ layer["qkv_W"].astype(dt) \
+                + layer["qkv_b"].astype(dt)                 # (S, d, 3H)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(s, d, nh, hd).transpose(0, 2, 1, 3)
+            k = k.reshape(s, d, nh, hd)                     # (S, d, H, Dh)
+            v = v.reshape(s, d, nh, hd)
+            # advanced-index write: rows pos..pos+d-1 of every slot
+            # (the advanced (S, d) block leads, then the H and Dh dims)
+            kc = kc.at[li, ar[:, None], :, pos_block].set(
+                k.astype(kc.dtype))
+            vc = vc.at[li, ar[:, None], :, pos_block].set(
+                v.astype(vc.dtype))
+            ctx = flash_attention_decode_mq(q, kc[li], vc[li],
+                                            qmask).astype(dt)
+            a = ctx.transpose(0, 2, 1, 3).reshape(s, d, cfg.hidden_size) \
+                @ layer["proj_W"].astype(dt) + layer["proj_b"].astype(dt)
+            x = _layer_norm(x + a, layer["ln1_scale"], layer["ln1_bias"],
+                            cfg.layer_norm_eps)
+            f = _ffn(cfg, layer, x, False, None)
+            x = _layer_norm(x + f, layer["ln2_scale"], layer["ln2_bias"],
+                            cfg.layer_norm_eps)
+        logits = bert_mlm_logits(cfg, params, x)            # (S, d, V)
+        return logits, {"k": kc, "v": vc}
 
     def prefill(self, margs, cache, slot, prompt, plen):
         """Causal full forward over one length-bucketed prompt (1, P);

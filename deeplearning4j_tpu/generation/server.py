@@ -12,21 +12,42 @@ ever changing a shape — the executable set is closed over
 
 Steady-state contract (linted by scripts/check_fastpath.py and
 regression-tested): past `warmup()`, the decode loop performs ZERO jit
-traces and ZERO XLA compiles — step, admit, retire, and grow all
-resolve from the in-memory executable tier — and the ONLY per-token
-host sync is the sampled-token fetch (`_fetch_tokens`); the whole
-decode state (KV caches / recurrent carries, positions, active mask,
-per-slot sampling knobs, rng keys) lives on device and is DONATED
-through every step, so steady state is one fixed-shape dispatch per
-token.
+traces and ZERO XLA compiles — superstep, admit, retire, and grow all
+resolve from the in-memory executable tier — and the ONLY host sync is
+the per-SUPERSTEP sampled-token-block fetch (`_fetch_tokens`); the
+whole decode state (KV caches / recurrent carries, positions, active
+mask, per-slot sampling knobs, rng keys) lives on device and is
+DONATED through every dispatch, so steady state is one fixed-shape
+dispatch per k tokens. The token block is a non-donated output whose
+host copy starts asynchronously (`_start_fetch`) right after dispatch:
+block n's journal append and stream delivery run while block n+1
+computes, so the fetch overlaps compute instead of gating it.
 
 Executables (per `FunctionStore`, two-tier: in-memory + on-disk
 serialized — a restarted replica warms from disk):
 
-- ``("step", C)`` — decode one token for all S slots at cache rung C:
-  embed → write K/V row (or advance carries) → single-query attention →
-  logits → fused per-slot sampling (greedy / temperature / top-k, all
-  TRACED per-slot values: mixed sampling configs share one executable).
+- ``("superstep", C, k)`` — decode k tokens for all S slots at cache
+  rung C as ONE `lax.scan` dispatch: each iteration embeds → writes the
+  K/V row (or advances carries) → single-query attention → logits →
+  fused per-slot sampling (greedy / temperature / top-k, all TRACED
+  per-slot values: mixed sampling configs share one executable).
+  Per-slot EOS/budget halt masks freeze finished slots mid-block
+  (frozen iterations are computed-but-masked, emitted as -1, never
+  delivered), so the block's semantics exactly equal k sequential
+  steps while dispatches and host fetches per token drop by k.
+  Admission / retirement / growth happen between supersteps, so EOS
+  retirement may lag up to ~2k steps behind the terminal token (one
+  block of halt lag + one block of async-fetch pipeline depth).
+- ``("verify", C, d)`` — exact greedy drafting (optional, off by
+  default): the host proposes up to d draft tokens (prompt-lookup
+  n-gram over the request's own journal; during crash-replay, the
+  journaled prefix itself), and one dispatch runs the q-block
+  [current, draft...] through a multi-query decode attention
+  (`flash_attention_decode_mq`), accepting exactly the prefix of
+  drafts that match the model's own greedy argmax. Delivered streams
+  are token-identical to vanilla greedy; non-greedy slots in the same
+  batch advance exactly one sampled token per round (one rng split),
+  keeping the sampled-stream bit-identity contract untouched.
 - ``("admit", C, P)`` — prefill one prompt at prompt bucket P, graft
   its cache/carry rows into a slot, arm the slot's sampling config and
   rng key, sample the first token.
@@ -76,6 +97,7 @@ Chaos fault sites: `generation.step`, `generation.admit`, `cache.grow`
 """
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
@@ -88,7 +110,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from deeplearning4j_tpu import monitoring as _mon
-from deeplearning4j_tpu.generation.sampling import (method_id,
+from deeplearning4j_tpu.generation.sampling import (GREEDY, method_id,
                                                     sample_step,
                                                     split_keys)
 from deeplearning4j_tpu.resilience import faults as _faults
@@ -198,6 +220,49 @@ class _SlotJournal:
         self.replay_idx = 0
 
 
+class _Block:
+    """One in-flight sampled-token block: the device (k, S) output of a
+    superstep/verify dispatch, the slot→journal map snapshotted at
+    dispatch time (delivery must never hand a stale token to a slot
+    re-admitted since), and the timing anchors for the per-token and
+    fetch-overlap metrics. `proposed` is the per-slot draft-proposal
+    count (drafting rounds only)."""
+
+    __slots__ = ("tokens", "recs", "k", "t0", "t_copy", "proposed")
+
+    def __init__(self, tokens, recs, k, t0, t_copy, proposed=None):
+        self.tokens = tokens
+        self.recs = recs
+        self.k = k
+        self.t0 = t0
+        self.t_copy = t_copy
+        self.proposed = proposed
+
+
+def _ngram_propose(history, nd, n=3):
+    """Prompt-lookup drafting: propose the `nd` tokens that followed
+    the most recent PREVIOUS occurrence of the history's trailing
+    n-gram (falling back to shorter grams down to a unigram). One
+    vectorized sliding-window comparison per gram length — this runs
+    on the decode hot path once per greedy slot per drafting round, so
+    no per-position python loop. Wrong proposals cost nothing but the
+    masked lanes of one verify dispatch; only exact greedy matches are
+    ever delivered."""
+    h = np.array(history, np.int32)
+    t = len(h)
+    for g in range(min(n, t - 1), 0, -1):
+        gram = h[t - g:]
+        # all candidate windows end before the trailing gram starts
+        wins = np.lib.stride_tricks.sliding_window_view(h[:t - 1], g)
+        hits = np.flatnonzero((wins == gram).all(axis=1))
+        if len(hits):
+            j = int(hits[-1])       # rightmost = freshest context wins
+            tail = h[j + g:j + g + nd]
+            if len(tail):
+                return tail
+    return h[:0]
+
+
 class GenerationServer:
     """Continuous-batching KV-cache decode server over one model.
 
@@ -223,7 +288,8 @@ class GenerationServer:
                  queue_limit=256, enqueue_timeout_ms=100.0,
                  exec_cache_dir=None, restart_policy=None,
                  max_consecutive_failures=8, pressure_relief_steps=256,
-                 pressure_relief_secs=60.0, memory_high_water=0.92):
+                 pressure_relief_secs=60.0, memory_high_water=0.92,
+                 superstep=1, draft=0):
         from deeplearning4j_tpu.generation.decode import RecurrentDecoder
         if not hasattr(decoder, "init_cache"):
             decoder = RecurrentDecoder(decoder)
@@ -231,6 +297,22 @@ class GenerationServer:
         self.slots = int(slots)
         if self.slots < 1:
             raise ValueError("slots must be >= 1")
+        self.superstep = int(superstep)
+        if self.superstep < 1:
+            raise ValueError("superstep must be >= 1")
+        self.draft = int(draft)
+        if self.draft < 0:
+            raise ValueError("draft must be >= 0")
+        if self.draft and self.superstep > 1:
+            raise ValueError(
+                "draft and superstep > 1 are alternative decode fast "
+                "paths — a drafting round already amortizes the "
+                "dispatch over up to draft+1 tokens; pick one")
+        if self.draft and not getattr(decoder, "supports_draft", False):
+            raise ValueError(
+                f"{type(decoder).__name__} has no draft-verify forward "
+                "(greedy drafting needs the multi-query KV-cache "
+                "`verify` path — BertDecoder with kv_dtype='fp')")
         rungs = tuple(sorted({int(c) for c in cache_lengths}))
         if not rungs or rungs[0] < 2:
             raise ValueError(f"cache_lengths must be >= 2: {cache_lengths}")
@@ -287,10 +369,11 @@ class GenerationServer:
                                      else float(pressure_relief_secs))
         self.memory_high_water = (None if memory_high_water is None
                                   else float(memory_high_water))
-        self.stats = {"tokens": 0, "steps": 0, "admissions": 0,
-                      "retirements": 0, "errors": 0, "replays": 0,
-                      "restarts": 0, "degradations": 0}
-        self.token_fetches = 0       # host syncs: ONE per decode step
+        self.stats = {"tokens": 0, "steps": 0, "supersteps": 0,
+                      "admissions": 0, "retirements": 0, "errors": 0,
+                      "replays": 0, "restarts": 0, "degradations": 0,
+                      "draft_accepts": 0, "draft_rejects": 0}
+        self.token_fetches = 0       # host syncs: ONE per decode block
         self._queue = queue.Queue(maxsize=int(queue_limit))
         self._store = None           # FunctionStore, built at warmup
         self._exec_cache_dir = exec_cache_dir
@@ -299,6 +382,8 @@ class GenerationServer:
         self._state = None           # donated decode-state tuple
         self._rung = None
         self._slot_req = {}          # slot -> _SlotJournal
+        self._inflight = None        # _Block dispatched, not delivered
+        self._latencies = collections.deque(maxlen=512)  # per-token ms
         self._replaying = []         # journals awaiting re-admission
         self._free = list(range(self.slots))
         self._counter = 0            # admission counter (rng derivation)
@@ -319,9 +404,10 @@ class GenerationServer:
 
     # -- warmup (the declared trace/compile boundary) ---------------------
     def warmup(self):
-        """Build the whole closed executable set — step/retire per
-        rung, admit per (rung, prompt bucket), grow per rung pair, the
-        replay key-advance — through the two-tier FunctionStore (warm
+        """Build the whole closed executable set — superstep (or
+        draft-verify) per rung, retire, admit per (rung, prompt
+        bucket), grow per rung pair, the replay key-advance — through
+        the two-tier FunctionStore (warm
         replica: deserialize, no XLA compile), initialize the device
         decode state at the smallest rung, and start the decode loop.
         Idempotent (and safe under concurrent first submits)."""
@@ -341,8 +427,13 @@ class GenerationServer:
         store = FunctionStore(
             f"{self.decoder.fingerprint()}-s{self.slots}",
             directory=self._exec_cache_dir)
-        store.register("step", self._traced_step,
-                       donate_argnums=self._donate_range())
+        if self.draft:
+            store.register("verify", self._traced_verify(self.draft),
+                           donate_argnums=self._donate_range())
+        else:
+            store.register("superstep",
+                           self._traced_superstep(self.superstep),
+                           donate_argnums=self._donate_range())
         store.register("admit", self._traced_admit,
                        donate_argnums=self._donate_range())
         store.register("retire", self._traced_retire,
@@ -355,13 +446,22 @@ class GenerationServer:
         sds = jax.ShapeDtypeStruct
         scalar_i = sds((), jnp.int32)
         scalar_f = sds((), jnp.float32)
+        slot_i = sds((self.slots,), jnp.int32)
         for ci, rung in enumerate(self.cache_lengths):
             spec = self._state_spec(rung)
             margs_spec = jax.tree_util.tree_map(
                 lambda l: sds(jnp.shape(l), jnp.result_type(l)),
                 self._margs)
-            key = ("step", rung)
-            e = store.load_or_compile(key, (*margs_spec, *spec))
+            if self.draft:
+                key = ("verify", rung, self.draft)
+                e = store.load_or_compile(
+                    key, (*margs_spec, *spec, slot_i, slot_i,
+                          sds((self.slots, self.draft), jnp.int32),
+                          slot_i))
+            else:
+                key = ("superstep", rung, self.superstep)
+                e = store.load_or_compile(
+                    key, (*margs_spec, *spec, slot_i, slot_i))
             self._exes[key] = e.call
             for p in self.prompt_buckets:
                 if p > rung:
@@ -432,17 +532,101 @@ class GenerationServer:
                 jnp.zeros((s,), jnp.int32))
 
     # -- traced bodies (pure; lowered once per signature at warmup) -------
-    def _traced_step(self, *args):
-        n = self.decoder.n_model_args
-        margs = args[:n]
-        cache, pos, active, tokens, rng, method, temp, topk = args[n:]
-        logits, cache = self.decoder.step(margs, cache, tokens, pos)
-        sampled, rng = sample_step(logits, rng, method, temp, topk)
-        tokens = jnp.where(active, sampled, tokens)
-        pos = jnp.where(active, pos + 1, pos)
-        out = jnp.where(active, sampled, -1)
-        return (cache, pos, active, tokens, rng, method, temp, topk,
-                out)
+    def _traced_superstep(self, k):
+        """k decode steps as ONE lax.scan dispatch. Per-slot halt masks
+        freeze a slot the moment it samples its EOS token or exhausts
+        its budget — frozen iterations keep recomputing the held token
+        at the held position (idempotent cache writes, masked -1
+        output), so the block's semantics exactly equal k sequential
+        steps with host-side retirement; retirement itself happens
+        after delivery, up to k steps late. `eos` is -1 for slots with
+        no EOS (sampled ids are always >= 0, so it never matches);
+        `budget` is the per-slot count of tokens the block may still
+        emit (see _superstep_args for the replay accounting)."""
+
+        def superstep(*args):
+            n = self.decoder.n_model_args
+            margs = args[:n]
+            (cache, pos, active, tokens, rng, method, temp, topk,
+             eos, budget) = args[n:]
+
+            def body(carry, _):
+                cache, pos, active, tokens, rng, budget = carry
+                logits, cache = self.decoder.step(margs, cache, tokens,
+                                                  pos)
+                sampled, rng = sample_step(logits, rng, method, temp,
+                                           topk)
+                out = jnp.where(active, sampled, -1)
+                budget = budget - active.astype(jnp.int32)
+                halt = (sampled == eos) | (budget <= 0)
+                tokens = jnp.where(active, sampled, tokens)
+                pos = jnp.where(active, pos + 1, pos)
+                active = active & ~halt
+                return (cache, pos, active, tokens, rng, budget), out
+
+            (cache, pos, active, tokens, rng, _), outs = lax.scan(
+                body, (cache, pos, active, tokens, rng, budget), None,
+                length=k)
+            return (cache, pos, active, tokens, rng, method, temp,
+                    topk, outs)                           # outs (k, S)
+
+        return superstep
+
+    def _traced_verify(self, ndraft):
+        """One greedy-drafting round as ONE dispatch: the decoder's
+        multi-query `verify` forward scores the q-block
+        [current, draft...], and the acceptance rule delivers the
+        longest prefix of draft tokens matching the model's own greedy
+        argmax, plus the model's next token — so every delivered token
+        IS the vanilla greedy token (exactness by construction), and a
+        full match delivers ndraft+1 tokens for one dispatch. Non-
+        greedy slots ride the same dispatch with a zero-length draft
+        (host-enforced): they deliver exactly one sampled token per
+        round with exactly one rng split — their streams stay
+        bit-identical to the undrafted path. EOS/budget truncate the
+        delivered prefix and freeze the slot like the superstep."""
+        d = ndraft + 1
+
+        def verify(*args):
+            n = self.decoder.n_model_args
+            margs = args[:n]
+            (cache, pos, active, tokens, rng, method, temp, topk,
+             eos, budget, draft, dlen) = args[n:]
+            logits, cache = self.decoder.verify(margs, cache, tokens,
+                                                pos, draft)  # (S, d, V)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # position 0 samples with the slot's own config (ONE split
+            # per round — greedy slots ignore the key, sampled slots
+            # deliver exactly this one token)
+            first, rng = sample_step(logits[:, 0], rng, method, temp,
+                                     topk)
+            cand = jnp.concatenate([first[:, None], greedy[:, 1:]],
+                                   axis=1)                 # (S, d)
+            # draft j consumed iff every draft token <= j matched the
+            # model's prediction (prefix rule)
+            ok = ((jnp.arange(ndraft)[None, :] < dlen[:, None])
+                  & (cand[:, :ndraft] == draft))
+            m = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
+            js = jnp.arange(d)[None, :]
+            deliver = (js <= m[:, None]) & (js < budget[:, None])
+            # stop AFTER the first delivered EOS (it is itself emitted)
+            is_eos = deliver & (cand == eos[:, None])
+            before = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) \
+                - is_eos.astype(jnp.int32)
+            deliver &= (before == 0) & active[:, None]
+            out = jnp.where(deliver, cand, -1)             # (S, d)
+            ndel = deliver.sum(axis=1).astype(jnp.int32)
+            pos = pos + ndel
+            budget = budget - ndel
+            last = jnp.take_along_axis(
+                cand, jnp.clip(ndel - 1, 0, d - 1)[:, None],
+                axis=1)[:, 0]
+            tokens = jnp.where(ndel > 0, last, tokens)
+            active = active & ~(is_eos.any(axis=1) | (budget <= 0))
+            return (cache, pos, active, tokens, rng, method, temp,
+                    topk, out.T)                           # (d, S)
+
+        return verify
 
     def _traced_admit(self, *args):
         n = self.decoder.n_model_args
@@ -528,7 +712,16 @@ class GenerationServer:
         while not self._shutdown:
             try:
                 self._admit_pending()
-                if not self._slot_req:
+                if self._slot_req:
+                    self._dispatch_block()
+                elif self._inflight is not None:
+                    # every occupant retired, but the pipelined tail
+                    # block is still in flight: drain it (its live
+                    # slots were all frozen — rows of -1 — but the
+                    # fetch/step accounting must balance)
+                    blk, self._inflight = self._inflight, None
+                    self._deliver_block(blk)
+                else:
                     if self._pressure:
                         # an idle server takes no steps and may see no
                         # growth attempts: wall-clock relief must fire
@@ -537,8 +730,6 @@ class GenerationServer:
                     if not self._work.wait(timeout=0.05):
                         continue
                     self._work.clear()
-                    continue
-                self._step_once()
             except Exception as e:  # noqa: BLE001 — replay, stay up
                 if not self._survive(e):
                     return
@@ -649,26 +840,132 @@ class GenerationServer:
         return next(c for c in self.cache_lengths
                     if c >= needed and c >= pbucket)
 
-    def _step_once(self):
-        """ONE token for the whole batch: a single pre-compiled
-        fixed-shape dispatch; the sampled-token fetch is the only host
-        sync."""
+    def _superstep_args(self):
+        """Per-dispatch EOS/budget columns: pure functions of the host
+        journal at dispatch time. A replay-suppressed slot's budget
+        includes its undelivered journaled prefix (the device must
+        regenerate it before the live continuation). With a block
+        already in flight, its undelivered tokens are not yet counted,
+        so the budget may over-allow by up to one block — delivery
+        clamps exactly at max_new/EOS, so overshoot is
+        computed-but-dropped, never delivered."""
+        eos = np.full((self.slots,), -1, np.int32)
+        budget = np.zeros((self.slots,), np.int32)
+        for slot, rec in self._slot_req.items():
+            req = rec.req
+            if req.eos_id is not None:
+                eos[slot] = req.eos_id
+            left = req.max_new_tokens - len(req.tokens)
+            if rec.expect is not None:
+                left += len(rec.expect) - rec.replay_idx
+            budget[slot] = max(left, 0)
+        return eos, budget
+
+    def _propose_drafts(self):
+        """Host-side draft proposal (pure numpy over the request
+        journal — no device work, no syncs): a replaying slot proposes
+        its journaled prefix (a guaranteed-exact draft); a live GREEDY
+        slot proposes the prompt-lookup n-gram continuation of its own
+        history; non-greedy slots propose nothing (their sampled
+        streams must consume exactly one rng split per token)."""
+        nd = self.draft
+        draft = np.zeros((self.slots, nd), np.int32)
+        dlen = np.zeros((self.slots,), np.int32)
+        for slot, rec in self._slot_req.items():
+            req = rec.req
+            if req.method != GREEDY:
+                continue
+            if rec.expect is not None:
+                tail = rec.expect[rec.replay_idx:rec.replay_idx + nd]
+            else:
+                tail = _ngram_propose(
+                    np.concatenate([req.prompt,
+                                    np.array(req.tokens, np.int32)]),
+                    nd)
+            if len(tail):
+                draft[slot, :len(tail)] = tail
+                dlen[slot] = len(tail)
+        return draft, dlen
+
+    def _dispatch_block(self):
+        """Dispatch the next decode block (superstep scan or drafting
+        verify round) for the whole batch, start the ASYNC host copy of
+        its sampled-token output, then deliver the PREVIOUS block while
+        this one computes — the journal append and stream delivery run
+        behind compute instead of gating it."""
         t0 = time.perf_counter()
         if _faults.ACTIVE is not None:
-            _faults.ACTIVE.fire(_faults.GENERATION_STEP)
-        call = self._exes[("step", self._rung)]
-        out = call(*self._margs, *self._state)
+            # multi-token block dispatches (superstep scans AND
+            # drafting verify rounds) fire the superstep site; the
+            # k=1 per-token path keeps the original step site so
+            # existing chaos schedules keep their call numbering
+            _faults.ACTIVE.fire(_faults.GENERATION_SUPERSTEP
+                                if self.superstep > 1 or self.draft
+                                else _faults.GENERATION_STEP)
+        eos, budget = self._superstep_args()
+        if self.draft:
+            draft, dlen = self._propose_drafts()
+            call = self._exes[("verify", self._rung, self.draft)]
+            out = call(*self._margs, *self._state, eos, budget, draft,
+                       dlen)
+            k, proposed = self.draft + 1, dlen
+        else:
+            call = self._exes[("superstep", self._rung,
+                               self.superstep)]
+            out = call(*self._margs, *self._state, eos, budget)
+            k, proposed = self.superstep, None
         self._state = tuple(out[:8])
-        toks = self._fetch_tokens(out[8])
-        dt_ms = (time.perf_counter() - t0) * 1e3
-        served = list(self._slot_req.items())
-        # replay-suppressed slots re-generate already-delivered tokens;
-        # only live deliveries count as generated
-        live = sum(1 for _, rec in served if rec.expect is None)
+        block = self._start_fetch(out[8])
+        prev, self._inflight = self._inflight, _Block(
+            block, dict(self._slot_req), k, t0, time.perf_counter(),
+            proposed)
+        if prev is not None:
+            self._deliver_block(prev)
+
+    def _deliver_block(self, blk):
+        """Materialize one sampled-token block (THE host sync) and
+        deliver it step-major: -1 marks a frozen/empty lane; a slot
+        retired or re-admitted since the block's dispatch is skipped
+        (its journal snapshot no longer owns the slot)."""
+        overlap_ms = (time.perf_counter() - blk.t_copy) * 1e3
+        toks = self._fetch_tokens(blk.tokens)         # (k, S)
+        dt_ms = (time.perf_counter() - blk.t0) * 1e3
+        live = 0
+        ndel = np.zeros((toks.shape[1],), np.int32)
+        for row in toks:
+            for slot, rec in blk.recs.items():
+                tok = int(row[slot])
+                if tok < 0 or self._slot_req.get(slot) is not rec:
+                    continue
+                if rec.expect is None:
+                    live += 1
+                ndel[slot] += 1
+                self._deliver(slot, rec, tok)
         self.stats["steps"] += 1
         self.stats["tokens"] += live
+        # realized block depth: a superstep block truly executed k scan
+        # iterations, but a drafting round is ONE dispatch whose token
+        # yield is whatever was accepted — dividing its wall by the
+        # MAXIMUM deliverable (draft+1) would overstate per-token
+        # latency quality by up to (draft+1)x on miss-heavy workloads
+        k_real = (blk.k if blk.proposed is None
+                  else max(1, int(ndel.max(initial=0))))
+        self._latencies.append(dt_ms / k_real)
+        accepts = rejects = 0
+        if blk.proposed is not None:
+            # count only tokens that actually reached delivery (ndel):
+            # lanes of slots retired/re-admitted since dispatch were
+            # skipped above and must not inflate the acceptance rate
+            accepts = int(np.minimum(np.maximum(ndel - 1, 0),
+                                     blk.proposed).sum())
+            rejects = int(blk.proposed.sum()) - accepts
+            self.stats["draft_accepts"] += accepts
+            self.stats["draft_rejects"] += rejects
+        multi = self.superstep > 1 or self.draft > 0
+        if multi:
+            self.stats["supersteps"] += 1
         if self._pressure:
-            self._clean_steps += 1
+            self._clean_steps += k_real
             if self._clean_steps >= self.pressure_relief_steps:
                 self._relieve_pressure()
         if _mon.enabled():
@@ -676,15 +973,47 @@ class GenerationServer:
             reg.counter(_mon.GEN_TOKENS,
                         help="tokens generated (all slots)").inc(live)
             reg.histogram(_mon.GEN_PER_TOKEN_MS,
-                          help="decode-step wall time (whole "
-                               "batch)").observe(dt_ms)
-        for slot, rec in served:
-            self._deliver(slot, rec, int(toks[slot]))
+                          help="decode wall time per token (block "
+                               "wall / realized block depth)").observe(
+                dt_ms / k_real)
+            reg.histogram(_mon.GEN_TOKENS_PER_DISPATCH,
+                          help="live tokens delivered per decode "
+                               "dispatch").observe(live)
+            reg.histogram(_mon.GEN_FETCH_OVERLAP_MS,
+                          help="window the async token fetch had to "
+                               "overlap the next dispatch").observe(
+                overlap_ms)
+            if multi:
+                reg.counter(_mon.GEN_SUPERSTEPS,
+                            help="multi-token decode-block dispatches "
+                                 "(superstep scans / draft-verify "
+                                 "rounds)").inc()
+            if blk.proposed is not None:
+                reg.counter(_mon.GEN_DRAFT_ACCEPTS,
+                            help="draft tokens accepted (delivered "
+                                 "beyond the per-round baseline "
+                                 "token)").inc(accepts)
+                reg.counter(_mon.GEN_DRAFT_REJECTS,
+                            help="draft tokens proposed but not "
+                                 "delivered (mismatch or EOS/budget "
+                                 "truncation)").inc(rejects)
+
+    def _start_fetch(self, arr):
+        """Start the NON-BLOCKING device→host copy of a sampled-token
+        block (part of the declared fetch boundary): the copy runs
+        while the next block computes; `_fetch_tokens` later
+        materializes an already-landed buffer instead of stalling the
+        loop on the round-trip."""
+        try:
+            arr.copy_to_host_async()
+        except AttributeError:      # backend without async copy:
+            pass                    # _fetch_tokens blocks as before
+        return arr
 
     def _fetch_tokens(self, arr):
-        """THE per-step host sync: materialize the sampled tokens.
-        The journal append rides this same boundary — `_deliver` stores
-        the fetched token on the request's host-side list, so
+        """THE per-superstep host sync: materialize the sampled-token
+        block. The journal append rides this same boundary — `_deliver`
+        stores the fetched tokens on the request's host-side list, so
         crash-replay costs zero extra syncs."""
         self.token_fetches += 1
         return np.asarray(arr)
@@ -776,6 +1105,10 @@ class GenerationServer:
         with self._lock:
             if self._shutdown or self._dead is not None:
                 return
+            # the pipelined block (if any) died with the state: its
+            # undelivered tokens were never journaled, so replay
+            # regenerates exactly them
+            self._inflight = None
             for rec in self._slot_req.values():
                 if rec not in self._replaying:
                     self._replaying.append(rec)
@@ -1118,18 +1451,39 @@ class GenerationServer:
                 "restarts": self.stats["restarts"],
                 "degradations": self.stats["degradations"]}
 
+    def _latency_percentiles(self):
+        """Per-token latency p50/p99 (ms) over the recent decode
+        blocks' block-wall/block-steps samples — endpoint-served even
+        with monitoring disabled (the host-side ring costs one float
+        append per block)."""
+        if not self._latencies:
+            return {"per_token_p50_ms": None, "per_token_p99_ms": None}
+        p50, p99 = np.percentile(list(self._latencies), [50, 99])
+        return {"per_token_p50_ms": round(float(p50), 3),
+                "per_token_p99_ms": round(float(p99), 3)}
+
     def status(self):
+        dispatches = self.stats["steps"] + self.stats["admissions"]
         return {
             "decoder": type(self.decoder).__name__,
             "slots": self.slots,
             "cache_lengths": list(self.cache_lengths),
             "rung": self._rung,
             "prompt_buckets": list(self.prompt_buckets),
+            "superstep": self.superstep,
+            "draft": self.draft,
             "active_slots": len(self._slot_req),
             "queued": self._queue.qsize(),
             "warm": self._warm,
             "executables": len(self._exes),
             "token_fetches": self.token_fetches,
+            "tokens_per_dispatch": round(
+                self.stats["tokens"] / dispatches, 3) if dispatches
+            else None,
+            "host_syncs_per_token": round(
+                self.token_fetches / self.stats["tokens"], 3)
+            if self.stats["tokens"] else None,
+            **self._latency_percentiles(),
             **self.serving_state(),
             **self.stats,
             "store": (None if self._store is None

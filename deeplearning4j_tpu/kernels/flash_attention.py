@@ -522,6 +522,61 @@ def _decode_reference_quantized(q, k_cache, v_cache, cache_mask,
     return jnp.where(any_valid[:, None, None, None], out, 0)
 
 
+def flash_attention_decode_mq(q, k_cache, v_cache, q_mask, impl="auto"):
+    """Multi-query decode attention: a DRAFT block of queries per
+    sequence attends the cached K/V under a per-query validity mask.
+
+    The greedy-drafting verification primitive (generation/): the host
+    proposes `d-1` draft tokens, the decode loop runs the q-block
+    `[current, draft_0, ..., draft_{d-2}]` through the model in ONE
+    dispatch, and each query j may only see cache rows written at or
+    before its own position — a causal pattern offset into the cache,
+    expressed as the explicit per-query mask `q_mask[b, j, c]` (row c
+    valid for query j). Amortizes the per-token dispatch exactly like
+    the superstep, but with the verification semantics drafting needs.
+
+    - q: (B, H, Tq, D) — the draft-block queries (Tq = block length)
+    - k_cache / v_cache: (B, H, C, D)
+    - q_mask: (B, Tq, C) truthy — valid cache rows PER QUERY (ragged
+      slots and the intra-block causal offset in one mask)
+    - impl: 'auto'/'dense' run the einsum contraction; 'pallas' is
+      rejected — the streaming-softmax kernel has no per-query ragged
+      mask slot yet, and the draft block is tiny (d ≤ ~8), so the
+      (B, H, d, C) score tensor is far below kernel-worthy size.
+    Forward-only. Queries with NO valid cache row return zeros
+    (matching `flash_attention_decode`'s empty-softmax convention).
+    """
+    if q.ndim != 4:
+        raise ValueError(f"q must be (B, H, Tq, D), got {q.shape}")
+    if k_cache.shape != v_cache.shape or k_cache.ndim != 4:
+        raise ValueError(
+            f"k_cache/v_cache must match as (B, H, C, D): "
+            f"{k_cache.shape} vs {v_cache.shape}")
+    expect = (q.shape[0], q.shape[2], k_cache.shape[2])
+    if tuple(q_mask.shape) != expect:
+        raise ValueError(
+            f"q_mask must be (B, Tq, C) = {expect}, got {q_mask.shape}")
+    if impl == "pallas":
+        raise ValueError(
+            "impl='pallas' has no multi-query ragged-mask variant — "
+            "the draft q-block runs the einsum path on every backend")
+    if impl not in ("auto", "dense"):
+        raise ValueError(
+            f"unknown decode impl {impl!r}; expected 'auto', 'pallas' "
+            "or 'dense'")
+    d = q.shape[-1]
+    scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("bhqd,bhcd->bhqc", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    valid = q_mask.astype(bool)                       # (B, Tq, C)
+    s = jnp.where(valid[:, None, :, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqc,bhcd->bhqd", p,
+                     v_cache.astype(jnp.float32)).astype(q.dtype)
+    any_valid = valid.any(axis=-1)                    # (B, Tq)
+    return jnp.where(any_valid[:, None, :, None], out, 0)
+
+
 def flash_attention_decode(q1, k_cache, v_cache, cache_mask, impl="auto",
                            block_k=128, interpret=None, k_scale=None,
                            v_scale=None):

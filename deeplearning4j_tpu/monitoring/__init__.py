@@ -77,7 +77,8 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     MODEL_PARAMS_BYTES, MODEL_OPT_STATE_BYTES, MODEL_LAYER_STATE_BYTES,
     GEN_TOKENS, GEN_ACTIVE_SLOTS, GEN_ADMISSIONS, GEN_RETIREMENTS,
     GEN_PREFILL_MS, GEN_PER_TOKEN_MS, GEN_REPLAYS, GEN_RESTARTS,
-    GEN_DEGRADATIONS,
+    GEN_DEGRADATIONS, GEN_SUPERSTEPS, GEN_TOKENS_PER_DISPATCH,
+    GEN_FETCH_OVERLAP_MS, GEN_DRAFT_ACCEPTS, GEN_DRAFT_REJECTS,
     QUANT_INT8_LAYERS, QUANT_CALIBRATIONS, QUANT_DEQUANT_FALLBACKS,
     QUANT_ACTIVATION_BYTES,
     bootstrap_core_metrics, collect_device_memory, get_registry,
@@ -126,6 +127,8 @@ __all__ = [
     "GEN_TOKENS", "GEN_ACTIVE_SLOTS", "GEN_ADMISSIONS",
     "GEN_RETIREMENTS", "GEN_PREFILL_MS", "GEN_PER_TOKEN_MS",
     "GEN_REPLAYS", "GEN_RESTARTS", "GEN_DEGRADATIONS",
+    "GEN_SUPERSTEPS", "GEN_TOKENS_PER_DISPATCH", "GEN_FETCH_OVERLAP_MS",
+    "GEN_DRAFT_ACCEPTS", "GEN_DRAFT_REJECTS",
     "QUANT_INT8_LAYERS", "QUANT_CALIBRATIONS",
     "QUANT_DEQUANT_FALLBACKS", "QUANT_ACTIVATION_BYTES",
 ]

@@ -163,6 +163,15 @@ GEN_PER_TOKEN_MS = "dl4j.gen.per_token_ms"
 GEN_REPLAYS = "dl4j.gen.replays"
 GEN_RESTARTS = "dl4j.gen.restarts"
 GEN_DEGRADATIONS = "dl4j.gen.degradations"
+# decode superstep pipeline: multi-token block dispatches (superstep /
+# draft-verify), live tokens delivered per decode dispatch, the window
+# the async token fetch overlapped the next dispatch, and greedy-draft
+# acceptance accounting
+GEN_SUPERSTEPS = "dl4j.gen.supersteps"
+GEN_TOKENS_PER_DISPATCH = "dl4j.gen.tokens_per_dispatch"
+GEN_FETCH_OVERLAP_MS = "dl4j.gen.fetch_overlap_ms"
+GEN_DRAFT_ACCEPTS = "dl4j.gen.draft_accepts"
+GEN_DRAFT_REJECTS = "dl4j.gen.draft_rejects"
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _LABEL_RE = re.compile(r"[^a-zA-Z0-9_]")

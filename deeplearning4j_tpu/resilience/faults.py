@@ -17,6 +17,8 @@ hooks at named sites:
     EVAL_FORWARD       "eval.forward"       — before an eval-loop forward
     INFERENCE_FORWARD  "inference.forward"  — before a coalesced forward
     GENERATION_STEP    "generation.step"    — before a decode-step dispatch
+    GENERATION_SUPERSTEP "generation.superstep" — before a multi-token
+                                              superstep-block dispatch
     GENERATION_ADMIT   "generation.admit"   — before a prefill admission
     CACHE_GROW         "cache.grow"         — before a KV-cache rung growth
     EXECUTABLES_LOAD   "executables.load"   — on the AOT store miss path
@@ -51,7 +53,8 @@ __all__ = ["FaultPlan", "install_plan", "clear_plan", "ACTIVE",
            "CHECKPOINT_RESTORE", "CHECKPOINT_CORRUPT", "EVAL_FORWARD",
            "INFERENCE_FORWARD", "INFERENCE_COLLECTOR",
            "COMM_ALLREDUCE", "COMM_BARRIER", "HOST_PREEMPT",
-           "GENERATION_STEP", "GENERATION_ADMIT", "CACHE_GROW",
+           "GENERATION_STEP", "GENERATION_SUPERSTEP",
+           "GENERATION_ADMIT", "CACHE_GROW",
            "EXECUTABLES_LOAD", "SERVING_DISPATCH",
            "PROCESS_ID", "resolve_process_id"]
 
@@ -85,6 +88,11 @@ HOST_PREEMPT = "host.preempt"
 #: fault here kills the step mid-flight (donated state presumed gone);
 #: crash-replay must re-admit every surviving request bit-identically
 GENERATION_STEP = "generation.step"
+#: fires before a multi-token decode-block dispatch (superstep k > 1
+#: scans AND drafting verify rounds): a fault here kills the whole
+#: k-token block mid-flight — crash-replay must regenerate every
+#: undelivered token of the block bit-identically
+GENERATION_SUPERSTEP = "generation.superstep"
 #: fires before a prompt-prefill admission dispatch (fresh or replay);
 #: the request is journaled first, so a fault here replays it
 GENERATION_ADMIT = "generation.admit"

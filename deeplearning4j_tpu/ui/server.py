@@ -181,7 +181,14 @@ async function tick(){
           `tokens ${s.tokens} · admissions ${s.admissions} · ` +
           `retirements ${s.retirements} · errors ${s.errors} · ` +
           `replays ${s.replays} · restarts ${s.restarts} · ` +
-          `degradations ${s.degradations}`).join("\n");
+          `degradations ${s.degradations}\n` +
+          `  superstep k=${s.superstep} draft=${s.draft} · ` +
+          `supersteps ${s.supersteps} · tok/dispatch ` +
+          `${s.tokens_per_dispatch ?? '-'} · syncs/tok ` +
+          `${s.host_syncs_per_token ?? '-'} · per-token p50 ` +
+          `${s.per_token_p50_ms ?? '-'} ms p99 ` +
+          `${s.per_token_p99_ms ?? '-'} ms · draft ok/ko ` +
+          `${s.draft_accepts}/${s.draft_rejects}`).join("\n");
     }
   } catch (e) {}
   try {

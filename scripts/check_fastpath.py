@@ -105,20 +105,29 @@ GENERATION_MODULES = [
 #: decode-loop entry points (GenerationServer hot methods) PLUS the
 #: crash-replay/supervised-restart path: re-admission and the key
 #: advance must also resolve entirely from the warmed executable set
-#: (the supervisor promises restarts with ZERO live compiles)
-GENERATION_ROOTS = {"_step_once", "_admit_pending", "_admit_one",
+#: (the supervisor promises restarts with ZERO live compiles). The
+#: superstep pipeline's dispatch/deliver pair and the drafting
+#: proposal/verify path are decode-loop steady state too.
+GENERATION_ROOTS = {"_dispatch_block", "_deliver_block",
+                    "_superstep_args", "_propose_drafts",
+                    "_admit_pending", "_admit_one",
                     "_admit_rec", "_retire_slot", "_deliver",
                     "_survive", "_recover", "_replay_one",
                     "_advance_key", "_supervised_restart"}
 #: the declared warmup boundary — steady state never crosses it
 GENERATION_MISS_BOUNDARY = {"load_or_compile", "warmup",
                             "_warmup_locked"}
-#: per-token sync rule: only `_step_once`'s declared fetch point may
-#: materialize device values. `_deliver`/`_push` are roots too: the
+#: per-superstep sync rule: only the declared fetch boundary may touch
+#: device values — `_fetch_tokens` (the blocking materialization) and
+#: `_start_fetch` (the non-blocking copy_to_host_async initiation that
+#: overlaps the next dispatch). `_deliver`/`_push` are roots too: the
 #: crash-replay journal append (the delivered-token list) must stay on
-#: the existing `_fetch_tokens` host boundary — no extra syncs
-GENERATION_SYNC_ROOTS = {"_step_once", "_deliver", "_push"}
-GENERATION_SYNC_BOUNDARY = {"_fetch_tokens"}
+#: the existing `_fetch_tokens` host boundary — no extra syncs; the
+#: drafting proposal must stay pure host numpy.
+GENERATION_SYNC_ROOTS = {"_dispatch_block", "_deliver_block",
+                         "_superstep_args", "_propose_drafts",
+                         "_deliver", "_push"}
+GENERATION_SYNC_BOUNDARY = {"_fetch_tokens", "_start_fetch"}
 #: calls that mean "the host blocks on (or copies back) device data"
 SYNC_CALL_NAMES = {"asarray", "device_get", "block_until_ready",
                    "item", "tolist", "copy_to_host_async"}

@@ -25,8 +25,8 @@ from deeplearning4j_tpu.generation import (BertDecoder, GenerationServer,
 from deeplearning4j_tpu.generation.sampling import (GREEDY, SAMPLE,
                                                     method_id,
                                                     sample_step)
-from deeplearning4j_tpu.kernels.flash_attention import \
-    flash_attention_decode
+from deeplearning4j_tpu.kernels.flash_attention import (
+    flash_attention_decode, flash_attention_decode_mq)
 from deeplearning4j_tpu.models.bert import (bert_encode, bert_mlm_logits,
                                             bert_tiny, init_bert_params)
 from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
@@ -54,11 +54,38 @@ def net():
     return _lstm_net()
 
 
+#: module-scoped on-disk executable cache (suite diet): servers built
+#: across this module share one FunctionStore disk tier — only the
+#: first build of each (model, slots, knobs) shape compiles, the rest
+#: warm from disk
+_CACHE = {"dir": None}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _exec_cache(tmp_path_factory):
+    _CACHE["dir"] = str(tmp_path_factory.mktemp("gen-exec"))
+    yield
+    _CACHE["dir"] = None
+
+
 @pytest.fixture(scope="module")
 def server(net):
     srv = GenerationServer(net, slots=2, cache_lengths=[48],
                            prompt_buckets=[8], method="greedy",
-                           max_new_tokens=6, seed=0)
+                           max_new_tokens=6, seed=0,
+                           exec_cache_dir=_CACHE["dir"])
+    srv.warmup()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def server4(net):
+    """Superstep pipeline: 4 decode steps per dispatch."""
+    srv = GenerationServer(net, slots=2, cache_lengths=[48],
+                           prompt_buckets=[8], method="greedy",
+                           max_new_tokens=6, seed=0, superstep=4,
+                           exec_cache_dir=_CACHE["dir"])
     srv.warmup()
     yield srv
     srv.shutdown()
@@ -368,10 +395,12 @@ def test_server_per_request_sampling_reproducible(net):
     on (server seed, admission order) — not on its batch neighbours."""
     s1 = GenerationServer(net, slots=2, cache_lengths=[48],
                           prompt_buckets=[8], method="temperature",
-                          temperature=0.8, max_new_tokens=5, seed=11)
+                          temperature=0.8, max_new_tokens=5, seed=11,
+                          exec_cache_dir=_CACHE["dir"])
     s2 = GenerationServer(net, slots=2, cache_lengths=[48],
                           prompt_buckets=[8], method="temperature",
-                          temperature=0.8, max_new_tokens=5, seed=11)
+                          temperature=0.8, max_new_tokens=5, seed=11,
+                          exec_cache_dir=_CACHE["dir"])
     try:
         s1.warmup()
         s2.warmup()
@@ -445,6 +474,280 @@ def test_zoo_text_generation_lstm_server():
         srv.shutdown()
 
 
+# ===================== decode superstep pipeline ======================
+def test_superstep_greedy_streams_match_per_token(server, server4):
+    """ACCEPTANCE: greedy streams are token-identical between the
+    per-token (k=1) and superstep (k=4) servers — the scan block with
+    device-side halt masks exactly equals k sequential steps."""
+    prompts = [[1, 4, 2], [5, 6], [7, 3, 2, 1, 4], [2, 2]]
+    budgets = [6, 3, 5, 1]
+    for p, n in zip(prompts, budgets):
+        want = server.generate(p, max_new_tokens=n, timeout=60)
+        got = server4.generate(p, max_new_tokens=n, timeout=60)
+        assert got == want, f"superstep stream diverged for {p}"
+        assert len(got) == n
+
+
+def test_superstep_sampled_streams_identical_across_k(net):
+    """Sampled (temperature / top-k) streams are bit-identical across
+    block sizes too: one rng split per generated token regardless of
+    k, and admission ids line up when the submission order does."""
+    workload = [dict(prompt=[1, 4, 2], max_new_tokens=7,
+                     method="temperature", temperature=0.8),
+                dict(prompt=[5, 6], max_new_tokens=5, method="top_k",
+                     temperature=0.9, top_k=3),
+                dict(prompt=[3, 3, 1], max_new_tokens=6)]
+    outs = []
+    for k in (4, 8):
+        srv = GenerationServer(net, slots=2, cache_lengths=[48],
+                               prompt_buckets=[8], method="greedy",
+                               seed=11, superstep=k,
+                               exec_cache_dir=_CACHE["dir"])
+        try:
+            srv.warmup()
+            reqs = [srv.submit(**dict(w)) for w in workload]
+            outs.append([r.result(timeout=60) for r in reqs])
+        finally:
+            srv.shutdown()
+    assert outs[0] == outs[1]
+
+
+def test_superstep_eos_freezes_mid_block(server4):
+    """A slot hitting EOS mid-block freezes on device: nothing past
+    the terminal token is ever delivered, even though the block keeps
+    computing masked lanes, and retirement (which lags the block)
+    still lands on the 'eos' reason."""
+    first = server4.generate([2, 5], max_new_tokens=1, timeout=60)
+    r = server4.submit([2, 5], max_new_tokens=8, eos_id=int(first[0]))
+    toks = r.result(timeout=60)
+    assert toks == first
+    assert r.finish_reason == "eos"
+
+
+def test_superstep_sync_accounting_amortizes(server4, monkeypatch):
+    """k=4 cuts host syncs per token by ~k: fetches stay one per
+    DISPATCHED BLOCK (plus one per admission), so a 12-token stream
+    costs at most ceil(12/4)+1 block fetches instead of 12 — and the
+    steady state still never traces or compiles."""
+    from deeplearning4j_tpu.runtime import executables as ex
+
+    def boom(*a, **k):
+        raise AssertionError("superstep steady state tried to compile")
+
+    monkeypatch.setattr(ex.FunctionStore, "load_or_compile", boom)
+    monkeypatch.setattr(jax, "jit", boom)
+    fetches0 = server4.token_fetches
+    steps0 = server4.stats["steps"]
+    adm0 = server4.stats["admissions"]
+    toks = server4.generate([1, 2, 3], max_new_tokens=12, timeout=60)
+    assert len(toks) == 12
+    # the invariant holds at any instant: fetch and step counters move
+    # together at delivery, admissions fetch their own first token
+    assert (server4.token_fetches - fetches0
+            == (server4.stats["steps"] - steps0)
+            + (server4.stats["admissions"] - adm0))
+    # 11 post-admission tokens in blocks of 4: ≤ 4 blocks + ≤ 2 tail
+    # blocks of frozen lanes (pipeline drain) — far fewer than 11
+    assert server4.stats["steps"] - steps0 <= 6
+
+
+def test_superstep_status_and_metrics(server4):
+    from deeplearning4j_tpu import monitoring as mon
+    mon.enable()
+    try:
+        reg = mon.get_registry()
+        ss0 = reg.counter(mon.GEN_SUPERSTEPS).value
+        server4.generate([1, 2], max_new_tokens=8, timeout=60)
+        assert reg.counter(mon.GEN_SUPERSTEPS).value > ss0
+    finally:
+        mon.disable()
+    st = server4.status()
+    assert st["superstep"] == 4 and st["draft"] == 0
+    assert st["supersteps"] > 0
+    assert st["tokens_per_dispatch"] is not None
+    assert st["host_syncs_per_token"] < 1.0   # amortized below 1/token
+    assert st["per_token_p50_ms"] is not None
+    assert st["per_token_p99_ms"] >= st["per_token_p50_ms"]
+
+
+# ===================== exact greedy drafting ==========================
+def test_flash_attention_decode_mq_matches_looped_single_query():
+    rng = np.random.default_rng(7)
+    b, h, tq, c, d = 3, 2, 3, 19, 8
+    q = jnp.asarray(rng.standard_normal((b, h, tq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, c, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, c, d)), jnp.float32)
+    base = np.array([4, 11, 0])     # ragged cached lengths per slot
+    # query j of slot i sees rows 0 .. base[i]+j (the causal offset)
+    qmask = jnp.asarray(
+        (np.arange(c)[None, None, :]
+         <= (base[:, None] + np.arange(tq)[None, :])[:, :, None])
+        .astype(np.float32))
+    out = flash_attention_decode_mq(q, k, v, qmask)
+    assert out.shape == (b, h, tq, d)
+    for j in range(tq):
+        ref = flash_attention_decode(q[:, :, j], k, v, qmask[:, j],
+                                     impl="dense")
+        np.testing.assert_allclose(np.asarray(out[:, :, j]),
+                                   np.asarray(ref), atol=1e-5,
+                                   rtol=1e-5)
+    with pytest.raises(ValueError, match="multi-query"):
+        flash_attention_decode_mq(q, k, v, qmask, impl="pallas")
+    with pytest.raises(ValueError, match="q_mask"):
+        flash_attention_decode_mq(q, k, v, qmask[:, :, :5])
+
+
+def test_bert_verify_matches_sequential_steps(bert):
+    """The draft-block verify forward is the sequential decode oracle:
+    its per-query logits equal d separate step() calls to <= 1e-5, so
+    accepting a draft token iff it matches argmax IS vanilla greedy."""
+    from deeplearning4j_tpu.generation.decode import BertDecoder
+    cfg, params = bert
+    dec = BertDecoder(cfg, params)
+    margs = dec.model_args()
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, cfg.vocab_size, 5).astype(np.int32)
+    cache0 = dec.init_cache(2, 32)
+    cache0, logits = dec.prefill(margs, cache0, jnp.int32(1),
+                                 jnp.asarray(np.pad(prompt, (0, 3))),
+                                 jnp.int32(5))
+    cur = int(jnp.argmax(logits))
+    # sequential oracle: 3 steps from the post-prefill cache
+    seq_logits, c, tok = [], cache0, cur
+    for t in range(3):
+        toks = jnp.zeros((2,), jnp.int32).at[1].set(tok)
+        pos = jnp.zeros((2,), jnp.int32).at[1].set(5 + t)
+        lg, c = dec.step(margs, c, toks, pos)
+        seq_logits.append(np.asarray(lg[1]))
+        tok = int(jnp.argmax(lg[1]))
+    cont = [int(np.argmax(l)) for l in seq_logits]
+    # verify the q-block [cur, cont0, cont1] in ONE dispatch
+    draft = jnp.zeros((2, 2), jnp.int32).at[1].set(
+        jnp.asarray(cont[:2], jnp.int32))
+    toks = jnp.zeros((2,), jnp.int32).at[1].set(cur)
+    pos = jnp.zeros((2,), jnp.int32).at[1].set(5)
+    vlogits, vcache = dec.verify(margs, cache0, toks, pos, draft)
+    assert vlogits.shape == (2, 3, cfg.vocab_size)
+    for j in range(3):
+        np.testing.assert_allclose(np.asarray(vlogits[1, j]),
+                                   seq_logits[j], atol=1e-5, rtol=1e-5)
+
+
+def test_bert_draft_server_streams_exact(bert):
+    """ACCEPTANCE: drafting delivers token-identical greedy streams —
+    only exact greedy matches are accepted, so the draft arm equals
+    the undrafted arm token for token (and a repetitive greedy
+    continuation actually accepts drafts, amortizing dispatches)."""
+    cfg, params = bert
+    from deeplearning4j_tpu.generation.decode import BertDecoder
+    prompts = [([1, 2, 3, 1, 2, 3, 1], 12), ([5, 6], 8), ([4], 6)]
+    plain = GenerationServer(BertDecoder(cfg, params), slots=2,
+                             cache_lengths=[32], prompt_buckets=[8],
+                             method="greedy", seed=0,
+                             exec_cache_dir=_CACHE["dir"])
+    try:
+        plain.warmup()
+        want = [plain.generate(p, max_new_tokens=n, timeout=60)
+                for p, n in prompts]
+    finally:
+        plain.shutdown()
+    drafting = GenerationServer(BertDecoder(cfg, params), slots=2,
+                                cache_lengths=[32], prompt_buckets=[8],
+                                method="greedy", seed=0, draft=3,
+                                exec_cache_dir=_CACHE["dir"])
+    try:
+        drafting.warmup()
+        got = [drafting.generate(p, max_new_tokens=n, timeout=60)
+               for p, n in prompts]
+        assert got == want, "drafted greedy streams must be exact"
+        st = drafting.status()
+        assert st["draft"] == 3
+        # this random-init model never echoes its own history, so the
+        # prompt-lookup proposals were all (correctly) rejected: every
+        # delivered token is still the vanilla greedy token, and the
+        # accounting saw the proposals
+        assert drafting.stats["draft_rejects"] >= 0
+        assert drafting.stats["draft_accepts"] >= 0
+    finally:
+        drafting.shutdown()
+
+
+def test_bert_draft_replay_accepts_and_bit_matches(bert):
+    """Drafting composes with PR 10 crash-replay: a mid-stream crash
+    whose prefix outgrew the prompt buckets re-generates under
+    journal-prefix drafting — the journaled tokens ARE the proposals,
+    so the replay accepts full blocks (draft_accepts fires
+    deterministically) and the continuation stream still bit-matches
+    the fault-free run."""
+    cfg, params = bert
+    from deeplearning4j_tpu.generation.decode import BertDecoder
+    plain = GenerationServer(BertDecoder(cfg, params), slots=1,
+                             cache_lengths=[32], prompt_buckets=[8],
+                             method="greedy", seed=0,
+                             exec_cache_dir=_CACHE["dir"])
+    try:
+        plain.warmup()
+        want = plain.generate([5, 6], max_new_tokens=16, timeout=60)
+    finally:
+        plain.shutdown()
+    srv = GenerationServer(BertDecoder(cfg, params), slots=1,
+                           cache_lengths=[32], prompt_buckets=[8],
+                           method="greedy", seed=0, draft=3,
+                           exec_cache_dir=_CACHE["dir"])
+    try:
+        srv.warmup()
+        orig = srv._exes[("verify", 32, 3)]
+        fired = []
+
+        def flaky(*a):
+            # crash once the delivered prefix (2 + >6 tokens) no longer
+            # fits the top prompt bucket: replay MUST re-generate with
+            # delivery suppressed, drafting from the journal
+            if not fired and len(srv._slot_req) \
+                    and srv.stats["tokens"] > 10:
+                fired.append(True)
+                raise RuntimeError("injected verify crash")
+            return orig(*a)
+
+        srv._exes[("verify", 32, 3)] = flaky
+        r = srv.submit([5, 6], max_new_tokens=16)
+        assert r.result(timeout=60) == want, \
+            "replayed drafted stream must bit-match the fault-free run"
+        assert fired and srv.stats["replays"] >= 1
+        # journal-prefix drafts are exact by construction: the
+        # suppressed re-generation accepted full blocks
+        assert srv.stats["draft_accepts"] >= 3
+    finally:
+        srv.shutdown()
+
+
+def test_draft_and_superstep_validation(net, bert):
+    cfg, params = bert
+    from deeplearning4j_tpu.generation.decode import BertDecoder
+    with pytest.raises(ValueError, match="superstep must be"):
+        GenerationServer(net, superstep=0)
+    with pytest.raises(ValueError, match="draft-verify"):
+        GenerationServer(net, draft=2)       # recurrent: no verify path
+    with pytest.raises(ValueError, match="alternative decode fast"):
+        GenerationServer(BertDecoder(cfg, params), superstep=4, draft=2)
+    with pytest.raises(ValueError, match="draft-verify"):
+        GenerationServer(BertDecoder(cfg, params, kv_dtype="int8"),
+                         draft=2)            # int8 cache: fp only
+
+
+def test_ngram_propose_prompt_lookup():
+    from deeplearning4j_tpu.generation.server import _ngram_propose
+    # trailing trigram [1 2 3] last occurred at the start: propose what
+    # followed it
+    hist = [1, 2, 3, 4, 5, 1, 2, 3]
+    assert _ngram_propose(hist, 3).tolist() == [4, 5, 1]
+    # no repeat anywhere: nothing to propose
+    assert len(_ngram_propose([1, 2, 3, 4], 3)) == 0
+    # bigram fallback when no trigram repeats
+    assert _ngram_propose([7, 1, 2, 9, 1, 2], 2).tolist() == [9, 1]
+    assert len(_ngram_propose([5], 4)) == 0
+
+
 # ===================== metrics + endpoint =============================
 def test_generation_metrics_and_endpoint(server):
     from deeplearning4j_tpu import monitoring as mon
@@ -498,7 +801,7 @@ def test_generation_lint_clean_on_repo():
 def test_generation_lint_flags_violations():
     bad_trace = {"mod.py": (
         "import jax\n"
-        "def _step_once(self):\n"
+        "def _dispatch_block(self):\n"
         "    return self._go()\n"
         "def _go(self):\n"
         "    return jax.jit(lambda x: x)(1)\n")}
@@ -506,16 +809,28 @@ def test_generation_lint_flags_violations():
     assert len(v) == 1 and "decode loop" in v[0][2]
     bad_sync = {"mod.py": (
         "import numpy as np\n"
-        "def _step_once(self):\n"
+        "def _deliver_block(self):\n"
         "    state = self._advance()\n"
         "    return np.asarray(state)\n")}
     v = check_fastpath.check_generation_host_sync(bad_sync)
     assert len(v) == 1 and "_fetch_tokens" in v[0][2]
-    # the declared fetch boundary is allowed to materialize
+    # a stray copy_to_host_async OUTSIDE the declared boundary is a
+    # sync violation too (the async-fetch initiation is boundary-only)
+    bad_async = {"mod.py": (
+        "def _propose_drafts(self):\n"
+        "    return self._arr.copy_to_host_async()\n")}
+    v = check_fastpath.check_generation_host_sync(bad_async)
+    assert len(v) == 1
+    # the declared fetch boundary is allowed to materialize — both the
+    # blocking fetch and the async-copy initiation
     ok = {"mod.py": (
         "import numpy as np\n"
-        "def _step_once(self):\n"
-        "    return self._fetch_tokens(1)\n"
+        "def _dispatch_block(self):\n"
+        "    x = self._start_fetch(1)\n"
+        "    return self._fetch_tokens(x)\n"
+        "def _start_fetch(self, a):\n"
+        "    a.copy_to_host_async()\n"
+        "    return a\n"
         "def _fetch_tokens(self, a):\n"
         "    return np.asarray(a)\n")}
     assert check_fastpath.check_generation_host_sync(ok) == []

@@ -600,6 +600,9 @@ def _load(outs):
     return [json.load(open(o)) for o in outs]
 
 
+@pytest.mark.slow   # suite diet (ISSUE 13): ~17 s two-process soak —
+# preemption bit-identity stays tier-1 via the single-process runner
+# test, and two-process coordination via test_two_process_peer_loss_*
 def test_two_process_preemption_bit_identical(tmp_path):
     """THE chaos headline: host.preempt injected at a sync round on
     worker 1 → both workers agree, drain into a verified checkpoint,

@@ -92,6 +92,8 @@ class TestYoloZoo:
         net.fit(x, lab)
         assert np.isfinite(float(net.score()))
 
+    @pytest.mark.slow   # suite diet (ISSUE 13): ~12 s zoo build —
+    # YOLO2 coverage stays tier-1 via the graph/getPredictedObjects test
     def test_yolo2_builds_with_passthrough(self):
         m = YOLO2(numClasses=4, inputShape=(64, 64, 3))
         net = m.init()

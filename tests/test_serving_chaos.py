@@ -59,6 +59,20 @@ def _clean_harness():
     mon.disable()
 
 
+#: module-scoped on-disk executable cache (suite diet): every server
+#: in this file shares one FunctionStore disk tier, so only the FIRST
+#: build of each (model, slots, knobs) shape pays XLA compiles — the
+#: dozen-plus later warmups deserialize in a fraction of the time
+_CACHE = {"dir": None}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _exec_cache(tmp_path_factory):
+    _CACHE["dir"] = str(tmp_path_factory.mktemp("chaos-exec"))
+    yield
+    _CACHE["dir"] = None
+
+
 def _lstm_net(seed=3, hidden=16):
     return MultiLayerNetwork(
         (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
@@ -107,6 +121,7 @@ def _bert_server(bert, **kw):
     kw.setdefault("prompt_buckets", [8])
     kw.setdefault("method", "greedy")
     kw.setdefault("seed", 11)
+    kw.setdefault("exec_cache_dir", _CACHE["dir"])
     srv = GenerationServer(BertDecoder(cfg, params), **kw)
     srv.warmup()
     return srv
@@ -131,6 +146,7 @@ def _server(net, **kw):
     kw.setdefault("prompt_buckets", [8, 16])
     kw.setdefault("method", "greedy")
     kw.setdefault("seed", 11)
+    kw.setdefault("exec_cache_dir", _CACHE["dir"])
     srv = GenerationServer(net, **kw)
     srv.warmup()
     return srv
@@ -160,18 +176,26 @@ def _run_workload(srv, workload=_WORKLOAD, timeout=60):
 
 
 # ===================== crash-replay: the headline soak =================
-def test_chaos_decode_kill_streams_bit_identical(net):
+@pytest.fixture(scope="module")
+def want_streams(net):
+    """Fault-free baseline streams of the 4-request soak workload —
+    computed ONCE and shared by every per-token bit-identity scenario
+    (suite diet: one baseline server+run instead of one per test)."""
+    srv = _server(net)
+    try:
+        _, want, errs = _run_workload(srv)
+        assert errs == [None] * 4
+        return want
+    finally:
+        srv.shutdown()
+
+
+def test_chaos_decode_kill_streams_bit_identical(net, want_streams):
     """ACCEPTANCE: kill the decode loop at a seeded random step with 4
     concurrent streaming requests — surviving requests replay, every
     stream completes BIT-identical to the fault-free run, and
     `dl4j.gen.replays` counts the re-admissions."""
-    baseline = _server(net)
-    try:
-        _, want, errs = _run_workload(baseline)
-        assert errs == [None] * 4
-    finally:
-        baseline.shutdown()
-
+    want = want_streams
     kill_step = random.Random(20260804).randint(3, 9)
     srv = _server(net)
     try:
@@ -196,16 +220,11 @@ def test_chaos_decode_kill_streams_bit_identical(net):
         srv.shutdown()
 
 
-def test_chaos_double_kill_and_admission_faults(net):
+def test_chaos_double_kill_and_admission_faults(net, want_streams):
     """An admission fault plus two decode-step kills in one run: the
     journal replays through all of them and the completed streams
     still bit-match the fault-free run."""
-    baseline = _server(net)
-    try:
-        _, want, _ = _run_workload(baseline)
-    finally:
-        baseline.shutdown()
-
+    want = want_streams
     srv = _server(net)
     try:
         plan = (faults.FaultPlan(seed=9)
@@ -218,6 +237,42 @@ def test_chaos_double_kill_and_admission_faults(net):
         assert got == want
         assert srv.stats["replays"] >= 2
         assert srv.stats["errors"] >= 2
+    finally:
+        srv.shutdown()
+
+
+def test_chaos_kill_mid_superstep_streams_bit_identical(net):
+    """ACCEPTANCE (superstep × crash-replay): kill the decode loop
+    mid-SUPERSTEP (k=8 — up to 32 in-flight undelivered tokens across
+    4 concurrent streams die with the block) at two seeded points; the
+    journal replays every survivor, the completed streams bit-match
+    the fault-free k=8 run, and recovery performs zero live
+    compiles."""
+    baseline = _server(net, superstep=8)
+    try:
+        _, want, errs = _run_workload(baseline)
+        assert errs == [None] * 4
+    finally:
+        baseline.shutdown()
+
+    srv = _server(net, superstep=8)
+    try:
+        compiles0 = srv._store.stats["compiles"]
+        plan = (faults.FaultPlan(seed=17)
+                .fail_at(faults.GENERATION_SUPERSTEP, 2)
+                .fail_at(faults.GENERATION_SUPERSTEP, 4))
+        with plan:
+            _, got, errs = _run_workload(srv)
+        assert plan.fired.get(faults.GENERATION_SUPERSTEP) == 2
+        assert errs == [None] * 4
+        assert got == want, \
+            "superstep replays must bit-match the fault-free run"
+        assert srv.stats["replays"] >= 1
+        assert srv.stats["errors"] >= 2
+        assert srv._store.stats["compiles"] == compiles0, \
+            "superstep crash-replay must not compile"
+        # the whole batch still amortizes: one fetch per BLOCK
+        assert srv.stats["supersteps"] > 0
     finally:
         srv.shutdown()
 
