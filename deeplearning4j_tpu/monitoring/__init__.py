@@ -69,6 +69,8 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     WATCHDOG_STALLS, WATCHDOG_BEAT_AGE_SECONDS, WATCHDOG_DUMPS,
     DIST_PEERS, DIST_PEER_LOST, DIST_PREEMPTIONS,
     DIST_BARRIER_TIMEOUTS, DIST_ENCODED_BYTES, DIST_RESIDUAL_NORM,
+    DIST_ACCUM_MICROBATCHES, DIST_EXCHANGE_BUCKETS, DIST_BUCKET_BYTES,
+    DIST_EXPOSED_EXCHANGE_MS, DIST_ENCODER_MIGRATIONS,
     PIPELINE_SYNCS, PIPELINE_HOST_BLOCKED_MS, PIPELINE_PREFETCH_DEPTH,
     PIPELINE_STAGED_BATCHES,
     PROFILE_SESSIONS, PROFILE_CAPTURED_STEPS, PROFILE_DEVICE_MS,
@@ -122,6 +124,9 @@ __all__ = [
     "WATCHDOG_STALLS", "WATCHDOG_BEAT_AGE_SECONDS", "WATCHDOG_DUMPS",
     "DIST_PEERS", "DIST_PEER_LOST", "DIST_PREEMPTIONS",
     "DIST_BARRIER_TIMEOUTS", "DIST_ENCODED_BYTES", "DIST_RESIDUAL_NORM",
+    "DIST_ACCUM_MICROBATCHES", "DIST_EXCHANGE_BUCKETS",
+    "DIST_BUCKET_BYTES", "DIST_EXPOSED_EXCHANGE_MS",
+    "DIST_ENCODER_MIGRATIONS",
     "PIPELINE_SYNCS", "PIPELINE_HOST_BLOCKED_MS", "PIPELINE_PREFETCH_DEPTH",
     "PIPELINE_STAGED_BATCHES",
     "GEN_TOKENS", "GEN_ACTIVE_SLOTS", "GEN_ADMISSIONS",

@@ -110,6 +110,14 @@ DIST_PREEMPTIONS = "dl4j.dist.preemptions"
 DIST_BARRIER_TIMEOUTS = "dl4j.dist.barrier_timeouts"
 DIST_ENCODED_BYTES = "dl4j.dist.encoded_bytes"
 DIST_RESIDUAL_NORM = "dl4j.dist.residual_norm"
+# in-step accumulation + bucketed/overlapped exchange (parallel/buckets
+# + the accumulating trainer steps): configured knobs and the measured
+# standalone exchange cost (the time overlap exists to hide)
+DIST_ACCUM_MICROBATCHES = "dl4j.dist.accum_microbatches"
+DIST_EXCHANGE_BUCKETS = "dl4j.dist.exchange_buckets"
+DIST_BUCKET_BYTES = "dl4j.dist.bucket_bytes"
+DIST_EXPOSED_EXCHANGE_MS = "dl4j.dist.exposed_exchange_ms"
+DIST_ENCODER_MIGRATIONS = "dl4j.dist.encoder_migrations"
 
 # host pipeline (runtime/pipeline.py): is the host running ahead of the
 # device, or blocking on it? `syncs` counts every host-blocking

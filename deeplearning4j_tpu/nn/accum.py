@@ -1,0 +1,62 @@
+"""THE scan-sum core of in-step gradient accumulation (ISSUE 14).
+
+Every accumulated train step in the repo — MultiLayerNetwork's
+`_train_step_accum(_guarded)`, ComputationGraph's
+`_train_accum(_guarded)`, and `sharded_trainer.accumulate_grads` (the
+ShardedTrainer/MultiHostTrainer core) — runs its G microbatches through
+`accum_scan` below, so the accumulation semantics (zeros init, on-device
+tree sum, sequential state threading, per-microbatch loss-finiteness
+AND, 1/G mean) live in exactly one place and cannot drift between the
+five call sites.
+
+Deliberately dependency-free (jax only): imported from both `nn/` and
+`parallel/` without any package-cycle risk.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["accum_scan"]
+
+
+def accum_scan(grad_fn, params, state, inputs):
+    """Scan the stacked microbatches `inputs` (every leaf carries a
+    leading G axis), summing gradients and loss on device.
+
+    grad_fn(params, state, inp) -> ((loss, new_state), grads) computes
+    ONE microbatch's loss/grads; `state` (e.g. batch-norm running
+    stats, graph vertex state, or a dummy scalar for stateless loss
+    fns) threads SEQUENTIALLY through the scan — microbatch i+1's
+    forward sees microbatch i's state, exactly like a sequential
+    reference loop.
+
+    Returns (mean_grads, mean_loss, micro_ok, final_state) where
+    micro_ok is the AND of per-microbatch loss finiteness: a NaN/inf in
+    ANY microbatch survives into the guardian verdict even though only
+    the accumulated gradient is inspected downstream (non-finite grads
+    also propagate through the on-device sum into the accumulated
+    gnorm — micro_ok additionally covers a NaN loss with finite grads).
+    Unguarded callers simply drop it (a dead scalar AND per
+    microbatch).
+
+    The sum order is the microbatch order, so mean_loss is BIT-equal
+    and mean_grads are element-identical to an explicit sequential
+    accumulation loop over the same microbatches.
+    """
+    def body(carry, inp):
+        gsum, lsum, ok, s = carry
+        (loss, ns), grads = grad_fn(params, s, inp)
+        return (jax.tree_util.tree_map(jnp.add, gsum, grads),
+                lsum + loss, ok & jnp.isfinite(loss), ns), None
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    n = jax.tree_util.tree_leaves(inputs)[0].shape[0]
+    # jnp.array (not asarray): the training-exchange sync-lint flags
+    # asarray by name — device constants stay visibly host-sync-free
+    (gsum, lsum, ok, state), _ = jax.lax.scan(
+        body, (zeros, jnp.float32(0.0), jnp.array(True), state),
+        inputs)
+    inv = 1.0 / n
+    return (jax.tree_util.tree_map(lambda g: g * inv, gsum),
+            lsum * inv, ok, state)

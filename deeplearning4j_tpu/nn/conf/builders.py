@@ -297,6 +297,23 @@ class NeuralNetConfiguration:
             self._defaults["precisionPolicy"] = policy
             return self
 
+        def gradientAccumulation(self, n):
+            """In-step microbatch accumulation: the fit loops group
+            every G consecutive same-shape batches into ONE jitted
+            optimizer step that lax.scans the G backward passes,
+            accumulates gradients on device, and applies a single
+            update — one dispatch and one host round-trip per optimizer
+            step regardless of G, so effective batch sizes scale past
+            what fits device memory at once. Inherited by
+            ParallelWrapper (the dp path) and the model fit loops;
+            sub-G remainders run as ordinary per-batch steps. TBPTT
+            configs ignore it (the segment loop owns the dispatch)."""
+            n = int(n)
+            if n < 1:
+                raise ValueError("gradientAccumulation must be >= 1")
+            self._defaults["gradientAccumulation"] = n
+            return self
+
         def gradientNormalization(self, gn):
             self._defaults["gradientNormalization"] = gn
             return self

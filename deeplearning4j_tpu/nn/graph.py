@@ -23,6 +23,7 @@ from deeplearning4j_tpu.runtime import pipeline as _pipeline
 from deeplearning4j_tpu.util.crash_reporting import \
     with_crash_dump
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn import accum as _accum
 from deeplearning4j_tpu.nn.multilayer import (_apply_layer, _hook_params,
                                               _l1l2_penalty)
 from deeplearning4j_tpu.nn.updaters import build_optimizer, same_updater
@@ -680,6 +681,105 @@ class ComputationGraph:
         if _ps is not None:
             _ps.step_end()
 
+    # -- in-step gradient accumulation (ISSUE 14): see
+    # MultiLayerNetwork._train_step_accum — G microbatches, ONE update.
+    @functools.cached_property
+    def _train_accum(self):
+        """Accumulated graph step: `nn/accum.accum_scan` over G stacked
+        batch pytrees (grads/loss summed on device, vertex state
+        threaded sequentially), then ONE updater application."""
+        tx = self._tx
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step(params, opt_state, state, ins, labels, fmasks, lmasks,
+                 rngs):
+            grads, loss, _, state = _accum.accum_scan(
+                self._accum_grad_fn, params, state,
+                (ins, labels, fmasks, lmasks, rngs))
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            params = self._apply_constraints(params)
+            return params, opt_state, state, loss
+
+        return step
+
+    def _accum_grad_fn(self, params, state, inp):
+        """One microbatch's ((loss, new_state), grads) for accum_scan."""
+        i_, l_, fm, lm, rng = inp
+        (loss, ns), grads = jax.value_and_grad(
+            lambda p: self._loss(p, state, i_, l_, fm, lm, rng),
+            has_aux=True)(params)
+        return (loss, ns), grads
+
+    @functools.cached_property
+    def _train_accum_guarded(self):
+        """Guardian variant of `_train_accum`: one verdict gates the
+        accumulated update; a NaN in any microbatch poisons the
+        inspected loss (see MultiLayerNetwork._train_step_accum_guarded
+        for the full contract)."""
+        tx = self._tx
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step(params, opt_state, state, ins, labels, fmasks, lmasks,
+                 rngs, lr_scale, max_gnorm):
+            grads, loss, micro_ok, new_state = _accum.accum_scan(
+                self._accum_grad_fn, params, state,
+                (ins, labels, fmasks, lmasks, rngs))
+            vloss = jnp.where(micro_ok, loss, jnp.float32(jnp.nan))
+            params, opt_state, (state,), gnorm, ok = \
+                _guardian.guarded_apply(
+                    tx, grads, vloss, params, opt_state, lr_scale,
+                    max_gnorm, constraints=self._apply_constraints,
+                    extra=((new_state, state),))
+            return params, opt_state, state, loss, gnorm, ok
+
+        return step
+
+    def _fit_batches_accum(self, group):
+        """Flush a FULL G-batch group of unpacked batches through one
+        accumulated optimizer step (one real update: iteration count
+        and listeners advance once)."""
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        if _watchdog.ACTIVE is not None:
+            _watchdog.ACTIVE.beat(f"graph@{id(self):x}")
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_start()
+        with _mon.span("train.stage"):
+            subs = []
+            for _ in group:
+                self._rng_key, sub = jax.random.split(self._rng_key)
+                subs.append(sub)
+            stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                             *group)
+            ins, labels, fmasks, lmasks = stacked
+        _g = _guardian.ACTIVE
+        with _mon.span("train.accum_dispatch"):
+            if _g is not None:
+                (self._params, self._opt_state, self._state, loss,
+                 gnorm, ok) = self._train_accum_guarded(
+                    self._params, self._opt_state, self._state, ins,
+                    labels, fmasks, lmasks, jnp.stack(subs),
+                    _g.lr_scale, _g.max_gnorm)
+            else:
+                (self._params, self._opt_state, self._state,
+                 loss) = self._train_accum(
+                    self._params, self._opt_state, self._state, ins,
+                    labels, fmasks, lmasks, jnp.stack(subs))
+            self._score = loss
+        if _g is not None:
+            _g.on_step(loss, gnorm, ok)   # one verdict per real update
+        self._iteration += 1
+        self._last_features = jax.tree_util.tree_map(lambda a: a[-1], ins)
+        self._params_version = getattr(self, "_params_version", 0) + 1
+        with _mon.span("train.listeners"):
+            for listener in self._listeners:
+                listener.iterationDone(self, self._iteration, self._epoch)
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_end()
+
     @staticmethod
     def _batch_sig(unpacked_or_ds):
         leaves, treedef = jax.tree_util.tree_flatten(unpacked_or_ds)
@@ -716,14 +816,22 @@ class ComputationGraph:
                 if _watchdog.ACTIVE is not None:
                     _watchdog.ACTIVE.retire(f"graph@{id(self):x}")
             return self
+        accum = int(self.conf.defaults.get("gradientAccumulation", 1)
+                    or 1)
         k = max(1, int(stepsPerDispatch))
-        if _guardian.ACTIVE is not None:
+        if accum > 1:
+            k = accum   # accumulation owns the grouping (one update)
+        elif _guardian.ACTIVE is not None:
             k = 1    # guardian needs per-step health verdicts; a scan
             #          group would hide k-1 of them inside one dispatch
+            #          (an accumulated group is ONE update/verdict, so
+            #          accum > 1 stays on)
         n_epochs = int(epochs) if epochs is not None else 1
 
         def flush(group):
-            if len(group) == k:
+            if len(group) == k and accum > 1:
+                self._fit_batches_accum(group)
+            elif len(group) == k:
                 self._fit_batches_scanned(group)
             else:        # sub-k remainder: avoid a fresh per-length trace
                 for unpacked in group:

@@ -8,6 +8,9 @@ from deeplearning4j_tpu.parallel.ring_attention import (blockwise_attention,
                                                         dense_attention,
                                                         make_ring_attention,
                                                         ring_attention)
+from deeplearning4j_tpu.parallel.buckets import (BucketPlan,
+                                                 check_overlap_structure,
+                                                 plan_buckets)
 from deeplearning4j_tpu.parallel.compression import (encoded_updater,
                                                      threshold_encoding)
 from deeplearning4j_tpu.parallel.elastic import (ElasticCheckpointer,
@@ -29,6 +32,7 @@ __all__ = ["DeviceMesh", "initialize_distributed", "ParallelWrapper",
            "ParameterAveragingTrainer", "ShardedTrainer",
            "blockwise_attention", "dense_attention", "make_ring_attention",
            "ring_attention", "encoded_updater", "threshold_encoding",
+           "BucketPlan", "check_overlap_structure", "plan_buckets",
            "make_pipeline_fn", "make_pipelined_loss", "stack_stage_params",
            "ElasticCheckpointer", "ElasticTrainer", "initialize_multihost",
            "shard_optimizer_state", "state_memory_bytes",

@@ -586,7 +586,7 @@ class PeerCoordinator:
         return table
 
     def snapshot(self):
-        return {
+        snap = {
             "process_id": self.process_id,
             "num_processes": self.num_processes,
             "step": self.step,
@@ -599,6 +599,18 @@ class PeerCoordinator:
             "peers": {str(k): v for k, v in self.peer_table().items()},
             "last_report": self.last_report_path,
         }
+        # accumulation / bucketed-exchange knobs of the bound trainer
+        # (GET /health "distributed" section): how many microbatches
+        # each optimizer step accumulates and how the exchange is split
+        t = self._bound
+        if t is not None:
+            snap["accum_microbatches"] = int(
+                getattr(t, "accumulation", 1) or 1)
+            plan = getattr(t, "bucket_plan", None)
+            if plan is not None:
+                snap["exchange_buckets"] = plan.num_buckets
+                snap["bucket_bytes"] = list(plan.bucket_bytes)
+        return snap
 
     # -- monitor thread --------------------------------------------------
     def start_monitor(self, poll_interval=None, abort=None):

@@ -18,14 +18,20 @@ Four pieces:
    a typed `DistributedInitError`; nothing here can hang silently.
 
 2. **dp-over-DCN trainer** — `MultiHostTrainer`: `ShardedTrainer`
-   composed across processes with `compression.threshold_encoding`
-   INSIDE the jitted step: each worker quantizes its local gradient to
-   {−t, 0, +t} against its own residual buffer (shard_map over the dp
-   axis), and only the sparse quantized tensor rides the cross-host
-   all-reduce — the EncodedGradientsAccumulator exchange, with the
-   residual/threshold state per-worker-stacked, checkpointed with the
-   optimizer state, and restored bit-exactly on resume. Optional
-   ZeRO-1 (`parallel/zero.py`) shards the base optimizer state over dp.
+   composed across processes with in-step gradient accumulation and
+   `compression.threshold_encoding` INSIDE the jitted step: the step
+   scans G microbatches of a staged super-batch accumulating gradients
+   on device (one dispatch + one update per OPTIMIZER step regardless
+   of G), then each worker quantizes its accumulated local gradient to
+   {−t, 0, +t} per byte-balanced BUCKET (`parallel/buckets.py`)
+   against that bucket's own residual, and only the sparse quantized
+   payloads ride the cross-host all-reduce — N independent collectives
+   issued so bucket k exchanges while bucket k+1 encodes (the
+   EncodedGradientsAccumulator exchange, chunked + overlapped). The
+   per-bucket residual/threshold state is per-worker-stacked,
+   checkpointed with the optimizer state, and restored bit-exactly on
+   resume. Optional ZeRO-1 (`parallel/zero.py`) shards the base
+   optimizer state over dp.
 
 3. **Coordinated robustness** — `CoordinatedGuardian` reduces the
    device health verdicts across processes at every flush (elementwise
@@ -60,11 +66,13 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.parallel import buckets as _buckets
 from deeplearning4j_tpu.parallel import compression as _compression
 from deeplearning4j_tpu.parallel import coordination as _coord
 from deeplearning4j_tpu.parallel import zero as _zero
 from deeplearning4j_tpu.parallel.mesh import shard_map
-from deeplearning4j_tpu.parallel.sharded_trainer import ShardedTrainer
+from deeplearning4j_tpu.parallel.sharded_trainer import (ShardedTrainer,
+                                                         accumulate_grads)
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience import guardian as _guardian
 from deeplearning4j_tpu.resilience.errors import (CheckpointIntegrityError,
@@ -329,15 +337,21 @@ def initialize(coordinator_address=None, num_processes=None,
 
 
 # ======================= dp-over-DCN trainer ============================
-def global_batch(mesh, tree, axis="dp"):
+def global_batch(mesh, tree, axis="dp", accumulation=1):
     """Build globally-sharded batch arrays from per-host FULL copies
     (the SPMD-lockstep data recipe: every host generates the same batch
     deterministically, each materializes only its own shards). Staged
     donation-safe — the per-shard views go through the misaligned-copy
-    trick so XLA owns every buffer."""
+    trick so XLA owns every buffer.
+
+    accumulation > 1: `tree` is a SUPER-batch whose leaves carry a
+    leading microbatch axis (G, B, ...) — the microbatch axis stays
+    replicated, dim 1 shards over dp (matches
+    ShardedTrainer.shard_batch)."""
     from deeplearning4j_tpu.runtime.pipeline import as_unaliasable
     jmesh = getattr(mesh, "mesh", mesh)
-    sh = NamedSharding(jmesh, P(axis))
+    spec = P(None, axis) if int(accumulation) > 1 else P(axis)
+    sh = NamedSharding(jmesh, spec)
 
     def put(a):
         a = np.asarray(a)
@@ -348,42 +362,91 @@ def global_batch(mesh, tree, axis="dp"):
 
 
 class MultiHostTrainer(ShardedTrainer):
-    """`ShardedTrainer` with threshold-encoded gradient exchange: the
-    jitted step shard_maps over the dp axis so each worker quantizes its
-    LOCAL gradient against its own residual buffer before the
-    cross-host all-reduce — only the sparse {−t, 0, +t} tensor crosses
-    DCN (≡ EncodedGradientsAccumulator). The encoder state (residual /
-    adaptive threshold / wire count, stacked per worker and dp-sharded)
-    lives inside `opt_state["encoder"]`, so every checkpoint carries it
-    and a resumed run continues the residual accumulation bit-exactly.
+    """`ShardedTrainer` with accumulated, bucketed, threshold-encoded
+    gradient exchange: ONE jitted step per optimizer step that
 
-    `compress=False` degrades to the plain ShardedTrainer step (the
-    all-reduce rides full gradients). `zero1=True` shards the BASE
-    optimizer state over dp (`parallel/zero.py`); the update math stays
-    outside the shard_map so GSPMD partitions it by the state sharding.
+    1. lax.scans `accumulation` microbatches of a staged super-batch,
+       summing gradients on device (one dispatch regardless of G);
+    2. splits the accumulated gradient tree into byte-balanced buckets
+       (`parallel/buckets.py`), each a single flat vector;
+    3. per bucket: threshold-encodes against that bucket's OWN residual
+       + adaptive threshold (≡ EncodedGradientsAccumulator, now
+       chunked), then all-reduces the sparse {−t, 0, +t} payload — N
+       INDEPENDENT collectives issued in program order, so bucket k's
+       exchange is in flight while bucket k+1 still encodes (XLA's
+       latency-hiding scheduler overlaps them; structure asserted via
+       `buckets.check_overlap_structure` on the HLO text).
+
+    The per-bucket encoder state (flat residual vector + threshold +
+    wire count per bucket, stacked per worker and dp-sharded) lives
+    inside `opt_state["encoder"]`, so every checkpoint carries it and a
+    resumed run continues each bucket's residual accumulation
+    bit-exactly.
+
+    `compress=False` without an explicit bucket request degrades to the
+    plain ShardedTrainer step (GSPMD inserts the all-reduce); with
+    `buckets=`/`bucket_bytes=` it runs the bucketed exchange on RAW
+    gradients (split + overlapped, no encoding). `zero1=True` shards
+    the BASE optimizer state over dp (`parallel/zero.py`); the update
+    math stays outside the shard_map so GSPMD partitions it by the
+    state sharding — and with accumulation it runs once per super-batch,
+    not once per microbatch.
     """
 
     def __init__(self, loss_fn, updater, mesh=None, param_specs=None,
                  batch_axis="dp", donate=True, compress=True,
-                 compression_kw=None, zero1=False):
+                 compression_kw=None, zero1=False, accumulation=1,
+                 buckets=None, bucket_bytes=None):
         if mesh is None:
             mesh = Mesh(np.array(jax.devices()), (batch_axis,))
         super().__init__(loss_fn, updater, mesh, param_specs=param_specs,
-                         batch_axis=batch_axis, donate=donate)
+                         batch_axis=batch_axis, donate=donate,
+                         accumulation=accumulation)
         self.compress = bool(compress)
         self.zero1 = bool(zero1)
         self._compression_kw = dict(compression_kw or {})
         self._enc = (_compression.threshold_encoding(**self._compression_kw)
                      if self.compress else None)
+        self._num_buckets = buckets
+        self._bucket_bytes = bucket_bytes
+        #: the explicit shard_map'd exchange runs whenever encoding OR
+        #: bucketing is requested; otherwise GSPMD owns the all-reduce
+        self._explicit = (self.compress or buckets is not None
+                          or bucket_bytes is not None)
+        self.bucket_plan = None
+
+    # -- bucket plan ------------------------------------------------------
+    def _ensure_plan(self, tree):
+        """Build (once) the byte-balanced bucket plan from the gradient
+        tree's structure — host-side shape metadata only, never device
+        values."""
+        if self.bucket_plan is None:
+            self.bucket_plan = _buckets.plan_buckets(
+                tree, num_buckets=self._num_buckets,
+                bucket_bytes=self._bucket_bytes)
+            if _mon.enabled():
+                reg = _mon.get_registry()
+                reg.gauge(_mon.DIST_EXCHANGE_BUCKETS,
+                          help="independent collectives the gradient "
+                               "exchange is split into").set(
+                    self.bucket_plan.num_buckets)
+                reg.gauge(_mon.DIST_BUCKET_BYTES,
+                          help="largest planned bucket payload (bytes) "
+                               "— the byte-balance quality").set(
+                    max(self.bucket_plan.bucket_bytes))
+        return self.bucket_plan
 
     # -- state -----------------------------------------------------------
     def _init_encoder_state(self, params):
-        """Per-worker-stacked encoder state: leading axis = dp size,
-        sharded over dp so each worker owns exactly its own residual.
-        Built from host values via per-shard callbacks (a multi-process
-        mesh has no single process that could materialize the whole
+        """Per-worker-stacked, PER-BUCKET encoder state: each bucket
+        owns a flat residual vector (bucket_elems,), an adaptive
+        threshold and a wire count — leading axis = dp size, sharded
+        over dp so each worker owns exactly its own residuals. Built
+        from host values via per-shard callbacks (a multi-process mesh
+        has no single process that could materialize the whole
         array)."""
         from deeplearning4j_tpu.runtime.pipeline import as_unaliasable
+        plan = self._ensure_plan(params)
         n = dict(zip(self.mesh.axis_names,
                      self.mesh.devices.shape))[self.batch_axis]
         thr0 = np.float32(self._compression_kw.get(
@@ -404,11 +467,13 @@ class MultiHostTrainer(ShardedTrainer):
 
             return jax.make_array_from_callback(gshape, sh, shard)
 
-        residual = jax.tree_util.tree_map(
-            lambda p: stacked(p.shape, p.dtype, 0), params)
+        residual = {str(b): stacked((plan.bucket_elems[b],),
+                                    plan.bucket_dtype(b), 0)
+                    for b in range(plan.num_buckets)}
         return {"residual": residual,
-                "threshold": stacked((), np.float32, thr0),
-                "nnz": stacked((), np.int32, 0)}
+                "threshold": stacked((plan.num_buckets,), np.float32,
+                                     thr0),
+                "nnz": stacked((plan.num_buckets,), np.int32, 0)}
 
     def init(self, params):
         params = self.shard_params(params)
@@ -416,38 +481,120 @@ class MultiHostTrainer(ShardedTrainer):
         if self.zero1:
             base = _zero.shard_optimizer_state(base, self.mesh,
                                                axis=self.batch_axis)
+        if self._explicit:
+            self._ensure_plan(params)
         if not self.compress:
             return params, base
         return params, {"base": base,
                         "encoder": self._init_encoder_state(params)}
 
-    # -- the compressed step ---------------------------------------------
+    # -- the bucketed exchange -------------------------------------------
     def _make_exchange(self):
-        """shard_map'd gradient exchange: local grad → threshold-encode
-        against this worker's residual → pmean of the SPARSE tensor
-        across dp (the only cross-host traffic) → replicated decoded
-        update. Returns (g, new_encoder_state, loss)."""
+        """shard_map'd accumulate-and-exchange: scan the super-batch's
+        microbatches accumulating the LOCAL gradient, then per bucket:
+        threshold-encode against this worker's bucket residual (when
+        compressing) → pmean of the flat payload across dp (the only
+        cross-host traffic) → decode. Collectives are issued bucket by
+        bucket in program order, each independent of the next bucket's
+        encode — the overlap structure the HLO check asserts.
+
+        Returns (g, new_encoder_state, loss) when compressing, else
+        (g, loss). The loss is NaN-poisoned when any microbatch loss or
+        the accumulated local gradient is non-finite: a NaN fails every
+        `>= threshold` compare, so encoding it would silently ship
+        zeros while poisoning the residual — the poisoned (replicated)
+        loss makes every host's guarded verdict fail instead, and the
+        guarded step rolls the encoder state back."""
         enc, loss_fn, axis = self._enc, self.loss_fn, self.batch_axis
+        plan = self.bucket_plan
+        if plan is None:
+            raise RuntimeError("bucket plan not built — call init() "
+                               "before make_step()")
+        n_micro = self.accumulation
         wspec, rep = P(axis), P()
+        bspec = P(None, axis) if n_micro > 1 else wspec
 
-        def local(params, enc_state, batch, rng):
+        # Backends whose collectives lower synchronously (CPU) schedule
+        # by a memory-minimizing list heuristic that is free to group
+        # every encode before every all-reduce — legal, but it erases
+        # the issue-order structure this exchange exists to establish
+        # (an optimization_barrier doesn't survive: XLA's
+        # optimization-barrier-expander strips it before scheduling).
+        # There, pin bucket k+1's encode AFTER bucket k's collective
+        # with a numerically-inert data dependency:
+        # + 0.0 * sum(prev[:1])
+        # is exactly zero (encoded payloads are finite; float
+        # mul-by-zero is NOT foldable by XLA), costs a 1-element
+        # reduce, and is wall-time neutral on a sync backend (the
+        # collective blocks either way) — the HLO text then documents
+        # the overlap schedule async backends actually run. On TPU/GPU
+        # no pin is inserted: the latency-hiding scheduler must stay
+        # free to hoist all-reduce-starts wherever it likes.
+        pin_order = jax.default_backend() == "cpu"
+
+        def exchange_buckets(flats, e):
+            """[flat grads per bucket], per-worker encoder state ->
+            ([replicated flat per bucket], new state or None)."""
+            outs, res2, thr2, nnz2 = [], {}, [], []
+            for b in range(plan.num_buckets):
+                flat = flats[b]
+                if pin_order and b > 0:
+                    dep = 0.0 * jnp.sum(outs[b - 1][:1])
+                    flat = flat + dep.astype(flat.dtype)
+                with jax.named_scope(
+                        _buckets.ENCODE_SCOPE.format(b=b)):
+                    if enc is None:
+                        sent = flat
+                    else:
+                        st = {"residual": e["residual"][str(b)],
+                              "threshold": e["threshold"][b],
+                              "nnz": e["nnz"][b]}
+                        sent, st2 = enc.update(flat, st)
+                        res2[str(b)] = st2["residual"]
+                        thr2.append(st2["threshold"])
+                        nnz2.append(st2["nnz"])
+                with jax.named_scope(
+                        _buckets.EXCHANGE_SCOPE.format(b=b)):
+                    outs.append(jax.lax.pmean(sent, axis))
+            if enc is None:
+                return outs, None
+            return outs, {"residual": res2,
+                          "threshold": jnp.stack(thr2),
+                          "nnz": jnp.stack(nnz2)}
+
+        def local_grads(params, batch, rng):
             my = jax.lax.axis_index(axis)
-            loss, grads = jax.value_and_grad(loss_fn)(
-                params, batch, jax.random.fold_in(rng, my))
-            e = jax.tree_util.tree_map(lambda a: a[0], enc_state)
-            sent, e2 = enc.update(grads, e)
-            g = jax.tree_util.tree_map(
-                lambda s: jax.lax.pmean(s, axis), sent)
-            restack = jax.tree_util.tree_map
-            return (g, restack(lambda a: a[None], e2),
-                    jax.lax.pmean(loss, axis))
+            grads, loss, micro_ok = accumulate_grads(
+                loss_fn, params, batch, jax.random.fold_in(rng, my),
+                n_micro)
+            ok = micro_ok & jnp.isfinite(optax.global_norm(grads))
+            return grads, jnp.where(ok, loss, jnp.float32(jnp.nan))
 
-        return shard_map(local, mesh=self.mesh,
-                         in_specs=(rep, wspec, wspec, rep),
-                         out_specs=(rep, wspec, rep), check_vma=False)
+        if enc is not None:
+            def local(params, enc_state, batch, rng):
+                grads, loss = local_grads(params, batch, rng)
+                e = jax.tree_util.tree_map(lambda a: a[0], enc_state)
+                outs, e2 = exchange_buckets(plan.concat(grads), e)
+                restack = jax.tree_util.tree_map(lambda a: a[None], e2)
+                return (plan.split(outs), restack,
+                        jax.lax.pmean(loss, axis))
+
+            return shard_map(local, mesh=self.mesh,
+                             in_specs=(rep, wspec, bspec, rep),
+                             out_specs=(rep, wspec, rep),
+                             check_vma=False)
+
+        def local_raw(params, batch, rng):
+            grads, loss = local_grads(params, batch, rng)
+            outs, _ = exchange_buckets(plan.concat(grads), None)
+            return plan.split(outs), jax.lax.pmean(loss, axis)
+
+        return shard_map(local_raw, mesh=self.mesh,
+                         in_specs=(rep, bspec, rep),
+                         out_specs=(rep, rep), check_vma=False)
 
     def make_step(self):
-        if not self.compress:
+        if not self._explicit:
             return super().make_step()
         if self._step is not None:
             return self._step
@@ -455,19 +602,27 @@ class MultiHostTrainer(ShardedTrainer):
         exchange = self._make_exchange()
         donate = (0, 1) if self._donate else ()
 
-        @functools.partial(jax.jit, donate_argnums=donate)
-        def step(params, opt_state, batch, rng):
-            g, enc2, loss = exchange(params, opt_state["encoder"],
-                                     batch, rng)
-            updates, base2 = tx.update(g, opt_state["base"], params)
-            params = optax.apply_updates(params, updates)
-            return params, {"base": base2, "encoder": enc2}, loss
+        if self.compress:
+            @functools.partial(jax.jit, donate_argnums=donate)
+            def step(params, opt_state, batch, rng):
+                g, enc2, loss = exchange(params, opt_state["encoder"],
+                                         batch, rng)
+                updates, base2 = tx.update(g, opt_state["base"], params)
+                params = optax.apply_updates(params, updates)
+                return params, {"base": base2, "encoder": enc2}, loss
+        else:
+            @functools.partial(jax.jit, donate_argnums=donate)
+            def step(params, opt_state, batch, rng):
+                g, loss = exchange(params, batch, rng)
+                updates, opt_state = tx.update(g, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, loss
 
         self._step = step
         return step
 
     def make_guarded_step(self):
-        if not self.compress:
+        if not self._explicit:
             return super().make_guarded_step()
         cached = getattr(self, "_guarded_step", None)
         if cached is not None:
@@ -476,25 +631,41 @@ class MultiHostTrainer(ShardedTrainer):
         exchange = self._make_exchange()
         donate = (0, 1) if self._donate else ()
 
-        @functools.partial(jax.jit, donate_argnums=donate)
-        def step(params, opt_state, batch, rng, lr_scale, max_gnorm):
-            g, enc2, loss = exchange(params, opt_state["encoder"],
-                                     batch, rng)
-            # verdict on the EXCHANGED gradient — replicated, so every
-            # host computes the identical ok/gnorm; an unhealthy step
-            # rolls the encoder state back too (that step never
-            # happened, residual included)
-            params, base, (enc_sel,), gnorm, ok = _guardian.guarded_apply(
-                tx, g, loss, params, opt_state["base"], lr_scale,
-                max_gnorm, extra=((enc2, opt_state["encoder"]),))
-            return params, {"base": base, "encoder": enc_sel}, \
-                loss, gnorm, ok
+        if self.compress:
+            @functools.partial(jax.jit, donate_argnums=donate)
+            def step(params, opt_state, batch, rng, lr_scale,
+                     max_gnorm):
+                g, enc2, loss = exchange(params, opt_state["encoder"],
+                                         batch, rng)
+                # verdict on the EXCHANGED accumulated gradient —
+                # replicated, so every host computes the identical
+                # ok/gnorm (per-microbatch NaN arrives as the poisoned
+                # loss); an unhealthy step rolls the per-bucket encoder
+                # state back too (that step never happened, residuals
+                # included)
+                params, base, (enc_sel,), gnorm, ok = \
+                    _guardian.guarded_apply(
+                        tx, g, loss, params, opt_state["base"],
+                        lr_scale, max_gnorm,
+                        extra=((enc2, opt_state["encoder"]),))
+                return params, {"base": base, "encoder": enc_sel}, \
+                    loss, gnorm, ok
+        else:
+            @functools.partial(jax.jit, donate_argnums=donate)
+            def step(params, opt_state, batch, rng, lr_scale,
+                     max_gnorm):
+                g, loss = exchange(params, batch, rng)
+                params, opt_state, _, gnorm, ok = \
+                    _guardian.guarded_apply(
+                        tx, g, loss, params, opt_state, lr_scale,
+                        max_gnorm)
+                return params, opt_state, loss, gnorm, ok
 
         self._guarded_step = step
         return step
 
     def fit_batch(self, params, opt_state, batch, rng):
-        if self.compress and _faults.ACTIVE is not None:
+        if self._explicit and _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.COMM_ALLREDUCE)
         try:
             return super().fit_batch(params, opt_state, batch, rng)
@@ -507,26 +678,75 @@ class MultiHostTrainer(ShardedTrainer):
             raise
 
     # -- telemetry -------------------------------------------------------
+    def _exchange_probe(self):
+        """Jitted exchange-ONLY program (per-bucket encode → pmean on
+        ZERO gradients): times the standalone cost of the collectives —
+        the upper bound of what the overlapped schedule can hide
+        (`dl4j.dist.exposed_exchange_ms`). Compiled once; dispatched
+        only at stats cadence with monitoring enabled."""
+        cached = getattr(self, "_probe_fn", None)
+        if cached is not None:
+            return cached
+        plan, enc, axis = self.bucket_plan, self._enc, self.batch_axis
+
+        def local(enc_state):
+            e = jax.tree_util.tree_map(lambda a: a[0], enc_state)
+            acc = jnp.float32(0.0)
+            for b in range(plan.num_buckets):
+                flat = jnp.zeros((plan.bucket_elems[b],),
+                                 plan.bucket_dtype(b))
+                st = {"residual": e["residual"][str(b)],
+                      "threshold": e["threshold"][b],
+                      "nnz": e["nnz"][b]}
+                sent, _ = enc.update(flat, st)
+                acc = acc + jnp.sum(jax.lax.pmean(sent, axis) ** 2)
+            return acc
+
+        fn = shard_map(local, mesh=self.mesh,
+                       in_specs=(P(self.batch_axis),), out_specs=P(),
+                       check_vma=False)
+        self._probe_fn = jax.jit(fn)
+        return self._probe_fn
+
     def encoder_stats(self, opt_state):
         """Materialize the compression wire telemetry (one small host
         read — call at sync cadence, not per step): mean adaptive
-        threshold, total elements shipped last step, residual norm."""
+        threshold, total elements shipped last step, residual norm, and
+        the per-bucket wire ledger (elements shipped per bucket, summed
+        over workers)."""
         if not self.compress:
             return None
         fn = getattr(self, "_stats_fn", None)
         if fn is None:
             rep = NamedSharding(self.mesh, P())
-            fn = jax.jit(_compression.encoder_stats,
+
+            def stats(enc_state):
+                out = _compression.encoder_stats(enc_state)
+                nnz = enc_state["nnz"]           # (workers, buckets)
+                out["bucket_nnz"] = jnp.sum(
+                    nnz.reshape(-1, nnz.shape[-1]), axis=0)
+                return out
+
+            fn = jax.jit(stats,
                          out_shardings={"threshold": rep, "nnz": rep,
-                                        "residual_norm": rep})
+                                        "residual_norm": rep,
+                                        "bucket_nnz": rep})
             self._stats_fn = fn
         dev = fn(opt_state["encoder"])
-        host = {k: float(np.asarray(v.addressable_shards[0].data))
-                for k, v in dev.items()}
+
+        def materialize(v):
+            return np.asarray(v.addressable_shards[0].data) \
+                if hasattr(v, "addressable_shards") else np.asarray(v)
+
+        host = {k: materialize(v) for k, v in dev.items()}
+        host["threshold"] = float(host["threshold"])
+        host["residual_norm"] = float(host["residual_norm"])
         host["nnz"] = int(host["nnz"])
         # an encoded element ships as (index, sign) — call it 4 bytes on
         # the wire vs 4 bytes/element for a dense fp32 all-reduce
         host["encoded_bytes"] = host["nnz"] * 4
+        host["bucket_nnz"] = [int(v) for v in host["bucket_nnz"]]
+        host["bucket_encoded_bytes"] = [v * 4 for v in host["bucket_nnz"]]
         if _mon.enabled():
             reg = _mon.get_registry()
             reg.counter(_mon.DIST_ENCODED_BYTES,
@@ -536,6 +756,33 @@ class MultiHostTrainer(ShardedTrainer):
             reg.gauge(_mon.DIST_RESIDUAL_NORM,
                       help="global norm of the un-sent gradient "
                            "residual").set(host["residual_norm"])
+            # standalone exchange cost: dispatch the exchange-only
+            # probe and time the blocked wait (first call warms the
+            # compile un-timed; we are already at a declared host-sync
+            # cadence, never per step). SINGLE-PROCESS ONLY: the probe
+            # issues a collective, and monitoring.enabled() is
+            # host-LOCAL state — in a multi-process run a subset of
+            # hosts with monitoring on would issue a pmean the others
+            # never join (hang, or worse: pair with a peer's next
+            # training collective), so the probe is skipped entirely
+            # when collectives span processes.
+            if jax.process_count() > 1:
+                return host
+            import time as _time
+            probe = self._exchange_probe()
+            if not getattr(self, "_probe_warm", False):
+                jax.block_until_ready(probe(opt_state["encoder"]))
+                self._probe_warm = True
+            t0 = _time.perf_counter()
+            jax.block_until_ready(probe(opt_state["encoder"]))
+            ms = (_time.perf_counter() - t0) * 1e3
+            host["exposed_exchange_ms"] = ms
+            reg.gauge(_mon.DIST_EXPOSED_EXCHANGE_MS,
+                      help="standalone cost of the bucketed exchange "
+                           "(encode+all-reduce on current state) — the "
+                           "time the overlapped schedule exists to "
+                           "hide; probed in single-process runs only "
+                           "(the probe is itself a collective)").set(ms)
         return host
 
 
@@ -809,25 +1056,117 @@ class MultiHostRunner:
         """Restore generation `step` (or the newest verified when
         `verified_scan`) as HOST arrays, integrity-verify, then re-place
         onto the live tree's shardings (cross-process placements go
-        shard-by-shard). Returns (step, placed_state)."""
+        shard-by-shard). Returns (step, placed_state).
+
+        Checkpoints written BEFORE the bucketed exchange (encoder
+        residual keyed by param leaf, one shared threshold per worker)
+        restore through the legacy-layout fallback and are migrated
+        in-place to the per-bucket layout — residual BITS preserved
+        (each bucket's flat vector is the concat of its leaves'
+        residuals), the shared threshold tiled across buckets."""
         from deeplearning4j_tpu.parallel.elastic import replace_on_mesh
         from deeplearning4j_tpu.resilience import integrity as _integrity
         like_host = jax.tree_util.tree_map(
             lambda a: np.zeros(a.shape, a.dtype)
             if hasattr(a, "shape") else a, like_live)
         if verified_scan:
-            s, state = self.ckpt.restore_verified(like=like_host)
+            try:
+                s, state = self.ckpt.restore_verified(like=like_host)
+            except CheckpointIntegrityError as e:
+                s, state = self._restore_legacy(None, like_host, e)
         else:
             _debug("restore: reading generation", step)
-            s, state = self.ckpt.restore(step=step, like=like_host)
-            _debug("restore: verifying generation", s)
-            _integrity.verify_restored(self.directory, s, state)
+            try:
+                s, state = self.ckpt.restore(step=step, like=like_host)
+                _debug("restore: verifying generation", s)
+                _integrity.verify_restored(self.directory, s, state)
+            except (ValueError, CheckpointIntegrityError) as e:
+                s, state = self._restore_legacy(step, like_host, e)
         if s is None:
             return None, None
         _debug("restore: re-placing generation", s, "on the mesh")
         placed = replace_on_mesh(self.trainer.mesh, like_live, state)
         _debug("restore: placed generation", s)
         return s, placed
+
+    def _legacy_encoder_like(self, like_host):
+        """Host-zeros restore target in the PRE-bucketing encoder
+        layout (PR 7): residual = params-shaped tree of per-worker
+        stacks, ONE shared threshold / nnz scalar per worker. None when
+        this runner's state has no encoder (nothing legacy to match)."""
+        opt = like_host.get("opt_state")
+        plan = getattr(self.trainer, "bucket_plan", None)
+        if not (isinstance(opt, dict) and "encoder" in opt
+                and plan is not None):
+            return None
+        dp = opt["encoder"]["threshold"].shape[0]
+        residual = jax.tree_util.tree_unflatten(
+            plan.treedef,
+            [np.zeros((dp,) + plan.shapes[i], plan.dtypes[i])
+             for i in range(len(plan.shapes))])
+        legacy_opt = dict(opt)
+        legacy_opt["encoder"] = {"residual": residual,
+                                 "threshold": np.zeros((dp,),
+                                                       np.float32),
+                                 "nnz": np.zeros((dp,), np.int32)}
+        out = dict(like_host)
+        out["opt_state"] = legacy_opt
+        return out
+
+    def _migrate_encoder(self, state):
+        """Legacy -> per-bucket encoder layout, on host arrays:
+        bucket b's flat residual = concat of its leaves' residual rows
+        (bit-preserving), threshold tiled per bucket (every bucket
+        resumes the shared adaptive threshold it would have had), nnz
+        reset to 0 (pure last-step telemetry, not encoder input)."""
+        plan = self.trainer.bucket_plan
+        enc = state["opt_state"]["encoder"]
+        leaves = jax.tree_util.tree_leaves(enc["residual"])
+        dp = leaves[0].shape[0]
+        residual = {
+            str(b): np.concatenate(
+                [np.asarray(leaves[i]).reshape(dp, -1)
+                 for i in plan.buckets[b]], axis=1)
+            for b in range(plan.num_buckets)}
+        thr = np.tile(
+            np.asarray(enc["threshold"], np.float32).reshape(dp, 1),
+            (1, plan.num_buckets))
+        new_opt = dict(state["opt_state"])
+        new_opt["encoder"] = {
+            "residual": residual, "threshold": thr,
+            "nnz": np.zeros((dp, plan.num_buckets), np.int32)}
+        out = dict(state)
+        out["opt_state"] = new_opt
+        return out
+
+    def _restore_legacy(self, step, like_host, cause):
+        """Fallback restore for pre-bucketing checkpoints: re-restore
+        against the legacy encoder layout (the manifest verifies
+        against THAT tree), then migrate to the per-bucket layout.
+        Re-raises `cause` when the state has no encoder or the legacy
+        layout doesn't match either (genuine corruption)."""
+        from deeplearning4j_tpu.resilience import integrity as _integrity
+        legacy_like = self._legacy_encoder_like(like_host)
+        if legacy_like is None:
+            raise cause
+        try:
+            if step is None:
+                s, state = self.ckpt.restore_verified(like=legacy_like)
+            else:
+                s, state = self.ckpt.restore(step=step, like=legacy_like)
+                _integrity.verify_restored(self.directory, s, state)
+        except Exception:  # noqa: BLE001 — not legacy either
+            raise cause
+        if s is None:
+            return None, None
+        _debug("restore: migrating legacy encoder layout, generation",
+               s)
+        if _mon.enabled():
+            _mon.get_registry().counter(
+                _mon.DIST_ENCODER_MIGRATIONS,
+                help="pre-bucketing encoder states migrated to the "
+                     "per-bucket layout on restore").inc()
+        return s, self._migrate_encoder(state)
 
     def resume_or_init(self, init_params):
         """All hosts land on the SAME generation: process 0 scans for

@@ -27,6 +27,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.monitoring import profiler as _prof
+from deeplearning4j_tpu.nn import accum as _accum
 from deeplearning4j_tpu.nn.updaters import Updater
 from deeplearning4j_tpu.parallel import coordination as _dist
 from deeplearning4j_tpu.parallel.mesh import shard_map
@@ -39,22 +40,76 @@ def _as_tx(updater):
     return updater.to_optax() if isinstance(updater, Updater) else updater
 
 
+def accumulate_grads(loss_fn, params, batch, rng, n_micro):
+    """The trainer-facing accumulation entry (ShardedTrainer,
+    MultiHostTrainer's local worker): lax.scan over `n_micro`
+    microbatches (batch leaves carry a leading (G, ...) axis), summing
+    gradients and loss ON DEVICE — one dispatch and one optimizer step
+    regardless of G. The scan body is `nn/accum.accum_scan`, the ONE
+    shared core all five accumulated step builders drive (the nn/
+    model steps call it directly with their bn/vertex state threaded).
+
+    Returns (mean_grads, mean_loss, micro_ok) where micro_ok is the AND
+    of per-microbatch loss finiteness: a NaN/inf in ANY microbatch
+    survives into the verdict even though only the accumulated gradient
+    is inspected downstream (non-finite values also propagate through
+    the on-device sum, so the accumulated gnorm catches them — micro_ok
+    makes the per-microbatch contract explicit and covers a NaN loss
+    with finite grads). `n_micro == 1` is byte-for-byte the plain step:
+    no scan, no rng fold — existing key streams stay bit-identical.
+
+    The microbatch rng is fold_in(rng, i), so the scanned stream equals
+    an explicit sequential loop folding the same indices."""
+    if n_micro <= 1:
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+        return grads, loss, jnp.isfinite(loss)
+
+    def grad_fn(p, s, inp):
+        i, mb = inp
+        loss, grads = jax.value_and_grad(loss_fn)(
+            p, mb, jax.random.fold_in(rng, i))
+        return (loss, s), grads
+
+    grads, loss, ok, _ = _accum.accum_scan(
+        grad_fn, params, jnp.float32(0.0),   # stateless: dummy carry
+        (jnp.arange(n_micro), batch))
+    return grads, loss, ok
+
+
 class ShardedTrainer:
     """Sync-SPMD trainer over an explicit mesh.
 
     loss_fn(params, batch, rng) -> scalar; batch dim-0 shards over `dp`;
     params shard per `param_specs` (replicated where None).
+
+    accumulation=G > 1 turns each fit_batch into ONE jitted optimizer
+    step over a staged SUPER-batch whose leaves carry a leading
+    microbatch axis (G, B, ...): the step lax.scans the G backward
+    passes, accumulates gradients on device, and applies a single
+    update — one dispatch and one host fetch per optimizer step
+    regardless of G (the naive loop costs G dispatches + G updates),
+    so effective batch sizes scale past what HBM can hold at once.
+    The per-dp-shard batch dim is the SECOND axis; `shard_batch`
+    handles the placement.
     """
 
     def __init__(self, loss_fn, updater, mesh, param_specs=None,
-                 batch_axis="dp", donate=True):
+                 batch_axis="dp", donate=True, accumulation=1):
         self.loss_fn = loss_fn
         self.tx = _as_tx(updater)
         self.mesh = mesh
         self.param_specs = param_specs
         self.batch_axis = batch_axis
         self._donate = donate
+        self.accumulation = int(accumulation)
+        if self.accumulation < 1:
+            raise ValueError("accumulation must be >= 1")
         self._step = None
+        if self.accumulation > 1 and _mon.enabled():
+            _mon.get_registry().gauge(
+                _mon.DIST_ACCUM_MICROBATCHES,
+                help="microbatches accumulated per optimizer step") \
+                .set(self.accumulation)
 
     # -- placement -------------------------------------------------------
     def shard_params(self, params):
@@ -82,8 +137,15 @@ class ShardedTrainer:
         """dp-shard one batch pytree. owned=True stages host leaves
         through XLA-owned copies (runtime/pipeline.xla_owned_copy) — the
         background prefetch path uses it so staged buffers can never
-        alias loader-owned numpy memory."""
-        sh = NamedSharding(self.mesh, P(self.batch_axis))
+        alias loader-owned numpy memory.
+
+        With accumulation > 1 the batch is a SUPER-batch: leading axis =
+        microbatch index (replicated), dim 1 = per-microbatch batch dim
+        (dp-sharded) — the PR 3 prefetch stages whole super-batches the
+        same way, so the host pipeline rides unchanged."""
+        spec = (P(None, self.batch_axis) if self.accumulation > 1
+                else P(self.batch_axis))
+        sh = NamedSharding(self.mesh, spec)
 
         def put(a):
             _mon.record_transfer(getattr(a, "nbytes", 0))
@@ -124,12 +186,14 @@ class ShardedTrainer:
             return self._step
         tx = self.tx
         loss_fn = self.loss_fn
+        n_micro = self.accumulation
 
         donate = (0, 1) if self._donate else ()
 
         @functools.partial(jax.jit, donate_argnums=donate)
         def step(params, opt_state, batch, rng):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+            grads, loss, _ = accumulate_grads(loss_fn, params, batch,
+                                              rng, n_micro)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
@@ -149,13 +213,20 @@ class ShardedTrainer:
             return cached
         tx = self.tx
         loss_fn = self.loss_fn
+        n_micro = self.accumulation
         donate = (0, 1) if self._donate else ()
 
         @functools.partial(jax.jit, donate_argnums=donate)
         def step(params, opt_state, batch, rng, lr_scale, max_gnorm):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+            grads, loss, micro_ok = accumulate_grads(
+                loss_fn, params, batch, rng, n_micro)
+            # the verdict gates the ACCUMULATED update, but a NaN in any
+            # single microbatch still fails it: poison the loss the
+            # verdict inspects (non-finite grads also propagate through
+            # the accumulated gnorm)
+            vloss = jnp.where(micro_ok, loss, jnp.float32(jnp.nan))
             params, opt_state, _, gnorm, ok = _guardian.guarded_apply(
-                tx, grads, loss, params, opt_state, lr_scale, max_gnorm)
+                tx, grads, vloss, params, opt_state, lr_scale, max_gnorm)
             return params, opt_state, loss, gnorm, ok
 
         self._guarded_step = step

@@ -44,7 +44,7 @@ class _StagedShards:
 class ParallelWrapper:
     def __init__(self, model, workers=None, prefetch_buffer=2,
                  averaging_frequency=1, report_score=True, devices=None,
-                 shard_optimizer_state=False):
+                 shard_optimizer_state=False, gradient_accumulation=None):
         self.model = model
         devs = list(devices if devices is not None else jax.devices())
         n = workers or len(devs)
@@ -53,6 +53,14 @@ class ParallelWrapper:
         self.averaging_frequency = averaging_frequency  # sync SPMD ⇒ always 1
         self.report_score = report_score
         self.shard_optimizer_state = shard_optimizer_state  # ZeRO-1
+        # G; None = inherit the model conf's gradientAccumulation —
+        # an EXPLICIT 1 overrides the conf back to per-batch steps
+        self.gradient_accumulation = (None if gradient_accumulation
+                                      is None else
+                                      int(gradient_accumulation))
+        if self.gradient_accumulation is not None \
+                and self.gradient_accumulation < 1:
+            raise ValueError("gradient_accumulation must be >= 1")
 
     class Builder:
         def __init__(self, model):
@@ -78,6 +86,20 @@ class ParallelWrapper:
         def shardOptimizerState(self, flag=True):
             """ZeRO-1: shard updater state over dp (parallel/zero.py)."""
             self._kw["shard_optimizer_state"] = bool(flag)
+            return self
+
+        def gradientAccumulation(self, n):
+            """In-step microbatch accumulation: every G consecutive
+            same-shape batches run as ONE dp-sharded jitted optimizer
+            step (scan sums grads on device, single update) — one
+            dispatch per optimizer step regardless of G, effective
+            batch G× the per-dispatch footprint. Composes with the
+            guardian (one verdict per real update) and takes
+            precedence over stepsPerDispatch. When not set here it is
+            inherited from the conf DSL's `.gradientAccumulation(G)`;
+            an explicit `gradientAccumulation(1)` OVERRIDES the conf
+            back to plain per-batch dp steps."""
+            self._kw["gradient_accumulation"] = int(n)
             return self
 
         def workspaceMode(self, *_):
@@ -343,18 +365,108 @@ class ParallelWrapper:
         if _ps is not None:
             _ps.step_end()
 
+    def _fit_group_accum(self, group):
+        """One ACCUMULATED dp-sharded optimizer step over G stacked
+        batches — the model's `_train_accum`/`_train_step_accum` with
+        input sharding (k, B, ...) = (replicated, dp): the scan sums
+        per-microbatch gradients (each microbatch's psum rides the same
+        program) and applies ONE update. One real update: iteration and
+        listeners advance once; under a guardian the accumulated step's
+        single verdict gates it (per-microbatch NaN still caught via
+        the poisoned loss)."""
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.TRAIN_DISPATCH)
+        if _watchdog.ACTIVE is not None:
+            _watchdog.ACTIVE.beat(f"parallel_wrapper@{id(self):x}")
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_start()
+        m = self.model
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh2 = NamedSharding(self.mesh.mesh, P(None, "dp"))  # (G, B, ...)
+
+        def stack_put(field):
+            arrs = [getattr(ds, field) for ds in group]
+            if arrs[0] is None:
+                return None
+            stacked = np.stack([np.asarray(a) for a in arrs])
+            _mon.record_transfer(stacked.nbytes)
+            return jax.device_put(stacked, sh2)
+
+        with _mon.span("train.stage"):
+            subs = []
+            for _ in group:   # one key split per microbatch
+                m._rng_key, sub = jax.random.split(m._rng_key)
+                subs.append(sub)
+            xs, ys = stack_put("features"), stack_put("labels")
+            fms, lms = stack_put("featuresMask"), stack_put("labelsMask")
+        import jax.numpy as jnp
+        _g = _guardian.ACTIVE
+        with _mon.span("parallel.accum_dispatch"):
+            if self._graph_model():
+                ins, labels, fmasks, lmasks = m._pack_single(xs, ys, fms,
+                                                             lms)
+                if _g is not None:
+                    (m._params, m._opt_state, m._state, loss, gnorm,
+                     ok) = m._train_accum_guarded(
+                        m._params, m._opt_state, m._state, ins, labels,
+                        fmasks, lmasks, jnp.stack(subs), _g.lr_scale,
+                        _g.max_gnorm)
+                else:
+                    (m._params, m._opt_state, m._state,
+                     loss) = m._train_accum(
+                        m._params, m._opt_state, m._state, ins, labels,
+                        fmasks, lmasks, jnp.stack(subs))
+                m._last_features = jax.tree_util.tree_map(
+                    lambda a: a[-1], ins)
+            else:
+                if _g is not None:
+                    (m._params, m._opt_state, m._state, loss, gnorm,
+                     ok) = m._train_step_accum_guarded(
+                        m._params, m._opt_state, m._state, xs, ys, fms,
+                        lms, jnp.stack(subs), _g.lr_scale, _g.max_gnorm)
+                else:
+                    (m._params, m._opt_state, m._state,
+                     loss) = m._train_step_accum(
+                        m._params, m._opt_state, m._state, xs, ys, fms,
+                        lms, jnp.stack(subs))
+                m._last_features = xs[-1]
+            m._score = loss    # device scalar; score() floats on demand
+        if _g is not None:
+            _g.on_step(loss, gnorm, ok)   # one verdict per real update
+        m._iteration += 1
+        m._params_version = getattr(m, "_params_version", 0) + 1
+        with _mon.span("train.listeners"):
+            for listener in m._listeners:
+                listener.iterationDone(m, m._iteration, m._epoch)
+        _ps = _prof.ACTIVE
+        if _ps is not None:
+            _ps.step_end()
+
     def fit(self, iterator, epochs=1, stepsPerDispatch=1):
         """Data-parallel fit: same jitted train step as the wrapped model —
         input sharding makes it SPMD over the dp axis. stepsPerDispatch=k
         scans k same-shape batches inside ONE dispatch (ragged/odd batches
         fall back to the per-batch step; same key stream and math — dense
-        models bit-identical, conv models within fp-reassociation noise)."""
+        models bit-identical, conv models within fp-reassociation noise).
+
+        gradientAccumulation=G (builder knob, or inherited from the
+        model conf): every G same-shape batches run as ONE accumulated
+        optimizer step instead — one dispatch AND one update per group;
+        takes precedence over stepsPerDispatch and stays on under a
+        guardian (the accumulated step carries its own verdict)."""
         if self.model._params is None:
             self.model.init()
         self._shard_model()
         it, pf = iterator, None
+        accum = self.gradient_accumulation
+        if accum is None:   # unset → inherit; explicit 1 stays 1
+            accum = int(self.model.conf.defaults.get(
+                "gradientAccumulation", 1) or 1)
         k = max(1, int(stepsPerDispatch))
-        if _guardian.ACTIVE is not None:
+        if accum > 1:
+            k = accum   # accumulation owns the grouping
+        elif _guardian.ACTIVE is not None:
             k = 1    # per-step health verdicts (see model fit loops)
         if self.prefetch_buffer and hasattr(iterator, "asyncSupported") \
                 and iterator.asyncSupported():
@@ -396,7 +508,10 @@ class ParallelWrapper:
                                 sig = s
                             group.append(ds)
                             if len(group) == k:
-                                self._fit_group_scanned(group)
+                                if accum > 1:
+                                    self._fit_group_accum(group)
+                                else:
+                                    self._fit_group_scanned(group)
                                 group = []
                         flush()
                     self.model._epoch += 1

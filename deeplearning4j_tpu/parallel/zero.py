@@ -30,16 +30,19 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
-def _leaf_spec(shape, n):
-    """PartitionSpec splitting the largest axis divisible by n; None if no
-    axis qualifies (small/scalar leaves stay replicated)."""
+def _leaf_spec(shape, n, axis):
+    """PartitionSpec for one state leaf: the largest axis whose size is
+    divisible by `n` is sharded over mesh axis `axis`; P() (replicated)
+    when no axis qualifies (small/scalar leaves)."""
     best = -1
     for d, s in enumerate(shape):
         if s % n == 0 and s >= n and (best < 0 or s > shape[best]):
             best = d
     if best < 0:
-        return None
-    return best
+        return P()
+    spec = [None] * len(shape)
+    spec[best] = axis
+    return P(*spec)
 
 
 def shard_optimizer_state(opt_state, mesh, axis="dp"):
@@ -54,13 +57,7 @@ def shard_optimizer_state(opt_state, mesh, axis="dp"):
         shape = getattr(leaf, "shape", None)
         if shape is None:
             return leaf
-        d = _leaf_spec(shape, n)
-        if d is None:
-            sh = NamedSharding(jmesh, P())
-        else:
-            spec = [None] * len(shape)
-            spec[d] = axis
-            sh = NamedSharding(jmesh, P(*spec))
+        sh = NamedSharding(jmesh, _leaf_spec(shape, n, axis))
         return jax.device_put(leaf, sh)
 
     return jax.tree_util.tree_map(place, opt_state)

@@ -132,6 +132,31 @@ GENERATION_SYNC_BOUNDARY = {"_fetch_tokens", "_start_fetch"}
 SYNC_CALL_NAMES = {"asarray", "device_get", "block_until_ready",
                    "item", "tolist", "copy_to_host_async"}
 
+# -- training-exchange lint (accumulation scan + bucketed exchange) --------
+#: modules forming the distributed train-step hot path: the in-step
+#: accumulation scan, the bucket planner, and the bucketed
+#: encode→pmean→decode exchange must perform NO host sync — one
+#: dispatch per optimizer step, and the per-optimizer-step fetch
+#: (encoder_stats / guardian _materialize / lazy score) stays the one
+#: declared boundary
+TRAIN_MODULES = [
+    "deeplearning4j_tpu/parallel/sharded_trainer.py",
+    "deeplearning4j_tpu/parallel/multihost.py",
+    "deeplearning4j_tpu/parallel/buckets.py",
+    "deeplearning4j_tpu/parallel/compression.py",
+    "deeplearning4j_tpu/nn/accum.py",
+]
+#: per-optimizer-step entry points: the step builders (their traced
+#: bodies), the accumulation core, the bucket planner (host-side but
+#: must stay shape-metadata-only), and the dispatch hook
+TRAIN_SYNC_ROOTS = {"make_step", "make_guarded_step", "_make_exchange",
+                    "accumulate_grads", "accum_scan", "fit_batch",
+                    "plan_buckets", "concat", "split"}
+#: the declared host-fetch boundary — stats/score materialize at sync
+#: cadence, never per optimizer step; the traversal stops there
+TRAIN_SYNC_BOUNDARY = {"encoder_stats", "_materialize",
+                       "materialize_score"}
+
 #: attribute calls that hit the registry
 REGISTRY_ATTRS = {"counter", "gauge", "histogram"}
 #: bare/attribute function names that resolve the registry
@@ -288,6 +313,23 @@ def check_generation_steady_state(sources):
             "executable set"))
 
 
+def check_training_host_sync(sources):
+    """Zero host syncs on the distributed train-step path: the
+    accumulation scan dispatches once per optimizer step, the bucket
+    planner reads only leaf SHAPES, and the bucketed exchange stays
+    device-resident end to end — the stats/score fetch
+    (encoder_stats / guardian _materialize) is the only declared
+    per-optimizer-step host boundary."""
+    return _check_reachable(
+        sources, TRAIN_SYNC_ROOTS, TRAIN_SYNC_BOUNDARY,
+        SYNC_CALL_NAMES,
+        lambda what, via: (
+            f"{what} reachable from the distributed train step (via "
+            f"{via}) — the accumulation scan / bucketed exchange must "
+            "not sync the host; encoder_stats is the declared "
+            "boundary"))
+
+
 def check_generation_host_sync(sources):
     """Zero per-token host syncs beyond the sampled-token fetch: the
     decode step's only device materialization is the declared
@@ -325,6 +367,13 @@ def main(modules=None):
                     gen_sources[path] = f.read()
         violations.extend(check_generation_steady_state(gen_sources))
         violations.extend(check_generation_host_sync(gen_sources))
+        train_sources = {}
+        for rel in TRAIN_MODULES:
+            path = os.path.join(REPO_ROOT, rel)
+            if os.path.exists(path):
+                with open(path) as f:
+                    train_sources[path] = f.read()
+        violations.extend(check_training_host_sync(train_sources))
     for path, lineno, msg in violations:
         print(f"{os.path.relpath(path, REPO_ROOT)}:{lineno}: {msg}")
     if violations:

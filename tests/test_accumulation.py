@@ -1,0 +1,466 @@
+"""In-step gradient accumulation + bucketed overlapped exchange
+(ISSUE 14): the accumulated step must equal an on-device
+sequential-sum reference, the bucket planner must balance bytes and
+round-trip losslessly, the compiled step's HLO must carry the overlap
+structure, the guardian must gate the ACCUMULATED update (per-
+microbatch NaN included, encoder state rolled back), and the knobs
+must surface on metrics + the /health distributed snapshot."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.parallel.buckets import (check_overlap_structure,
+                                                 plan_buckets)
+from deeplearning4j_tpu.parallel.multihost import (MultiHostTrainer,
+                                                   global_batch)
+from deeplearning4j_tpu.parallel.sharded_trainer import ShardedTrainer
+
+G = 4
+
+
+def _loss_fn(p, batch, rng):
+    h = jnp.tanh(batch["x"] @ p["W1"] + p["b1"])
+    return jnp.mean((h @ p["W2"] - batch["y"]) ** 2)
+
+
+def _params():
+    r = np.random.default_rng(0)
+    return {"W1": (r.standard_normal((6, 16)) * 0.3).astype(np.float32),
+            "b1": np.zeros(16, np.float32),
+            "W2": (r.standard_normal((16, 2)) * 0.3).astype(np.float32)}
+
+
+@pytest.fixture(scope="module")
+def data():
+    """One super-batch (G, B, ...) + rng key, shared module-wide (suite
+    diet: the heavy cost here is jit compiles, not data)."""
+    r = np.random.default_rng(1)
+    xs = r.standard_normal((G, 8, 6)).astype(np.float32)
+    ys = r.standard_normal((G, 8, 2)).astype(np.float32)
+    return xs, ys, jax.random.PRNGKey(7)
+
+
+@pytest.fixture(scope="module")
+def reference(data):
+    """On-device sequential-sum reference: G per-microbatch grads
+    summed in order, ONE update — the exact contract the accumulated
+    step must reproduce (grads/params ≤1e-6, loss bit-equal)."""
+    xs, ys, key = data
+    tx = Sgd(0.1).to_optax()
+    p = jax.device_put({k: jnp.asarray(v) for k, v in _params().items()})
+    s = tx.init(p)
+    gsum = jax.tree_util.tree_map(jnp.zeros_like, p)
+    lsum = jnp.float32(0.0)
+    for i in range(G):
+        l, g = jax.value_and_grad(_loss_fn)(
+            p, {"x": xs[i], "y": ys[i]}, jax.random.fold_in(key, i))
+        gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+        lsum = lsum + l
+    grads = jax.tree_util.tree_map(lambda g_: g_ * (1.0 / G), gsum)
+    upd, s = tx.update(grads, s, p)
+    return optax.apply_updates(p, upd), float(lsum * (1.0 / G))
+
+
+# ===================== bucket planner ==================================
+def test_bucket_planner_balances_and_round_trips():
+    tree = {"a": jnp.ones((100, 4)), "b": jnp.ones((7,)),
+            "c": jnp.ones((50, 3)), "d": jnp.ones((20,)),
+            "e": jnp.ones((300,))}
+    plan = plan_buckets(tree, num_buckets=3)
+    assert plan.num_buckets == 3
+    assert sum(plan.bucket_bytes) == plan.total_bytes == 3508
+    # byte balance: greedy LPT keeps the max bucket under the largest
+    # leaf + the mean of the rest (leaf granularity bound)
+    assert max(plan.bucket_bytes) <= 1600   # the largest single leaf
+    # concat/split is the identity (up to the plan's flat layout)
+    flats = plan.concat(tree)
+    assert [int(f.shape[0]) for f in flats] == list(plan.bucket_elems)
+    back = plan.split(flats)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(back[k]),
+                                      np.asarray(tree[k]))
+    # deterministic for the same structure
+    plan2 = plan_buckets(tree, num_buckets=3)
+    assert plan2.buckets == plan.buckets
+
+
+def test_bucket_planner_dtype_separation_and_target_bytes():
+    tree = {"w": jnp.ones((64,)), "i": jnp.zeros((64,), jnp.int32)}
+    plan = plan_buckets(tree, num_buckets=2)
+    # a bucket never mixes dtypes (its payload is ONE flat vector)
+    for b in range(plan.num_buckets):
+        dts = {str(plan.dtypes[i]) for i in plan.buckets[b]}
+        assert len(dts) == 1
+    # target-bytes mode derives the count; clamped to the leaf count
+    big = {"a": jnp.ones((1000,)), "b": jnp.ones((1000,))}
+    assert plan_buckets(big, bucket_bytes=4000).num_buckets == 2
+    assert plan_buckets(big, bucket_bytes=10 ** 9).num_buckets == 1
+    with pytest.raises(ValueError):
+        plan_buckets(big, num_buckets=2, bucket_bytes=100)
+
+
+# ===================== accumulated step ≡ reference =====================
+def test_sharded_accum_matches_sequential_sum_reference(data, reference,
+                                                        devices8):
+    """ShardedTrainer(accumulation=G): ONE dispatch, grads/params match
+    the sequential-sum reference ≤1e-6 and the loss is bit-equal."""
+    xs, ys, key = data
+    pref, loss_ref = reference
+    mesh = MultiHostTrainer(_loss_fn, Sgd(0.1)).mesh
+    tr = ShardedTrainer(_loss_fn, Sgd(0.1), mesh, accumulation=G)
+    p, s = tr.init(_params())
+    batch = tr.shard_batch({"x": xs, "y": ys})
+    p, s, loss = tr.fit_batch(p, s, batch, key)
+    for k in pref:
+        np.testing.assert_allclose(np.asarray(p[k]), np.asarray(pref[k]),
+                                   atol=1e-6)
+    assert float(loss) == loss_ref          # bit-equal
+
+
+def test_multihost_raw_bucketed_accum_matches_reference(data, reference,
+                                                        devices8):
+    """compress=False + buckets: the explicit bucketed exchange on RAW
+    accumulated gradients is numerically the same optimizer step (pmean
+    of per-worker means == global mean)."""
+    xs, ys, key = data
+    pref, _ = reference
+    tr = MultiHostTrainer(_loss_fn, Sgd(0.1), compress=False, buckets=2,
+                          accumulation=G)
+    p, s = tr.init(_params())
+    batch = global_batch(tr.mesh, {"x": xs, "y": ys}, accumulation=G)
+    p, s, loss = tr.fit_batch(p, s, batch, key)
+    for k in pref:
+        np.testing.assert_allclose(np.asarray(p[k]), np.asarray(pref[k]),
+                                   atol=1e-6)
+
+
+def test_compressed_bucket_split_is_exact_at_equal_thresholds(data,
+                                                              devices8):
+    """Splitting the encoded exchange into buckets must not change the
+    step-1 math: encoding is elementwise given the threshold, and every
+    bucket starts at the same initial threshold — so buckets=1 and
+    buckets=3 produce identical exchanged updates (the thresholds only
+    diverge per bucket from step 2 on, by design)."""
+    xs, ys, key = data
+    outs = {}
+    for nb in (1, 3):
+        tr = MultiHostTrainer(_loss_fn, Sgd(0.1), compress=True,
+                              buckets=nb, accumulation=G,
+                              compression_kw={"initial_threshold": 1e-3})
+        p, s = tr.init(_params())
+        batch = global_batch(tr.mesh, {"x": xs, "y": ys}, accumulation=G)
+        p, s, _ = tr.fit_batch(p, s, batch, key)
+        outs[nb] = {k: np.asarray(v) for k, v in p.items()}
+        assert tr.bucket_plan.num_buckets == nb
+    for k in outs[1]:
+        np.testing.assert_array_equal(outs[1][k], outs[3][k])
+
+
+def test_per_bucket_thresholds_adapt_independently(data, devices8):
+    """Each bucket owns its residual + adaptive threshold: a bucket
+    whose gradients never clear the threshold DECAYS its threshold
+    (ship more next step) while a dense bucket BOOSTS — the old single
+    shared threshold could only do one or the other. A parameter with
+    zero gradient (unused in the loss) isolates the sparse bucket."""
+    xs, ys, key = data
+
+    def loss_dead(p, batch, rng):
+        return _loss_fn(p, batch, rng) + 0.0 * jnp.sum(p["dead"] * 0.0)
+
+    params = dict(_params(), dead=np.ones((32,), np.float32))
+    tr = MultiHostTrainer(loss_dead, Sgd(0.1), compress=True, buckets=4,
+                          accumulation=G,
+                          compression_kw={"initial_threshold": 1e-3})
+    p, s = tr.init(params)
+    assert tr.bucket_plan.num_buckets == 4   # one leaf per bucket
+    batch = global_batch(tr.mesh, {"x": xs, "y": ys}, accumulation=G)
+    for i in range(6):
+        p, s, _ = tr.fit_batch(p, s, batch, jax.random.fold_in(key, i))
+    thr = np.asarray(jax.device_get(s["encoder"]["threshold"]))
+    # stacked per worker: (workers, buckets); workers agree, buckets
+    # diverge (dead bucket decayed toward min, dense buckets boosted)
+    assert thr.shape[-1] == 4
+    assert thr[0].max() > 1e-3 > thr[0].min()
+    stats = tr.encoder_stats(s)
+    assert len(stats["bucket_encoded_bytes"]) == 4
+    assert stats["encoded_bytes"] == sum(stats["bucket_encoded_bytes"])
+    # the dead parameter's bucket shipped nothing
+    dead_leaf = next(i for i, sh in enumerate(tr.bucket_plan.shapes)
+                     if sh == (32,))
+    dead_bucket = next(b for b, idxs in enumerate(tr.bucket_plan.buckets)
+                       if dead_leaf in idxs)
+    assert stats["bucket_nnz"][dead_bucket] == 0
+
+
+# ===================== overlap structure ================================
+def test_hlo_overlap_structure_all_step_variants(data, devices8):
+    """The compiled step must show one collective per bucket, with
+    bucket k's collective scheduled BEFORE bucket k+1's encode — the
+    structural form XLA's latency-hiding scheduler overlaps (async
+    start/done on TPU/GPU; order-pinned sync collectives here on
+    CPU)."""
+    xs, ys, key = data
+    tr = MultiHostTrainer(_loss_fn, Sgd(0.1), compress=True, buckets=3,
+                          accumulation=G,
+                          compression_kw={"initial_threshold": 1e-4})
+    p, s = tr.init(_params())
+    batch = global_batch(tr.mesh, {"x": xs, "y": ys}, accumulation=G)
+    hlo = tr.make_step().lower(p, s, batch, key).compile().as_text()
+    assert check_overlap_structure(hlo, 3) == []
+    hlo_g = tr.make_guarded_step().lower(
+        p, s, batch, key, jnp.float32(1.0),
+        jnp.float32(np.inf)).compile().as_text()
+    assert check_overlap_structure(hlo_g, 3) == []
+    # and the checker itself rejects a serialized-exchange schedule
+    serialized = "\n".join(
+        ["ENTRY %main () -> f32[] {",
+         '  %e0 = f32[4] fusion(), metadata={op_name="a/dl4j_bucket0_encode/x"}',
+         '  %e1 = f32[4] fusion(), metadata={op_name="a/dl4j_bucket1_encode/x"}',
+         '  %a0 = f32[4] all-reduce(%e0), metadata={op_name="a/dl4j_bucket0_exchange/x"}',
+         '  %a1 = f32[4] all-reduce(%e1), metadata={op_name="a/dl4j_bucket1_exchange/x"}',
+         "}"])
+    assert check_overlap_structure(serialized, 2) != []
+
+
+# ===================== guardian composition =============================
+def test_guarded_accum_refuses_nan_microbatch_and_rolls_back_encoder(
+        data, devices8):
+    """A NaN in ONE microbatch of the super-batch fails the single
+    accumulated verdict: params, optimizer state AND the per-bucket
+    encoder state (residuals, thresholds) all stay at their pre-step
+    values — that step never happened."""
+    xs, ys, key = data
+    tr = MultiHostTrainer(_loss_fn, Sgd(0.1), compress=True, buckets=3,
+                          accumulation=G,
+                          compression_kw={"initial_threshold": 1e-4})
+    p, s = tr.init(_params())
+    batch = global_batch(tr.mesh, {"x": xs, "y": ys}, accumulation=G)
+    # one healthy step so residuals are nonzero (a real rollback target)
+    step = tr.make_guarded_step()
+    p, s, loss, gnorm, ok = step(p, s, batch, key, jnp.float32(1.0),
+                                 jnp.float32(np.inf))
+    assert bool(ok)
+    before = jax.device_get({"p": p, "enc": s["encoder"]})
+    xs_bad = xs.copy()
+    xs_bad[2] = np.nan                      # poison microbatch 2 only
+    bad = global_batch(tr.mesh, {"x": xs_bad, "y": ys}, accumulation=G)
+    p, s, loss, gnorm, ok = step(p, s, bad, jax.random.fold_in(key, 1),
+                                 jnp.float32(1.0), jnp.float32(np.inf))
+    assert not bool(ok)
+    after = jax.device_get({"p": p, "enc": s["encoder"]})
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    res = after["enc"]["residual"]
+    assert any(np.abs(res[k]).sum() > 0 for k in res)  # real residuals
+
+
+def test_graph_wrapper_accumulation_matches_conf_accum(devices8):
+    """The conf DSL knob and the wrapper knob drive the SAME accumulated
+    step for ComputationGraph models: dp-sharded wrapper accumulation
+    equals the graph's own conf-driven accumulated fit."""
+    from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration,
+                                       OutputLayer)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+
+    def gnet(accum=None):
+        b = (NeuralNetConfiguration.Builder().seed(6).updater(Sgd(0.05))
+             .activation("relu"))
+        if accum:
+            b = b.gradientAccumulation(accum)
+        conf = (b.graphBuilder()
+                .addInputs("in")
+                .addLayer("d", DenseLayer.Builder().nOut(12).build(),
+                          "in")
+                .addLayer("out", OutputLayer.Builder("mcxent").nOut(3)
+                          .activation("softmax").build(), "d")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(6)).build())
+        return ComputationGraph(conf).init()
+
+    r = np.random.default_rng(10)
+    x = r.standard_normal((64, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.integers(0, 3, 64)]
+    g1 = gnet()
+    ParallelWrapper.Builder(g1).workers(8).gradientAccumulation(4) \
+        .build().fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=2)
+    g2 = gnet(accum=4)
+    g2.fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=2)
+    for a, b in zip(jax.tree_util.tree_leaves(g1._params),
+                    jax.tree_util.tree_leaves(g2._params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+    assert g1._iteration == g2._iteration == 2   # one update per group
+
+
+def test_zero1_rides_the_accumulated_bucketed_step(data, devices8):
+    """ZeRO-1 composes: the base optimizer state stays dp-sharded
+    through the accumulated bucketed step (GSPMD partitions the ONE
+    update per super-batch by the state sharding), and the step still
+    trains."""
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.nn.updaters import Adam
+    xs, ys, key = data
+    tr = MultiHostTrainer(_loss_fn, Adam(0.01), compress=True, buckets=2,
+                          accumulation=G, zero1=True,
+                          compression_kw={"initial_threshold": 1e-4})
+    p, s = tr.init(_params())
+    batch = global_batch(tr.mesh, {"x": xs, "y": ys}, accumulation=G)
+    losses = []
+    for i in range(5):
+        p, s, loss = tr.fit_batch(p, s, batch, jax.random.fold_in(key, i))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    sharded = [l for l in jax.tree_util.tree_leaves(s["base"])
+               if hasattr(l, "sharding") and l.sharding.spec != P()
+               and "dp" in str(l.sharding.spec)]
+    assert sharded, "no base-state leaf stayed dp-sharded through the " \
+                    "accumulated step"
+
+
+# ===================== knobs on metrics + /health =======================
+def test_accum_bucket_knobs_on_metrics_and_health(data, devices8):
+    from deeplearning4j_tpu import monitoring as mon
+    from deeplearning4j_tpu.parallel.coordination import (LocalKV,
+                                                          PeerCoordinator)
+    xs, ys, key = data
+    mon.enable()
+    try:
+        tr = MultiHostTrainer(_loss_fn, Sgd(0.1), compress=True,
+                              buckets=2, accumulation=G,
+                              compression_kw={"initial_threshold": 1e-4})
+        p, s = tr.init(_params())
+        reg = mon.get_registry()
+        assert reg.get(mon.DIST_ACCUM_MICROBATCHES).value == G
+        assert reg.get(mon.DIST_EXCHANGE_BUCKETS).value == 2
+        assert reg.get(mon.DIST_BUCKET_BYTES).value == \
+            max(tr.bucket_plan.bucket_bytes)
+        batch = global_batch(tr.mesh, {"x": xs, "y": ys}, accumulation=G)
+        p, s, _ = tr.fit_batch(p, s, batch, key)
+        tr.encoder_stats(s)
+        assert reg.get(mon.DIST_EXPOSED_EXCHANGE_MS).value >= 0
+        # /health "distributed" snapshot carries the knobs via the
+        # bound coordinator
+        c = PeerCoordinator(sync_every=2, client=LocalKV(), process_id=0,
+                            num_processes=1)
+        c.bind(tr)
+        snap = c.snapshot()
+        assert snap["accum_microbatches"] == G
+        assert snap["exchange_buckets"] == 2
+        assert snap["bucket_bytes"] == list(tr.bucket_plan.bucket_bytes)
+    finally:
+        mon.disable()
+
+
+# ===================== review-hardening regressions =====================
+def test_legacy_encoder_checkpoint_migrates_on_resume(tmp_path,
+                                                      devices8):
+    """Checkpoints written BEFORE the bucketed exchange (PR 7 layout:
+    encoder residual keyed by param leaf, ONE shared adaptive threshold
+    per worker) still resume: restore falls back to the legacy layout
+    and migrates it in place — residual BITS preserved (each bucket's
+    flat vector is the concat of its leaves' rows), the shared
+    threshold tiled across buckets, nnz (pure last-step telemetry)
+    reset to 0."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel.coordination import (LocalKV,
+                                                          PeerCoordinator)
+    from deeplearning4j_tpu.parallel.multihost import MultiHostRunner
+
+    def make(name):
+        coord = PeerCoordinator(sync_every=4, peer_timeout=5.0,
+                                client=LocalKV(), process_id=0,
+                                num_processes=1, dump_dir=str(tmp_path))
+        tr = MultiHostTrainer(_loss_fn, Sgd(0.1), compress=True,
+                              buckets=2,
+                              compression_kw={"initial_threshold": 1e-4})
+        return tr, MultiHostRunner(tr, str(tmp_path / name), coord,
+                                   save_every=100, rng_seed=3,
+                                   monitor=False, sigterm=False)
+
+    tr, runner = make("ck_legacy")
+    p, opt = tr.init(_params())
+    plan = tr.bucket_plan
+    dp = opt["encoder"]["threshold"].shape[0]
+    sh = NamedSharding(tr.mesh, P("dp"))
+    rl = np.random.default_rng(9)
+    legacy_res_host = jax.tree_util.tree_unflatten(
+        plan.treedef,
+        [rl.standard_normal((dp,) + plan.shapes[i])
+         .astype(plan.dtypes[i]) for i in range(len(plan.shapes))])
+    legacy_opt = dict(opt)
+    legacy_opt["encoder"] = {
+        "residual": jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sh), legacy_res_host),
+        "threshold": jax.device_put(np.full((dp,), 2.5e-4, np.float32),
+                                    sh),
+        "nnz": jax.device_put(np.full((dp,), 17, np.int32), sh)}
+    runner.step = 5
+    runner.finalize(p, legacy_opt)   # manifest over the LEGACY tree
+
+    tr2, runner2 = make("ck_legacy")
+    _, opt2 = runner2.resume_or_init(_params())
+    assert runner2.step == 5
+    plan2 = tr2.bucket_plan
+    leg_leaves = jax.tree_util.tree_leaves(legacy_res_host)
+    for b in range(plan2.num_buckets):
+        want = np.concatenate([leg_leaves[i].reshape(dp, -1)
+                               for i in plan2.buckets[b]], axis=1)
+        got = np.asarray(jax.device_get(
+            opt2["encoder"]["residual"][str(b)]))
+        np.testing.assert_array_equal(got, want)   # BIT-preserved
+    thr = np.asarray(jax.device_get(opt2["encoder"]["threshold"]))
+    np.testing.assert_array_equal(
+        thr, np.full((dp, plan2.num_buckets), 2.5e-4, np.float32))
+    assert int(np.asarray(jax.device_get(
+        opt2["encoder"]["nnz"])).sum()) == 0
+    runner2.close()
+
+
+def test_wrapper_explicit_accum_1_overrides_conf(devices8):
+    """An EXPLICIT ParallelWrapper .gradientAccumulation(1) disables
+    the model conf's G (plain per-batch dp steps — per-step iteration/
+    listener/guardian cadence restored); leaving it unset still
+    inherits the conf knob."""
+    from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration,
+                                       OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+
+    def net():
+        conf = (NeuralNetConfiguration.Builder().seed(6)
+                .updater(Sgd(0.05)).activation("relu")
+                .gradientAccumulation(4).list()
+                .layer(DenseLayer.Builder().nOut(12).build())
+                .layer(OutputLayer.Builder("mcxent").nOut(3)
+                       .activation("softmax").build())
+                .setInputType(InputType.feedForward(6)).build())
+        m = MultiLayerNetwork(conf)
+        m.init()
+        return m
+
+    r = np.random.default_rng(11)
+    x = r.standard_normal((64, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[r.integers(0, 3, 64)]
+
+    inherit = net()
+    ParallelWrapper.Builder(inherit).workers(8).build() \
+        .fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=2)
+    assert inherit._iteration == 2    # 4 batches/epoch = 1 G-group
+
+    override = net()
+    ParallelWrapper.Builder(override).workers(8) \
+        .gradientAccumulation(1).build() \
+        .fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=2)
+    assert override._iteration == 8   # per-batch steps, conf G ignored

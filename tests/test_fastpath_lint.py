@@ -56,6 +56,42 @@ def test_lint_accepts_guarded_patterns():
     assert check_fastpath.check_source(good) == []
 
 
+def test_training_sync_lint_flags_host_sync_in_exchange():
+    """The training-exchange rule: a host materialization reachable
+    from the step builders / bucket planner is flagged; the declared
+    encoder_stats boundary is not descended into."""
+    bad = textwrap.dedent("""
+        import numpy as np
+
+        def make_step(self):
+            def step(params, batch):
+                return self._exchange(params, batch)
+            return step
+
+        def _exchange(self, params, batch):
+            return np.asarray(params)      # host sync on the hot path
+
+        def encoder_stats(self, opt_state):
+            return np.asarray(opt_state)   # declared boundary: allowed
+    """)
+    v = check_fastpath.check_training_host_sync({"m.py": bad})
+    assert len(v) == 1
+    assert "declared" in v[0][2] and "_exchange" in v[0][2]
+
+
+def test_training_sync_lint_accepts_current_exchange():
+    """The real accumulation scan + bucket planner + bucketed exchange
+    pass the rule (also covered by test_repo_hot_paths_are_clean; this
+    pins the module set so a rename doesn't silently drop coverage)."""
+    sources = {}
+    for rel in check_fastpath.TRAIN_MODULES:
+        path = os.path.join(check_fastpath.REPO_ROOT, rel)
+        assert os.path.exists(path), f"lint module vanished: {rel}"
+        with open(path) as f:
+            sources[path] = f.read()
+    assert check_fastpath.check_training_host_sync(sources) == []
+
+
 def test_lint_rejects_guard_after_the_call():
     # the guard must precede the call — a later early-return doesn't
     # protect the hot path

@@ -420,7 +420,7 @@ TOTAL, SYNC, SAVE = 12, 2, 4
 
 
 def _make_runner(tmp_path, ckpt_name, preempt_at=None, guardian=False,
-                 compress=True):
+                 compress=True, accum=1, buckets=None):
     import jax
 
     from deeplearning4j_tpu.nn.updaters import Sgd
@@ -438,6 +438,7 @@ def _make_runner(tmp_path, ckpt_name, preempt_at=None, guardian=False,
                                   num_processes=1,
                                   dump_dir=str(tmp_path))
     trainer = MultiHostTrainer(loss_fn, Sgd(0.3), compress=compress,
+                               accumulation=accum, buckets=buckets,
                                compression_kw={"initial_threshold": 1e-4})
     g = None
     if guardian:
@@ -458,6 +459,16 @@ def _make_runner(tmp_path, ckpt_name, preempt_at=None, guardian=False,
 def _batch(trainer, step, nan=False):
     from deeplearning4j_tpu.parallel.multihost import global_batch
     r = np.random.default_rng(100 + step)
+    g = trainer.accumulation
+    if g > 1:
+        # super-batch (G, B, ...): a NaN poisons ONE microbatch only —
+        # the accumulated verdict must still catch it
+        xs = r.standard_normal((g, 8, 6)).astype(np.float32)
+        scale = np.ones((g, 8, 1), np.float32)
+        if nan:
+            scale[1] = np.nan
+        return global_batch(trainer.mesh, {"x": xs, "scale": scale},
+                            accumulation=g)
     xs = r.standard_normal((8, 6)).astype(np.float32)
     return global_batch(trainer.mesh,
                         {"x": xs,
@@ -516,9 +527,11 @@ def test_runner_preemption_bit_identical_single_process(tmp_path):
 
 
 def test_runner_resume_restores_encoder_residual(tmp_path):
-    """The threshold-encoding residual rides the checkpoint: after a
-    drain + resume the encoder state is restored bit-exactly (the
-    property that makes the compressed trainer's resume exact)."""
+    """The per-bucket threshold-encoding residual rides the checkpoint:
+    after a drain + resume the encoder state is restored bit-exactly
+    (the property that makes the compressed trainer's resume exact).
+    Buckets are keyed "0".."N-1" since the bucketed exchange (ISSUE
+    14); the one-leaf model here planners into a single bucket."""
     runner = _make_runner(tmp_path, "ck_res", preempt_at=2)
     with pytest.raises(PreemptionSignal):
         _drive(runner)
@@ -526,9 +539,62 @@ def test_runner_resume_restores_encoder_residual(tmp_path):
     runner.close()
     runner = _make_runner(tmp_path, "ck_res")
     params, opt_state = runner.resume_or_init(_init_params())
-    res = opt_state["encoder"]["residual"]["W1"]
+    res = opt_state["encoder"]["residual"]["0"]
     assert np.abs(np.asarray(res)).sum() > 0   # accumulated, restored
     runner.close()
+
+
+def _tree_digest(tree):
+    import hashlib
+
+    import jax
+    h = hashlib.md5()
+    for leaf in jax.tree_util.tree_leaves(jax.device_get(tree)):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def test_runner_preemption_bit_identical_with_accumulation(tmp_path):
+    """ISSUE 14 chaos acceptance: kill/resume mid-run with in-step
+    accumulation (G=4) + bucketed encoded exchange stays bit-identical
+    — params AND the per-bucket encoder state (residuals + adaptive
+    thresholds) of the resumed run equal a never-preempted run's."""
+    runner = _make_runner(tmp_path, "ck_acc_clean", accum=4, buckets=2)
+    params, opt = _drive(runner)
+    ref_p, ref_enc = _tree_digest(params), _tree_digest(opt["encoder"])
+    runner.finalize(params, opt)
+
+    runner = _make_runner(tmp_path, "ck_acc_pre", preempt_at=2, accum=4,
+                          buckets=2)
+    with pytest.raises(PreemptionSignal):
+        _drive(runner)
+    faults.clear_plan()
+    drained_step = runner.step
+    runner.close()
+    assert 0 < drained_step < TOTAL
+
+    runner = _make_runner(tmp_path, "ck_acc_pre", accum=4, buckets=2)
+    params2, opt2 = _drive(runner)
+    assert runner.resumed_step == drained_step
+    assert _tree_digest(params2) == ref_p            # bit-identical
+    assert _tree_digest(opt2["encoder"]) == ref_enc  # per-bucket state
+    runner.finalize(params2, opt2)
+
+
+def test_runner_rollback_with_nan_microbatch_under_accumulation(
+        tmp_path):
+    """Guardian × accumulation chaos: a NaN in one MICROBATCH of the
+    super-batch fails the accumulated verdict (update refused on
+    device), the window exhausts the skip rung, and the coordinated
+    rollback lands on a verified generation — training ends finite."""
+    runner = _make_runner(tmp_path, "ck_acc_roll", guardian=True,
+                          accum=4, buckets=2)
+    params, opt = _drive(runner, total=TOTAL, nan_steps=(5, 6, 7, 8))
+    g = runner.guardian
+    assert g.skipped >= 2                 # device refused the NaN steps
+    assert g.rollbacks >= 1               # ladder reached rollback
+    assert np.isfinite(np.asarray(params["W1"])).all()
+    runner.finalize(params, opt)
 
 
 def test_runner_rollback_lands_on_verified_generation(tmp_path):
@@ -642,6 +708,11 @@ def test_two_process_preemption_bit_identical(tmp_path):
                                   np.asarray(clean[0]["losses"][8:]))
 
 
+@pytest.mark.slow   # suite diet (ISSUE 14): ~13 s two-process soak —
+# peer-loss containment stays tier-1 via the in-process
+# test_peer_lost_is_bounded_and_dumps + test_monitor_detects_silent_peer,
+# and real two-process jax.distributed execution via
+# test_multihost.py::test_two_process_sharded_trainer
 def test_two_process_peer_loss_bounded(tmp_path):
     """A hard-killed peer (os._exit inside sync round 2) surfaces on
     the survivor as PeerLostError + a peer-table dump within the

@@ -694,6 +694,7 @@ def test_comm_allreduce_fault_fires_before_dispatch():
     from deeplearning4j_tpu.parallel.multihost import MultiHostTrainer
     t = MultiHostTrainer.__new__(MultiHostTrainer)   # hook-level probe
     t.compress = True
+    t._explicit = True     # the explicit-exchange flag the hook checks
     with faults.FaultPlan(seed=0).fail_at(faults.COMM_ALLREDUCE, 1):
         with pytest.raises(InjectedFault):
             t.fit_batch(None, None, None, None)

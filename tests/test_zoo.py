@@ -107,6 +107,11 @@ class TestInstantiation:
 
 
 class TestNASNet:
+    @pytest.mark.slow   # suite diet (ISSUE 14): ~9 s build+train —
+    # the zoo build-forward-fit class stays tier-1 via
+    # TestInstantiation's fast rows (incl. the graph-model
+    # SqueezeNet/InceptionResNetV1); NASNet-specific wiring runs in
+    # the slow set like Darknet19/Xception/EfficientNet
     def test_builds_and_trains(self):
         from deeplearning4j_tpu.models.zoo import NASNet
         m = NASNet(numClasses=4, inputShape=(32, 32, 3), numBlocks=1,
