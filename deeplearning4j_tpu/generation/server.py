@@ -110,11 +110,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.monitoring import requests as _req
 from deeplearning4j_tpu.generation.sampling import (GREEDY, method_id,
                                                     sample_step,
                                                     split_keys)
 from deeplearning4j_tpu.resilience import faults as _faults
-from deeplearning4j_tpu.resilience.errors import (MemoryPressureError,
+from deeplearning4j_tpu.resilience.errors import (InferenceOverloadedError,
+                                                  InferenceTimeoutError,
+                                                  MemoryPressureError,
                                                   ReplayDivergedError,
                                                   ServerDeadError)
 from deeplearning4j_tpu.resilience.policy import RetryPolicy
@@ -144,6 +147,10 @@ class GenerationRequest:
         self.tokens = []                      # generated token ids
         self.error = None
         self.finish_reason = None             # "eos" | "length" | "error"
+        #: request-scoped tracing (monitoring/requests.py): None with
+        #: monitoring off — every append below is one is-None branch
+        self.trace = None
+        self.trace_id = None
         self._done = threading.Event()
         self._stream = queue.Queue()
 
@@ -159,11 +166,17 @@ class GenerationRequest:
 
     def _finish(self, reason):
         self.finish_reason = reason
+        if self.trace is not None:
+            self.trace.event("retire", reason=reason,
+                             tokens=len(self.tokens))
+            self.trace.finish(reason)
         self._done.set()
         self._stream.put(None)
 
     def _fail(self, exc):
         self.error = exc
+        if self.trace is not None:
+            self.trace.event("failed", error=type(exc).__name__)
         self._finish("error")
 
     # -- client side ------------------------------------------------------
@@ -690,16 +703,38 @@ class GenerationServer:
             on_token=on_token)
         deadline = (None if timeout_ms is None
                     else time.monotonic() + float(timeout_ms) / 1e3)
+        req.trace = _req.start("generation", meta={
+            "prompt_len": int(prompt.size),
+            "max_new_tokens": req.max_new_tokens,
+            "method": req.method})
+        if req.trace is not None:
+            req.trace_id = req.trace.trace_id
+            req.trace.event("enqueue", queued=self._queue.qsize())
         # liveness check + enqueue are ONE locked step: a request must
         # never land in the queue after shutdown()/_die() drained it
         # (nothing would ever fail or serve it — result() would hang)
-        with self._lock:
-            if self._shutdown:
-                raise RuntimeError("GenerationServer is shut down")
-            if self._dead is not None:
-                raise self._dead
-            bounded_enqueue(self._queue, req, deadline,
-                            self.enqueue_timeout, what="generation")
+        try:
+            with self._lock:
+                if self._shutdown:
+                    raise RuntimeError("GenerationServer is shut down")
+                if self._dead is not None:
+                    raise self._dead
+                bounded_enqueue(self._queue, req, deadline,
+                                self.enqueue_timeout, what="generation")
+        except BaseException as e:
+            if req.trace is not None:
+                # classify the rejection so a ring full of dead-server
+                # refusals never reads as load shedding: only the
+                # bounded-queue overload is a "shed"
+                if isinstance(e, InferenceOverloadedError):
+                    status = "shed"
+                elif isinstance(e, InferenceTimeoutError):
+                    status = "timeout"
+                else:
+                    status = "rejected"
+                req.trace.event(status, error=type(e).__name__)
+                req.trace.finish(status)
+            raise
         self._work.set()
         return req
 
@@ -785,7 +820,8 @@ class GenerationServer:
                         help="tokens generated (all slots)").inc()
             reg.histogram(_mon.GEN_PREFILL_MS,
                           help="prompt prefill + cache-graft wall "
-                               "time").observe(prefill_ms)
+                               "time").observe(prefill_ms,
+                                               trace_id=req.trace_id)
             reg.gauge(_mon.GEN_ACTIVE_SLOTS,
                       help="occupied decode slots").set(
                 len(self._slot_req))
@@ -811,10 +847,15 @@ class GenerationServer:
         if rung != self._rung:
             if _faults.ACTIVE is not None:
                 _faults.ACTIVE.fire(_faults.CACHE_GROW)
+            if req.trace is not None:
+                req.trace.event("grow", to_rung=rung)
             call = self._exes[(f"grow_to_{rung}", self._rung)]
             cache = call(self._state[_CACHE])
             self._state = (cache,) + self._state[1:]
             self._rung = rung
+        if req.trace is not None:
+            req.trace.event("admit", slot=slot, rung=rung,
+                            bucket=pbucket, admit_id=rec.admit_id)
         padded = np.zeros((pbucket,), np.int32)
         padded[:plen] = prompt
         if _faults.ACTIVE is not None:
@@ -930,6 +971,22 @@ class GenerationServer:
         overlap_ms = (time.perf_counter() - blk.t_copy) * 1e3
         toks = self._fetch_tokens(blk.tokens)         # (k, S)
         dt_ms = (time.perf_counter() - blk.t0) * 1e3
+        # request timelines: one "block" event per still-owned slot —
+        # appended HERE, on the existing fetch boundary (toks is host
+        # data already), BEFORE delivery so a retirement this block
+        # lands after its final block event. Zero new syncs.
+        ex_tid = None
+        for slot, rec in blk.recs.items():
+            if self._slot_req.get(slot) is not rec:
+                continue
+            if ex_tid is None and rec.expect is None:
+                ex_tid = rec.req.trace_id
+            tr = rec.req.trace
+            if tr is not None:
+                tr.event("block", k=blk.k,
+                         tokens=int((toks[:, slot] >= 0).sum()),
+                         wall_ms=round(dt_ms, 3),
+                         overlap_ms=round(overlap_ms, 3))
         live = 0
         ndel = np.zeros((toks.shape[1],), np.int32)
         for row in toks:
@@ -975,7 +1032,7 @@ class GenerationServer:
             reg.histogram(_mon.GEN_PER_TOKEN_MS,
                           help="decode wall time per token (block "
                                "wall / realized block depth)").observe(
-                dt_ms / k_real)
+                dt_ms / k_real, trace_id=ex_tid)
             reg.histogram(_mon.GEN_TOKENS_PER_DISPATCH,
                           help="live tokens delivered per decode "
                                "dispatch").observe(live)
@@ -1166,6 +1223,10 @@ class GenerationServer:
                            if p >= plen)
             use_prefix = (self._rung_for(needed, pb_prefix)
                           == self._rung_for(needed, pb_orig))
+        if req.trace is not None:
+            req.trace.event("replay",
+                            mode="prefix" if use_prefix
+                            else "regenerate", delivered=g)
         if use_prefix:
             prefix = np.concatenate(
                 [req.prompt, np.asarray(req.tokens, np.int32)])

@@ -36,9 +36,17 @@ Scope split across the repo's three observability layers:
 from __future__ import annotations
 
 from deeplearning4j_tpu.monitoring.state import STATE
+from deeplearning4j_tpu.monitoring import cluster  # noqa: F401
 from deeplearning4j_tpu.monitoring import memory  # noqa: F401
 from deeplearning4j_tpu.monitoring import profiler  # noqa: F401
+from deeplearning4j_tpu.monitoring import requests  # noqa: F401
+from deeplearning4j_tpu.monitoring import slo  # noqa: F401
 from deeplearning4j_tpu.monitoring import steps  # noqa: F401
+from deeplearning4j_tpu.monitoring.requests import (  # noqa: F401
+    RequestLog, RequestTimeline, merged_chrome_trace, request_log)
+from deeplearning4j_tpu.monitoring.slo import (  # noqa: F401
+    LatencyObjective, RatioObjective, SloTracker, ThroughputObjective,
+    standard_objectives)
 from deeplearning4j_tpu.monitoring.memory import (  # noqa: F401
     MemoryMonitor)
 from deeplearning4j_tpu.monitoring.profiler import (  # noqa: F401
@@ -83,6 +91,8 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     GEN_FETCH_OVERLAP_MS, GEN_DRAFT_ACCEPTS, GEN_DRAFT_REJECTS,
     QUANT_INT8_LAYERS, QUANT_CALIBRATIONS, QUANT_DEQUANT_FALLBACKS,
     QUANT_ACTIVATION_BYTES,
+    INFERENCE_REQUEST_MS, SLO_BREACHES, SLO_BURN_RATE, SLO_BREACHED,
+    CLUSTER_SNAPSHOT_AGE,
     bootstrap_core_metrics, collect_device_memory, get_registry,
     record_transfer)
 from deeplearning4j_tpu.monitoring.tracing import (  # noqa: F401
@@ -136,6 +146,13 @@ __all__ = [
     "GEN_DRAFT_ACCEPTS", "GEN_DRAFT_REJECTS",
     "QUANT_INT8_LAYERS", "QUANT_CALIBRATIONS",
     "QUANT_DEQUANT_FALLBACKS", "QUANT_ACTIVATION_BYTES",
+    "INFERENCE_REQUEST_MS", "SLO_BREACHES", "SLO_BURN_RATE",
+    "SLO_BREACHED", "CLUSTER_SNAPSHOT_AGE",
+    "requests", "slo", "cluster",
+    "RequestLog", "RequestTimeline", "request_log",
+    "merged_chrome_trace",
+    "SloTracker", "LatencyObjective", "ThroughputObjective",
+    "RatioObjective", "standard_objectives",
 ]
 
 

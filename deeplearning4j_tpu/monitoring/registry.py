@@ -20,9 +20,11 @@ Everything is JSON-native (`snapshot()`), same idiom as `ui/stats.py`.
 """
 from __future__ import annotations
 
+import collections
 import math
 import re
 import threading
+import time
 
 from deeplearning4j_tpu.monitoring.state import STATE
 
@@ -158,6 +160,25 @@ QUANT_CALIBRATIONS = "dl4j.quant.calibrations"
 QUANT_DEQUANT_FALLBACKS = "dl4j.quant.dequant_fallbacks"
 QUANT_ACTIVATION_BYTES = "dl4j.quant.activation_traffic_bytes"
 
+# request-scoped serving metrics (monitoring/requests.py wires the
+# timelines; the latency histograms carry EXEMPLAR trace ids so a bad
+# p99 clicks through to an actual slow-request timeline on /requests)
+INFERENCE_REQUEST_MS = "dl4j.inference.request_ms"
+
+# SLO tracker (monitoring/slo.py): declarative objectives evaluated on
+# a multi-window burn-rate rule over the histograms / flight recorder
+# already collected. `breaches` counts objective trips (labels:
+# objective), `burn_rate` is the current error-budget burn per window
+# (labels: objective, window), `breached` is 0/1 per objective.
+SLO_BREACHES = "dl4j.slo.breaches"
+SLO_BURN_RATE = "dl4j.slo.burn_rate"
+SLO_BREACHED = "dl4j.slo.breached"
+
+# cluster metrics plane (monitoring/cluster.py): per-host snapshot age
+# as seen from process 0 (labels: host; host="cluster" is the max age —
+# a stale host means its publishing process stopped syncing)
+CLUSTER_SNAPSHOT_AGE = "dl4j.cluster.snapshot_age_seconds"
+
 # autoregressive generation (generation/server.py): KV-cache decode loop
 # with continuous-batching admission
 GEN_TOKENS = "dl4j.gen.tokens"
@@ -190,16 +211,71 @@ def _prom_name(name):
     return "_" + n if n[:1].isdigit() else n
 
 
+def _esc_label_value(v):
+    """Label-value escaping per the text exposition format: backslash
+    first, then newline and double quote — a value containing any of
+    them must round-trip through a strict scraper."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n") \
+                 .replace('"', '\\"')
+
+
+def _esc_help(text):
+    """HELP-line escaping (the spec escapes `\\` and line feeds only;
+    quotes are legal in help text)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _prom_value(v):
+    """Sample-value rendering: the format spec requires `+Inf` / `-Inf`
+    / `NaN` spellings — Python's `inf`/`nan` break strict scrapers."""
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        return f"{v:.9g}"
+    return str(v)
+
+
 def _prom_labels(labels, extra=()):
     items = list(labels) + list(extra)
     if not items:
         return ""
-    def esc(v):
-        return str(v).replace("\\", "\\\\").replace("\n", "\\n") \
-                     .replace('"', '\\"')
-    body = ",".join(f'{_LABEL_RE.sub("_", str(k))}="{esc(v)}"'
+    body = ",".join(f'{_LABEL_RE.sub("_", str(k))}="{_esc_label_value(v)}"'
                     for k, v in items)
     return "{" + body + "}"
+
+
+def _render_family_header(lines, pname, kind, help_text=None):
+    """The `# HELP` / `# TYPE` lines for one family — ONE rule shared
+    by the local renderer and the cluster plane (monitoring/cluster.py)
+    so the conformance guarantees cannot drift between them."""
+    if help_text is not None:
+        lines.append(f"# HELP {pname} {_esc_help(help_text)}")
+    lines.append(f"# TYPE {pname} "
+                 f"{'summary' if kind == 'histogram' else kind}")
+
+
+def _render_sample_lines(lines, pname, kind, labelitems, rec):
+    """The sample lines for one series. `rec` carries `quantiles`
+    ((q, value) pairs, Nones skipped) + `count`/`sum` for histograms,
+    `value` otherwise — escaping and the `+Inf`/`NaN` spellings all
+    route through `_prom_labels`/`_prom_value` here, for every
+    renderer."""
+    if kind == "histogram":
+        for q, qv in rec.get("quantiles", ()):
+            if qv is not None:
+                lines.append(
+                    f"{pname}"
+                    f"{_prom_labels(labelitems, [('quantile', q)])}"
+                    f" {_prom_value(float(qv))}")
+        lines.append(f"{pname}_count{_prom_labels(labelitems)} "
+                     f"{int(rec.get('count', 0))}")
+        lines.append(f"{pname}_sum{_prom_labels(labelitems)} "
+                     f"{_prom_value(float(rec.get('sum', 0.0)))}")
+    else:
+        lines.append(f"{pname}{_prom_labels(labelitems)} "
+                     f"{_prom_value(rec.get('value', 0))}")
 
 
 class Counter:
@@ -252,8 +328,12 @@ class Histogram:
     observations — O(reservoir) memory however long training runs."""
 
     __slots__ = ("name", "labels", "_lock", "_count", "_sum", "_min",
-                 "_max", "_ring", "_ring_n", "_idx")
+                 "_max", "_ring", "_ring_n", "_idx", "_exemplars")
     kind = "histogram"
+
+    #: recent (value, trace_id, ts) observations retained for exemplar
+    #: lookup — bounded, newest wins on eviction
+    EXEMPLAR_WINDOW = 64
 
     def __init__(self, name, labels=(), reservoir=2048):
         self.name = name
@@ -266,8 +346,13 @@ class Histogram:
         self._ring = [0.0] * int(reservoir)
         self._ring_n = 0
         self._idx = 0
+        self._exemplars = None      # allocated on first traced observe
 
-    def observe(self, value):
+    def observe(self, value, trace_id=None):
+        """Record one observation; `trace_id` (optional) attaches a
+        request-timeline exemplar — the top values of the recent window
+        keep their trace ids (`exemplars()`), so a bad p99 links to an
+        actual slow request on `GET /requests/<id>`."""
         v = float(value)
         with self._lock:
             self._count += 1
@@ -280,6 +365,21 @@ class Histogram:
             self._idx = (self._idx + 1) % len(self._ring)
             if self._ring_n < len(self._ring):
                 self._ring_n += 1
+            if trace_id is not None:
+                if self._exemplars is None:
+                    self._exemplars = collections.deque(
+                        maxlen=self.EXEMPLAR_WINDOW)
+                self._exemplars.append((v, str(trace_id), time.time()))
+
+    def exemplars(self, top=5):
+        """The highest-valued recent traced observations, descending:
+        [{"value", "trace_id", "ts"}]. These are the trace ids behind
+        the current tail of the distribution — the p99 click-through."""
+        with self._lock:
+            recent = list(self._exemplars) if self._exemplars else []
+        recent.sort(key=lambda e: e[0], reverse=True)
+        return [{"value": v, "trace_id": t, "ts": ts}
+                for v, t, ts in recent[:int(top)]]
 
     @property
     def count(self):
@@ -305,6 +405,9 @@ class Histogram:
             out = {"count": self._count, "sum": self._sum,
                    "min": None if self._count == 0 else self._min,
                    "max": None if self._count == 0 else self._max}
+            has_ex = bool(self._exemplars)
+        if has_ex:
+            out["exemplars"] = self.exemplars()
         for label, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
             if window:
                 pos = min(len(window) - 1,
@@ -367,6 +470,13 @@ class MetricsRegistry:
             self.generation += 1
 
     # -- export ----------------------------------------------------------
+    def help_texts(self):
+        """{metric name: help string} — the cluster renderer
+        (monitoring/cluster.py) reuses the local help lines for the
+        per-host-labeled families."""
+        with self._lock:
+            return dict(self._help)
+
     def snapshot(self):
         """JSON-native dump (same idiom as ui/stats records):
         {name: [{labels: {...}, ...metric fields}]}."""
@@ -384,7 +494,13 @@ class MetricsRegistry:
 
     def prometheus_text(self):
         """Prometheus text exposition format 0.0.4. Histograms are emitted
-        as summaries (streaming quantiles, not cumulative buckets)."""
+        as summaries (streaming quantiles, not cumulative buckets).
+        Conformance guarantees (unit-tested): every family gets a
+        `# TYPE` line (and a `# HELP` line whenever a help string was
+        registered, escaped per the spec), label values escape `\\`,
+        `"` and newlines, and non-finite samples render as `+Inf` /
+        `-Inf` / `NaN` — strict scrapers must never choke on a value
+        that came out of the registry."""
         with self._lock:
             items = sorted(self._metrics.items(), key=lambda kv: kv[0])
             helps = dict(self._help)
@@ -394,28 +510,17 @@ class MetricsRegistry:
             pname = _prom_name(name)
             if pname not in seen_header:
                 seen_header.add(pname)
-                if name in helps:
-                    lines.append(f"# HELP {pname} {helps[name]}")
-                ptype = "summary" if isinstance(m, Histogram) else m.kind
-                lines.append(f"# TYPE {pname} {ptype}")
+                _render_family_header(lines, pname, m.kind,
+                                      helps.get(name))
             if isinstance(m, Histogram):
                 snap = m.snapshot()
-                for label, q in (("p50", "0.5"), ("p95", "0.95"),
-                                 ("p99", "0.99")):
-                    v = snap[label]
-                    if v is not None:
-                        lines.append(
-                            f"{pname}"
-                            f"{_prom_labels(labelitems, [('quantile', q)])}"
-                            f" {v:.9g}")
-                lines.append(f"{pname}_count{_prom_labels(labelitems)} "
-                             f"{snap['count']}")
-                lines.append(f"{pname}_sum{_prom_labels(labelitems)} "
-                             f"{snap['sum']:.9g}")
+                rec = {"count": snap["count"], "sum": snap["sum"],
+                       "quantiles": [("0.5", snap["p50"]),
+                                     ("0.95", snap["p95"]),
+                                     ("0.99", snap["p99"])]}
             else:
-                v = m.value
-                vs = f"{v:.9g}" if isinstance(v, float) else str(v)
-                lines.append(f"{pname}{_prom_labels(labelitems)} {vs}")
+                rec = {"value": m.value}
+            _render_sample_lines(lines, pname, m.kind, labelitems, rec)
         return "\n".join(lines) + "\n"
 
 

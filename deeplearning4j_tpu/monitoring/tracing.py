@@ -153,6 +153,13 @@ class Tracer:
         return out
 
     # -- export ----------------------------------------------------------
+    @property
+    def epoch_ns(self):
+        """perf_counter origin of this tracer's timestamps — lets other
+        event sources (monitoring/requests.py lanes) align with the
+        span timebase when merging into one Chrome trace."""
+        return self._epoch_ns
+
     def events(self):
         with self._lock:
             return list(self._events)
@@ -163,13 +170,49 @@ class Tracer:
             self._dropped = 0
         self._epoch_ns = time.perf_counter_ns()
 
-    def to_chrome_trace(self):
+    def _process_metadata(self, process_name=None):
+        """Chrome "M" metadata events naming this PROCESS (and its
+        span-recording threads): merged multi-process traces then
+        render each process as its own named lane group instead of
+        interleaving everything under one anonymous pid. The process
+        index comes from the distributed bootstrap when one ran
+        (resilience.faults.PROCESS_ID / DL4J_PROCESS_ID) — no jax
+        import from the export path."""
+        if process_name is None:
+            idx = None
+            import sys
+            faults = sys.modules.get(
+                "deeplearning4j_tpu.resilience.faults")
+            if faults is not None:
+                idx = getattr(faults, "PROCESS_ID", None)
+            if idx is None:
+                idx = os.environ.get("DL4J_PROCESS_ID")
+            tag = f"p{idx} " if idx is not None else ""
+            process_name = f"dl4j {tag}(pid {self._pid})"
+        meta = [
+            {"ph": "M", "name": "process_name", "pid": self._pid,
+             "args": {"name": process_name}},
+        ]
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid in list(self._stacks_by_tid):
+            meta.append({"ph": "M", "name": "thread_name",
+                         "pid": self._pid, "tid": tid,
+                         "args": {"name": names.get(tid,
+                                                    f"thread-{tid}")}})
+        return meta
+
+    def to_chrome_trace(self, process_name=None):
         """Chrome trace-event JSON object (the {"traceEvents": [...]}
-        envelope both Perfetto and chrome://tracing load)."""
+        envelope both Perfetto and chrome://tracing load). Leads with
+        real pid/process-name (and thread-name) metadata events, so
+        traces from several processes concatenated into one document
+        render as separate named lanes."""
         with self._lock:
             events = list(self._events)
             dropped = self._dropped
-        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        doc = {"traceEvents": self._process_metadata(process_name)
+               + events,
+               "displayTimeUnit": "ms"}
         if dropped:
             doc["otherData"] = {"droppedEvents": dropped}
         return doc

@@ -46,6 +46,7 @@ import threading
 import time
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.monitoring import cluster as _cluster
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience.errors import (PeerDesyncError,
                                                   PeerLostError,
@@ -220,6 +221,11 @@ class PeerCoordinator:
         self.on_sync = None        # callback(self) after each sync point
         self._monitor = None
         self._prev_active = None
+        #: extra per-host stats riding the heartbeat + metrics snapshot
+        #: (a driving runner drops e.g. exchange_bytes in here at sync
+        #: cadence; the peer table and the cluster plane surface them)
+        self.stats_extra = {}
+        self._last_sync = None     # (step, clock) of the previous sync
 
     # -- install / clear (faults.py pattern) -----------------------------
     def install(self):
@@ -348,9 +354,18 @@ class PeerCoordinator:
                 _faults.ACTIVE.fire(_faults.HOST_PREEMPT)
             except PreemptionSignal as e:
                 self.request_preemption(f"injected: {e}")
+        now = self._clock()
+        rate = None
+        if self._last_sync is not None and now > self._last_sync[1]:
+            rate = round((self.step - self._last_sync[0])
+                         / (now - self._last_sync[1]), 3)
+        self._last_sync = (self.step, now)
         hb = {"step": self.step, "t": time.time(),
               "preempt": bool(self._preempt_requested),
-              "reason": self._preempt_reason}
+              "reason": self._preempt_reason,
+              "steps_per_s": rate}
+        if self.stats_extra:
+            hb.update(self.stats_extra)
         self.publish(f"hb/{rnd}/{self.process_id}", json.dumps(hb))
         peers = {self.process_id: hb}
         for pid in range(self.num_processes):
@@ -389,6 +404,16 @@ class PeerCoordinator:
             try:
                 self._client.key_value_delete(
                     self._key(f"hb/{rnd - 2}/{self.process_id}"))
+            except Exception:  # noqa: BLE001
+                pass
+        if _mon.enabled():
+            # cluster metrics plane: ONE overwritten `metrics/<pid>` KV
+            # key per process at this (guardian-flush) cadence — no new
+            # collectives, no new syncs, bounded keys by construction.
+            # Best-effort: a full/failed KV write must not fail a step.
+            try:
+                extra = {"steps_per_s": rate, **self.stats_extra}
+                _cluster.publish(self, extra=extra)
             except Exception:  # noqa: BLE001
                 pass
         if self.on_sync is not None:
@@ -610,6 +635,14 @@ class PeerCoordinator:
             if plan is not None:
                 snap["exchange_buckets"] = plan.num_buckets
                 snap["bucket_bytes"] = list(plan.bucket_bytes)
+        # cluster metrics plane (process 0 is the serving end): per-host
+        # snapshot ages / steps/s / exchange bytes for GET /health —
+        # best-effort and bounded (health must always answer fast)
+        if self.process_id == 0 and self.num_processes > 1 \
+                and _mon.enabled():
+            cm = _cluster.health_meta(self)
+            if cm is not None:
+                snap["cluster"] = cm
         return snap
 
     # -- monitor thread --------------------------------------------------

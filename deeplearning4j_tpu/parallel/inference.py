@@ -67,6 +67,7 @@ import time
 import numpy as np
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.monitoring import requests as _req
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience.errors import (InferenceOverloadedError,
                                                   InferenceTimeoutError)
@@ -125,7 +126,7 @@ def bounded_enqueue(q, item, deadline, enqueue_timeout, count_timeout=None,
 
 class _Request:
     __slots__ = ("x", "event", "result", "error", "claimed", "cancelled",
-                 "server")
+                 "server", "timeline")
 
     def __init__(self, x):
         self.x = x
@@ -135,6 +136,7 @@ class _Request:
         self.claimed = False
         self.cancelled = False  # deadline expired: discard, never serve
         self.server = None      # thread that claimed it (set under lock)
+        self.timeline = None    # request trace (monitoring/requests.py)
 
 
 class ParallelInference:
@@ -304,6 +306,44 @@ class ParallelInference:
             xs = tuple(a[None] for a in xs)
         deadline = None if timeout_ms is None \
             else time.monotonic() + float(timeout_ms) / 1e3
+        # request-scoped tracing: one bounded timeline per request (None
+        # when monitoring is off — every append below is one branch);
+        # the request-latency histogram keeps EXEMPLAR trace ids so a
+        # bad p99 on /metrics links to a concrete timeline on /requests
+        tl = _req.start("inference", meta={"rows": int(xs[0].shape[0])})
+        t_req = time.perf_counter()
+        try:
+            out = self._output_traced(xs, multi, single, deadline,
+                                      timeout_ms, tl)
+        except InferenceTimeoutError:
+            if tl is not None:
+                tl.event("timeout")
+                tl.finish("timeout")
+            raise
+        except InferenceOverloadedError:
+            if tl is not None:
+                tl.event("shed")
+                tl.finish("shed")
+            raise
+        except BaseException as e:
+            if tl is not None:
+                tl.event("failed", error=type(e).__name__)
+                tl.finish("error")
+            raise
+        if tl is not None:
+            tl.event("done")
+            tl.finish("ok")
+            if _mon.enabled():
+                _mon.get_registry().histogram(
+                    _mon.INFERENCE_REQUEST_MS,
+                    help="end-to-end inference request latency "
+                         "(enqueue to delivery)").observe(
+                    (time.perf_counter() - t_req) * 1e3,
+                    trace_id=tl.trace_id)
+        return out
+
+    def _output_traced(self, xs, multi, single, deadline, timeout_ms,
+                       tl):
         if self.mode == InferenceMode.SEQUENTIAL or self._shutdown:
             return self._direct_deadline(xs, multi, single, deadline)
         if self._thread is not None and not self._thread.is_alive():
@@ -312,6 +352,9 @@ class ParallelInference:
             if not self._revive_collector():
                 return self._direct_deadline(xs, multi, single, deadline)
         req = _Request(xs)
+        req.timeline = tl
+        if tl is not None:
+            tl.event("enqueue", queued=self._queue.qsize())
         self._enqueue(req, deadline)
         degraded = False
         while not req.event.is_set():
@@ -542,6 +585,11 @@ class ParallelInference:
 
     def _run(self, batch):
         try:
+            for r in batch:
+                if r.timeline is not None:
+                    r.timeline.event("dispatch",
+                                     rows=int(r.x[0].shape[0]),
+                                     coalesced=len(batch))
             if _faults.ACTIVE is not None:
                 _faults.ACTIVE.fire(_faults.INFERENCE_FORWARD)
             if self._ladder is not None and self._aot_breaker.allow():

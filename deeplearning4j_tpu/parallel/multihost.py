@@ -1278,12 +1278,18 @@ class MultiHostRunner:
     # -- the step --------------------------------------------------------
     def _on_sync(self, coordinator):
         """Sync-point piggyback: refresh the compression wire telemetry
-        at flush cadence (never per step)."""
+        at flush cadence (never per step). The per-sync encoded-bytes
+        figure rides the NEXT heartbeat + cluster metrics snapshot via
+        `coordinator.stats_extra`, giving the process-0 peer table its
+        per-peer exchange-bytes column."""
         opt_state = getattr(self, "_last_opt_state", None)
         if opt_state is not None and \
                 getattr(self.trainer, "compress", False):
             try:
-                self.trainer.encoder_stats(opt_state)
+                host = self.trainer.encoder_stats(opt_state)
+                if host is not None:
+                    coordinator.stats_extra["exchange_bytes"] = \
+                        host["encoded_bytes"]
             except Exception:  # noqa: BLE001 — telemetry is best-effort
                 pass
 

@@ -78,14 +78,18 @@ __all__ = [
 
 def health_snapshot():
     """The `GET /health` payload: overall status plus the installed
-    guardian's, watchdog's, multi-host coordinator's, and serving
-    (GenerationServer) introspection snapshots (None when not
-    installed). Status ladder: a latched stall, a lost peer, a dead
-    serving loop, or an exhausted guardian makes the process unhealthy;
-    a guardian mid-escalation, a pending preemption, or a serving
-    memory-pressure degradation reports degraded; otherwise ok. The
-    coordinator snapshot carries the per-process PEER TABLE (heartbeat
-    step/age, preempt flags, lost verdicts)."""
+    guardian's, watchdog's, multi-host coordinator's, serving
+    (GenerationServer), and SLO-tracker introspection snapshots (None
+    when not installed). Status ladder: a latched stall, a lost peer, a
+    dead serving loop, or an exhausted guardian makes the process
+    unhealthy; a guardian mid-escalation, a pending preemption, a
+    serving memory-pressure degradation, or an SLO BREACH (the violated
+    objective is named in the "slo" section) reports degraded —
+    breaches auto-recover with the burn rate, so the degradation clears
+    itself. The coordinator snapshot carries the per-process PEER TABLE
+    (heartbeat step/age, steps/s, exchange bytes, preempt flags, lost
+    verdicts) and, on process 0 of a multi-host run, the cluster
+    metrics-plane meta (per-host snapshot ages)."""
     import sys
     from deeplearning4j_tpu.resilience import guardian as _guardian
     from deeplearning4j_tpu.resilience import watchdog as _watchdog
@@ -108,10 +112,21 @@ def health_snapshot():
             ssnap = [s.serving_state() for s in list(_gen._SERVERS)]
         except Exception:  # noqa: BLE001 — health must always answer
             ssnap = None
+    # SLO tracker: evaluation is PULL-driven from right here (rate-
+    # limited inside the tracker) — nothing on a hot path ever pays it
+    slosnap = None
+    _slo = sys.modules.get("deeplearning4j_tpu.monitoring.slo")
+    if _slo is not None and _slo.ACTIVE is not None:
+        try:
+            slosnap = _slo.ACTIVE.snapshot()
+        except Exception:  # noqa: BLE001 — health must always answer
+            slosnap = None
     status = "ok"
     if gsnap is not None and gsnap["status"] == "degraded":
         status = "degraded"
     if ssnap and any(s["state"] == "degraded" for s in ssnap):
+        status = "degraded"
+    if slosnap is not None and slosnap.get("violated"):
         status = "degraded"
     if csnap is not None and (csnap["preempt_requested"]
                               or csnap["preempted"]):
@@ -125,7 +140,7 @@ def health_snapshot():
     if ssnap and any(s["state"] == "dead" for s in ssnap):
         status = "serving_dead"
     return {"status": status, "guardian": gsnap, "watchdog": wsnap,
-            "distributed": csnap, "serving": ssnap}
+            "distributed": csnap, "serving": ssnap, "slo": slosnap}
 
 
 def __getattr__(name):

@@ -21,6 +21,17 @@ Two forms, both dependency-free:
 - `GET /generation` — autoregressive generation status
   (generation/server.py `status()`): per-server slot occupancy, cache
   rung, admission/retirement/token tallies, executable provenance.
+- `GET /requests` / `GET /requests/<trace-id>` — request-scoped
+  tracing (monitoring/requests.py): in-flight + recent per-request
+  lifecycle timelines, with latency-histogram exemplars linking a bad
+  p99 to the slow request behind it; `GET /trace` exports the merged
+  Chrome trace (host spans + request lanes) for Perfetto.
+- `GET /slo` — SLO tracker state (monitoring/slo.py): objectives,
+  per-window burn rates, current breaches; breaches also flip
+  `GET /health` to degraded with the objective named.
+- In a multi-host run, process 0's `/metrics` serves the CLUSTER view
+  (monitoring/cluster.py): every host's series labeled host="<pid>"
+  plus host="cluster" aggregates from the coordination-KV snapshots.
 - `render_static_html(storage, path)` — a self-contained HTML snapshot
   (inline SVG charts) for environments without an open port.
 """
@@ -69,6 +80,19 @@ no profile captured yet</pre></div>
 <code>GET /generation</code>; live while a GenerationServer runs</div>
 <pre id="generation" style="max-height:240px;overflow:auto;font-size:12px">
 no generation servers live</pre></div>
+<div class="chart"><h2>Requests (trace timelines)</h2>
+<div class="meta">Request-scoped tracing — <code>GET /requests</code>,
+<code>GET /requests/&lt;trace-id&gt;</code>; p99 exemplars link
+histogram tails to slow-request timelines; full merged Chrome trace at
+<code>GET /trace</code></div>
+<pre id="requests" style="max-height:240px;overflow:auto;font-size:12px">
+no request timelines yet</pre></div>
+<div class="chart"><h2>SLOs (burn rate)</h2>
+<div class="meta">Declarative objectives on a multi-window burn-rate
+rule — <code>GET /slo</code>; a breach flips <code>GET /health</code>
+to degraded with the objective named</div>
+<pre id="slo" style="max-height:160px;overflow:auto;font-size:12px">
+no SLO tracker installed</pre></div>
 <div class="chart"><h2>Step-time attribution (flight recorder)</h2>
 <div class="meta">Per-step host phase breakdown (data_next / dispatch /
 listeners + host-blocked and compile stalls) — <code>GET /steps</code>;
@@ -189,6 +213,30 @@ async function tick(){
           `${s.per_token_p50_ms ?? '-'} ms p99 ` +
           `${s.per_token_p99_ms ?? '-'} ms · draft ok/ko ` +
           `${s.draft_accepts}/${s.draft_rejects}`).join("\n");
+    }
+  } catch (e) {}
+  try {
+    const rr = await fetch('/requests?last=12'); const rd = await rr.json();
+    const rows = [...(rd.active||[]), ...(rd.recent||[]).slice().reverse()];
+    if (rows.length){
+      document.getElementById('requests').textContent = rows.map(t => {
+        const last = t.events.length ? t.events[t.events.length-1] : null;
+        const blocks = t.events.filter(e=>e.event==='block').length;
+        return `${t.trace_id} [${t.kind}] ${t.status||'in-flight'} · ` +
+          `${t.events.length} events · blocks ${blocks}` +
+          (last ? ` · last ${last.event}@${last.t_ms.toFixed(1)}ms` : '');
+      }).join("\n");
+    }
+  } catch (e) {}
+  try {
+    const lr = await fetch('/slo'); const ld = await lr.json();
+    if (ld.installed && ld.objectives){
+      document.getElementById('slo').textContent =
+        Object.values(ld.objectives).map(o =>
+          `${o.breached ? 'BREACH' : '  ok  '} ${o.name}: ` +
+          `burn short ${o.burn_short} long ${o.burn_long} · ` +
+          `last ${o.last_value==null?'-':o.last_value.toFixed(3)} ` +
+          `(limit ${o.threshold})`).join("\n");
     }
   } catch (e) {}
   try {
@@ -355,6 +403,71 @@ class UIServer:
                         server as _gen
                     body = json.dumps(_gen.status()).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/requests"):
+                    # request-scoped tracing (monitoring/requests.py):
+                    # /requests = in-flight + recent ring (+ the
+                    # latency-histogram exemplars that link into it);
+                    # /requests/<trace-id> = one timeline (404 when it
+                    # aged out); /requests?last=N bounds the ring tail
+                    from deeplearning4j_tpu import monitoring as _mon
+                    from deeplearning4j_tpu.monitoring import \
+                        requests as _reqs
+                    parsed = urllib.parse.urlparse(self.path)
+                    parts = [p for p in parsed.path.split("/") if p]
+                    if len(parts) > 1:
+                        tl = _reqs.log().get(urllib.parse.unquote(
+                            parts[1]))
+                        if tl is None:
+                            body = b'{"error": "unknown trace id"}'
+                            self.send_response(404)
+                            self.send_header("Content-Type",
+                                             "application/json")
+                            self.send_header("Content-Length",
+                                             str(len(body)))
+                            self.end_headers()
+                            self.wfile.write(body)
+                            return
+                        body = json.dumps(tl.snapshot()).encode()
+                    else:
+                        q = urllib.parse.parse_qs(parsed.query)
+                        try:
+                            last = int(q.get("last", ["32"])[0])
+                        except ValueError:
+                            last = 32
+                        doc = _reqs.log().snapshot(last=last)
+                        reg = _mon.get_registry()
+                        ex = {}
+                        for name in (_mon.GEN_PER_TOKEN_MS,
+                                     _mon.GEN_PREFILL_MS,
+                                     _mon.INFERENCE_REQUEST_MS):
+                            h = reg.get(name)
+                            if h is not None:
+                                e = h.exemplars()
+                                if e:
+                                    ex[name] = e
+                        doc["exemplars"] = ex
+                        body = json.dumps(doc).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/slo"):
+                    # SLO tracker state: objectives, burn rates per
+                    # window, current breaches (evaluation is driven
+                    # from here, rate-limited by the tracker)
+                    from deeplearning4j_tpu.monitoring import slo as _slo
+                    t = _slo.ACTIVE
+                    body = json.dumps(
+                        {"installed": t is not None,
+                         **(t.snapshot() if t is not None else {})}
+                    ).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/trace"):
+                    # merged Chrome trace: host-side spans (per-process
+                    # metadata lanes) + every request timeline as its
+                    # own lane — save and load in Perfetto
+                    from deeplearning4j_tpu.monitoring import \
+                        requests as _reqs
+                    body = json.dumps(
+                        _reqs.merged_chrome_trace()).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/health"):
                     # training-guardian + stall-watchdog state
                     # (resilience.health_snapshot): 200 while healthy,
@@ -386,7 +499,29 @@ class UIServer:
                             _mon.bootstrap_core_metrics(reg)
                         except Exception:  # noqa: BLE001 — always serve
                             pass
-                    body = reg.prometheus_text().encode()
+                    body = None
+                    # cluster metrics plane: in a multi-host run,
+                    # process 0 serves EVERY host's series labeled
+                    # host="<pid>" plus cluster aggregates
+                    # (host="cluster") from the per-host snapshots on
+                    # the coordination KV. sys.modules, never a fresh
+                    # import: a dashboard-only process must not pull
+                    # the parallel stack in from its 1 s tick.
+                    import sys as _sys
+                    _coord = _sys.modules.get(
+                        "deeplearning4j_tpu.parallel.coordination")
+                    c = _coord.ACTIVE if _coord is not None else None
+                    if c is not None and c.num_processes > 1 \
+                            and c.process_id == 0:
+                        try:
+                            from deeplearning4j_tpu.monitoring import \
+                                cluster as _cluster
+                            body = _cluster.cluster_prometheus_text(
+                                c, reg).encode()
+                        except Exception:  # noqa: BLE001 — always serve
+                            body = None
+                    if body is None:
+                        body = reg.prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 else:
                     body = _PAGE.encode()

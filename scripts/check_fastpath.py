@@ -65,6 +65,13 @@ HOT_MODULES = [
     "deeplearning4j_tpu/quantize/core.py",
     "deeplearning4j_tpu/quantize/infer.py",
     "deeplearning4j_tpu/quantize/kvcache.py",
+    # request-timeline module: its appends ride the decode/dispatch
+    # hot paths, so any registry/exemplar traffic it ever grows must
+    # sit behind the enabled guard like the call sites that feed it.
+    # monitoring/slo.py and monitoring/cluster.py stay UNLINTED on
+    # purpose: both are pull-driven (endpoint / sync-point cadence,
+    # never per step) — the same cold-path class as listeners and ui.
+    "deeplearning4j_tpu/monitoring/requests.py",
 ]
 
 # -- serving steady-state lint --------------------------------------------
@@ -75,6 +82,10 @@ HOT_MODULES = [
 SERVING_MODULES = [
     "deeplearning4j_tpu/parallel/inference.py",
     "deeplearning4j_tpu/runtime/executables.py",
+    # request timelines are appended from the dispatch path — the
+    # walker descends into the append helpers to prove they stay pure
+    # host bookkeeping (no trace, no compile)
+    "deeplearning4j_tpu/monitoring/requests.py",
 ]
 #: steady-state entry points: the collector's dispatch path and the
 #: store/ring hot methods
@@ -101,6 +112,11 @@ GENERATION_MODULES = [
     # no-trace / no-host-sync rules as the rest of the loop
     "deeplearning4j_tpu/quantize/kvcache.py",
     "deeplearning4j_tpu/quantize/core.py",
+    # request-timeline appends ride the decode loop's delivery path —
+    # they must stay INSIDE the declared _deliver_block/_fetch_tokens
+    # sync boundary: pure host bookkeeping, no device materialization,
+    # no trace. The walker descends into event()/finish() to prove it.
+    "deeplearning4j_tpu/monitoring/requests.py",
 ]
 #: decode-loop entry points (GenerationServer hot methods) PLUS the
 #: crash-replay/supervised-restart path: re-admission and the key
@@ -126,7 +142,11 @@ GENERATION_MISS_BOUNDARY = {"load_or_compile", "warmup",
 #: drafting proposal must stay pure host numpy.
 GENERATION_SYNC_ROOTS = {"_dispatch_block", "_deliver_block",
                          "_superstep_args", "_propose_drafts",
-                         "_deliver", "_push"}
+                         "_deliver", "_push",
+                         # retirement closes the request timeline
+                         # (trace.event/finish) — walked so the close
+                         # path stays host-pure too
+                         "_retire_slot", "_finish", "_fail"}
 GENERATION_SYNC_BOUNDARY = {"_fetch_tokens", "_start_fetch"}
 #: calls that mean "the host blocks on (or copies back) device data"
 SYNC_CALL_NAMES = {"asarray", "device_get", "block_until_ready",

@@ -73,6 +73,76 @@ flat = np.concatenate([np.asarray(jax.device_get(params[k])).ravel()
                        for k in sorted(params)])
 result = {"pid": pid, "losses": losses,
           "checksum": float(np.abs(flat).sum())}
+
+# -- cluster metrics plane over the REAL coordination KV (ISSUE 15) ------
+# Each process publishes its registry snapshot at sync cadence; process
+# 0 renders the fleet /metrics view and the /health cluster meta. A
+# forced SLO breach on process 0 must flip health to degraded with the
+# objective named, then recover once the breach clears.
+import time
+
+from deeplearning4j_tpu import monitoring as mon
+from deeplearning4j_tpu import resilience
+from deeplearning4j_tpu.monitoring import cluster as cluster_mod
+from deeplearning4j_tpu.monitoring import slo as slo_mod
+from deeplearning4j_tpu.parallel.coordination import PeerCoordinator
+
+mon.enable()
+reg = mon.get_registry()
+reg.counter("dl4j.test.worker_steps").inc(len(losses))
+coordinator = PeerCoordinator(sync_every=1, peer_timeout=30).install()
+for _ in range(3):
+    coordinator.on_step()
+coordinator.barrier("metrics-published")
+
+if pid == 0:
+    text = cluster_mod.cluster_prometheus_text(coordinator)
+    probe = "dl4j_test_worker_steps"
+    result["cluster_metrics"] = {
+        "host0": f'{probe}{{host="0"}}' in text,
+        "host1": f'{probe}{{host="1"}}' in text,
+        "cluster_sum": f'{probe}{{host="cluster"}} 10' in text,
+        "age_gauge": "dl4j_cluster_snapshot_age_seconds" in text,
+    }
+    snap = resilience.health_snapshot()
+    result["health_cluster"] = snap["distributed"]["cluster"]
+    table = coordinator.peer_table()
+    result["peer_steps_per_s"] = {
+        str(k): v.get("steps_per_s") for k, v in table.items()}
+
+    # forced SLO breach: impossible latency objective over a loaded
+    # histogram; tiny burn windows so breach AND recovery both land
+    # inside the soak
+    h = reg.histogram("dl4j.test.worker_lat", reservoir=256)
+    for _ in range(256):
+        h.observe(100.0)
+    tracker = slo_mod.SloTracker(
+        [slo_mod.LatencyObjective("worker_p99",
+                                  metric="dl4j.test.worker_lat",
+                                  max_value=5.0)],
+        short_window=0.2, long_window=0.5, min_interval=0.0).install()
+    deadline = time.monotonic() + 0.7
+    while time.monotonic() < deadline:
+        tracker.evaluate(force=True)
+        time.sleep(0.05)
+    breach = resilience.health_snapshot()
+    result["slo_breach"] = {"status": breach["status"],
+                            "violated": breach["slo"]["violated"]}
+    for _ in range(512):                     # latency recovers
+        h.observe(0.1)
+    deadline = time.monotonic() + 0.7
+    while time.monotonic() < deadline:
+        tracker.evaluate(force=True)
+        time.sleep(0.05)
+    recovered = resilience.health_snapshot()
+    result["slo_recovered"] = {"status": recovered["status"],
+                               "violated": recovered["slo"]["violated"]}
+    tracker.uninstall()
+
+coordinator.barrier("slo-done")
+coordinator.uninstall()
+mon.disable()
+
 with open(out_path, "w") as f:
     json.dump(result, f)
 print("worker", pid, "done", result["losses"][0], "->", result["losses"][-1])

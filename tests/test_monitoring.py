@@ -159,7 +159,11 @@ def test_span_nesting_and_chrome_trace_json(tmp_path):
     mon.export_chrome_trace(path)
     with open(path) as f:
         doc = json.loads(f.read())                 # valid JSON
-    evs = doc["traceEvents"]
+    # the document leads with process/thread-name metadata (ISSUE 15:
+    # merged multi-process traces render as separate named lanes)
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert metas and metas[0]["name"] == "process_name"
+    evs = [e for e in doc["traceEvents"] if e["ph"] != "M"]
     assert [e["name"] for e in evs] == ["inner", "inner2", "outer"]
     for e in evs:
         assert e["ph"] == "X"

@@ -56,6 +56,24 @@ def test_two_process_sharded_trainer(tmp_path):
     # replicated params agree bit-for-bit across processes
     assert results[0]["checksum"] == results[1]["checksum"]
 
+    # cluster metrics plane (ISSUE 15): process 0's /metrics carries
+    # BOTH hosts' series (host="0"/"1" labels) plus the cluster
+    # aggregate, published over the real coordination KV
+    cm = results[0]["cluster_metrics"]
+    assert cm["host0"] and cm["host1"], cm
+    assert cm["cluster_sum"] and cm["age_gauge"], cm
+    # /health aggregates the per-host snapshot meta on process 0
+    hc = results[0]["health_cluster"]
+    assert hc["published"] == 2 and sorted(hc["hosts"]) == ["0", "1"]
+    assert all(v is not None
+               for v in results[0]["peer_steps_per_s"].values())
+    # forced SLO breach flips health to degraded with the objective
+    # named, then auto-recovers once the breach clears
+    assert results[0]["slo_breach"]["status"] == "degraded"
+    assert results[0]["slo_breach"]["violated"] == ["worker_p99"]
+    assert results[0]["slo_recovered"]["status"] == "ok"
+    assert results[0]["slo_recovered"]["violated"] == []
+
 
 def test_orbax_restore_across_mesh_shape_change(tmp_path, devices8):
     """Elastic resume must re-place a checkpoint saved on one mesh layout

@@ -20,6 +20,7 @@ faults.py site is exercised by some test): GENERATION_STEP,
 GENERATION_ADMIT, CACHE_GROW, SERVING_DISPATCH, EXECUTABLES_LOAD,
 INFERENCE_FORWARD, COMM_BARRIER, COMM_ALLREDUCE.
 """
+import json
 import random
 import threading
 import time
@@ -275,6 +276,115 @@ def test_chaos_kill_mid_superstep_streams_bit_identical(net):
         assert srv.stats["supersteps"] > 0
     finally:
         srv.shutdown()
+
+
+def test_chaos_killed_request_timeline_full_lifecycle(net):
+    """ISSUE 15 acceptance (chaos × request tracing): a decode kill
+    mid-stream at superstep k=8 leaves every request with a finished
+    timeline showing the FULL lifecycle — enqueue → admit → superstep
+    blocks → replay → re-admit → more blocks → retire — served over
+    `GET /requests/<id>`, while the delivered streams stay bit-identical
+    to the fault-free run. Zero added host syncs on the decode path is
+    proven by the fastpath sync lint (test_fastpath_lint walks the
+    timeline appends inside the _deliver_block/_fetch_tokens
+    boundary)."""
+    import urllib.request
+    from deeplearning4j_tpu.monitoring import requests as reqmod
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    baseline = _server(net, superstep=8)
+    try:
+        _, want, errs = _run_workload(baseline)
+        assert errs == [None] * 4
+    finally:
+        baseline.shutdown()
+
+    srv = _server(net, superstep=8)
+    try:
+        mon.enable()
+        reqmod.log().clear()
+        plan = faults.FaultPlan(seed=17).fail_at(
+            faults.GENERATION_SUPERSTEP, 2)
+        with plan:
+            reqs, got, errs = _run_workload(srv)
+        assert plan.fired.get(faults.GENERATION_SUPERSTEP) == 1
+        assert errs == [None] * 4
+        assert got == want, \
+            "replayed streams must bit-match the fault-free run"
+        assert srv.stats["replays"] >= 1
+
+        replayed = 0
+        for req, toks in zip(reqs, got):
+            assert req.trace_id is not None
+            tl = reqmod.log().get(req.trace_id)
+            assert tl is not None and tl.status == req.finish_reason
+            names = [e["event"] for e in tl.events]
+            # every request: enqueue → admit → ≥1 block → retire (last)
+            assert names[0] == "enqueue"
+            assert "admit" in names and names[-1] == "retire"
+            assert names.count("block") >= 1
+            retire = next(e for e in tl.events
+                          if e["event"] == "retire")
+            assert retire["tokens"] == len(toks)
+            if "replay" in names:
+                replayed += 1
+                i_replay = names.index("replay")
+                # the replay is followed by a RE-admission and blocks
+                # resume after it (a request killed before its first
+                # delivered block legitimately has no block before)
+                assert "admit" in names[i_replay:]
+                i_readmit = i_replay + names[i_replay:].index("admit")
+                assert "block" in names[i_readmit:]
+        assert replayed >= 1, "the kill must replay at least one stream"
+
+        # the acceptance surface: GET /requests/<id> serves the same
+        # lifecycle, and the per-token p99 exemplars link into the run
+        server = UIServer.getInstance()
+        server.start(port=0)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            doc = json.loads(urllib.request.urlopen(
+                base + f"/requests/{reqs[0].trace_id}",
+                timeout=10).read().decode())
+            served = [e["event"] for e in doc["events"]]
+            assert served[0] == "enqueue" and served[-1] == "retire"
+            listing = json.loads(urllib.request.urlopen(
+                base + "/requests", timeout=10).read().decode())
+            ids = {t.trace_id for t in reqs}
+            assert listing["exemplars"].get(mon.GEN_PER_TOKEN_MS), \
+                "per-token p99 exemplars must be served"
+            # this run's trace ids sit in the exemplar window (earlier
+            # tests in the module may own the top-valued slots)
+            window = mon.get_registry().get(
+                mon.GEN_PER_TOKEN_MS).exemplars(top=64)
+            assert ids & {e["trace_id"] for e in window}
+        finally:
+            server.stop()
+    finally:
+        srv.shutdown()
+        reqmod.log().clear()
+
+
+def test_submit_rejection_status_not_mislabeled_as_shed(net):
+    """A shut-down (or dead) server's submit refusal must land in the
+    request ring as 'rejected', never as 'shed' — an operator reading
+    /requests during an incident must be able to tell dead-server
+    refusals from genuine overload shedding."""
+    from deeplearning4j_tpu.monitoring import requests as reqmod
+    srv = _server(net)
+    try:
+        mon.enable()
+        reqmod.log().clear()
+        srv.shutdown()
+        with pytest.raises(RuntimeError):
+            srv.submit(prompt=[1, 2], max_new_tokens=2)
+        rec = reqmod.log().snapshot()["recent"][-1]
+        assert rec["status"] == "rejected"
+        assert rec["events"][-1]["event"] == "rejected"
+        assert rec["events"][-1]["error"] == "RuntimeError"
+    finally:
+        srv.shutdown()
+        reqmod.log().clear()
 
 
 def test_supervised_restart_from_warm_store_zero_compiles(net):
