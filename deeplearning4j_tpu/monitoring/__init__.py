@@ -42,11 +42,12 @@ from deeplearning4j_tpu.monitoring import profiler  # noqa: F401
 from deeplearning4j_tpu.monitoring import requests  # noqa: F401
 from deeplearning4j_tpu.monitoring import slo  # noqa: F401
 from deeplearning4j_tpu.monitoring import steps  # noqa: F401
+from deeplearning4j_tpu.monitoring import stragglers  # noqa: F401
 from deeplearning4j_tpu.monitoring.requests import (  # noqa: F401
     RequestLog, RequestTimeline, merged_chrome_trace, request_log)
 from deeplearning4j_tpu.monitoring.slo import (  # noqa: F401
-    LatencyObjective, RatioObjective, SloTracker, ThroughputObjective,
-    standard_objectives)
+    LatencyObjective, RatioObjective, SloTracker, StepTimeObjective,
+    StragglerObjective, ThroughputObjective, standard_objectives)
 from deeplearning4j_tpu.monitoring.memory import (  # noqa: F401
     MemoryMonitor)
 from deeplearning4j_tpu.monitoring.profiler import (  # noqa: F401
@@ -79,6 +80,7 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     DIST_BARRIER_TIMEOUTS, DIST_ENCODED_BYTES, DIST_RESIDUAL_NORM,
     DIST_ACCUM_MICROBATCHES, DIST_EXCHANGE_BUCKETS, DIST_BUCKET_BYTES,
     DIST_EXPOSED_EXCHANGE_MS, DIST_ENCODER_MIGRATIONS,
+    DIST_STRAGGLER_RATIO, DIST_STRAGGLER_SKEW_MS,
     PIPELINE_SYNCS, PIPELINE_HOST_BLOCKED_MS, PIPELINE_PREFETCH_DEPTH,
     PIPELINE_STAGED_BATCHES,
     PROFILE_SESSIONS, PROFILE_CAPTURED_STEPS, PROFILE_DEVICE_MS,
@@ -137,6 +139,7 @@ __all__ = [
     "DIST_ACCUM_MICROBATCHES", "DIST_EXCHANGE_BUCKETS",
     "DIST_BUCKET_BYTES", "DIST_EXPOSED_EXCHANGE_MS",
     "DIST_ENCODER_MIGRATIONS",
+    "DIST_STRAGGLER_RATIO", "DIST_STRAGGLER_SKEW_MS",
     "PIPELINE_SYNCS", "PIPELINE_HOST_BLOCKED_MS", "PIPELINE_PREFETCH_DEPTH",
     "PIPELINE_STAGED_BATCHES",
     "GEN_TOKENS", "GEN_ACTIVE_SLOTS", "GEN_ADMISSIONS",
@@ -148,11 +151,12 @@ __all__ = [
     "QUANT_DEQUANT_FALLBACKS", "QUANT_ACTIVATION_BYTES",
     "INFERENCE_REQUEST_MS", "SLO_BREACHES", "SLO_BURN_RATE",
     "SLO_BREACHED", "CLUSTER_SNAPSHOT_AGE",
-    "requests", "slo", "cluster",
+    "requests", "slo", "cluster", "stragglers",
     "RequestLog", "RequestTimeline", "request_log",
     "merged_chrome_trace",
     "SloTracker", "LatencyObjective", "ThroughputObjective",
-    "RatioObjective", "standard_objectives",
+    "RatioObjective", "StepTimeObjective", "StragglerObjective",
+    "standard_objectives",
 ]
 
 

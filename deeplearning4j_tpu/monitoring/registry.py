@@ -120,6 +120,14 @@ DIST_EXCHANGE_BUCKETS = "dl4j.dist.exchange_buckets"
 DIST_BUCKET_BYTES = "dl4j.dist.bucket_bytes"
 DIST_EXPOSED_EXCHANGE_MS = "dl4j.dist.exposed_exchange_ms"
 DIST_ENCODER_MIGRATIONS = "dl4j.dist.encoder_migrations"
+# straggler attribution (monitoring/stragglers.py): process 0 computes
+# per-step skew across the published per-host step timelines and names
+# the slowest host AND phase — the labels on these gauges ARE the
+# culprit (labels: host, phase). `ratio` is max-host / median-host
+# attributed step time; `skew_ms` the slow host's absolute excess over
+# the median host.
+DIST_STRAGGLER_RATIO = "dl4j.dist.straggler_ratio"
+DIST_STRAGGLER_SKEW_MS = "dl4j.dist.straggler_skew_ms"
 
 # host pipeline (runtime/pipeline.py): is the host running ahead of the
 # device, or blocking on it? `syncs` counts every host-blocking

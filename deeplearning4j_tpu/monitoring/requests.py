@@ -256,11 +256,27 @@ def start(kind, meta=None, trace_id=None, max_events=256):
 def merged_chrome_trace():
     """One Chrome trace-event document merging the host-side span
     tracer (its own per-thread lanes, now with process metadata) with
-    every request timeline as a dedicated lane — load in Perfetto to
-    see requests against the host phases that served them."""
+    every request timeline as a dedicated lane — and, when a peer
+    coordinator is active, one named training lane per HOST from the
+    published step timelines (monitoring/stragglers.py), so cross-host
+    step skew is visually obvious next to the local phases. Load in
+    Perfetto."""
+    import sys
     from deeplearning4j_tpu.monitoring.tracing import get_tracer
     tracer = get_tracer()
     doc = tracer.to_chrome_trace()
-    doc["traceEvents"] = list(doc["traceEvents"]) + \
+    events = list(doc["traceEvents"]) + \
         _global_log.chrome_events(epoch_ns=tracer.epoch_ns)
+    # sys.modules, never a fresh import: a trace export must not pull
+    # the parallel stack (and jax.distributed with it) into a process
+    # that never used it
+    coord_mod = sys.modules.get("deeplearning4j_tpu.parallel.coordination")
+    coord = getattr(coord_mod, "ACTIVE", None) if coord_mod else None
+    if coord is not None:
+        try:
+            from deeplearning4j_tpu.monitoring import stragglers as _sg
+            events += _sg.chrome_events(coord, epoch_ns=tracer.epoch_ns)
+        except Exception:  # noqa: BLE001 — lanes are best-effort
+            pass
+    doc["traceEvents"] = events
     return doc

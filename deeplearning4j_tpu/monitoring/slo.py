@@ -45,7 +45,8 @@ from deeplearning4j_tpu.monitoring import registry as _registry
 from deeplearning4j_tpu.monitoring.state import STATE
 
 __all__ = ["Objective", "LatencyObjective", "ThroughputObjective",
-           "RatioObjective", "SloTracker", "ACTIVE", "clear_tracker",
+           "RatioObjective", "StepTimeObjective", "StragglerObjective",
+           "SloTracker", "ACTIVE", "clear_tracker",
            "standard_objectives"]
 
 #: the installed tracker `resilience.health_snapshot()` consults
@@ -179,6 +180,77 @@ class RatioObjective(Objective):
             return None
         self.last_value = dn / dd
         return self.last_value > self.threshold
+
+
+class StepTimeObjective(Objective):
+    """A step wall-time quantile from the flight recorder
+    (monitoring/steps.py) must stay at or under `max_ms` — the
+    training-side twin of LatencyObjective, read from the ring's
+    percentile roll-up instead of a histogram."""
+
+    def __init__(self, name, max_ms, quantile=0.99, description=""):
+        q = float(quantile)
+        self._qkey = "p%d" % round(q * 100)
+        super().__init__(name, description or
+                         f"step wall {self._qkey} <= {max_ms} ms")
+        self.quantile = q
+        self.threshold = float(max_ms)
+
+    def measure(self, registry=None):
+        from deeplearning4j_tpu.monitoring import steps as _steps
+        wall = _steps.recorder().summary().get("wall_ms")
+        if not wall or wall.get(self._qkey) is None:
+            return None
+        self.last_value = float(wall[self._qkey])
+        return self.last_value > self.threshold
+
+
+class StragglerObjective(Objective):
+    """The max-host / median-host attributed step-time ratio (straggler
+    plane, monitoring/stragglers.py) must stay at or under `max_ratio`.
+    Breaching carries the CULPRIT — slowest host and phase — into
+    `describe()`, so `GET /health` names who to replace or rebalance,
+    not just that someone is slow. Inconclusive (None) below two
+    reporting hosts or with no coordinator attached."""
+
+    def __init__(self, name, max_ratio=2.0, coordinator=None,
+                 description=""):
+        super().__init__(name, description or
+                         f"max-host/median-host step time <= "
+                         f"{max_ratio}x")
+        self.threshold = float(max_ratio)
+        self._coordinator = coordinator
+        self.culprit = None
+
+    def _coord(self):
+        if self._coordinator is not None:
+            return self._coordinator
+        # late lookup so the objective can be declared before the
+        # coordinator exists (and survives coordinator replacement on
+        # elastic restart); sys.modules, never a fresh import — an
+        # objective must not trigger module init from a health poll
+        import sys
+        mod = sys.modules.get("deeplearning4j_tpu.parallel.coordination")
+        return getattr(mod, "ACTIVE", None) if mod else None
+
+    def measure(self, registry=None):
+        coord = self._coord()
+        if coord is None:
+            return None
+        from deeplearning4j_tpu.monitoring import stragglers as _sg
+        att = _sg.attribution(coord)
+        if att is None or att.get("ratio") is None:
+            return None
+        self.last_value = float(att["ratio"])
+        self.culprit = att.get("slowest")
+        return self.last_value > self.threshold
+
+    def describe(self):
+        d = super().describe()
+        if self.culprit is not None:
+            d["culprit"] = {"host": self.culprit.get("host"),
+                            "phase": self.culprit.get("phase")}
+        return d
 
 
 class SloTracker:
@@ -332,10 +404,13 @@ class SloTracker:
 
 
 def standard_objectives(per_token_p99_ms=None, steps_drop=None,
-                        replay_ratio=None):
-    """The three objectives the ISSUE names, with env-var thresholds:
+                        replay_ratio=None, step_p99_ms=None,
+                        straggler_ratio=None):
+    """The standard objective set, with env-var thresholds:
     DL4J_SLO_PER_TOKEN_P99_MS, DL4J_SLO_STEPS_DROP,
-    DL4J_SLO_REPLAY_RATIO (an unset/None knob omits the objective)."""
+    DL4J_SLO_REPLAY_RATIO, DL4J_SLO_STEP_P99_MS,
+    DL4J_SLO_STRAGGLER_RATIO (an unset/None knob omits the
+    objective)."""
     import os
 
     def knob(arg, env):
@@ -362,6 +437,12 @@ def standard_objectives(per_token_p99_ms=None, steps_drop=None,
                                   num=_registry.GEN_REPLAYS,
                                   den=_registry.GEN_ADMISSIONS,
                                   max_ratio=v))
+    v = knob(step_p99_ms, "DL4J_SLO_STEP_P99_MS")
+    if v is not None:
+        out.append(StepTimeObjective("step_p99", max_ms=v))
+    v = knob(straggler_ratio, "DL4J_SLO_STRAGGLER_RATIO")
+    if v is not None:
+        out.append(StragglerObjective("straggler_ratio", max_ratio=v))
     return out
 
 

@@ -46,6 +46,14 @@ PHASE_BY_SPAN = {
     "parallel.dispatch": "dispatch",
     "parallel.scan_dispatch": "dispatch",
     "sharded.dispatch": "dispatch",
+    # an EXPLICIT exchange span (a trainer that dispatches its gradient
+    # exchange separately from the step, e.g. a parameter-server-style
+    # loop). The overlapped bucketed exchange lives INSIDE the jitted
+    # dispatch, so today this phase is usually empty per-host — the
+    # fleet-level exchange exposure is instead DERIVED from cross-host
+    # dispatch-phase skew (monitoring/stragglers.py).
+    "train.exchange": "exchange",
+    "multihost.exchange": "exchange",
     "train.listeners": "listeners",
 }
 DETAIL_BY_SPAN = {
@@ -59,7 +67,7 @@ DETAIL_BY_SPAN = {
 STEP_END_SPANS = ("train.listeners", "sharded.dispatch")
 
 #: phases that add up to (approximately) the step wall time
-SUM_PHASES = ("data_next", "stage", "dispatch", "listeners")
+SUM_PHASES = ("data_next", "stage", "dispatch", "exchange", "listeners")
 
 #: a gap larger than this between one step's end and the next step's
 #: first span means the loop was IDLE in between (a later fit() call, a
@@ -227,6 +235,36 @@ class StepRecorder:
                 covs.append(attributed / r["wall_ms"])
         if covs:
             out["coverage"] = sum(covs) / len(covs)
+        return out
+
+    def compact_summary(self, tail=16):
+        """Bounded, KV-publishable digest of the ring: per-phase
+        p50/p99 (+mean/count), wall p50/p99, blocked/compile totals,
+        and a short record tail (step, ts, wall, phases) so process 0
+        can render per-host trace lanes. Everything is plain JSON
+        numbers — publishing is serialization of values the recorder
+        already holds, never a device touch."""
+        s = self.summary()
+        out = {"count": s["count"],
+               "host_blocked_ms_total": round(s["host_blocked_ms_total"],
+                                              3),
+               "compile_count_total": s["compile_count_total"],
+               "compile_ms_total": round(s["compile_ms_total"], 3),
+               "wall_ms": None, "phases": {}}
+        if s["wall_ms"]:
+            out["wall_ms"] = {"p50": round(s["wall_ms"]["p50"], 3),
+                              "p99": round(s["wall_ms"]["p99"], 3)}
+        for k, v in s["phases"].items():
+            out["phases"][k] = {"p50": round(v["p50"], 3),
+                                "p99": round(v["p99"], 3),
+                                "mean": round(v["mean"], 3),
+                                "count": v["count"]}
+        out["tail"] = [
+            {"step": r["step"], "ts": r["ts"],
+             "wall_ms": (None if r["wall_ms"] is None
+                         else round(r["wall_ms"], 3)),
+             "phases": {k: round(v, 3) for k, v in r["phases"].items()}}
+            for r in self.records(last=tail)]
         return out
 
     def clear(self):

@@ -47,6 +47,7 @@ import time
 
 from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.monitoring import cluster as _cluster
+from deeplearning4j_tpu.monitoring import stragglers as _stragglers
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience.errors import (PeerDesyncError,
                                                   PeerLostError,
@@ -416,6 +417,13 @@ class PeerCoordinator:
                 _cluster.publish(self, extra=extra)
             except Exception:  # noqa: BLE001
                 pass
+            # per-host step timeline (straggler plane): ONE overwritten
+            # `steps/<pid>` key per process at the same cadence — same
+            # zero-cost contract, same best-effort posture.
+            try:
+                _stragglers.publish(self, extra={"steps_per_s": rate})
+            except Exception:  # noqa: BLE001
+                pass
         if self.on_sync is not None:
             self.on_sync(self)
         if self._decision == PREEMPT and not self.driver_attached:
@@ -608,6 +616,13 @@ class PeerCoordinator:
             pass
         for pid, info in self._lost.items():
             table.setdefault(pid, {})["lost"] = info
+        # straggler columns: per-host attributed step time + the culprit
+        # verdict on the slow host's row (best-effort, read-only KV)
+        if _mon.enabled() and self.num_processes > 1:
+            try:
+                _stragglers.annotate_peer_table(self, table)
+            except Exception:  # noqa: BLE001
+                pass
         return table
 
     def snapshot(self):
@@ -643,6 +658,12 @@ class PeerCoordinator:
             cm = _cluster.health_meta(self)
             if cm is not None:
                 snap["cluster"] = cm
+            try:
+                sg = _stragglers.attribution(self)
+            except Exception:  # noqa: BLE001
+                sg = None
+            if sg is not None:
+                snap["stragglers"] = sg
         return snap
 
     # -- monitor thread --------------------------------------------------

@@ -756,17 +756,32 @@ class MultiHostTrainer(ShardedTrainer):
             reg.gauge(_mon.DIST_RESIDUAL_NORM,
                       help="global norm of the un-sent gradient "
                            "residual").set(host["residual_norm"])
-            # standalone exchange cost: dispatch the exchange-only
-            # probe and time the blocked wait (first call warms the
-            # compile un-timed; we are already at a declared host-sync
-            # cadence, never per step). SINGLE-PROCESS ONLY: the probe
-            # issues a collective, and monitoring.enabled() is
-            # host-LOCAL state — in a multi-process run a subset of
-            # hosts with monitoring on would issue a pmean the others
-            # never join (hang, or worse: pair with a peer's next
-            # training collective), so the probe is skipped entirely
-            # when collectives span processes.
+            # exchange exposure, two regimes on one gauge:
+            # - single-process: dispatch the exchange-only probe and
+            #   time the blocked wait (first call warms the compile
+            #   un-timed; we are already at a declared host-sync
+            #   cadence, never per step) — a standalone UPPER bound.
+            # - multi-process: the probe issues a collective, and
+            #   monitoring.enabled() is host-LOCAL state — a subset of
+            #   hosts with monitoring on would issue a pmean the others
+            #   never join (hang, or worse: pair with a peer's next
+            #   training collective). Instead DERIVE a lower bound from
+            #   the published per-host step timelines: in a lockstep
+            #   collective step the cross-host spread in dispatch-phase
+            #   p50 is wall time the exchange exposed on the fast hosts
+            #   (monitoring/stragglers.py, no collective issued).
             if jax.process_count() > 1:
+                ms = self._derived_exchange_ms()
+                if ms is not None:
+                    host["exposed_exchange_ms_derived"] = ms
+                    reg.gauge(_mon.DIST_EXPOSED_EXCHANGE_MS,
+                              help="exposed cost of the bucketed "
+                                   "exchange: probed standalone in "
+                                   "single-process runs (upper bound); "
+                                   "derived from cross-host dispatch-"
+                                   "phase skew in multi-process runs "
+                                   "(lower bound, no collective)"
+                              ).set(ms)
                 return host
             import time as _time
             probe = self._exchange_probe()
@@ -778,12 +793,26 @@ class MultiHostTrainer(ShardedTrainer):
             ms = (_time.perf_counter() - t0) * 1e3
             host["exposed_exchange_ms"] = ms
             reg.gauge(_mon.DIST_EXPOSED_EXCHANGE_MS,
-                      help="standalone cost of the bucketed exchange "
-                           "(encode+all-reduce on current state) — the "
-                           "time the overlapped schedule exists to "
-                           "hide; probed in single-process runs only "
-                           "(the probe is itself a collective)").set(ms)
+                      help="exposed cost of the bucketed exchange: "
+                           "probed standalone in single-process runs "
+                           "(upper bound); derived from cross-host "
+                           "dispatch-phase skew in multi-process runs "
+                           "(lower bound, no collective)").set(ms)
         return host
+
+    @staticmethod
+    def _derived_exchange_ms():
+        """Multi-process exposed-exchange estimate off the straggler
+        plane's published timelines — None without an active
+        coordinator or below two reporting hosts."""
+        coord = _coord.ACTIVE
+        if coord is None:
+            return None
+        try:
+            from deeplearning4j_tpu.monitoring import stragglers as _sg
+            return _sg.derived_exchange_ms(coord)
+        except Exception:  # noqa: BLE001
+            return None
 
 
 # ===================== coordinated robustness ===========================

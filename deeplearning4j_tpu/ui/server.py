@@ -32,12 +32,19 @@ Two forms, both dependency-free:
 - In a multi-host run, process 0's `/metrics` serves the CLUSTER view
   (monitoring/cluster.py): every host's series labeled host="<pid>"
   plus host="cluster" aggregates from the coordination-KV snapshots.
+- `GET /stragglers` — straggler attribution
+  (monitoring/stragglers.py): per-host attributed step time from the
+  published step-timeline digests, the max/median ratio, and the
+  culprit host + phase; `/steps` on process 0 also carries every
+  host's timeline digest under "hosts", and `/trace` gains one named
+  training lane per host.
 - `render_static_html(storage, path)` — a self-contained HTML snapshot
   (inline SVG charts) for environments without an open port.
 """
 from __future__ import annotations
 
 import json
+import sys
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -382,8 +389,53 @@ class UIServer:
                     except ValueError:
                         last = 64
                     rec = _steps.recorder()
-                    body = json.dumps({"records": rec.records(last=last),
-                                       "summary": rec.summary()}).encode()
+                    doc = {"records": rec.records(last=last),
+                           "summary": rec.summary()}
+                    # cluster-aware on process 0 of a multi-host run:
+                    # every host's published timeline digest rides
+                    # alongside the local ring (sys.modules — serving
+                    # /steps must not pull in the parallel stack)
+                    coord_mod = sys.modules.get(
+                        "deeplearning4j_tpu.parallel.coordination")
+                    coord = getattr(coord_mod, "ACTIVE", None) \
+                        if coord_mod else None
+                    if coord is not None and coord.process_id == 0 \
+                            and coord.num_processes > 1:
+                        try:
+                            from deeplearning4j_tpu.monitoring import \
+                                stragglers as _sg
+                            doc["hosts"] = {
+                                str(pid): snap.get("timeline")
+                                for pid, snap
+                                in sorted(_sg.gather(coord).items())}
+                        except Exception:  # noqa: BLE001
+                            pass
+                    body = json.dumps(doc).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/stragglers"):
+                    # straggler attribution (monitoring/stragglers.py):
+                    # per-host attributed step time from the published
+                    # timelines, the max/median ratio, and the culprit
+                    # host + phase. 404 without an active coordinator —
+                    # a single-process run has no peers to skew against
+                    coord_mod = sys.modules.get(
+                        "deeplearning4j_tpu.parallel.coordination")
+                    coord = getattr(coord_mod, "ACTIVE", None) \
+                        if coord_mod else None
+                    if coord is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        self.wfile.write(b"no active peer coordinator")
+                        return
+                    from deeplearning4j_tpu.monitoring import \
+                        stragglers as _sg
+                    att = _sg.attribution(coord)
+                    if att is None:
+                        att = {"hosts": {}, "published": 0,
+                               "ratio": None, "median_step_ms": None,
+                               "slowest": None,
+                               "error": "coordination KV unreachable"}
+                    body = json.dumps(att).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/executables"):
                     # AOT serving-executable cache status: per-store

@@ -41,11 +41,19 @@ def cache_dir(root: str) -> str:
     return os.path.join(root, ".jax_cache", f"cpu-{host_cpu_key()}")
 
 
-def enable_compile_cache(root: str, min_compile_secs: float = 1.0) -> None:
+def enable_compile_cache(root: str, min_compile_secs: float = 2.0) -> None:
     """Point jax's persistent compilation cache at cache_dir(root).
 
     Single definition shared by bench.py and exp_tpu_r4.py so the two
-    chip-facing entry points can never silently diverge on cache policy."""
+    chip-facing entry points can never silently diverge on cache policy.
+
+    min_compile_secs floor of 2.0 is deliberate: XLA:CPU's serialized
+    executable for at least one borderline-fast (~1 s) compile in this
+    codebase deserializes WRONG — the reader gets bad numerics and a
+    corrupted heap (GC segfault at exit) while the writer, which keeps
+    using its in-memory executable, stays green. Keeping sub-2 s
+    compiles out of the cache costs little (they are cheap to redo by
+    definition) and keeps the poison class off disk entirely."""
     import jax
 
     jax.config.update("jax_compilation_cache_dir", cache_dir(root))

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Bench-regression gate: a fresh `BENCH_*.json` must not fall more
+than `--tolerance` below the best prior entry in the checked-in bench
+trajectory.
+
+The repo accumulates one headline artifact per bench round
+(`BENCH_r<NN>.json` at the repo root), in two shapes:
+
+- the wrapped driver format: `{"n", "cmd", "rc", "tail",
+  "parsed": {"value", "error", "metric", "unit"} | null}` — `parsed`
+  is null (or `rc` nonzero) when the round never produced a number;
+- the flat local format: the parsed payload at top level
+  (`{"value", "metric", "unit", ...extra section keys}`), values
+  sometimes serialized as strings.
+
+The headline is `parsed["value"]` (higher is better). Nothing has ever
+compared one round against the previous — a silent throughput
+regression would land unnoticed. This script is that comparison, and
+`tests/test_bench_regression.py` pins its verdicts over the existing
+artifacts in tier-1.
+
+Exemption: the axon tunnel wedge (BENCH.md "Environment hazard"). A
+round whose every attempt timed out before the device banner printed
+(`value == 0.0`, "timeout" in the error trail, no "# device:" line in
+the tail) measured the ENVIRONMENT, not the code — it is skipped as a
+prior and tolerated as a fresh result (reported, exit 0): failing the
+gate on an outage would teach people to ignore it.
+
+Usage:
+    python scripts/check_bench_regression.py BENCH_fresh.json
+    python scripts/check_bench_regression.py --tolerance 0.05 fresh.json
+Exit 0 = within tolerance (or no usable prior / fresh outage),
+exit 1 = regression.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: tolerated fractional drop below the best prior headline (0.10 =
+#: fresh may be up to 10% slower); override with --tolerance or
+#: DL4J_BENCH_TOLERANCE
+DEFAULT_TOLERANCE = 0.10
+
+
+def load_artifact(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def parsed_of(doc):
+    """The parsed payload of either artifact shape, or None when the
+    round produced no result (wrapped with `parsed: null` or a nonzero
+    driver rc)."""
+    if not isinstance(doc, dict):
+        return None
+    if "parsed" in doc or "rc" in doc:
+        if doc.get("rc") not in (0, None):
+            return None
+        p = doc.get("parsed")
+        return p if isinstance(p, dict) else None
+    return doc if "value" in doc else None
+
+
+def headline_value(doc):
+    """float headline (img/s — higher is better), or None. Flat local
+    artifacts serialize numbers as strings, hence the float()."""
+    p = parsed_of(doc)
+    if p is None or p.get("value") is None:
+        return None
+    try:
+        return float(p["value"])
+    except (TypeError, ValueError):
+        return None
+
+
+def is_outage(doc):
+    """The axon-tunnel-outage signature (BENCH.md): zero headline,
+    every attempt a timeout, and the device banner never printed —
+    the run never reached the accelerator."""
+    p = parsed_of(doc)
+    if p is None:
+        return False
+    v = headline_value(doc)
+    if v is None or v != 0.0:
+        return False
+    blob = str(p.get("error") or "") + str(doc.get("tail") or "")
+    return "timeout" in blob and "# device:" not in str(doc.get("tail")
+                                                       or "")
+
+
+def trajectory_paths(root=REPO_ROOT):
+    return sorted(glob.glob(os.path.join(root, "BENCH_*.json")))
+
+
+def best_prior(paths=None, exclude=()):
+    """(value, path) of the best usable prior headline — outage rounds
+    and no-result rounds are not priors. (None, None) when the
+    trajectory holds nothing usable."""
+    exclude = {os.path.abspath(p) for p in exclude}
+    best_v, best_p = None, None
+    for path in paths if paths is not None else trajectory_paths():
+        if os.path.abspath(path) in exclude:
+            continue
+        try:
+            doc = load_artifact(path)
+        except (OSError, ValueError):
+            continue
+        if is_outage(doc):
+            continue
+        v = headline_value(doc)
+        if v is None or v <= 0.0:
+            continue
+        if best_v is None or v > best_v:
+            best_v, best_p = v, path
+    return best_v, best_p
+
+
+def check(fresh_path, tolerance=DEFAULT_TOLERANCE, paths=None):
+    """Verdict dict: {"ok", "reason", "fresh", "prior", "prior_path",
+    "floor"}. ok=False only for a genuine regression — a fresh outage
+    or an empty trajectory passes with the reason named."""
+    doc = load_artifact(fresh_path)
+    prior, prior_path = best_prior(paths=paths, exclude=(fresh_path,))
+    out = {"ok": True, "fresh": headline_value(doc), "prior": prior,
+           "prior_path": prior_path, "floor": None, "reason": None}
+    if is_outage(doc):
+        out["reason"] = ("fresh round matches the axon-tunnel-outage "
+                         "signature — environment, not code; exempt")
+        return out
+    if out["fresh"] is None:
+        out["ok"] = False
+        out["reason"] = "fresh artifact holds no headline value"
+        return out
+    if prior is None:
+        out["reason"] = "no usable prior in the bench trajectory"
+        return out
+    floor = prior * (1.0 - float(tolerance))
+    out["floor"] = floor
+    if out["fresh"] < floor:
+        out["ok"] = False
+        out["reason"] = (f"regression: {out['fresh']:.2f} < floor "
+                         f"{floor:.2f} ({tolerance:.0%} below best "
+                         f"prior {prior:.2f} from "
+                         f"{os.path.basename(prior_path)})")
+    else:
+        out["reason"] = (f"{out['fresh']:.2f} within {tolerance:.0%} of "
+                         f"best prior {prior:.2f} "
+                         f"({os.path.basename(prior_path)})")
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("fresh", help="fresh BENCH_*.json to gate")
+    ap.add_argument("--tolerance", type=float,
+                    default=float(os.environ.get("DL4J_BENCH_TOLERANCE",
+                                                 DEFAULT_TOLERANCE)),
+                    help="tolerated fractional drop below the best "
+                         "prior (default %(default)s)")
+    args = ap.parse_args(argv)
+    verdict = check(args.fresh, tolerance=args.tolerance)
+    print(json.dumps(verdict, indent=2))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -177,6 +177,36 @@ TRAIN_SYNC_ROOTS = {"make_step", "make_guarded_step", "_make_exchange",
 TRAIN_SYNC_BOUNDARY = {"encoder_stats", "_materialize",
                        "materialize_score"}
 
+# -- step-timeline publish lint (straggler plane) --------------------------
+#: the per-host step-timeline publish hooks (monitoring/stragglers.py,
+#: fed from the coordination sync point) must be pure host
+#: serialization: walking the publish path from each group's roots must
+#: reach NO device materialization. Groups are linted SEPARATELY
+#: because the walker's call graph is by bare function name and
+#: `publish` exists in coordination.py (the KV write), cluster.py, and
+#: stragglers.py — one union graph would shadow two of the three.
+TIMELINE_MODULE_GROUPS = [
+    ["deeplearning4j_tpu/parallel/coordination.py"],
+    ["deeplearning4j_tpu/monitoring/stragglers.py",
+     "deeplearning4j_tpu/monitoring/steps.py"],
+    ["deeplearning4j_tpu/monitoring/cluster.py"],
+]
+#: publish-path entry points present in the groups: the sync-point
+#: cadence hook (coordination), the digest publishers
+#: (stragglers/cluster), and the ring digest they serialize (steps)
+TIMELINE_SYNC_ROOTS = {"_sync_point", "publish", "compact_summary"}
+#: forensics reports materialize freely — they run on the failure
+#: path, never at the publish cadence
+TIMELINE_SYNC_BOUNDARY = {"_write_report"}
+
+#: coordination-module aliases whose `.publish(self, ...)` is a
+#: METRICS-plane publish (cluster metrics / step timelines) — each such
+#: call must sit inside the enabled-guard. The coordinator's own
+#: `self.publish(...)` (heartbeats, guardian verdicts) is control
+#: plane: it runs whether or not monitoring is on, and is exempt.
+METRICS_PUBLISH_ALIASES = {"_cluster", "_stragglers"}
+METRICS_PUBLISH_MODULES = ["deeplearning4j_tpu/parallel/coordination.py"]
+
 #: attribute calls that hit the registry
 REGISTRY_ATTRS = {"counter", "gauge", "histogram"}
 #: bare/attribute function names that resolve the registry
@@ -364,6 +394,48 @@ def check_generation_host_sync(sources):
             "per-token host sync"))
 
 
+def check_timeline_host_sync(sources):
+    """Zero host syncs on the step-timeline publish path: publishing a
+    per-host digest is JSON over numbers the flight recorder already
+    holds — a device materialization reachable from `publish` /
+    `compact_summary` / `_sync_point` would turn the metrics plane
+    into a hidden per-sync host sync."""
+    return _check_reachable(
+        sources, TIMELINE_SYNC_ROOTS, TIMELINE_SYNC_BOUNDARY,
+        SYNC_CALL_NAMES,
+        lambda what, via: (
+            f"{what} reachable from the step-timeline publish path "
+            f"(via {via}) — publishing must stay pure host "
+            "serialization, never a device touch"))
+
+
+def check_metrics_publish_guarded(source, path="<string>"):
+    """Every metrics-plane publish in the coordination module
+    (`_cluster.publish(...)` / `_stragglers.publish(...)`) must sit
+    inside the enabled-guard: with monitoring off the sync point pays
+    one branch, not a KV write per sync."""
+    tree = ast.parse(source, filename=path)
+    violations = []
+
+    def walk(node, ancestors):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "publish" \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in METRICS_PUBLISH_ALIASES \
+                    and not _guarded(node, ancestors):
+                violations.append(
+                    (path, node.lineno,
+                     f"{f.value.id}.publish(...) outside the "
+                     "enabled-guard — the metrics/timeline planes must "
+                     "cost one branch when monitoring is off"))
+        for child in ast.iter_child_nodes(node):
+            walk(child, ancestors + [node])
+
+    walk(tree, [])
+    return violations
+
+
 def main(modules=None):
     violations = []
     for rel in modules or HOT_MODULES:
@@ -394,6 +466,20 @@ def main(modules=None):
                 with open(path) as f:
                     train_sources[path] = f.read()
         violations.extend(check_training_host_sync(train_sources))
+        for group in TIMELINE_MODULE_GROUPS:
+            tl_sources = {}
+            for rel in group:
+                path = os.path.join(REPO_ROOT, rel)
+                if os.path.exists(path):
+                    with open(path) as f:
+                        tl_sources[path] = f.read()
+            violations.extend(check_timeline_host_sync(tl_sources))
+        for rel in METRICS_PUBLISH_MODULES:
+            path = os.path.join(REPO_ROOT, rel)
+            if os.path.exists(path):
+                with open(path) as f:
+                    violations.extend(
+                        check_metrics_publish_guarded(f.read(), path))
     for path, lineno, msg in violations:
         print(f"{os.path.relpath(path, REPO_ROOT)}:{lineno}: {msg}")
     if violations:

@@ -57,8 +57,27 @@ jax.config.update("jax_default_matmul_precision", "highest")
 from deeplearning4j_tpu.util.hostkey import cache_dir  # noqa: E402
 
 jax.config.update("jax_compilation_cache_dir", cache_dir("/root/repo"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# 2.0 s floor, NOT lower: a borderline ~1 s compile (the zero1
+# accumulated-bucketed step) produces a serialized executable that
+# deserializes WRONG on this XLA:CPU build — readers get bad numerics
+# (test_zero1_rides_the_accumulated_bucketed_step fails) and a corrupt
+# heap that segfaults the GC, while the writing run stays green on its
+# in-memory executable. Sub-2 s compiles are cheap to redo; caching
+# them only plants landmines (see util/hostkey.enable_compile_cache).
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+# Preload orbax BEFORE any test compiles: its lazy import drags in the whole
+# google-cloud/aiohttp stack mid-suite (first ElasticCheckpointer
+# construction) — a multi-second import churn that lands while live jaxlib
+# MLIR objects are being garbage-collected and makes any latent heap
+# corruption (see the cache note above) crash right there instead of at
+# exit. Importing it here, while no MLIR objects exist yet, keeps module
+# state deterministic and removes the mid-suite pause. If the suite ever
+# starts failing deterministically with wrong numerics + GC segfaults,
+# suspect a poisoned .jax_cache entry first — diagnosis recipe in
+# .claude/skills/verify/SKILL.md.
+import orbax.checkpoint  # noqa: E402, F401
 
 import pytest  # noqa: E402
 

@@ -110,6 +110,90 @@ if pid == 0:
     result["peer_steps_per_s"] = {
         str(k): v.get("steps_per_s") for k, v in table.items()}
 
+# -- straggler plane over the REAL coordination KV (ISSUE 16) ------------
+# Process 1 plays the straggler: its flight recorder reports a 60 ms
+# dispatch phase vs process 0's 5 ms. One sync point publishes both
+# digests; process 0 must name the host AND the phase on /stragglers,
+# carry both timelines on /steps, render one training lane per host on
+# /trace, and flip health degraded via the StragglerObjective — then
+# auto-recover when the slowdown clears.
+from deeplearning4j_tpu.monitoring import steps as steps_mod
+from deeplearning4j_tpu.monitoring import stragglers as stragglers_mod
+
+rec = steps_mod.recorder()
+rec.clear()
+dispatch_ms = 60.0 if pid == 1 else 5.0
+for _ in range(4):
+    rec.on_span("fit.data_next", 1.0)
+    rec.on_span("sharded.dispatch", dispatch_ms)
+coordinator.on_step()                      # sync-point publish
+coordinator.barrier("slowed-published")
+
+if pid == 0:
+    att = stragglers_mod.attribution(coordinator)
+    result["straggler"] = att["slowest"]
+    result["timeline_hosts"] = sorted(att["hosts"])
+    result["timeline_phases"] = {
+        h: sorted(d["phases_p50_ms"]) for h, d in att["hosts"].items()}
+    result["derived_exchange_ms"] = \
+        stragglers_mod.derived_exchange_ms(coordinator)
+
+    import urllib.request
+    from deeplearning4j_tpu.ui.server import UIServer
+    server = UIServer.getInstance()
+    server.start(port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        sdoc = json.load(urllib.request.urlopen(base + "/stragglers",
+                                                timeout=10))
+        result["http_stragglers"] = sdoc["slowest"]
+        steps_doc = json.load(urllib.request.urlopen(base + "/steps",
+                                                     timeout=10))
+        result["http_steps_hosts"] = sorted(steps_doc.get("hosts", {}))
+        tdoc = json.load(urllib.request.urlopen(base + "/trace",
+                                                timeout=10))
+        result["trace_lanes"] = sorted(
+            e["args"]["name"] for e in tdoc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"
+            and str(e["args"].get("name", "")).startswith("train host"))
+    finally:
+        server.stop()
+
+    sg_tracker = slo_mod.SloTracker(
+        [slo_mod.StragglerObjective("straggler_ratio", max_ratio=2.0,
+                                    coordinator=coordinator)],
+        short_window=0.2, long_window=0.5, min_interval=0.0).install()
+    deadline = time.monotonic() + 0.7
+    while time.monotonic() < deadline:
+        sg_tracker.evaluate(force=True)
+        time.sleep(0.05)
+    breach = resilience.health_snapshot()
+    obj = breach["slo"]["objectives"]["straggler_ratio"]
+    result["straggler_breach"] = {"status": breach["status"],
+                                  "violated": breach["slo"]["violated"],
+                                  "culprit": obj.get("culprit")}
+
+coordinator.barrier("straggler-breach")
+
+# the slowdown clears: both hosts republish healthy digests
+rec.clear()
+for _ in range(4):
+    rec.on_span("fit.data_next", 1.0)
+    rec.on_span("sharded.dispatch", 5.0)
+coordinator.on_step()
+coordinator.barrier("recovered-published")
+
+if pid == 0:
+    deadline = time.monotonic() + 0.7
+    while time.monotonic() < deadline:
+        sg_tracker.evaluate(force=True)
+        time.sleep(0.05)
+    recovered = resilience.health_snapshot()
+    result["straggler_recovered"] = {
+        "status": recovered["status"],
+        "violated": recovered["slo"]["violated"]}
+    sg_tracker.uninstall()
+
     # forced SLO breach: impossible latency objective over a loaded
     # histogram; tiny burn windows so breach AND recovery both land
     # inside the soak

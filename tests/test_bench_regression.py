@@ -1,0 +1,96 @@
+"""scripts/check_bench_regression.py in tier-1: the bench trajectory's
+headline values gate fresh rounds, with the axon-tunnel-outage
+signature (BENCH.md) exempted — pinned over the REAL checked-in
+artifacts so the parser tracks both artifact shapes."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+import check_bench_regression as cbr  # noqa: E402
+
+REPO = cbr.REPO_ROOT
+
+
+def _art(name):
+    return cbr.load_artifact(os.path.join(REPO, name))
+
+
+def test_parses_both_artifact_shapes():
+    # wrapped driver format
+    assert cbr.headline_value(_art("BENCH_r02.json")) == 2212.83
+    # flat local format (string-friendly values)
+    assert cbr.headline_value(_art("BENCH_r04_local.json")) == 2589.02
+    # a no-result round (parsed: null, rc != 0) has no headline
+    assert cbr.headline_value(_art("BENCH_r01.json")) is None
+
+
+def test_outage_signature_on_real_artifacts():
+    for name in ("BENCH_r03.json", "BENCH_r04.json", "BENCH_r05.json"):
+        assert cbr.is_outage(_art(name)), name
+    for name in ("BENCH_r01.json", "BENCH_r02.json",
+                 "BENCH_r03_local.json", "BENCH_r04_local.json"):
+        assert not cbr.is_outage(_art(name)), name
+
+
+def test_best_prior_over_checked_in_trajectory():
+    v, path = cbr.best_prior()
+    assert v == 2589.02
+    assert os.path.basename(path) == "BENCH_r04_local.json"
+    # excluding the best falls back to the next usable headline
+    v2, path2 = cbr.best_prior(exclude=(path,))
+    assert v2 == 2587.65
+    assert os.path.basename(path2) == "BENCH_r03_local.json"
+
+
+def _write(tmp_path, doc, name="BENCH_fresh.json"):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_fresh_within_tolerance_passes(tmp_path):
+    fresh = _write(tmp_path, {"value": 2400.0, "metric": "m",
+                              "unit": "img/s"})
+    verdict = cbr.check(fresh, tolerance=0.10)
+    assert verdict["ok"] and verdict["floor"] < 2400.0
+    assert verdict["prior"] == 2589.02
+
+
+def test_fresh_regression_fails(tmp_path):
+    fresh = _write(tmp_path, {"value": 2000.0, "metric": "m",
+                              "unit": "img/s"})
+    verdict = cbr.check(fresh, tolerance=0.10)
+    assert not verdict["ok"] and "regression" in verdict["reason"]
+    # a looser tolerance knob clears the same artifact
+    assert cbr.check(fresh, tolerance=0.25)["ok"]
+
+
+def test_fresh_outage_is_exempt(tmp_path):
+    fresh = _write(tmp_path, {
+        "n": 1, "cmd": "bench", "rc": 0, "tail": "no banner",
+        "parsed": {"value": 0.0,
+                   "error": "attempt 1: timeout after 420s",
+                   "metric": "m", "unit": "img/s"}})
+    verdict = cbr.check(fresh, tolerance=0.10)
+    assert verdict["ok"] and "outage" in verdict["reason"]
+
+
+def test_fresh_without_headline_fails(tmp_path):
+    fresh = _write(tmp_path, {"n": 1, "cmd": "bench", "rc": 1,
+                              "tail": "crash", "parsed": None})
+    verdict = cbr.check(fresh, tolerance=0.10)
+    assert not verdict["ok"] and "no headline" in verdict["reason"]
+
+
+def test_main_exit_codes(tmp_path, capsys):
+    ok = _write(tmp_path, {"value": 2589.0, "metric": "m",
+                           "unit": "img/s"}, "BENCH_ok.json")
+    bad = _write(tmp_path, {"value": 1.0, "metric": "m",
+                            "unit": "img/s"}, "BENCH_bad.json")
+    assert cbr.main([ok]) == 0
+    assert cbr.main([bad]) == 1
+    assert cbr.main([bad, "--tolerance", "1.0"]) == 0
+    capsys.readouterr()

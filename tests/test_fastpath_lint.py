@@ -92,6 +92,72 @@ def test_training_sync_lint_accepts_current_exchange():
     assert check_fastpath.check_training_host_sync(sources) == []
 
 
+def test_timeline_lint_module_groups_exist_and_pass():
+    """The step-timeline publish rule over the real modules (also
+    covered by test_repo_hot_paths_are_clean; this pins the group set
+    so a rename doesn't silently drop coverage)."""
+    for group in check_fastpath.TIMELINE_MODULE_GROUPS:
+        sources = {}
+        for rel in group:
+            path = os.path.join(check_fastpath.REPO_ROOT, rel)
+            assert os.path.exists(path), f"lint module vanished: {rel}"
+            with open(path) as f:
+                sources[path] = f.read()
+        assert check_fastpath.check_timeline_host_sync(sources) == []
+
+
+def test_timeline_lint_flags_device_touch_in_publish():
+    """A device materialization reachable from the timeline publish
+    path is flagged — publishing must stay pure host serialization."""
+    bad = textwrap.dedent("""
+        import json
+        import numpy as np
+
+        def publish(coordinator, recorder=None):
+            snap = _digest(recorder)
+            coordinator.publish("steps/0", json.dumps(snap))
+
+        def _digest(recorder):
+            return {"w": np.asarray(recorder.wall).tolist()}
+    """)
+    v = check_fastpath.check_timeline_host_sync({"m.py": bad})
+    assert len(v) == 2   # asarray AND tolist
+    assert all("publish path" in msg for _, _, msg in v)
+
+
+def test_metrics_publish_guard_accepts_current_coordination():
+    path = os.path.join(check_fastpath.REPO_ROOT,
+                        check_fastpath.METRICS_PUBLISH_MODULES[0])
+    assert os.path.exists(path)
+    with open(path) as f:
+        assert check_fastpath.check_metrics_publish_guarded(
+            f.read(), path) == []
+
+
+def test_metrics_publish_guard_flags_unguarded_publish():
+    """An unguarded metrics-plane publish at the sync point is flagged;
+    the coordinator's own control-plane publish (heartbeats) is
+    exempt, and the guarded form passes."""
+    bad = textwrap.dedent("""
+        def _sync_point(self, rate):
+            self.publish("hb/0/0", "{}")          # control plane: ok
+            _cluster.publish(self, extra={})       # metrics: unguarded
+            _stragglers.publish(self)              # timeline: unguarded
+    """)
+    v = check_fastpath.check_metrics_publish_guarded(bad)
+    assert len(v) == 2
+    assert all("enabled-guard" in msg for _, _, msg in v)
+
+    good = textwrap.dedent("""
+        def _sync_point(self, rate):
+            self.publish("hb/0/0", "{}")
+            if _mon.enabled():
+                _cluster.publish(self, extra={})
+                _stragglers.publish(self)
+    """)
+    assert check_fastpath.check_metrics_publish_guarded(good) == []
+
+
 def test_lint_rejects_guard_after_the_call():
     # the guard must precede the call — a later early-return doesn't
     # protect the hot path

@@ -13,6 +13,7 @@ Tier-1 acceptance anchors:
 import os
 import sys
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -541,14 +542,33 @@ def test_superstep_sync_accounting_amortizes(server4, monkeypatch):
     adm0 = server4.stats["admissions"]
     toks = server4.generate([1, 2, 3], max_new_tokens=12, timeout=60)
     assert len(toks) == 12
-    # the invariant holds at any instant: fetch and step counters move
-    # together at delivery, admissions fetch their own first token
-    assert (server4.token_fetches - fetches0
-            == (server4.stats["steps"] - steps0)
-            + (server4.stats["admissions"] - adm0))
+    # delivery runs on the worker thread and can lag generate()'s
+    # return (tail blocks of frozen lanes drain after the request
+    # resolves) — poll until the counters go quiet before reading them
+    deadline = time.time() + 10.0
+    last = None
+    while time.time() < deadline:
+        cur = (server4.token_fetches, server4.stats["steps"],
+               server4.stats["admissions"])
+        if cur == last:
+            break
+        last = cur
+        time.sleep(0.25)
+    fetches = server4.token_fetches - fetches0
+    steps = server4.stats["steps"] - steps0
+    adm = server4.stats["admissions"] - adm0
+    # every fetch is a block-delivery or an admission sync — never more.
+    # Strictly FEWER is legal: an admission that lands while a block is
+    # in flight rides that block's fetch instead of syncing on its own
+    # prefill (the pipeline coalesces), so exact equality is
+    # interleaving-dependent
+    assert 0 < fetches <= steps + adm
+    # the headline amortization: 12 tokens at k=4 cost a handful of
+    # syncs (blocks + admissions), nowhere near one sync per token
+    assert fetches <= 8 < 12
     # 11 post-admission tokens in blocks of 4: ≤ 4 blocks + ≤ 2 tail
     # blocks of frozen lanes (pipeline drain) — far fewer than 11
-    assert server4.stats["steps"] - steps0 <= 6
+    assert steps <= 6
 
 
 def test_superstep_status_and_metrics(server4):

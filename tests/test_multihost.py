@@ -74,6 +74,33 @@ def test_two_process_sharded_trainer(tmp_path):
     assert results[0]["slo_recovered"]["status"] == "ok"
     assert results[0]["slo_recovered"]["violated"] == []
 
+    # straggler plane (ISSUE 16): process 0 gathered BOTH hosts' step
+    # timelines over the KV and named the artificially slowed peer —
+    # host 1, dispatch phase — with the skew quantified
+    r0 = results[0]
+    assert r0["timeline_hosts"] == ["0", "1"]
+    assert all("dispatch" in ph for ph in r0["timeline_phases"].values())
+    assert r0["straggler"]["host"] == "1"
+    assert r0["straggler"]["phase"] == "dispatch"
+    assert r0["straggler"]["ratio"] > 2.0
+    # the derived multi-process exchange exposure is the cross-host
+    # dispatch skew (60 vs 5 ms feeds)
+    assert 50.0 <= r0["derived_exchange_ms"] <= 60.0
+    # HTTP surfaces on process 0: /stragglers names the culprit,
+    # /steps carries every host's digest, /trace has one lane per host
+    assert r0["http_stragglers"]["host"] == "1"
+    assert r0["http_stragglers"]["phase"] == "dispatch"
+    assert r0["http_steps_hosts"] == ["0", "1"]
+    assert r0["trace_lanes"] == ["train host 0", "train host 1"]
+    # straggler SLO: degraded with the culprit named, auto-recovered
+    # once both hosts republished healthy digests
+    assert r0["straggler_breach"]["status"] == "degraded"
+    assert r0["straggler_breach"]["violated"] == ["straggler_ratio"]
+    assert r0["straggler_breach"]["culprit"] == {"host": "1",
+                                                 "phase": "dispatch"}
+    assert r0["straggler_recovered"]["status"] == "ok"
+    assert r0["straggler_recovered"]["violated"] == []
+
 
 def test_orbax_restore_across_mesh_shape_change(tmp_path, devices8):
     """Elastic resume must re-place a checkpoint saved on one mesh layout

@@ -91,6 +91,15 @@ class TestYoloZoo:
         lab = yolo_labels(2, 2, 2, 3)     # 64 / 2^5 = 2 grid
         net.fit(x, lab)
         assert np.isfinite(float(net.score()))
+        # graph-level getPredictedObjects delegation on the same built
+        # net (keeps the detection convenience tier-1 now that the
+        # bigger YOLO2 twin runs in the slow lane)
+        dets = net.getPredictedObjects(x, confThreshold=0.0,
+                                       nmsThreshold=0.5)
+        assert len(dets) == 2
+        for d in dets[0]:
+            assert 0.0 <= d.centerX <= 2.0 and 0.0 <= d.centerY <= 2.0
+            assert 0 <= d.getPredictedClass() < 3
 
     @pytest.mark.slow   # suite diet (ISSUE 13): ~12 s zoo build —
     # YOLO2 coverage stays tier-1 via the graph/getPredictedObjects test
@@ -241,6 +250,10 @@ class TestDetectionOutput:
 
 
 class TestGraphDetection:
+    @pytest.mark.slow   # suite diet: ~29 s YOLO2 build — the graph
+    # getPredictedObjects delegation stays tier-1 via the TinyYOLO net
+    # in test_tinyyolo_trains; YOLO2 build coverage rides the (slow)
+    # passthrough test above
     def test_yolo2_graph_getPredictedObjects(self):
         """ComputationGraph twin of the detection convenience: the YOLO2
         zoo model (graph with Yolo2OutputLayer head) emits DetectedObject

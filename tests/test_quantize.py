@@ -335,6 +335,9 @@ def test_fused_conv_bn_eval_epilogue():
 
 
 # ===================== selective recompute ============================
+@pytest.mark.slow   # suite diet: ~13 s (grad-compiles BOTH the plain
+# and rematted graph); remat stays tier-1 via the training-step and
+# layers-policy tests below — this is the bit-equality oracle only
 def test_remat_blocks_gradients_equal():
     plain = _residual_graph("none")
     remat = _residual_graph("blocks")

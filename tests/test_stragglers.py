@@ -1,0 +1,313 @@
+"""Distributed training observability (ISSUE 16): per-host step
+timelines over the coordination KV, process-0 straggler attribution
+(slowest host AND phase), the derived exchange-exposure estimate, the
+training SLO objectives, and the /stragglers + cluster-aware /steps +
+per-host /trace lane endpoints — all exercised in-process over LocalKV
+coordinator pairs (the two-REAL-process version rides
+tests/multihost_worker.py).
+"""
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu import monitoring as mon
+from deeplearning4j_tpu.monitoring import requests as reqmod
+from deeplearning4j_tpu.monitoring import slo
+from deeplearning4j_tpu.monitoring import steps as steps_mod
+from deeplearning4j_tpu.monitoring import stragglers
+from deeplearning4j_tpu.parallel import coordination as coord_mod
+from deeplearning4j_tpu.parallel.coordination import (LocalKV,
+                                                      PeerCoordinator)
+
+
+@pytest.fixture(autouse=True)
+def _stragglers_clean():
+    """Clean process-global switches around every test: monitoring off,
+    empty flight recorder, no SLO tracker, no coordinator."""
+    mon.disable()
+    steps_mod.recorder().clear()
+    reqmod.log().clear()
+    slo.clear_tracker()
+    yield
+    mon.disable()
+    mon.get_tracer().clear()
+    steps_mod.recorder().clear()
+    reqmod.log().clear()
+    slo.clear_tracker()
+    coord_mod.clear_coordinator()
+
+
+def _pair(sync_every=1):
+    kv = LocalKV()
+    return [PeerCoordinator(sync_every=sync_every, peer_timeout=5.0,
+                            client=kv, process_id=i, num_processes=2)
+            for i in (0, 1)]
+
+
+def _feed(rec, data_ms=1.0, dispatch_ms=5.0, steps=4):
+    for _ in range(steps):
+        rec.on_span("fit.data_next", data_ms)
+        rec.on_span("sharded.dispatch", dispatch_ms)
+
+
+def _publish_pair(c0, c1, slow_dispatch_ms=60.0, fast_dispatch_ms=5.0):
+    """Host 0 fast, host 1 slow in the dispatch phase — two separate
+    recorders standing in for two processes' rings."""
+    fast, slow = steps_mod.StepRecorder(), steps_mod.StepRecorder()
+    _feed(fast, dispatch_ms=fast_dispatch_ms)
+    _feed(slow, data_ms=2.0, dispatch_ms=slow_dispatch_ms)
+    stragglers.publish(c0, recorder=fast)
+    stragglers.publish(c1, recorder=slow)
+
+
+# ===================== the publishable digest ==========================
+def test_compact_summary_shape_and_json_roundtrip():
+    rec = steps_mod.StepRecorder()
+    _feed(rec, steps=6)
+    d = rec.compact_summary(tail=3)
+    # JSON-serializable by construction — the KV publish is json.dumps
+    d2 = json.loads(json.dumps(d))
+    assert d2["count"] == 6
+    assert set(d2["phases"]) == {"data_next", "dispatch"}
+    for v in d2["phases"].values():
+        assert set(v) == {"p50", "p99", "mean", "count"}
+    assert d2["phases"]["dispatch"]["p50"] == 5.0
+    assert len(d2["tail"]) == 3
+    assert [r["step"] for r in d2["tail"]] == [4, 5, 6]
+    for r in d2["tail"]:
+        assert set(r) == {"step", "ts", "wall_ms", "phases"}
+
+
+def test_exchange_phase_joins_the_attribution_sum():
+    assert "exchange" in steps_mod.SUM_PHASES
+    assert steps_mod.PHASE_BY_SPAN["train.exchange"] == "exchange"
+    rec = steps_mod.StepRecorder()
+    rec.on_span("fit.data_next", 1.0)
+    rec.on_span("train.exchange", 7.0)
+    rec.on_span("sharded.dispatch", 2.0)
+    assert rec.records()[-1]["phases"]["exchange"] == 7.0
+
+
+# ===================== publish / gather over the KV ====================
+def test_publish_gather_roundtrip():
+    c0, c1 = _pair()
+    rec = steps_mod.StepRecorder()
+    _feed(rec)
+    snap = stragglers.publish(c0, recorder=rec,
+                              extra={"steps_per_s": 3.5})
+    assert snap["steps_per_s"] == 3.5
+    stragglers.publish(c1, recorder=rec)
+    got = stragglers.gather(c0)
+    assert sorted(got) == [0, 1]
+    assert got[0]["timeline"]["phases"]["dispatch"]["p50"] == 5.0
+    assert got[0]["steps_per_s"] == 3.5
+    # overwrite: republishing keeps one bounded key per host
+    stragglers.publish(c0, recorder=rec)
+    assert sorted(stragglers.gather(c1)) == [0, 1]
+
+
+def test_sync_point_publishes_timeline_only_when_enabled():
+    """The coordination sync point carries the timeline publish behind
+    the SAME enabled-guard as the cluster metrics plane."""
+    import threading
+
+    def drive(cs, steps):
+        errs = []
+
+        def run(c):
+            try:
+                for _ in range(steps):
+                    c.on_step()
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=run, args=(c,)) for c in cs]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert errs == []
+
+    cs = _pair()
+    drive(cs, 1)
+    assert stragglers.gather(cs[0]) == {}      # disabled: no publish
+    mon.enable()
+    drive(cs, 1)
+    got = stragglers.gather(cs[0])
+    assert sorted(got) == [0, 1]
+    assert "timeline" in got[0] and "steps_per_s" in got[0]
+
+
+# ===================== attribution =====================================
+def test_attribution_names_slowest_host_and_phase():
+    c0, c1 = _pair()
+    _publish_pair(c0, c1)
+    att = stragglers.attribution(c0)
+    assert sorted(att["hosts"]) == ["0", "1"]
+    assert att["published"] == 2
+    # lower median of 2 hosts = the fast one → ratio is max/min
+    assert att["ratio"] == pytest.approx(62.0 / 6.0, rel=1e-3)
+    assert att["slowest"]["host"] == "1"
+    assert att["slowest"]["phase"] == "dispatch"
+    assert att["slowest"]["excess_ms"] == pytest.approx(55.0)
+    assert att["hosts"]["1"]["step_ms"] == pytest.approx(62.0)
+    assert att["hosts"]["0"]["snapshot_age_s"] >= 0
+
+
+def test_attribution_inconclusive_below_two_hosts():
+    c0, _ = _pair()
+    rec = steps_mod.StepRecorder()
+    _feed(rec)
+    stragglers.publish(c0, recorder=rec)
+    att = stragglers.attribution(c0)
+    assert att["published"] == 1
+    assert att["ratio"] is None and att["slowest"] is None
+
+
+def test_attribution_sets_gauges_on_process0_when_enabled():
+    c0, c1 = _pair()
+    _publish_pair(c0, c1)
+    # disabled: the verdict computes but no gauge traffic
+    stragglers.attribution(c0)
+    assert mon.get_registry().get(
+        mon.DIST_STRAGGLER_RATIO,
+        {"host": "1", "phase": "dispatch"}) is None
+    mon.enable()
+    stragglers.attribution(c0)
+    g = mon.get_registry().get(mon.DIST_STRAGGLER_RATIO,
+                               {"host": "1", "phase": "dispatch"})
+    assert g is not None and g.value == pytest.approx(62.0 / 6.0,
+                                                      rel=1e-3)
+    skew = mon.get_registry().get(mon.DIST_STRAGGLER_SKEW_MS,
+                                  {"host": "1", "phase": "dispatch"})
+    assert skew.value == pytest.approx(56.0)   # 62 - 6
+    # process 1 never publishes the fleet verdict
+    before = mon.get_registry().get(mon.DIST_STRAGGLER_RATIO,
+                                    {"host": "1", "phase": "dispatch"})
+    v = before.value
+    stragglers.attribution(c1)
+    assert before.value == v
+
+
+def test_derived_exchange_exposure_from_dispatch_skew():
+    c0, c1 = _pair()
+    assert stragglers.derived_exchange_ms(c0) is None   # nobody published
+    _publish_pair(c0, c1, slow_dispatch_ms=60.0, fast_dispatch_ms=5.0)
+    assert stragglers.derived_exchange_ms(c0) == pytest.approx(55.0)
+
+
+def test_peer_table_and_snapshot_carry_straggler_columns():
+    c0, c1 = _pair()
+    _publish_pair(c0, c1)
+    mon.enable()
+    table = c0.peer_table()
+    assert table[0]["step_ms_p50"] == pytest.approx(6.0)
+    assert table[1]["step_ms_p50"] == pytest.approx(62.0)
+    assert table[1]["straggler"]["phase"] == "dispatch"
+    assert "straggler" not in table[0]
+    snap = c0.snapshot()
+    assert snap["stragglers"]["slowest"]["host"] == "1"
+    # process 1 is not the serving end
+    assert "stragglers" not in c1.snapshot()
+
+
+# ===================== SLO objectives ==================================
+def test_straggler_objective_breach_culprit_and_recovery():
+    c0, c1 = _pair()
+    obj = slo.StragglerObjective("straggler_ratio", max_ratio=2.0,
+                                 coordinator=c0)
+    assert obj.measure() is None               # nothing published yet
+    _publish_pair(c0, c1)
+    assert obj.measure() is True
+    d = obj.describe()
+    assert d["culprit"] == {"host": "1", "phase": "dispatch"}
+    assert d["last_value"] == pytest.approx(62.0 / 6.0, rel=1e-3)
+    # slowdown clears → met
+    _publish_pair(c0, c1, slow_dispatch_ms=5.0)
+    assert obj.measure() is False
+
+
+def test_straggler_objective_finds_active_coordinator():
+    c0, c1 = _pair()
+    _publish_pair(c0, c1)
+    obj = slo.StragglerObjective("straggler_ratio", max_ratio=2.0)
+    assert obj.measure() is None               # no ACTIVE coordinator
+    c0.install()
+    try:
+        assert obj.measure() is True
+    finally:
+        c0.uninstall()
+
+
+def test_step_time_objective_reads_the_flight_recorder():
+    obj = slo.StepTimeObjective("step_p99", max_ms=1000.0)
+    assert obj.measure() is None               # empty ring
+    # one closed step whose wall ≈ its only span's duration
+    steps_mod.recorder().on_span("sharded.dispatch", 50.0)
+    assert obj.measure() is False
+    assert 0 < obj.last_value < 1000.0
+    tight = slo.StepTimeObjective("step_p50", max_ms=1e-6, quantile=0.5)
+    assert tight.measure() is True
+
+
+def test_standard_objectives_training_knobs(monkeypatch):
+    assert slo.standard_objectives() == []
+    objs = slo.standard_objectives(step_p99_ms=800.0,
+                                   straggler_ratio=2.5)
+    assert [o.name for o in objs] == ["step_p99", "straggler_ratio"]
+    assert objs[0].threshold == 800.0 and objs[1].threshold == 2.5
+    monkeypatch.setenv("DL4J_SLO_STEP_P99_MS", "600")
+    monkeypatch.setenv("DL4J_SLO_STRAGGLER_RATIO", "3")
+    names = [o.name for o in slo.standard_objectives()]
+    assert names == ["step_p99", "straggler_ratio"]
+
+
+# ===================== endpoints + trace lanes =========================
+def test_stragglers_steps_and_trace_endpoints():
+    from deeplearning4j_tpu.ui.server import UIServer
+    mon.enable()
+    c0, c1 = _pair()
+    _publish_pair(c0, c1)
+    c0.install()
+    server = UIServer.getInstance()
+    server.start(port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        att = json.load(urllib.request.urlopen(base + "/stragglers",
+                                               timeout=10))
+        assert att["slowest"]["host"] == "1"
+        assert att["slowest"]["phase"] == "dispatch"
+        assert sorted(att["hosts"]) == ["0", "1"]
+        # /steps on process 0 carries every host's timeline digest
+        doc = json.load(urllib.request.urlopen(base + "/steps",
+                                               timeout=10))
+        assert sorted(doc["hosts"]) == ["0", "1"]
+        assert doc["hosts"]["1"]["phases"]["dispatch"]["p50"] \
+            == pytest.approx(60.0)
+        assert "summary" in doc and "records" in doc
+        # /trace gains one named training lane per host
+        t = json.load(urllib.request.urlopen(base + "/trace",
+                                             timeout=10))
+        lanes = sorted(e["args"]["name"] for e in t["traceEvents"]
+                       if e["ph"] == "M" and e["name"] == "process_name"
+                       and str(e["args"].get("name", "")
+                               ).startswith("train host"))
+        assert lanes == ["train host 0", "train host 1"]
+        slices = [e for e in t["traceEvents"]
+                  if e.get("cat") == "train" and e["ph"] == "X"]
+        assert slices and all(e["pid"] >= stragglers.LANE_BASE
+                              for e in slices)
+        # without a coordinator, /stragglers is a 404 (single-process
+        # runs have no peers to skew against) and /steps drops "hosts"
+        c0.uninstall()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/stragglers", timeout=10)
+        assert ei.value.code == 404
+        doc = json.load(urllib.request.urlopen(base + "/steps",
+                                               timeout=10))
+        assert "hosts" not in doc
+    finally:
+        server.stop()
+        c0.uninstall()

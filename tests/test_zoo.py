@@ -22,10 +22,9 @@ def _onehot(n, k, seed=0):
 
 
 # (model ctor, input shape HWC, numClasses) — shapes shrunk for CPU.
-# Darknet19 and Xception (the suite's two slowest non-slow tests at
-# ~12.6 s each, tier-1 diet) run in the slow set; the equally-shaped
-# SqueezeNet/InceptionResNetV1 rows keep the graph-model coverage in
-# the fast lane.
+# Darknet19, Xception and SqueezeNet (~13-15 s builds each, tier-1
+# diet) run in the slow set; the equally-shaped InceptionResNetV1 row
+# keeps the graph-model coverage in the fast lane.
 SMALL_MODELS = [
     (lambda: LeNet(numClasses=10), (28, 28, 1), 10),
     (lambda: SimpleCNN(numClasses=5, inputShape=(32, 32, 3)), (32, 32, 3), 5),
@@ -33,8 +32,9 @@ SMALL_MODELS = [
     pytest.param(
         lambda: Darknet19(numClasses=6, inputShape=(64, 64, 3)),
         (64, 64, 3), 6, marks=pytest.mark.slow),
-    (lambda: SqueezeNet(numClasses=4, inputShape=(64, 64, 3)),
-     (64, 64, 3), 4),
+    pytest.param(
+        lambda: SqueezeNet(numClasses=4, inputShape=(64, 64, 3)),
+        (64, 64, 3), 4, marks=pytest.mark.slow),
     pytest.param(
         lambda: Xception(numClasses=4, inputShape=(64, 64, 3),
                          middleFlowBlocks=1), (64, 64, 3), 4,
