@@ -25,6 +25,16 @@ assertion (bucket k's collective scheduled before bucket k+1's encode
 — `parallel.buckets.check_overlap_structure`). Also reports the
 per-bucket encoded-bytes ledger from the encoder state.
 
+The **sparse-wire arm** (ISSUE 17) measures the ragged wire format
+against the dense pmean baseline at the MEASURED nnz: per-worker
+per-bucket wire bytes ((capacity + header) int32 slots vs 4 bytes per
+element dense), the nnz ledger those bytes track, and the wall cost of
+an elastic re-form (mid-run JOIN: drain save + leader commit + mesh
+rebuild 4→8 devices + encoder re-stack + re-place). Headline `value`
+is the dense/wire byte ratio (higher = fewer bytes on the wire);
+`scripts/check_bench_regression.py` gates successive MULTIHOST_*
+artifacts on it.
+
 Run:  JAX_PLATFORMS=cpu python bench_multihost.py
 """
 import argparse
@@ -194,6 +204,116 @@ def _bench_arms(g):
     }
 
 
+def _bench_sparse_wire(wire_capacity=0.05, steps=8):
+    """Sparse ragged wire vs the dense pmean baseline at the measured
+    nnz, on the same bucketed MLP: the dense exchange moves 4 bytes per
+    PARAMETER per worker per step regardless of sparsity; the sparse
+    wire moves (capacity + header) int32 slots per bucket — sized to
+    the nnz ledger, not the parameter count."""
+    import jax
+
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.parallel.multihost import (MultiHostTrainer,
+                                                       global_batch)
+    tr = MultiHostTrainer(
+        _loss_fn, Sgd(0.05), compress=True, buckets=NUM_BUCKETS,
+        wire="sparse", wire_capacity=wire_capacity,
+        compression_kw={"initial_threshold": 1e-4})
+    p, s = tr.init(_init_params())
+    xs, ys = _micro_batches(1)
+    batch = global_batch(tr.mesh, {"x": xs[0], "y": ys[0]})
+    key = jax.random.PRNGKey(0)
+    for n in range(steps):
+        p, s, loss = tr.fit_batch(p, s, batch, jax.random.fold_in(key, n))
+    jax.block_until_ready(loss)
+    ledger = tr.encoder_stats(s)
+    return {
+        "wire_capacity_frac": wire_capacity,
+        "wire_capacity_tokens": ledger["wire_capacity"],
+        "nnz": ledger["nnz"],
+        "nnz_wire_cost_bytes": ledger["encoded_bytes"],
+        "wire_bytes": ledger["wire_bytes"],
+        "dense_bytes": ledger["dense_bytes"],
+        "dense_over_wire": round(
+            ledger["dense_bytes"] / ledger["wire_bytes"], 2),
+        "bucket_wire_bytes": ledger["bucket_wire_bytes"],
+    }
+
+
+def _bench_elastic_reform():
+    """Wall cost of one mid-run JOIN re-form (drain save + leader
+    commit + trainer rebuild on the widened 4→8-device mesh + encoder
+    re-stack + re-place), measured around the runner's own `_reform` on
+    the live coordination-KV flow."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.parallel.multihost import (ElasticMembership,
+                                                       LocalKV,
+                                                       MultiHostRunner,
+                                                       MultiHostTrainer,
+                                                       PeerCoordinator,
+                                                       global_batch)
+    from jax.sharding import Mesh
+
+    def mesh_factory(members):
+        return Mesh(np.array(jax.devices()[:4 * len(members)]), ("dp",))
+
+    kv, tmp = LocalKV(), tempfile.mkdtemp()
+    c0 = PeerCoordinator(sync_every=2, peer_timeout=8.0, client=kv,
+                         process_id=0, num_processes=1, dump_dir=tmp)
+    tr = MultiHostTrainer(_loss_fn, Sgd(0.05), compress=True,
+                          mesh=mesh_factory([0]), buckets=NUM_BUCKETS,
+                          compression_kw={"initial_threshold": 1e-4})
+    runner = MultiHostRunner(tr, tmp + "/ck", c0, save_every=100,
+                             elastic=True, mesh_factory=mesh_factory,
+                             monitor=False, sigterm=False)
+    p, s = runner.resume_or_init(_init_params())
+    xs, ys = _micro_batches(1)
+    key = jax.random.PRNGKey(0)
+
+    reform_ms = []
+    orig = runner._reform
+
+    def timed(*a, **k):
+        t0 = time.perf_counter()
+        out = orig(*a, **k)
+        reform_ms.append((time.perf_counter() - t0) * 1000.0)
+        return out
+
+    runner._reform = timed
+
+    def joiner():
+        c1 = PeerCoordinator(sync_every=2, peer_timeout=12.0, client=kv,
+                             process_id=1, num_processes=1, dump_dir=tmp)
+        m1 = ElasticMembership(c1, members=[1])
+        m1.announce_join()
+        info = m1.await_admission(timeout=30.0)
+        c1.step, c1.rounds = int(info["cstep"]), int(info["rounds"])
+        # the runner drives 4 more fit_batch after the step-2 re-form
+        # (sync_every=2 → 2 rounds): pump exactly those, or the runner
+        # times out on a missing heartbeat and spuriously replaces us
+        for _ in range(4):
+            c1.on_step()
+
+    t = threading.Thread(target=joiner)
+    t.start()
+    time.sleep(0.3)      # let the announcement land before step 1
+    for n in range(6):   # the join lands at the first sync boundary
+        batch = global_batch(runner.trainer.mesh,
+                             {"x": xs[0], "y": ys[0]})
+        p, s, _ = runner.fit_batch(p, s, batch,
+                                   jax.random.fold_in(key, n))
+    t.join(timeout=60)
+    runner.close()
+    assert reform_ms, "the join never re-formed — bench harness bug"
+    return {"join_reform_ms": round(reform_ms[0], 1),
+            "dp_after": int(s["encoder"]["threshold"].shape[0])}
+
+
 def run():
     import jax
     result = {
@@ -204,6 +324,13 @@ def run():
     }
     for g in G_VALUES:
         result[f"g{g}"] = _bench_arms(g)
+    result["sparse_wire"] = _bench_sparse_wire()
+    result["elastic_reform"] = _bench_elastic_reform()
+    # flat-local artifact headline for check_bench_regression.py: the
+    # dense/wire byte ratio at the measured nnz (higher is better)
+    result["value"] = result["sparse_wire"]["dense_over_wire"]
+    result["metric"] = "dense_bytes / sparse_wire_bytes"
+    result["unit"] = "x"
     return result
 
 
@@ -221,6 +348,11 @@ def main():
         if not arm["overlap_structure_ok"]:
             bad.append(f"g{g} overlap structure: "
                        + "; ".join(arm["overlap_problems"]))
+    sw = result["sparse_wire"]
+    if sw["wire_bytes"] >= sw["dense_bytes"]:
+        bad.append(f"sparse wire moved {sw['wire_bytes']} bytes ≥ dense "
+                   f"{sw['dense_bytes']} — the ragged format lost its "
+                   f"reason to exist")
     if bad:
         raise SystemExit("bench targets missed: " + " | ".join(bad))
 

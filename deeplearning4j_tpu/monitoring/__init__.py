@@ -80,6 +80,7 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     DIST_BARRIER_TIMEOUTS, DIST_ENCODED_BYTES, DIST_RESIDUAL_NORM,
     DIST_ACCUM_MICROBATCHES, DIST_EXCHANGE_BUCKETS, DIST_BUCKET_BYTES,
     DIST_EXPOSED_EXCHANGE_MS, DIST_ENCODER_MIGRATIONS,
+    DIST_REFORMS_AGREED, DIST_REFORMS, DIST_REFORM_MS, DIST_WIRE_BYTES,
     DIST_STRAGGLER_RATIO, DIST_STRAGGLER_SKEW_MS,
     PIPELINE_SYNCS, PIPELINE_HOST_BLOCKED_MS, PIPELINE_PREFETCH_DEPTH,
     PIPELINE_STAGED_BATCHES,
@@ -139,6 +140,8 @@ __all__ = [
     "DIST_ACCUM_MICROBATCHES", "DIST_EXCHANGE_BUCKETS",
     "DIST_BUCKET_BYTES", "DIST_EXPOSED_EXCHANGE_MS",
     "DIST_ENCODER_MIGRATIONS",
+    "DIST_REFORMS_AGREED", "DIST_REFORMS", "DIST_REFORM_MS",
+    "DIST_WIRE_BYTES",
     "DIST_STRAGGLER_RATIO", "DIST_STRAGGLER_SKEW_MS",
     "PIPELINE_SYNCS", "PIPELINE_HOST_BLOCKED_MS", "PIPELINE_PREFETCH_DEPTH",
     "PIPELINE_STAGED_BATCHES",

@@ -120,6 +120,13 @@ DIST_EXCHANGE_BUCKETS = "dl4j.dist.exchange_buckets"
 DIST_BUCKET_BYTES = "dl4j.dist.bucket_bytes"
 DIST_EXPOSED_EXCHANGE_MS = "dl4j.dist.exposed_exchange_ms"
 DIST_ENCODER_MIGRATIONS = "dl4j.dist.encoder_migrations"
+# elastic membership (parallel/membership.py): agreed membership
+# changes, executed mesh re-forms (labels: kind=join|leave|replace) and
+# the wall cost of the last re-form (drain save + rebuild + re-place)
+DIST_REFORMS_AGREED = "dl4j.dist.reforms_agreed"
+DIST_REFORMS = "dl4j.dist.reforms"
+DIST_REFORM_MS = "dl4j.dist.reform_ms"
+DIST_WIRE_BYTES = "dl4j.dist.wire_bytes"
 # straggler attribution (monitoring/stragglers.py): process 0 computes
 # per-step skew across the published per-host step timelines and names
 # the slowest host AND phase — the labels on these gauges ARE the

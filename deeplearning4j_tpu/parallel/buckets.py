@@ -180,8 +180,11 @@ def plan_buckets(tree, num_buckets=None, bucket_bytes=None):
 
 
 # ===================== HLO structural overlap check =====================
+# dense wire rides all-reduce; the sparse token wire rides all-gather —
+# both count as "the bucket's collective" for the overlap structure
 _COLLECTIVE_RE = re.compile(
-    r"=\s+\S+\s+(all-reduce-start|all-reduce)\(")
+    r"=\s+\S+\s+(all-reduce-start|all-reduce"
+    r"|all-gather-start|all-gather)\(")
 
 
 def _entry_lines(hlo_text):

@@ -72,6 +72,155 @@ def threshold_encoding(initial_threshold=DEFAULT_INITIAL_THRESHOLD,
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+# -- sparse ragged wire format (ISSUE 17) -----------------------------------
+#
+# The dense exchange pmean's a {−t,0,+t} tensor the size of the bucket; the
+# reference stack (EncodedGradientsAccumulator over Aeron) ships messages
+# whose size tracks nnz instead. Wire layout per worker per bucket, one
+# int32 vector of `capacity + 2` elements:
+#
+#   [ count | threshold_bits | tok_0 ... tok_{K-1} ]
+#
+#   count          shipped element count (<= capacity)
+#   threshold_bits the f32 threshold bit-cast to int32 (receiver needs t
+#                  to reconstruct ±t values)
+#   tok            (index+1) * sign for each shipped element; 0 = empty
+#                  slot. The +1 bias keeps index 0 representable with a
+#                  sign.
+#
+# Size-prefixed in the header, fixed capacity on the wire so the allgather
+# stays a static-shape collective (jit-compatible ragged-ness: the payload
+# is ragged in *meaning* — trailing zero slots — not in shape). Decode
+# scatters with mode='drop', so a corrupt out-of-range token can never
+# write out of bounds; structural corruption (bad count, nonsense
+# threshold, out-of-range index) poisons the delivered gradient to NaN so
+# the guardian gates the step — never a silent wrong-gradient.
+
+#: header slots in front of the token array: [count, threshold_bits]
+WIRE_HEADER = 2
+
+
+def wire_capacity(elems, frac):
+    """Per-bucket token capacity: `frac` of the bucket's elements, at least
+    1, never more than the bucket itself. Host-side, static per plan."""
+    return max(1, min(int(elems), int(-(-elems * frac // 1))))
+
+
+def wire_payload_bytes(capacity):
+    """Per-worker wire bytes for one bucket at the given capacity."""
+    return (int(capacity) + WIRE_HEADER) * 4
+
+
+def sparse_encode(flat, state, capacity, min_threshold=1e-5,
+                  decay=0.95, boost=1.2, target_sparsity=1e-3):
+    """Encode one worker's bucket gradient into (payload, new_state).
+
+    The residual/threshold math is the dense encoder's
+    (`threshold_encoding`), op for op: as long as nnz <= capacity the
+    shipped set equals the dense mask, the residual update is identical,
+    and the adaptive-threshold rule keys off the TRUE mask count — so
+    dense and sparse state trajectories match bit-exactly whenever
+    nothing overflows. On overflow the first `capacity` above-threshold
+    elements ship and the rest stay in the residual (shipped next step
+    after the threshold boosts), so the wire never lies about what was
+    delivered."""
+    elems = flat.size
+    thr = state["threshold"]
+    acc = flat + state["residual"]
+    mask = jnp.abs(acc) >= thr
+    dense_sent = jnp.where(mask, jnp.sign(acc) * thr, 0.0).astype(flat.dtype)
+    nnz = jnp.sum(jnp.abs(dense_sent) > 0)
+
+    idx = jnp.nonzero(mask, size=capacity, fill_value=elems)[0]
+    idx = idx.astype(jnp.int32)
+    vals = jnp.take(dense_sent, idx, mode="fill", fill_value=0)
+    # what actually ships (== dense_sent unless capacity overflowed)
+    sent = jnp.zeros_like(flat).at[idx].add(vals, mode="drop")
+    new_r = acc - sent
+
+    frac = nnz / elems
+    new_thr = jnp.where(frac < target_sparsity, thr * decay,
+                        jnp.where(frac > 50 * target_sparsity,
+                                  thr * boost, thr))
+    new_thr = jnp.maximum(new_thr, min_threshold)
+
+    sgn = jnp.where(vals > 0, 1, jnp.where(vals < 0, -1, 0)).astype(jnp.int32)
+    tok = (idx + 1) * sgn
+    count = jnp.sum(sgn != 0).astype(jnp.int32)
+    thr_bits = jax.lax.bitcast_convert_type(
+        thr.astype(jnp.float32), jnp.int32)
+    payload = jnp.concatenate([count[None], thr_bits[None], tok])
+    return payload, {"residual": new_r, "threshold": new_thr,
+                     "nnz": nnz.astype(jnp.int32)}
+
+
+def _decode_row(row, elems, dtype):
+    """One worker's payload -> its dense {−t,0,+t} contribution (bit-equal
+    to what that worker's dense encoder would have produced), NaN-poisoned
+    if the message is structurally corrupt."""
+    count, thr_bits, tok = row[0], row[1], row[WIRE_HEADER:]
+    thr = jax.lax.bitcast_convert_type(thr_bits, jnp.float32)
+    valid = tok != 0
+    idx = jnp.where(valid, jnp.abs(tok) - 1, elems)
+    sgn = jnp.sign(tok).astype(jnp.float32)
+    vals = jnp.where(valid, (sgn * thr).astype(dtype), 0).astype(dtype)
+    out = jnp.zeros((elems,), dtype).at[idx].add(vals, mode="drop")
+    ok = ((count == jnp.sum(valid))
+          & (count <= tok.shape[0])
+          & jnp.isfinite(thr) & (thr > 0)
+          & jnp.all(jnp.where(valid, idx < elems, True)))
+    return jnp.where(ok, out, jnp.full((), jnp.nan, dtype))
+
+
+def sparse_decode(gathered, elems, dtype):
+    """Decode-and-accumulate the allgathered payloads (num_workers,
+    capacity+2) into the mean delivered gradient.
+
+    The accumulation is an explicit linear chain in worker order — on this
+    backend that reproduces `jax.lax.pmean`'s reduction order bit-for-bit
+    (asserted by the tier-1 wire tests), which is what keeps the sparse
+    exchange bit-identical to the dense one at fixed membership.
+    """
+    n = gathered.shape[0]
+    acc = _decode_row(gathered[0], elems, dtype)
+    for w in range(1, n):
+        acc = acc + _decode_row(gathered[w], elems, dtype)
+    return acc / n
+
+
+def check_payload(payload, elems, capacity=None):
+    """Host-side structural validation of one wire message; raises the
+    typed `WireFormatError` naming the violation. Used by the recovery /
+    chaos paths — the hot decode stays in-jit and poisons instead."""
+    import numpy as np
+
+    from deeplearning4j_tpu.resilience.errors import WireFormatError
+
+    p = np.asarray(payload)
+    if p.ndim != 1 or p.size < WIRE_HEADER:
+        raise WireFormatError(
+            f"truncated wire message: {p.size} slots < header {WIRE_HEADER}")
+    if capacity is not None and p.size != capacity + WIRE_HEADER:
+        raise WireFormatError(
+            f"wire message size {p.size} != capacity {capacity} + header")
+    count = int(p[0])
+    thr = float(np.frombuffer(
+        np.asarray(p[1], np.int32).tobytes(), np.float32)[0])
+    tok = p[WIRE_HEADER:]
+    nz = int(np.count_nonzero(tok))
+    if count != nz:
+        raise WireFormatError(
+            f"wire count field {count} != {nz} non-empty tokens")
+    if not np.isfinite(thr) or thr <= 0:
+        raise WireFormatError(f"wire threshold {thr!r} not a positive float")
+    idx = np.abs(tok[tok != 0]) - 1
+    if idx.size and int(idx.max()) >= elems:
+        raise WireFormatError(
+            f"wire token index {int(idx.max())} out of range for "
+            f"{elems}-element bucket")
+    return count, thr
+
+
 def encoder_stats(enc_state):
     """Device-scalar wire telemetry for a (possibly per-worker-stacked)
     threshold-encoding state: mean adaptive threshold, total elements

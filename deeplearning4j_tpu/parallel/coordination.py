@@ -54,8 +54,8 @@ from deeplearning4j_tpu.resilience.errors import (PeerDesyncError,
                                                   PreemptionSignal)
 
 __all__ = ["ACTIVE", "LocalKV", "PeerCoordinator", "PeerMonitor",
-           "clear_coordinator", "default_peer_timeout",
-           "install_preemption_handler"]
+           "PREEMPT", "REFORM", "clear_coordinator",
+           "default_peer_timeout", "install_preemption_handler"]
 
 #: THE switch the trainer hot hooks check (faults.py pattern). None →
 #: coordination off (the permanent state in single-host runs).
@@ -63,6 +63,10 @@ ACTIVE = None
 
 #: decision constants a driving runner consumes via `take_decision()`
 PREEMPT = "preempt"
+#: membership change agreed (join/leave announcements in the round's
+#: heartbeat union): the driving runner re-forms the dp mesh at this
+#: step boundary. PREEMPT takes precedence when both arise in one round.
+REFORM = "reform"
 
 
 def default_peer_timeout():
@@ -190,6 +194,13 @@ class PeerCoordinator:
                               else jax.process_index())
         self.num_processes = int(num_processes if num_processes is not None
                                  else jax.process_count())
+        #: the ACTIVE roster — every gather/agreement/autopsy loop walks
+        #: this, never `range(num_processes)`. Fixed-membership runs
+        #: keep the full range; an attached `ElasticMembership` rewrites
+        #: it through `reform()` at agreed boundaries.
+        self.members = list(range(self.num_processes))
+        self.membership = None     # ElasticMembership attaches itself
+        self._pending_reform = None  # (joins, leaves) behind a REFORM
         self.ns = namespace
         self.dump_dir = dump_dir
         self._clock = clock
@@ -281,7 +292,12 @@ class PeerCoordinator:
         t = self.barrier_timeout if timeout is None else float(timeout)
         kw = {}
         if isinstance(self._client, LocalKV):
-            kw["expected"] = self.num_processes
+            kw["expected"] = len(self.members)
+        elif set(self.members) != set(range(self.num_processes)):
+            # elastic roster: scope the fence to the ACTIVE members so a
+            # departed host can never be waited on (the service default
+            # would expect every launched process)
+            kw["process_ids"] = list(self.members)
         try:
             self._client.wait_at_barrier(self._key(f"barrier/{name}"),
                                          int(t * 1000), **kw)
@@ -292,7 +308,7 @@ class PeerCoordinator:
                     help="cross-process barriers that timed out").inc()
             raise self._peer_lost_error(
                 f"barrier {name!r} not reached by all "
-                f"{self.num_processes} processes within {t:.1f} s",
+                f"{len(self.members)} members within {t:.1f} s",
                 cause=e) from e
 
     # -- preemption ------------------------------------------------------
@@ -314,6 +330,36 @@ class PeerCoordinator:
         mirror of TrainingGuardian.take_action()."""
         d, self._decision = self._decision, None
         return d
+
+    def take_reform(self):
+        """Return-and-clear the (joins, leaves) delta behind the last
+        REFORM decision — the runner consumes this right after
+        `take_decision()` returned REFORM."""
+        r, self._pending_reform = self._pending_reform, None
+        return r
+
+    def reform(self, members):
+        """Adopt a NEW member roster at an agreed boundary: every
+        subsequent gather / barrier / autopsy walks the new list, and
+        stale per-peer bookkeeping for departed pids is dropped so a
+        replaced host re-joining under the same pid starts clean."""
+        members = sorted(int(p) for p in members)
+        if not members:
+            raise ValueError("reform: empty member roster")
+        self.members = members
+        keep = set(members)
+        self._lost = {p: v for p, v in self._lost.items() if p in keep}
+        self._peers = {p: v for p, v in self._peers.items() if p in keep}
+        self._beat_obs = {p: v for p, v in self._beat_obs.items()
+                          if p in keep}
+        if self._monitor is not None:
+            self._monitor._tripped &= keep
+        if _mon.enabled():
+            _mon.get_registry().gauge(
+                _mon.DIST_PEERS,
+                help="peer processes seen at the last sync point") \
+                .set(len(members))
+        return self
 
     def bind(self, trainer):
         """Scope step counting to `trainer`: while bound, ONLY calls
@@ -365,11 +411,21 @@ class PeerCoordinator:
               "preempt": bool(self._preempt_requested),
               "reason": self._preempt_reason,
               "steps_per_s": rate}
+        if self.membership is not None:
+            # this process's VIEW of pending join/leave announcements —
+            # the agreed delta is the UNION over the round's write-once
+            # heartbeat set, so every member reaches the same REFORM
+            # decision even when announcements land mid-round
+            mj, ml = self.membership.pending()
+            if mj:
+                hb["mjoin"] = mj
+            if ml:
+                hb["mleave"] = ml
         if self.stats_extra:
             hb.update(self.stats_extra)
         self.publish(f"hb/{rnd}/{self.process_id}", json.dumps(hb))
         peers = {self.process_id: hb}
-        for pid in range(self.num_processes):
+        for pid in self.members:
             if pid == self.process_id:
                 continue
             try:
@@ -392,6 +448,24 @@ class PeerCoordinator:
                 _mon.get_registry().counter(
                     _mon.DIST_PREEMPTIONS,
                     help="coordinated preemption drains agreed").inc()
+        elif self.membership is not None:
+            joins, leaves = set(), set()
+            for info in peers.values():
+                joins.update(int(p) for p in info.get("mjoin") or ())
+                leaves.update(int(p) for p in info.get("mleave") or ())
+            joins -= set(self.members)
+            leaves &= set(self.members)
+            if joins or leaves:
+                if self.driver_attached:
+                    self._decision = REFORM
+                    self._pending_reform = (sorted(joins), sorted(leaves))
+                    if _mon.enabled():
+                        _mon.get_registry().counter(
+                            _mon.DIST_REFORMS_AGREED,
+                            help="membership changes agreed at sync "
+                                 "points").inc()
+                # undriven: nothing can execute a mesh re-form — the
+                # announcements stay pending and harmless
         if _mon.enabled():
             _mon.get_registry().gauge(
                 _mon.DIST_PEERS,
@@ -530,7 +604,7 @@ class PeerCoordinator:
             # death (observation times are local-monotonic: clock skew
             # on the peers cannot fake or hide freshness)
             if all(self._beat_obs.get(pid, (None, -1.0))[1] >= started
-                   for pid in range(self.num_processes)
+                   for pid in self.members
                    if pid != self.process_id):
                 raise exc
             if stale:
@@ -577,7 +651,7 @@ class PeerCoordinator:
         seen = self.alive_info()
         now = time.monotonic()
         stale = set()
-        for pid in range(self.num_processes):
+        for pid in self.members:
             if pid == self.process_id:
                 continue
             if pid not in seen:
@@ -629,6 +703,7 @@ class PeerCoordinator:
         snap = {
             "process_id": self.process_id,
             "num_processes": self.num_processes,
+            "members": list(self.members),
             "step": self.step,
             "rounds": self.rounds,
             "sync_every": self.sync_every,

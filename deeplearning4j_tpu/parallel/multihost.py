@@ -77,13 +77,15 @@ from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience import guardian as _guardian
 from deeplearning4j_tpu.resilience.errors import (CheckpointIntegrityError,
                                                   DistributedInitError,
+                                                  MembershipChangeError,
                                                   PeerDesyncError,
                                                   PeerLostError,
                                                   PreemptionSignal)
 from deeplearning4j_tpu.resilience.policy import RetryPolicy
 
 __all__ = [
-    "CoordinatedGuardian", "MultiHostRunner", "MultiHostTrainer",
+    "CoordinatedGuardian", "ElasticMembership", "MultiHostRunner",
+    "MultiHostTrainer",
     "global_batch", "initialize", "initialized", "process_id",
     "PeerCoordinator", "PeerMonitor", "LocalKV",
     "install_preemption_handler",
@@ -106,6 +108,8 @@ PeerCoordinator = _coord.PeerCoordinator
 PeerMonitor = _coord.PeerMonitor
 LocalKV = _coord.LocalKV
 install_preemption_handler = _coord.install_preemption_handler
+from deeplearning4j_tpu.parallel.membership import (  # noqa: E402
+    ElasticMembership)
 
 
 def __getattr__(name):
@@ -396,7 +400,8 @@ class MultiHostTrainer(ShardedTrainer):
     def __init__(self, loss_fn, updater, mesh=None, param_specs=None,
                  batch_axis="dp", donate=True, compress=True,
                  compression_kw=None, zero1=False, accumulation=1,
-                 buckets=None, bucket_bytes=None):
+                 buckets=None, bucket_bytes=None, wire="dense",
+                 wire_capacity=0.05):
         if mesh is None:
             mesh = Mesh(np.array(jax.devices()), (batch_axis,))
         super().__init__(loss_fn, updater, mesh, param_specs=param_specs,
@@ -409,11 +414,55 @@ class MultiHostTrainer(ShardedTrainer):
                      if self.compress else None)
         self._num_buckets = buckets
         self._bucket_bytes = bucket_bytes
+        if wire not in ("dense", "sparse"):
+            raise ValueError(f"wire must be 'dense' or 'sparse', got "
+                             f"{wire!r}")
+        if wire == "sparse" and not self.compress:
+            raise ValueError("wire='sparse' ships threshold-encoded "
+                             "tokens — it requires compress=True")
+        #: "dense": pmean the {−t,0,+t} tensor (bucket-sized traffic);
+        #: "sparse": size-prefixed (index, sign) token allgather whose
+        #: wire bytes track nnz (compression.sparse_encode/_decode)
+        self.wire = wire
+        #: per-bucket token capacity: a float = fraction of the bucket's
+        #: elements (size it ~2× the expected nnz band so the ≤2×-nnz
+        #: wire bound holds with headroom), or an int = absolute slots
+        self._wire_capacity = wire_capacity
         #: the explicit shard_map'd exchange runs whenever encoding OR
         #: bucketing is requested; otherwise GSPMD owns the all-reduce
         self._explicit = (self.compress or buckets is not None
                           or bucket_bytes is not None)
         self.bucket_plan = None
+
+    def wire_caps(self):
+        """Per-bucket wire token capacities (sparse wire only; static)."""
+        plan = self.bucket_plan
+        if self.wire != "sparse" or plan is None:
+            return None
+        if isinstance(self._wire_capacity, float):
+            return [_compression.wire_capacity(plan.bucket_elems[b],
+                                               self._wire_capacity)
+                    for b in range(plan.num_buckets)]
+        return [max(1, min(int(plan.bucket_elems[b]),
+                           int(self._wire_capacity)))
+                for b in range(plan.num_buckets)]
+
+    def rebuild(self, mesh):
+        """A fresh trainer with this one's configuration on a DIFFERENT
+        mesh — the elastic re-form primitive (the dp width changed, so
+        every jitted program and the bucket plan's sharding context must
+        be rebuilt; the plan itself is pure tree structure and carries
+        over unchanged)."""
+        clone = type(self)(
+            self.loss_fn, self.tx, mesh=mesh,
+            param_specs=self.param_specs, batch_axis=self.batch_axis,
+            donate=self._donate, compress=self.compress,
+            compression_kw=self._compression_kw, zero1=self.zero1,
+            accumulation=self.accumulation, buckets=self._num_buckets,
+            bucket_bytes=self._bucket_bytes, wire=self.wire,
+            wire_capacity=self._wire_capacity)
+        clone.bucket_plan = self.bucket_plan
+        return clone
 
     # -- bucket plan ------------------------------------------------------
     def _ensure_plan(self, tree):
@@ -531,6 +580,12 @@ class MultiHostTrainer(ShardedTrainer):
         # no pin is inserted: the latency-hiding scheduler must stay
         # free to hoist all-reduce-starts wherever it likes.
         pin_order = jax.default_backend() == "cpu"
+        sparse = self.wire == "sparse"
+        caps = self.wire_caps() if sparse else None
+        # the adaptive-threshold hyperparameters, shared with the dense
+        # encoder so the two wire formats run the SAME state trajectory
+        adapt_kw = {k: v for k, v in self._compression_kw.items()
+                    if k != "initial_threshold"}
 
         def exchange_buckets(flats, e):
             """[flat grads per bucket], per-worker encoder state ->
@@ -549,13 +604,27 @@ class MultiHostTrainer(ShardedTrainer):
                         st = {"residual": e["residual"][str(b)],
                               "threshold": e["threshold"][b],
                               "nnz": e["nnz"][b]}
-                        sent, st2 = enc.update(flat, st)
+                        if sparse:
+                            sent, st2 = _compression.sparse_encode(
+                                flat, st, caps[b], **adapt_kw)
+                        else:
+                            sent, st2 = enc.update(flat, st)
                         res2[str(b)] = st2["residual"]
                         thr2.append(st2["threshold"])
                         nnz2.append(st2["nnz"])
                 with jax.named_scope(
                         _buckets.EXCHANGE_SCOPE.format(b=b)):
-                    outs.append(jax.lax.pmean(sent, axis))
+                    if sparse:
+                        # size-prefixed token payloads ride an
+                        # allgather (wire bytes ∝ capacity, not bucket
+                        # size); decode-and-accumulate reproduces the
+                        # dense pmean bit-for-bit at fixed membership
+                        gathered = jax.lax.all_gather(sent, axis)
+                        outs.append(_compression.sparse_decode(
+                            gathered, plan.bucket_elems[b],
+                            plan.bucket_dtype(b)))
+                    else:
+                        outs.append(jax.lax.pmean(sent, axis))
             if enc is None:
                 return outs, None
             return outs, {"residual": res2,
@@ -667,6 +736,8 @@ class MultiHostTrainer(ShardedTrainer):
     def fit_batch(self, params, opt_state, batch, rng):
         if self._explicit and _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.COMM_ALLREDUCE)
+            if self.wire == "sparse":
+                _faults.ACTIVE.fire(_faults.WIRE_DECODE)
         try:
             return super().fit_batch(params, opt_state, batch, rng)
         except (PeerLostError, PreemptionSignal):
@@ -747,6 +818,22 @@ class MultiHostTrainer(ShardedTrainer):
         host["encoded_bytes"] = host["nnz"] * 4
         host["bucket_nnz"] = [int(v) for v in host["bucket_nnz"]]
         host["bucket_encoded_bytes"] = [v * 4 for v in host["bucket_nnz"]]
+        if self.wire == "sparse":
+            # ACTUAL wire cost of the sparse format: every worker ships
+            # (capacity + header) int32 slots per bucket each step —
+            # static by construction, sized to track the nnz ledger
+            caps = self.wire_caps()
+            n_workers = int(np.prod(
+                opt_state["encoder"]["nnz"].shape[:-1]))
+            host["wire_capacity"] = list(caps)
+            host["bucket_wire_bytes"] = [
+                _compression.wire_payload_bytes(c) * n_workers
+                for c in caps]
+            host["wire_bytes"] = int(sum(host["bucket_wire_bytes"]))
+            plan = self.bucket_plan
+            host["dense_bytes"] = int(sum(
+                plan.bucket_elems[b] * np.dtype(plan.bucket_dtype(b)).itemsize
+                for b in range(plan.num_buckets)) * n_workers)
         if _mon.enabled():
             reg = _mon.get_registry()
             reg.counter(_mon.DIST_ENCODED_BYTES,
@@ -756,6 +843,12 @@ class MultiHostTrainer(ShardedTrainer):
             reg.gauge(_mon.DIST_RESIDUAL_NORM,
                       help="global norm of the un-sent gradient "
                            "residual").set(host["residual_norm"])
+            if self.wire == "sparse":
+                reg.gauge(_mon.DIST_WIRE_BYTES,
+                          help="actual per-step bytes on the sparse "
+                               "ragged wire (all workers, all buckets: "
+                               "capacity + header slots)") \
+                    .set(host["wire_bytes"])
             # exchange exposure, two regimes on one gauge:
             # - single-process: dispatch the exchange-only probe and
             #   time the blocked wait (first call warms the compile
@@ -837,7 +930,7 @@ class CoordinatedGuardian(_guardian.TrainingGuardian):
         import json
         gnorms, oks, retryables = super()._materialize()
         c = self.coordinator
-        if c is None or c.num_processes <= 1:
+        if c is None or len(c.members) <= 1:
             return gnorms, oks, retryables
         n = self._flushes
         self._flushes += 1
@@ -846,7 +939,7 @@ class CoordinatedGuardian(_guardian.TrainingGuardian):
                               "ok": [bool(x) for x in oks]}))
         gnorms = np.asarray(gnorms, np.float32)
         oks = np.asarray(oks, bool)
-        for pid in range(c.num_processes):
+        for pid in c.members:
             if pid == c.process_id:
                 continue
             try:
@@ -895,7 +988,8 @@ class MultiHostRunner:
 
     def __init__(self, trainer, directory, coordinator, save_every=10,
                  guardian=None, verify_saves=True, max_to_keep=5,
-                 rng_seed=0, monitor=True, sigterm=True):
+                 rng_seed=0, monitor=True, sigterm=True,
+                 elastic=False, mesh_factory=None, membership=None):
         from deeplearning4j_tpu.parallel.elastic import ElasticCheckpointer
         self.trainer = trainer
         self.coordinator = coordinator
@@ -905,6 +999,23 @@ class MultiHostRunner:
         self.verify_saves = bool(verify_saves)
         self.primary = coordinator.process_id == 0
         multi = coordinator.num_processes > 1
+        # -- elastic membership: mid-run join/leave/replace ---------------
+        self.elastic = bool(elastic)
+        self.mesh_factory = mesh_factory
+        self.membership = None
+        self._replaces = 0         # replacement transitions executed
+        if self.elastic:
+            if mesh_factory is None:
+                raise ValueError(
+                    "elastic=True needs a mesh_factory(members) -> Mesh "
+                    "so the dp mesh can re-form when membership changes")
+            if getattr(trainer, "zero1", False):
+                raise ValueError(
+                    "elastic membership with zero1 optimizer-state "
+                    "sharding is unsupported: re-forming would re-shard "
+                    "the partitioned optimizer state mid-run")
+            self.membership = membership if membership is not None \
+                else ElasticMembership(coordinator)
         # single-writer pattern: process 0 owns the directory (orbax
         # barriers scoped to it alone — see ElasticCheckpointer), peers
         # open it read-only for restore + manifest verification; only
@@ -988,6 +1099,9 @@ class MultiHostRunner:
         # arrays — skipping it on peers would leave the primary's
         # collective waiting forever
         host = self._host_state(params, opt_state)
+        self._last_host_state = host   # elastic re-form reuses this
+        #                                snapshot (no second old-mesh
+        #                                collective once a host is gone)
         if self.primary:
             self.ckpt.save(self.step, host["params"], host["opt_state"],
                            wait=wait,
@@ -1304,6 +1418,309 @@ class MultiHostRunner:
         g.note_rollback(int(s))
         return placed["params"], placed["opt_state"]
 
+    # -- elastic membership: mid-run join / leave / replace --------------
+    def request_leave(self):
+        """Announce a GRACEFUL leave for this host: the next sync point
+        agrees the REFORM on every member, the final state drains to a
+        verified checkpoint on the old mesh, the survivors re-form, and
+        this host's fit loop unwinds with `PreemptionSignal` — the same
+        clean-exit contract the SIGTERM drain gives."""
+        if not self.elastic:
+            raise MembershipChangeError(
+                "request_leave() requires an elastic runner "
+                "(elastic=True with a mesh_factory)")
+        return self.membership.announce_leave()
+
+    def _encoder_dp(self, opt_state):
+        """The per-worker encoder stack width of this state, or None
+        when the trainer doesn't compress (nothing width-dependent)."""
+        if not getattr(self.trainer, "compress", False) \
+                or not isinstance(opt_state, dict) \
+                or "encoder" not in opt_state:
+            return None
+        return int(opt_state["encoder"]["threshold"].shape[0])
+
+    def _elastic_like(self, like_host, dp):
+        """Host-zeros restore target with the encoder stacks at width
+        `dp` (the width the checkpoint was WRITTEN at) — None when the
+        current width already matches or there is no encoder."""
+        opt = like_host.get("opt_state")
+        if dp is None or not (isinstance(opt, dict) and "encoder" in opt):
+            return None
+        enc = opt["encoder"]
+        if int(np.asarray(enc["threshold"]).shape[0]) == int(dp):
+            return None
+
+        def widen(a):
+            a = np.asarray(a)
+            return np.zeros((int(dp),) + a.shape[1:], a.dtype)
+
+        new_opt = dict(opt)
+        new_opt["encoder"] = {
+            "residual": {b: widen(r)
+                         for b, r in enc["residual"].items()},
+            "threshold": widen(enc["threshold"]),
+            "nnz": widen(enc["nnz"])}
+        out = dict(like_host)
+        out["opt_state"] = new_opt
+        return out
+
+    def _restore_restacked(self, step, like_live, old_dp,
+                           verified_scan=False):
+        """`_restore_placed` for a WIDTH-CHANGED resume: restore (and
+        integrity-verify) against the checkpoint's own old-width
+        encoder layout, re-stack the per-worker encoder state for the
+        live width (`membership.restack_encoder`), then re-place on the
+        live mesh. Falls through to the plain path when widths match."""
+        from deeplearning4j_tpu.parallel.elastic import replace_on_mesh
+        from deeplearning4j_tpu.parallel.membership import restack_encoder
+        from deeplearning4j_tpu.resilience import integrity as _integrity
+        like_host = jax.tree_util.tree_map(
+            lambda a: np.zeros(a.shape, a.dtype)
+            if hasattr(a, "shape") else a, like_live)
+        wide = self._elastic_like(like_host, old_dp)
+        if wide is None:
+            return self._restore_placed(step, like_live,
+                                        verified_scan=verified_scan)
+        if verified_scan:
+            s, state = self.ckpt.restore_verified(like=wide)
+        else:
+            s, state = self.ckpt.restore(step=step, like=wide)
+            _integrity.verify_restored(self.directory, s, state)
+        if s is None:
+            return None, None
+        new_dp = self._encoder_dp(like_host.get("opt_state"))
+        new_opt = dict(state["opt_state"])
+        new_opt["encoder"] = restack_encoder(new_opt["encoder"], new_dp)
+        state = dict(state)
+        state["opt_state"] = new_opt
+        placed = replace_on_mesh(self.trainer.mesh, like_live, state)
+        return s, placed
+
+    def _reform(self, params, opt_state, delta):
+        """Execute an AGREED membership change at this step boundary:
+        coordinated drain save on the OLD mesh (the joiner's warm start
+        and the leaver's final state), the join-admission fault window,
+        leader commit (+ departed-host KV reap), then the survivors
+        rebuild on the new mesh. Returns (None, None) on the leaving
+        host — the caller unwinds with the drain signal."""
+        import time as _time
+        joins, leaves = delta
+        c = self.coordinator
+        if 0 in leaves:
+            self.membership.abandon(leaves=[0])
+            raise MembershipChangeError(
+                "process 0 cannot leave an elastic run: it owns the "
+                "checkpoint directory and hosts the coordination "
+                "service — drain the whole run (preemption) instead")
+        t0 = _time.monotonic()
+        saved = self._save(params, opt_state, wait=True)
+        host = self._last_host_state if saved \
+            else self._host_state(params, opt_state)
+        if joins and not saved:
+            # the guardian could not vouch, so no drain checkpoint was
+            # written: a joiner warm-starting an OLDER generation would
+            # desync against the survivors' live step — withdraw the
+            # joins (they re-announce later), keep any leaves
+            self.membership.abandon(joins=joins)
+            joins = []
+            if not leaves:
+                return params, opt_state
+        if _faults.ACTIVE is not None:
+            # host.join: an injected failure in the admission window
+            # abandons the announcements — the OLD roster stays
+            # authoritative and live state is untouched (typed failure,
+            # never a half-applied roster)
+            try:
+                _faults.ACTIVE.fire(_faults.HOST_JOIN)
+            except BaseException as e:
+                self.membership.abandon(joins=joins, leaves=leaves)
+                raise MembershipChangeError(
+                    f"membership change (join={joins}, leave={leaves}) "
+                    f"failed before commit — previous roster stays "
+                    f"authoritative: {e}") from e
+        info = {"step": self.step, "cstep": c.step, "rounds": c.rounds,
+                "save_seq": self._save_seq,
+                "dp": self._encoder_dp(opt_state),
+                "flushes": getattr(self.guardian, "_flushes", 0),
+                "rollbacks": getattr(self.guardian, "rollbacks", 0)}
+        new_members = self.membership.commit(joins, leaves, info=info)
+        if c.process_id in leaves:
+            return None, None
+        params, opt_state = self._rebuild(host, new_members)
+        if _mon.enabled():
+            reg = _mon.get_registry()
+            reg.counter(_mon.DIST_REFORMS,
+                        labels={"kind": "join" if joins else "leave"},
+                        help="elastic mesh re-forms executed").inc()
+            reg.gauge(_mon.DIST_REFORM_MS,
+                      help="wall ms of the last elastic re-form "
+                           "(drain save + rebuild + re-place)") \
+                .set(round((_time.monotonic() - t0) * 1000.0, 3))
+        return params, opt_state
+
+    def _rebuild(self, host, new_members):
+        """Re-form onto the NEW roster from the replicated host
+        snapshot: fresh trainer on `mesh_factory(members)`, per-worker
+        encoder stacks re-stacked for the new dp width (residual mass
+        conserved), every leaf re-placed on the new mesh."""
+        from deeplearning4j_tpu.parallel.elastic import replace_on_mesh
+        from deeplearning4j_tpu.parallel.membership import restack_encoder
+        new_mesh = self.mesh_factory(list(new_members))
+        new_trainer = self.trainer.rebuild(new_mesh)
+        fresh_p, fresh_o = new_trainer.init(
+            jax.tree_util.tree_map(np.asarray, host["params"]))
+        like = {"params": fresh_p, "opt_state": fresh_o}
+        state = {"params": host["params"],
+                 "opt_state": dict(host["opt_state"])
+                 if isinstance(host["opt_state"], dict)
+                 else host["opt_state"]}
+        if isinstance(state["opt_state"], dict) \
+                and "encoder" in state["opt_state"] \
+                and getattr(new_trainer, "compress", False):
+            new_dp = int(fresh_o["encoder"]["threshold"].shape[0])
+            state["opt_state"]["encoder"] = restack_encoder(
+                state["opt_state"]["encoder"], new_dp)
+        placed = replace_on_mesh(new_mesh, like, state)
+        self.trainer = new_trainer
+        self._gather_cache = {}
+        self._last_opt_state = None
+        self.coordinator.bind(new_trainer)
+        if self.guardian is not None:
+            self.guardian.bind(new_trainer)
+        return placed["params"], placed["opt_state"]
+
+    def _replace_lost(self, params, opt_state, exc):
+        """A peer died mid-run (`PeerLostError`): the survivors re-form
+        on the reduced roster and KEEP TRAINING from the newest
+        verified checkpoint (the step may rewind by < save_every); a
+        restarted or standby host joins back through `join_cluster`
+        later. The live state is unusable — in a real multi-host run it
+        spans the dead host's devices — so replacement is a restore,
+        not a migration. Re-raises `exc` when nothing can survive
+        (process 0 died: it owns the checkpoints and the KV store)."""
+        import time as _time
+        c = self.coordinator
+        lost = sorted(set(c._lost) & set(c.members))
+        if not lost or c.process_id in lost:
+            raise exc
+        if 0 in lost:
+            raise exc
+        survivors = [p for p in c.members if p not in lost]
+        if not survivors:
+            raise exc
+        t0 = _time.monotonic()
+        old_dp = self._encoder_dp(opt_state)
+        self._replaces += 1
+        m = self.membership
+        if c.process_id == min(survivors):
+            for pid in lost:
+                m.reap_host(pid)
+        m.members = list(survivors)
+        m.epoch += 1
+        c.reform(survivors)
+        new_mesh = self.mesh_factory(list(survivors))
+        new_trainer = self.trainer.rebuild(new_mesh)
+        host_like = jax.tree_util.tree_map(
+            lambda a: np.zeros(a.shape, a.dtype)
+            if hasattr(a, "shape") else a, params)
+        fresh_p, fresh_o = new_trainer.init(host_like)
+        self.trainer = new_trainer
+        self._gather_cache = {}
+        self._last_opt_state = None
+        c.bind(new_trainer)
+        if self.guardian is not None:
+            self.guardian.bind(new_trainer)
+        like = {"params": fresh_p, "opt_state": fresh_o}
+        key = f"ctl/replace/{self._replaces}"
+        if len(survivors) <= 1 or self.primary:
+            try:
+                self.ckpt.manager.wait_until_finished()
+                s, placed = self._restore_restacked(
+                    None, like, old_dp, verified_scan=True)
+                if s is None:
+                    raise CheckpointIntegrityError(
+                        f"peer(s) {lost} lost but no verified "
+                        f"checkpoint exists to re-form from") from exc
+            except BaseException:
+                if len(survivors) > 1:
+                    try:
+                        c.publish(key, "fail")
+                    except Exception:  # noqa: BLE001
+                        pass
+                raise
+            if len(survivors) > 1:
+                c.publish(key, str(int(s)))
+        else:
+            v = self._fetch_decision(key, "replace")
+            if v == "fail":
+                raise CheckpointIntegrityError(
+                    "the lead survivor failed its replacement restore "
+                    "— see its logs") from exc
+            s, placed = self._restore_restacked(int(v), like, old_dp)
+        if len(survivors) > 1:
+            c.barrier(f"replace/{self._replaces}")
+        self.step = int(s)
+        self._note_resume()
+        if _mon.enabled():
+            reg = _mon.get_registry()
+            reg.counter(_mon.DIST_REFORMS, labels={"kind": "replace"},
+                        help="elastic mesh re-forms executed").inc()
+            reg.gauge(_mon.DIST_REFORM_MS,
+                      help="wall ms of the last elastic re-form "
+                           "(drain save + rebuild + re-place)") \
+                .set(round((_time.monotonic() - t0) * 1000.0, 3))
+        return placed["params"], placed["opt_state"]
+
+    @classmethod
+    def join_cluster(cls, trainer_factory, directory, coordinator,
+                     mesh_factory, init_params, timeout=None, **kw):
+        """JOINER bootstrap: announce on the KV, wait for the running
+        cluster to agree and admit at a step boundary, build the
+        trainer on the NEW mesh (`trainer_factory(mesh)`), warm-start
+        from the drain checkpoint the members wrote at that boundary
+        (encoder stacks re-stacked to the new dp width), and adopt the
+        members' step / round / barrier counters so lockstep agreement
+        holds from the first step. Returns (runner, params, opt_state).
+
+        Raises the typed `MembershipChangeError` (announcement
+        withdrawn, cluster untouched) when admission fails or the
+        `host.join` fault fires."""
+        m = ElasticMembership(coordinator,
+                              members=[coordinator.process_id])
+        m.announce_join()
+        if _faults.ACTIVE is not None:
+            try:
+                _faults.ACTIVE.fire(_faults.HOST_JOIN)
+            except BaseException as e:
+                m.abandon(joins=[coordinator.process_id])
+                raise MembershipChangeError(
+                    f"join aborted before admission — announcement "
+                    f"withdrawn, cluster roster untouched: {e}") from e
+        info = m.await_admission(timeout=timeout)
+        trainer = trainer_factory(mesh_factory(list(m.members)))
+        coordinator.step = int(info.get("cstep") or 0)
+        coordinator.rounds = int(info.get("rounds") or 0)
+        runner = cls(trainer, directory, coordinator, elastic=True,
+                     mesh_factory=mesh_factory, membership=m, **kw)
+        runner._save_seq = int(info.get("save_seq") or 0)
+        g = runner.guardian
+        if g is not None:
+            g._flushes = int(info.get("flushes") or 0)
+            if hasattr(g, "rollbacks"):
+                g.rollbacks = int(info.get("rollbacks") or 0)
+        params, opt_state = runner.trainer.init(init_params)
+        like = {"params": params, "opt_state": opt_state}
+        step = int(info.get("step") or 0)
+        s, placed = runner._restore_restacked(
+            step if step > 0 else None, like, info.get("dp"),
+            verified_scan=step <= 0)
+        if s is not None:
+            runner.step = int(s)
+            runner._note_resume()
+            params, opt_state = placed["params"], placed["opt_state"]
+        return runner, params, opt_state
+
     # -- the step --------------------------------------------------------
     def _on_sync(self, coordinator):
         """Sync-point piggyback: refresh the compression wire telemetry
@@ -1319,6 +1736,9 @@ class MultiHostRunner:
                 if host is not None:
                     coordinator.stats_extra["exchange_bytes"] = \
                         host["encoded_bytes"]
+                    if "wire_bytes" in host:
+                        coordinator.stats_extra["wire_bytes"] = \
+                            host["wire_bytes"]
             except Exception:  # noqa: BLE001 — telemetry is best-effort
                 pass
 
@@ -1331,8 +1751,18 @@ class MultiHostRunner:
         if rng is None:
             rng = jax.random.fold_in(self.root_rng, self.step)
         self._last_opt_state = opt_state
-        params, opt_state, loss = self.trainer.fit_batch(
-            params, opt_state, batch, rng)
+        try:
+            params, opt_state, loss = self.trainer.fit_batch(
+                params, opt_state, batch, rng)
+        except PeerLostError as e:
+            if not self.elastic:
+                raise
+            # survivors re-form on the reduced roster instead of dying
+            # with the peer; the batch is dropped (its buffers may be
+            # donated) and loss is None — the caller re-batches on the
+            # NEW trainer.mesh at the (possibly rewound) runner.step
+            params, opt_state = self._replace_lost(params, opt_state, e)
+            return params, opt_state, None
         self._last_opt_state = opt_state
         self.step += 1
         g = self.guardian
@@ -1357,6 +1787,22 @@ class MultiHostRunner:
                  f"checkpoint was written; resume falls back to the "
                  f"last verified generation"),
                 step=self.step)
+        if d == _coord.REFORM and self.elastic:
+            delta = self.coordinator.take_reform()
+            if delta is not None:
+                params, opt_state = self._reform(params, opt_state,
+                                                 delta)
+                if params is None:
+                    raise PreemptionSignal(
+                        f"graceful leave complete at step {self.step} "
+                        f"— final state drained to a checkpoint and "
+                        f"the survivors re-formed without this host",
+                        step=self.step)
+                # the re-form just drain-saved THIS step; a second
+                # periodic save would advance _save_seq past the
+                # joiner's adopted ticket value and fence on a member
+                # that is still warm-starting
+                return params, opt_state, loss
         if self.step % self.save_every == 0:
             self._save(params, opt_state, wait=False)
         return params, opt_state, loss

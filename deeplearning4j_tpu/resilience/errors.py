@@ -16,7 +16,7 @@ __all__ = [
     "DivergenceError", "CheckpointIntegrityError",
     "DistributedInitError", "PeerLostError", "PeerDesyncError",
     "PreemptionSignal", "ServerDeadError", "MemoryPressureError",
-    "ReplayDivergedError",
+    "ReplayDivergedError", "WireFormatError", "MembershipChangeError",
 ]
 
 
@@ -138,6 +138,25 @@ class ReplayDivergedError(ResilienceError):
     contract was violated (should never happen; a bug or nondeterminism
     in the decode path). The affected request fails typed rather than
     silently delivering a forked continuation."""
+
+
+class WireFormatError(ResilienceError):
+    """A sparse gradient wire message failed structural validation
+    (truncated payload, count/token mismatch, non-finite threshold, or an
+    out-of-range token index). The in-jit decode path poisons the
+    delivered gradient to NaN so the guardian gates the step — this typed
+    error is what the host-side validator (`compression.check_payload`)
+    and the `wire.decode` fault site raise, so corruption is contained
+    loudly, never delivered as a silent wrong gradient."""
+
+
+class MembershipChangeError(ResilienceError):
+    """An elastic membership transition (join admission, leave, or
+    replacement re-form) failed before it could commit: the joiner died
+    mid-admission, the reform barrier timed out, or the roster write was
+    lost. The previous membership epoch stays authoritative — survivors
+    keep training on the old roster and the transition is retried or
+    abandoned, never half-applied."""
 
 
 class PreemptionSignal(ResilienceError):

@@ -23,6 +23,8 @@ hooks at named sites:
     CACHE_GROW         "cache.grow"         — before a KV-cache rung growth
     EXECUTABLES_LOAD   "executables.load"   — on the AOT store miss path
     SERVING_DISPATCH   "serving.dispatch"   — inside the AOT serving path
+    HOST_JOIN          "host.join"          — during elastic join admission
+    WIRE_DECODE        "wire.decode"        — before a sparse-wire exchange
 
 The hook at every call site is literally
 
@@ -56,6 +58,7 @@ __all__ = ["FaultPlan", "install_plan", "clear_plan", "ACTIVE",
            "GENERATION_STEP", "GENERATION_SUPERSTEP",
            "GENERATION_ADMIT", "CACHE_GROW",
            "EXECUTABLES_LOAD", "SERVING_DISPATCH",
+           "HOST_JOIN", "WIRE_DECODE",
            "PROCESS_ID", "resolve_process_id"]
 
 DATA_NEXT = "data.next"
@@ -106,6 +109,17 @@ EXECUTABLES_LOAD = "executables.load"
 #: must open the AOT breaker and degrade to the legacy path, then
 #: recover through the half-open probe after cooldown
 SERVING_DISPATCH = "serving.dispatch"
+#: fires during elastic join admission — after the joiner announced
+#: itself but before the membership commit. A fault here simulates the
+#: joiner (or an admitting member) dying mid-join: the transition must
+#: be abandoned typed (`MembershipChangeError`), the old roster stays
+#: authoritative, and survivors keep training
+HOST_JOIN = "host.join"
+#: fires before a sparse-wire train-step dispatch (the allgather +
+#: decode-and-accumulate exchange) — simulates a corrupt/truncated
+#: sparse gradient message; containment must be a typed error or a
+#: guardian-gated step, never a silently wrong delivered gradient
+WIRE_DECODE = "wire.decode"
 
 #: THE switch production hooks check. None → injection off (the
 #: permanent state outside resilience tests).

@@ -50,6 +50,10 @@ HOT_MODULES = [
     # sync-point registry calls guarded like everything else
     "deeplearning4j_tpu/parallel/coordination.py",
     "deeplearning4j_tpu/parallel/multihost.py",
+    # elastic membership: `pending()` folds into EVERY heartbeat, and
+    # the reform/commit/reap paths live next to the runner's counters —
+    # registry traffic there obeys the same enabled-guard contract
+    "deeplearning4j_tpu/parallel/membership.py",
     "deeplearning4j_tpu/resilience/guardian.py",
     "deeplearning4j_tpu/resilience/watchdog.py",
     "deeplearning4j_tpu/resilience/faults.py",
@@ -171,7 +175,14 @@ TRAIN_MODULES = [
 #: must stay shape-metadata-only), and the dispatch hook
 TRAIN_SYNC_ROOTS = {"make_step", "make_guarded_step", "_make_exchange",
                     "accumulate_grads", "accum_scan", "fit_batch",
-                    "plan_buckets", "concat", "split"}
+                    "plan_buckets", "concat", "split",
+                    # the sparse wire codec runs INSIDE the traced
+                    # exchange — encode, size-prefixed decode rows and
+                    # the chain-sum accumulate are explicit roots so a
+                    # host sync in the wire path can never hide behind
+                    # a renamed call site
+                    "sparse_encode", "sparse_decode", "_decode_row",
+                    "wire_caps"}
 #: the declared host-fetch boundary — stats/score materialize at sync
 #: cadence, never per optimizer step; the traversal stops there
 TRAIN_SYNC_BOUNDARY = {"encoder_stats", "_materialize",
@@ -186,7 +197,12 @@ TRAIN_SYNC_BOUNDARY = {"encoder_stats", "_materialize",
 #: `publish` exists in coordination.py (the KV write), cluster.py, and
 #: stragglers.py — one union graph would shadow two of the three.
 TIMELINE_MODULE_GROUPS = [
-    ["deeplearning4j_tpu/parallel/coordination.py"],
+    # membership.py rides group 1: `pending()` (the join/leave fold)
+    # runs inside EVERY heartbeat build — the walker descends from
+    # _sync_point into it and proves the fold stays KV reads + JSON,
+    # never a device touch
+    ["deeplearning4j_tpu/parallel/coordination.py",
+     "deeplearning4j_tpu/parallel/membership.py"],
     ["deeplearning4j_tpu/monitoring/stragglers.py",
      "deeplearning4j_tpu/monitoring/steps.py"],
     ["deeplearning4j_tpu/monitoring/cluster.py"],

@@ -1,0 +1,147 @@
+"""Cross-process KV for the elastic chaos tests: a LocalKV served over
+TCP.
+
+`jax.distributed` cannot lose a member — the coordination service
+aborts the survivors when a process dies, which is exactly the failure
+mode the elastic runner exists to survive. The elastic soak therefore
+runs each worker as an INDEPENDENT single-process jax instance and
+routes the coordination plane (heartbeats, membership announcements,
+admission tickets, barriers) through this server, which the test
+harness owns — killing a worker with SIGKILL leaves the control plane
+up, so the survivors' agreement and the replacement's admission are
+exercised for real across process boundaries.
+
+Protocol: one JSON object per line, one connection per request (every
+blocking get/barrier call holds its own socket, so concurrent blocking
+calls from one client never interleave). The server is a thin shim over
+a `LocalKV` — same write-once, blocking-get and counted-barrier
+semantics the in-process tests rely on.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+
+from deeplearning4j_tpu.parallel.coordination import LocalKV
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        kv = self.server.kv  # type: ignore[attr-defined]
+        line = self.rfile.readline()
+        if not line:
+            return
+        try:
+            req = json.loads(line)
+            op = req["op"]
+            if op == "set":
+                kv.key_value_set(req["k"], req["v"],
+                                 allow_overwrite=req.get("ow", False))
+                rsp = {"ok": True}
+            elif op == "get":
+                rsp = {"ok": True,
+                       "v": kv.blocking_key_value_get(req["k"], req["t"])}
+            elif op == "dir":
+                rsp = {"ok": True, "items": kv.key_value_dir_get(req["k"])}
+            elif op == "del":
+                kv.key_value_delete(req["k"])
+                rsp = {"ok": True}
+            elif op == "barrier":
+                kv.wait_at_barrier(req["id"], req["t"],
+                                   expected=req.get("expected", 1))
+                rsp = {"ok": True}
+            else:
+                rsp = {"ok": False, "err": f"unknown op {op!r}"}
+        except TimeoutError as e:
+            rsp = {"ok": False, "err": str(e), "timeout": True}
+        except RuntimeError as e:
+            rsp = {"ok": False, "err": str(e)}
+        except Exception as e:  # noqa: BLE001 — report, don't kill server
+            rsp = {"ok": False, "err": repr(e)}
+        self.wfile.write((json.dumps(rsp) + "\n").encode())
+
+
+class KVServer(socketserver.ThreadingTCPServer):
+    """Harness-side server. `with KVServer() as srv: ... srv.port`."""
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host="localhost", port=0):
+        super().__init__((host, port), _Handler)
+        self.kv = LocalKV()
+        self.port = self.server_address[1]
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        self.server_close()
+        return False
+
+
+class TcpKV(LocalKV):
+    """Worker-side client with the LocalKV surface, over the wire.
+
+    Subclasses LocalKV ON PURPOSE: `PeerCoordinator.barrier` scopes the
+    fence to the active members (`expected=len(members)`) for LocalKV
+    clients, and the elastic soak needs exactly those member-counted
+    barriers across processes."""
+
+    def __init__(self, host, port, connect_timeout=30.0):
+        super().__init__()
+        self.addr = (host, int(port))
+        self.connect_timeout = float(connect_timeout)
+
+    def _rpc(self, req, timeout=None):
+        s = socket.create_connection(self.addr,
+                                     timeout=self.connect_timeout)
+        try:
+            # blocking ops: give the socket the op timeout + slack so
+            # the server's own DEADLINE_EXCEEDED arrives first
+            if timeout is not None:
+                s.settimeout(timeout / 1000.0 + 10.0)
+            s.sendall((json.dumps(req) + "\n").encode())
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    raise ConnectionError("kv server closed connection")
+                buf += chunk
+            rsp = json.loads(buf)
+        finally:
+            s.close()
+        if not rsp.get("ok"):
+            if rsp.get("timeout"):
+                raise TimeoutError(rsp.get("err", "timeout"))
+            raise RuntimeError(rsp.get("err", "kv rpc failed"))
+        return rsp
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        self._rpc({"op": "set", "k": key, "v": value,
+                   "ow": allow_overwrite})
+
+    def blocking_key_value_get(self, key, timeout_in_ms):
+        return self._rpc({"op": "get", "k": key, "t": timeout_in_ms},
+                         timeout=timeout_in_ms)["v"]
+
+    def key_value_dir_get(self, key):
+        return [tuple(kv) for kv in
+                self._rpc({"op": "dir", "k": key})["items"]]
+
+    def key_value_delete(self, key):
+        self._rpc({"op": "del", "k": key})
+
+    def wait_at_barrier(self, barrier_id, timeout_in_ms, process_ids=None,
+                        expected=1):
+        self._rpc({"op": "barrier", "id": barrier_id,
+                   "t": timeout_in_ms, "expected": expected},
+                  timeout=timeout_in_ms)

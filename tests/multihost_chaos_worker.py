@@ -15,6 +15,10 @@ mode:
   die@R       — worker 1 hard-exits (os._exit) inside sync round R:
                 the survivor must surface `PeerLostError` + a peer
                 report within its peer timeout, never hang
+  sparse      — like clean, but the gradient exchange rides the sparse
+                ragged wire format (per-bucket (index,sign) payloads
+                over a REAL cross-process allgather, capacity = nnz):
+                the final params must match a dense clean run
 
 The trainer is the full multi-host stack: MultiHostTrainer with
 threshold-encoded gradient exchange, CoordinatedGuardian, and a
@@ -96,6 +100,8 @@ W2 = (rng.standard_normal((16, 4)) * 0.3).astype(np.float32)
 coordinator = PeerCoordinator(sync_every=SYNC, peer_timeout=PEER_TIMEOUT,
                               dump_dir=os.path.dirname(out_path))
 trainer = MultiHostTrainer(loss_fn, Sgd(0.2), compress=True,
+                           wire="sparse" if mode == "sparse" else "dense",
+                           wire_capacity=1.0,
                            compression_kw={"initial_threshold": 1e-3})
 guardian = CoordinatedGuardian(coordinator, warmup_steps=100)
 runner = MultiHostRunner(trainer, ckpt_dir, coordinator,
@@ -136,7 +142,12 @@ try:
         print(f"worker {pid} step {runner.step}", flush=True)
     runner.finalize(params, opt_state)
     result.update(done=True, checksum=checksum(params),
-                  losses=losses, steps=runner.step)
+                  losses=losses, steps=runner.step,
+                  params={k: np.array(
+                      params[k].addressable_shards[0].data).tolist()
+                      for k in sorted(params)},
+                  wire_stats=trainer.encoder_stats(opt_state)
+                  if mode == "sparse" else None)
 except PreemptionSignal as e:
     result.update(preempted=True, step=runner.step, reason=str(e))
     runner.close()

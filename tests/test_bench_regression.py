@@ -85,6 +85,41 @@ def test_fresh_without_headline_fails(tmp_path):
     assert not verdict["ok"] and "no headline" in verdict["reason"]
 
 
+def test_multihost_artifact_gates_its_own_trajectory(tmp_path):
+    """MULTIHOST_r01.json (the sparse-wire byte ratio + elastic reform
+    timing from bench_multihost.py) is a separate trajectory from the
+    chip BENCH_* rounds — gated via the explicit `paths` knob so the
+    CPU-host ratio never competes with img/s headlines."""
+    art = os.path.join(REPO, "MULTIHOST_r01.json")
+    doc = cbr.load_artifact(art)
+    v = cbr.headline_value(doc)
+    assert v is not None and v > 1.0, \
+        "sparse wire must beat dense bytes"
+    assert doc["elastic_reform"]["join_reform_ms"] > 0
+    assert doc["elastic_reform"]["dp_after"] == 8
+    assert doc["sparse_wire"]["wire_bytes"] < doc["sparse_wire"][
+        "dense_bytes"]
+    # the checked-in round is its own prior: an equal fresh value passes
+    fresh_ok = _write(tmp_path, {"value": v, "metric": doc["metric"],
+                                 "unit": "x"}, "MULTIHOST_fresh.json")
+    verdict = cbr.check(fresh_ok, tolerance=0.10, paths=[art])
+    assert verdict["ok"] and verdict["prior"] == v
+    assert os.path.basename(verdict["prior_path"]) == "MULTIHOST_r01.json"
+    # a collapsed wire ratio is a caught regression
+    fresh_bad = _write(tmp_path, {"value": round(v * 0.5, 2),
+                                  "metric": doc["metric"], "unit": "x"},
+                       "MULTIHOST_bad.json")
+    verdict = cbr.check(fresh_bad, tolerance=0.10, paths=[art])
+    assert not verdict["ok"] and "regression" in verdict["reason"]
+
+
+def test_multihost_artifact_invisible_to_default_trajectory():
+    """The default BENCH_* glob must not pick up the multihost round —
+    a 19.9x ratio would otherwise poison the img/s floor."""
+    v, path = cbr.best_prior()
+    assert os.path.basename(path).startswith("BENCH_")
+
+
 def test_main_exit_codes(tmp_path, capsys):
     ok = _write(tmp_path, {"value": 2589.0, "metric": "m",
                            "unit": "img/s"}, "BENCH_ok.json")

@@ -708,6 +708,50 @@ def test_two_process_preemption_bit_identical(tmp_path):
                                   np.asarray(clean[0]["losses"][8:]))
 
 
+@pytest.mark.slow   # real two-process soak; sparse-wire bit-identity
+# stays tier-1 via test_wire_format.py::test_sparse_trainer_bit_identical
+def test_two_process_sparse_wire_matches_dense(tmp_path):
+    """The sparse ragged wire over a REAL cross-process allgather
+    (jax.distributed, 2 workers): a full soak on the sparse wire must
+    land on the SAME trained params as the dense exchange — the format
+    changes the bytes on the wire, never the training trajectory. The
+    workers also report the wire ledger: every worker ships
+    (capacity + header) int32 slots per bucket, nothing dense-sized."""
+    procs, outs = _spawn_pair(tmp_path, tmp_path / "ckWd", "clean", "wd")
+    logs = _wait_pair(procs)
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, f"dense worker {i}:\n{logs[i][-3000:]}"
+    dense = _load(outs)
+    assert dense[0]["done"] and dense[1]["done"]
+
+    procs, outs = _spawn_pair(tmp_path, tmp_path / "ckWs", "sparse", "ws")
+    logs = _wait_pair(procs)
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, f"sparse worker {i}:\n{logs[i][-3000:]}"
+    sparse = _load(outs)
+    assert sparse[0]["done"] and sparse[1]["done"]
+    # both workers of the sparse world agree exactly (replicated params)
+    assert sparse[0]["checksum"] == sparse[1]["checksum"]
+    # the sparse trajectory matches the dense one (float-reduction
+    # distance: the cross-process collective is allgather+chain instead
+    # of the backend's allreduce)
+    np.testing.assert_allclose(np.asarray(sparse[0]["losses"]),
+                               np.asarray(dense[0]["losses"]),
+                               rtol=0, atol=1e-6)
+    for k in dense[0]["params"]:
+        np.testing.assert_allclose(
+            np.asarray(sparse[0]["params"][k], np.float32),
+            np.asarray(dense[0]["params"][k], np.float32),
+            rtol=0, atol=1e-6, err_msg=f"param {k} diverged on the wire")
+    # wire ledger: the reported bytes are exactly the ragged format's
+    # (capacity + header) slots per worker per bucket
+    ws = sparse[0]["wire_stats"]
+    assert ws["wire_bytes"] == sum(ws["bucket_wire_bytes"])
+    for cap, b in zip(ws["wire_capacity"], ws["bucket_wire_bytes"]):
+        # 8 dp shards (4 devices × 2 processes), WIRE_HEADER=2 slots
+        assert b == (cap + 2) * 4 * 8
+
+
 @pytest.mark.slow   # suite diet (ISSUE 14): ~13 s two-process soak —
 # peer-loss containment stays tier-1 via the in-process
 # test_peer_lost_is_bounded_and_dumps + test_monitor_detects_silent_peer,

@@ -307,6 +307,9 @@ def test_ring_attention_flash_kernel_path(devices8):
                                    atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow   # suite diet (ISSUE 17): ~3.5 s — the flash kernel
+# path stays tier-1 via test_ring_attention_flash_kernel_path, and
+# causal ring numerics via test_ring_attention_causal
 def test_ring_attention_flash_causal_matches_dense(devices8):
     """Round-4: the CAUSAL ring now rides the flash kernels too — the
     diagonal ring step runs the causal kernel, past steps the full
@@ -427,6 +430,9 @@ def test_ulysses_attention_causal_and_head_check(devices8):
                                   jnp.asarray(bad))
 
 
+@pytest.mark.slow   # suite diet (ISSUE 17): ~6 s — ulysses numerics
+# stay tier-1 via test_ulysses_attention_matches_dense, and the BERT
+# integration via test_bert_masked_ring_matches_dense
 def test_bert_with_ulysses_attention_matches_dense(devices8):
     """Model-level sp swap: BERT-tiny loss under all-to-all attention ==
     the dense single-device path (same one-arg swap as ring)."""
@@ -620,6 +626,9 @@ def test_ring_attention_masked_flash_path(devices8):
                                    rtol=5e-3, atol=5e-4)
 
 
+@pytest.mark.slow   # suite diet (ISSUE 17): ~5.8 s — the masked flash
+# path stays tier-1 via test_ring_attention_masked_flash_path, and
+# ragged-mask numerics via test_ring_attention_masked_matches_dense
 def test_ring_attention_masked_flash_zero_length_and_bool_mask(devices8):
     """Review r5: a zero-length example must yield finite grads (the -inf
     merged lse maps back to the kernels' +1e30 sentinel in backward),
@@ -663,6 +672,9 @@ def test_ring_attention_masked_flash_zero_length_and_bool_mask(devices8):
     assert np.isfinite(np.asarray(gb)[1]).all()
 
 
+@pytest.mark.slow   # suite diet (ISSUE 17): ~3.8 s — causal masked
+# numerics stay tier-1 via test_ring_attention_masked_causal, the flash
+# lowering via test_ring_attention_masked_flash_path
 def test_ring_attention_masked_flash_causal_left_padding(devices8):
     """Review r5: causal + LEFT padding — valid query rows that causally
     see no valid key must not leak garbage gradients."""
