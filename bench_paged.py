@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""CPU microbench: paged KV cache vs slot-contiguous serving capacity
+at EQUAL HBM (generation/ — ISSUE 18), one JSON artifact.
+
+The claim under measurement is the paged-attention capacity argument:
+a slot-contiguous server must reserve `rung x slots` KV rows up front
+(every slot pays for the longest supportable request), while the paged
+server allocates fixed-size pages only for rows a sequence actually
+uses — so on a ragged-length request mix the same HBM holds several
+times more concurrent sequences. Both arms here get EXACTLY the same
+KV HBM budget and the same max-length support (rung 64 = bert-tiny's
+position ceiling):
+
+- **dense arm** — 4 slots x rung 64 = 256 contiguous KV rows.
+- **paged arm** — a 32-page pool of 8 rows each = 256 KV rows (one
+  page is the NULL write-sink, so 248 are allocatable — the paged arm
+  runs slightly UNDER the dense budget), 24 slots reading through the
+  per-slot page table.
+
+Workload: a ragged mix of 48 greedy requests sharing a 16-token system
+prefix (2 full pages, deduped by the prefix registry) with 0-3
+divergent tail tokens and 4-6 token budgets — every request needs
+<= 24 KV rows, so a dense slot wastes >= 40 of its 64 reserved rows
+while the paged arm pays ~1 private page past the shared prefix.
+
+Methodology is bench.py's median-of->=5-windows + recorded-spread
+(VERDICT r4: a point sample of a +-20%-noise distribution is not a
+measurement); one window = serve the full 48-request mix, with a
+watcher thread sampling the live slot occupancy for the peak.
+
+Headline `value` = peak concurrent sequences (paged) / dense slots at
+equal HBM — acceptance >= 4.0. The artifact also carries the
+prefix-dedup bytes-saved ledger (pages_reused x cache_page_bytes, fp
+AND int8 page costs — int8 pages halve again on top of paging) and the
+cross-arm token-identity verdict: the paged streams must equal the
+dense streams token for token (greedy streams are a pure function of
+the prompt, so they must survive the layout change AND the different
+slot count bit-exactly). `scripts/check_bench_regression.py` gates
+successive BENCH_PAGED_* artifacts on the headline via its `paths`
+knob (MULTIHOST_r01 precedent — a 6x capacity ratio must never
+compete with img/s headlines in the default BENCH_* trajectory).
+
+Run:  JAX_PLATFORMS=cpu python bench_paged.py
+"""
+import argparse
+import json
+import os
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import numpy as np
+
+# bench.py is import-safe (no device init at module scope) — share THE
+# windowing helper instead of copying it, so the methodology cannot
+# drift between benches
+from bench import _median_of_windows
+
+from deeplearning4j_tpu.generation import BertDecoder, GenerationServer
+from deeplearning4j_tpu.models.bert import bert_tiny, init_bert_params
+from deeplearning4j_tpu.quantize.kvcache import cache_page_bytes
+
+RUNG = 64            # bert-tiny position ceiling: both arms support it
+PBUCKET = 24
+PAGE_SIZE = 8
+DENSE_SLOTS = 4
+POOL_PAGES = 32      # 32 pages x 8 rows == 4 slots x 64 rows
+PAGED_SLOTS = 24
+N_REQUESTS = 48
+SYS_PREFIX = list(range(1, 17))   # 16 tokens = 2 full shared pages
+
+
+def _request_mix():
+    """48 ragged greedy requests over 6 prompt variants: the shared
+    system prefix plus 0-3 divergent tail tokens, budgets 4-6, every
+    request's prompt+generation <= 24 rows (3 pages)."""
+    variants = [
+        (SYS_PREFIX, 6),
+        (SYS_PREFIX + [21], 5),
+        (SYS_PREFIX + [22, 23], 6),
+        (SYS_PREFIX + [24], 4),
+        (SYS_PREFIX + [25, 26, 27], 5),
+        (SYS_PREFIX + [28, 29], 4),
+    ]
+    mix = [variants[i % len(variants)] for i in range(N_REQUESTS)]
+    assert all(len(p) + n <= PBUCKET for p, n in mix)
+    return mix
+
+
+def _serve_mix(srv, mix):
+    """One timed window: submit the whole mix, sample live slot
+    occupancy from a watcher thread, consume every stream. Returns
+    (streams, tokens_per_sec, peak_concurrent)."""
+    peak = [0]
+    done = threading.Event()
+
+    def watch():
+        while not done.is_set():
+            peak[0] = max(peak[0], len(srv._slot_req))
+            time.sleep(0.001)
+
+    w = threading.Thread(target=watch)
+    w.start()
+    t0 = time.perf_counter()
+    reqs = [srv.submit(list(p), max_new_tokens=n) for p, n in mix]
+    streams = [r.result(timeout=300) for r in reqs]
+    dt = time.perf_counter() - t0
+    done.set()
+    w.join()
+    toks = sum(len(s) for s in streams)
+    return streams, toks / dt, peak[0]
+
+
+def _run_arm(srv, mix, k_windows=5):
+    """Median tokens/s over independent windows; window 0's streams
+    and the max peak across windows ride along."""
+    state = {"streams": None, "peak": 0}
+
+    def window(i):
+        streams, rate, peak = _serve_mix(srv, mix)
+        if i == 0:
+            state["streams"] = streams
+        state["peak"] = max(state["peak"], peak)
+        return rate
+
+    rate, vals, spread = _median_of_windows(window, k=k_windows)
+    return {"rate": rate, "windows": [round(v, 1) for v in vals],
+            "spread_pct": round(spread * 100, 1),
+            "streams": state["streams"], "peak": state["peak"]}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="BENCH_PAGED_fresh.json")
+    ap.add_argument("--windows", type=int, default=5)
+    args = ap.parse_args(argv)
+
+    cfg = bert_tiny()
+    params = init_bert_params(cfg, jax.random.PRNGKey(0))
+    mix = _request_mix()
+    row_bytes = 2 * cfg.num_layers * cfg.num_heads * cfg.head_dim * 4
+    dense_bytes = DENSE_SLOTS * RUNG * row_bytes
+    page_fp = cache_page_bytes(cfg.num_layers, cfg.num_heads, PAGE_SIZE,
+                               cfg.head_dim)
+    page_i8 = cache_page_bytes(cfg.num_layers, cfg.num_heads, PAGE_SIZE,
+                               cfg.head_dim, kv_dtype="int8")
+    paged_bytes = POOL_PAGES * page_fp
+    assert paged_bytes == dense_bytes, (paged_bytes, dense_bytes)
+
+    print(f"# dense arm: {DENSE_SLOTS} slots x rung {RUNG} "
+          f"({dense_bytes} KV bytes)")
+    dense_srv = GenerationServer(
+        BertDecoder(cfg, params), slots=DENSE_SLOTS,
+        cache_lengths=[RUNG], prompt_buckets=[PBUCKET],
+        method="greedy", seed=0)
+    dense_srv.warmup()
+    try:
+        dense = _run_arm(dense_srv, mix, k_windows=args.windows)
+    finally:
+        dense_srv.shutdown()
+    print(f"# dense: {dense['rate']:.1f} tok/s, "
+          f"peak {dense['peak']} concurrent")
+
+    print(f"# paged arm: {PAGED_SLOTS} slots over a {POOL_PAGES}-page "
+          f"pool ({paged_bytes} KV bytes)")
+    paged_srv = GenerationServer(
+        BertDecoder(cfg, params, page_size=PAGE_SIZE,
+                    pool_pages=POOL_PAGES),
+        slots=PAGED_SLOTS, cache_lengths=[RUNG],
+        prompt_buckets=[PBUCKET], method="greedy", seed=0)
+    paged_srv.warmup()
+    try:
+        paged = _run_arm(paged_srv, mix, k_windows=args.windows)
+        pool = {**paged_srv._pages.occupancy(), **paged_srv._pages.stats}
+    finally:
+        paged_srv.shutdown()
+    print(f"# paged: {paged['rate']:.1f} tok/s, "
+          f"peak {paged['peak']} concurrent, "
+          f"{pool['prefix_hits']} prefix hits")
+
+    identical = dense["streams"] == paged["streams"]
+    assert identical, "paged streams diverged from dense streams"
+    value = round(paged["peak"] / DENSE_SLOTS, 2)
+
+    doc = {
+        "model": "bert_tiny",
+        "rung": RUNG,
+        "prompt_bucket": PBUCKET,
+        "page_size": PAGE_SIZE,
+        "requests": N_REQUESTS,
+        "shared_prefix_tokens": len(SYS_PREFIX),
+        "dense": {"slots": DENSE_SLOTS, "kv_bytes": dense_bytes,
+                  "tok_per_s": round(dense["rate"], 1),
+                  "windows": dense["windows"],
+                  "spread_pct": dense["spread_pct"],
+                  "peak_concurrent": dense["peak"]},
+        "paged": {"slots": PAGED_SLOTS, "pool_pages": POOL_PAGES,
+                  "kv_bytes": paged_bytes,
+                  "tok_per_s": round(paged["rate"], 1),
+                  "windows": paged["windows"],
+                  "spread_pct": paged["spread_pct"],
+                  "peak_concurrent": paged["peak"],
+                  "pool": pool},
+        "prefix_dedup": {
+            "prefix_hits": pool["prefix_hits"],
+            "pages_reused": pool["pages_reused"],
+            "cow_copies": pool["cow_copies"],
+            "page_bytes_fp": page_fp,
+            "page_bytes_int8": page_i8,
+            "bytes_saved": pool["pages_reused"] * page_fp,
+        },
+        "token_identity": {"requests": N_REQUESTS,
+                           "identical": identical},
+        "value": value,
+        "metric": "paged_concurrent_seqs_vs_dense_equal_hbm",
+        "unit": "x",
+        "provenance": {"host": "cpu", "jax": jax.__version__,
+                       "windows": args.windows},
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(f"# headline: {value}x concurrent sequences at equal HBM "
+          f"-> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -23,10 +23,25 @@ The contract (all pure functions, traced into AOT executables by the
 server — nothing here may touch the host):
 
     model_args()                  -> tuple of non-donated leading args
-    init_cache(slots, cache_len)  -> donated cache pytree
     step(margs, cache, tokens, pos)            -> (logits (S,V), cache')
     prefill(margs, cache, slot, prompt, plen)  -> (cache', logits (V,))
     grow(cache, new_len)          -> cache padded to a longer rung
+    init_cache(slots, cache_len)  -> donated cache pytree
+
+PAGED mode (`BertDecoder(..., page_size=ps, pool_pages=P)`): the cache
+pytree becomes a pooled layout `(L, P, H, ps, Dh)` — P fixed-size pages
+shared by every slot — and `step`/`verify`/`prefill` take the per-slot
+page index the host allocator (generation/paging.py) computes between
+dispatches (`ptab` (S, rung//ps) for decode reads/writes, `wrow`
+(ceil(P_bucket/ps),) write-redirect for prefill). Physical page 0 is the
+null page: unmapped reads land there (hidden by the cache mask) and
+redundant writes (shared-prefix re-prefill, frozen-lane rewrites past a
+request's budget) are redirected into it. `grow` is the identity — the
+pool is rung-independent; a rung only sets the gathered view width — and
+`page_copy` is the copy-on-write primitive. Attention reads through
+`flash_attention_decode_paged` / `_mq_paged`, whose gather feeds the
+UNCHANGED masked-softmax arithmetic, so paged streams are bit-identical
+to slot-contiguous ones.
 """
 from __future__ import annotations
 
@@ -37,7 +52,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from deeplearning4j_tpu.kernels.flash_attention import (
-    flash_attention, flash_attention_decode, flash_attention_decode_mq)
+    flash_attention, flash_attention_decode, flash_attention_decode_mq,
+    flash_attention_decode_mq_paged, flash_attention_decode_paged)
 from deeplearning4j_tpu.models.bert import (_ffn, _layer_norm,
                                             bert_mlm_logits)
 from deeplearning4j_tpu.parallel.ring_attention import dense_attention
@@ -62,7 +78,8 @@ class BertDecoder:
     uses_cache_rungs = True
     n_model_args = 1
 
-    def __init__(self, cfg, params, attn_impl="auto", kv_dtype="fp"):
+    def __init__(self, cfg, params, attn_impl="auto", kv_dtype="fp",
+                 page_size=None, pool_pages=None):
         if cfg.moe_layers:
             raise ValueError(
                 "BertDecoder does not support MoE layers (dense-dispatch "
@@ -90,10 +107,37 @@ class BertDecoder:
         self.kv_dtype = kv_dtype
         self.vocab_size = int(cfg.vocab_size)
         self.max_cache_len = int(cfg.max_position_embeddings)
+        # paged KV: pool_pages fixed-size pages of page_size rows each,
+        # shared by all slots through a per-slot page index (page 0 is
+        # the null page — see generation/paging.py for the layout
+        # contract). pool_pages is the explicit HBM knob: a ragged
+        # request costs ceil(len/ps) pages instead of a whole rung.
+        self.paged = page_size is not None
+        if self.paged:
+            self.page_size = int(page_size)
+            if self.page_size < 1:
+                raise ValueError(
+                    f"page_size must be >= 1, got {page_size}")
+            if pool_pages is None:
+                raise ValueError(
+                    "paged mode needs an explicit pool_pages — the page "
+                    "pool (not the rung) is the real HBM budget; "
+                    "slots * rung // page_size + 1 reproduces the "
+                    "slot-contiguous footprint")
+            self.pool_pages = int(pool_pages)
+            if self.pool_pages < 2:
+                raise ValueError(
+                    f"pool_pages must be >= 2 (null page + 1), "
+                    f"got {pool_pages}")
+        else:
+            if pool_pages is not None:
+                raise ValueError("pool_pages requires page_size")
+            self.page_size = self.pool_pages = None
 
     def fingerprint(self):
         parts = ("bert-decode", repr(self.cfg), self.attn_impl,
-                 self.kv_dtype, _shape_tree_repr(self.params))
+                 self.kv_dtype, self.page_size, self.pool_pages,
+                 _shape_tree_repr(self.params))
         return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
 
     def model_args(self):
@@ -101,8 +145,15 @@ class BertDecoder:
 
     def init_cache(self, slots, cache_len):
         cfg = self.cfg
-        shape = (cfg.num_layers, slots, cfg.num_heads, cache_len,
-                 cfg.head_dim)
+        if self.paged:
+            # pooled pages, slot- and rung-independent: the rung only
+            # sets the gathered view width (ptab columns); HBM is
+            # pool_pages × page_size rows, int8 halving page bytes
+            shape = (cfg.num_layers, self.pool_pages, cfg.num_heads,
+                     self.page_size, cfg.head_dim)
+        else:
+            shape = (cfg.num_layers, slots, cfg.num_heads, cache_len,
+                     cfg.head_dim)
         if self.kv_dtype == "int8":
             return {"k": jnp.zeros(shape, jnp.int8),
                     "v": jnp.zeros(shape, jnp.int8),
@@ -112,6 +163,8 @@ class BertDecoder:
         return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
     def grow(self, cache, new_len):
+        if self.paged:      # the pool is rung-independent
+            return cache
         pad = [(0, 0)] * 5
         pad[3] = (0, int(new_len) - cache["k"].shape[3])
         out = {"k": jnp.pad(cache["k"], pad),
@@ -119,6 +172,19 @@ class BertDecoder:
         if "ks" in cache:   # scale rows pad at 1 (zero rows round-trip)
             out["ks"] = jnp.pad(cache["ks"], pad[:4], constant_values=1.0)
             out["vs"] = jnp.pad(cache["vs"], pad[:4], constant_values=1.0)
+        return out
+
+    def page_copy(self, cache, src, dst):
+        """Copy physical page `src` over `dst` across every layer and
+        pool leaf — the copy-on-write primitive: the host allocator
+        dispatches this (pre-compiled, donated) before the first block
+        that would write into a shared page."""
+        out = {}
+        for name, t in cache.items():
+            zeros = (0,) * (t.ndim - 2)
+            pg = lax.dynamic_slice(
+                t, (0, src) + zeros, (t.shape[0], 1) + t.shape[2:])
+            out[name] = lax.dynamic_update_slice(t, pg, (0, dst) + zeros)
         return out
 
     def _embed(self, params, tokens, pos):
@@ -143,6 +209,16 @@ class BertDecoder:
         return flash_attention_decode(q, kc, vc, cmask, impl=impl,
                                       k_scale=ks, v_scale=vs)
 
+    def _decode_attn_paged(self, q, kp, vp, ptab, cmask, ks=None,
+                           vs=None):
+        impl = self.attn_impl
+        if impl == "auto":
+            impl = ("pallas" if jax.default_backend() == "tpu"
+                    and ks is None else "dense")
+        return flash_attention_decode_paged(q, kp, vp, ptab, cmask,
+                                            impl=impl, k_scale_pool=ks,
+                                            v_scale_pool=vs)
+
     def _prefill_attn(self, q, k, v):
         if self.attn_impl == "pallas" or (
                 self.attn_impl == "auto"
@@ -150,12 +226,18 @@ class BertDecoder:
             return flash_attention(q, k, v, causal=True)
         return dense_attention(q, k, v, causal=True)
 
-    def step(self, margs, cache, tokens, pos):
+    def step(self, margs, cache, tokens, pos, ptab=None):
         """One decode step for the whole batch: embed `tokens` at their
         slot positions, write each slot's K/V row at `pos`, attend the
         single query over rows 0..pos, and return next-token logits.
         `pos[s]` = number of already-cached tokens in slot s (the
-        position the current token occupies)."""
+        position the current token occupies). Paged mode additionally
+        takes `ptab` (S, maxp) int32 — reads gather through it, the
+        row write lands in page `pos // ps` at offset `pos % ps`, and
+        frozen-lane writes past the mapped view (pos == C) are
+        redirected to the null page (a dense cache silently DROPS that
+        out-of-range scatter; pages must redirect it explicitly or the
+        clamped index would corrupt a live row)."""
         (params,) = margs
         cfg = self.cfg
         x = self._embed(params, tokens, pos)            # (S, H)
@@ -165,8 +247,17 @@ class BertDecoder:
         vs = cache.get("vs")
         s = tokens.shape[0]
         ar = jnp.arange(s)
-        c = kc.shape[3]
         nh, hd = cfg.num_heads, cfg.head_dim
+        paged = self.paged
+        if paged:
+            psz = self.page_size
+            maxp = ptab.shape[1]
+            c = maxp * psz
+            poff = pos % psz
+            phys = ptab[ar, jnp.minimum(pos // psz, maxp - 1)]
+            wphys = jnp.where(pos < c, phys, 0)         # (S,)
+        else:
+            c = kc.shape[3]
         # rows 0..pos are valid (the current write included)
         cmask = jnp.arange(c)[None, :] <= pos[:, None]  # (S, C)
         dt = x.dtype
@@ -182,14 +273,26 @@ class BertDecoder:
                     quantize_rows
                 k, k_sc = quantize_rows(k)
                 v, v_sc = quantize_rows(v)
-                ks = ks.at[li, ar, :, pos].set(k_sc)
-                vs = vs.at[li, ar, :, pos].set(v_sc)
-            kc = kc.at[li, ar, :, pos].set(k.astype(kc.dtype))
-            vc = vc.at[li, ar, :, pos].set(v.astype(vc.dtype))
-            ctx = self._decode_attn(
-                q, kc[li], vc[li], cmask,
-                ks[li] if int8_kv else None,
-                vs[li] if int8_kv else None).astype(dt)
+                if paged:
+                    ks = ks.at[li, wphys, :, poff].set(k_sc)
+                    vs = vs.at[li, wphys, :, poff].set(v_sc)
+                else:
+                    ks = ks.at[li, ar, :, pos].set(k_sc)
+                    vs = vs.at[li, ar, :, pos].set(v_sc)
+            if paged:
+                kc = kc.at[li, wphys, :, poff].set(k.astype(kc.dtype))
+                vc = vc.at[li, wphys, :, poff].set(v.astype(vc.dtype))
+                ctx = self._decode_attn_paged(
+                    q, kc[li], vc[li], ptab, cmask,
+                    ks[li] if int8_kv else None,
+                    vs[li] if int8_kv else None).astype(dt)
+            else:
+                kc = kc.at[li, ar, :, pos].set(k.astype(kc.dtype))
+                vc = vc.at[li, ar, :, pos].set(v.astype(vc.dtype))
+                ctx = self._decode_attn(
+                    q, kc[li], vc[li], cmask,
+                    ks[li] if int8_kv else None,
+                    vs[li] if int8_kv else None).astype(dt)
             a = ctx.reshape(s, cfg.hidden_size) \
                 @ layer["proj_W"].astype(dt) + layer["proj_b"].astype(dt)
             x = _layer_norm(x + a, layer["ln1_scale"], layer["ln1_bias"],
@@ -211,7 +314,7 @@ class BertDecoder:
         drafting is fp-cache only."""
         return self.kv_dtype == "fp"
 
-    def verify(self, margs, cache, tokens, pos, draft):
+    def verify(self, margs, cache, tokens, pos, draft, ptab=None):
         """Draft-block decode: for each slot, run the q-block
         ``[tokens[s], draft[s, 0], ..., draft[s, d-2]]`` at positions
         ``pos[s] .. pos[s]+d-1`` through the stack in ONE dispatch —
@@ -234,8 +337,18 @@ class BertDecoder:
         x = self._embed(params, tok_block, pos_block)       # (S, d, H)
         kc, vc = cache["k"], cache["v"]
         ar = jnp.arange(s)
-        c = kc.shape[3]
         nh, hd = cfg.num_heads, cfg.head_dim
+        paged = self.paged
+        if paged:
+            psz = self.page_size
+            maxp = ptab.shape[1]
+            c = maxp * psz
+            poff = pos_block % psz                          # (S, d)
+            phys = ptab[ar[:, None],
+                        jnp.minimum(pos_block // psz, maxp - 1)]
+            wphys = jnp.where(pos_block < c, phys, 0)       # (S, d)
+        else:
+            c = kc.shape[3]
         # query j sees rows 0..pos+j (its own write included)
         qmask = jnp.arange(c)[None, None, :] <= pos_block[:, :, None]
         dt = x.dtype
@@ -248,12 +361,18 @@ class BertDecoder:
             v = v.reshape(s, d, nh, hd)
             # advanced-index write: rows pos..pos+d-1 of every slot
             # (the advanced (S, d) block leads, then the H and Dh dims)
-            kc = kc.at[li, ar[:, None], :, pos_block].set(
-                k.astype(kc.dtype))
-            vc = vc.at[li, ar[:, None], :, pos_block].set(
-                v.astype(vc.dtype))
-            ctx = flash_attention_decode_mq(q, kc[li], vc[li],
-                                            qmask).astype(dt)
+            if paged:
+                kc = kc.at[li, wphys, :, poff].set(k.astype(kc.dtype))
+                vc = vc.at[li, wphys, :, poff].set(v.astype(vc.dtype))
+                ctx = flash_attention_decode_mq_paged(
+                    q, kc[li], vc[li], ptab, qmask).astype(dt)
+            else:
+                kc = kc.at[li, ar[:, None], :, pos_block].set(
+                    k.astype(kc.dtype))
+                vc = vc.at[li, ar[:, None], :, pos_block].set(
+                    v.astype(vc.dtype))
+                ctx = flash_attention_decode_mq(q, kc[li], vc[li],
+                                                qmask).astype(dt)
             a = ctx.transpose(0, 2, 1, 3).reshape(s, d, cfg.hidden_size) \
                 @ layer["proj_W"].astype(dt) + layer["proj_b"].astype(dt)
             x = _layer_norm(x + a, layer["ln1_scale"], layer["ln1_bias"],
@@ -264,13 +383,39 @@ class BertDecoder:
         logits = bert_mlm_logits(cfg, params, x)            # (S, d, V)
         return logits, {"k": kc, "v": vc}
 
-    def prefill(self, margs, cache, slot, prompt, plen):
+    def _write_prompt_pages(self, pool, block, wrow, li):
+        """Scatter a prefill K/V (or scale) block into pool pages:
+        `pool` is the full (L, P, nh, ps, ...) pool, `block` the layer's
+        (nh, P_bucket, ...) rows, `wrow[j]` the physical page logical
+        page j writes into — 0 (the null page) for pages whose bytes
+        already exist on device (shared-prefix hit) or that hold only
+        bucket padding, so redundant writes are discarded without
+        branching."""
+        psz = self.page_size
+        npp = wrow.shape[0]
+        pad = [(0, 0)] * block.ndim
+        pad[1] = (0, npp * psz - block.shape[1])
+        # (nh, npp·ps, ...) -> per-page (1, 1, nh, ps, ...) updates
+        pages = jnp.pad(block, pad).reshape(
+            (block.shape[0], npp, psz) + block.shape[2:])
+        for j in range(npp):
+            upd = pages[:, j][None, None]
+            pool = lax.dynamic_update_slice(
+                pool, upd.astype(pool.dtype),
+                (li, wrow[j]) + (0,) * (pool.ndim - 2))
+        return pool
+
+    def prefill(self, margs, cache, slot, prompt, plen, wrow=None):
         """Causal full forward over one length-bucketed prompt (1, P);
         writes the slot's K/V block for rows 0..P-1 in one shot and
         returns the logits at the last REAL position (plen - 1). Rows
         beyond plen hold padding garbage — masked out by the decode
         cache mask (pos starts at plen), so a bucketed prompt serves
-        bit-the-same as an exact-length one."""
+        bit-the-same as an exact-length one. Paged mode writes through
+        the `wrow` redirect instead of the slot's rows (see
+        `_write_prompt_pages`); the forward itself is identical, so a
+        shared-prefix admission still yields exact first-token
+        logits."""
         (params,) = margs
         cfg = self.cfg
         p_len = prompt.shape[0]
@@ -281,6 +426,7 @@ class BertDecoder:
                         emb["ln_bias"], cfg.layer_norm_eps)
         kc, vc = cache["k"], cache["v"]
         int8_kv = self.kv_dtype == "int8"
+        paged = self.paged
         ks = cache.get("ks")
         vs = cache.get("vs")
         nh, hd = cfg.num_heads, cfg.head_dim
@@ -299,14 +445,23 @@ class BertDecoder:
                     quantize_rows
                 kq, k_sc = quantize_rows(k)             # (1, nh, P)
                 vq, v_sc = quantize_rows(v)
-                kc = lax.dynamic_update_slice(
-                    kc, kq[None], (li, slot, 0, 0, 0))
-                vc = lax.dynamic_update_slice(
-                    vc, vq[None], (li, slot, 0, 0, 0))
-                ks = lax.dynamic_update_slice(
-                    ks, k_sc[None], (li, slot, 0, 0))
-                vs = lax.dynamic_update_slice(
-                    vs, v_sc[None], (li, slot, 0, 0))
+                if paged:
+                    kc = self._write_prompt_pages(kc, kq[0], wrow, li)
+                    vc = self._write_prompt_pages(vc, vq[0], wrow, li)
+                    ks = self._write_prompt_pages(ks, k_sc[0], wrow, li)
+                    vs = self._write_prompt_pages(vs, v_sc[0], wrow, li)
+                else:
+                    kc = lax.dynamic_update_slice(
+                        kc, kq[None], (li, slot, 0, 0, 0))
+                    vc = lax.dynamic_update_slice(
+                        vc, vq[None], (li, slot, 0, 0, 0))
+                    ks = lax.dynamic_update_slice(
+                        ks, k_sc[None], (li, slot, 0, 0))
+                    vs = lax.dynamic_update_slice(
+                        vs, v_sc[None], (li, slot, 0, 0))
+            elif paged:
+                kc = self._write_prompt_pages(kc, k[0], wrow, li)
+                vc = self._write_prompt_pages(vc, v[0], wrow, li)
             else:
                 kc = lax.dynamic_update_slice(
                     kc, k[None].astype(kc.dtype), (li, slot, 0, 0, 0))

@@ -59,6 +59,28 @@ serialized — a restarted replica warms from disk):
 - ``("advance_key_n",)`` — advance one rng key past n consumed
   sampling splits in a single dispatch (the crash-replay
   continuation-key derivation).
+- ``("page_copy",)`` — copy one physical KV page pool→pool (paged
+  servers only: the copy-on-write primitive behind prefix sharing).
+
+Paged KV mode (decoder built with ``page_size``/``pool_pages``): the
+cache is a fixed pool of physical pages instead of S contiguous rung
+rows, and every executable additionally threads a host-built page
+index — superstep/verify take the ``(S, rung // page_size)`` int32 page
+table, admit takes the per-logical-page write-redirect row. The pool is
+RUNG-INDEPENDENT, so ``grow`` degenerates to a host-side rung relabel
+(no dispatch, no per-rung-pair executables); the rung only sets the
+page-table width the dispatch reads through. Between dispatches the
+host `PageAllocator` (generation/paging.py) maps prompt pages with
+hash-of-prefix dedup (identical prefixes share read-only pages),
+allocates write coverage for the next block, and copy-on-writes shared
+pages before their first divergent write — each CoW is one pre-compiled
+``("page_copy",)`` dispatch. Page bookkeeping is pure host numpy on the
+existing dispatch boundaries: zero extra syncs, zero traces (linted).
+Pool exhaustion raises the typed `PagePoolExhaustedError` — refused
+pre-dispatch at admission (fails only that request), and mid-stream it
+carries the RESOURCE_EXHAUSTED token so the OOM classifier routes it
+through the degradation ladder, whose paged form gains an
+evict-cold-pages level between shed-queued and shrink-rung.
 
 Survivability (the serving twin of the PR 5/7 training guardian):
 
@@ -92,8 +114,9 @@ Survivability (the serving twin of the PR 5/7 training guardian):
 
 Admission rides the same bounded-enqueue/shed semantics as
 `ParallelInference` (`InferenceOverloadedError`, enqueue timeout).
-Chaos fault sites: `generation.step`, `generation.admit`, `cache.grow`
-(resilience/faults.py) fire inside the loop at zero disabled-path cost.
+Chaos fault sites: `generation.step`, `generation.admit`, `cache.grow`,
+and (paged servers) `cache.page` (resilience/faults.py) fire inside the
+loop at zero disabled-path cost.
 """
 from __future__ import annotations
 
@@ -111,6 +134,7 @@ from jax import lax
 
 from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.monitoring import requests as _req
+from deeplearning4j_tpu.generation.paging import PageAllocator
 from deeplearning4j_tpu.generation.sampling import (GREEDY, method_id,
                                                     sample_step,
                                                     split_keys)
@@ -118,6 +142,7 @@ from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience.errors import (InferenceOverloadedError,
                                                   InferenceTimeoutError,
                                                   MemoryPressureError,
+                                                  PagePoolExhaustedError,
                                                   ReplayDivergedError,
                                                   ServerDeadError)
 from deeplearning4j_tpu.resilience.policy import RetryPolicy
@@ -222,15 +247,20 @@ class _SlotJournal:
     function of this record, which is exactly what `_replay_one` needs
     to continue an interrupted request bit-identically. While a
     re-generation replay is in flight, `expect` holds the
-    already-delivered prefix and `replay_idx` the suppression cursor."""
+    already-delivered prefix and `replay_idx` the suppression cursor.
+    `disp_pos` (paged servers) is the host upper bound of KV rows whose
+    writes have been dispatched — the page allocator covers
+    `[disp_pos, disp_pos + k)` before each block, so live writes always
+    land on mapped private pages without ever syncing device `pos`."""
 
-    __slots__ = ("req", "admit_id", "expect", "replay_idx")
+    __slots__ = ("req", "admit_id", "expect", "replay_idx", "disp_pos")
 
     def __init__(self, req, admit_id):
         self.req = req
         self.admit_id = admit_id
         self.expect = None
         self.replay_idx = 0
+        self.disp_pos = 0
 
 
 class _Block:
@@ -339,6 +369,19 @@ class GenerationServer:
                 f"top cache rung {rungs[-1]} exceeds the model's "
                 f"maximum decodable length {decoder.max_cache_len}")
         self.cache_lengths = rungs
+        #: paged-KV mode: decoder stores KV in a physical page pool and
+        #: every dispatch reads through a host-built page table
+        self.paged = bool(getattr(decoder, "paged", False))
+        if self.paged:
+            ps = int(decoder.page_size)
+            bad = [c for c in rungs if c % ps]
+            if bad:
+                raise ValueError(
+                    f"paged decode needs cache rungs divisible by the "
+                    f"page size {ps}: {bad}")
+            self._pages = PageAllocator(decoder.pool_pages, ps)
+        else:
+            self._pages = None
         if prompt_buckets is None:
             prompt_buckets, b = [], 8
             while b < rungs[-1]:
@@ -406,7 +449,8 @@ class GenerationServer:
         self._work = threading.Event()
         self._shutdown = False
         self._dead = None            # typed ServerDeadError once latched
-        self._pressure = 0           # degradation-ladder level (0..3)
+        self._pressure = 0           # ladder level (0..3; paged 0..4)
+        self._page_counts = {"prefix_hits": 0, "evictions": 0}
         self._rung_cap = None        # growth cap while under pressure
         self._clean_steps = 0        # steps since the last OOM event
         self._pressure_ts = 0.0      # monotonic time of last escalation
@@ -451,6 +495,12 @@ class GenerationServer:
                        donate_argnums=self._donate_range())
         store.register("retire", self._traced_retire,
                        donate_argnums=(0, 1, 2))
+        if self.paged:
+            store.register(
+                "page_copy",
+                lambda cache, src, dst: self.decoder.page_copy(
+                    cache, src, dst),
+                donate_argnums=(0,))
         store.register(
             "advance_key_n",
             lambda k, n: lax.fori_loop(
@@ -465,27 +515,38 @@ class GenerationServer:
             margs_spec = jax.tree_util.tree_map(
                 lambda l: sds(jnp.shape(l), jnp.result_type(l)),
                 self._margs)
+            # paged mode threads the page table through every decode
+            # dispatch; its width is the rung's page count
+            ptab = ((sds((self.slots,
+                          rung // self.decoder.page_size), jnp.int32),)
+                    if self.paged else ())
             if self.draft:
                 key = ("verify", rung, self.draft)
                 e = store.load_or_compile(
                     key, (*margs_spec, *spec, slot_i, slot_i,
                           sds((self.slots, self.draft), jnp.int32),
-                          slot_i))
+                          slot_i, *ptab))
             else:
                 key = ("superstep", rung, self.superstep)
                 e = store.load_or_compile(
-                    key, (*margs_spec, *spec, slot_i, slot_i))
+                    key, (*margs_spec, *spec, slot_i, slot_i, *ptab))
             self._exes[key] = e.call
             for p in self.prompt_buckets:
                 if p > rung:
                     continue
+                wrow = ((sds((-(-p // self.decoder.page_size),),
+                             jnp.int32),) if self.paged else ())
                 key = ("admit", rung, p)
                 e = store.load_or_compile(
                     key, (*margs_spec, *spec, scalar_i,
                           sds((p,), jnp.int32), scalar_i,
                           sds((2,), jnp.uint32), scalar_i, scalar_f,
-                          scalar_i))
+                          scalar_i, *wrow))
                 self._exes[key] = e.call
+            if self.paged:
+                # the pool is rung-independent: growth is a host-side
+                # rung relabel, no grow executables exist
+                continue
             for bigger in self.cache_lengths[ci + 1:]:
                 name = f"grow_to_{bigger}"
                 store.register(
@@ -506,6 +567,12 @@ class GenerationServer:
         e = store.load_or_compile(key, (sds((2,), jnp.uint32),
                                         scalar_i))
         self._exes[key] = e.call
+        if self.paged:
+            key = ("page_copy",)
+            e = store.load_or_compile(
+                key, (self._state_spec(self.cache_lengths[0])[_CACHE],
+                      scalar_i, scalar_i))
+            self._exes[key] = e.call
         self._store = store
         self._rung = self.cache_lengths[0]
         self._state = self._init_state(self._rung)
@@ -560,13 +627,22 @@ class GenerationServer:
         def superstep(*args):
             n = self.decoder.n_model_args
             margs = args[:n]
-            (cache, pos, active, tokens, rng, method, temp, topk,
-             eos, budget) = args[n:]
+            if self.paged:
+                (cache, pos, active, tokens, rng, method, temp, topk,
+                 eos, budget, ptab) = args[n:]
+            else:
+                (cache, pos, active, tokens, rng, method, temp, topk,
+                 eos, budget) = args[n:]
+                ptab = None
 
             def body(carry, _):
                 cache, pos, active, tokens, rng, budget = carry
-                logits, cache = self.decoder.step(margs, cache, tokens,
-                                                  pos)
+                if ptab is None:
+                    logits, cache = self.decoder.step(margs, cache,
+                                                      tokens, pos)
+                else:
+                    logits, cache = self.decoder.step(margs, cache,
+                                                      tokens, pos, ptab)
                 sampled, rng = sample_step(logits, rng, method, temp,
                                            topk)
                 out = jnp.where(active, sampled, -1)
@@ -603,10 +679,16 @@ class GenerationServer:
         def verify(*args):
             n = self.decoder.n_model_args
             margs = args[:n]
-            (cache, pos, active, tokens, rng, method, temp, topk,
-             eos, budget, draft, dlen) = args[n:]
-            logits, cache = self.decoder.verify(margs, cache, tokens,
-                                                pos, draft)  # (S, d, V)
+            if self.paged:
+                (cache, pos, active, tokens, rng, method, temp, topk,
+                 eos, budget, draft, dlen, ptab) = args[n:]
+                logits, cache = self.decoder.verify(
+                    margs, cache, tokens, pos, draft, ptab)  # (S, d, V)
+            else:
+                (cache, pos, active, tokens, rng, method, temp, topk,
+                 eos, budget, draft, dlen) = args[n:]
+                logits, cache = self.decoder.verify(
+                    margs, cache, tokens, pos, draft)        # (S, d, V)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             # position 0 samples with the slot's own config (ONE split
             # per round — greedy slots ignore the key, sampled slots
@@ -644,10 +726,16 @@ class GenerationServer:
     def _traced_admit(self, *args):
         n = self.decoder.n_model_args
         margs = args[:n]
-        (cache, pos, active, tokens, rng, method, temp, topk,
-         slot, prompt, plen, key, m, t, k) = args[n:]
-        cache, logits = self.decoder.prefill(margs, cache, slot, prompt,
-                                             plen)
+        if self.paged:
+            (cache, pos, active, tokens, rng, method, temp, topk,
+             slot, prompt, plen, key, m, t, k, wrow) = args[n:]
+            cache, logits = self.decoder.prefill(margs, cache, slot,
+                                                 prompt, plen, wrow)
+        else:
+            (cache, pos, active, tokens, rng, method, temp, topk,
+             slot, prompt, plen, key, m, t, k) = args[n:]
+            cache, logits = self.decoder.prefill(margs, cache, slot,
+                                                 prompt, plen)
         first, key2 = sample_step(logits[None], key[None], m[None],
                                   t[None], k[None])
         pos = pos.at[slot].set(plen)
@@ -842,17 +930,37 @@ class GenerationServer:
         if needed > rung or pbucket > rung:
             rung = self._rung_for(needed, pbucket)
             self._check_growth(rung)    # raises MemoryPressureError
+        if self._pages is not None and _faults.ACTIVE is not None:
+            # fired BEFORE the slot pop: an injected admission-time
+            # pool fault (MemoryPressureError-classified) is contained
+            # to the request without leaking the slot
+            _faults.ACTIVE.fire(_faults.CACHE_PAGE)
         slot = self._free.pop()
+        wrow = None
+        if self._pages is not None:
+            try:
+                wrow = self._pages.admit_slot(slot, prompt, pbucket)
+            except PagePoolExhaustedError:
+                # PRE-dispatch refusal (allocations rolled back): the
+                # slot goes back untouched and only this request fails
+                self._free.append(slot)
+                raise
+            rec.disp_pos = plen
         self._slot_req[slot] = rec
         if rung != self._rung:
             if _faults.ACTIVE is not None:
                 _faults.ACTIVE.fire(_faults.CACHE_GROW)
             if req.trace is not None:
                 req.trace.event("grow", to_rung=rung)
-            call = self._exes[(f"grow_to_{rung}", self._rung)]
-            cache = call(self._state[_CACHE])
-            self._state = (cache,) + self._state[1:]
-            self._rung = rung
+            if self._pages is not None:
+                # the pool is rung-independent: growth just widens the
+                # page table the next dispatches read through
+                self._rung = rung
+            else:
+                call = self._exes[(f"grow_to_{rung}", self._rung)]
+                cache = call(self._state[_CACHE])
+                self._state = (cache,) + self._state[1:]
+                self._rung = rung
         if req.trace is not None:
             req.trace.event("admit", slot=slot, rung=rung,
                             bucket=pbucket, admit_id=rec.admit_id)
@@ -861,10 +969,14 @@ class GenerationServer:
         if _faults.ACTIVE is not None:
             _faults.ACTIVE.fire(_faults.GENERATION_ADMIT)
         call = self._exes[("admit", rung, pbucket)]
+        extra = () if wrow is None else (wrow,)
         out = call(*self._margs, *self._state, np.int32(slot), padded,
                    np.int32(plen), key, np.int32(req.method),
-                   np.float32(req.temperature), np.int32(req.top_k))
+                   np.float32(req.temperature), np.int32(req.top_k),
+                   *extra)
         self._state = tuple(out[:8])
+        if self._pages is not None:
+            self._emit_page_metrics()
         first = int(self._fetch_tokens(out[8]))
         self._deliver(slot, rec, first)
 
@@ -928,6 +1040,61 @@ class GenerationServer:
                 dlen[slot] = len(tail)
         return draft, dlen
 
+    def _page_args(self, k):
+        """Paged-mode page prep for one decode block (host work on the
+        dispatch boundary — zero syncs): guarantee every occupied slot
+        owns writable pages for its next `k` KV rows — allocating fresh
+        pages and copy-on-writing shared ones (each CoW is one tiny
+        pre-compiled `("page_copy",)` dispatch) — then materialize the
+        page table at the current rung width. Coverage is clipped to
+        the request's total row need; a frozen lane's held-position
+        rewrite past that lands on the null page by construction
+        (unmapped table entries are 0)."""
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.fire(_faults.CACHE_PAGE)
+        copy = self._exes[("page_copy",)]
+        for slot, rec in self._slot_req.items():
+            req = rec.req
+            needed = int(req.prompt.size) + req.max_new_tokens
+            hi = min(rec.disp_pos + k, needed)
+            if hi <= rec.disp_pos:
+                continue
+            for src, dst in self._pages.ensure_range(slot, rec.disp_pos,
+                                                     hi - 1):
+                cache = copy(self._state[_CACHE], np.int32(src),
+                             np.int32(dst))
+                self._state = (cache,) + self._state[1:]
+            rec.disp_pos = hi
+        self._emit_page_metrics()
+        return self._pages.build_table(
+            self.slots, self._rung // self.decoder.page_size)
+
+    def _emit_page_metrics(self):
+        """Page-pool observability (enabled-guarded, rides the dispatch
+        boundary): occupancy/sharing gauges plus eviction and
+        prefix-hit counters incremented by delta from the allocator's
+        monotonic stats."""
+        if not _mon.enabled():
+            return
+        reg = _mon.get_registry()
+        occ = self._pages.occupancy()
+        reg.gauge(_mon.GEN_PAGES_ACTIVE,
+                  help="physical KV pages holding live or cold-resident "
+                       "content").set(occ["pages_active"])
+        reg.gauge(_mon.GEN_PAGES_SHARED,
+                  help="shared (prefix-dedup) pages referenced by >= 1 "
+                       "live slot").set(occ["pages_shared"])
+        st = self._pages.stats
+        for metric, key, hlp in (
+                (_mon.GEN_PAGE_EVICTIONS, "evictions",
+                 "cold shared KV pages evicted (LRU / ladder)"),
+                (_mon.GEN_PREFIX_HITS, "prefix_hits",
+                 "admissions that reused >= 1 shared prefix page")):
+            delta = st[key] - self._page_counts[key]
+            if delta:
+                reg.counter(metric, help=hlp).inc(delta)
+                self._page_counts[key] = st[key]
+
     def _dispatch_block(self):
         """Dispatch the next decode block (superstep scan or drafting
         verify round) for the whole batch, start the ASYNC host copy of
@@ -944,16 +1111,19 @@ class GenerationServer:
                                 if self.superstep > 1 or self.draft
                                 else _faults.GENERATION_STEP)
         eos, budget = self._superstep_args()
+        ptab = (() if self._pages is None else
+                (self._page_args(self.draft + 1 if self.draft
+                                 else self.superstep),))
         if self.draft:
             draft, dlen = self._propose_drafts()
             call = self._exes[("verify", self._rung, self.draft)]
             out = call(*self._margs, *self._state, eos, budget, draft,
-                       dlen)
+                       dlen, *ptab)
             k, proposed = self.draft + 1, dlen
         else:
             call = self._exes[("superstep", self._rung,
                                self.superstep)]
-            out = call(*self._margs, *self._state, eos, budget)
+            out = call(*self._margs, *self._state, eos, budget, *ptab)
             k, proposed = self.superstep, None
         self._state = tuple(out[:8])
         block = self._start_fetch(out[8])
@@ -1111,6 +1281,10 @@ class GenerationServer:
                        *self._state[_RNG:])
         rec = self._slot_req.pop(slot)
         self._free.append(slot)
+        if self._pages is not None:
+            # private pages free; shared prefix pages stay resident
+            # cold for the next identical prompt (evictable currency)
+            self._pages.release_slot(slot)
         self.stats["retirements"] += 1
         try:
             if _mon.enabled():
@@ -1174,6 +1348,11 @@ class GenerationServer:
             self._replaying.sort(key=lambda r: r.admit_id)
             self._rung = self.cache_lengths[0]
             self._state = self._init_state(self._rung)
+            if self._pages is not None:
+                # pool contents died with the state: the allocator
+                # forgets everything and the ordered re-admissions
+                # rebuild table + prefix registry from the journal
+                self._pages.reset()
             while self._replaying:
                 rec = self._replaying[0]
                 if rec.req.done():
@@ -1306,7 +1485,7 @@ class GenerationServer:
         if isinstance(exc, ServerDeadError):
             return False
         if CrashReportingUtil.is_oom(exc):
-            if self._pressure < 3:
+            if self._pressure < (4 if self._pages is not None else 3):
                 return True
             cap = self._rung_cap or self.cache_lengths[-1]
             return any(c < cap for c in self.cache_lengths)
@@ -1325,18 +1504,27 @@ class GenerationServer:
         """Escalate the ladder one level: 1 = refuse cache growth past
         the current rung, 2 = also shed every queued admission, 3 =
         shrink the cap one pre-compiled rung (in-flight requests replay
-        into it; ones that no longer fit fail typed). Keeps a
+        into it; ones that no longer fit fail typed). Paged servers get
+        an extra level between shed and shrink — 3 = evict every cold
+        (refcount-zero) shared prefix page, reclaiming pool headroom
+        before giving up rung capacity; shrink moves to 4. Keeps a
         `monitoring/memory.py` telemetry reading for OOM forensics."""
         self._clean_steps = 0
         self._pressure_ts = time.monotonic()
         if self._pressure == 0 or self._rung_cap is None:
             self._rung_cap = self._rung
-        self._pressure = min(3, self._pressure + 1)
-        action = ("refuse_growth", "shed_queue",
-                  "shrink")[self._pressure - 1]
+        if self._pages is not None:
+            ladder = ("refuse_growth", "shed_queue", "evict_pages",
+                      "shrink")
+        else:
+            ladder = ("refuse_growth", "shed_queue", "shrink")
+        self._pressure = min(len(ladder), self._pressure + 1)
+        action = ladder[self._pressure - 1]
         if self._pressure >= 2:
             self._shed_queue(exc)
-        if self._pressure >= 3:
+        if self._pages is not None and self._pressure >= 3:
+            self._pages.evict_cold()
+        if self._pressure >= len(ladder):
             smaller = [c for c in self.cache_lengths
                        if c < self._rung_cap]
             if smaller:
@@ -1505,12 +1693,19 @@ class GenerationServer:
             state = "degraded"
         else:
             state = "serving" if self._warm else "cold"
-        return {"state": state, "pressure": self._pressure,
-                "rung_cap": self._rung_cap,
-                "active_slots": len(self._slot_req),
-                "replays": self.stats["replays"],
-                "restarts": self.stats["restarts"],
-                "degradations": self.stats["degradations"]}
+        out = {"state": state, "pressure": self._pressure,
+               "rung_cap": self._rung_cap,
+               "active_slots": len(self._slot_req),
+               "replays": self.stats["replays"],
+               "restarts": self.stats["restarts"],
+               "degradations": self.stats["degradations"]}
+        if self._pages is not None:
+            # page-pool occupancy + dedup/CoW/eviction counters: the
+            # capacity signal for paged replicas on /health and
+            # /generation (status() spreads this dict)
+            out["page_pool"] = {**self._pages.occupancy(),
+                                **self._pages.stats}
+        return out
 
     def _latency_percentiles(self):
         """Per-token latency p50/p99 (ms) over the recent decode
@@ -1533,6 +1728,7 @@ class GenerationServer:
             "prompt_buckets": list(self.prompt_buckets),
             "superstep": self.superstep,
             "draft": self.draft,
+            "paged": self.paged,
             "active_slots": len(self._slot_req),
             "queued": self._queue.qsize(),
             "warm": self._warm,

@@ -655,3 +655,104 @@ def flash_attention_decode(q1, k_cache, v_cache, cache_mask, impl="auto",
             f"unknown decode impl {impl!r}; expected 'auto', 'pallas' "
             "or 'dense'")
     return out[:, :, 0, :] if squeeze else out
+
+
+# ---------------------------------------------------------------------------
+# paged decode: attention reading a pooled KV through a per-slot page index
+# ---------------------------------------------------------------------------
+def gather_kv_pages(pool, page_table):
+    """Materialize the per-slot contiguous cache VIEW from a paged pool.
+
+    - pool: (P, H, ps, D) — one layer's KV page pool (P physical pages
+      of `ps` rows each; page 0 is the null/scratch page by convention)
+    - page_table: (B, n) int32 — physical page id per (slot, logical
+      page); unmapped entries point at page 0 and are hidden by the
+      caller's cache mask
+    Returns (B, H, n·ps, D) — bit-identical to the slot-contiguous
+    cache layout, so the existing masked-softmax decode arithmetic
+    (and therefore token streams) carries over unchanged.
+    """
+    if pool.ndim != 4:
+        raise ValueError(f"pool must be (P, H, ps, D), got {pool.shape}")
+    if page_table.ndim != 2:
+        raise ValueError(
+            f"page_table must be (B, n_pages), got {page_table.shape}")
+    b, n = page_table.shape
+    _, h, ps, d = pool.shape
+    g = jnp.take(pool, page_table, axis=0)      # (B, n, H, ps, D)
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, h, n * ps, d)
+
+
+def gather_scale_pages(scale_pool, page_table):
+    """Per-row scale twin of `gather_kv_pages` for the int8 pool.
+
+    - scale_pool: (P, H, ps) float32 — per-row quantization scales
+    - page_table: (B, n) int32
+    Returns (B, H, n·ps) ready for the scale-folding einsum path.
+    """
+    if scale_pool.ndim != 3:
+        raise ValueError(
+            f"scale_pool must be (P, H, ps), got {scale_pool.shape}")
+    b, n = page_table.shape
+    _, h, ps = scale_pool.shape
+    g = jnp.take(scale_pool, page_table, axis=0)  # (B, n, H, ps)
+    return g.transpose(0, 2, 1, 3).reshape(b, h, n * ps)
+
+
+def flash_attention_decode_paged(q1, k_pool, v_pool, page_table,
+                                 cache_mask, impl="auto", block_k=128,
+                                 interpret=None, k_scale_pool=None,
+                                 v_scale_pool=None):
+    """`flash_attention_decode` generalized to gather-by-page: the query
+    attends a (B, H, C, D) view gathered from a device-resident page
+    pool through the per-slot page index, C = n_pages·ps.
+
+    Pages let ragged sequences pay for the rows they use instead of a
+    worst-case rung (µ-cuDNN's fixed-block thesis applied to cache
+    memory), and let identical prompt prefixes share physical pages.
+    The gather feeds the UNCHANGED masked-softmax machinery — einsum
+    reference, Pallas kernel, and the int8 scale-folding path all see
+    the same (B, H, C, D) operands as the slot-contiguous layout, so
+    streams stay bit-identical.
+
+    - q1: (B, H, D) or (B, H, 1, D)
+    - k_pool / v_pool: (P, H, ps, D) — pooled pages (int8 under
+      `kv_dtype="int8"`, halving page bytes)
+    - page_table: (B, n_pages) int32 physical page ids
+    - cache_mask: (B, n_pages·ps) — valid ROWS of the gathered view
+    - k_scale_pool / v_scale_pool: (P, H, ps) float32 scales of an
+      int8 pool; folded inside the contractions as in the contiguous
+      path
+    """
+    if k_pool.shape != v_pool.shape or k_pool.ndim != 4:
+        raise ValueError(
+            f"k_pool/v_pool must match as (P, H, ps, D): "
+            f"{k_pool.shape} vs {v_pool.shape}")
+    if (k_scale_pool is None) != (v_scale_pool is None):
+        raise ValueError(
+            "k_scale_pool and v_scale_pool must be given together")
+    kc = gather_kv_pages(k_pool, page_table)
+    vc = gather_kv_pages(v_pool, page_table)
+    ks = vs = None
+    if k_scale_pool is not None:
+        ks = gather_scale_pages(k_scale_pool, page_table)
+        vs = gather_scale_pages(v_scale_pool, page_table)
+    return flash_attention_decode(q1, kc, vc, cache_mask, impl=impl,
+                                  block_k=block_k, interpret=interpret,
+                                  k_scale=ks, v_scale=vs)
+
+
+def flash_attention_decode_mq_paged(q, k_pool, v_pool, page_table,
+                                    q_mask, impl="auto"):
+    """`flash_attention_decode_mq` through the page index: the drafting
+    verify dispatch reads the SAME paged pool as the superstep scan, so
+    every decode mode inherits paging from one gather. Operands as in
+    `flash_attention_decode_mq` with (k_pool, v_pool, page_table) in
+    place of the contiguous caches."""
+    if k_pool.shape != v_pool.shape or k_pool.ndim != 4:
+        raise ValueError(
+            f"k_pool/v_pool must match as (P, H, ps, D): "
+            f"{k_pool.shape} vs {v_pool.shape}")
+    kc = gather_kv_pages(k_pool, page_table)
+    vc = gather_kv_pages(v_pool, page_table)
+    return flash_attention_decode_mq(q, kc, vc, q_mask, impl=impl)

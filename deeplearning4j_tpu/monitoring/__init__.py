@@ -92,6 +92,8 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     GEN_PREFILL_MS, GEN_PER_TOKEN_MS, GEN_REPLAYS, GEN_RESTARTS,
     GEN_DEGRADATIONS, GEN_SUPERSTEPS, GEN_TOKENS_PER_DISPATCH,
     GEN_FETCH_OVERLAP_MS, GEN_DRAFT_ACCEPTS, GEN_DRAFT_REJECTS,
+    GEN_PAGES_ACTIVE, GEN_PAGES_SHARED, GEN_PAGE_EVICTIONS,
+    GEN_PREFIX_HITS,
     QUANT_INT8_LAYERS, QUANT_CALIBRATIONS, QUANT_DEQUANT_FALLBACKS,
     QUANT_ACTIVATION_BYTES,
     INFERENCE_REQUEST_MS, SLO_BREACHES, SLO_BURN_RATE, SLO_BREACHED,
@@ -150,6 +152,8 @@ __all__ = [
     "GEN_REPLAYS", "GEN_RESTARTS", "GEN_DEGRADATIONS",
     "GEN_SUPERSTEPS", "GEN_TOKENS_PER_DISPATCH", "GEN_FETCH_OVERLAP_MS",
     "GEN_DRAFT_ACCEPTS", "GEN_DRAFT_REJECTS",
+    "GEN_PAGES_ACTIVE", "GEN_PAGES_SHARED", "GEN_PAGE_EVICTIONS",
+    "GEN_PREFIX_HITS",
     "QUANT_INT8_LAYERS", "QUANT_CALIBRATIONS",
     "QUANT_DEQUANT_FALLBACKS", "QUANT_ACTIVATION_BYTES",
     "INFERENCE_REQUEST_MS", "SLO_BREACHES", "SLO_BURN_RATE",

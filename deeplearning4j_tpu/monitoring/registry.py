@@ -216,6 +216,12 @@ GEN_TOKENS_PER_DISPATCH = "dl4j.gen.tokens_per_dispatch"
 GEN_FETCH_OVERLAP_MS = "dl4j.gen.fetch_overlap_ms"
 GEN_DRAFT_ACCEPTS = "dl4j.gen.draft_accepts"
 GEN_DRAFT_REJECTS = "dl4j.gen.draft_rejects"
+# paged KV cache: pool occupancy/sharing gauges plus prefix-dedup and
+# cold-page-eviction counters (emitted on the decode dispatch boundary)
+GEN_PAGES_ACTIVE = "dl4j.gen.pages_active"
+GEN_PAGES_SHARED = "dl4j.gen.pages_shared"
+GEN_PAGE_EVICTIONS = "dl4j.gen.page_evictions"
+GEN_PREFIX_HITS = "dl4j.gen.prefix_hits"
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _LABEL_RE = re.compile(r"[^a-zA-Z0-9_]")

@@ -17,14 +17,35 @@ into the attention contraction as epilogue multipliers — for the score
 pass the row scale folds onto the logits, for the value pass it folds
 onto the softmax weights), so no dequantized fp copy of the cache is
 ever materialized in HBM.
-"""
+
+The codec composes with the paged KV layout unchanged: an int8 page is
+the same `(H, ps, Dh)` block plus its `(H, ps)` scale page, so paging
+halves again on top of the int8 ¼ — `cache_page_bytes` is the one
+place that arithmetic lives (the bench ledger and the pool-sizing docs
+both read it)."""
 from __future__ import annotations
 
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.quantize.core import INT8_MAX
 
-__all__ = ["quantize_rows", "dequantize_rows"]
+__all__ = ["quantize_rows", "dequantize_rows", "cache_page_bytes"]
+
+
+def cache_page_bytes(layers, heads, page_size, head_dim, kv_dtype="fp",
+                     dtype_bytes=4):
+    """HBM bytes one physical KV page costs across all layers: K and V
+    blocks of `(heads, page_size, head_dim)` per layer — int8 pages pay
+    1 byte/element plus the per-(head, row) float32 scale columns, fp
+    pages pay `dtype_bytes`. Host-side sizing arithmetic only (pool
+    provisioning, the paged bench's bytes-saved ledger); nothing here
+    touches a device value."""
+    rows = int(heads) * int(page_size)
+    if kv_dtype == "int8":
+        per = rows * int(head_dim) * 1 + rows * 4   # payload + scales
+    else:
+        per = rows * int(head_dim) * int(dtype_bytes)
+    return 2 * int(layers) * per                    # K and V pools
 
 
 def quantize_rows(x):

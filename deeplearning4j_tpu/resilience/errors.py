@@ -16,6 +16,7 @@ __all__ = [
     "DivergenceError", "CheckpointIntegrityError",
     "DistributedInitError", "PeerLostError", "PeerDesyncError",
     "PreemptionSignal", "ServerDeadError", "MemoryPressureError",
+    "PagePoolExhaustedError",
     "ReplayDivergedError", "WireFormatError", "MembershipChangeError",
 ]
 
@@ -130,6 +131,19 @@ class MemoryPressureError(ResilienceError):
     pressure, or an in-flight request that no longer fits the shrunken
     cache rung. The server itself stays up — only the refused request
     fails."""
+
+
+class PagePoolExhaustedError(MemoryPressureError):
+    """The paged KV allocator ran out of physical pages even after
+    evicting every cold (refcount-zero) shared page. At admission the
+    request is refused typed and the server keeps serving; mid-stream
+    the error carries the RESOURCE_EXHAUSTED token so the OOM
+    classifier routes it through the degradation ladder (shed →
+    evict-cold-pages → shrink) and crash-replay re-packs the pool from
+    the journal."""
+
+    def __init__(self, message):
+        super().__init__(f"{message} (RESOURCE_EXHAUSTED: kv page pool)")
 
 
 class ReplayDivergedError(ResilienceError):

@@ -21,6 +21,9 @@ hooks at named sites:
                                               superstep-block dispatch
     GENERATION_ADMIT   "generation.admit"   — before a prefill admission
     CACHE_GROW         "cache.grow"         — before a KV-cache rung growth
+    CACHE_PAGE         "cache.page"         — before paged-KV page work
+                                              (admission mapping / block
+                                              allocate + CoW + table)
     EXECUTABLES_LOAD   "executables.load"   — on the AOT store miss path
     SERVING_DISPATCH   "serving.dispatch"   — inside the AOT serving path
     HOST_JOIN          "host.join"          — during elastic join admission
@@ -56,7 +59,7 @@ __all__ = ["FaultPlan", "install_plan", "clear_plan", "ACTIVE",
            "INFERENCE_FORWARD", "INFERENCE_COLLECTOR",
            "COMM_ALLREDUCE", "COMM_BARRIER", "HOST_PREEMPT",
            "GENERATION_STEP", "GENERATION_SUPERSTEP",
-           "GENERATION_ADMIT", "CACHE_GROW",
+           "GENERATION_ADMIT", "CACHE_GROW", "CACHE_PAGE",
            "EXECUTABLES_LOAD", "SERVING_DISPATCH",
            "HOST_JOIN", "WIRE_DECODE",
            "PROCESS_ID", "resolve_process_id"]
@@ -102,6 +105,13 @@ GENERATION_ADMIT = "generation.admit"
 #: fires before a KV-cache rung-growth dispatch; inject an OOM-shaped
 #: error here to drive the memory-pressure degradation ladder
 CACHE_GROW = "cache.grow"
+#: fires before paged-KV page work (admission page mapping; the
+#: per-block allocate/CoW/table build) — inject
+#: `PagePoolExhaustedError` to exercise pool exhaustion (contained
+#: refusal at admission, degradation ladder + crash-replay mid-stream)
+#: or any error to simulate a corrupt page index the replay must
+#: rebuild bit-identically
+CACHE_PAGE = "cache.page"
 #: fires on the AOT executable-store miss path (disk load / live
 #: compile) — simulates a corrupt or unreachable executable cache
 EXECUTABLES_LOAD = "executables.load"

@@ -63,6 +63,7 @@ HOT_MODULES = [
     "deeplearning4j_tpu/generation/server.py",
     "deeplearning4j_tpu/generation/decode.py",
     "deeplearning4j_tpu/generation/sampling.py",
+    "deeplearning4j_tpu/generation/paging.py",
     # quantized inference: the rewritten layers' apply() and the chain
     # executor run inside every served forward — registry calls belong
     # to the rewrite/calibration cold path only
@@ -110,6 +111,10 @@ GENERATION_MODULES = [
     "deeplearning4j_tpu/generation/server.py",
     "deeplearning4j_tpu/generation/decode.py",
     "deeplearning4j_tpu/generation/sampling.py",
+    # paged-KV bookkeeping runs BETWEEN every pair of decode dispatches
+    # (page allocation, prefix lookup, CoW planning, table build) — it
+    # must stay pure host numpy/python: no trace, no device sync
+    "deeplearning4j_tpu/generation/paging.py",
     "deeplearning4j_tpu/runtime/executables.py",
     # the int8 KV-cache codec runs INSIDE the decode step (quantize the
     # new K/V row, dequant-in-attention) — it must obey the same
@@ -133,7 +138,12 @@ GENERATION_ROOTS = {"_dispatch_block", "_deliver_block",
                     "_admit_pending", "_admit_one",
                     "_admit_rec", "_retire_slot", "_deliver",
                     "_survive", "_recover", "_replay_one",
-                    "_advance_key", "_supervised_restart"}
+                    "_advance_key", "_supervised_restart",
+                    # paged-KV hot path: per-block page prep and the
+                    # allocator's admission/eviction/prefix machinery
+                    # resolve from pre-compiled executables only
+                    "_page_args", "admit_slot", "ensure_range",
+                    "evict_cold", "release_slot", "build_table"}
 #: the declared warmup boundary — steady state never crosses it
 GENERATION_MISS_BOUNDARY = {"load_or_compile", "warmup",
                             "_warmup_locked"}
@@ -150,7 +160,14 @@ GENERATION_SYNC_ROOTS = {"_dispatch_block", "_deliver_block",
                          # retirement closes the request timeline
                          # (trace.event/finish) — walked so the close
                          # path stays host-pure too
-                         "_retire_slot", "_finish", "_fail"}
+                         "_retire_slot", "_finish", "_fail",
+                         # paged-KV page prep rides the dispatch
+                         # boundary: allocation, prefix lookup, CoW
+                         # planning, table build, and the pool metrics
+                         # emit must add ZERO host syncs per token
+                         "_page_args", "_emit_page_metrics",
+                         "admit_slot", "abort_admit", "ensure_range",
+                         "evict_cold", "release_slot", "build_table"}
 GENERATION_SYNC_BOUNDARY = {"_fetch_tokens", "_start_fetch"}
 #: calls that mean "the host blocks on (or copies back) device data"
 SYNC_CALL_NAMES = {"asarray", "device_get", "block_until_ready",

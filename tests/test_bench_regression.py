@@ -113,6 +113,37 @@ def test_multihost_artifact_gates_its_own_trajectory(tmp_path):
     assert not verdict["ok"] and "regression" in verdict["reason"]
 
 
+def test_paged_artifact_gates_its_own_trajectory(tmp_path):
+    """BENCH_PAGED_r01.json (the paged-KV concurrent-sequences-at-
+    equal-HBM ratio from bench_paged.py) is gated via the explicit
+    `paths` knob like the MULTIHOST round — the acceptance floor is
+    the checked-in >= 4x headline."""
+    art = os.path.join(REPO, "BENCH_PAGED_r01.json")
+    doc = cbr.load_artifact(art)
+    v = cbr.headline_value(doc)
+    assert v is not None and v >= 4.0, \
+        "paged KV must hold >= 4x concurrent sequences at equal HBM"
+    assert doc["paged"]["kv_bytes"] == doc["dense"]["kv_bytes"]
+    assert doc["paged"]["peak_concurrent"] == doc["paged"]["slots"]
+    assert doc["token_identity"]["identical"] is True
+    assert doc["prefix_dedup"]["bytes_saved"] > 0
+    assert doc["prefix_dedup"]["page_bytes_int8"] < doc[
+        "prefix_dedup"]["page_bytes_fp"]
+    # the checked-in round is its own prior: an equal fresh value passes
+    fresh_ok = _write(tmp_path, {"value": v, "metric": doc["metric"],
+                                 "unit": "x"}, "BENCH_PAGED_fresh.json")
+    verdict = cbr.check(fresh_ok, tolerance=0.10, paths=[art])
+    assert verdict["ok"] and verdict["prior"] == v
+    assert os.path.basename(
+        verdict["prior_path"]) == "BENCH_PAGED_r01.json"
+    # a collapsed capacity ratio is a caught regression
+    fresh_bad = _write(tmp_path, {"value": round(v * 0.5, 2),
+                                  "metric": doc["metric"], "unit": "x"},
+                       "BENCH_PAGED_bad.json")
+    verdict = cbr.check(fresh_bad, tolerance=0.10, paths=[art])
+    assert not verdict["ok"] and "regression" in verdict["reason"]
+
+
 def test_multihost_artifact_invisible_to_default_trajectory():
     """The default BENCH_* glob must not pick up the multihost round —
     a 19.9x ratio would otherwise poison the img/s floor."""

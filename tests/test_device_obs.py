@@ -54,6 +54,10 @@ def _iterator(n_batches=5, batch=8, n_in=4, n_out=2, seed=0):
 
 # -- ProfileSession --------------------------------------------------------
 class TestProfileSession:
+    @pytest.mark.slow   # suite diet (ISSUE 18): ~9 s profiled 6-step
+    # fit; capture/report basics stay tier-1 via
+    # test_finish_closes_short_window, and the registry/endpoint
+    # surface via TestEndpoints::test_profile_and_steps_endpoints
     def test_armed_session_captures_k_steps_and_reports(self):
         net = _mlp()
         session = mon.profile_next_steps(3)

@@ -158,6 +158,69 @@ def test_metrics_publish_guard_flags_unguarded_publish():
     assert check_fastpath.check_metrics_publish_guarded(good) == []
 
 
+def test_generation_lint_pins_paging_module():
+    """paging.py is IN the generation lint module set (a rename or a
+    set edit can't silently drop the paged hot path from coverage),
+    and the real allocator passes both the trace- and sync-rules: page
+    allocation / prefix lookup / CoW planning / table build are pure
+    host bookkeeping."""
+    rel = "deeplearning4j_tpu/generation/paging.py"
+    assert rel in check_fastpath.GENERATION_MODULES
+    for root in ("_page_args", "admit_slot", "ensure_range",
+                 "evict_cold", "release_slot", "build_table"):
+        assert root in check_fastpath.GENERATION_SYNC_ROOTS
+    path = os.path.join(check_fastpath.REPO_ROOT, rel)
+    assert os.path.exists(path), "lint module vanished: paging.py"
+    with open(path) as f:
+        src = f.read()
+    assert check_fastpath.check_generation_steady_state(
+        {path: src}) == []
+    assert check_fastpath.check_generation_host_sync({path: src}) == []
+
+
+def test_generation_sync_lint_flags_sync_in_page_walk():
+    """A host materialization reachable from the per-block page walk
+    (_page_args → ensure_range/build_table) is flagged: page
+    bookkeeping between dispatches must add ZERO host syncs per
+    token."""
+    bad = textwrap.dedent("""
+        import numpy as np
+
+        def _page_args(self, k):
+            for slot in self._slot_req:
+                self._pages.ensure_range(slot, 0, k)
+            return self._pages.build_table(4, 4)
+
+        def ensure_range(self, slot, lo, hi):
+            return []
+
+        def build_table(self, slots, maxp):
+            return np.asarray(self._table).tolist()   # host sync!
+    """)
+    v = check_fastpath.check_generation_host_sync({"m.py": bad})
+    assert len(v) == 2   # asarray AND tolist
+    assert all("host sync" in msg or "asarray" in msg or "tolist" in msg
+               for _, _, msg in v)
+
+
+def test_generation_trace_lint_flags_compile_in_page_admission():
+    """A live trace/compile reachable from the page-admission root is
+    flagged — steady-state paging resolves everything from the warmed
+    executable set."""
+    bad = textwrap.dedent("""
+        import jax
+
+        def _admit_rec(self, rec):
+            self._pages.admit_slot(0, rec, 8)
+
+        def admit_slot(self, slot, prompt, pbucket):
+            return jax.jit(lambda x: x)(prompt)   # live compile!
+    """)
+    v = check_fastpath.check_generation_steady_state({"m.py": bad})
+    assert len(v) == 1
+    assert "admit_slot" in v[0][2]
+
+
 def test_lint_rejects_guard_after_the_call():
     # the guard must precede the call — a later early-return doesn't
     # protect the hot path

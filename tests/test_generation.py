@@ -169,6 +169,41 @@ def test_causal_encode_prefix_invariant(bert):
 
 
 # ===================== decode exactness ===============================
+def test_bert_kv_decode_first_step_matches_full_forward(bert):
+    """Fast lane of test_bert_kv_decode_matches_full_forward: the
+    prefill logits and the FIRST decode step match the full-sequence
+    causal recompute (one encode shape instead of four — the deeper
+    positions run in the slow lane)."""
+    cfg, params = bert
+    dec = BertDecoder(cfg, params)
+    margs = dec.model_args()
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(1, cfg.vocab_size, 7).astype(np.int32)
+    plen = len(prompt)
+    cache = dec.init_cache(3, 32)
+    cache, logits = dec.prefill(margs, cache, jnp.int32(1),
+                                jnp.asarray(np.pad(prompt, (0, 9))),
+                                jnp.int32(plen))
+    ids = jnp.asarray(prompt)[None]
+    ref_h = bert_encode(cfg, params, ids, causal=True)
+    ref = bert_mlm_logits(cfg, params, ref_h)[0, -1]
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    tok = int(jnp.argmax(logits))
+    toks = jnp.zeros((3,), jnp.int32).at[1].set(tok)
+    pos = jnp.zeros((3,), jnp.int32).at[1].set(plen)
+    lg, cache = dec.step(margs, cache, toks, pos)
+    ref_h = bert_encode(cfg, params,
+                        jnp.asarray(list(prompt) + [tok])[None],
+                        causal=True)
+    ref = bert_mlm_logits(cfg, params, ref_h)[0, -1]
+    np.testing.assert_allclose(np.asarray(lg[1]), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.slow   # suite diet (ISSUE 18): ~17 s — four growing-length
+# encode recompiles; prefill + first-step exactness stays tier-1 via
+# test_bert_kv_decode_first_step_matches_full_forward
 def test_bert_kv_decode_matches_full_forward(bert):
     """Acceptance: KV-cache decode logits match the full-sequence
     causal forward recompute to <= 1e-5 at every generated position."""
@@ -204,6 +239,37 @@ def test_bert_kv_decode_matches_full_forward(bert):
         tok = int(jnp.argmax(lg[1]))
 
 
+def test_lstm_decode_first_step_bit_identical():
+    """Fast lane of test_lstm_decode_bit_identical_to_full_forward:
+    prefill + ONE decode step BIT-match the masked full-sequence
+    forward (logits and carries); the deeper steps and the unmasked
+    tolerance check run in the slow lane."""
+    net = _lstm_net(seed=5, layers=2, hidden=24)
+    dec = RecurrentDecoder(net)
+    margs = dec.model_args()
+    prompt = np.array([1, 4, 2, 7, 3], np.int32)
+    plen = len(prompt)
+    cache = dec.init_cache(2, 48)
+    cache, logits = dec.prefill(margs, cache, jnp.int32(0),
+                                jnp.asarray(np.pad(prompt, (0, 3))),
+                                jnp.int32(plen))
+    tok = int(jnp.argmax(logits))
+    lg, cache = dec.step(margs, cache, jnp.asarray([tok, 0], jnp.int32),
+                         jnp.asarray([plen, 0], jnp.int32))
+    seq = list(prompt) + [tok]
+    x = jax.nn.one_hot(np.asarray(seq), V, dtype=jnp.float32)[None]
+    ones = jnp.ones((1, len(seq)), jnp.float32)
+    _, preact, _, _, carries = net._forward(
+        net._params, net._state, x, False, None, mask=ones, carries={})
+    assert jnp.array_equal(preact[0, -1].astype(jnp.float32), lg[0])
+    for idx, rows in carries.items():
+        for ref_c, dec_c in zip(rows, cache["carries"][idx]):
+            assert jnp.array_equal(ref_c[0], dec_c[0])
+
+
+@pytest.mark.slow   # suite diet (ISSUE 18): ~10 s — four steps + two
+# full-forward jits; the bit-identity contract stays tier-1 via
+# test_lstm_decode_first_step_bit_identical
 def test_lstm_decode_bit_identical_to_full_forward():
     """Acceptance: carry-state decode (bucketed masked prefill + T=1
     steps) is BIT-identical — carries and logits — to the canonical
@@ -424,6 +490,34 @@ def test_server_validates_limits(server):
         server.submit([])
 
 
+def test_bert_server_grow_rungs_no_recompile(bert):
+    """Fast lane of test_bert_server_grow_and_disk_warm: a longer
+    admission grows the KV cache to the pre-compiled bigger rung with
+    zero post-warmup compiles (shares the module exec cache; the
+    private-dir disk-warm restart half runs in the slow lane)."""
+    cfg, params = bert
+    srv = GenerationServer(BertDecoder(cfg, params), slots=2,
+                           cache_lengths=[16, 32], prompt_buckets=[8],
+                           method="greedy", max_new_tokens=4,
+                           exec_cache_dir=_CACHE["dir"], seed=0)
+    srv.warmup()
+    try:
+        compiles = srv._store.stats["compiles"]
+        assert len(srv.generate([1, 2, 3], max_new_tokens=4,
+                                timeout=60)) == 4
+        assert srv._rung == 16
+        long = srv.submit([5, 6, 7, 8, 9, 10, 11], max_new_tokens=20)
+        assert len(long.result(timeout=60)) == 20
+        assert srv._rung == 32
+        assert srv._store.stats["compiles"] == compiles
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.slow   # suite diet (ISSUE 18): ~19 s — compiles a private
+# executable set TWICE (fresh dir + restart); rung growth stays tier-1
+# via test_bert_server_grow_rungs_no_recompile, warm-restart zero-
+# compiles via test_supervised_restart_from_warm_store_zero_compiles
 def test_bert_server_grow_and_disk_warm(bert, tmp_path):
     """Cache-length rungs: a longer admission grows the KV cache to a
     pre-compiled bigger rung (no recompile); a restarted replica warms
@@ -617,6 +711,36 @@ def test_flash_attention_decode_mq_matches_looped_single_query():
         flash_attention_decode_mq(q, k, v, qmask[:, :, :5])
 
 
+def test_bert_verify_first_query_matches_step(bert):
+    """Fast lane of test_bert_verify_matches_sequential_steps: the
+    verify block's FIRST query logits equal one sequential step()
+    (one oracle step instead of three; the full per-query sweep runs
+    in the slow lane, and end-to-end draft exactness stays tier-1 via
+    test_bert_draft_server_streams_exact)."""
+    from deeplearning4j_tpu.generation.decode import BertDecoder
+    cfg, params = bert
+    dec = BertDecoder(cfg, params)
+    margs = dec.model_args()
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, cfg.vocab_size, 5).astype(np.int32)
+    cache0 = dec.init_cache(2, 32)
+    cache0, logits = dec.prefill(margs, cache0, jnp.int32(1),
+                                 jnp.asarray(np.pad(prompt, (0, 3))),
+                                 jnp.int32(5))
+    cur = int(jnp.argmax(logits))
+    toks = jnp.zeros((2,), jnp.int32).at[1].set(cur)
+    pos = jnp.zeros((2,), jnp.int32).at[1].set(5)
+    lg, _ = dec.step(margs, cache0, toks, pos)
+    draft = jnp.zeros((2, 2), jnp.int32)
+    vlogits, _ = dec.verify(margs, cache0, toks, pos, draft)
+    assert vlogits.shape == (2, 3, cfg.vocab_size)
+    np.testing.assert_allclose(np.asarray(vlogits[1, 0]),
+                               np.asarray(lg[1]), atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.slow   # suite diet (ISSUE 18): ~10 s — three-step oracle
+# loop; the verify-equals-step contract stays tier-1 via
+# test_bert_verify_first_query_matches_step
 def test_bert_verify_matches_sequential_steps(bert):
     """The draft-block verify forward is the sequential decode oracle:
     its per-query logits equal d separate step() calls to <= 1e-5, so

@@ -76,6 +76,12 @@ class TestInitPretrainedH5:
 
 
 class TestTransferFromPretrained:
+    @pytest.mark.slow   # suite diet (ISSUE 18): ~13 s — trains a LeNet
+    # twice just to compose two already-covered contracts; freeze-keeps-
+    # weights/head-trains stays tier-1 via tests/test_transfer.py::
+    # {test_feature_extractor_freezes_params,
+    #  test_frozen_training_still_learns_head} and checkpoint loading
+    # via TestInitPretrainedZip::test_loads_checkpointed_weights
     def test_fine_tune_starts_from_loaded_weights(self, tmp_path):
         """TransferLearning on an initPretrained() network: frozen layers
         keep the CHECKPOINT's weights (not random init) while the new head

@@ -4,6 +4,7 @@ import glob
 import os
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.inputs import InputType
@@ -48,6 +49,11 @@ class TestProfiling:
         assert stats["train_step"]["count"] == 5
         assert stats["train_step"]["total_time_s"] > 0
 
+    @pytest.mark.slow   # suite diet (ISSUE 18): ~10 s — 6 fits just to
+    # arm a real jax.profiler window; a REAL xplane.pb artifact stays
+    # tier-1 via tests/test_device_obs.py::TestProfileSession::
+    # test_listener_window_also_yields_report and the structural
+    # decode/op_breakdown contract via tests/test_xplane.py
     def test_jax_profiler_trace_artifact(self, tmp_path):
         trace_dir = str(tmp_path / "trace")
         net = _net()

@@ -17,8 +17,8 @@ The invariants every scenario asserts:
 
 Fault sites driven here (scripts/check_fault_coverage.py asserts every
 faults.py site is exercised by some test): GENERATION_STEP,
-GENERATION_ADMIT, CACHE_GROW, SERVING_DISPATCH, EXECUTABLES_LOAD,
-INFERENCE_FORWARD, COMM_BARRIER, COMM_ALLREDUCE.
+GENERATION_ADMIT, CACHE_GROW, CACHE_PAGE, SERVING_DISPATCH,
+EXECUTABLES_LOAD, INFERENCE_FORWARD, COMM_BARRIER, COMM_ALLREDUCE.
 """
 import json
 import random
@@ -44,6 +44,7 @@ from deeplearning4j_tpu.parallel.inference import (InferenceMode,
 from deeplearning4j_tpu.resilience import faults
 from deeplearning4j_tpu.resilience.errors import (InjectedFault,
                                                   MemoryPressureError,
+                                                  PagePoolExhaustedError,
                                                   ServerDeadError)
 from deeplearning4j_tpu.resilience.policy import (CircuitBreaker,
                                                   RetryPolicy)
@@ -124,6 +125,23 @@ def _bert_server(bert, **kw):
     kw.setdefault("seed", 11)
     kw.setdefault("exec_cache_dir", _CACHE["dir"])
     srv = GenerationServer(BertDecoder(cfg, params), **kw)
+    srv.warmup()
+    return srv
+
+
+def _bert_paged_server(bert, **kw):
+    """_bert_server on the paged KV pool — every chaos invariant must
+    also hold when recovery rebuilds a page table + prefix registry
+    from the journal, not just a contiguous cache."""
+    cfg, params = bert
+    dec_kw = dict(page_size=8, pool_pages=kw.pop("pool_pages", 40))
+    kw.setdefault("slots", 2)
+    kw.setdefault("cache_lengths", [16, 32])
+    kw.setdefault("prompt_buckets", [8])
+    kw.setdefault("method", "greedy")
+    kw.setdefault("seed", 11)
+    kw.setdefault("exec_cache_dir", _CACHE["dir"])
+    srv = GenerationServer(BertDecoder(cfg, params, **dec_kw), **kw)
     srv.warmup()
     return srv
 
@@ -494,6 +512,12 @@ def _oom(site, call_n):
         f"call {call_n})")
 
 
+@pytest.mark.slow   # suite diet (ISSUE 18): ~11 s — level 1 alone is a
+# strict sub-walk of test_pressure_ladder_sheds_queue_then_shrinks
+# (refuse-growth cap, typed failure, fitting requests still serve);
+# the CACHE_GROW site + "degraded" serving_state stay tier-1 via
+# test_pressure_decays_while_idle and
+# test_pressure_decays_by_wall_clock_without_steps
 def test_pressure_level1_refuses_growth_keeps_serving(bert):
     """An OOM during cache growth escalates to level 1: the grown-past
     request fails typed, in-flight requests replay at the capped rung,
@@ -589,6 +613,102 @@ def test_pressure_decays_after_clean_stretch(bert):
         assert srv.generate([5, 6, 7, 8, 9, 10, 11], max_new_tokens=20,
                             timeout=60)   # growth works again
         assert srv._rung == 32
+    finally:
+        srv.shutdown()
+
+
+# ===================== paged KV pool chaos ============================
+def test_chaos_page_fault_replay_bit_identical(bert):
+    """ACCEPTANCE (paged): a `cache.page` fault (corrupt page index /
+    failed pool bookkeeping) mid-stream crashes the loop; recovery
+    resets the pool, rebuilds the page table + prefix registry from the
+    journal, and every completed stream is BIT-identical to the
+    fault-free SLOT-CONTIGUOUS run — superstep k=2 so the kill lands
+    inside a multi-token block."""
+    dense = _bert_server(bert, superstep=2)
+    try:
+        _, want, errs = _run_workload(dense)
+        assert errs == [None] * 4
+    finally:
+        dense.shutdown()
+
+    srv = _bert_paged_server(bert, superstep=2)
+    try:
+        # call 6 is past the first admissions' fires: it lands on a
+        # steady-state block's page walk, pool already populated
+        plan = faults.FaultPlan(seed=9).fail_at(faults.CACHE_PAGE, 6)
+        with plan:
+            _, got, errs = _run_workload(srv)
+        assert plan.fired.get(faults.CACHE_PAGE) == 1
+        assert errs == [None] * 4
+        assert got == want, \
+            "paged replay must bit-match the dense fault-free run"
+        assert srv.stats["replays"] >= 1
+        # the rebuilt pool is consistent: a fresh request serves
+        assert len(srv.generate([3, 1], max_new_tokens=3,
+                                timeout=60)) == 3
+    finally:
+        srv.shutdown()
+
+
+def _pool_oom(site, call_n):
+    return PagePoolExhaustedError(
+        f"kv page pool exhausted (injected at {site} call {call_n})")
+
+
+def test_chaos_paged_ladder_evicts_cold_pages_before_shrink(bert):
+    """The paged ladder has FOUR rungs: repeated pool-exhaustion OOMs
+    walk refuse-growth → shed-queue → EVICT-COLD-PAGES → shrink. The
+    third incident reclaims resident refcount-zero prefix pages and
+    leaves rung capacity untouched; only the fourth gives up the rung.
+    slots=1 serializes everything, so step numbering is deterministic."""
+    srv = _bert_paged_server(bert, slots=1)
+    try:
+        mon.enable()
+        deg = lambda a: mon.get_registry().counter(  # noqa: E731
+            mon.GEN_DEGRADATIONS, labels={"action": a}).value
+        # incidents 1+2 hit a request that grew (relabeled) to rung 32;
+        # it replays through both and completes
+        plan = (faults.FaultPlan(seed=8)
+                .fail_at(faults.GENERATION_STEP, 2, exc=_pool_oom)
+                .fail_at(faults.GENERATION_STEP, 4, exc=_pool_oom))
+        with plan:
+            big = srv.submit([5, 6, 7, 8, 9, 10, 11],
+                             max_new_tokens=20)              # needs 32
+            assert len(big.result(timeout=60)) == 20
+        assert srv._pressure == 2
+        assert srv._rung_cap == 32          # capped, nothing shrunk
+        assert deg("refuse_growth") == 1 and deg("shed_queue") == 1
+        # the retired request left its prompt pages resident COLD —
+        # exactly the headroom level 3 reclaims
+        assert srv.serving_state()["page_pool"]["pages_cold"] > 0
+        ev0 = srv._pages.stats["evictions"]
+
+        # incident 3: evict_pages — pool headroom, NOT rung capacity
+        plan = faults.FaultPlan(seed=9).fail_at(
+            faults.GENERATION_STEP, 1, exc=_pool_oom)
+        with plan:
+            assert len(srv.generate([1, 2], max_new_tokens=4,
+                                    timeout=60)) == 4
+        assert srv._pressure == 3
+        assert srv._rung_cap == 32          # still no shrink
+        assert deg("evict_pages") == 1 and deg("shrink") == 0
+        assert srv._pages.stats["evictions"] > ev0
+
+        # incident 4: out of pool moves — NOW the cap shrinks to 16
+        plan = faults.FaultPlan(seed=10).fail_at(
+            faults.GENERATION_STEP, 1, exc=_pool_oom)
+        with plan:
+            big2 = srv.submit([5, 6, 7, 8, 9, 10, 11],
+                              max_new_tokens=20)
+            with pytest.raises(MemoryPressureError):
+                big2.result(timeout=60)
+        assert srv._pressure == 4
+        assert srv._rung_cap == 16
+        assert deg("shrink") == 1
+        # the server still serves requests that fit the shrunken rung
+        assert len(srv.generate([1, 2], max_new_tokens=4,
+                                timeout=60)) == 4
     finally:
         srv.shutdown()
 
