@@ -1,4 +1,7 @@
-"""Observability disabled-path microbench (CPU): the ISSUE 15 guard.
+"""Observability disabled-path microbench (CPU): the ISSUE 15 guard,
+re-armed for every observability PR since (the ISSUE 19 ops event
+journal's emission hooks ride the same enabled-guard and the same two
+workloads below).
 
 Request tracing, the cluster metrics plane, and SLO tracking must be
 FREE when off — every instrumentation point this PR adds is one

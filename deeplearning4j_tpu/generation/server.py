@@ -133,6 +133,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.monitoring import events as _events
 from deeplearning4j_tpu.monitoring import requests as _req
 from deeplearning4j_tpu.generation.paging import PageAllocator
 from deeplearning4j_tpu.generation.sampling import (GREEDY, method_id,
@@ -457,6 +458,7 @@ class GenerationServer:
         self._consecutive_failures = 0   # incidents without a delivery
         self._warm = False
         self._thread = None
+        self._corr = "genserver-%x" % id(self)   # ops-event incident key
         _SERVERS.add(self)
 
     # -- warmup (the declared trace/compile boundary) ---------------------
@@ -822,6 +824,12 @@ class GenerationServer:
                     status = "rejected"
                 req.trace.event(status, error=type(e).__name__)
                 req.trace.finish(status)
+            if _mon.enabled():
+                _events.emit(
+                    "generation", _events.SERVER_REFUSED,
+                    attrs={"error": type(e).__name__,
+                           "request": getattr(req, "trace_id", None)},
+                    correlation_id=self._corr)
             raise
         self._work.set()
         return req
@@ -944,6 +952,11 @@ class GenerationServer:
                 # PRE-dispatch refusal (allocations rolled back): the
                 # slot goes back untouched and only this request fails
                 self._free.append(slot)
+                if _mon.enabled():
+                    _events.emit(
+                        "generation", _events.PAGES_EXHAUSTED,
+                        attrs={"request": getattr(req, "trace_id", None)},
+                        correlation_id=self._corr)
                 raise
             rec.disp_pos = plen
         self._slot_req[slot] = rec
@@ -952,6 +965,10 @@ class GenerationServer:
                 _faults.ACTIVE.fire(_faults.CACHE_GROW)
             if req.trace is not None:
                 req.trace.event("grow", to_rung=rung)
+            if _mon.enabled():
+                _events.emit("generation", _events.CACHE_GROWN,
+                             attrs={"to_rung": rung},
+                             correlation_id=self._corr)
             if self._pages is not None:
                 # the pool is rung-independent: growth just widens the
                 # page table the next dispatches read through
@@ -1316,13 +1333,28 @@ class GenerationServer:
                 f"{self._consecutive_failures} consecutive "
                 f"decode-loop failures"))
             return False
+        if _mon.enabled():
+            _events.emit(
+                "generation", _events.SERVER_DISRUPTED,
+                attrs={"error": type(exc).__name__,
+                       "consecutive": self._consecutive_failures},
+                correlation_id=self._corr)
         if CrashReportingUtil.is_oom(exc):
             self._note_memory_pressure(exc)
         try:
             self._recover(exc)
+            if _mon.enabled():
+                _events.emit("generation", _events.SERVER_RECOVERED,
+                             attrs={"via": "replay"},
+                             correlation_id=self._corr)
             return True
         except Exception as e2:  # noqa: BLE001 — supervisor takes over
-            return self._supervised_restart(e2)
+            ok = self._supervised_restart(e2)
+            if ok and _mon.enabled():
+                _events.emit("generation", _events.SERVER_RECOVERED,
+                             attrs={"via": "restart"},
+                             correlation_id=self._corr)
+            return ok
 
     def _recover(self, exc=None):
         """Crash-replay recovery: every in-flight journal moves to the
@@ -1428,6 +1460,12 @@ class GenerationServer:
             reg.counter(_mon.GEN_REPLAYS,
                         help="in-flight requests re-admitted by "
                              "crash-replay").inc()
+            _events.emit(
+                "generation", _events.SERVER_REPLAY,
+                attrs={"request": getattr(req, "trace_id", None),
+                       "mode": "prefix" if use_prefix else "regenerate",
+                       "delivered": g},
+                correlation_id=self._corr)
             if live_first:
                 reg.counter(_mon.GEN_TOKENS,
                             help="tokens generated (all slots)").inc()
@@ -1498,6 +1536,9 @@ class GenerationServer:
                 _mon.GEN_RESTARTS,
                 help="supervised decode-loop restarts from the warm "
                      "FunctionStore").inc()
+            _events.emit("generation", _events.SERVER_RESTARTED,
+                         attrs={"restarts": self.stats["restarts"]},
+                         correlation_id=self._corr)
 
     # -- memory-pressure degradation ladder -------------------------------
     def _note_memory_pressure(self, exc):
@@ -1520,15 +1561,29 @@ class GenerationServer:
             ladder = ("refuse_growth", "shed_queue", "shrink")
         self._pressure = min(len(ladder), self._pressure + 1)
         action = ladder[self._pressure - 1]
+        if _mon.enabled():
+            _events.emit(
+                "generation", _events.PRESSURE_ESCALATED,
+                attrs={"level": self._pressure, "action": action,
+                       "error": type(exc).__name__},
+                correlation_id=self._corr)
         if self._pressure >= 2:
             self._shed_queue(exc)
         if self._pages is not None and self._pressure >= 3:
-            self._pages.evict_cold()
+            evicted = self._pages.evict_cold()
+            if _mon.enabled():
+                _events.emit("generation", _events.PAGES_EVICTED,
+                             attrs={"evicted": evicted},
+                             correlation_id=self._corr)
         if self._pressure >= len(ladder):
             smaller = [c for c in self.cache_lengths
                        if c < self._rung_cap]
             if smaller:
                 self._rung_cap = smaller[-1]
+                if _mon.enabled():
+                    _events.emit("generation", _events.CACHE_SHRUNK,
+                                 attrs={"cap": self._rung_cap},
+                                 correlation_id=self._corr)
             else:
                 # no smaller pre-compiled rung: the ladder is out of
                 # moves — say so instead of reporting a phantom shrink
@@ -1550,6 +1605,11 @@ class GenerationServer:
         self._pressure = max(0, self._pressure - 1)
         if self._pressure == 0:
             self._rung_cap = None
+        if _mon.enabled():
+            _events.emit("generation", _events.PRESSURE_RELIEVED,
+                         attrs={"level": self._pressure},
+                         correlation_id=self._corr,
+                         resolves=self._pressure == 0)
 
     def _maybe_relieve_by_time(self):
         """Wall-clock decay: re-evaluated on every growth attempt, so
@@ -1611,6 +1671,10 @@ class GenerationServer:
             err.__cause__ = cause
             req._fail(err)
             shed += 1
+        if shed and _mon.enabled():
+            _events.emit("generation", _events.SERVER_SHED,
+                         attrs={"shed": shed},
+                         correlation_id=self._corr)
         return shed
 
     def _count_degradation(self, action):
@@ -1647,6 +1711,11 @@ class GenerationServer:
         stream consumer waits out its timeout on a dead server."""
         err = ServerDeadError(f"GenerationServer {reason}: {cause!r}")
         err.__cause__ = cause
+        if _mon.enabled():
+            _events.emit("generation", _events.SERVER_DEAD,
+                         attrs={"reason": reason,
+                                "error": type(cause).__name__},
+                         correlation_id=self._corr)
         with self._lock:
             self._dead = err
             self._fail_open_requests(err)

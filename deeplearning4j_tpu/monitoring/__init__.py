@@ -40,6 +40,7 @@ from deeplearning4j_tpu.monitoring import cluster  # noqa: F401
 from deeplearning4j_tpu.monitoring import memory  # noqa: F401
 from deeplearning4j_tpu.monitoring import profiler  # noqa: F401
 from deeplearning4j_tpu.monitoring import requests  # noqa: F401
+from deeplearning4j_tpu.monitoring import events  # noqa: F401
 from deeplearning4j_tpu.monitoring import slo  # noqa: F401
 from deeplearning4j_tpu.monitoring import steps  # noqa: F401
 from deeplearning4j_tpu.monitoring import stragglers  # noqa: F401
@@ -61,6 +62,7 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     JIT_PERSISTENT_REQUESTS,
     EXEC_COMPILES, EXEC_COMPILE_SECONDS, EXEC_DISK_HITS,
     EXEC_DESERIALIZE_FAILURES, EXEC_SERIALIZE_FAILURES,
+    EXEC_FLOPS, EXEC_BYTES_ACCESSED,
     SERVING_ROWS, SERVING_PADDED_ROWS, SERVING_BUCKET_OCCUPANCY,
     SERVING_SPLITS, SERVING_STAGED_BUFFERS, SERVING_STAGING_OCCUPANCY,
     SERVING_AOT_FALLBACKS,
@@ -97,6 +99,7 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     QUANT_INT8_LAYERS, QUANT_CALIBRATIONS, QUANT_DEQUANT_FALLBACKS,
     QUANT_ACTIVATION_BYTES,
     INFERENCE_REQUEST_MS, SLO_BREACHES, SLO_BURN_RATE, SLO_BREACHED,
+    EVENTS_EMITTED, EVENTS_DROPPED, INCIDENTS_OPEN, INCIDENTS_RESOLVED,
     CLUSTER_SNAPSHOT_AGE,
     bootstrap_core_metrics, collect_device_memory, get_registry,
     record_transfer)
@@ -122,6 +125,7 @@ __all__ = [
     "JIT_PERSISTENT_REQUESTS",
     "EXEC_COMPILES", "EXEC_COMPILE_SECONDS", "EXEC_DISK_HITS",
     "EXEC_DESERIALIZE_FAILURES", "EXEC_SERIALIZE_FAILURES",
+    "EXEC_FLOPS", "EXEC_BYTES_ACCESSED",
     "SERVING_ROWS", "SERVING_PADDED_ROWS", "SERVING_BUCKET_OCCUPANCY",
     "SERVING_SPLITS", "SERVING_STAGED_BUFFERS",
     "SERVING_STAGING_OCCUPANCY", "SERVING_AOT_FALLBACKS",
@@ -158,7 +162,9 @@ __all__ = [
     "QUANT_DEQUANT_FALLBACKS", "QUANT_ACTIVATION_BYTES",
     "INFERENCE_REQUEST_MS", "SLO_BREACHES", "SLO_BURN_RATE",
     "SLO_BREACHED", "CLUSTER_SNAPSHOT_AGE",
-    "requests", "slo", "cluster", "stragglers",
+    "EVENTS_EMITTED", "EVENTS_DROPPED", "INCIDENTS_OPEN",
+    "INCIDENTS_RESOLVED",
+    "requests", "slo", "cluster", "stragglers", "events",
     "RequestLog", "RequestTimeline", "request_log",
     "merged_chrome_trace",
     "SloTracker", "LatencyObjective", "ThroughputObjective",

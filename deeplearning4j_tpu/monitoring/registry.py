@@ -53,6 +53,11 @@ EXEC_COMPILE_SECONDS = "dl4j.exec.compile_seconds"
 EXEC_DISK_HITS = "dl4j.exec.disk_hits"
 EXEC_DESERIALIZE_FAILURES = "dl4j.exec.deserialize_failures"
 EXEC_SERIALIZE_FAILURES = "dl4j.exec.serialize_failures"
+# XLA cost model per cached executable, recorded once at compile/load
+# time (labels: store, signature) — the per-dispatch FLOPs/bytes
+# denominator behind "as fast as the hardware allows"
+EXEC_FLOPS = "dl4j.exec.flops"
+EXEC_BYTES_ACCESSED = "dl4j.exec.bytes_accessed"
 
 # shape-bucketed continuous batching (parallel/inference.py AOT path):
 # padding waste = padded_rows / (rows + padded_rows); occupancy is the
@@ -188,6 +193,14 @@ INFERENCE_REQUEST_MS = "dl4j.inference.request_ms"
 SLO_BREACHES = "dl4j.slo.breaches"
 SLO_BURN_RATE = "dl4j.slo.burn_rate"
 SLO_BREACHED = "dl4j.slo.breached"
+
+# ops event journal + incident correlation (monitoring/events.py):
+# emitted/dropped count the bounded ring's intake, open/resolved track
+# the correlator — an open incident is the fleet router's drain signal
+EVENTS_EMITTED = "dl4j.events.emitted"
+EVENTS_DROPPED = "dl4j.events.dropped"
+INCIDENTS_OPEN = "dl4j.incidents.open"
+INCIDENTS_RESOLVED = "dl4j.incidents.resolved"
 
 # cluster metrics plane (monitoring/cluster.py): per-host snapshot age
 # as seen from process 0 (labels: host; host="cluster" is the max age —

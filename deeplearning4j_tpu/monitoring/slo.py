@@ -41,6 +41,7 @@ import threading
 import time
 from collections import deque
 
+from deeplearning4j_tpu.monitoring import events as _events
 from deeplearning4j_tpu.monitoring import registry as _registry
 from deeplearning4j_tpu.monitoring.state import STATE
 
@@ -253,6 +254,21 @@ class StragglerObjective(Objective):
         return d
 
 
+def _exemplar_ids(obj, top=3):
+    """Trace ids behind the tail of the objective's histogram (if it
+    has one) — the breach event links straight to slow requests."""
+    metric = getattr(obj, "metric", None)
+    if not metric:
+        return []
+    try:
+        hist = _registry.get_registry().histogram(
+            metric, labels=getattr(obj, "labels", None))
+        return [e["trace_id"] for e in hist.exemplars(top=top)
+                if e.get("trace_id")]
+    except Exception:  # noqa: BLE001 — breach reporting must not raise
+        return []
+
+
 class SloTracker:
     """Evaluates a set of objectives on the multi-window burn-rate rule
     and carries the breach state `GET /health` reports.
@@ -354,8 +370,20 @@ class SloTracker:
                             labels={"objective": obj.name},
                             help="SLO objective breach trips "
                                  "(multi-window burn rule)").inc()
+                        _events.emit(
+                            "monitoring", _events.SLO_BREACH,
+                            attrs={"objective": obj.name,
+                                   "burn_short": round(bs, 4),
+                                   "burn_long": round(bl, 4),
+                                   "exemplars": _exemplar_ids(obj)},
+                            correlation_id="slo-%s" % obj.name)
                 elif not breached and was:
                     self._breached.pop(obj.name, None)
+                    if STATE.enabled:
+                        _events.emit(
+                            "monitoring", _events.SLO_RECOVER,
+                            attrs={"objective": obj.name},
+                            correlation_id="slo-%s" % obj.name)
                 if STATE.enabled:
                     reg = _registry.get_registry()
                     for win, b in (("short", bs), ("long", bl)):

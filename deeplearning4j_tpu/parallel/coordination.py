@@ -47,6 +47,7 @@ import time
 
 from deeplearning4j_tpu import monitoring as _mon
 from deeplearning4j_tpu.monitoring import cluster as _cluster
+from deeplearning4j_tpu.monitoring import events as _events
 from deeplearning4j_tpu.monitoring import stragglers as _stragglers
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience.errors import (PeerDesyncError,
@@ -524,6 +525,11 @@ class PeerCoordinator:
             _mon.get_registry().counter(
                 _mon.DIST_PEER_LOST,
                 help="peers declared lost/wedged/desynced").inc()
+        if _mon.enabled():
+            # before the report, so its journal tail shows this loss
+            _events.emit("parallel", _events.PEER_LOST,
+                         attrs={"message": message},
+                         correlation_id="peers-%d" % self.process_id)
         path = None
         if write_report:
             path = self._write_report(["PEER LOST: " + message]
@@ -541,6 +547,9 @@ class PeerCoordinator:
             _mon.get_registry().counter(
                 _mon.DIST_PEER_LOST,
                 help="peers declared lost/wedged/desynced").inc()
+            _events.emit("parallel", _events.PEER_DESYNC,
+                         attrs={"message": msg},
+                         correlation_id="peers-%d" % self.process_id)
         path = self._write_report(["PEER DESYNC: " + msg])
         return PeerDesyncError(msg, peers=self.peer_table(),
                                report_path=path)

@@ -37,6 +37,8 @@ import time
 
 import numpy as np
 
+from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.monitoring import events as _events
 from deeplearning4j_tpu.resilience.errors import MembershipChangeError
 
 __all__ = ["ElasticMembership", "restack_encoder",
@@ -82,6 +84,10 @@ class ElasticMembership:
         self.c.publish(f"{LEAVE_PREFIX}{pid}",
                        json.dumps({"pid": pid, "t": time.time()}),
                        overwrite=True)
+        if _mon.enabled():
+            _events.emit("parallel", _events.MEMBERSHIP_LEAVE,
+                         attrs={"pid": pid},
+                         correlation_id="membership")
         return pid
 
     def pending(self):
@@ -132,6 +138,12 @@ class ElasticMembership:
                 self.reap_host(pid)
         self.members = new_members
         self.c.reform(new_members)
+        if _mon.enabled():
+            _events.emit("parallel", _events.MEMBERSHIP_EPOCH,
+                         attrs={"epoch": self.epoch, "joins": joins,
+                                "leaves": leaves,
+                                "members": new_members},
+                         correlation_id="membership")
         return new_members
 
     def abandon(self, joins=(), leaves=()):
@@ -163,6 +175,12 @@ class ElasticMembership:
         self.epoch = int(info["epoch"])
         self.members = sorted(int(p) for p in info["members"])
         self.c.reform(self.members)
+        if _mon.enabled():
+            _events.emit("parallel", _events.MEMBERSHIP_JOINED,
+                         attrs={"pid": self.c.process_id,
+                                "epoch": self.epoch,
+                                "members": self.members},
+                         correlation_id="membership")
         return info
 
     # -- departed-host KV hygiene ----------------------------------------

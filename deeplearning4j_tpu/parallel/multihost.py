@@ -66,6 +66,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.monitoring import events as _events
 from deeplearning4j_tpu.parallel import buckets as _buckets
 from deeplearning4j_tpu.parallel import compression as _compression
 from deeplearning4j_tpu.parallel import coordination as _coord
@@ -1670,6 +1671,11 @@ class MultiHostRunner:
                       help="wall ms of the last elastic re-form "
                            "(drain save + rebuild + re-place)") \
                 .set(round((_time.monotonic() - t0) * 1000.0, 3))
+            _events.emit("parallel", _events.MEMBERSHIP_REPLACED,
+                         attrs={"lost": sorted(lost),
+                                "survivors": sorted(survivors),
+                                "step": self.step},
+                         correlation_id="membership")
         return placed["params"], placed["opt_state"]
 
     @classmethod

@@ -261,6 +261,10 @@ class FaultPlan:
             _mon.get_registry().counter(
                 _mon.RESILIENCE_FAULTS_INJECTED, labels={"site": site},
                 help="faults raised by the injection harness").inc()
+            from deeplearning4j_tpu.monitoring import events as _events
+            _events.emit("resilience", _events.FAULT_INJECTED,
+                         attrs={"site": site, "call": n,
+                                "error": type(exc).__name__})
         raise exc
 
     def calls(self, site):

@@ -55,6 +55,7 @@ import time
 import numpy as np
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.monitoring import events as _events
 from deeplearning4j_tpu.resilience.errors import DivergenceError
 
 __all__ = ["ACTIVE", "RETRY", "ROLLBACK", "TrainingGuardian",
@@ -305,6 +306,12 @@ class TrainingGuardian:
         self.max_gnorm = float("inf")
         self.last_restored_step = int(restored_step)
         self.last_good_step = self.step
+        if _mon.enabled():
+            _events.emit(
+                "resilience", _events.GUARDIAN_ROLLBACK,
+                attrs={"step": self.step, "phase": "restored",
+                       "restored_step": self.last_restored_step},
+                correlation_id="guardian-%x" % id(self))
 
     # -- the check -------------------------------------------------------
     def _materialize(self):
@@ -393,6 +400,12 @@ class TrainingGuardian:
                     and self.lr_scale != 1.0:
                 self.lr_scale = 1.0
                 self.lr_retries = 0
+                if _mon.enabled():
+                    _events.emit(
+                        "resilience", _events.GUARDIAN_RECOVERED,
+                        attrs={"step": self.step,
+                               "good_checks": self._good_checks},
+                        correlation_id="guardian-%x" % id(self))
         if _mon.enabled():
             reg = _mon.get_registry()
             reg.counter(_mon.GUARDIAN_CHECKS,
@@ -436,6 +449,11 @@ class TrainingGuardian:
                 _mon.get_registry().counter(
                     _mon.GUARDIAN_LR_RETRIES,
                     help="reduce-LR-and-retry escalations").inc()
+                _events.emit(
+                    "resilience", _events.GUARDIAN_RETRY,
+                    attrs={"step": self.step, "lr_scale": self.lr_scale,
+                           "retry": bool(can_retry)},
+                    correlation_id="guardian-%x" % id(self))
             return
         if self.rollbacks < self.max_rollbacks:
             self.rollbacks += 1                  # rung 3: checkpoint
@@ -446,8 +464,19 @@ class TrainingGuardian:
                     _mon.GUARDIAN_ROLLBACKS,
                     help="checkpoint rollbacks the guardian "
                          "requested").inc()
+                _events.emit(
+                    "resilience", _events.GUARDIAN_ROLLBACK,
+                    attrs={"step": self.step, "phase": "requested",
+                           "rollbacks": self.rollbacks},
+                    correlation_id="guardian-%x" % id(self))
             return
         self.healthy = False                     # rung 4: give up
+        if _mon.enabled():
+            _events.emit(
+                "resilience", _events.GUARDIAN_DIVERGED,
+                attrs={"step": self.step, "skipped": self.skipped,
+                       "rollbacks": self.rollbacks},
+                correlation_id="guardian-%x" % id(self))
 
     # -- introspection (GET /health) -------------------------------------
     def snapshot(self):

@@ -67,6 +67,7 @@ import time
 import traceback
 
 from deeplearning4j_tpu import monitoring as _mon
+from deeplearning4j_tpu.monitoring import events as _events
 
 __all__ = ["ACTIVE", "StallWatchdog", "clear_watchdog", "default_timeout",
            "write_debug_report"]
@@ -121,7 +122,10 @@ def write_debug_report(headline, dump_dir=None, prefix="dl4j-stall-report",
     Python thread's stack, the flight-recorder tail, the last device
     memory reading, and the multi-host peer table. Returns the report
     path. `extra_sections` is a list of line-lists inserted after the
-    headline."""
+    headline. Every caller (stall watchdog, peer monitor, crash dumps
+    via util/crash_reporting) shares the journal-tail section below,
+    and a machine-readable post-mortem bundle rides alongside the text
+    report when monitoring is enabled."""
     ts = time.strftime("%Y%m%d-%H%M%S")
     directory = dump_dir or os.getcwd()
     os.makedirs(directory, exist_ok=True)
@@ -135,6 +139,8 @@ def write_debug_report(headline, dump_dir=None, prefix="dl4j-stall-report",
     for section in (extra_sections or ()):
         lines.extend(section)
         lines.append("")
+    lines.extend(_events.event_tail_lines())
+    lines.append("")
     lines.extend(_peer_table_lines())
     lines.append("")
     lines.append("Open monitoring spans by thread:")
@@ -165,6 +171,11 @@ def write_debug_report(headline, dump_dir=None, prefix="dl4j-stall-report",
             lines.append(f"  {k}: {v}")
     else:
         lines.append("  (none — memory telemetry not sampling)")
+    if _mon.enabled():
+        bundle_path = _events.write_bundle(
+            dump_dir=directory, headline=f"{prefix}: see {path}")
+        lines.append("")
+        lines.append(f"Post-mortem bundle: {bundle_path or '(failed)'}")
     with open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
     if count_dump and _mon.enabled():
@@ -216,6 +227,11 @@ class StallWatchdog:
             age = self.beat_age()
             if age is None or age <= self.stall_timeout:
                 self.stalled = False
+                if _mon.enabled():
+                    _events.emit(
+                        "resilience", _events.WATCHDOG_RECOVERED,
+                        attrs={"trainer": name},
+                        correlation_id="watchdog-%x" % id(self))
 
     def retire(self, name="trainer"):
         """A trainer's fit completed: its heartbeat stops being stall
@@ -344,6 +360,11 @@ class StallWatchdog:
                 _mon.WATCHDOG_STALLS,
                 help="training steps that exceeded the stall "
                      "timeout").inc()
+            _events.emit(
+                "resilience", _events.WATCHDOG_STALL,
+                attrs={"beat_age_s": round(age, 3),
+                       "timeout_s": self.stall_timeout},
+                correlation_id="watchdog-%x" % id(self))
         try:
             self.last_report_path = self._write_report(age)
         except Exception:  # noqa: BLE001 — the report must never kill us

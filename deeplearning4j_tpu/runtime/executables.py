@@ -287,11 +287,29 @@ class BucketLadder:
 
 # -- the stores ------------------------------------------------------------
 class _Entry:
-    __slots__ = ("call", "source")
+    __slots__ = ("call", "source", "cost")
 
     def __init__(self, call, source):
         self.call = call            # compiled/loaded executable
         self.source = source        # "compile" | "disk"
+        self.cost = None            # {"flops","bytes_accessed"} or None
+
+
+def _cost_of(call):
+    """XLA's static cost analysis for one compiled executable:
+    {"flops", "bytes_accessed"} floats, or None when the backend
+    doesn't expose it. Pure host metadata — no dispatch, no sync."""
+    try:
+        ca = call.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        bytes_accessed = float(ca.get("bytes accessed", 0.0))
+        if flops <= 0.0 and bytes_accessed <= 0.0:
+            return None
+        return {"flops": flops, "bytes_accessed": bytes_accessed}
+    except Exception:  # noqa: BLE001 — cost is advisory, never fatal
+        return None
 
 
 class _AotStoreBase:
@@ -349,6 +367,23 @@ class _AotStoreBase:
         if _mon.enabled():
             _mon.get_registry().counter(name, help=help_).inc()
 
+    def _note_cost(self, key, e):
+        """Record the executable's static cost once, at compile/load
+        time (miss path only — the steady-state lookup never re-reads
+        it): per-entry on the store status, and per-signature gauges so
+        tokens/s has a FLOPs-per-dispatch denominator."""
+        e.cost = _cost_of(e.call)
+        if e.cost is not None and _mon.enabled():
+            reg = _mon.get_registry()
+            labels = {"store": self.kind, "signature": repr(key)[:120]}
+            reg.gauge(_mon.EXEC_FLOPS, labels=labels,
+                      help="XLA cost-analysis FLOPs per dispatch of "
+                           "this cached executable").set(e.cost["flops"])
+            reg.gauge(_mon.EXEC_BYTES_ACCESSED, labels=labels,
+                      help="XLA cost-analysis bytes accessed per "
+                           "dispatch of this cached executable") \
+               .set(e.cost["bytes_accessed"])
+
     def _resolve(self, key, lower_fn):
         """Memory → disk (deserialize, no XLA compile) → live compile
         (persisted back), under the store lock. Corrupt or mismatched
@@ -369,9 +404,11 @@ class _AotStoreBase:
                 e = self._load_disk(key, path)
                 if e is not None:
                     self._mem[key] = e
+                    self._note_cost(key, e)
                     return e
             e = self._compile_live(key, lower_fn, path)
             self._mem[key] = e
+            self._note_cost(key, e)
             return e
 
     def _load_disk(self, key, path):
@@ -497,12 +534,23 @@ class _AotStoreBase:
                     "serving executables that could not be "
                     "serialized to disk (in-process cache only)")
 
+    @staticmethod
+    def _entry_status(k, e):
+        d = {"signature": repr(k), "source": e.source}
+        if e.cost is not None:
+            d["flops"] = e.cost["flops"]
+            d["bytes_accessed"] = e.cost["bytes_accessed"]
+            d["cost"] = ("%.3g MFLOPs / %.3g MB per dispatch"
+                         % (e.cost["flops"] / 1e6,
+                            e.cost["bytes_accessed"] / 1e6))
+        return d
+
     def status(self):
         return {"kind": self.kind,
                 "fingerprint": self.fingerprint,
                 "flavour": self.flavour,
                 "directory": self.directory,
-                "entries": [{"signature": repr(k), "source": e.source}
+                "entries": [self._entry_status(k, e)
                             for k, e in sorted(self._mem.items(),
                                                key=lambda kv: repr(kv[0]))],
                 "trace_calls": self.trace_calls,
@@ -587,8 +635,8 @@ class ExecutableStore(_AotStoreBase):
     def status(self):
         base = super().status()
         base["model"] = type(self.model).__name__
-        base["entries"] = [{"signature": repr(k[0]), "masked": k[1],
-                            "source": e.source}
+        base["entries"] = [dict(self._entry_status(k, e),
+                                signature=repr(k[0]), masked=k[1])
                            for k, e in sorted(self._mem.items(),
                                               key=lambda kv: repr(kv[0]))]
         return base

@@ -107,6 +107,12 @@ records appear while monitoring is enabled
 (<code>MetricsListener()</code>)</div>
 <pre id="steps" style="max-height:320px;overflow:auto;font-size:12px">
 no step records yet</pre></div>
+<div class="chart"><h2>Incidents (ops event journal)</h2>
+<div class="meta">Correlated cross-subsystem incidents — raw events at
+<code>GET /events</code>, incidents at <code>GET /incidents</code>;
+post-mortem bundle on demand via <code>POST /debug/bundle</code></div>
+<pre id="incidents" style="max-height:240px;overflow:auto;font-size:12px">
+no incidents yet</pre></div>
 <script>
 const COLORS = ['#0a6','#06a','#a06','#a60','#60a','#6a0','#066','#660'];
 function poly(svg, xs, ys, color){
@@ -272,6 +278,19 @@ async function tick(){
           (r.wall_ms==null?'?':r.wall_ms.toFixed(2)) + ` ms  ${ph}\n`;
       }
       el.textContent = txt;
+    }
+  } catch (e) {}
+  try {
+    const ir = await fetch('/incidents'); const id_ = await ir.json();
+    const rows = [...(id_.open||[]), ...(id_.recent||[]).slice().reverse()];
+    if (rows.length){
+      document.getElementById('incidents').textContent = rows.map(i =>
+        `${i.state==='open' ? 'OPEN  ' : 'closed'} ${i.id} · ` +
+        `trigger ${i.trigger.kind} [${i.trigger.subsystem}] · ` +
+        `${i.actions.length} actions · ` +
+        `resolution ${i.resolution || '-'} · ` +
+        (i.duration_s==null ? 'ongoing' :
+         `${i.duration_s.toFixed(2)} s`)).join("\n");
     }
   } catch (e) {}
   const tr = await fetch('/tsne'); const td = await tr.json();
@@ -536,6 +555,28 @@ class UIServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                elif self.path.startswith("/events"):
+                    # ops event journal tail (monitoring/events.py):
+                    # ordered structured events across subsystems;
+                    # /events?last=N bounds the tail
+                    from deeplearning4j_tpu.monitoring import \
+                        events as _ev
+                    q = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query)
+                    try:
+                        last = int(q.get("last", ["64"])[0])
+                    except ValueError:
+                        last = 64
+                    body = json.dumps(_ev.snapshot(last=last)).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/incidents"):
+                    # correlated incidents: open + recently closed, each
+                    # {trigger, actions, resolution, duration} linking
+                    # through to /requests/<id> and /trace
+                    from deeplearning4j_tpu.monitoring import \
+                        events as _ev
+                    body = json.dumps(_ev.incidents()).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/metrics"):
                     # Prometheus scrape surface for the host-side
                     # monitoring registry; with monitoring ENABLED the
@@ -600,6 +641,24 @@ class UIServer:
                     body = json.dumps({"armed": True,
                                        "steps": session.steps}).encode()
                     code = 200
+                elif self.path.startswith("/debug/bundle"):
+                    # on-demand post-mortem bundle: one JSON file with
+                    # the event tail, incidents, metrics snapshot, step
+                    # recorder, request ring, health and open spans —
+                    # the same document crash dumps and stall reports
+                    # write (monitoring/events.py bundle()). ?dir=
+                    # overrides the output directory.
+                    from deeplearning4j_tpu.monitoring import \
+                        events as _ev
+                    q = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query)
+                    dump_dir = q.get("dir", [None])[0]
+                    p = _ev.write_bundle(dump_dir=dump_dir,
+                                         headline="POST /debug/bundle")
+                    body = json.dumps(
+                        {"path": p,
+                         "sections": list(_ev.BUNDLE_SECTIONS)}).encode()
+                    code = 200 if p else 500
                 else:
                     body = b'{"error": "unknown endpoint"}'
                     code = 404

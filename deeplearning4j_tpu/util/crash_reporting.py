@@ -182,6 +182,26 @@ class CrashReportingUtil:
             lines.append(f"(flight recorder unavailable: {e})")
             lines.append("")
 
+        # ops event journal tail: the SAME section stall and peer
+        # reports embed (monitoring/events.py) — the ordered causal
+        # record leading into this crash, plus the machine-readable
+        # post-mortem bundle alongside the text dump
+        try:
+            from deeplearning4j_tpu import monitoring as _mon
+            from deeplearning4j_tpu.monitoring import events as _events
+            lines.extend(_events.event_tail_lines())
+            lines.append("")
+            if _mon.enabled():
+                bundle_path = _events.write_bundle(
+                    dump_dir=os.path.dirname(path) or None,
+                    headline=f"memory crash dump: see {path}")
+                lines.append(f"Post-mortem bundle: "
+                             f"{bundle_path or '(failed)'}")
+                lines.append("")
+        except Exception as e:  # noqa: BLE001 — dumps must never raise
+            lines.append(f"(event journal unavailable: {e})")
+            lines.append("")
+
         # monitoring snapshot: what was the process DOING at OOM time?
         # (counters tell the story so far, the open span stack tells the
         # phase that died). Only when monitoring is on — the dump must

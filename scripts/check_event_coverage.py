@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Event-kind coverage lint: every ops-event kind declared in
+`monitoring/events.py` must be exercised by at least one test.
+
+The event journal is an incident-forensics surface — an event kind no
+test ever emits is a timeline entry nobody has ever seen rendered, and
+its correlation behavior (does it open an incident? absorb? resolve?)
+is unverified. This script parses events.py for the declared kind
+constants (module-level ``UPPER_NAME = "dotted.kind"`` string
+assignments) and greps the test tree for either the constant name
+(``SERVER_DISRUPTED``) or the literal kind string
+(``"server.disrupted"``). A kind referenced by neither fails the lint,
+so a new event kind cannot ship untested.
+
+Grep-based on purpose, exactly like `check_fault_coverage.py`: it runs
+in tier-1 (tests/test_event_coverage.py) with zero imports of jax or
+the package, and a textual reference is the right bar — the
+referencing test, not this lint, is responsible for emitting the kind
+through a production hook or asserting its correlation semantics.
+
+Run manually:  python scripts/check_event_coverage.py
+(prints uncovered kinds, exit 1 when any).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EVENTS_MODULE = os.path.join(REPO_ROOT, "deeplearning4j_tpu",
+                             "monitoring", "events.py")
+TESTS_DIR = os.path.join(REPO_ROOT, "tests")
+
+#: what a kind value looks like: lowercase dotted words
+#: ("server.disrupted"). Filters out the other module-level string
+#: constants (metric names carry the "dl4j." prefix but those live in
+#: registry.py, not here; defaults and section tuples never match).
+_KIND_RE = re.compile(r"[a-z_]+(\.[a-z_]+)+")
+
+
+def declared_kinds(source=None):
+    """{CONSTANT_NAME: "kind.string"} for every module-level kind
+    declaration in events.py (or the given source override)."""
+    if source is None:
+        with open(EVENTS_MODULE) as f:
+            source = f.read()
+    kinds = {}
+    for node in ast.parse(source).body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        value = node.value
+        if (name.isupper() and isinstance(value, ast.Constant)
+                and isinstance(value.value, str)
+                and _KIND_RE.fullmatch(value.value)):
+            kinds[name] = value.value
+    return kinds
+
+
+def test_sources(tests_dir=None):
+    """{path: source} for every python file under tests/."""
+    tests_dir = tests_dir or TESTS_DIR
+    out = {}
+    for base, _, names in os.walk(tests_dir):
+        for n in sorted(names):
+            if n.endswith(".py"):
+                path = os.path.join(base, n)
+                with open(path) as f:
+                    out[path] = f.read()
+    return out
+
+
+def uncovered_kinds(kinds=None, sources=None):
+    """[(constant, kind)] declared kinds no test references by
+    constant name (word-bounded) or literal string."""
+    kinds = declared_kinds() if kinds is None else kinds
+    sources = test_sources() if sources is None else sources
+    blob = "\n".join(sources.values())
+    missing = []
+    for name, kind in sorted(kinds.items()):
+        if re.search(rf"\b{re.escape(name)}\b", blob):
+            continue
+        if kind in blob:
+            continue
+        missing.append((name, kind))
+    return missing
+
+
+def main():
+    missing = uncovered_kinds()
+    for name, kind in missing:
+        print(f"{name} ({kind!r}): no test references this ops-event "
+              "kind")
+    if missing:
+        print(f"\n{len(missing)} uncovered event kind(s): every "
+              "events.py kind must be exercised by at least one test "
+              "(reference the constant or the kind string and drive "
+              "the emission hook or its correlation semantics).")
+    return missing
+
+
+if __name__ == "__main__":
+    sys.exit(1 if main() else 0)

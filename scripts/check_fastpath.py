@@ -469,6 +469,78 @@ def check_metrics_publish_guarded(source, path="<string>"):
     return violations
 
 
+# -- ops-event emission lint ------------------------------------------------
+#: modules holding ops-event emission hooks (monitoring/events.py
+#: `_events.emit(...)` call sites): every emit must sit inside the
+#: enabled-guard — with monitoring off an event hook costs ONE branch,
+#: never a lock + ring append. events.py itself stays out of
+#: HOT_MODULES on purpose: it IS the guarded side, and its bundle()
+#: crash path reads the registry unconditionally by design.
+EVENT_HOOK_MODULES = [
+    "deeplearning4j_tpu/resilience/guardian.py",
+    "deeplearning4j_tpu/resilience/watchdog.py",
+    "deeplearning4j_tpu/resilience/faults.py",
+    "deeplearning4j_tpu/generation/server.py",
+    "deeplearning4j_tpu/parallel/coordination.py",
+    "deeplearning4j_tpu/parallel/membership.py",
+    "deeplearning4j_tpu/parallel/multihost.py",
+    "deeplearning4j_tpu/monitoring/slo.py",
+]
+#: the canonical import alias at every hook site
+EVENT_EMIT_ALIASES = {"_events"}
+
+#: the journal's own emit path (everything an `emit()` call can reach)
+#: must stay pure host bookkeeping: no device touch, no trace. The
+#: post-mortem side (`bundle`/`write_bundle`) is the declared boundary
+#: — it runs on the failure path, never at emission cadence.
+EVENT_JOURNAL_MODULES = ["deeplearning4j_tpu/monitoring/events.py"]
+EVENT_EMIT_ROOTS = {"emit", "journal", "_correlate", "_sweep_quiet",
+                    "_close", "_publish_locked", "snapshot",
+                    "incidents", "absorb", "close"}
+EVENT_EMIT_BOUNDARY = {"bundle", "write_bundle"}
+
+
+def check_event_emit_guarded(source, path="<string>"):
+    """Every ops-event emission hook (`_events.emit(...)`) must sit
+    inside the enabled-guard: the event journal is monitoring-plane
+    state, and a disabled run pays one branch per hook site, not a
+    journal append per incident-adjacent code path."""
+    tree = ast.parse(source, filename=path)
+    violations = []
+
+    def walk(node, ancestors):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "emit" \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in EVENT_EMIT_ALIASES \
+                    and not _guarded(node, ancestors):
+                violations.append(
+                    (path, node.lineno,
+                     f"{f.value.id}.emit(...) outside the "
+                     "enabled-guard — ops-event hooks must cost one "
+                     "branch when monitoring is off"))
+        for child in ast.iter_child_nodes(node):
+            walk(child, ancestors + [node])
+
+    walk(tree, [])
+    return violations
+
+
+def check_event_emit_host_pure(sources):
+    """The journal emit path (emit → correlate → sweep → publish) rides
+    failure-adjacent hot paths (decode loop, sync point, train step) —
+    walking it must reach NO device materialization and NO trace; the
+    post-mortem bundle writer is the declared cold boundary."""
+    return _check_reachable(
+        sources, EVENT_EMIT_ROOTS, EVENT_EMIT_BOUNDARY,
+        SYNC_CALL_NAMES | TRACE_CALL_NAMES,
+        lambda what, via: (
+            f"{what} reachable from the event-journal emit path (via "
+            f"{via}) — emission must stay pure host bookkeeping; only "
+            "bundle()/write_bundle() may do heavyweight work"))
+
+
 def main(modules=None):
     violations = []
     for rel in modules or HOT_MODULES:
@@ -513,6 +585,19 @@ def main(modules=None):
                 with open(path) as f:
                     violations.extend(
                         check_metrics_publish_guarded(f.read(), path))
+        for rel in EVENT_HOOK_MODULES:
+            path = os.path.join(REPO_ROOT, rel)
+            if os.path.exists(path):
+                with open(path) as f:
+                    violations.extend(
+                        check_event_emit_guarded(f.read(), path))
+        ev_sources = {}
+        for rel in EVENT_JOURNAL_MODULES:
+            path = os.path.join(REPO_ROOT, rel)
+            if os.path.exists(path):
+                with open(path) as f:
+                    ev_sources[path] = f.read()
+        violations.extend(check_event_emit_host_pure(ev_sources))
     for path, lineno, msg in violations:
         print(f"{os.path.relpath(path, REPO_ROOT)}:{lineno}: {msg}")
     if violations:

@@ -167,6 +167,12 @@ def test_attn_impl_auto_and_flash_match_dense():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow   # suite diet (ISSUE 19): ~10 s — grad-compiles the
+# whole encoder twice; masked-flash numerics keep kernel-level
+# fast-lane twins (test_kernels.py::test_flash_masked_fwd_matches_dense,
+# test_flash_masked_grads_match_dense,
+# test_flash_masked_no_grad_leak_to_padding) and the bert-level flash
+# wiring stays via test_attn_impl_auto_and_flash_match_dense
 def test_flash_handles_padding_mask(tiny):
     """Round-3: attn_impl='flash' accepts padded batches (the kernels carry
     a per-example validity mask); valid-position numerics == dense."""

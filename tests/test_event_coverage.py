@@ -1,0 +1,44 @@
+"""Tier-1 gate for scripts/check_event_coverage.py: every ops-event
+kind declared in monitoring/events.py must be exercised by at least
+one test, so a new event kind cannot ship with unverified correlation
+semantics (the same run-the-lint-in-CI pattern as
+test_fault_coverage.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+import check_event_coverage as cec  # noqa: E402
+
+from deeplearning4j_tpu.monitoring import events  # noqa: E402
+
+
+def test_every_declared_kind_is_covered():
+    missing = cec.uncovered_kinds()
+    assert missing == [], (
+        "event kinds with no exercising test: "
+        + ", ".join(f"{n} ({k})" for n, k in missing))
+
+
+def test_declared_kinds_match_the_harness():
+    """The AST scrape agrees with what the events module actually
+    exports — a kind constant the scrape misses would silently escape
+    the coverage gate."""
+    kinds = cec.declared_kinds()
+    exported = {n: getattr(events, n) for n in events.__all__
+                if isinstance(getattr(events, n), str)
+                and cec._KIND_RE.fullmatch(getattr(events, n))}
+    assert kinds == exported
+    assert "SERVER_DISRUPTED" in kinds and "PRESSURE_ESCALATED" in kinds
+
+
+def test_detects_an_uncovered_kind():
+    kinds = {"FAKE_KIND": "totally.uncovered"}
+    sources = {"tests/test_x.py": "def test_nothing():\n    pass\n"}
+    missing = cec.uncovered_kinds(kinds, sources)
+    assert missing == [("FAKE_KIND", "totally.uncovered")]
+    # covered by constant name OR by the literal kind string
+    by_name = {"tests/test_x.py": "ev.emit('x', events.FAKE_KIND)"}
+    assert cec.uncovered_kinds(kinds, by_name) == []
+    by_literal = {"tests/test_x.py": 'journal.emit("x", "totally.uncovered")'}
+    assert cec.uncovered_kinds(kinds, by_literal) == []

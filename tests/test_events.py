@@ -1,0 +1,664 @@
+"""Ops event journal (monitoring/events.py): the bounded ordered ring,
+incident correlation (trigger → actions → resolution), the seven-section
+post-mortem bundle, the /events + /incidents + POST /debug/bundle
+surfaces, and the production emission hooks across resilience,
+generation serving, the parallel stack, and the SLO tracker.
+
+The acceptance scenarios: a seeded decode kill and a pressure-ladder
+walk each produce a DETERMINISTIC ordered incident on GET /incidents
+(trigger kind, action kinds, resolution); crash dumps, stall reports
+and peer reports all embed the SAME journal-tail section plus a
+machine-readable bundle; and the executable cost gauges ride
+GET /executables. scripts/check_event_coverage.py asserts every kind
+declared in events.py is referenced here (or by another test)."""
+import glob
+import json
+import os
+import tempfile
+import threading
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu import monitoring as mon
+from deeplearning4j_tpu.monitoring import events as ev
+from deeplearning4j_tpu.monitoring import slo
+from deeplearning4j_tpu.monitoring.registry import MetricsRegistry
+from deeplearning4j_tpu.resilience import (StallWatchdog, TrainingGuardian,
+                                           faults)
+from deeplearning4j_tpu.resilience.errors import InjectedFault
+from deeplearning4j_tpu.util.crash_reporting import CrashReportingUtil
+
+
+@pytest.fixture(autouse=True)
+def _clean_harness():
+    faults.clear_plan()
+    ev.reset()
+    yield
+    faults.clear_plan()
+    ev.reset()
+    mon.disable()
+
+
+def _fake_journal(**kw):
+    """Journal on a test-owned clock: deterministic window/quiet sweeps."""
+    t = [0.0]
+    kw.setdefault("window_s", 5.0)
+    kw.setdefault("quiet_s", 10.0)
+    j = ev.reset(clock=lambda: t[0], **kw)
+    return j, t
+
+
+# ===================== the journal itself ==============================
+def test_ring_is_bounded_ordered_and_counts_drops():
+    j = ev.reset(capacity=4)
+    mon.enable()
+    for i in range(6):
+        ev.emit("test", ev.CACHE_GROWN, attrs={"i": i})
+    snap = ev.snapshot(last=None)
+    assert snap["capacity"] == 4 and snap["emitted"] == 6
+    assert snap["dropped"] == 2
+    seqs = [e["seq"] for e in snap["events"]]
+    assert seqs == [3, 4, 5, 6], "ring keeps the ordered tail"
+    assert [e["attrs"]["i"] for e in snap["events"]] == [2, 3, 4, 5]
+    # last=N bounds the served tail without touching the ring
+    assert [e["seq"] for e in ev.snapshot(last=2)["events"]] == [5, 6]
+    assert j.snapshot(last=0)["events"] == []
+
+
+def test_disabled_emit_is_a_noop_behind_one_branch():
+    mon.disable()
+    assert ev.emit("test", ev.SERVER_DEAD, attrs={"reason": "x"}) is None
+    snap = ev.snapshot(last=None)
+    assert snap["emitted"] == 0 and snap["events"] == []
+    assert ev.incidents()["open"] == []
+
+
+#: the full kind catalog with its default severities — every constant
+#: referenced BY NAME so scripts/check_event_coverage.py sees each kind
+#: exercised, and each one emitted through a real journal below
+_CATALOG = [
+    (ev.GUARDIAN_RETRY, "error"),
+    (ev.GUARDIAN_ROLLBACK, "error"),
+    (ev.GUARDIAN_DIVERGED, "error"),
+    (ev.GUARDIAN_RECOVERED, "info"),
+    (ev.WATCHDOG_STALL, "error"),
+    (ev.WATCHDOG_RECOVERED, "info"),
+    (ev.FAULT_INJECTED, "info"),
+    (ev.PRESSURE_ESCALATED, "error"),
+    (ev.PRESSURE_RELIEVED, "info"),
+    (ev.SERVER_REFUSED, "warn"),
+    (ev.SERVER_SHED, "warn"),
+    (ev.CACHE_GROWN, "info"),
+    (ev.CACHE_SHRUNK, "warn"),
+    (ev.PAGES_EXHAUSTED, "warn"),
+    (ev.PAGES_EVICTED, "info"),
+    (ev.SERVER_DISRUPTED, "error"),
+    (ev.SERVER_REPLAY, "info"),
+    (ev.SERVER_RESTARTED, "warn"),
+    (ev.SERVER_RECOVERED, "info"),
+    (ev.SERVER_DEAD, "error"),
+    (ev.MEMBERSHIP_EPOCH, "info"),
+    (ev.MEMBERSHIP_JOINED, "info"),
+    (ev.MEMBERSHIP_LEAVE, "info"),
+    (ev.MEMBERSHIP_REPLACED, "warn"),
+    (ev.PEER_LOST, "error"),
+    (ev.PEER_DESYNC, "error"),
+    (ev.SLO_BREACH, "error"),
+    (ev.SLO_RECOVER, "info"),
+]
+
+
+def test_kind_catalog_severities_and_incident_opening():
+    assert {k for k, _ in _CATALOG} == set(ev.KIND_SEVERITY), \
+        "the catalog above must track events.KIND_SEVERITY exactly"
+    for kind, severity in _CATALOG:
+        j = ev.EventJournal(capacity=8)
+        e = j.emit("test", kind)
+        assert e["severity"] == severity, kind
+        opens = (severity == "error")
+        assert (len(j.incidents()["open"]) == 1) == opens, kind
+    # explicit severity override wins over the catalog default
+    j = ev.EventJournal(capacity=8)
+    assert j.emit("test", ev.CACHE_GROWN,
+                  severity="warn")["severity"] == "warn"
+
+
+def test_incident_trigger_actions_resolution_and_links():
+    j, t = _fake_journal()
+    mon.enable()
+    ev.emit("generation", ev.SERVER_DISRUPTED,
+            attrs={"error": "InjectedFault"}, correlation_id="g1")
+    t[0] = 1.0
+    ev.emit("generation", ev.SERVER_REPLAY,
+            attrs={"request": "req-a"}, correlation_id="g1")
+    t[0] = 2.5
+    ev.emit("generation", ev.SERVER_RECOVERED,
+            attrs={"via": "replay"}, correlation_id="g1")
+    inc = ev.incidents()
+    assert inc["open"] == [] and inc["resolved_total"] == 1
+    snap = inc["recent"][0]
+    assert snap["state"] == "resolved"
+    assert snap["trigger"]["kind"] == ev.SERVER_DISRUPTED
+    assert snap["kinds"] == [ev.SERVER_DISRUPTED, ev.SERVER_REPLAY,
+                             ev.SERVER_RECOVERED]
+    assert snap["resolution"] == ev.SERVER_RECOVERED
+    assert snap["duration_s"] == pytest.approx(2.5)
+    assert snap["links"]["trace"] == "/trace"
+    assert snap["links"]["requests"] == ["/requests/req-a"]
+    # the events themselves carry the incident id they were filed under
+    evs = ev.snapshot(last=None)["events"]
+    assert {e["incident"] for e in evs} == {snap["id"]}
+
+
+def test_incident_window_quiet_close_and_correlation_beyond_window():
+    j, t = _fake_journal(window_s=5.0, quiet_s=10.0)
+    mon.enable()
+    ev.emit("resilience", ev.WATCHDOG_STALL)            # opens, no corr
+    t[0] = 3.0
+    ev.emit("resilience", ev.GUARDIAN_RETRY)            # within window:
+    assert len(ev.incidents()["open"]) == 1             # absorbed
+    # quiet period passes with no adjacent events: lazy close at the
+    # next emit/snapshot, resolution None (nothing claimed recovery)
+    t[0] = 20.0
+    inc = ev.incidents()
+    assert inc["open"] == [] and inc["recent"][0]["resolution"] is None
+    assert inc["recent"][0]["kinds"] == [ev.WATCHDOG_STALL,
+                                         ev.GUARDIAN_RETRY]
+    # same correlation id glues events across a gap LONGER than the
+    # adjacency window (a slow rollback still belongs to its incident)
+    t[0] = 30.0
+    ev.emit("parallel", ev.PEER_LOST, correlation_id="peers-0")
+    t[0] = 38.0                                          # gap 8 s > 5 s
+    ev.emit("parallel", ev.MEMBERSHIP_REPLACED, correlation_id="peers-0")
+    open_inc = ev.incidents()["open"][0]
+    assert open_inc["kinds"] == [ev.PEER_LOST, ev.MEMBERSHIP_REPLACED]
+    # but an UNcorrelated error outside the window (yet before the
+    # quiet period closes the first) opens its own incident
+    t[0] = 44.5                                          # gap 6.5 s > 5 s
+    ev.emit("generation", ev.SERVER_DEAD, correlation_id="other")
+    assert len(ev.incidents()["open"]) == 2
+
+
+def test_env_knobs_size_the_ring_and_correlator(monkeypatch):
+    monkeypatch.setenv("DL4J_EVENT_RING", "7")
+    monkeypatch.setenv("DL4J_INCIDENT_WINDOW", "2.5")
+    monkeypatch.setenv("DL4J_INCIDENT_QUIET", "20")
+    j = ev.EventJournal()
+    assert j.capacity == 7
+    assert j.window_s == 2.5 and j.quiet_s == 20.0
+    monkeypatch.setenv("DL4J_EVENT_RING", "bogus")
+    assert ev.EventJournal().capacity == 512
+
+
+def test_journal_metrics_published_on_the_registry():
+    ev.reset(capacity=2)
+    mon.enable()
+    reg = mon.get_registry()
+    emitted0 = reg.counter(mon.EVENTS_EMITTED).value
+    ev.emit("generation", ev.SERVER_DISRUPTED, correlation_id="m1")
+    ev.emit("generation", ev.SERVER_REPLAY, correlation_id="m1")
+    ev.emit("generation", ev.SERVER_RECOVERED, correlation_id="m1")
+    assert reg.counter(mon.EVENTS_EMITTED).value - emitted0 == 3
+    assert reg.gauge(mon.EVENTS_DROPPED).value == 1     # ring of 2
+    assert reg.gauge(mon.INCIDENTS_OPEN).value == 0
+    assert reg.gauge(mon.INCIDENTS_RESOLVED).value == 1
+
+
+def test_emission_is_thread_safe_and_totally_ordered():
+    ev.reset(capacity=4096)
+    mon.enable()
+
+    def pump(k):
+        for _ in range(100):
+            ev.emit("test", ev.CACHE_GROWN, attrs={"w": k})
+
+    threads = [threading.Thread(target=pump, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = ev.snapshot(last=None)
+    seqs = [e["seq"] for e in snap["events"]]
+    assert snap["emitted"] == 400 and seqs == sorted(seqs)
+    assert len(set(seqs)) == 400, "seq is unique under concurrency"
+
+
+# ===================== post-mortem bundle ==============================
+def test_bundle_has_all_seven_sections_and_roundtrips(tmp_path):
+    mon.enable()
+    ev.emit("test", ev.SERVER_DISRUPTED, correlation_id="b1")
+    ev.emit("test", ev.SERVER_RECOVERED, correlation_id="b1")
+    path = ev.write_bundle(dump_dir=str(tmp_path), headline="unit test")
+    assert path is not None and os.path.exists(path)
+    with open(path) as f:
+        doc = json.load(f)                    # valid JSON round-trip
+    assert tuple(doc["meta"]["sections"]) == ev.BUNDLE_SECTIONS
+    for section in ev.BUNDLE_SECTIONS:
+        assert section in doc, f"missing bundle section: {section}"
+    assert doc["meta"]["headline"] == "unit test"
+    assert doc["events"]["emitted"] == 2
+    assert doc["incidents"]["resolved_total"] == 1
+    assert isinstance(doc["metrics"], dict)   # registry snapshot
+    assert "records" in doc["steps"] and "summary" in doc["steps"]
+    assert "recent" in doc["requests"]
+    assert "status" in doc["health"]
+    # explicit path wins over dump_dir resolution
+    p2 = ev.write_bundle(path=str(tmp_path / "b.json"))
+    assert p2 == str(tmp_path / "b.json") and os.path.exists(p2)
+
+
+def test_event_tail_lines_is_the_shared_debug_section():
+    mon.enable()
+    ev.emit("generation", ev.PAGES_EXHAUSTED, attrs={"request": "r1"},
+            correlation_id="g9")
+    lines = ev.event_tail_lines()
+    assert lines[0] == "Ops event journal (tail):"
+    assert any(ev.PAGES_EXHAUSTED in ln and "corr=g9" in ln
+               and "request=r1" in ln for ln in lines)
+    ev.reset()
+    assert "  (no events recorded)" in ev.event_tail_lines()
+
+
+def test_crash_dump_embeds_journal_tail_and_writes_bundle(tmp_path):
+    mon.enable()
+    ev.emit("generation", ev.SERVER_SHED, attrs={"shed": 3},
+            correlation_id="crash")
+    path = CrashReportingUtil.writeMemoryCrashDump(
+        object(), MemoryError("RESOURCE_EXHAUSTED: out of memory"),
+        path=str(tmp_path / "dump.txt"))
+    text = open(path).read()
+    assert "Ops event journal (tail):" in text
+    assert ev.SERVER_SHED in text and "corr=crash" in text
+    assert "Post-mortem bundle:" in text
+    bundles = glob.glob(str(tmp_path / "dl4j-bundle-*.json"))
+    assert len(bundles) == 1
+    assert set(ev.BUNDLE_SECTIONS) <= set(json.load(open(bundles[0])))
+
+
+# ===================== dashboard surfaces ==============================
+def test_events_incidents_and_debug_bundle_endpoints(tmp_path):
+    from deeplearning4j_tpu.ui.server import UIServer
+    mon.enable()
+    ev.emit("generation", ev.SERVER_DISRUPTED, correlation_id="u1")
+    ev.emit("generation", ev.SERVER_REPLAY, attrs={"request": "r-7"},
+            correlation_id="u1")
+    ev.emit("generation", ev.SERVER_RECOVERED, correlation_id="u1")
+    server = UIServer.getInstance()
+    server.start(port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        doc = json.loads(urllib.request.urlopen(
+            base + "/events?last=2", timeout=10).read().decode())
+        assert [e["kind"] for e in doc["events"]] == \
+            [ev.SERVER_REPLAY, ev.SERVER_RECOVERED]
+        assert doc["emitted"] == 3 and doc["capacity"] >= 3
+        inc = json.loads(urllib.request.urlopen(
+            base + "/incidents", timeout=10).read().decode())
+        assert inc["resolved_total"] == 1
+        assert inc["recent"][0]["resolution"] == ev.SERVER_RECOVERED
+        assert inc["recent"][0]["links"]["requests"] == ["/requests/r-7"]
+        req = urllib.request.Request(
+            base + "/debug/bundle?dir=" + str(tmp_path), method="POST")
+        out = json.loads(urllib.request.urlopen(
+            req, timeout=10).read().decode())
+        assert out["path"] and os.path.exists(out["path"])
+        assert os.path.dirname(out["path"]) == str(tmp_path)
+        assert tuple(out["sections"]) == ev.BUNDLE_SECTIONS
+        with open(out["path"]) as f:
+            assert json.load(f)["meta"]["headline"] == "POST /debug/bundle"
+    finally:
+        server.stop()
+
+
+# ===================== production hooks: resilience ====================
+def test_guardian_ladder_emits_one_correlated_incident():
+    mon.enable()
+    g = TrainingGuardian(max_skips=0, max_lr_retries=1, max_rollbacks=1,
+                         recovery_checks=1)
+
+    def climb():
+        g._action = None
+        g._climbed_this_flush = False
+        g._bad_streak = g.max_skips + 1
+        g._escalate(can_retry=True)
+
+    climb()                                   # rung 2: GUARDIAN_RETRY
+    climb()                                   # rung 3: GUARDIAN_ROLLBACK
+    climb()                                   # rung 4: GUARDIAN_DIVERGED
+    assert not g.healthy
+    g.note_rollback(41)                       # driver restored a ckpt
+    g.healthy = True
+    g.lr_scale = 0.5                          # recovery flush restores it
+    g._good_checks = 0
+    g._pending = [(1.0, 1.0, True)]
+    g._flush()                                # GUARDIAN_RECOVERED
+    assert g.lr_scale == 1.0
+    kinds = [e["kind"] for e in ev.snapshot(last=None)["events"]]
+    assert kinds == [ev.GUARDIAN_RETRY, ev.GUARDIAN_ROLLBACK,
+                     ev.GUARDIAN_DIVERGED, ev.GUARDIAN_ROLLBACK,
+                     ev.GUARDIAN_RECOVERED]
+    phases = [e["attrs"].get("phase") for e in
+              ev.snapshot(last=None)["events"]]
+    assert "requested" in phases and "restored" in phases
+    inc = ev.incidents()
+    assert len(inc["recent"]) == 1 and inc["open"] == []
+    snap = inc["recent"][0]
+    assert snap["trigger"]["kind"] == ev.GUARDIAN_RETRY
+    assert snap["resolution"] == ev.GUARDIAN_RECOVERED
+    assert snap["correlation_id"] == "guardian-%x" % id(g)
+
+
+def test_watchdog_stall_report_shares_tail_and_recovers(tmp_path):
+    mon.enable()
+    t = [0.0]
+    wd = StallWatchdog(stall_timeout=10.0, poll_interval=3600,
+                       dump_dir=str(tmp_path), clock=lambda: t[0])
+    wd.arm()
+    wd.beat("trainer")
+    t[0] = 11.0
+    assert wd.check_now() is True             # WATCHDOG_STALL + report
+    report = open(wd.last_report_path).read()
+    assert "Ops event journal (tail):" in report
+    assert ev.WATCHDOG_STALL in report, \
+        "the stall event precedes the report, so its own tail shows it"
+    assert "Post-mortem bundle:" in report
+    assert glob.glob(str(tmp_path / "dl4j-bundle-*.json"))
+    wd.beat("trainer")                        # WATCHDOG_RECOVERED
+    assert not wd.stalled
+    inc = ev.incidents()
+    assert inc["open"] == []
+    assert inc["recent"][0]["trigger"]["kind"] == ev.WATCHDOG_STALL
+    assert inc["recent"][0]["resolution"] == ev.WATCHDOG_RECOVERED
+    wd.disarm()
+
+
+def test_fault_injection_emits_site_attributed_event():
+    mon.enable()
+    plan = faults.FaultPlan(seed=3).fail_at(faults.GENERATION_STEP, 2)
+    with plan:
+        plan.fire(faults.GENERATION_STEP)     # call 1: no rule match
+        with pytest.raises(InjectedFault):
+            plan.fire(faults.GENERATION_STEP)
+    evs = ev.snapshot(last=None)["events"]
+    assert len(evs) == 1
+    assert evs[0]["kind"] == ev.FAULT_INJECTED
+    assert evs[0]["attrs"]["site"] == faults.GENERATION_STEP
+    assert evs[0]["attrs"]["call"] == 2
+    assert evs[0]["attrs"]["error"] == "InjectedFault"
+
+
+# ===================== production hooks: SLO tracker ===================
+def test_slo_breach_and_recover_events_close_the_incident():
+    mon.enable()
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", reservoir=64)
+    fake = [0.0]
+    obj = slo.LatencyObjective("per_token_p99", metric="lat",
+                               max_value=5.0)
+    obj.measure = lambda registry=None, _o=obj, _r=reg: \
+        slo.LatencyObjective.measure(_o, registry=_r)
+    tr = slo.SloTracker([obj], clock=lambda: fake[0],
+                        short_window=10.0, long_window=40.0,
+                        min_interval=0.0)
+    h.observe(1.0)
+    for _ in range(15):
+        fake[0] += 2.0
+        tr.evaluate(force=True)
+    for _ in range(64):
+        h.observe(100.0)
+    for _ in range(9):
+        fake[0] += 2.0
+        tr.evaluate(force=True)
+    assert tr.breaches() == ["per_token_p99"]
+    breach_evs = [e for e in ev.snapshot(last=None)["events"]
+                  if e["kind"] == ev.SLO_BREACH]
+    assert len(breach_evs) == 1, "one event per FLIP, not per evaluate"
+    assert breach_evs[0]["attrs"]["objective"] == "per_token_p99"
+    assert breach_evs[0]["correlation_id"] == "slo-per_token_p99"
+    for _ in range(64):
+        h.observe(0.1)
+    for _ in range(30):
+        fake[0] += 2.0
+        tr.evaluate(force=True)
+    assert tr.breaches() == []
+    kinds = [e["kind"] for e in ev.snapshot(last=None)["events"]]
+    assert kinds == [ev.SLO_BREACH, ev.SLO_RECOVER]
+    inc = ev.incidents()
+    assert inc["open"] == []
+    assert inc["recent"][0]["resolution"] == ev.SLO_RECOVER
+
+
+# ===================== production hooks: parallel stack ================
+def _coord(kv, pid, tmp, num=1):
+    from deeplearning4j_tpu.parallel.coordination import PeerCoordinator
+    return PeerCoordinator(sync_every=2, peer_timeout=5.0, client=kv,
+                           process_id=pid, num_processes=num,
+                           dump_dir=tmp)
+
+
+def test_peer_loss_and_desync_events_precede_the_report(tmp_path):
+    from deeplearning4j_tpu.parallel.coordination import LocalKV
+    mon.enable()
+    c = _coord(LocalKV(), 0, str(tmp_path))
+    err = c._peer_lost_error("peer 1 heartbeat missed", write_report=True)
+    assert err.report_path is not None
+    report = open(err.report_path).read()
+    assert "Ops event journal (tail):" in report
+    assert ev.PEER_LOST in report, \
+        "the loss is emitted BEFORE the report, so the tail shows it"
+    err2 = c.desync_error("step disagreement at round 3")
+    assert ev.PEER_DESYNC in open(err2.report_path).read()
+    evs = ev.snapshot(last=None)["events"]
+    assert [e["kind"] for e in evs] == [ev.PEER_LOST, ev.PEER_DESYNC]
+    assert all(e["correlation_id"] == "peers-0" for e in evs)
+    assert len(ev.incidents()["open"]) == 1, \
+        "same correlation id: the desync joins the loss incident"
+
+
+def test_membership_transitions_emit_epoch_join_leave(tmp_path):
+    from deeplearning4j_tpu.parallel.coordination import LocalKV
+    from deeplearning4j_tpu.parallel.membership import ElasticMembership
+    mon.enable()
+    kv = LocalKV()
+    c0, c1 = _coord(kv, 0, str(tmp_path)), _coord(kv, 1, str(tmp_path))
+    m0 = ElasticMembership(c0, members=[0])
+    m1 = ElasticMembership(c1, members=[1])
+    m1.announce_join()
+    assert m0.commit([1], []) == [0, 1]       # MEMBERSHIP_EPOCH
+    m1.await_admission(timeout=2.0)           # MEMBERSHIP_JOINED
+    m0.announce_leave(pid=1)                  # MEMBERSHIP_LEAVE
+    kinds = [e["kind"] for e in ev.snapshot(last=None)["events"]]
+    assert kinds == [ev.MEMBERSHIP_EPOCH, ev.MEMBERSHIP_JOINED,
+                     ev.MEMBERSHIP_LEAVE]
+    epoch = ev.snapshot(last=None)["events"][0]
+    assert epoch["attrs"]["epoch"] == 1
+    assert epoch["attrs"]["joins"] == [1]
+    assert epoch["attrs"]["members"] == [0, 1]
+    assert all(e["correlation_id"] == "membership"
+               for e in ev.snapshot(last=None)["events"])
+    assert ev.incidents()["open"] == [], \
+        "orderly membership churn is info-severity: no incident"
+
+
+# ===================== seeded chaos → deterministic incidents ==========
+#: module-scoped on-disk executable cache + one shared tiny LSTM server
+#: (suite diet: one build, every chaos scenario reuses it)
+_CACHE = {"dir": None}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _exec_cache(tmp_path_factory):
+    _CACHE["dir"] = str(tmp_path_factory.mktemp("events-exec"))
+    yield
+    _CACHE["dir"] = None
+
+
+@pytest.fixture(scope="module")
+def srv():
+    from deeplearning4j_tpu.generation import GenerationServer
+    from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+         .weightInit("xavier").list()
+         .layer(LSTM(nOut=16, activation="tanh"))
+         .layer(RnnOutputLayer(lossFunction="mcxent", nOut=16,
+                               activation="softmax"))
+         .setInputType(InputType.recurrent(16)).build())).init()
+    server = GenerationServer(net, slots=2, cache_lengths=[32],
+                              prompt_buckets=[8], method="greedy",
+                              seed=11, exec_cache_dir=_CACHE["dir"])
+    server.warmup()
+    yield server
+    server.shutdown()
+
+
+def _consume(reqs, timeout=60):
+    out, errs = [None] * len(reqs), [None] * len(reqs)
+
+    def run(i, req):
+        try:
+            out[i] = list(req.stream(timeout=timeout))
+        except Exception as e:  # noqa: BLE001 — asserted by callers
+            errs[i] = e
+
+    threads = [threading.Thread(target=run, args=(i, r))
+               for i, r in enumerate(reqs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout + 10)
+        assert not t.is_alive(), "stream consumer hung"
+    return out, errs
+
+
+def test_chaos_decode_kill_yields_deterministic_incident(srv):
+    """ACCEPTANCE: a seeded decode kill with two concurrent streams
+    produces ONE incident on GET /incidents with the deterministic
+    ordered timeline server.disrupted → server.replay* →
+    server.recovered, linking to the replayed requests."""
+    from deeplearning4j_tpu.ui.server import UIServer
+    mon.enable()
+    ev.reset()
+    plan = faults.FaultPlan(seed=5).fail_at(faults.GENERATION_STEP, 4)
+    with plan:
+        reqs = [srv.submit(prompt=[1, 4, 2], max_new_tokens=6),
+                srv.submit(prompt=[5, 6], max_new_tokens=6)]
+        out, errs = _consume(reqs)
+    assert plan.fired.get(faults.GENERATION_STEP) == 1
+    assert errs == [None, None]
+    assert all(len(o) == 6 for o in out)
+
+    server = UIServer.getInstance()
+    server.start(port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        inc = json.loads(urllib.request.urlopen(
+            base + "/incidents", timeout=10).read().decode())
+        assert inc["open"] == [] and inc["resolved_total"] == 1
+        snap = inc["recent"][0]
+        kinds = snap["kinds"]
+        assert kinds[0] == ev.SERVER_DISRUPTED
+        assert kinds[-1] == ev.SERVER_RECOVERED
+        replays = [k for k in kinds if k == ev.SERVER_REPLAY]
+        assert len(replays) >= 1
+        assert set(kinds) <= {ev.SERVER_DISRUPTED, ev.SERVER_REPLAY,
+                              ev.SERVER_RECOVERED}
+        assert snap["resolution"] == ev.SERVER_RECOVERED
+        assert snap["trigger"]["attrs"]["error"] == "InjectedFault"
+        assert snap["duration_s"] >= 0
+        # the incident links through to the replayed request timelines
+        ids = {r.trace_id for r in reqs}
+        linked = {p.rsplit("/", 1)[1]
+                  for p in snap["links"].get("requests", [])}
+        assert linked and linked <= ids
+        # and the raw journal serves the same ordered story (prefixed
+        # by the fault harness's own injection marker, which is info-
+        # severity and precedes the incident the kill opens)
+        evd = json.loads(urllib.request.urlopen(
+            base + "/events?last=64", timeout=10).read().decode())
+        served = [e["kind"] for e in evd["events"]]
+        assert served == [ev.FAULT_INJECTED] + kinds, \
+            "journal order IS the incident order"
+    finally:
+        server.stop()
+
+
+def test_chaos_pressure_ladder_walk_resolves_at_level_zero(srv):
+    """ACCEPTANCE: a seeded pressure-ladder walk (escalate ×3, relieve
+    ×3) is one incident — pressure.escalated trigger, the further
+    escalations and partial reliefs as actions, resolved by the
+    pressure.relieved that lands back at level 0."""
+    mon.enable()
+    ev.reset()
+    exc = MemoryError("RESOURCE_EXHAUSTED: out of memory")
+    for _ in range(3):
+        srv._note_memory_pressure(exc)
+    assert srv._pressure == 3
+    for _ in range(3):
+        srv._relieve_pressure()
+    assert srv._pressure == 0
+    inc = ev.incidents()
+    assert inc["open"] == [] and len(inc["recent"]) == 1
+    snap = inc["recent"][0]
+    assert snap["trigger"]["kind"] == ev.PRESSURE_ESCALATED
+    assert snap["trigger"]["attrs"] == {
+        "level": 1, "action": "refuse_growth", "error": "MemoryError"}
+    walked = [(e["kind"], e["attrs"]["level"])
+              for e in [snap["trigger"]] + snap["actions"]]
+    assert walked == [(ev.PRESSURE_ESCALATED, 1),
+                      (ev.PRESSURE_ESCALATED, 2),
+                      (ev.PRESSURE_ESCALATED, 3),
+                      (ev.PRESSURE_RELIEVED, 2),
+                      (ev.PRESSURE_RELIEVED, 1),
+                      (ev.PRESSURE_RELIEVED, 0)]
+    assert snap["resolution"] == ev.PRESSURE_RELIEVED, \
+        "only the relief that reaches level 0 resolves"
+    assert snap["correlation_id"] == srv._corr
+
+
+# ===================== executable cost gauges ==========================
+def test_cost_analysis_rides_store_status_and_gauges():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.runtime.executables import FunctionStore
+    mon.enable()
+    with tempfile.TemporaryDirectory() as d:
+        store = FunctionStore("events-cost-test", directory=d)
+        store.register("mm", lambda a, b: jnp.matmul(a, b) + 1.0)
+        x = jnp.ones((8, 8), jnp.float32)
+        store.load_or_compile(("mm", 8), (x, x))
+        entries = store.status()["entries"]
+    assert len(entries) == 1
+    e = entries[0]
+    # XLA:CPU serves cost_analysis: 8x8x8 matmul+add = 1088 flops
+    assert e["flops"] > 0 and e["bytes_accessed"] > 0
+    assert "MFLOPs" in e["cost"] and "per dispatch" in e["cost"]
+    reg = mon.get_registry()
+    snap = reg.snapshot()
+    assert any(r["value"] == e["flops"]
+               for r in snap.get(mon.EXEC_FLOPS, [])), \
+        "dl4j.exec.flops gauge must carry the per-dispatch cost"
+    assert any(r["value"] == e["bytes_accessed"]
+               for r in snap.get(mon.EXEC_BYTES_ACCESSED, []))
+
+
+def test_cost_line_served_on_executables_endpoint(srv):
+    from deeplearning4j_tpu.ui.server import UIServer
+    mon.enable()
+    server = UIServer.getInstance()
+    server.start(port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        doc = json.loads(urllib.request.urlopen(
+            base + "/executables", timeout=10).read().decode())
+        entries = [e for store in doc["stores"]
+                   for e in store.get("entries", [])]
+        with_cost = [e for e in entries if "cost" in e]
+        assert with_cost, "the warmed decode executables carry costs"
+        assert all(e["flops"] > 0 and "per dispatch" in e["cost"]
+                   for e in with_cost)
+    finally:
+        server.stop()

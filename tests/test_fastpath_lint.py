@@ -221,6 +221,80 @@ def test_generation_trace_lint_flags_compile_in_page_admission():
     assert "admit_slot" in v[0][2]
 
 
+def test_event_emit_guard_pins_hook_modules_and_accepts_them():
+    """Every module carrying ops-event emission hooks is IN the lint
+    set (a rename can't silently drop one), and the real hooks all sit
+    behind the enabled-guard."""
+    expected = {
+        "deeplearning4j_tpu/resilience/guardian.py",
+        "deeplearning4j_tpu/resilience/watchdog.py",
+        "deeplearning4j_tpu/resilience/faults.py",
+        "deeplearning4j_tpu/generation/server.py",
+        "deeplearning4j_tpu/parallel/coordination.py",
+        "deeplearning4j_tpu/parallel/membership.py",
+        "deeplearning4j_tpu/parallel/multihost.py",
+        "deeplearning4j_tpu/monitoring/slo.py",
+    }
+    assert expected <= set(check_fastpath.EVENT_HOOK_MODULES)
+    for rel in check_fastpath.EVENT_HOOK_MODULES:
+        path = os.path.join(check_fastpath.REPO_ROOT, rel)
+        assert os.path.exists(path), f"lint module vanished: {rel}"
+        with open(path) as f:
+            assert check_fastpath.check_event_emit_guarded(
+                f.read(), path) == []
+
+
+def test_event_emit_guard_flags_bare_emit():
+    bad = textwrap.dedent("""
+        from deeplearning4j_tpu.monitoring import events as _events
+
+        def _flush(self):
+            _events.emit("guardian", _events.GUARDIAN_RETRY)
+    """)
+    v = check_fastpath.check_event_emit_guarded(bad)
+    assert len(v) == 1
+    assert "one branch" in v[0][2]
+
+    good = textwrap.dedent("""
+        from deeplearning4j_tpu import monitoring as _mon
+        from deeplearning4j_tpu.monitoring import events as _events
+
+        def _flush(self):
+            if _mon.enabled():
+                _events.emit("guardian", _events.GUARDIAN_RETRY)
+    """)
+    assert check_fastpath.check_event_emit_guarded(good) == []
+
+
+def test_event_emit_purity_accepts_journal_and_flags_sync():
+    """The real journal emit path is pure host bookkeeping; a device
+    materialization reachable from emit is flagged, while the declared
+    bundle()/write_bundle() cold boundary is not descended into."""
+    sources = {}
+    for rel in check_fastpath.EVENT_JOURNAL_MODULES:
+        path = os.path.join(check_fastpath.REPO_ROOT, rel)
+        assert os.path.exists(path), f"lint module vanished: {rel}"
+        with open(path) as f:
+            sources[path] = f.read()
+    assert check_fastpath.check_event_emit_host_pure(sources) == []
+
+    bad = textwrap.dedent("""
+        import numpy as np
+
+        def emit(source, kind):
+            return _correlate(kind)
+
+        def _correlate(kind):
+            return np.asarray(kind)     # host sync on the emit path!
+
+        def bundle():
+            return np.asarray([1]).tolist()   # declared boundary: ok
+    """)
+    v = check_fastpath.check_event_emit_host_pure({"m.py": bad})
+    assert len(v) == 1
+    assert "emit path" in v[0][2]
+
+
 def test_lint_rejects_guard_after_the_call():
     # the guard must precede the call — a later early-return doesn't
     # protect the hot path

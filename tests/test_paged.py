@@ -213,6 +213,13 @@ def test_paged_streams_bit_identical_mixed_sampling(bert):
         srv.shutdown()
 
 
+@pytest.mark.slow   # suite diet (ISSUE 19): ~30 s — compiles four more
+# store identities just to cross int8 × superstep × paging; each factor
+# keeps a fast-lane twin: paged-vs-dense bit-identity via
+# test_paged_streams_bit_identical_mixed_sampling, the int8 KV codec
+# via test_quantize.py::test_int8_kv_cache_decode_matches_fp, and
+# multi-token blocks through the page index via
+# test_paged_draft_verify_bit_identical
 def test_paged_superstep_int8_bit_identical(bert):
     """Superstep k=3 blocks + the int8 KV codec through the paged read
     path: scale pages gather alongside payload pages, streams stay

@@ -618,6 +618,13 @@ def test_pressure_decays_after_clean_stretch(bert):
 
 
 # ===================== paged KV pool chaos ============================
+@pytest.mark.slow   # suite diet (ISSUE 19): ~20 s — a second full
+# dense-vs-paged superstep compile set just to cross replay × paging;
+# fast-lane twins: replay bit-identity via
+# test_chaos_decode_kill_streams_bit_identical, paged pool recovery
+# under chaos via test_chaos_paged_ladder_evicts_cold_pages_before_shrink,
+# and paged-read bit-identity via
+# test_paged.py::test_paged_streams_bit_identical_mixed_sampling
 def test_chaos_page_fault_replay_bit_identical(bert):
     """ACCEPTANCE (paged): a `cache.page` fault (corrupt page index /
     failed pool bookkeeping) mid-stream crashes the loop; recovery
