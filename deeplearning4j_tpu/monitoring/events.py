@@ -311,13 +311,10 @@ class EventJournal:
             self._open.append(inc)
 
     def _sweep_quiet(self, now):
-        still_open = []
-        for inc in self._open:
+        # iterate a copy: _close() removes from self._open in place
+        for inc in list(self._open):
             if now - inc.last_ts > self.quiet_s:
                 self._close(inc, now, None)
-            else:
-                still_open.append(inc)
-        self._open = still_open
 
     def _close(self, inc, ts, resolution):
         inc.close(ts, resolution)
@@ -354,14 +351,16 @@ class EventJournal:
         with self._lock:
             self._sweep_quiet(self._clock())
             events = list(self._ring)
+            emitted = self._seq
+            dropped = self.dropped
         if last is not None and last >= 0:
             # slice via len(): events[-0:] would be the WHOLE ring
             events = events[len(events) - min(last, len(events)):]
         return {
             "events": events,
             "capacity": self.capacity,
-            "emitted": self._seq,
-            "dropped": self.dropped,
+            "emitted": emitted,
+            "dropped": dropped,
         }
 
     def incidents(self):

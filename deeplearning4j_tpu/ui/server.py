@@ -646,15 +646,15 @@ class UIServer:
                     # the event tail, incidents, metrics snapshot, step
                     # recorder, request ring, health and open spans —
                     # the same document crash dumps and stall reports
-                    # write (monitoring/events.py bundle()). ?dir=
-                    # overrides the output directory.
+                    # write (monitoring/events.py bundle()). The output
+                    # directory comes from DL4J_CRASH_DUMP_DIR (cwd
+                    # otherwise), never from the request: a client-
+                    # supplied path would let any caller of this
+                    # unauthenticated endpoint create files anywhere
+                    # the process can write.
                     from deeplearning4j_tpu.monitoring import \
                         events as _ev
-                    q = urllib.parse.parse_qs(
-                        urllib.parse.urlparse(self.path).query)
-                    dump_dir = q.get("dir", [None])[0]
-                    p = _ev.write_bundle(dump_dir=dump_dir,
-                                         headline="POST /debug/bundle")
+                    p = _ev.write_bundle(headline="POST /debug/bundle")
                     body = json.dumps(
                         {"path": p,
                          "sections": list(_ev.BUNDLE_SECTIONS)}).encode()

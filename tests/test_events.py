@@ -180,6 +180,33 @@ def test_incident_window_quiet_close_and_correlation_beyond_window():
     assert len(ev.incidents()["open"]) == 2
 
 
+def test_quiet_sweep_closes_every_stale_incident_and_keeps_fresh_ones():
+    # regression: the sweep used to mutate the open list while
+    # iterating it, so the incident AFTER a quiet-closed one was
+    # silently dropped — neither open nor recent nor counted
+    j, t = _fake_journal(window_s=1.0, quiet_s=10.0)
+    mon.enable()
+    ev.emit("resilience", ev.WATCHDOG_STALL, correlation_id="a")
+    t[0] = 2.0                                  # gaps > 1 s window:
+    ev.emit("parallel", ev.PEER_LOST, correlation_id="b")
+    t[0] = 4.0                                  # three distinct incidents
+    ev.emit("generation", ev.SERVER_DEAD, correlation_id="c")
+    assert len(ev.incidents()["open"]) == 3
+    # a and b go quiet; c stays fresh via a correlated follow-up
+    t[0] = 13.0
+    ev.emit("generation", ev.SERVER_RESTARTED, correlation_id="c")
+    inc = ev.incidents()
+    assert [i["trigger"]["correlation_id"] for i in inc["open"]] == ["c"]
+    assert sorted(i["trigger"]["correlation_id"]
+                  for i in inc["recent"]) == ["a", "b"]
+    assert inc["resolved_total"] == 2
+    # and once c goes quiet too, nothing is lost
+    t[0] = 30.0
+    inc = ev.incidents()
+    assert inc["open"] == [] and inc["resolved_total"] == 3
+    assert len(inc["recent"]) == 3
+
+
 def test_env_knobs_size_the_ring_and_correlator(monkeypatch):
     monkeypatch.setenv("DL4J_EVENT_RING", "7")
     monkeypatch.setenv("DL4J_INCIDENT_WINDOW", "2.5")
@@ -277,8 +304,9 @@ def test_crash_dump_embeds_journal_tail_and_writes_bundle(tmp_path):
 
 
 # ===================== dashboard surfaces ==============================
-def test_events_incidents_and_debug_bundle_endpoints(tmp_path):
+def test_events_incidents_and_debug_bundle_endpoints(tmp_path, monkeypatch):
     from deeplearning4j_tpu.ui.server import UIServer
+    monkeypatch.setenv("DL4J_CRASH_DUMP_DIR", str(tmp_path))
     mon.enable()
     ev.emit("generation", ev.SERVER_DISRUPTED, correlation_id="u1")
     ev.emit("generation", ev.SERVER_REPLAY, attrs={"request": "r-7"},
@@ -298,8 +326,10 @@ def test_events_incidents_and_debug_bundle_endpoints(tmp_path):
         assert inc["resolved_total"] == 1
         assert inc["recent"][0]["resolution"] == ev.SERVER_RECOVERED
         assert inc["recent"][0]["links"]["requests"] == ["/requests/r-7"]
+        # the endpoint must ignore client-supplied paths; the output dir
+        # comes from DL4J_CRASH_DUMP_DIR alone
         req = urllib.request.Request(
-            base + "/debug/bundle?dir=" + str(tmp_path), method="POST")
+            base + "/debug/bundle?dir=/definitely/not/here", method="POST")
         out = json.loads(urllib.request.urlopen(
             req, timeout=10).read().decode())
         assert out["path"] and os.path.exists(out["path"])
