@@ -9,6 +9,9 @@ admission (ROADMAP item 2 — the chat-style serving scenario class).
 - `server`   — GenerationServer: fixed-shape decode batches, AOT
   executables per (slot bucket, cache rung, prompt bucket), per-slot
   admission/retirement, streaming token callbacks.
+- `fleet`    — FleetRouter: health-driven routing across N replicas
+  with replica supervision, mid-stream failover replay (client streams
+  stay exactly-once and bit-identical), and an autoscale signal.
 
 Quick start:
 
@@ -22,6 +25,7 @@ Quick start:
 """
 from deeplearning4j_tpu.generation.decode import (BertDecoder,
                                                   RecurrentDecoder)
+from deeplearning4j_tpu.generation.fleet import FleetRequest, FleetRouter
 from deeplearning4j_tpu.generation.sampling import (GREEDY, SAMPLE,
                                                     method_id,
                                                     sample_step)
@@ -31,6 +35,7 @@ from deeplearning4j_tpu.generation.server import (GenerationRequest,
 
 __all__ = [
     "BertDecoder", "RecurrentDecoder",
+    "FleetRequest", "FleetRouter",
     "GREEDY", "SAMPLE", "method_id", "sample_step",
     "GenerationRequest", "GenerationServer", "status",
 ]

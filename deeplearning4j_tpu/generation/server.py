@@ -264,6 +264,13 @@ class _SlotJournal:
         self.disp_pos = 0
 
 
+def _queued_req(item):
+    """The GenerationRequest behind one admission-queue entry: adopted
+    records (`adopt()`) ride the queue as their `_SlotJournal`, local
+    submits as the bare request — drain/shed must fail either form."""
+    return item.req if isinstance(item, _SlotJournal) else item
+
+
 class _Block:
     """One in-flight sampled-token block: the device (k, S) output of a
     superstep/verify dispatch, the slot→journal map snapshotted at
@@ -838,6 +845,50 @@ class GenerationServer:
         """Blocking convenience: submit + result."""
         return self.submit(prompt, **kw).result(timeout=timeout)
 
+    def adopt(self, req, admit_id, timeout_ms=None):
+        """Admit a pre-built request under an EXPLICIT admission id —
+        the fleet-router hook behind cross-replica failover. A stream
+        is a pure function of (server seed, admit_id, prompt, sampling
+        config), so a router that keeps replica seeds aligned and
+        assigns fleet-wide admission ids gets streams independent of
+        WHICH replica serves them. `req.tokens` may already hold the
+        delivered prefix of a request whose replica died mid-stream:
+        the record then re-enters through the existing crash-replay
+        machinery (prefix re-prefill, or re-generation with delivery
+        suppressed), so the continuation is bit-identical to an
+        uninterrupted run and nothing is ever re-delivered."""
+        from deeplearning4j_tpu.parallel.inference import bounded_enqueue
+        if not self._warm:
+            self.warmup()
+        plen = int(req.prompt.size)
+        if plen < 1:
+            raise ValueError("prompt must hold at least one token")
+        if plen > self.prompt_buckets[-1]:
+            raise ValueError(
+                f"prompt length {plen} exceeds the top prompt "
+                f"bucket {self.prompt_buckets[-1]}")
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if plen + req.max_new_tokens > self.cache_lengths[-1]:
+            raise ValueError(
+                f"prompt ({plen}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds the top cache rung "
+                f"{self.cache_lengths[-1]}")
+        rec = _SlotJournal(req, int(admit_id))
+        deadline = (None if timeout_ms is None
+                    else time.monotonic() + float(timeout_ms) / 1e3)
+        # same locked liveness check + bounded enqueue as submit(): the
+        # record must never land in a queue shutdown()/_die() drained
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("GenerationServer is shut down")
+            if self._dead is not None:
+                raise self._dead
+            bounded_enqueue(self._queue, rec, deadline,
+                            self.enqueue_timeout, what="generation")
+        self._work.set()
+        return req
+
     # -- decode loop ------------------------------------------------------
     def _loop(self):
         while not self._shutdown:
@@ -878,20 +929,28 @@ class GenerationServer:
         TPU; CPU ignores donation) — it propagates so `_survive`
         rebuilds the state and REPLAYS every journaled request,
         including the one whose admission crashed. (Size/shape
-        validation already happened at submit().)"""
+        validation already happened at submit()/adopt().)"""
         while self._free:
             try:
-                req = self._queue.get_nowait()
+                item = self._queue.get_nowait()
             except queue.Empty:
                 return
+            # adopted records (fleet failover / explicit-id admission)
+            # ride the queue AS their journal; local submits are bare
+            # requests that get their journal in _admit_one
+            rec = item if isinstance(item, _SlotJournal) else None
+            req = rec.req if rec is not None else item
             try:
-                self._admit_one(req)
+                if rec is None:
+                    self._admit_one(req)
+                else:
+                    self._admit_adopted(rec)
             except MemoryPressureError as e:
                 req._fail(e)      # pre-dispatch refusal: state intact
                 continue
             except Exception as e:  # noqa: BLE001 — see docstring
-                if not any(rec.req is req
-                           for rec in self._slot_req.values()):
+                if not any(r.req is req
+                           for r in self._slot_req.values()):
                     # failed before the journal was registered: nothing
                     # will replay it — fail it so no caller hangs
                     req._fail(e)
@@ -901,7 +960,33 @@ class GenerationServer:
         """Fresh admission: assign the next admission id (the rng-key
         derivation the journal replays) and dispatch."""
         self._counter += 1
-        rec = _SlotJournal(req, self._counter)
+        self._admit_fresh(_SlotJournal(req, self._counter))
+
+    def _admit_adopted(self, rec):
+        """Admit a router-journaled record (`adopt()`): one with no
+        delivered prefix admits exactly like a local submission, just
+        under its explicit id; one carrying a delivered prefix is a
+        mid-stream failover and re-enters through `_replay_one` — the
+        same journal-replay path an in-process crash uses — so the
+        continuation stays bit-identical and exactly-once. A record
+        whose prefix already carries the terminal token only lost its
+        retirement to the dead replica: finish it, never generate past
+        EOS / max_new_tokens."""
+        req = rec.req
+        if req.done():
+            return
+        reason = self._finished_reason(req)
+        if reason is not None:
+            req._finish(reason)
+            return
+        if req.tokens:
+            self._replay_one(rec)
+        else:
+            self._admit_fresh(rec)
+
+    def _admit_fresh(self, rec):
+        """Dispatch one journaled first-time admission and count it."""
+        req = rec.req
         t0 = time.perf_counter()
         self._admit_rec(rec, req.prompt, self._admit_key(rec.admit_id))
         prefill_ms = (time.perf_counter() - t0) * 1e3
@@ -1663,13 +1748,13 @@ class GenerationServer:
         shed = 0
         while True:
             try:
-                req = self._queue.get_nowait()
+                item = self._queue.get_nowait()
             except queue.Empty:
                 break
             err = MemoryPressureError(
                 "queued admission shed under memory pressure")
             err.__cause__ = cause
-            req._fail(err)
+            _queued_req(item)._fail(err)
             shed += 1
         if shed and _mon.enabled():
             _events.emit("generation", _events.SERVER_SHED,
@@ -1700,7 +1785,7 @@ class GenerationServer:
     def _drain_queue(self, err):
         while True:
             try:
-                self._queue.get_nowait()._fail(err)
+                _queued_req(self._queue.get_nowait())._fail(err)
             except queue.Empty:
                 return
 

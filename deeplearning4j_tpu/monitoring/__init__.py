@@ -96,6 +96,8 @@ from deeplearning4j_tpu.monitoring.registry import (  # noqa: F401
     GEN_FETCH_OVERLAP_MS, GEN_DRAFT_ACCEPTS, GEN_DRAFT_REJECTS,
     GEN_PAGES_ACTIVE, GEN_PAGES_SHARED, GEN_PAGE_EVICTIONS,
     GEN_PREFIX_HITS,
+    FLEET_ROUTED, FLEET_FAILOVERS, FLEET_REPLACEMENTS, FLEET_HEALTHY,
+    FLEET_DESIRED_REPLICAS,
     QUANT_INT8_LAYERS, QUANT_CALIBRATIONS, QUANT_DEQUANT_FALLBACKS,
     QUANT_ACTIVATION_BYTES,
     INFERENCE_REQUEST_MS, SLO_BREACHES, SLO_BURN_RATE, SLO_BREACHED,
@@ -158,6 +160,8 @@ __all__ = [
     "GEN_DRAFT_ACCEPTS", "GEN_DRAFT_REJECTS",
     "GEN_PAGES_ACTIVE", "GEN_PAGES_SHARED", "GEN_PAGE_EVICTIONS",
     "GEN_PREFIX_HITS",
+    "FLEET_ROUTED", "FLEET_FAILOVERS", "FLEET_REPLACEMENTS",
+    "FLEET_HEALTHY", "FLEET_DESIRED_REPLICAS",
     "QUANT_INT8_LAYERS", "QUANT_CALIBRATIONS",
     "QUANT_DEQUANT_FALLBACKS", "QUANT_ACTIVATION_BYTES",
     "INFERENCE_REQUEST_MS", "SLO_BREACHES", "SLO_BURN_RATE",

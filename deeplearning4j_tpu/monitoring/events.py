@@ -108,6 +108,19 @@ PEER_DESYNC = "peer.desync"
 SLO_BREACH = "slo.breach"
 #: a breached SLO objective recovered
 SLO_RECOVER = "slo.recover"
+#: the fleet router stopped admitting to a replica (attrs: replica,
+#: reason = dead|burn_rate) — the replica-lost incident trigger
+REPLICA_UNHEALTHY = "replica.unhealthy"
+#: the supervisor drained a lost replica before replacement (attrs:
+#: replica, open_requests re-routed through failover)
+REPLICA_DRAINED = "replica.drained"
+#: a fresh replica took the lost one's roster slot (warm spin-up from
+#: the shared FunctionStore: attrs carry compiled/from_disk counts) —
+#: resolves the replica-lost incident
+REPLICA_REPLACED = "replica.replaced"
+#: one in-flight request re-routed to a healthy replica (journal
+#: replay, delivered prefix suppressed; attrs: from, to, delivered)
+REQUEST_FAILOVER = "request.failover"
 
 #: kind -> default severity.  Every kind the journal accepts is here.
 KIND_SEVERITY = {
@@ -139,6 +152,10 @@ KIND_SEVERITY = {
     PEER_DESYNC: "error",
     SLO_BREACH: "error",
     SLO_RECOVER: "info",
+    REPLICA_UNHEALTHY: "error",
+    REPLICA_DRAINED: "warn",
+    REPLICA_REPLACED: "info",
+    REQUEST_FAILOVER: "warn",
 }
 
 #: kinds that close the incident absorbing them (resolution = kind).
@@ -147,6 +164,7 @@ _RESOLVING = frozenset({
     WATCHDOG_RECOVERED,
     SERVER_RECOVERED,
     SLO_RECOVER,
+    REPLICA_REPLACED,
 })
 
 _DEFAULT_RING = 512
@@ -535,4 +553,6 @@ __all__ = [
     "SERVER_DEAD", "MEMBERSHIP_EPOCH", "MEMBERSHIP_JOINED",
     "MEMBERSHIP_LEAVE", "MEMBERSHIP_REPLACED", "PEER_LOST",
     "PEER_DESYNC", "SLO_BREACH", "SLO_RECOVER",
+    "REPLICA_UNHEALTHY", "REPLICA_DRAINED", "REPLICA_REPLACED",
+    "REQUEST_FAILOVER",
 ]

@@ -236,6 +236,18 @@ GEN_PAGES_SHARED = "dl4j.gen.pages_shared"
 GEN_PAGE_EVICTIONS = "dl4j.gen.page_evictions"
 GEN_PREFIX_HITS = "dl4j.gen.prefix_hits"
 
+# fleet router (generation/fleet.py): health-driven routing across
+# GenerationServer replicas. `routed` counts admissions per replica
+# (labels: replica), `failovers` mid-stream re-routes via journal
+# replay, `replacements` supervisor-built replacement replicas;
+# `healthy` and `desired_replicas` are the live roster gauge and the
+# autoscale signal (queue depth x SLO burn)
+FLEET_ROUTED = "dl4j.fleet.routed"
+FLEET_FAILOVERS = "dl4j.fleet.failovers"
+FLEET_REPLACEMENTS = "dl4j.fleet.replacements"
+FLEET_HEALTHY = "dl4j.fleet.healthy"
+FLEET_DESIRED_REPLICAS = "dl4j.fleet.desired_replicas"
+
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _LABEL_RE = re.compile(r"[^a-zA-Z0-9_]")
 

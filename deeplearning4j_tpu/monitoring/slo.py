@@ -433,12 +433,12 @@ class SloTracker:
 
 def standard_objectives(per_token_p99_ms=None, steps_drop=None,
                         replay_ratio=None, step_p99_ms=None,
-                        straggler_ratio=None):
+                        straggler_ratio=None, failover_ratio=None):
     """The standard objective set, with env-var thresholds:
     DL4J_SLO_PER_TOKEN_P99_MS, DL4J_SLO_STEPS_DROP,
     DL4J_SLO_REPLAY_RATIO, DL4J_SLO_STEP_P99_MS,
-    DL4J_SLO_STRAGGLER_RATIO (an unset/None knob omits the
-    objective)."""
+    DL4J_SLO_STRAGGLER_RATIO, DL4J_SLO_FAILOVER_RATIO (an unset/None
+    knob omits the objective)."""
     import os
 
     def knob(arg, env):
@@ -471,6 +471,15 @@ def standard_objectives(per_token_p99_ms=None, steps_drop=None,
     v = knob(straggler_ratio, "DL4J_SLO_STRAGGLER_RATIO")
     if v is not None:
         out.append(StragglerObjective("straggler_ratio", max_ratio=v))
+    v = knob(failover_ratio, "DL4J_SLO_FAILOVER_RATIO")
+    if v is not None:
+        # fleet health: mid-stream failovers per routed admission — a
+        # fleet that re-routes most of its traffic is burning replicas
+        # even while every individual stream still completes
+        out.append(RatioObjective("failover_ratio",
+                                  num=_registry.FLEET_FAILOVERS,
+                                  den=_registry.FLEET_ROUTED,
+                                  max_ratio=v))
     return out
 
 

@@ -283,6 +283,25 @@ class PeerCoordinator:
         return [(k[len(pfx):], v)
                 for k, v in self._client.key_value_dir_get(pfx)]
 
+    def publish_json(self, key, doc):
+        """Overwrite-publish one JSON document under `key` — the
+        directory-registry primitive: each process re-publishes its own
+        `<prefix>/<pid>` entry and `fetch_json_dir` merges the cross-host
+        view (the fleet replica registry rides this)."""
+        self.publish(key, json.dumps(doc), overwrite=True)
+
+    def fetch_json_dir(self, prefix):
+        """Read every JSON document under `prefix` → {suffix: doc},
+        skipping entries that fail to parse (a publisher mid-write or a
+        foreign key must not poison the merged registry view)."""
+        out = {}
+        for suffix, raw in self.fetch_dir(prefix):
+            try:
+                out[suffix] = json.loads(raw)
+            except (TypeError, ValueError):
+                continue
+        return out
+
     def barrier(self, name, timeout=None):
         """Named cross-process fence with a bounded timeout → a timeout
         is a LOST/WEDGED peer (dump + `PeerLostError`), never a silent

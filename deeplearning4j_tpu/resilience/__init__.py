@@ -37,17 +37,18 @@ from __future__ import annotations
 
 from deeplearning4j_tpu.resilience.errors import (  # noqa: F401
     CheckpointIntegrityError, CircuitOpenError, DistributedInitError,
-    DivergenceError, FatalTrainingError, InferenceOverloadedError,
-    InferenceTimeoutError, InjectedFault, MemoryPressureError,
-    PeerDesyncError, PeerLostError, PreemptionSignal,
-    ReplayDivergedError, ResilienceError, RetryExhaustedError,
-    ServerDeadError, TransientError)
+    DivergenceError, FatalTrainingError, FleetDeadError,
+    InferenceOverloadedError, InferenceTimeoutError, InjectedFault,
+    MemoryPressureError, PeerDesyncError, PeerLostError,
+    PreemptionSignal, ReplayDivergedError, ResilienceError,
+    RetryExhaustedError, ServerDeadError, TransientError)
 from deeplearning4j_tpu.resilience.faults import (  # noqa: F401
     CACHE_GROW, CHECKPOINT_CORRUPT, CHECKPOINT_RESTORE, CHECKPOINT_SAVE,
     COMM_ALLREDUCE, COMM_BARRIER, DATA_NEXT, EVAL_FORWARD,
     EXECUTABLES_LOAD, GENERATION_ADMIT, GENERATION_STEP, HOST_PREEMPT,
-    INFERENCE_COLLECTOR, INFERENCE_FORWARD, SERVING_DISPATCH,
-    TRAIN_DISPATCH, FaultPlan, clear_plan, install_plan)
+    INFERENCE_COLLECTOR, INFERENCE_FORWARD, REPLICA_RESTART,
+    ROUTER_DISPATCH, SERVING_DISPATCH, TRAIN_DISPATCH, FaultPlan,
+    clear_plan, install_plan)
 from deeplearning4j_tpu.resilience.guardian import (  # noqa: F401
     TrainingGuardian)
 from deeplearning4j_tpu.resilience.policy import (  # noqa: F401
@@ -61,8 +62,8 @@ __all__ = [
     "InferenceOverloadedError", "InjectedFault", "FatalTrainingError",
     "DivergenceError", "CheckpointIntegrityError",
     "DistributedInitError", "PeerLostError", "PeerDesyncError",
-    "PreemptionSignal", "ServerDeadError", "MemoryPressureError",
-    "ReplayDivergedError",
+    "PreemptionSignal", "ServerDeadError", "FleetDeadError",
+    "MemoryPressureError", "ReplayDivergedError",
     "RetryPolicy", "CircuitBreaker", "default_classifier",
     "FaultPlan", "install_plan", "clear_plan",
     "DATA_NEXT", "TRAIN_DISPATCH", "CHECKPOINT_SAVE",
@@ -71,6 +72,7 @@ __all__ = [
     "COMM_ALLREDUCE", "COMM_BARRIER", "HOST_PREEMPT",
     "GENERATION_STEP", "GENERATION_ADMIT", "CACHE_GROW",
     "EXECUTABLES_LOAD", "SERVING_DISPATCH",
+    "ROUTER_DISPATCH", "REPLICA_RESTART",
     "TrainingGuardian", "StallWatchdog", "health_snapshot",
     "FaultTolerantTrainer",
 ]
@@ -79,8 +81,8 @@ __all__ = [
 def health_snapshot():
     """The `GET /health` payload: overall status plus the installed
     guardian's, watchdog's, multi-host coordinator's, serving
-    (GenerationServer), and SLO-tracker introspection snapshots (None
-    when not installed). Status ladder: a latched stall, a lost peer, a
+    (GenerationServer), fleet-router, and SLO-tracker introspection
+    snapshots (None when not installed). Status ladder: a latched stall, a lost peer, a
     dead serving loop, or an exhausted guardian makes the process
     unhealthy; a guardian mid-escalation, a pending preemption, a
     serving memory-pressure degradation, or an SLO BREACH (the violated
@@ -112,6 +114,16 @@ def health_snapshot():
             ssnap = [s.serving_state() for s in list(_gen._SERVERS)]
         except Exception:  # noqa: BLE001 — health must always answer
             ssnap = None
+    # fleet routers (generation/fleet.py): compact per-router view —
+    # replica healths + the autoscale signal; same sys.modules
+    # discipline as the serving states above
+    fsnap = None
+    _fl = sys.modules.get("deeplearning4j_tpu.generation.fleet")
+    if _fl is not None:
+        try:
+            fsnap = [r.fleet_state() for r in list(_fl._ROUTERS)]
+        except Exception:  # noqa: BLE001 — health must always answer
+            fsnap = None
     # SLO tracker: evaluation is PULL-driven from right here (rate-
     # limited inside the tracker) — nothing on a hot path ever pays it
     slosnap = None
@@ -126,6 +138,8 @@ def health_snapshot():
         status = "degraded"
     if ssnap and any(s["state"] == "degraded" for s in ssnap):
         status = "degraded"
+    if fsnap and any(f["state"] == "degraded" for f in fsnap):
+        status = "degraded"
     if slosnap is not None and slosnap.get("violated"):
         status = "degraded"
     if csnap is not None and (csnap["preempt_requested"]
@@ -139,8 +153,11 @@ def health_snapshot():
         status = "diverged"
     if ssnap and any(s["state"] == "dead" for s in ssnap):
         status = "serving_dead"
+    if fsnap and any(f["state"] == "dead" for f in fsnap):
+        status = "serving_dead"
     return {"status": status, "guardian": gsnap, "watchdog": wsnap,
-            "distributed": csnap, "serving": ssnap, "slo": slosnap}
+            "distributed": csnap, "serving": ssnap, "fleet": fsnap,
+            "slo": slosnap}
 
 
 def __getattr__(name):

@@ -15,8 +15,8 @@ __all__ = [
     "InferenceOverloadedError", "InjectedFault", "FatalTrainingError",
     "DivergenceError", "CheckpointIntegrityError",
     "DistributedInitError", "PeerLostError", "PeerDesyncError",
-    "PreemptionSignal", "ServerDeadError", "MemoryPressureError",
-    "PagePoolExhaustedError",
+    "PreemptionSignal", "ServerDeadError", "FleetDeadError",
+    "MemoryPressureError", "PagePoolExhaustedError",
     "ReplayDivergedError", "WireFormatError", "MembershipChangeError",
 ]
 
@@ -122,6 +122,16 @@ class ServerDeadError(ResilienceError):
     error and future submits refuse immediately. Deliberately typed so
     a fleet supervisor can tell 'replace this replica' from a transient
     per-request failure; `GET /health` reports `serving_dead`."""
+
+
+class FleetDeadError(ServerDeadError):
+    """Every replica behind a FleetRouter is dead and replacement is
+    exhausted (or disabled): the fleet as a whole can no longer serve.
+    Latched exactly like the per-replica ServerDeadError — every open
+    fleet request fails with this error and future submits refuse
+    immediately. Deliberately a ServerDeadError subclass so callers
+    handling 'serving is down' catch both; the distinct type tells an
+    operator the outage is fleet-wide, not one replaceable replica."""
 
 
 class MemoryPressureError(ResilienceError):

@@ -62,6 +62,7 @@ __all__ = ["FaultPlan", "install_plan", "clear_plan", "ACTIVE",
            "GENERATION_ADMIT", "CACHE_GROW", "CACHE_PAGE",
            "EXECUTABLES_LOAD", "SERVING_DISPATCH",
            "HOST_JOIN", "WIRE_DECODE",
+           "ROUTER_DISPATCH", "REPLICA_RESTART",
            "PROCESS_ID", "resolve_process_id"]
 
 DATA_NEXT = "data.next"
@@ -130,6 +131,16 @@ HOST_JOIN = "host.join"
 #: sparse gradient message; containment must be a typed error or a
 #: guardian-gated step, never a silently wrong delivered gradient
 WIRE_DECODE = "wire.decode"
+#: fires in the FleetRouter before handing a request to the replica it
+#: routed to — a fault here is a dispatch-path blip the router must
+#: absorb inside the request's bounded failover budget (re-route, never
+#: a client-visible error while a healthy replica remains)
+ROUTER_DISPATCH = "router.dispatch"
+#: fires in the fleet replica supervisor before building a dead
+#: replica's replacement — a fault here simulates a restart that itself
+#: fails: the replica stays out of the roster, surviving replicas keep
+#: serving, and only zero live replicas latches `FleetDeadError`
+REPLICA_RESTART = "replica.restart"
 
 #: THE switch production hooks check. None → injection off (the
 #: permanent state outside resilience tests).

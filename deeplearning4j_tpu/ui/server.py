@@ -21,6 +21,10 @@ Two forms, both dependency-free:
 - `GET /generation` — autoregressive generation status
   (generation/server.py `status()`): per-server slot occupancy, cache
   rung, admission/retirement/token tallies, executable provenance.
+- `GET /fleet` — fleet-router status (generation/fleet.py `status()`):
+  per-replica health / burn rate / rung / slot + queue occupancy,
+  routing and failover tallies, and the autoscale signal (queue depth
+  x SLO burn → desired replica count).
 - `GET /requests` / `GET /requests/<trace-id>` — request-scoped
   tracing (monitoring/requests.py): in-flight + recent per-request
   lifecycle timelines, with latency-histogram exemplars linking a bad
@@ -87,6 +91,12 @@ no profile captured yet</pre></div>
 <code>GET /generation</code>; live while a GenerationServer runs</div>
 <pre id="generation" style="max-height:240px;overflow:auto;font-size:12px">
 no generation servers live</pre></div>
+<div class="chart"><h2>Fleet (replica routing)</h2>
+<div class="meta">Health-driven routing across GenerationServer
+replicas — <code>GET /fleet</code>; per-replica health + burn rate,
+failover tallies, and the autoscale signal</div>
+<pre id="fleet" style="max-height:240px;overflow:auto;font-size:12px">
+no fleet routers live</pre></div>
 <div class="chart"><h2>Requests (trace timelines)</h2>
 <div class="meta">Request-scoped tracing — <code>GET /requests</code>,
 <code>GET /requests/&lt;trace-id&gt;</code>; p99 exemplars link
@@ -226,6 +236,25 @@ async function tick(){
           `${s.per_token_p50_ms ?? '-'} ms p99 ` +
           `${s.per_token_p99_ms ?? '-'} ms · draft ok/ko ` +
           `${s.draft_accepts}/${s.draft_rejects}`).join("\n");
+    }
+  } catch (e) {}
+  try {
+    const fr = await fetch('/fleet'); const fd = await fr.json();
+    if (fd.routers && fd.routers.length){
+      document.getElementById('fleet').textContent =
+        fd.routers.map(f =>
+          f.replicas.map(r =>
+            `${r.name} [${r.health}] burn ${r.burn_short}/` +
+            `${r.burn_long} · slots ${r.active_slots}/${r.slots} · ` +
+            `queued ${r.queued} · routed ${r.routed} · failovers ` +
+            `${r.failovers} · replacements ${r.replacements}`
+          ).join("\n") +
+          `\n  fleet: submitted ${f.submitted} · completed ` +
+          `${f.completed} · failovers ${f.failovers} · shed ` +
+          `${f.shed} · desired replicas ` +
+          `${f.autoscale.desired_replicas} (util ` +
+          `${f.autoscale.utilization} x burn ` +
+          `${f.autoscale.slo_burn})`).join("\n\n");
     }
   } catch (e) {}
   try {
@@ -473,6 +502,16 @@ class UIServer:
                     from deeplearning4j_tpu.generation import \
                         server as _gen
                     body = json.dumps(_gen.status()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/fleet"):
+                    # fleet-router status (generation/fleet.py): every
+                    # live router's per-replica health / burn rate /
+                    # pressure rung / slot + queue occupancy, routing
+                    # and failover tallies, and the autoscale signal
+                    # (queue depth x SLO burn -> desired replicas)
+                    from deeplearning4j_tpu.generation import \
+                        fleet as _fleet
+                    body = json.dumps(_fleet.status()).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/requests"):
                     # request-scoped tracing (monitoring/requests.py):

@@ -64,6 +64,9 @@ HOT_MODULES = [
     "deeplearning4j_tpu/generation/decode.py",
     "deeplearning4j_tpu/generation/sampling.py",
     "deeplearning4j_tpu/generation/paging.py",
+    # fleet router: routed/failover counters ride every request's
+    # relay path — guarded, or the disabled fleet pays per request
+    "deeplearning4j_tpu/generation/fleet.py",
     # quantized inference: the rewritten layers' apply() and the chain
     # executor run inside every served forward — registry calls belong
     # to the rewrite/calibration cold path only
@@ -172,6 +175,24 @@ GENERATION_SYNC_BOUNDARY = {"_fetch_tokens", "_start_fetch"}
 #: calls that mean "the host blocks on (or copies back) device data"
 SYNC_CALL_NAMES = {"asarray", "device_get", "block_until_ready",
                    "item", "tolist", "copy_to_host_async"}
+
+# -- fleet-router hot-path lint --------------------------------------------
+#: the fleet router's route / dispatch / relay / failover walk runs on
+#: EVERY request (and every mid-stream failover): it must stay pure
+#: host bookkeeping — no trace, no device sync. Linted on fleet.py
+#: alone: the replica servers it drives are covered by the generation
+#: lint above, and `submit()` is deliberately NOT a root (prompt
+#: normalization np.asarray lives there, exactly like the server's).
+FLEET_MODULES = ["deeplearning4j_tpu/generation/fleet.py"]
+#: per-request / per-failover entry points: replica selection, the
+#: adopt-hook dispatch, the stream relay pump, the failover decision,
+#: and the health/burn bookkeeping they lean on
+FLEET_ROOTS = {"_route", "_dispatch", "_relay", "_failover",
+               "_health", "_mark", "_retryable", "_finalize"}
+#: the declared cold boundary — replica replacement (supervision) may
+#: warm executables from the shared disk store; the routing walk never
+#: crosses into it
+FLEET_BOUNDARY = {"_supervise", "warmup"}
 
 # -- training-exchange lint (accumulation scan + bucketed exchange) --------
 #: modules forming the distributed train-step hot path: the in-step
@@ -427,6 +448,32 @@ def check_generation_host_sync(sources):
             "per-token host sync"))
 
 
+def check_fleet_trace_free(sources):
+    """Zero traces/compiles on the fleet routing walk: routing reads
+    health snapshots and hands a pre-built request to `adopt()` — a
+    compile reachable from route/dispatch/relay/failover would hide an
+    unbounded stall inside what must be a bounded re-route."""
+    return _check_reachable(
+        sources, FLEET_ROOTS, FLEET_BOUNDARY, TRACE_CALL_NAMES,
+        lambda what, via: (
+            f"{what} reachable from the fleet routing walk (via {via})"
+            " — replica replacement (_supervise) is the only place a "
+            "warmup may happen, and it warms from the shared disk "
+            "store"))
+
+
+def check_fleet_host_sync(sources):
+    """Zero device syncs on the fleet routing walk: the router is pure
+    host plumbing between the client and the replica decode loops —
+    token relaying moves already-fetched ints, never device values."""
+    return _check_reachable(
+        sources, FLEET_ROOTS, FLEET_BOUNDARY, SYNC_CALL_NAMES,
+        lambda what, via: (
+            f"{what} reachable from the fleet routing walk (via {via})"
+            " — the router must never touch device data; the replica's"
+            " _fetch_tokens boundary already did"))
+
+
 def check_timeline_host_sync(sources):
     """Zero host syncs on the step-timeline publish path: publishing a
     per-host digest is JSON over numbers the flight recorder already
@@ -481,6 +528,7 @@ EVENT_HOOK_MODULES = [
     "deeplearning4j_tpu/resilience/watchdog.py",
     "deeplearning4j_tpu/resilience/faults.py",
     "deeplearning4j_tpu/generation/server.py",
+    "deeplearning4j_tpu/generation/fleet.py",
     "deeplearning4j_tpu/parallel/coordination.py",
     "deeplearning4j_tpu/parallel/membership.py",
     "deeplearning4j_tpu/parallel/multihost.py",
@@ -564,6 +612,14 @@ def main(modules=None):
                     gen_sources[path] = f.read()
         violations.extend(check_generation_steady_state(gen_sources))
         violations.extend(check_generation_host_sync(gen_sources))
+        fleet_sources = {}
+        for rel in FLEET_MODULES:
+            path = os.path.join(REPO_ROOT, rel)
+            if os.path.exists(path):
+                with open(path) as f:
+                    fleet_sources[path] = f.read()
+        violations.extend(check_fleet_trace_free(fleet_sources))
+        violations.extend(check_fleet_host_sync(fleet_sources))
         train_sources = {}
         for rel in TRAIN_MODULES:
             path = os.path.join(REPO_ROOT, rel)

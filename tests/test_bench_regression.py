@@ -144,6 +144,39 @@ def test_paged_artifact_gates_its_own_trajectory(tmp_path):
     assert not verdict["ok"] and "regression" in verdict["reason"]
 
 
+def test_fleet_artifact_gates_its_own_trajectory(tmp_path):
+    """BENCH_FLEET_r01.json (the fleet-routing overhead ratio + time-
+    to-healthy from bench_fleet.py) is gated via the explicit `paths`
+    knob like the MULTIHOST/PAGED rounds. The headline is the
+    3-replica aggregate tok/s over ONE bare replica on a single-core
+    host — it guards router overhead (~1x floor), not parallel
+    speedup, so it must never compete with img/s headlines."""
+    art = os.path.join(REPO, "BENCH_FLEET_r01.json")
+    doc = cbr.load_artifact(art)
+    v = cbr.headline_value(doc)
+    assert v is not None and v >= 0.5, \
+        "fleet routing must not halve single-replica throughput"
+    assert doc["fleet"]["replicas"] == 3
+    assert doc["fleet"]["slots"] == doc["single"]["slots"]
+    assert doc["token_identity"]["identical"] is True
+    assert doc["time_to_healthy"]["median_ms"] < 10_000
+    assert doc["time_to_healthy"]["zero_compile"] is True
+    assert all(w["compiled"] == 0 for w in doc["fleet"]["warmup"])
+    # the checked-in round is its own prior: an equal fresh value passes
+    fresh_ok = _write(tmp_path, {"value": v, "metric": doc["metric"],
+                                 "unit": "x"}, "BENCH_FLEET_fresh.json")
+    verdict = cbr.check(fresh_ok, tolerance=0.10, paths=[art])
+    assert verdict["ok"] and verdict["prior"] == v
+    assert os.path.basename(
+        verdict["prior_path"]) == "BENCH_FLEET_r01.json"
+    # a collapsed overhead ratio is a caught regression
+    fresh_bad = _write(tmp_path, {"value": round(v * 0.5, 3),
+                                  "metric": doc["metric"], "unit": "x"},
+                       "BENCH_FLEET_bad.json")
+    verdict = cbr.check(fresh_bad, tolerance=0.10, paths=[art])
+    assert not verdict["ok"] and "regression" in verdict["reason"]
+
+
 def test_multihost_artifact_invisible_to_default_trajectory():
     """The default BENCH_* glob must not pick up the multihost round —
     a 19.9x ratio would otherwise poison the img/s floor."""

@@ -106,6 +106,10 @@ _CATALOG = [
     (ev.PEER_DESYNC, "error"),
     (ev.SLO_BREACH, "error"),
     (ev.SLO_RECOVER, "info"),
+    (ev.REPLICA_UNHEALTHY, "error"),
+    (ev.REPLICA_DRAINED, "warn"),
+    (ev.REPLICA_REPLACED, "info"),
+    (ev.REQUEST_FAILOVER, "warn"),
 ]
 
 

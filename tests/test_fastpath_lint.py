@@ -308,3 +308,63 @@ def test_lint_rejects_guard_after_the_call():
                 return
     """)
     assert len(check_fastpath.check_source(bad)) == 2
+
+
+def test_fleet_lint_pins_fleet_module():
+    """fleet.py is IN the guarded-hook module sets AND the fleet
+    routing-walk lint (a set edit can't silently drop the router's
+    hot path from coverage), and the real router passes both rules:
+    route / dispatch / relay / failover are pure host plumbing."""
+    rel = "deeplearning4j_tpu/generation/fleet.py"
+    assert rel in check_fastpath.HOT_MODULES
+    assert rel in check_fastpath.EVENT_HOOK_MODULES
+    assert check_fastpath.FLEET_MODULES == [rel]
+    for root in ("_route", "_dispatch", "_relay", "_failover"):
+        assert root in check_fastpath.FLEET_ROOTS
+    assert "_supervise" in check_fastpath.FLEET_BOUNDARY
+    path = os.path.join(check_fastpath.REPO_ROOT, rel)
+    assert os.path.exists(path), "lint module vanished: fleet.py"
+    with open(path) as f:
+        src = f.read()
+    assert check_fastpath.check_fleet_trace_free({path: src}) == []
+    assert check_fastpath.check_fleet_host_sync({path: src}) == []
+
+
+def test_fleet_sync_lint_flags_sync_in_relay():
+    """A device materialization reachable from the relay pump is
+    flagged: the router moves already-fetched host ints between the
+    replica stream and the client handle — never device values."""
+    bad = textwrap.dedent("""
+        import numpy as np
+
+        def _relay(self, replica, freq, backend):
+            for tok in self._pull(backend):
+                freq._push(tok)
+
+        def _pull(self, backend):
+            return np.asarray(backend.tokens).tolist()   # host sync!
+    """)
+    v = check_fastpath.check_fleet_host_sync({"m.py": bad})
+    assert len(v) == 2   # asarray AND tolist
+    assert all("routing walk" in msg for _, _, msg in v)
+
+
+def test_fleet_trace_lint_flags_compile_in_dispatch():
+    """A live compile reachable from dispatch is flagged, while the
+    SAME compile inside the declared _supervise boundary is accepted —
+    replica replacement is the one place warmup may happen."""
+    bad = textwrap.dedent("""
+        import jax
+
+        def _dispatch(self, replica, freq):
+            return self._build(freq)
+
+        def _build(self, freq):
+            return jax.jit(lambda x: x)(freq.prompt)   # live compile!
+
+        def _supervise(self, replica, cause):
+            return jax.jit(lambda x: x)(0)   # cold boundary: ok
+    """)
+    v = check_fastpath.check_fleet_trace_free({"m.py": bad})
+    assert len(v) == 1
+    assert "_build" in v[0][2]

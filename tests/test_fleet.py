@@ -1,0 +1,427 @@
+"""FleetRouter functional surface: health-driven routing across
+GenerationServer replicas, the `adopt()` admission hook behind it, the
+zero-admissions rule for burn-breached replicas, deadline propagation,
+the autoscale signal, the cross-host replica registry, and the `/fleet`
+observability endpoint.
+
+The load-bearing invariant everything here leans on: a stream is a
+pure function of (server seed, admission id, prompt, sampling config),
+and the router assigns FLEET-wide admission ids over seed-aligned
+replicas — so fleet output is bit-identical to the same workload on a
+single bare server, whatever the replica count (the chaos twin of this
+file extends that through mid-stream replica kills).
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitoring as mon
+from deeplearning4j_tpu.generation import (FleetRouter, GenerationRequest,
+                                           GenerationServer)
+from deeplearning4j_tpu.generation import fleet as fleet_mod
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.parallel.coordination import LocalKV, PeerCoordinator
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.errors import (InferenceOverloadedError,
+                                                  InferenceTimeoutError)
+
+V = 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_harness():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+    mon.disable()
+
+
+#: module-scoped on-disk executable cache: the FIRST server warmup
+#: pays the XLA compiles, every later replica (and every supervisor
+#: replacement) deserializes from disk
+_CACHE = {"dir": None}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _exec_cache(tmp_path_factory):
+    _CACHE["dir"] = str(tmp_path_factory.mktemp("fleet-exec"))
+    yield
+    _CACHE["dir"] = None
+
+
+def _lstm_net(seed=3, hidden=16):
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+         .weightInit("xavier").list()
+         .layer(LSTM(nOut=hidden, activation="tanh"))
+         .layer(RnnOutputLayer(lossFunction="mcxent", nOut=V,
+                               activation="softmax"))
+         .setInputType(InputType.recurrent(V)).build())).init()
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _lstm_net()
+
+
+def _server(net, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("cache_lengths", [48])
+    kw.setdefault("prompt_buckets", [8])
+    kw.setdefault("method", "greedy")
+    kw.setdefault("seed", 11)
+    kw.setdefault("exec_cache_dir", _CACHE["dir"])
+    return GenerationServer(net, **kw)
+
+
+def _fleet(net, n=3, **kw):
+    return FleetRouter(factory=lambda i: _server(net), num_replicas=n,
+                       **kw)
+
+
+#: mixed sampling configs: temperature/top-k requests prove the rng
+#: identity (seed, admit id) survives routing, not just argmax
+_WORKLOAD = [
+    dict(prompt=[1, 2, 3], max_new_tokens=8),
+    dict(prompt=[5, 4], max_new_tokens=10, method="sample",
+         temperature=0.8),
+    dict(prompt=[7, 3, 2, 1], max_new_tokens=12, method="top_k",
+         temperature=0.9, top_k=3),
+    dict(prompt=[2, 2, 5], max_new_tokens=6),
+]
+
+
+@pytest.fixture(scope="module")
+def want_streams(net):
+    """Fault-free single-server baseline for the shared workload, in
+    the same submission order the fleet tests use."""
+    srv = _server(net)
+    srv.warmup()
+    try:
+        reqs = [srv.submit(**dict(w)) for w in _WORKLOAD]
+        return [list(r.stream(timeout=60)) for r in reqs]
+    finally:
+        srv.shutdown()
+
+
+# -- the adopt() hook (server side of the router contract) ----------------
+
+def test_adopt_matches_submit_stream(net):
+    """adopt() under an explicit admission id reproduces submit()'s
+    stream exactly: admission ids, not admission order, drive the
+    per-request rng."""
+    srv = _server(net)
+    srv.warmup()
+    want = list(srv.submit(**dict(_WORKLOAD[0])).stream(timeout=60))
+    srv.shutdown()
+    srv2 = _server(net)
+    srv2.warmup()
+    try:
+        w = dict(_WORKLOAD[0])
+        req = GenerationRequest(np.asarray(w["prompt"], np.int32),
+                                w["max_new_tokens"], None, 0, 1.0, 0)
+        srv2.adopt(req, admit_id=1)
+        assert list(req.stream(timeout=60)) == want
+    finally:
+        srv2.shutdown()
+
+
+def test_adopt_with_delivered_prefix_streams_continuation_only(net):
+    """A failover re-submission carries the delivered prefix: the
+    adopting server replays it SUPPRESSED — the stream yields only the
+    continuation, and the final token list is bit-identical."""
+    srv = _server(net)
+    srv.warmup()
+    want = list(srv.submit(**dict(_WORKLOAD[0])).stream(timeout=60))
+    srv.shutdown()
+    srv2 = _server(net)
+    srv2.warmup()
+    try:
+        w = dict(_WORKLOAD[0])
+        req = GenerationRequest(np.asarray(w["prompt"], np.int32),
+                                w["max_new_tokens"], None, 0, 1.0, 0)
+        req.tokens = list(want[:3])
+        srv2.adopt(req, admit_id=1)
+        assert list(req.stream(timeout=60)) == want[3:]
+        assert req.tokens == want
+    finally:
+        srv2.shutdown()
+
+
+def test_adopt_with_terminal_prefix_finishes_immediately(net):
+    """A prefix that already exhausted the token budget needs no decode
+    at all — the adopting server just closes the request."""
+    srv = _server(net)
+    srv.warmup()
+    want = list(srv.submit(**dict(_WORKLOAD[0])).stream(timeout=60))
+    srv.shutdown()
+    srv2 = _server(net)
+    srv2.warmup()
+    try:
+        w = dict(_WORKLOAD[0])
+        req = GenerationRequest(np.asarray(w["prompt"], np.int32),
+                                w["max_new_tokens"], None, 0, 1.0, 0)
+        req.tokens = list(want)
+        srv2.adopt(req, admit_id=1)
+        assert list(req.stream(timeout=60)) == []
+        assert req.finish_reason == "length"
+    finally:
+        srv2.shutdown()
+
+
+# -- routing ---------------------------------------------------------------
+
+def test_fleet_streams_bit_identical_to_single_server(net, want_streams):
+    """The tentpole identity: a 3-replica fleet serves the workload
+    bit-identically to one bare server, and every admission went
+    through exactly one replica."""
+    with _fleet(net) as router:
+        reqs = [router.submit(**dict(w)) for w in _WORKLOAD]
+        got = [list(r.stream(timeout=60)) for r in reqs]
+        assert got == want_streams
+        st = router.status()
+        assert sum(r["routed"] for r in st["replicas"]) == len(_WORKLOAD)
+        assert st["completed"] == len(_WORKLOAD)
+        assert st["failovers"] == 0 and st["failed"] == 0
+        # warm spin-up: replicas 2 and 3 deserialized from replica 1's
+        # disk writes — the fleet never compiled the same shape twice
+        for rep in router._replicas[1:]:
+            assert rep.server._store.stats["compiles"] == 0
+
+
+def test_routing_spreads_load_least_loaded_first(net):
+    """With every replica healthy and idle the router spreads the
+    workload instead of piling onto one replica."""
+    with _fleet(net) as router:
+        reqs = [router.submit(**dict(_WORKLOAD[i % len(_WORKLOAD)]))
+                for i in range(6)]
+        for r in reqs:
+            r.result(timeout=60)
+        routed = [rep.routed for rep in router._replicas]
+        assert sum(routed) == 6
+        assert all(n >= 1 for n in routed), routed
+
+
+def test_submit_validation_mirrors_server(net):
+    with _fleet(net, n=1) as router:
+        with pytest.raises(ValueError):
+            router.submit(prompt=[])
+        with pytest.raises(ValueError):
+            router.submit(prompt=list(range(9)))      # > top bucket
+        with pytest.raises(ValueError):
+            router.submit(prompt=[1], max_new_tokens=0)
+        with pytest.raises(ValueError):
+            router.submit(prompt=[1], max_new_tokens=64)  # > top rung
+
+
+def test_replicas_must_be_seed_aligned(net):
+    a = _server(net, seed=11)
+    b = _server(net, seed=12)
+    try:
+        with pytest.raises(ValueError, match="bit-identical"):
+            FleetRouter(replicas=[a, b])
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+# -- health gating ---------------------------------------------------------
+
+def test_burn_breached_replica_gets_zero_admissions_until_recovery(net):
+    """THE acceptance counter: a burn-rate-breached replica receives
+    no new admissions while breached (events.REPLICA_UNHEALTHY marks
+    the transition), and rejoins the pool once its windows age out."""
+    mon.enable()
+    clk = {"t": 100.0}
+    with _fleet(net, clock=lambda: clk["t"]) as router:
+        victim = router._replicas[0]
+        # drive the victim's gauge over budget: all-failure windows
+        for _ in range(6):
+            victim.gauge.record(clk["t"], bad=True)
+        assert victim.health(clk["t"]) == "unhealthy"
+        before = victim.routed
+        reqs = [router.submit(**dict(_WORKLOAD[i % len(_WORKLOAD)]))
+                for i in range(4)]
+        for r in reqs:
+            r.result(timeout=60)
+        assert victim.routed == before, \
+            "a burn-breached replica must receive ZERO admissions"
+        from deeplearning4j_tpu.monitoring import events
+        kinds = [e["kind"]
+                 for e in events.snapshot(last=None)["events"]]
+        assert events.REPLICA_UNHEALTHY in kinds
+        # recovery: bad samples age out of the long window
+        clk["t"] += 30.0
+        assert victim.health(clk["t"]) == "healthy"
+        reqs = [router.submit(**dict(_WORKLOAD[0])) for _ in range(3)]
+        for r in reqs:
+            r.result(timeout=60)
+        assert victim.routed > before, \
+            "a recovered replica must rejoin the admission pool"
+
+
+def test_pressure_degraded_replica_not_admitted(net):
+    """The pressure ladder feeds routing: a degraded replica is
+    skipped while healthy peers remain (shed-to-healthy)."""
+    with _fleet(net, n=2) as router:
+        victim = router._replicas[0]
+        victim.server._pressure = 1
+        victim.server._pressure_ts = time.monotonic()
+        reqs = [router.submit(**dict(_WORKLOAD[0])) for _ in range(3)]
+        for r in reqs:
+            r.result(timeout=60)
+        assert victim.routed == 0
+        assert router._replicas[1].routed == 3
+        assert router.fleet_state()["state"] == "degraded"
+
+
+def test_all_degraded_sheds_typed(net):
+    """Shed-to-floor: zero healthy replicas (but live ones) refuses
+    typed instead of admitting to a degrading replica — and does NOT
+    latch the fleet dead."""
+    with _fleet(net, n=1) as router:
+        router._replicas[0].server._pressure = 2
+        router._replicas[0].server._pressure_ts = time.monotonic()
+        req = router.submit(**dict(_WORKLOAD[0]))
+        with pytest.raises(InferenceOverloadedError):
+            req.result(timeout=30)
+        assert router.status()["shed"] == 1
+        assert router._dead is None
+        # recovery: pressure clears, the same fleet serves again
+        router._replicas[0].server._pressure = 0
+        assert router.submit(
+            **dict(_WORKLOAD[0])).result(timeout=60) is not None
+
+
+def test_expired_deadline_fails_typed_before_dispatch(net):
+    with _fleet(net, n=1) as router:
+        req = router.submit(**dict(_WORKLOAD[0]), timeout_ms=-1.0)
+        with pytest.raises(InferenceTimeoutError):
+            req.result(timeout=30)
+
+
+# -- observability / autoscale / registry ----------------------------------
+
+def test_request_timeline_carries_route_entries(net):
+    mon.enable()
+    with _fleet(net, n=2) as router:
+        req = router.submit(**dict(_WORKLOAD[0]))
+        req.result(timeout=60)
+        assert req.trace is not None and req.trace.kind == "fleet"
+        evs = [e["event"] for e in req.trace.snapshot()["events"]]
+        assert "route" in evs
+
+
+def test_fleet_metrics_emitted_under_monitoring(net):
+    mon.enable()
+    with _fleet(net, n=2) as router:
+        router.submit(**dict(_WORKLOAD[0])).result(timeout=60)
+        router.autoscale()
+        names = set(mon.get_registry().snapshot())
+        assert mon.FLEET_ROUTED in names
+        assert mon.FLEET_HEALTHY in names
+        assert mon.FLEET_DESIRED_REPLICAS in names
+
+
+def test_autoscale_signal_shape_and_floor(net):
+    with _fleet(net, n=2) as router:
+        sig = router.autoscale()
+        assert sig["replicas_live"] == 2
+        assert sig["replicas_healthy"] == 2
+        assert sig["desired_replicas"] >= 1
+        assert 0.0 <= sig["utilization"] <= 1.0
+        assert sig["slo_burn"] >= 1.0
+        # a dead pool asks for a full replacement roster
+        for rep in router._replicas:
+            rep.server._pressure = 3
+            rep.server._pressure_ts = time.monotonic()
+        assert router.autoscale()["replicas_healthy"] == 0
+
+
+def test_fleet_status_and_health_snapshot(net):
+    with _fleet(net, n=2) as router:
+        router.submit(**dict(_WORKLOAD[0])).result(timeout=60)
+        st = router.status()
+        assert {r["name"] for r in st["replicas"]} == {"r0", "r1"}
+        assert all(r["health"] == "healthy" for r in st["replicas"])
+        fs = router.fleet_state()
+        assert fs["state"] == "serving"
+        from deeplearning4j_tpu import resilience
+        snap = resilience.health_snapshot()
+        assert snap["fleet"] is not None
+        assert any(f["state"] == "serving" for f in snap["fleet"])
+        assert fleet_mod.status()["routers"]
+
+
+def test_replica_registry_publishes_over_coordination_kv(net):
+    """The cross-host half: each process publishes its replica roster
+    under fleet/<pid>; directory() merges the views."""
+    kv = LocalKV()
+    c0 = PeerCoordinator(sync_every=2, client=kv, process_id=0,
+                         num_processes=2)
+    c1 = PeerCoordinator(sync_every=2, client=kv, process_id=1,
+                         num_processes=2)
+    with _fleet(net, n=2) as router:
+        doc = router.publish(coordinator=c0)
+        assert doc["process_id"] == 0
+        router.publish(coordinator=c1)
+        view = fleet_mod.directory(coordinator=c0)
+        assert set(view) == {"0", "1"}
+        assert len(view["0"]["replicas"]) == 2
+        assert view["1"]["autoscale"]["desired_replicas"] >= 1
+
+
+def test_fleet_endpoint_serves_router_status(net):
+    from deeplearning4j_tpu.ui.server import UIServer
+    with _fleet(net, n=2) as router:
+        router.submit(**dict(_WORKLOAD[0])).result(timeout=60)
+        server = UIServer.getInstance()
+        server.start(port=0)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            data = json.loads(urllib.request.urlopen(
+                base + "/fleet", timeout=10).read().decode())
+            routers = data["routers"]
+            assert routers and len(routers[0]["replicas"]) == 2
+            assert routers[0]["autoscale"]["desired_replicas"] >= 1
+        finally:
+            server.stop()
+
+
+def test_shutdown_refuses_new_submits(net):
+    router = _fleet(net, n=1)
+    router.warmup()
+    router.submit(**dict(_WORKLOAD[0])).result(timeout=60)
+    router.shutdown()
+    with pytest.raises(RuntimeError):
+        router.submit(**dict(_WORKLOAD[0]))
+
+
+def test_idle_replica_death_revived_off_the_dispatch_path(net):
+    """An IDLE replica that dies (no in-flight stream to observe it)
+    is revived by a background supervision kick from the next routed
+    request — the dispatch itself lands on a healthy survivor and the
+    roster returns to full strength without draining the fleet."""
+    with _fleet(net, n=2) as router:
+        victim = router._replicas[1]
+        victim.server._die(RuntimeError("idle chaos kill"))
+        assert victim.health(time.monotonic()) == "dead"
+        # a routed request kicks the reviver and is served elsewhere
+        assert router.submit(**dict(_WORKLOAD[0])).result(
+            timeout=60) is not None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if victim.health(time.monotonic()) == "healthy":
+                break
+            time.sleep(0.05)
+        assert victim.health(time.monotonic()) == "healthy"
+        assert victim.replacements == 1
+        assert victim.server._store.stats["compiles"] == 0
+        assert router.fleet_state()["state"] == "serving"

@@ -1,0 +1,276 @@
+"""Fleet-level chaos: seeded fault injection against the FleetRouter.
+
+The headline soak kills 1-of-3 replicas MID-STREAM (a seeded
+`GENERATION_STEP` fault with `max_consecutive_failures=0` turns the
+Nth decode step into an immediate replica death) and asserts the
+fleet's whole robustness story at once:
+
+- zero client-visible failures — every stream completes;
+- streams BIT-IDENTICAL to the fault-free single-server baseline
+  (fleet-wide admission ids over seed-aligned replicas make a stream a
+  pure function of (seed, admit id, prompt, sampling config); the
+  failover replay suppresses the delivered prefix);
+- one ordered incident on the ops journal — replica-lost
+  (`replica.unhealthy`) → drain (`replica.drained`) → replace
+  (`replica.replaced`, resolving) with the `request.failover` actions
+  absorbed while it was open;
+- the supervisor's replacement replica performed ZERO live compiles
+  (warm spin-up from the shared disk FunctionStore).
+
+Fault sites driven here (scripts/check_fault_coverage.py):
+ROUTER_DISPATCH (dispatch-path blips absorbed by the bounded failover
+budget, and typed exhaustion when the budget runs out) and
+REPLICA_RESTART (a replacement build that itself fails leaves the slot
+dead — the fleet keeps serving on the survivors, and only zero live
+replicas latches `FleetDeadError`).
+"""
+import threading
+
+import pytest
+
+from deeplearning4j_tpu import monitoring as mon
+from deeplearning4j_tpu.generation import FleetRouter, GenerationServer
+from deeplearning4j_tpu.monitoring import events
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.errors import (FleetDeadError,
+                                                  InjectedFault,
+                                                  ServerDeadError)
+
+V = 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_harness():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+    mon.disable()
+
+
+_CACHE = {"dir": None}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _exec_cache(tmp_path_factory):
+    _CACHE["dir"] = str(tmp_path_factory.mktemp("fleet-chaos-exec"))
+    yield
+    _CACHE["dir"] = None
+
+
+def _lstm_net(seed=3, hidden=16):
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+         .weightInit("xavier").list()
+         .layer(LSTM(nOut=hidden, activation="tanh"))
+         .layer(RnnOutputLayer(lossFunction="mcxent", nOut=V,
+                               activation="softmax"))
+         .setInputType(InputType.recurrent(V)).build())).init()
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _lstm_net()
+
+
+def _server(net, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("cache_lengths", [48])
+    kw.setdefault("prompt_buckets", [8])
+    kw.setdefault("method", "greedy")
+    kw.setdefault("seed", 11)
+    kw.setdefault("exec_cache_dir", _CACHE["dir"])
+    # chaos servers die on the FIRST step failure: no in-process
+    # supervised restart — replica death is the FLEET's problem here
+    kw.setdefault("max_consecutive_failures", 0)
+    return GenerationServer(net, **kw)
+
+
+def _fleet(net, n=3, **kw):
+    return FleetRouter(factory=lambda i: _server(net), num_replicas=n,
+                       **kw)
+
+
+_WORKLOAD = [
+    dict(prompt=[1, 2, 3], max_new_tokens=8),
+    dict(prompt=[5, 4], max_new_tokens=10, method="sample",
+         temperature=0.8),
+    dict(prompt=[7, 3, 2, 1], max_new_tokens=12, method="top_k",
+         temperature=0.9, top_k=3),
+    dict(prompt=[2, 2, 5], max_new_tokens=6),
+]
+
+
+@pytest.fixture(scope="module")
+def want_streams(net):
+    srv = _server(net)
+    srv.warmup()
+    try:
+        reqs = [srv.submit(**dict(w)) for w in _WORKLOAD]
+        return [list(r.stream(timeout=60)) for r in reqs]
+    finally:
+        srv.shutdown()
+
+
+def _consume(reqs, timeout=60):
+    """The production shape: one streaming consumer thread per
+    request. Returns (token lists, errors)."""
+    out = [None] * len(reqs)
+    errs = [None] * len(reqs)
+
+    def run(i, req):
+        try:
+            out[i] = list(req.stream(timeout=timeout))
+        except Exception as e:  # noqa: BLE001 — asserted by callers
+            errs[i] = e
+
+    threads = [threading.Thread(target=run, args=(i, r))
+               for i, r in enumerate(reqs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout + 30)
+    assert not any(t.is_alive() for t in threads), "consumer hung"
+    return out, errs
+
+
+def _kind(x):
+    return x.get("kind") if isinstance(x, dict) else x
+
+
+# -- the headline soak -----------------------------------------------------
+
+def test_fleet_chaos_soak_replica_killed_mid_stream(net, want_streams):
+    """Kill 1-of-3 replicas mid-stream (seeded): zero client-visible
+    failures, bit-identical streams, one ordered replica-lost →
+    drain → replace incident, zero-compile replacement."""
+    mon.enable()
+    events.reset()
+    plan = faults.FaultPlan(seed=5).fail_at(faults.GENERATION_STEP, 12)
+    with plan:
+        with _fleet(net) as router:
+            reqs = [router.submit(**dict(w)) for w in _WORKLOAD]
+            out, errs = _consume(reqs)
+            assert errs == [None] * len(reqs), errs
+            assert out == want_streams, "failover must continue the "\
+                "stream bit-identically to an uninterrupted run"
+            assert plan.fired[faults.GENERATION_STEP] == 1
+            st = router.status()
+            assert st["failovers"] >= 1
+            assert st["replacements"] == 1
+            assert st["failed"] == 0 and st["shed"] == 0
+            assert router._dead is None, \
+                "one lost replica must never latch the fleet dead"
+            assert router.fleet_state()["state"] == "serving"
+            # warm spin-up: the replacement (and every survivor)
+            # resolved everything from the shared disk store
+            for rep in router._replicas:
+                assert rep.server._store.stats["compiles"] == 0
+    # the episode is ONE ordered incident on the ops journal
+    incs = events.incidents()
+    closed = [i for i in incs["recent"] + incs["open"]
+              if events.REPLICA_REPLACED in i["kinds"]]
+    assert closed, f"no replica-lost incident correlated: {incs}"
+    inc = closed[0]
+    kinds = inc["kinds"]
+    assert events.REPLICA_UNHEALTHY in kinds
+    assert kinds.index(events.REPLICA_UNHEALTHY) \
+        < kinds.index(events.REPLICA_DRAINED) \
+        < kinds.index(events.REPLICA_REPLACED)
+    assert _kind(inc["resolution"]) == events.REPLICA_REPLACED
+    assert inc["state"] == "resolved"
+    all_kinds = [e["kind"] for e in events.snapshot(last=None)["events"]]
+    assert events.REQUEST_FAILOVER in all_kinds
+
+
+def test_fleet_dispatch_chaos_absorbed_within_budget(net):
+    """Seeded dispatch-path blips (every 5th ROUTER_DISPATCH faults):
+    the bounded failover budget absorbs every one — the full workload
+    completes bit-identically with zero client-visible errors. The
+    baseline is the SAME 8-request submission order on one bare server
+    (streams are a function of the admission id, so an 8-deep workload
+    needs its own fault-free run)."""
+    srv = _server(net)
+    srv.warmup()
+    try:
+        base = [srv.submit(**dict(_WORKLOAD[i % len(_WORKLOAD)]))
+                for i in range(8)]
+        want = [list(r.stream(timeout=60)) for r in base]
+    finally:
+        srv.shutdown()
+    plan = faults.FaultPlan(seed=7).every(faults.ROUTER_DISPATCH, 5)
+    with plan:
+        with _fleet(net, failover_budget=6) as router:
+            reqs = [router.submit(**dict(_WORKLOAD[i % len(_WORKLOAD)]))
+                    for i in range(8)]
+            out, errs = _consume(reqs)
+            assert errs == [None] * len(reqs), errs
+            assert out == want
+            assert plan.fired[faults.ROUTER_DISPATCH] >= 1
+            assert router.status()["failovers"] \
+                >= plan.fired[faults.ROUTER_DISPATCH]
+            assert router.status()["failed"] == 0
+
+
+def test_fleet_dispatch_budget_exhaustion_fails_typed(net):
+    """A dispatch path that faults EVERY time exhausts the bounded
+    failover budget and surfaces the typed injected error — promptly,
+    never a hang."""
+    plan = faults.FaultPlan(seed=3).every(faults.ROUTER_DISPATCH, 1)
+    with plan:
+        with _fleet(net, failover_budget=2) as router:
+            req = router.submit(**dict(_WORKLOAD[0]))
+            with pytest.raises(InjectedFault):
+                req.result(timeout=30)
+            st = router.status()
+            assert st["failed"] == 1
+            assert st["failovers"] == 2       # the whole budget
+            assert plan.fired[faults.ROUTER_DISPATCH] == 3
+
+
+def test_replica_restart_fault_leaves_slot_dead_fleet_serves_on(net,
+                                                                want_streams):
+    """A replacement build that itself fails (REPLICA_RESTART fault):
+    the slot stays dead, the in-flight stream completes bit-identically
+    on the survivor, and the fleet keeps serving degraded — no latch."""
+    plan = (faults.FaultPlan(seed=9)
+            .fail_at(faults.GENERATION_STEP, 6)
+            .every(faults.REPLICA_RESTART, 1))
+    with plan:
+        with _fleet(net, n=2) as router:
+            reqs = [router.submit(**dict(w)) for w in _WORKLOAD[:2]]
+            out, errs = _consume(reqs)
+            assert errs == [None, None], errs
+            assert out == want_streams[:2]
+            assert plan.fired[faults.GENERATION_STEP] == 1
+            assert plan.fired[faults.REPLICA_RESTART] >= 1
+            st = router.status()
+            assert st["replacements"] == 0
+            healths = [r["health"] for r in st["replicas"]]
+            assert sorted(healths) == ["dead", "healthy"]
+            assert router.fleet_state()["state"] == "degraded"
+            assert router._dead is None
+            # the survivor carries new traffic alone
+            assert router.submit(**dict(_WORKLOAD[0])).result(
+                timeout=60) == want_streams[0]
+
+
+def test_fleet_dead_latches_only_at_zero_live_replicas(net):
+    """THE latch rule: a single-replica fleet whose replica dies with
+    no restart budget fails open requests with the typed
+    `FleetDeadError` (a ServerDeadError subclass) and refuses every
+    later submit — but only because ZERO live replicas remain."""
+    plan = faults.FaultPlan(seed=4).fail_at(faults.GENERATION_STEP, 3)
+    with plan:
+        with _fleet(net, n=1, restart_budget=0) as router:
+            req = router.submit(**dict(_WORKLOAD[0]))
+            with pytest.raises(FleetDeadError) as ei:
+                req.result(timeout=30)
+            assert isinstance(ei.value, ServerDeadError)
+            assert router._dead is not None
+            assert router.fleet_state()["state"] == "dead"
+            with pytest.raises(FleetDeadError):
+                router.submit(**dict(_WORKLOAD[0]))
